@@ -1,0 +1,226 @@
+"""Defaulting tests, modeled on the reference's pkg/apis/*/v1/defaults_test.go."""
+
+import pytest
+
+from tf_operator_tpu.api import common, jaxjob, mxjob, pytorchjob, tfjob, xgboostjob
+from tf_operator_tpu.api.k8s import Container, ObjectMeta, PodSpec, PodTemplateSpec
+
+
+def make_tfjob(worker_replicas=1, container_name=tfjob.DEFAULT_CONTAINER_NAME):
+    return tfjob.TFJob(
+        metadata=ObjectMeta(name="test-tfjob", namespace="default"),
+        spec=tfjob.TFJobSpec(
+            tf_replica_specs={
+                tfjob.REPLICA_TYPE_WORKER: common.ReplicaSpec(
+                    replicas=worker_replicas,
+                    template=PodTemplateSpec(
+                        spec=PodSpec(containers=[Container(name=container_name, image="img")])
+                    ),
+                )
+            }
+        ),
+    )
+
+
+class TestTFJobDefaults:
+    def test_clean_pod_policy_defaults_to_running(self):
+        job = make_tfjob()
+        tfjob.set_defaults(job)
+        assert job.spec.run_policy.clean_pod_policy == common.CLEAN_POD_POLICY_RUNNING
+
+    def test_success_policy_defaults_to_empty(self):
+        job = make_tfjob()
+        tfjob.set_defaults(job)
+        assert job.spec.success_policy == tfjob.SUCCESS_POLICY_DEFAULT
+
+    def test_replicas_default_to_one(self):
+        job = make_tfjob()
+        job.spec.tf_replica_specs[tfjob.REPLICA_TYPE_WORKER].replicas = None
+        tfjob.set_defaults(job)
+        assert job.spec.tf_replica_specs[tfjob.REPLICA_TYPE_WORKER].replicas == 1
+
+    def test_restart_policy_defaults_to_never(self):
+        job = make_tfjob()
+        tfjob.set_defaults(job)
+        assert (
+            job.spec.tf_replica_specs[tfjob.REPLICA_TYPE_WORKER].restart_policy
+            == common.RESTART_POLICY_NEVER
+        )
+
+    def test_default_port_injected(self):
+        job = make_tfjob()
+        tfjob.set_defaults(job)
+        ports = job.spec.tf_replica_specs[tfjob.REPLICA_TYPE_WORKER].template.spec.containers[0].ports
+        assert any(
+            p.name == tfjob.DEFAULT_PORT_NAME and p.container_port == tfjob.DEFAULT_PORT
+            for p in ports
+        )
+
+    def test_existing_port_not_overwritten(self):
+        from tf_operator_tpu.api.k8s import ContainerPort
+
+        job = make_tfjob()
+        spec = job.spec.tf_replica_specs[tfjob.REPLICA_TYPE_WORKER]
+        spec.template.spec.containers[0].ports.append(
+            ContainerPort(name=tfjob.DEFAULT_PORT_NAME, container_port=12345)
+        )
+        tfjob.set_defaults(job)
+        ports = spec.template.spec.containers[0].ports
+        assert len(ports) == 1 and ports[0].container_port == 12345
+
+    def test_replica_type_case_normalization(self):
+        # "worker" (lowercase) must normalize to "Worker" (reference
+        # defaults.go:setTypeNamesToCamelCase).
+        job = make_tfjob()
+        spec = job.spec.tf_replica_specs.pop(tfjob.REPLICA_TYPE_WORKER)
+        job.spec.tf_replica_specs["worker"] = spec
+        tfjob.set_defaults(job)
+        assert list(job.spec.tf_replica_specs) == [tfjob.REPLICA_TYPE_WORKER]
+
+
+class TestOtherKindDefaults:
+    def test_pytorch_restart_policy_on_failure(self):
+        job = pytorchjob.PyTorchJob(
+            spec=pytorchjob.PyTorchJobSpec(
+                pytorch_replica_specs={
+                    pytorchjob.REPLICA_TYPE_MASTER: common.ReplicaSpec(
+                        template=PodTemplateSpec(
+                            spec=PodSpec(containers=[Container(name="pytorch", image="img")])
+                        )
+                    )
+                }
+            )
+        )
+        pytorchjob.set_defaults(job)
+        master = job.spec.pytorch_replica_specs[pytorchjob.REPLICA_TYPE_MASTER]
+        assert master.restart_policy == common.RESTART_POLICY_ON_FAILURE
+        assert master.replicas == 1
+        assert master.template.spec.containers[0].ports[0].container_port == 23456
+
+    def test_mxnet_defaults(self):
+        job = mxjob.MXJob(
+            spec=mxjob.MXJobSpec(
+                mx_replica_specs={
+                    mxjob.REPLICA_TYPE_WORKER: common.ReplicaSpec(
+                        template=PodTemplateSpec(
+                            spec=PodSpec(containers=[Container(name="mxnet", image="img")])
+                        )
+                    )
+                }
+            )
+        )
+        mxjob.set_defaults(job)
+        assert job.spec.job_mode == mxjob.JOB_MODE_TRAIN
+        worker = job.spec.mx_replica_specs[mxjob.REPLICA_TYPE_WORKER]
+        assert worker.template.spec.containers[0].ports[0].container_port == 9091
+
+    def test_xgboost_defaults(self):
+        job = xgboostjob.XGBoostJob(
+            spec=xgboostjob.XGBoostJobSpec(
+                xgb_replica_specs={
+                    xgboostjob.REPLICA_TYPE_MASTER: common.ReplicaSpec(
+                        template=PodTemplateSpec(
+                            spec=PodSpec(containers=[Container(name="xgboost", image="img")])
+                        )
+                    )
+                }
+            )
+        )
+        xgboostjob.set_defaults(job)
+        master = job.spec.xgb_replica_specs[xgboostjob.REPLICA_TYPE_MASTER]
+        assert master.template.spec.containers[0].ports[0].container_port == 9999
+        assert master.restart_policy == common.RESTART_POLICY_NEVER
+
+
+class TestJAXJobDefaults:
+    def _job(self, accelerator="v5e-32", num_slices=1, replicas=None):
+        return jaxjob.JAXJob(
+            spec=jaxjob.JAXJobSpec(
+                jax_replica_specs={
+                    jaxjob.REPLICA_TYPE_WORKER: common.ReplicaSpec(
+                        replicas=replicas,
+                        template=PodTemplateSpec(
+                            spec=PodSpec(containers=[Container(name="jax", image="img")])
+                        ),
+                    )
+                },
+                tpu=jaxjob.TPUSpec(accelerator_type=accelerator),
+                num_slices=num_slices,
+            )
+        )
+
+    def test_replicas_default_to_slice_hosts(self):
+        job = self._job("v5e-32")  # 32 chips / 4 per host = 8 hosts
+        jaxjob.set_defaults(job)
+        assert job.spec.jax_replica_specs[jaxjob.REPLICA_TYPE_WORKER].replicas == 8
+
+    def test_multislice_replicas(self):
+        job = self._job("v5e-16", num_slices=2)  # 4 hosts per slice x 2
+        jaxjob.set_defaults(job)
+        assert job.spec.jax_replica_specs[jaxjob.REPLICA_TYPE_WORKER].replicas == 8
+
+    def test_gang_min_available_pinned_to_full_slice(self):
+        job = self._job("v5e-32")
+        jaxjob.set_defaults(job)
+        assert job.spec.run_policy.scheduling_policy.min_available == 8
+
+    def test_restart_policy_defaults_to_exit_code(self):
+        job = self._job()
+        jaxjob.set_defaults(job)
+        worker = job.spec.jax_replica_specs[jaxjob.REPLICA_TYPE_WORKER]
+        assert worker.restart_policy == common.RESTART_POLICY_EXIT_CODE
+
+
+class TestSerialization:
+    def test_tfjob_roundtrip(self):
+        manifest = {
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "TFJob",
+            "metadata": {"name": "dist-mnist", "namespace": "kubeflow"},
+            "spec": {
+                "tfReplicaSpecs": {
+                    "PS": {
+                        "replicas": 2,
+                        "restartPolicy": "Never",
+                        "template": {
+                            "spec": {
+                                "containers": [
+                                    {"name": "tensorflow", "image": "dist-mnist:1.0"}
+                                ]
+                            }
+                        },
+                    },
+                    "Worker": {
+                        "replicas": 4,
+                        "template": {
+                            "spec": {
+                                "containers": [
+                                    {"name": "tensorflow", "image": "dist-mnist:1.0"}
+                                ]
+                            }
+                        },
+                    },
+                },
+                "runPolicy": {"cleanPodPolicy": "All", "backoffLimit": 3},
+            },
+        }
+        job = tfjob.TFJob.parse(manifest)
+        assert job.name == "dist-mnist"
+        assert job.spec.tf_replica_specs["PS"].replicas == 2
+        assert job.spec.tf_replica_specs["Worker"].replicas == 4
+        assert job.spec.run_policy.clean_pod_policy == "All"
+        assert job.spec.run_policy.backoff_limit == 3
+
+        out = job.to_dict()
+        assert out["spec"]["tfReplicaSpecs"]["Worker"]["replicas"] == 4
+        assert out["spec"]["runPolicy"]["cleanPodPolicy"] == "All"
+        # Round-trip through parse again is stable.
+        assert tfjob.TFJob.parse(out).to_dict() == out
+
+    def test_parse_job_dispatches_by_kind(self):
+        from tf_operator_tpu.api import parse_job
+
+        job = parse_job({"kind": "JAXJob", "metadata": {"name": "j"}, "spec": {}})
+        assert isinstance(job, jaxjob.JAXJob)
+        with pytest.raises(Exception):
+            parse_job({"kind": "Nope"})

@@ -1,0 +1,183 @@
+"""Validation tests, modeled on the reference's pkg/apis/*/validation tests."""
+
+import pytest
+
+from tf_operator_tpu.api import common, jaxjob, mxjob, pytorchjob, tfjob, xgboostjob
+from tf_operator_tpu.api.defaulting import ValidationError
+from tf_operator_tpu.api.k8s import Container, PodSpec, PodTemplateSpec
+
+
+def replica(container_name, image="img", replicas=1):
+    return common.ReplicaSpec(
+        replicas=replicas,
+        template=PodTemplateSpec(
+            spec=PodSpec(containers=[Container(name=container_name, image=image)])
+        ),
+    )
+
+
+class TestTFJobValidation:
+    def test_nil_specs_invalid(self):
+        with pytest.raises(ValidationError):
+            tfjob.validate(tfjob.TFJobSpec())
+
+    def test_valid_spec(self):
+        spec = tfjob.TFJobSpec(
+            tf_replica_specs={
+                tfjob.REPLICA_TYPE_WORKER: replica("tensorflow"),
+                tfjob.REPLICA_TYPE_PS: replica("tensorflow"),
+            }
+        )
+        tfjob.validate(spec)
+
+    def test_missing_image_invalid(self):
+        spec = tfjob.TFJobSpec(
+            tf_replica_specs={tfjob.REPLICA_TYPE_WORKER: replica("tensorflow", image="")}
+        )
+        with pytest.raises(ValidationError, match="Image is undefined"):
+            tfjob.validate(spec)
+
+    def test_wrong_container_name_invalid(self):
+        spec = tfjob.TFJobSpec(
+            tf_replica_specs={tfjob.REPLICA_TYPE_WORKER: replica("not-tensorflow")}
+        )
+        with pytest.raises(ValidationError, match="no container named tensorflow"):
+            tfjob.validate(spec)
+
+    def test_two_chiefs_invalid(self):
+        spec = tfjob.TFJobSpec(
+            tf_replica_specs={
+                tfjob.REPLICA_TYPE_CHIEF: replica("tensorflow"),
+                tfjob.REPLICA_TYPE_MASTER: replica("tensorflow"),
+            }
+        )
+        with pytest.raises(ValidationError, match="more than 1 chief/master"):
+            tfjob.validate(spec)
+
+    def test_no_containers_invalid(self):
+        spec = tfjob.TFJobSpec(
+            tf_replica_specs={
+                tfjob.REPLICA_TYPE_WORKER: common.ReplicaSpec(template=PodTemplateSpec())
+            }
+        )
+        with pytest.raises(ValidationError, match="containers definition expected"):
+            tfjob.validate(spec)
+
+
+class TestPyTorchJobValidation:
+    def test_master_required(self):
+        spec = pytorchjob.PyTorchJobSpec(
+            pytorch_replica_specs={pytorchjob.REPLICA_TYPE_WORKER: replica("pytorch")}
+        )
+        with pytest.raises(ValidationError, match="Master ReplicaSpec must be present"):
+            pytorchjob.validate(spec)
+
+    def test_single_master_enforced(self):
+        spec = pytorchjob.PyTorchJobSpec(
+            pytorch_replica_specs={
+                pytorchjob.REPLICA_TYPE_MASTER: replica("pytorch", replicas=2)
+            }
+        )
+        with pytest.raises(ValidationError, match="only 1 master"):
+            pytorchjob.validate(spec)
+
+    def test_invalid_replica_type(self):
+        spec = pytorchjob.PyTorchJobSpec(
+            pytorch_replica_specs={
+                pytorchjob.REPLICA_TYPE_MASTER: replica("pytorch"),
+                "Chief": replica("pytorch"),
+            }
+        )
+        with pytest.raises(ValidationError, match="must be one of"):
+            pytorchjob.validate(spec)
+
+    def test_valid(self):
+        spec = pytorchjob.PyTorchJobSpec(
+            pytorch_replica_specs={
+                pytorchjob.REPLICA_TYPE_MASTER: replica("pytorch"),
+                pytorchjob.REPLICA_TYPE_WORKER: replica("pytorch", replicas=3),
+            }
+        )
+        pytorchjob.validate(spec)
+
+
+class TestMXJobValidation:
+    def test_two_schedulers_invalid(self):
+        spec = mxjob.MXJobSpec(
+            mx_replica_specs={
+                mxjob.REPLICA_TYPE_SCHEDULER: replica("mxnet"),
+            }
+        )
+        mxjob.validate(spec)  # one scheduler fine
+
+    def test_container_name(self):
+        spec = mxjob.MXJobSpec(mx_replica_specs={mxjob.REPLICA_TYPE_WORKER: replica("bad")})
+        with pytest.raises(ValidationError):
+            mxjob.validate(spec)
+
+
+class TestXGBoostJobValidation:
+    def test_master_required(self):
+        spec = xgboostjob.XGBoostJobSpec(
+            xgb_replica_specs={xgboostjob.REPLICA_TYPE_WORKER: replica("xgboost")}
+        )
+        with pytest.raises(ValidationError, match="Master ReplicaSpec must be present"):
+            xgboostjob.validate(spec)
+
+    def test_valid(self):
+        spec = xgboostjob.XGBoostJobSpec(
+            xgb_replica_specs={
+                xgboostjob.REPLICA_TYPE_MASTER: replica("xgboost"),
+                xgboostjob.REPLICA_TYPE_WORKER: replica("xgboost", replicas=2),
+            }
+        )
+        xgboostjob.validate(spec)
+
+
+class TestJAXJobValidation:
+    def test_valid(self):
+        spec = jaxjob.JAXJobSpec(
+            jax_replica_specs={jaxjob.REPLICA_TYPE_WORKER: replica("jax", replicas=8)},
+            tpu=jaxjob.TPUSpec(accelerator_type="v5e-32"),
+        )
+        jaxjob.validate(spec)
+
+    def test_unknown_accelerator(self):
+        spec = jaxjob.JAXJobSpec(
+            jax_replica_specs={jaxjob.REPLICA_TYPE_WORKER: replica("jax")},
+            tpu=jaxjob.TPUSpec(accelerator_type="v99-1"),
+        )
+        with pytest.raises(ValidationError, match="unknown TPU accelerator"):
+            jaxjob.validate(spec)
+
+    def test_replica_topology_mismatch(self):
+        spec = jaxjob.JAXJobSpec(
+            jax_replica_specs={jaxjob.REPLICA_TYPE_WORKER: replica("jax", replicas=3)},
+            tpu=jaxjob.TPUSpec(accelerator_type="v5e-32"),  # needs 8 hosts
+        )
+        with pytest.raises(ValidationError, match="requires 8 workers"):
+            jaxjob.validate(spec)
+
+    def test_mesh_chip_count_mismatch(self):
+        spec = jaxjob.JAXJobSpec(
+            jax_replica_specs={jaxjob.REPLICA_TYPE_WORKER: replica("jax", replicas=8)},
+            tpu=jaxjob.TPUSpec(accelerator_type="v5e-32"),
+            mesh={"fsdp": 8, "tp": 2},  # 16 != 32
+        )
+        with pytest.raises(ValidationError, match="mesh"):
+            jaxjob.validate(spec)
+
+    def test_mesh_matching_chips_valid(self):
+        spec = jaxjob.JAXJobSpec(
+            jax_replica_specs={jaxjob.REPLICA_TYPE_WORKER: replica("jax", replicas=8)},
+            tpu=jaxjob.TPUSpec(accelerator_type="v5e-32"),
+            mesh={"fsdp": 8, "tp": 4},
+        )
+        jaxjob.validate(spec)
+
+    def test_exit_code_retry_taxonomy(self):
+        # 1-127 permanent, 128+ retryable (reference design doc :84).
+        assert not common.is_retryable_exit_code(1)
+        assert not common.is_retryable_exit_code(127)
+        assert common.is_retryable_exit_code(128)
+        assert common.is_retryable_exit_code(137)

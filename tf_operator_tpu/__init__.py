@@ -1,0 +1,26 @@
+"""tf_operator_tpu — a TPU-native training operator.
+
+A from-scratch rebuild of the capabilities of savvihub/tf-operator (the
+Kubeflow TF/training operator, reference at /root/reference) designed
+TPU-first:
+
+- CRD-style job kinds (``TFJob``, ``PyTorchJob``, ``MXJob``, ``XGBoostJob``
+  and the new ``JAXJob``) with the reference's defaulting + validation
+  semantics (reference: pkg/apis/*/v1).
+- A reconciler engine (re-owning what the reference imports from
+  kubeflow/common v0.3.4: ReconcileJobs / ReconcilePods / ReconcileServices,
+  expectations, run-policy enforcement — reference: §2.9 of SURVEY.md).
+- TPU pod-slices as the all-or-nothing gang unit, and JAX/XLA bootstrap env
+  (``jax.distributed`` coordinator, ``TPU_WORKER_ID``, mesh coordinates)
+  instead of GPU-era rendezvous env.
+- A JAX/Flax workload tier (models/, ops/, parallel/, train/) providing the
+  example workloads and the performance-bearing compute path: SPMD over
+  ``jax.sharding.Mesh`` via ``jit``/``shard_map``, Pallas TPU kernels for
+  attention, ring-attention sequence parallelism for long context.
+
+The control plane is pure Python (the reference control plane is pure Go; it
+contains no native code — SURVEY.md §2), while the compute path lowers to
+XLA/Pallas on TPU.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,40 @@
+"""API layer: shared job vocabulary + the five job kinds.
+
+Mirrors the reference's pkg/apis tree (SURVEY.md §2.2) with the kubeflow/common
+shared types owned in-repo (SURVEY.md §2.9), plus the TPU-native JAXJob.
+"""
+
+from . import common, jaxjob, k8s, mxjob, pytorchjob, tfjob, xgboostjob
+from .common import (
+    JobCondition,
+    JobObject,
+    JobStatus,
+    ReplicaSpec,
+    ReplicaStatus,
+    RunPolicy,
+    SchedulingPolicy,
+)
+from .defaulting import ValidationError
+from .jaxjob import JAXJob
+from .mxjob import MXJob
+from .pytorchjob import PyTorchJob
+from .tfjob import TFJob
+from .xgboostjob import XGBoostJob
+
+# Kind registry: kind name -> (class, set_defaults, validate)
+KINDS = {
+    tfjob.KIND: (TFJob, tfjob.set_defaults, tfjob.validate),
+    pytorchjob.KIND: (PyTorchJob, pytorchjob.set_defaults, pytorchjob.validate),
+    mxjob.KIND: (MXJob, mxjob.set_defaults, mxjob.validate),
+    xgboostjob.KIND: (XGBoostJob, xgboostjob.set_defaults, xgboostjob.validate),
+    jaxjob.KIND: (JAXJob, jaxjob.set_defaults, jaxjob.validate),
+}
+
+
+def parse_job(data: dict) -> JobObject:
+    """Parse a manifest dict into its typed job object by `kind`."""
+    kind = data.get("kind", "")
+    if kind not in KINDS:
+        raise ValidationError(f"unknown job kind {kind!r}")
+    cls = KINDS[kind][0]
+    return cls.parse(data)

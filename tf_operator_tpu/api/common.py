@@ -1,0 +1,244 @@
+"""Shared API vocabulary for all training job kinds.
+
+Re-owns the types the reference imports from kubeflow/common v0.3.4
+``apis/common/v1`` (ReplicaSpec, RestartPolicy, RunPolicy, JobStatus,
+JobCondition, ReplicaStatus — consumed at 50+ sites in the reference,
+SURVEY.md §2.2/§2.9). In this framework they are first-class and in-repo.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .k8s import ObjectMeta, PodTemplateSpec, from_dict, to_dict
+
+# --- Replica types are plain strings; frameworks define their own constants.
+ReplicaType = str
+
+# --- Restart policies (commonv1.RestartPolicy)
+RESTART_POLICY_ALWAYS = "Always"
+RESTART_POLICY_ON_FAILURE = "OnFailure"
+RESTART_POLICY_NEVER = "Never"
+# ExitCode: retryability decided by the container exit code (1-127 permanent,
+# 128+ retryable — reference docs/design/tf_job_design_doc.md:84 and
+# tfjob_controller.go:717-719).
+RESTART_POLICY_EXIT_CODE = "ExitCode"
+
+RESTART_POLICIES = (
+    RESTART_POLICY_ALWAYS,
+    RESTART_POLICY_ON_FAILURE,
+    RESTART_POLICY_NEVER,
+    RESTART_POLICY_EXIT_CODE,
+)
+
+# --- Clean pod policies (commonv1.CleanPodPolicy)
+CLEAN_POD_POLICY_ALL = "All"
+CLEAN_POD_POLICY_RUNNING = "Running"
+CLEAN_POD_POLICY_NONE = "None"
+
+# --- Job condition types (commonv1.JobConditionType)
+JOB_CREATED = "Created"
+JOB_RUNNING = "Running"
+JOB_RESTARTING = "Restarting"
+JOB_SUCCEEDED = "Succeeded"
+JOB_FAILED = "Failed"
+
+CONDITION_TRUE = "True"
+CONDITION_FALSE = "False"
+
+
+def is_retryable_exit_code(exit_code: int) -> bool:
+    """Exit-code taxonomy: 1-127 are permanent errors (caller bugs, config),
+    128+ are retryable (SIGKILL/SIGTERM from preemption, OOM kills).
+
+    Reference: kubeflow/common train_util.IsRetryableExitCode, used at
+    tfjob_controller.go:718; rationale docs/design/tf_job_design_doc.md:84.
+    """
+    return exit_code >= 128
+
+
+@dataclass
+class SchedulingPolicy:
+    """Gang-scheduling knobs (commonv1.SchedulingPolicy, visible in the
+    flattened CRD manifests/base/crds/kubeflow.org_tfjobs.yaml runPolicy)."""
+
+    min_available: Optional[int] = None
+    queue: str = ""
+    min_resources: Dict[str, str] = field(default_factory=dict)
+    priority_class: str = ""
+
+
+@dataclass
+class RunPolicy:
+    """Policies that apply to the whole job (commonv1.RunPolicy)."""
+
+    clean_pod_policy: Optional[str] = None
+    ttl_seconds_after_finished: Optional[int] = None
+    active_deadline_seconds: Optional[int] = None
+    backoff_limit: Optional[int] = None
+    scheduling_policy: Optional[SchedulingPolicy] = None
+
+
+@dataclass
+class ReplicaSpec:
+    """Spec of one replica group (commonv1.ReplicaSpec)."""
+
+    replicas: Optional[int] = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    restart_policy: str = ""
+
+
+@dataclass
+class ReplicaStatus:
+    """Per-replica-type counters (commonv1.ReplicaStatus)."""
+
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+
+@dataclass
+class JobCondition:
+    """One entry in JobStatus.conditions (commonv1.JobCondition)."""
+
+    type: str = ""
+    status: str = CONDITION_TRUE
+    reason: str = ""
+    message: str = ""
+    last_update_time: Optional[float] = None
+    last_transition_time: Optional[float] = None
+
+
+@dataclass
+class JobStatus:
+    """Observed state of a training job (commonv1.JobStatus)."""
+
+    conditions: List[JobCondition] = field(default_factory=list)
+    replica_statuses: Dict[str, ReplicaStatus] = field(default_factory=dict)
+    start_time: Optional[float] = None
+    completion_time: Optional[float] = None
+    last_reconcile_time: Optional[float] = None
+
+
+# --- Condition helpers (kubeflow/common pkg/util/status.go equivalents) ---
+
+def get_condition(status: JobStatus, cond_type: str) -> Optional[JobCondition]:
+    for c in status.conditions:
+        if c.type == cond_type:
+            return c
+    return None
+
+
+def has_condition(status: JobStatus, cond_type: str) -> bool:
+    c = get_condition(status, cond_type)
+    return c is not None and c.status == CONDITION_TRUE
+
+
+def is_succeeded(status: JobStatus) -> bool:
+    return has_condition(status, JOB_SUCCEEDED)
+
+
+def is_failed(status: JobStatus) -> bool:
+    return has_condition(status, JOB_FAILED)
+
+
+def is_finished(status: JobStatus) -> bool:
+    return is_succeeded(status) or is_failed(status)
+
+
+def is_running(status: JobStatus) -> bool:
+    return has_condition(status, JOB_RUNNING)
+
+
+def update_job_conditions(
+    status: JobStatus, cond_type: str, reason: str, message: str, now: Optional[float] = None
+) -> None:
+    """Append/refresh a condition, maintaining the reference's invariants:
+
+    - setting Running removes Restarting (and vice versa);
+    - terminal conditions (Succeeded/Failed) flip Running to False;
+    - timestamps update on every set, transition time only on status change.
+
+    Reference: kubeflow/common pkg/util/status.go setCondition/filterOutCondition
+    semantics as exercised by the reference's status_test.go.
+    """
+    now = time.time() if now is None else now
+    new_cond = JobCondition(
+        type=cond_type,
+        status=CONDITION_TRUE,
+        reason=reason,
+        message=message,
+        last_update_time=now,
+        last_transition_time=now,
+    )
+
+    existing = get_condition(status, cond_type)
+    if existing is not None and existing.status == new_cond.status and existing.reason == new_cond.reason:
+        # No transition: refresh update time/message only.
+        existing.last_update_time = now
+        existing.message = message
+        return
+
+    # Filter out: the same type; Restarting when setting Running; Running when
+    # setting Restarting (mutually exclusive in the reference state machine).
+    drop = {cond_type}
+    if cond_type == JOB_RUNNING:
+        drop.add(JOB_RESTARTING)
+    if cond_type == JOB_RESTARTING:
+        drop.add(JOB_RUNNING)
+    kept = [c for c in status.conditions if c.type not in drop]
+
+    if cond_type in (JOB_SUCCEEDED, JOB_FAILED):
+        for c in kept:
+            if c.type == JOB_RUNNING and c.status == CONDITION_TRUE:
+                c.status = CONDITION_FALSE
+                c.last_transition_time = now
+                c.last_update_time = now
+
+    kept.append(new_cond)
+    status.conditions = kept
+
+
+def initialize_replica_statuses(status: JobStatus, rtype: ReplicaType) -> None:
+    status.replica_statuses[rtype] = ReplicaStatus()
+
+
+@dataclass
+class JobObject:
+    """Base class for all job kinds: metadata + status + (de)serialization.
+
+    Concrete kinds (TFJob, PyTorchJob, MXJob, XGBoostJob, JAXJob) add their
+    spec type and expose the generic accessors the reconciler engine needs.
+    """
+
+    api_version: str = "kubeflow.org/v1"
+    kind: str = ""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    status: JobStatus = field(default_factory=JobStatus)
+
+    # -- generic accessors the engine relies on; kinds override -------------
+    def replica_specs(self) -> Dict[ReplicaType, ReplicaSpec]:
+        raise NotImplementedError
+
+    def run_policy(self) -> RunPolicy:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def to_dict(self) -> dict:
+        return to_dict(self)
+
+    @classmethod
+    def parse(cls, data: dict) -> "JobObject":
+        return from_dict(cls, data)

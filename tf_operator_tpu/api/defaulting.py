@@ -1,0 +1,82 @@
+"""Shared defaulting/validation helpers used by every job kind.
+
+Reference parity: pkg/apis/*/v1/defaults.go (setDefaultPort,
+setDefaultReplicas, setTypeNamesToCamelCase) and
+pkg/apis/*/validation/validation.go.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable
+
+from .common import ReplicaSpec, ReplicaType
+from .k8s import ContainerPort, PodSpec
+
+
+class ValidationError(ValueError):
+    """Raised when a job spec fails admission-style validation."""
+
+
+def set_default_port(spec: PodSpec, container_name: str, port_name: str, port: int) -> None:
+    """Inject the default rendezvous port into the framework container if the
+    user did not declare one (reference defaults.go:setDefaultPort)."""
+    if not spec.containers:
+        return
+    index = 0
+    for i, container in enumerate(spec.containers):
+        if container.name == container_name:
+            index = i
+            break
+    for p in spec.containers[index].ports:
+        if p.name == port_name:
+            return
+    spec.containers[index].ports.append(ContainerPort(name=port_name, container_port=port))
+
+
+def set_default_replicas(spec: ReplicaSpec, default_restart_policy: str) -> None:
+    """replicas -> 1, restart policy -> framework default
+    (reference defaults.go:setDefaultReplicas)."""
+    if spec.replicas is None:
+        spec.replicas = 1
+    if not spec.restart_policy:
+        spec.restart_policy = default_restart_policy
+
+
+def normalize_replica_type_names(
+    specs: Dict[ReplicaType, ReplicaSpec], canonical: Iterable[ReplicaType]
+) -> None:
+    """Case-normalize user-supplied replica-type keys to their canonical
+    camel-case spelling (reference defaults.go:setTypeNamesToCamelCase)."""
+    for typ in canonical:
+        for t in list(specs.keys()):
+            if t != typ and t.lower() == typ.lower():
+                specs[typ] = specs.pop(t)
+                break
+
+
+def validate_replica_specs(
+    specs: Dict[ReplicaType, ReplicaSpec], container_name: str, kind: str
+) -> None:
+    """Common validation: specs present, containers defined, images set, and
+    at least one container bearing the framework's canonical name
+    (reference validation/validation.go:validateV1ReplicaSpecs)."""
+    if not specs:
+        raise ValidationError(f"{kind}Spec is not valid")
+    for rtype, value in specs.items():
+        if value is None or not value.template.spec.containers:
+            raise ValidationError(
+                f"{kind}Spec is not valid: containers definition expected in {rtype}"
+            )
+        num_named = 0
+        for container in value.template.spec.containers:
+            if not container.image:
+                raise ValidationError(
+                    f"{kind}Spec is not valid: Image is undefined in the container of {rtype}"
+                )
+            if container.name == container_name:
+                num_named += 1
+        if num_named == 0:
+            raise ValidationError(
+                f"{kind}Spec is not valid: There is no container named "
+                f"{container_name} in {rtype}"
+            )

@@ -1,0 +1,203 @@
+"""JAXJob v1 API — the TPU-native job kind (new; no reference counterpart).
+
+Where the reference's TFJob models a GPU/CPU parameter-server world
+(pkg/apis/tensorflow/v1/types.go), JAXJob models the TPU world directly:
+
+- A single ``Worker`` replica group; each worker is one TPU VM host of a
+  pod-slice. Worker-0's headless service is the ``jax.distributed``
+  coordinator (the analog of the reference's master/chief rendezvous —
+  SURVEY.md §7 build plan, stage 2).
+- ``tpu``: the pod-slice request (accelerator type, topology) — the
+  all-or-nothing gang unit. Replicas defaults to the host count the
+  topology implies, and gang minAvailable is pinned to it: a partial
+  slice is useless, unlike a partial GPU worker set.
+- ``numSlices`` > 1 declares a multislice (DCN-connected) job; each slice
+  is its own gang and the mesh gains a leading ``slice`` (DCN) axis.
+- ``mesh``: logical axis layout the workload tier materializes via
+  ``tf_operator_tpu.runtime.tpu_init`` (published to pods as JAX_MESH_SPEC).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from .common import (
+    CLEAN_POD_POLICY_RUNNING,
+    JobObject,
+    ReplicaSpec,
+    ReplicaType,
+    RunPolicy,
+)
+from .defaulting import (
+    ValidationError,
+    normalize_replica_type_names,
+    set_default_port,
+    set_default_replicas,
+    validate_replica_specs,
+)
+
+KIND = "JAXJob"
+PLURAL = "jaxjobs"
+SINGULAR = "jaxjob"
+GROUP = "kubeflow.org"
+VERSION = "v1"
+DEFAULT_CONTAINER_NAME = "jax"
+DEFAULT_PORT_NAME = "jaxjob-port"
+# Coordinator port for jax.distributed.initialize (worker-0 hosts it).
+DEFAULT_PORT = 1234
+# TPU interruptions (preemption/maintenance) surface as 128+ exit codes,
+# which ExitCode policy treats as retryable; plain failures stay permanent.
+DEFAULT_RESTART_POLICY = "ExitCode"
+
+REPLICA_TYPE_WORKER = "Worker"
+CANONICAL_REPLICA_TYPES = (REPLICA_TYPE_WORKER,)
+
+# Known accelerator types -> (chips per slice, chips per host). Used to
+# default replicas (hosts = chips/chips_per_host) and gang minAvailable.
+ACCELERATOR_TOPOLOGIES: Dict[str, tuple] = {
+    "v4-8": (4, 4),
+    "v4-16": (8, 4),
+    "v4-32": (16, 4),
+    "v5e-1": (1, 1),
+    "v5e-4": (4, 4),
+    "v5e-8": (8, 8),
+    "v5e-16": (16, 4),
+    "v5e-32": (32, 4),
+    "v5e-64": (64, 4),
+    "v5e-128": (128, 4),
+    "v5e-256": (256, 4),
+    "v5p-8": (4, 4),
+    "v5p-16": (8, 4),
+    "v5p-32": (16, 4),
+    "v6e-8": (8, 8),
+    "v6e-16": (16, 4),
+    "v6e-32": (32, 4),
+    "v6e-64": (64, 4),
+    "v6e-256": (256, 4),
+}
+
+
+@dataclass
+class TPUSpec:
+    """The pod-slice request attached to the Worker replica group."""
+
+    # e.g. "v5e-32" — see ACCELERATOR_TOPOLOGIES.
+    accelerator_type: str = ""
+    # Physical topology string, e.g. "4x8" (v5e-32) or "2x2x2" (v4-16);
+    # published to pods and used as the GKE topology node selector.
+    topology: str = ""
+    # Chips handed to each worker pod (google.com/tpu resource).
+    chips_per_host: Optional[int] = None
+
+
+def hosts_for(tpu: TPUSpec) -> Optional[int]:
+    """Host (pod) count a slice requires, or None when unknown."""
+    info = ACCELERATOR_TOPOLOGIES.get(tpu.accelerator_type)
+    if info is None:
+        return None
+    chips, default_chips_per_host = info
+    per_host = tpu.chips_per_host or default_chips_per_host
+    return max(1, chips // per_host)
+
+
+def chips_for(tpu: TPUSpec) -> Optional[int]:
+    info = ACCELERATOR_TOPOLOGIES.get(tpu.accelerator_type)
+    return info[0] if info else None
+
+
+@dataclass
+class JAXJobSpec:
+    run_policy: RunPolicy = field(default_factory=RunPolicy)
+    jax_replica_specs: Dict[ReplicaType, ReplicaSpec] = field(default_factory=dict)
+    tpu: Optional[TPUSpec] = None
+    # Multislice: number of DCN-connected slices; each slice is one gang of
+    # `hosts_for(tpu)` workers and the global mesh gains a leading DCN axis.
+    num_slices: int = 1
+    # Logical mesh the workload should build, e.g. {"dp": 1, "fsdp": 8, "tp": 4}.
+    # Published to every pod as JAX_MESH_SPEC (JSON); axes sizes must multiply
+    # to the global chip count when both are known.
+    mesh: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class JAXJob(JobObject):
+    kind: str = KIND
+    spec: JAXJobSpec = field(default_factory=JAXJobSpec)
+
+    def replica_specs(self) -> Dict[ReplicaType, ReplicaSpec]:
+        return self.spec.jax_replica_specs
+
+    def run_policy(self) -> RunPolicy:
+        return self.spec.run_policy
+
+
+
+def set_defaults(job: JAXJob) -> None:
+    if job.spec.run_policy.clean_pod_policy is None:
+        job.spec.run_policy.clean_pod_policy = CLEAN_POD_POLICY_RUNNING
+    if job.spec.num_slices <= 0:
+        job.spec.num_slices = 1
+    normalize_replica_type_names(job.spec.jax_replica_specs, CANONICAL_REPLICA_TYPES)
+    for spec in job.spec.jax_replica_specs.values():
+        # Replicas default: hosts implied by the slice topology × slices,
+        # falling back to 1 (single-process) when no TPU block is given.
+        if spec.replicas is None and job.spec.tpu is not None:
+            hosts = hosts_for(job.spec.tpu)
+            if hosts is not None:
+                spec.replicas = hosts * job.spec.num_slices
+        set_default_replicas(spec, DEFAULT_RESTART_POLICY)
+        set_default_port(spec.template.spec, DEFAULT_CONTAINER_NAME, DEFAULT_PORT_NAME, DEFAULT_PORT)
+    # Pin gang minAvailable to one slice's host count: a TPU slice is
+    # all-or-nothing (SURVEY.md §2.5 "gang scheduling" row). Each slice of a
+    # multislice job is its own gang — minAvailable stays per-slice so a free
+    # slice can start while others are pending.
+    rp = job.spec.run_policy
+    worker = job.spec.jax_replica_specs.get(REPLICA_TYPE_WORKER)
+    if worker is not None and worker.replicas:
+        from .common import SchedulingPolicy
+
+        per_slice = worker.replicas
+        if job.spec.tpu is not None:
+            per_slice = hosts_for(job.spec.tpu) or max(
+                1, worker.replicas // max(1, job.spec.num_slices)
+            )
+        if rp.scheduling_policy is None:
+            rp.scheduling_policy = SchedulingPolicy()
+        if rp.scheduling_policy.min_available is None:
+            rp.scheduling_policy.min_available = per_slice
+
+
+def validate(spec: JAXJobSpec) -> None:
+    validate_replica_specs(spec.jax_replica_specs, DEFAULT_CONTAINER_NAME, KIND)
+    for rtype in spec.jax_replica_specs:
+        if rtype not in CANONICAL_REPLICA_TYPES:
+            raise ValidationError(
+                f"JAXReplicaType is {rtype} but must be one of {list(CANONICAL_REPLICA_TYPES)}"
+            )
+    if spec.tpu is not None and spec.tpu.accelerator_type:
+        if spec.tpu.accelerator_type not in ACCELERATOR_TOPOLOGIES:
+            raise ValidationError(
+                f"JAXJobSpec is not valid: unknown TPU accelerator type "
+                f"{spec.tpu.accelerator_type!r}"
+            )
+        worker = spec.jax_replica_specs.get(REPLICA_TYPE_WORKER)
+        hosts = hosts_for(spec.tpu)
+        if worker is not None and worker.replicas is not None and hosts is not None:
+            if worker.replicas != hosts * max(1, spec.num_slices):
+                raise ValidationError(
+                    f"JAXJobSpec is not valid: {spec.tpu.accelerator_type} × "
+                    f"{spec.num_slices} slice(s) requires {hosts * max(1, spec.num_slices)} "
+                    f"workers, got {worker.replicas}"
+                )
+    if spec.mesh and spec.tpu is not None:
+        chips = chips_for(spec.tpu)
+        if chips is not None:
+            total = 1
+            for size in spec.mesh.values():
+                total *= size
+            if total != chips * max(1, spec.num_slices):
+                raise ValidationError(
+                    f"JAXJobSpec is not valid: mesh {spec.mesh} has {total} devices "
+                    f"but the job provisions {chips * max(1, spec.num_slices)} chips"
+                )

@@ -1,0 +1,289 @@
+"""Minimal Kubernetes object model.
+
+The reference operator manipulates core/v1 Pods, Services and metadata via
+k8s.io/api structs. This module provides the slice of that object model the
+operator needs, as plain dataclasses with camelCase (de)serialization so
+specs round-trip through YAML/JSON exactly like real manifests.
+
+Reference parity: k8s.io/api/core/v1 types as used by
+pkg/controller.v1/*/ *_controller.go and pkg/common/util in the reference.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import functools
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+_CAMEL_RE = re.compile(r"_([a-z0-9])")
+_SNAKE_RE = re.compile(r"(?<!^)(?=[A-Z])")
+
+
+def _to_camel(name: str) -> str:
+    return _CAMEL_RE.sub(lambda m: m.group(1).upper(), name)
+
+
+def _to_snake(name: str) -> str:
+    return _SNAKE_RE.sub("_", name).lower()
+
+
+def to_dict(obj: Any) -> Any:
+    """Serialize a dataclass tree to a JSON-able dict with camelCase keys.
+
+    ``None`` values and empty containers are dropped, matching the
+    ``omitempty`` behaviour of the reference's Go JSON tags.
+    """
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {}
+        for f in dataclasses.fields(obj):
+            val = to_dict(getattr(obj, f.name))
+            if val is None or val == {} or val == []:
+                continue
+            key = f.metadata.get("json", _to_camel(f.name))
+            out[key] = val
+        return out
+    if isinstance(obj, dict):
+        return {k: to_dict(v) for k, v in obj.items() if v is not None}
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(v) for v in obj]
+    return obj
+
+
+def from_dict(cls: type, data: Any) -> Any:
+    """Deserialize camelCase dict ``data`` into dataclass ``cls``.
+
+    Unknown keys are ignored (K8s API machinery drops unknown fields for
+    structural schemas); nested dataclass/list/dict field types are resolved
+    from type hints.
+    """
+    if data is None:
+        return None
+    if not dataclasses.is_dataclass(cls):
+        return data
+    hints, json_names = _class_schema(cls)
+    kwargs = {}
+    for key, val in dict(data).items():
+        fname = json_names.get(key, _to_snake(key))
+        if fname not in hints:
+            continue
+        kwargs[fname] = _coerce(hints[fname], val)
+    return cls(**kwargs)
+
+
+@functools.lru_cache(maxsize=None)
+def _class_schema(cls: type):
+    """Cache type hints + json-name map per class; get_type_hints re-evaluates
+    stringified annotations (PEP 563) on every call otherwise."""
+    import typing
+
+    hints = typing.get_type_hints(cls)
+    json_names = {}
+    for f in dataclasses.fields(cls):
+        json_names[f.metadata.get("json", _to_camel(f.name))] = f.name
+    return hints, json_names
+
+
+def _coerce(hint: Any, val: Any) -> Any:
+    import typing
+
+    origin = typing.get_origin(hint)
+    args = typing.get_args(hint)
+    if origin is typing.Union:  # Optional[X]
+        inner = [a for a in args if a is not type(None)]
+        return _coerce(inner[0], val) if inner else val
+    if origin in (list, List):
+        return [_coerce(args[0], v) for v in val] if args else list(val)
+    if origin in (dict, Dict):
+        if args and dataclasses.is_dataclass(args[1]):
+            return {k: from_dict(args[1], v) for k, v in val.items()}
+        return dict(val)
+    if dataclasses.is_dataclass(hint) and isinstance(val, dict):
+        return from_dict(hint, val)
+    return val
+
+
+@dataclass
+class OwnerReference:
+    api_version: str = ""
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: Optional[bool] = None
+    block_owner_deletion: Optional[bool] = None
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    uid: str = ""
+    resource_version: str = ""
+    creation_timestamp: Optional[float] = None
+    deletion_timestamp: Optional[float] = None
+    owner_references: List[OwnerReference] = field(default_factory=list)
+
+    def controller_ref(self) -> Optional[OwnerReference]:
+        for ref in self.owner_references:
+            if ref.controller:
+                return ref
+        return None
+
+
+@dataclass
+class EnvVar:
+    name: str = ""
+    value: str = ""
+
+
+@dataclass
+class ContainerPort:
+    name: str = ""
+    container_port: int = 0
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    command: List[str] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
+    env: List[EnvVar] = field(default_factory=list)
+    ports: List[ContainerPort] = field(default_factory=list)
+    resources: Dict[str, Dict[str, str]] = field(default_factory=dict)
+    working_dir: str = ""
+
+    def set_env(self, name: str, value: str) -> None:
+        self.env.append(EnvVar(name=name, value=str(value)))
+
+    def get_env(self, name: str) -> Optional[str]:
+        for e in self.env:
+            if e.name == name:
+                return e.value
+        return None
+
+
+@dataclass
+class PodSpec:
+    containers: List[Container] = field(default_factory=list)
+    restart_policy: str = ""
+    scheduler_name: str = ""
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    host_network: Optional[bool] = None
+    subdomain: str = ""
+    # TPU-native: pod-slice topology request (maps to GKE's
+    # cloud.google.com/gke-tpu-topology nodeSelector + google.com/tpu resource)
+    tolerations: List[Dict[str, Any]] = field(default_factory=list)
+
+
+@dataclass
+class PodTemplateSpec:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+
+
+@dataclass
+class ContainerStateTerminated:
+    exit_code: int = 0
+    reason: str = ""
+    finished_at: Optional[float] = None
+
+
+@dataclass
+class ContainerState:
+    terminated: Optional[ContainerStateTerminated] = None
+    running: Optional[Dict[str, Any]] = None
+    waiting: Optional[Dict[str, Any]] = None
+
+
+@dataclass
+class ContainerStatus:
+    name: str = ""
+    state: ContainerState = field(default_factory=ContainerState)
+    restart_count: int = 0
+
+
+# Pod phases (k8s.io/api/core/v1 PodPhase)
+POD_PENDING = "Pending"
+POD_RUNNING = "Running"
+POD_SUCCEEDED = "Succeeded"
+POD_FAILED = "Failed"
+POD_UNKNOWN = "Unknown"
+
+
+@dataclass
+class PodStatus:
+    phase: str = POD_PENDING
+    container_statuses: List[ContainerStatus] = field(default_factory=list)
+    start_time: Optional[float] = None
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class Pod:
+    api_version: str = "v1"
+    kind: str = "Pod"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    def deep_copy(self) -> "Pod":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class ServicePort:
+    name: str = ""
+    port: int = 0
+
+
+@dataclass
+class ServiceSpec:
+    # "None" => headless, as the reference creates. JSON key is the k8s
+    # spelling "clusterIP", which snake->camel conversion cannot produce.
+    cluster_ip: str = field(default="", metadata={"json": "clusterIP"})
+    selector: Dict[str, str] = field(default_factory=dict)
+    ports: List[ServicePort] = field(default_factory=list)
+
+
+@dataclass
+class Service:
+    api_version: str = "v1"
+    kind: str = "Service"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+
+    def deep_copy(self) -> "Service":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class Event:
+    """A lifecycle event recorded against a job object.
+
+    The reference emits core/v1 Events via an EventRecorder
+    (e.g. SuccessfulDeleteJob / ExitedWithCode / TFJobRestarting —
+    pkg/controller.v1/tensorflow/{pod.go:45-55,status.go:34-45}).
+    """
+
+    type: str = "Normal"  # Normal | Warning
+    reason: str = ""
+    message: str = ""
+    involved_object: str = ""  # "<kind>/<namespace>/<name>"
+    timestamp: Optional[float] = None
+
+
+def new_owner_reference(api_version: str, kind: str, name: str, uid: str) -> OwnerReference:
+    return OwnerReference(
+        api_version=api_version,
+        kind=kind,
+        name=name,
+        uid=uid,
+        controller=True,
+        block_owner_deletion=True,
+    )

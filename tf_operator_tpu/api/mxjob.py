@@ -1,0 +1,122 @@
+"""MXJob v1 API types, defaults and validation.
+
+Reference parity: pkg/apis/mxnet/v1/{mxjob_types,constants,defaults}.go and
+pkg/apis/mxnet/validation/validation.go.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from .common import (
+    CLEAN_POD_POLICY_RUNNING,
+    JobObject,
+    ReplicaSpec,
+    ReplicaType,
+    RunPolicy,
+)
+from .defaulting import (
+    ValidationError,
+    normalize_replica_type_names,
+    set_default_port,
+    set_default_replicas,
+)
+
+# Constants (reference pkg/apis/mxnet/v1/constants.go:20-28)
+KIND = "MXJob"
+PLURAL = "mxjobs"
+SINGULAR = "mxjob"
+GROUP = "kubeflow.org"
+VERSION = "v1"
+DEFAULT_CONTAINER_NAME = "mxnet"
+DEFAULT_PORT_NAME = "mxjob-port"
+DEFAULT_PORT = 9091
+DEFAULT_RESTART_POLICY = "Never"
+
+# Job modes (reference mxjob_types.go:26-33)
+JOB_MODE_TRAIN = "MXTrain"
+JOB_MODE_TUNE = "MXTune"
+
+# Replica types (reference mxjob_types.go:35-50). The Tuner* types support
+# TVM auto-tuning topologies (examples/mxnet/tune in the reference).
+REPLICA_TYPE_SCHEDULER = "Scheduler"
+REPLICA_TYPE_SERVER = "Server"
+REPLICA_TYPE_WORKER = "Worker"
+REPLICA_TYPE_TUNER_TRACKER = "TunerTracker"
+REPLICA_TYPE_TUNER_SERVER = "TunerServer"
+REPLICA_TYPE_TUNER = "Tuner"
+
+CANONICAL_REPLICA_TYPES = (
+    REPLICA_TYPE_SCHEDULER,
+    REPLICA_TYPE_SERVER,
+    REPLICA_TYPE_WORKER,
+    REPLICA_TYPE_TUNER_TRACKER,
+    REPLICA_TYPE_TUNER_SERVER,
+    REPLICA_TYPE_TUNER,
+)
+
+# Annotation consulted for TVM tuning labels (reference mxnet.go:31-32)
+TUNER_SERVER_KEY = "tuner-server-key"
+
+
+@dataclass
+class MXJobSpec:
+    run_policy: RunPolicy = field(default_factory=RunPolicy)
+    job_mode: str = JOB_MODE_TRAIN
+    mx_replica_specs: Dict[ReplicaType, ReplicaSpec] = field(default_factory=dict)
+
+
+@dataclass
+class MXJob(JobObject):
+    kind: str = KIND
+    spec: MXJobSpec = field(default_factory=MXJobSpec)
+
+    def replica_specs(self) -> Dict[ReplicaType, ReplicaSpec]:
+        return self.spec.mx_replica_specs
+
+    def run_policy(self) -> RunPolicy:
+        return self.spec.run_policy
+
+
+
+def contains_scheduler_spec(job: MXJob) -> bool:
+    """reference mxnet.go:ContainSchedulerSpec"""
+    return REPLICA_TYPE_SCHEDULER in job.spec.mx_replica_specs
+
+
+def set_defaults(job: MXJob) -> None:
+    if job.spec.run_policy.clean_pod_policy is None:
+        job.spec.run_policy.clean_pod_policy = CLEAN_POD_POLICY_RUNNING
+    if not job.spec.job_mode:
+        job.spec.job_mode = JOB_MODE_TRAIN
+    normalize_replica_type_names(job.spec.mx_replica_specs, CANONICAL_REPLICA_TYPES)
+    for spec in job.spec.mx_replica_specs.values():
+        set_default_replicas(spec, DEFAULT_RESTART_POLICY)
+        set_default_port(spec.template.spec, DEFAULT_CONTAINER_NAME, DEFAULT_PORT_NAME, DEFAULT_PORT)
+
+
+def validate(spec: MXJobSpec) -> None:
+    """reference pkg/apis/mxnet/validation/validation.go — containers and
+    images present, container named `mxnet`, at most one Scheduler."""
+    if not spec.mx_replica_specs:
+        raise ValidationError("MXJobSpec is not valid")
+    found_scheduler = 0
+    for rtype, value in spec.mx_replica_specs.items():
+        if value is None or not value.template.spec.containers:
+            raise ValidationError("MXJobSpec is not valid")
+        if rtype == REPLICA_TYPE_SCHEDULER:
+            found_scheduler += 1
+        num_named = 0
+        for container in value.template.spec.containers:
+            if not container.image:
+                raise ValidationError("MXJobSpec is not valid")
+            if container.name == DEFAULT_CONTAINER_NAME:
+                num_named += 1
+        if num_named == 0:
+            raise ValidationError(
+                f"MXJobSpec is not valid: There is no container named "
+                f"{DEFAULT_CONTAINER_NAME} in {rtype}"
+            )
+    if found_scheduler > 1:
+        raise ValidationError("more than 1 scheduler found")

@@ -1,0 +1,89 @@
+"""PyTorchJob v1 API types, defaults and validation.
+
+Reference parity: pkg/apis/pytorch/v1/{pytorchjob_types,constants,defaults}.go
+and pkg/apis/pytorch/validation/validation.go.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+from .common import (
+    CLEAN_POD_POLICY_RUNNING,
+    JobObject,
+    ReplicaSpec,
+    ReplicaType,
+    RunPolicy,
+)
+from .defaulting import (
+    ValidationError,
+    normalize_replica_type_names,
+    set_default_port,
+    set_default_replicas,
+    validate_replica_specs,
+)
+
+# Constants (reference pkg/apis/pytorch/v1/constants.go:22-30)
+KIND = "PyTorchJob"
+PLURAL = "pytorchjobs"
+SINGULAR = "pytorchjob"
+GROUP = "kubeflow.org"
+VERSION = "v1"
+DEFAULT_CONTAINER_NAME = "pytorch"
+DEFAULT_PORT_NAME = "pytorchjob-port"
+DEFAULT_PORT = 23456
+DEFAULT_RESTART_POLICY = "OnFailure"
+
+# Replica types (reference pytorchjob_types.go:61-67)
+REPLICA_TYPE_MASTER = "Master"
+REPLICA_TYPE_WORKER = "Worker"
+
+CANONICAL_REPLICA_TYPES = (REPLICA_TYPE_MASTER, REPLICA_TYPE_WORKER)
+
+
+@dataclass
+class PyTorchJobSpec:
+    run_policy: RunPolicy = field(default_factory=RunPolicy)
+    pytorch_replica_specs: Dict[ReplicaType, ReplicaSpec] = field(default_factory=dict)
+
+
+@dataclass
+class PyTorchJob(JobObject):
+    kind: str = KIND
+    spec: PyTorchJobSpec = field(default_factory=PyTorchJobSpec)
+
+    def replica_specs(self) -> Dict[ReplicaType, ReplicaSpec]:
+        return self.spec.pytorch_replica_specs
+
+    def run_policy(self) -> RunPolicy:
+        return self.spec.run_policy
+
+
+
+def set_defaults(job: PyTorchJob) -> None:
+    if job.spec.run_policy.clean_pod_policy is None:
+        job.spec.run_policy.clean_pod_policy = CLEAN_POD_POLICY_RUNNING
+    normalize_replica_type_names(job.spec.pytorch_replica_specs, CANONICAL_REPLICA_TYPES)
+    for spec in job.spec.pytorch_replica_specs.values():
+        set_default_replicas(spec, DEFAULT_RESTART_POLICY)
+        set_default_port(spec.template.spec, DEFAULT_CONTAINER_NAME, DEFAULT_PORT_NAME, DEFAULT_PORT)
+
+
+def validate(spec: PyTorchJobSpec) -> None:
+    """reference pkg/apis/pytorch/validation/validation.go:ValidateV1PyTorchJobSpec —
+    valid replica types only, images set, container named `pytorch`, and
+    exactly one Master with replicas == 1."""
+    if not spec.pytorch_replica_specs:
+        raise ValidationError("PyTorchJobSpec is not valid")
+    for rtype in spec.pytorch_replica_specs:
+        if rtype not in CANONICAL_REPLICA_TYPES:
+            raise ValidationError(
+                f"PyTorchReplicaType is {rtype} but must be one of {list(CANONICAL_REPLICA_TYPES)}"
+            )
+    validate_replica_specs(spec.pytorch_replica_specs, DEFAULT_CONTAINER_NAME, KIND)
+    master = spec.pytorch_replica_specs.get(REPLICA_TYPE_MASTER)
+    if master is None:
+        raise ValidationError("PyTorchJobSpec is not valid: Master ReplicaSpec must be present")
+    if master.replicas is not None and master.replicas != 1:
+        raise ValidationError("PyTorchJobSpec is not valid: There must be only 1 master replica")

@@ -1,0 +1,116 @@
+"""TFJob v1 API types, defaults and validation.
+
+Reference parity: pkg/apis/tensorflow/v1/{types,common,constants,util,
+defaults}.go and pkg/apis/tensorflow/validation/validation.go.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from .common import (
+    CLEAN_POD_POLICY_RUNNING,
+    JobObject,
+    ReplicaSpec,
+    ReplicaType,
+    RunPolicy,
+)
+from .defaulting import (
+    ValidationError,
+    normalize_replica_type_names,
+    set_default_port,
+    set_default_replicas,
+    validate_replica_specs,
+)
+
+# Constants (reference pkg/apis/tensorflow/v1/constants.go:21-39)
+KIND = "TFJob"
+PLURAL = "tfjobs"
+SINGULAR = "tfjob"
+GROUP = "kubeflow.org"
+VERSION = "v1"
+DEFAULT_CONTAINER_NAME = "tensorflow"
+DEFAULT_PORT_NAME = "tfjob-port"
+DEFAULT_PORT = 2222
+DEFAULT_RESTART_POLICY = "Never"
+
+# Replica types (reference types.go:77-95)
+REPLICA_TYPE_PS = "PS"
+REPLICA_TYPE_WORKER = "Worker"
+REPLICA_TYPE_CHIEF = "Chief"
+REPLICA_TYPE_MASTER = "Master"
+REPLICA_TYPE_EVAL = "Evaluator"
+
+CANONICAL_REPLICA_TYPES = (
+    REPLICA_TYPE_PS,
+    REPLICA_TYPE_WORKER,
+    REPLICA_TYPE_CHIEF,
+    REPLICA_TYPE_MASTER,
+    REPLICA_TYPE_EVAL,
+)
+
+# Success policies (reference common.go:18-23)
+SUCCESS_POLICY_DEFAULT = ""
+SUCCESS_POLICY_ALL_WORKERS = "AllWorkers"
+
+
+def is_chief_or_master(rtype: ReplicaType) -> bool:
+    """reference util.go:22-26"""
+    return rtype in (REPLICA_TYPE_CHIEF, REPLICA_TYPE_MASTER)
+
+
+def is_worker(rtype: ReplicaType) -> bool:
+    """reference util.go:28-30"""
+    return rtype == REPLICA_TYPE_WORKER
+
+
+def is_evaluator(rtype: ReplicaType) -> bool:
+    """reference util.go:32-34"""
+    return rtype == REPLICA_TYPE_EVAL
+
+
+@dataclass
+class TFJobSpec:
+    """reference types.go:29-71"""
+
+    run_policy: RunPolicy = field(default_factory=RunPolicy)
+    success_policy: Optional[str] = None
+    tf_replica_specs: Dict[ReplicaType, ReplicaSpec] = field(default_factory=dict)
+    # EnableDynamicWorker => sparse TF_CONFIG so workers can join/leave
+    # without restarting the world (reference types.go:69-70,
+    # tensorflow.go:62-83).
+    enable_dynamic_worker: bool = False
+
+
+@dataclass
+class TFJob(JobObject):
+    kind: str = KIND
+    spec: TFJobSpec = field(default_factory=TFJobSpec)
+
+    def replica_specs(self) -> Dict[ReplicaType, ReplicaSpec]:
+        return self.spec.tf_replica_specs
+
+    def run_policy(self) -> RunPolicy:
+        return self.spec.run_policy
+
+
+
+def set_defaults(tfjob: TFJob) -> None:
+    """reference defaults.go:96-123 (SetDefaults_TFJob)"""
+    if tfjob.spec.run_policy.clean_pod_policy is None:
+        tfjob.spec.run_policy.clean_pod_policy = CLEAN_POD_POLICY_RUNNING
+    if tfjob.spec.success_policy is None:
+        tfjob.spec.success_policy = SUCCESS_POLICY_DEFAULT
+    normalize_replica_type_names(tfjob.spec.tf_replica_specs, CANONICAL_REPLICA_TYPES)
+    for spec in tfjob.spec.tf_replica_specs.values():
+        set_default_replicas(spec, DEFAULT_RESTART_POLICY)
+        set_default_port(spec.template.spec, DEFAULT_CONTAINER_NAME, DEFAULT_PORT_NAME, DEFAULT_PORT)
+
+
+def validate(spec: TFJobSpec) -> None:
+    """reference validation/validation.go:27-66 (ValidateV1TFJobSpec)"""
+    validate_replica_specs(spec.tf_replica_specs, DEFAULT_CONTAINER_NAME, KIND)
+    found_chief = sum(1 for rt in spec.tf_replica_specs if is_chief_or_master(rt))
+    if found_chief > 1:
+        raise ValidationError("TFJobSpec is not valid: more than 1 chief/master found")

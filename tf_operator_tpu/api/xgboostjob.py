@@ -1,0 +1,90 @@
+"""XGBoostJob v1 API types, defaults and validation.
+
+Reference parity: pkg/apis/xgboost/v1/{xgboostjob_types,constants,defaults}.go
+and pkg/apis/xgboost/validation/validation.go. Also drives LightGBM jobs via
+WORKER_ADDRS/WORKER_PORT env (reference xgboost.go:95-107).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+from .common import (
+    CLEAN_POD_POLICY_RUNNING,
+    JobObject,
+    ReplicaSpec,
+    ReplicaType,
+    RunPolicy,
+)
+from .defaulting import (
+    ValidationError,
+    normalize_replica_type_names,
+    set_default_port,
+    set_default_replicas,
+    validate_replica_specs,
+)
+
+# Constants (reference pkg/apis/xgboost/v1/constants.go:20-27)
+KIND = "XGBoostJob"
+PLURAL = "xgboostjobs"
+SINGULAR = "xgboostjob"
+GROUP = "kubeflow.org"
+VERSION = "v1"
+DEFAULT_CONTAINER_NAME = "xgboost"
+DEFAULT_PORT_NAME = "xgboostjob-port"
+DEFAULT_PORT = 9999
+DEFAULT_RESTART_POLICY = "Never"
+
+# Replica types (reference xgboostjob_types.go:25-30)
+REPLICA_TYPE_MASTER = "Master"
+REPLICA_TYPE_WORKER = "Worker"
+
+CANONICAL_REPLICA_TYPES = (REPLICA_TYPE_MASTER, REPLICA_TYPE_WORKER)
+
+
+@dataclass
+class XGBoostJobSpec:
+    run_policy: RunPolicy = field(default_factory=RunPolicy)
+    xgb_replica_specs: Dict[ReplicaType, ReplicaSpec] = field(default_factory=dict)
+
+
+@dataclass
+class XGBoostJob(JobObject):
+    kind: str = KIND
+    spec: XGBoostJobSpec = field(default_factory=XGBoostJobSpec)
+
+    def replica_specs(self) -> Dict[ReplicaType, ReplicaSpec]:
+        return self.spec.xgb_replica_specs
+
+    def run_policy(self) -> RunPolicy:
+        return self.spec.run_policy
+
+
+
+def set_defaults(job: XGBoostJob) -> None:
+    if job.spec.run_policy.clean_pod_policy is None:
+        job.spec.run_policy.clean_pod_policy = CLEAN_POD_POLICY_RUNNING
+    normalize_replica_type_names(job.spec.xgb_replica_specs, CANONICAL_REPLICA_TYPES)
+    for spec in job.spec.xgb_replica_specs.values():
+        set_default_replicas(spec, DEFAULT_RESTART_POLICY)
+        set_default_port(spec.template.spec, DEFAULT_CONTAINER_NAME, DEFAULT_PORT_NAME, DEFAULT_PORT)
+
+
+def validate(spec: XGBoostJobSpec) -> None:
+    """reference pkg/apis/xgboost/validation/validation.go — valid replica
+    types, images set, container named `xgboost`, exactly one Master with
+    replicas == 1."""
+    if not spec.xgb_replica_specs:
+        raise ValidationError("XGBoostJobSpec is not valid")
+    for rtype in spec.xgb_replica_specs:
+        if rtype not in CANONICAL_REPLICA_TYPES:
+            raise ValidationError(
+                f"XGBoostReplicaType is {rtype} but must be one of {list(CANONICAL_REPLICA_TYPES)}"
+            )
+    validate_replica_specs(spec.xgb_replica_specs, DEFAULT_CONTAINER_NAME, KIND)
+    master = spec.xgb_replica_specs.get(REPLICA_TYPE_MASTER)
+    if master is None:
+        raise ValidationError("XGBoostJobSpec is not valid: Master ReplicaSpec must be present")
+    if master.replicas is not None and master.replicas != 1:
+        raise ValidationError("XGBoostJobSpec is not valid: There must be only 1 master replica")
