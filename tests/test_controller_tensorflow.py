@@ -1,0 +1,449 @@
+"""TFJob controller tests.
+
+Modeled on the reference's T1 tier (pkg/controller.v1/tensorflow/
+{controller,pod,status}_test.go): seed cluster state, run syncs, assert
+exact pod/service actions and condition transitions. The InMemoryCluster
+plays the role of the seeded informer indexers + fake pod control.
+"""
+
+import json
+
+import pytest
+
+from tf_operator_tpu.api import common as capi
+from tf_operator_tpu.api import tfjob as tfapi
+from tf_operator_tpu.api.k8s import (
+    POD_FAILED,
+    POD_PENDING,
+    POD_RUNNING,
+    POD_SUCCEEDED,
+    Container,
+    ObjectMeta,
+    PodSpec,
+    PodTemplateSpec,
+)
+from tf_operator_tpu.cluster.memory import InMemoryCluster, terminate_after
+from tf_operator_tpu.controllers.tensorflow import TFController
+
+
+def tfjob_manifest(
+    name="test-tfjob",
+    namespace="default",
+    worker=0,
+    ps=0,
+    chief=0,
+    evaluator=0,
+    restart_policy=None,
+    clean_pod_policy=None,
+    success_policy=None,
+    backoff_limit=None,
+    active_deadline=None,
+    ttl=None,
+):
+    def group(n):
+        spec = {
+            "replicas": n,
+            "template": {
+                "spec": {
+                    "containers": [
+                        {"name": "tensorflow", "image": "test-image:latest"}
+                    ]
+                }
+            },
+        }
+        if restart_policy:
+            spec["restartPolicy"] = restart_policy
+        return spec
+
+    replicas = {}
+    if worker:
+        replicas["Worker"] = group(worker)
+    if ps:
+        replicas["PS"] = group(ps)
+    if chief:
+        replicas["Chief"] = group(chief)
+    if evaluator:
+        replicas["Evaluator"] = group(evaluator)
+    run_policy = {}
+    if clean_pod_policy:
+        run_policy["cleanPodPolicy"] = clean_pod_policy
+    if backoff_limit is not None:
+        run_policy["backoffLimit"] = backoff_limit
+    if active_deadline is not None:
+        run_policy["activeDeadlineSeconds"] = active_deadline
+    if ttl is not None:
+        run_policy["ttlSecondsAfterFinished"] = ttl
+    spec = {"tfReplicaSpecs": replicas}
+    if run_policy:
+        spec["runPolicy"] = run_policy
+    if success_policy is not None:
+        spec["successPolicy"] = success_policy
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "TFJob",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": spec,
+    }
+
+
+@pytest.fixture
+def env():
+    cluster = InMemoryCluster()
+    controller = TFController(cluster)
+    return cluster, controller
+
+
+def create_and_sync(cluster, controller, manifest):
+    cluster.create_job(manifest)
+    controller.run_until_idle()
+    name = manifest["metadata"]["name"]
+    ns = manifest["metadata"].get("namespace", "default")
+    return cluster.get_job("TFJob", ns, name)
+
+
+class TestPodCreation:
+    def test_creates_pods_and_services_per_replica(self, env):
+        cluster, controller = env
+        create_and_sync(cluster, controller, tfjob_manifest(worker=4, ps=2))
+        pods = cluster.list_pods()
+        services = cluster.list_services()
+        assert len(pods) == 6
+        assert len(services) == 6
+        names = sorted(p.metadata.name for p in pods)
+        assert names == [
+            "test-tfjob-ps-0",
+            "test-tfjob-ps-1",
+            "test-tfjob-worker-0",
+            "test-tfjob-worker-1",
+            "test-tfjob-worker-2",
+            "test-tfjob-worker-3",
+        ]
+        # Services are headless and selector-matched to one replica.
+        svc = next(s for s in services if s.metadata.name == "test-tfjob-worker-1")
+        assert svc.spec.cluster_ip == "None"
+        assert svc.spec.selector["replica-index"] == "1"
+        assert svc.spec.ports[0].port == 2222
+
+    def test_pod_labels_and_owner_refs(self, env):
+        cluster, controller = env
+        job = create_and_sync(cluster, controller, tfjob_manifest(worker=1))
+        pod = cluster.list_pods()[0]
+        labels = pod.metadata.labels
+        assert labels["group-name"] == "kubeflow.org"
+        assert labels["job-name"] == "test-tfjob"
+        assert labels["replica-type"] == "worker"
+        assert labels["replica-index"] == "0"
+        # worker-0 is master role when no chief present
+        assert labels["job-role"] == "master"
+        ref = pod.metadata.controller_ref()
+        assert ref.kind == "TFJob" and ref.uid == job["metadata"]["uid"]
+
+    def test_chief_takes_master_role(self, env):
+        cluster, controller = env
+        create_and_sync(cluster, controller, tfjob_manifest(worker=2, chief=1))
+        pods = {p.metadata.name: p for p in cluster.list_pods()}
+        assert pods["test-tfjob-chief-0"].metadata.labels.get("job-role") == "master"
+        assert pods["test-tfjob-worker-0"].metadata.labels.get("job-role") is None
+
+    def test_created_condition_set(self, env):
+        cluster, controller = env
+        job = create_and_sync(cluster, controller, tfjob_manifest(worker=1))
+        conds = job["status"]["conditions"]
+        assert conds[0]["type"] == "Created"
+        assert conds[0]["reason"] == "TFJobCreated"
+
+    def test_scale_down_deletes_out_of_range_pods(self, env):
+        cluster, controller = env
+        manifest = tfjob_manifest(worker=3)
+        job = create_and_sync(cluster, controller, tfjob_manifest(worker=3))
+        assert len(cluster.list_pods()) == 3
+        # Scale down to 1 worker.
+        job["spec"]["tfReplicaSpecs"]["Worker"]["replicas"] = 1
+        cluster.update_job(job)
+        controller.run_until_idle()
+        names = sorted(p.metadata.name for p in cluster.list_pods())
+        assert names == ["test-tfjob-worker-0"]
+
+
+class TestTFConfig:
+    def test_tf_config_content(self, env):
+        cluster, controller = env
+        create_and_sync(cluster, controller, tfjob_manifest(worker=2, ps=1))
+        pod = cluster.get_pod("default", "test-tfjob-worker-1")
+        cfg = json.loads(pod.spec.containers[0].get_env("TF_CONFIG"))
+        assert cfg["task"] == {"type": "worker", "index": 1}
+        assert cfg["environment"] == "cloud"
+        assert cfg["cluster"]["worker"] == [
+            "test-tfjob-worker-0.default.svc:2222",
+            "test-tfjob-worker-1.default.svc:2222",
+        ]
+        assert cfg["cluster"]["ps"] == ["test-tfjob-ps-0.default.svc:2222"]
+
+    def test_single_process_job_gets_no_tf_config(self, env):
+        cluster, controller = env
+        create_and_sync(cluster, controller, tfjob_manifest(worker=1))
+        pod = cluster.get_pod("default", "test-tfjob-worker-0")
+        assert pod.spec.containers[0].get_env("TF_CONFIG") is None
+
+    def test_dynamic_worker_sparse_config(self, env):
+        cluster, controller = env
+        manifest = tfjob_manifest(worker=2, ps=1)
+        manifest["spec"]["enableDynamicWorker"] = True
+        create_and_sync(cluster, controller, manifest)
+        pod = cluster.get_pod("default", "test-tfjob-worker-1")
+        cfg = json.loads(pod.spec.containers[0].get_env("TF_CONFIG"))
+        assert "sparseCluster" in cfg
+        assert cfg["sparseCluster"]["worker"] == {"1": "test-tfjob-worker-1.default.svc:2222"}
+        assert cfg["sparseCluster"]["ps"] == ["test-tfjob-ps-0.default.svc:2222"]
+
+
+class TestStatusMachine:
+    def test_running_condition_when_worker_running(self, env):
+        cluster, controller = env
+        create_and_sync(cluster, controller, tfjob_manifest(worker=2))
+        cluster.set_pod_phase("default", "test-tfjob-worker-0", POD_RUNNING)
+        controller.run_until_idle()
+        job = cluster.get_job("TFJob", "default", "test-tfjob")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds["Running"]["status"] == "True"
+        assert job["status"]["replicaStatuses"]["Worker"]["active"] == 1
+
+    def test_worker0_completion_succeeds_job(self, env):
+        cluster, controller = env
+        create_and_sync(cluster, controller, tfjob_manifest(worker=2))
+        cluster.set_pod_phase("default", "test-tfjob-worker-1", POD_RUNNING)
+        controller.run_until_idle()
+        cluster.set_pod_phase("default", "test-tfjob-worker-0", POD_SUCCEEDED, exit_code=0)
+        controller.run_until_idle()
+        job = cluster.get_job("TFJob", "default", "test-tfjob")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds["Succeeded"]["status"] == "True"
+        # The prior Running condition is flipped to False by the terminal one.
+        assert conds["Running"]["status"] == "False"
+
+    def test_all_workers_policy_waits_for_all(self, env):
+        cluster, controller = env
+        create_and_sync(
+            cluster, controller, tfjob_manifest(worker=2, success_policy="AllWorkers")
+        )
+        cluster.set_pod_phase("default", "test-tfjob-worker-0", POD_SUCCEEDED, exit_code=0)
+        cluster.set_pod_phase("default", "test-tfjob-worker-1", POD_RUNNING)
+        controller.run_until_idle()
+        job = cluster.get_job("TFJob", "default", "test-tfjob")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert "Succeeded" not in conds
+        # Finish the second worker -> job succeeds.
+        cluster.set_pod_phase("default", "test-tfjob-worker-1", POD_SUCCEEDED, exit_code=0)
+        controller.run_until_idle()
+        job = cluster.get_job("TFJob", "default", "test-tfjob")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds["Succeeded"]["status"] == "True"
+
+    def test_chief_completion_wins_over_workers(self, env):
+        cluster, controller = env
+        create_and_sync(cluster, controller, tfjob_manifest(worker=2, chief=1))
+        cluster.set_pod_phase("default", "test-tfjob-chief-0", POD_SUCCEEDED, exit_code=0)
+        cluster.set_pod_phase("default", "test-tfjob-worker-0", POD_RUNNING)
+        cluster.set_pod_phase("default", "test-tfjob-worker-1", POD_RUNNING)
+        controller.run_until_idle()
+        job = cluster.get_job("TFJob", "default", "test-tfjob")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds["Succeeded"]["status"] == "True"
+
+    def test_failed_pod_fails_job(self, env):
+        cluster, controller = env
+        create_and_sync(cluster, controller, tfjob_manifest(worker=2))
+        cluster.set_pod_phase("default", "test-tfjob-worker-1", POD_FAILED, exit_code=1)
+        controller.run_until_idle()
+        job = cluster.get_job("TFJob", "default", "test-tfjob")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds["Failed"]["status"] == "True"
+        assert job["status"]["replicaStatuses"]["Worker"]["failed"] == 1
+
+
+class TestRestartPolicies:
+    def test_exit_code_retryable_restarts_pod(self, env):
+        cluster, controller = env
+        create_and_sync(
+            cluster, controller, tfjob_manifest(worker=2, restart_policy="ExitCode")
+        )
+        # Retryable exit code (137 = SIGKILL) -> pod deleted, job Restarting.
+        cluster.set_pod_phase("default", "test-tfjob-worker-1", POD_FAILED, exit_code=137)
+        controller.run_until_idle()
+        job = cluster.get_job("TFJob", "default", "test-tfjob")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds["Restarting"]["status"] == "True"
+        assert "Failed" not in conds
+        # Next sync recreates worker-1.
+        controller.run_until_idle()
+        assert any(
+            p.metadata.name == "test-tfjob-worker-1" and p.status.phase == POD_PENDING
+            for p in cluster.list_pods()
+        )
+
+    def test_retryable_failure_with_running_peers_restarts_not_fails(self, env):
+        """Regression: a retryable failure while sibling workers are Running
+        must yield Restarting (not Failed — the Running condition must not
+        clobber the Restarting guard) and the pod must be recreated."""
+        cluster, controller = env
+        create_and_sync(
+            cluster, controller, tfjob_manifest(worker=3, ps=1, restart_policy="ExitCode")
+        )
+        for p in cluster.list_pods():
+            cluster.set_pod_phase(p.metadata.namespace, p.metadata.name, POD_RUNNING)
+        controller.run_until_idle()
+        cluster.set_pod_phase("default", "test-tfjob-worker-1", POD_FAILED, exit_code=137)
+        controller.run_until_idle()
+        job = cluster.get_job("TFJob", "default", "test-tfjob")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        # Restarting is transient (the recreated pod's sync flips it back to
+        # Running); the durable signals are: never Failed, pod recreated,
+        # restart recorded, and the job still live.
+        assert "Failed" not in conds
+        assert any(p.metadata.name == "test-tfjob-worker-1" for p in cluster.list_pods())
+        assert any(e.reason == "TFJobRestarting" for e in cluster.list_events())
+        cluster.set_pod_phase("default", "test-tfjob-worker-1", POD_RUNNING)
+        controller.run_until_idle()
+        job = cluster.get_job("TFJob", "default", "test-tfjob")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds["Running"]["status"] == "True"
+        assert "Restarting" not in conds
+
+    def test_exit_code_permanent_fails_job(self, env):
+        cluster, controller = env
+        create_and_sync(
+            cluster, controller, tfjob_manifest(worker=2, restart_policy="ExitCode")
+        )
+        cluster.set_pod_phase("default", "test-tfjob-worker-1", POD_FAILED, exit_code=1)
+        controller.run_until_idle()
+        job = cluster.get_job("TFJob", "default", "test-tfjob")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds["Failed"]["status"] == "True"
+        assert "Restarting" not in conds
+
+    def test_exit_code_policy_maps_to_pod_restart_never(self, env):
+        cluster, controller = env
+        create_and_sync(
+            cluster, controller, tfjob_manifest(worker=1, restart_policy="ExitCode")
+        )
+        pod = cluster.list_pods()[0]
+        assert pod.spec.restart_policy == "Never"
+
+
+class TestRunPolicies:
+    def test_clean_pod_policy_running(self, env):
+        cluster, controller = env
+        create_and_sync(cluster, controller, tfjob_manifest(worker=3))
+        cluster.set_pod_phase("default", "test-tfjob-worker-0", POD_SUCCEEDED, exit_code=0)
+        cluster.set_pod_phase("default", "test-tfjob-worker-1", POD_RUNNING)
+        cluster.set_pod_phase("default", "test-tfjob-worker-2", POD_RUNNING)
+        controller.run_until_idle()
+        # Default CleanPodPolicy=Running: running pods deleted, completed kept.
+        names = sorted(p.metadata.name for p in cluster.list_pods())
+        assert names == ["test-tfjob-worker-0"]
+        assert cluster.list_services() == []
+
+    def test_clean_pod_policy_none_keeps_pods(self, env):
+        cluster, controller = env
+        create_and_sync(
+            cluster, controller, tfjob_manifest(worker=2, clean_pod_policy="None")
+        )
+        cluster.set_pod_phase("default", "test-tfjob-worker-0", POD_SUCCEEDED, exit_code=0)
+        controller.run_until_idle()
+        assert len(cluster.list_pods()) == 2
+
+    def test_clean_pod_policy_all_deletes_all(self, env):
+        cluster, controller = env
+        create_and_sync(
+            cluster, controller, tfjob_manifest(worker=2, clean_pod_policy="All")
+        )
+        cluster.set_pod_phase("default", "test-tfjob-worker-0", POD_SUCCEEDED, exit_code=0)
+        cluster.set_pod_phase("default", "test-tfjob-worker-1", POD_SUCCEEDED, exit_code=0)
+        controller.run_until_idle()
+        assert cluster.list_pods() == []
+
+    def test_active_deadline_fails_job(self, env):
+        now = [1000.0]
+        cluster = InMemoryCluster(clock=lambda: now[0])
+        controller = TFController(cluster, clock=lambda: now[0])
+        cluster.create_job(tfjob_manifest(worker=1, active_deadline=60))
+        controller.run_until_idle()
+        cluster.set_pod_phase("default", "test-tfjob-worker-0", POD_RUNNING)
+        controller.run_until_idle()
+        now[0] += 120  # past the deadline
+        controller.queue.add("TFJob:default/test-tfjob")
+        controller.run_until_idle()
+        job = cluster.get_job("TFJob", "default", "test-tfjob")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds["Failed"]["reason"] == "DeadlineExceeded"
+        assert cluster.list_pods() == []
+
+    def test_ttl_deletes_finished_job(self, env):
+        now = [1000.0]
+        cluster = InMemoryCluster(clock=lambda: now[0])
+        controller = TFController(cluster, clock=lambda: now[0])
+        cluster.create_job(tfjob_manifest(worker=1, ttl=30))
+        controller.run_until_idle()
+        cluster.set_pod_phase("default", "test-tfjob-worker-0", POD_SUCCEEDED, exit_code=0)
+        controller.run_until_idle()
+        assert cluster.get_job("TFJob", "default", "test-tfjob")
+        now[0] += 60
+        controller.queue.add("TFJob:default/test-tfjob")
+        controller.run_until_idle()
+        from tf_operator_tpu.cluster.base import NotFound
+
+        with pytest.raises(NotFound):
+            cluster.get_job("TFJob", "default", "test-tfjob")
+
+
+class TestInvalidSpecs:
+    def test_invalid_spec_marks_failed_without_crashing(self, env):
+        cluster, controller = env
+        manifest = tfjob_manifest(worker=1)
+        manifest["spec"]["tfReplicaSpecs"]["Worker"]["template"]["spec"]["containers"][0][
+            "name"
+        ] = "main"
+        cluster.create_job(manifest)
+        controller.run_until_idle()
+        job = cluster.get_job("TFJob", "default", "test-tfjob")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds["Failed"]["status"] == "True"
+        assert cluster.list_pods() == []
+
+
+class TestEndToEndLifecycle:
+    def test_full_lifecycle_with_simulated_kubelet(self, env):
+        """Create job -> pods run -> worker-0 exits 0 -> job Succeeded ->
+        CleanPodPolicy removes running pods. The reference needs a real
+        cluster for this (T3); here the kubelet sim plays it in-process."""
+        cluster, controller = env
+        cluster.create_job(tfjob_manifest(worker=2, ps=1))
+        controller.run_until_idle()
+        # Register behaviors: worker-0 exits cleanly after 2 ticks, others run on.
+        cluster.set_behavior("default", "test-tfjob-worker-0", terminate_after(2, 0))
+        for _ in range(5):
+            cluster.step()
+            controller.run_until_idle()
+        job = cluster.get_job("TFJob", "default", "test-tfjob")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds["Succeeded"]["status"] == "True"
+        # Running pods (worker-1, ps-0) cleaned up; completed worker kept.
+        assert sorted(p.metadata.name for p in cluster.list_pods()) == [
+            "test-tfjob-worker-0"
+        ]
+        # Lifecycle events were recorded.
+        reasons = {e.reason for e in cluster.list_events()}
+        assert "SuccessfulCreatePod" in reasons
+        assert "TFJobSucceeded" in reasons
+
+    def test_metrics_counters(self, env):
+        cluster, controller = env
+        create_and_sync(cluster, controller, tfjob_manifest(worker=1))
+        cluster.set_pod_phase("default", "test-tfjob-worker-0", POD_SUCCEEDED, exit_code=0)
+        controller.run_until_idle()
+        m = controller.metrics
+        assert m.counter_value("training_operator_jobs_created_total", "default", "TFJob") >= 1
+        assert (
+            m.counter_value("training_operator_jobs_successful_total", "default", "TFJob") == 1
+        )
+        assert "training_operator_jobs_created_total" in m.render()

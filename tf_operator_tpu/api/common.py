@@ -159,7 +159,9 @@ def update_job_conditions(
 
     - setting Running removes Restarting (and vice versa);
     - terminal conditions (Succeeded/Failed) flip Running to False;
-    - timestamps update on every set, transition time only on status change.
+    - re-setting an identical condition is a strict no-op (timestamps advance
+      only on a transition or a message change), so steady-state syncs do not
+      produce status diffs.
 
     Reference: kubeflow/common pkg/util/status.go setCondition/filterOutCondition
     semantics as exercised by the reference's status_test.go.
@@ -176,9 +178,12 @@ def update_job_conditions(
 
     existing = get_condition(status, cond_type)
     if existing is not None and existing.status == new_cond.status and existing.reason == new_cond.reason:
-        # No transition: refresh update time/message only.
-        existing.last_update_time = now
-        existing.message = message
+        # No transition. An identical condition must be a strict no-op —
+        # refreshing timestamps would make every sync look like a status
+        # change and turn the watch->reconcile loop into a hot loop.
+        if existing.message != message:
+            existing.message = message
+            existing.last_update_time = now
         return
 
     # Filter out: the same type; Restarting when setting Running; Running when

@@ -1,0 +1,9 @@
+"""Per-framework communication-bootstrap contracts.
+
+The operator contains no transport; it is a rendezvous-config injector
+(SURVEY.md §5.8). Each module here generates the env one framework's
+processes need to find each other: `tf_config` (TF_CONFIG JSON), `c10d`
+(MASTER_ADDR/RANK/WORLD_SIZE), `dmlc` (MXNet PS-Lite), `rabit`
+(XGBoost/LightGBM), and `jaxdist` (jax.distributed coordinator + TPU slice
+topology — the TPU-native contract with no reference counterpart).
+"""

@@ -1,0 +1,91 @@
+"""TF_CONFIG generation.
+
+Reference parity: pkg/controller.v1/tensorflow/tensorflow.go (genTFConfigJSONStr,
+genClusterSpec, SparseClusterSpec for dynamic workers).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List
+
+from ..api import tfjob as tfapi
+from ..api.tfjob import TFJob
+from ..core.job_controller import gen_general_name
+
+# Custom cluster DNS domain, e.g. "cluster.local" (reference tensorflow.go:30-33).
+ENV_CUSTOM_CLUSTER_DOMAIN = "CUSTOM_CLUSTER_DOMAIN"
+
+
+def replica_service_host(job_name: str, namespace: str, rtype: str, index: int) -> str:
+    """Stable DNS name of one replica's headless service:
+    "<job>-<type>-<i>.<ns>.svc[.<domain>]" (reference tensorflow.go:153-166).
+    Built on gen_general_name so the hostnames always match the services the
+    engine actually creates."""
+    host = gen_general_name(job_name, rtype, index) + f".{namespace}.svc"
+    domain = os.environ.get(ENV_CUSTOM_CLUSTER_DOMAIN, "")
+    if domain:
+        host += f".{domain}"
+    return host
+
+
+def get_port_from_job(job: TFJob, rtype: str) -> int:
+    spec = job.spec.tf_replica_specs[rtype]
+    for container in spec.template.spec.containers:
+        if container.name == tfapi.DEFAULT_CONTAINER_NAME:
+            for port in container.ports:
+                if port.name == tfapi.DEFAULT_PORT_NAME:
+                    return port.container_port
+    return tfapi.DEFAULT_PORT
+
+
+def gen_cluster_spec(job: TFJob) -> Dict[str, List[str]]:
+    """{"ps": ["host:2222", ...], "worker": [...]} (reference genClusterSpec)."""
+    cluster: Dict[str, List[str]] = {}
+    for rtype, spec in job.spec.tf_replica_specs.items():
+        rt = rtype.lower()
+        port = get_port_from_job(job, rtype)
+        cluster[rt] = [
+            f"{replica_service_host(job.name, job.namespace, rt, i)}:{port}"
+            for i in range(spec.replicas or 0)
+        ]
+    return cluster
+
+
+def gen_tf_config(job: TFJob, rtype: str, index: int) -> str:
+    """The TF_CONFIG JSON for one replica (reference genTFConfigJSONStr).
+
+    With EnableDynamicWorker, emit the sparse form: each worker sees only
+    itself + the PS list, so workers can join/leave without restarting the
+    world (reference tensorflow.go:62-83,110-119)."""
+    cluster = gen_cluster_spec(job)
+    rt = rtype.lower()
+    if job.spec.enable_dynamic_worker:
+        sparse: Dict[str, object] = {"worker": {}, "ps": []}
+        if rt == tfapi.REPLICA_TYPE_PS.lower():
+            sparse["ps"] = [cluster[rt][index]]
+        elif rt == tfapi.REPLICA_TYPE_WORKER.lower():
+            sparse["ps"] = cluster.get(tfapi.REPLICA_TYPE_PS.lower(), [])
+            sparse["worker"] = {index: cluster[rt][index]}
+        return json.dumps(
+            {"sparseCluster": sparse, "task": {"type": rt, "index": index}},
+            separators=(",", ":"),
+        )
+    return json.dumps(
+        {
+            "cluster": cluster,
+            "task": {"type": rt, "index": index},
+            # "cloud" keeps legacy tf.contrib.learn from defaulting to local
+            # (reference tensorflow.go:127-131).
+            "environment": "cloud",
+        },
+        separators=(",", ":"),
+    )
+
+
+def is_distributed(job: TFJob) -> bool:
+    """Single-process jobs get no TF_CONFIG (reference pod.go:296-319)."""
+    specs = job.spec.tf_replica_specs
+    total = sum(spec.replicas or 0 for spec in specs.values())
+    return total > 1

@@ -1,0 +1,14 @@
+"""Cluster backends.
+
+The operator talks to a cluster through the small interface in `base.py`.
+`memory.py` provides an in-process cluster (API-server + scheduler + kubelet
+simulation) used by unit tests (replacing the reference's fake clients +
+seeded informer indexers, SURVEY.md §4 T1) and by the e2e harness (replacing
+the reference's real EKS cluster, §4 T3). `kube.py` speaks to a real
+Kubernetes API server for production deployments.
+"""
+
+from .base import Cluster, NotFound
+from .memory import InMemoryCluster
+
+__all__ = ["Cluster", "NotFound", "InMemoryCluster"]

@@ -1,0 +1,98 @@
+"""The cluster interface the operator programs against.
+
+The reference reaches its cluster through client-go clientsets + informers
+(L0 in SURVEY.md §1). This interface is the equivalent seam: everything the
+engine needs — typed CRUD for jobs/pods/services/podgroups, events, and watch
+callbacks — with no Kubernetes dependency, so the same engine drives the
+in-memory simulator and a real API server.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from ..api.k8s import Event, Pod, Service
+
+
+class NotFound(KeyError):
+    """Object does not exist (k8s 404 analog)."""
+
+
+# Watch event types
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+WatchHandler = Callable[[str, object], None]  # (event_type, object) -> None
+
+
+class Cluster:
+    """Abstract cluster backend."""
+
+    # ---- jobs (CR objects, stored as dicts keyed by kind) ----
+    def create_job(self, job_dict: dict) -> dict:
+        raise NotImplementedError
+
+    def get_job(self, kind: str, namespace: str, name: str) -> dict:
+        raise NotImplementedError
+
+    def list_jobs(self, kind: str, namespace: Optional[str] = None) -> List[dict]:
+        raise NotImplementedError
+
+    def update_job(self, job_dict: dict) -> dict:
+        raise NotImplementedError
+
+    def update_job_status(self, kind: str, namespace: str, name: str, status: dict) -> dict:
+        raise NotImplementedError
+
+    def delete_job(self, kind: str, namespace: str, name: str) -> None:
+        raise NotImplementedError
+
+    # ---- pods ----
+    def create_pod(self, pod: Pod) -> Pod:
+        raise NotImplementedError
+
+    def get_pod(self, namespace: str, name: str) -> Pod:
+        raise NotImplementedError
+
+    def list_pods(self, namespace: Optional[str] = None, labels: Optional[Dict[str, str]] = None) -> List[Pod]:
+        raise NotImplementedError
+
+    def update_pod(self, pod: Pod) -> Pod:
+        raise NotImplementedError
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        raise NotImplementedError
+
+    # ---- services ----
+    def create_service(self, service: Service) -> Service:
+        raise NotImplementedError
+
+    def list_services(self, namespace: Optional[str] = None, labels: Optional[Dict[str, str]] = None) -> List[Service]:
+        raise NotImplementedError
+
+    def delete_service(self, namespace: str, name: str) -> None:
+        raise NotImplementedError
+
+    # ---- pod groups (gang scheduling unit; volcano PodGroup analog) ----
+    def create_pod_group(self, group: dict) -> dict:
+        raise NotImplementedError
+
+    def get_pod_group(self, namespace: str, name: str) -> dict:
+        raise NotImplementedError
+
+    def delete_pod_group(self, namespace: str, name: str) -> None:
+        raise NotImplementedError
+
+    # ---- events ----
+    def record_event(self, event: Event) -> None:
+        raise NotImplementedError
+
+    def list_events(self, involved_object: Optional[str] = None) -> List[Event]:
+        raise NotImplementedError
+
+    # ---- watches ----
+    def watch(self, kind: str, handler: WatchHandler) -> None:
+        """Register a callback for ADDED/MODIFIED/DELETED events on `kind`
+        ("pods", "services", or a job kind like "TFJob")."""
+        raise NotImplementedError

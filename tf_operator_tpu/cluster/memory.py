@@ -1,0 +1,355 @@
+"""In-memory cluster: API-server + scheduler + kubelet simulation.
+
+Serves two roles the reference splits across harness tiers (SURVEY.md §4):
+
+- T1 double: tests seed pods/phases directly (like testutil.SetPodsStatuses
+  seeding informer indexers) and assert engine actions.
+- e2e simulator: `step()` plays scheduler + kubelet — binds pending pods
+  (honoring gang all-or-nothing via pod groups) and runs container behaviors
+  registered per pod, so whole job lifecycles (run → exit codes → restart →
+  completion) execute in-process.
+
+Semantics follow the API server where it matters to the engine: objects get
+uid + monotonically-increasing resourceVersion, reads return deep copies,
+deletes are observable via watch events, status updates bump versions.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..api.k8s import (
+    POD_FAILED,
+    POD_PENDING,
+    POD_RUNNING,
+    POD_SUCCEEDED,
+    ContainerState,
+    ContainerStateTerminated,
+    ContainerStatus,
+    Event,
+    Pod,
+    Service,
+)
+from . import base
+from .base import ADDED, DELETED, MODIFIED, NotFound
+
+
+class InMemoryCluster(base.Cluster):
+    def __init__(self, clock=time.time):
+        self._lock = threading.RLock()
+        self._clock = clock
+        self._uid = itertools.count(1)
+        self._rv = itertools.count(1)
+        self._jobs: Dict[Tuple[str, str, str], dict] = {}
+        self._pods: Dict[Tuple[str, str], Pod] = {}
+        self._services: Dict[Tuple[str, str], Service] = {}
+        self._pod_groups: Dict[Tuple[str, str], dict] = {}
+        self._events: List[Event] = []
+        self._watchers: Dict[str, List[base.WatchHandler]] = {}
+        # pod name -> behavior fn(pod) called on each step() while running
+        self._behaviors: Dict[Tuple[str, str], Callable[[Pod], None]] = {}
+
+    # ------------------------------------------------------------------ util
+    def _emit(self, kind: str, event_type: str, obj) -> None:
+        for handler in self._watchers.get(kind, []):
+            handler(event_type, copy.deepcopy(obj))
+
+    def watch(self, kind: str, handler: base.WatchHandler) -> None:
+        with self._lock:
+            self._watchers.setdefault(kind, []).append(handler)
+
+    # ------------------------------------------------------------------ jobs
+    def create_job(self, job_dict: dict) -> dict:
+        job_dict = copy.deepcopy(job_dict)
+        kind = job_dict.get("kind", "")
+        meta = job_dict.setdefault("metadata", {})
+        ns, name = meta.get("namespace", "default"), meta["name"]
+        meta.setdefault("namespace", "default")
+        with self._lock:
+            if (kind, ns, name) in self._jobs:
+                raise ValueError(f"{kind} {ns}/{name} already exists")
+            meta["uid"] = f"uid-{next(self._uid)}"
+            meta["resourceVersion"] = str(next(self._rv))
+            meta["creationTimestamp"] = self._clock()
+            self._jobs[(kind, ns, name)] = job_dict
+            out = copy.deepcopy(job_dict)
+        self._emit(kind, ADDED, out)
+        return out
+
+    def get_job(self, kind: str, namespace: str, name: str) -> dict:
+        with self._lock:
+            try:
+                return copy.deepcopy(self._jobs[(kind, namespace, name)])
+            except KeyError:
+                raise NotFound(f"{kind} {namespace}/{name}")
+
+    def list_jobs(self, kind: str, namespace: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            return [
+                copy.deepcopy(j)
+                for (k, ns, _), j in self._jobs.items()
+                if k == kind and (namespace is None or ns == namespace)
+            ]
+
+    def update_job(self, job_dict: dict) -> dict:
+        kind = job_dict.get("kind", "")
+        meta = job_dict.get("metadata", {})
+        ns, name = meta.get("namespace", "default"), meta["name"]
+        with self._lock:
+            if (kind, ns, name) not in self._jobs:
+                raise NotFound(f"{kind} {ns}/{name}")
+            stored = copy.deepcopy(job_dict)
+            stored["metadata"]["resourceVersion"] = str(next(self._rv))
+            self._jobs[(kind, ns, name)] = stored
+            out = copy.deepcopy(stored)
+        self._emit(kind, MODIFIED, out)
+        return out
+
+    def update_job_status(self, kind: str, namespace: str, name: str, status: dict) -> dict:
+        with self._lock:
+            job = self._jobs.get((kind, namespace, name))
+            if job is None:
+                raise NotFound(f"{kind} {namespace}/{name}")
+            job["status"] = copy.deepcopy(status)
+            job["metadata"]["resourceVersion"] = str(next(self._rv))
+            out = copy.deepcopy(job)
+        self._emit(kind, MODIFIED, out)
+        return out
+
+    def delete_job(self, kind: str, namespace: str, name: str) -> None:
+        with self._lock:
+            job = self._jobs.pop((kind, namespace, name), None)
+            if job is None:
+                raise NotFound(f"{kind} {namespace}/{name}")
+        self._emit(kind, DELETED, job)
+
+    # ------------------------------------------------------------------ pods
+    def create_pod(self, pod: Pod) -> Pod:
+        pod = pod.deep_copy()
+        key = (pod.metadata.namespace, pod.metadata.name)
+        with self._lock:
+            if key in self._pods:
+                raise ValueError(f"pod {key} already exists")
+            pod.metadata.uid = f"uid-{next(self._uid)}"
+            pod.metadata.resource_version = str(next(self._rv))
+            pod.metadata.creation_timestamp = self._clock()
+            pod.status.phase = POD_PENDING
+            self._pods[key] = pod
+            out = pod.deep_copy()
+        self._emit("pods", ADDED, out)
+        return out
+
+    def get_pod(self, namespace: str, name: str) -> Pod:
+        with self._lock:
+            try:
+                return self._pods[(namespace, name)].deep_copy()
+            except KeyError:
+                raise NotFound(f"pod {namespace}/{name}")
+
+    def list_pods(self, namespace=None, labels=None) -> List[Pod]:
+        with self._lock:
+            out = []
+            for (ns, _), pod in self._pods.items():
+                if namespace is not None and ns != namespace:
+                    continue
+                if labels and any(pod.metadata.labels.get(k) != v for k, v in labels.items()):
+                    continue
+                out.append(pod.deep_copy())
+            return out
+
+    def update_pod(self, pod: Pod) -> Pod:
+        key = (pod.metadata.namespace, pod.metadata.name)
+        with self._lock:
+            if key not in self._pods:
+                raise NotFound(f"pod {key}")
+            pod = pod.deep_copy()
+            pod.metadata.resource_version = str(next(self._rv))
+            self._pods[key] = pod
+            out = pod.deep_copy()
+        self._emit("pods", MODIFIED, out)
+        return out
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        with self._lock:
+            pod = self._pods.pop((namespace, name), None)
+            self._behaviors.pop((namespace, name), None)
+            if pod is None:
+                raise NotFound(f"pod {namespace}/{name}")
+        self._emit("pods", DELETED, pod)
+
+    # -------------------------------------------------------------- services
+    def create_service(self, service: Service) -> Service:
+        service = service.deep_copy()
+        key = (service.metadata.namespace, service.metadata.name)
+        with self._lock:
+            if key in self._services:
+                raise ValueError(f"service {key} already exists")
+            service.metadata.uid = f"uid-{next(self._uid)}"
+            service.metadata.resource_version = str(next(self._rv))
+            self._services[key] = service
+            out = service.deep_copy()
+        self._emit("services", ADDED, out)
+        return out
+
+    def list_services(self, namespace=None, labels=None) -> List[Service]:
+        with self._lock:
+            out = []
+            for (ns, _), svc in self._services.items():
+                if namespace is not None and ns != namespace:
+                    continue
+                if labels and any(svc.metadata.labels.get(k) != v for k, v in labels.items()):
+                    continue
+                out.append(svc.deep_copy())
+            return out
+
+    def delete_service(self, namespace: str, name: str) -> None:
+        with self._lock:
+            svc = self._services.pop((namespace, name), None)
+            if svc is None:
+                raise NotFound(f"service {namespace}/{name}")
+        self._emit("services", DELETED, svc)
+
+    # ------------------------------------------------------------ pod groups
+    def create_pod_group(self, group: dict) -> dict:
+        group = copy.deepcopy(group)
+        meta = group.setdefault("metadata", {})
+        key = (meta.get("namespace", "default"), meta["name"])
+        with self._lock:
+            self._pod_groups[key] = group
+            return copy.deepcopy(group)
+
+    def get_pod_group(self, namespace: str, name: str) -> dict:
+        with self._lock:
+            try:
+                return copy.deepcopy(self._pod_groups[(namespace, name)])
+            except KeyError:
+                raise NotFound(f"podgroup {namespace}/{name}")
+
+    def delete_pod_group(self, namespace: str, name: str) -> None:
+        with self._lock:
+            self._pod_groups.pop((namespace, name), None)
+
+    # ---------------------------------------------------------------- events
+    def record_event(self, event: Event) -> None:
+        with self._lock:
+            if event.timestamp is None:
+                event.timestamp = self._clock()
+            self._events.append(event)
+
+    def list_events(self, involved_object: Optional[str] = None) -> List[Event]:
+        with self._lock:
+            return [
+                copy.deepcopy(e)
+                for e in self._events
+                if involved_object is None or e.involved_object == involved_object
+            ]
+
+    # ----------------------------------------------------- kubelet/scheduler
+    def set_behavior(self, namespace: str, name: str, fn: Callable[[Pod], None]) -> None:
+        """Register a per-step container behavior for a running pod. `fn`
+        mutates the pod in place (e.g. terminate with an exit code)."""
+        with self._lock:
+            self._behaviors[(namespace, name)] = fn
+
+    def _gang_schedulable(self, pod: Pod) -> bool:
+        """All-or-nothing: a pod annotated with a gang group only binds when
+        the whole gang's pods exist (minAvailable present in the cluster)."""
+        from ..core.constants import ANNOTATION_GANG_GROUP_NAME
+
+        group_name = pod.metadata.annotations.get(ANNOTATION_GANG_GROUP_NAME)
+        if not group_name:
+            return True
+        group = self._pod_groups.get((pod.metadata.namespace, group_name))
+        if group is None:
+            return False
+        min_available = group.get("spec", {}).get("minMember", 1)
+        peers = [
+            p
+            for p in self._pods.values()
+            if p.metadata.namespace == pod.metadata.namespace
+            and p.metadata.annotations.get(ANNOTATION_GANG_GROUP_NAME) == group_name
+        ]
+        return len(peers) >= min_available
+
+    def step(self) -> None:
+        """Advance the simulated cluster by one tick: bind pending pods
+        (gang-aware) and run container behaviors of running pods."""
+        updates = []
+        with self._lock:
+            for key, pod in list(self._pods.items()):
+                if pod.status.phase == POD_PENDING:
+                    if self._gang_schedulable(pod):
+                        pod.status.phase = POD_RUNNING
+                        pod.status.start_time = self._clock()
+                        pod.metadata.resource_version = str(next(self._rv))
+                        updates.append(pod.deep_copy())
+                elif pod.status.phase == POD_RUNNING:
+                    behavior = self._behaviors.get(key)
+                    if behavior is not None:
+                        behavior(pod)
+                        pod.metadata.resource_version = str(next(self._rv))
+                        updates.append(pod.deep_copy())
+        for pod in updates:
+            self._emit("pods", MODIFIED, pod)
+
+    # ------------------------------------------------- test-seeding helpers
+    def set_pod_phase(
+        self,
+        namespace: str,
+        name: str,
+        phase: str,
+        exit_code: Optional[int] = None,
+        container_name: str = "",
+        restart_count: int = 0,
+    ) -> None:
+        """Directly set a pod's phase (and terminated exit code), as the
+        reference's testutil.SetPodsStatuses seeds informer indexers."""
+        with self._lock:
+            pod = self._pods.get((namespace, name))
+            if pod is None:
+                raise NotFound(f"pod {namespace}/{name}")
+            pod.status.phase = phase
+            if phase == POD_RUNNING and pod.status.start_time is None:
+                pod.status.start_time = self._clock()
+            if exit_code is not None:
+                cname = container_name or (pod.spec.containers[0].name if pod.spec.containers else "")
+                pod.status.container_statuses = [
+                    ContainerStatus(
+                        name=cname,
+                        restart_count=restart_count,
+                        state=ContainerState(
+                            terminated=ContainerStateTerminated(
+                                exit_code=exit_code, finished_at=self._clock()
+                            )
+                        ),
+                    )
+                ]
+            pod.metadata.resource_version = str(next(self._rv))
+            out = pod.deep_copy()
+        self._emit("pods", MODIFIED, out)
+
+
+def terminate_after(steps: int, exit_code: int = 0):
+    """Behavior factory: container runs `steps` ticks then terminates."""
+    state = {"left": steps}
+
+    def fn(pod: Pod) -> None:
+        state["left"] -= 1
+        if state["left"] > 0:
+            return
+        pod.status.phase = POD_SUCCEEDED if exit_code == 0 else POD_FAILED
+        cname = pod.spec.containers[0].name if pod.spec.containers else ""
+        pod.status.container_statuses = [
+            ContainerStatus(
+                name=cname,
+                state=ContainerState(
+                    terminated=ContainerStateTerminated(exit_code=exit_code)
+                ),
+            )
+        ]
+
+    return fn

@@ -1,0 +1,41 @@
+"""Framework controllers + registry.
+
+Mirrors pkg/controller.v1 in the reference: one controller per job kind,
+registered in a kind -> factory map (register_controller.go:37-50).
+"""
+
+from typing import Callable, Dict
+
+# kind -> factory(cluster, **kwargs) -> FrameworkController; populated by
+# each controller module at import time via `register`.
+SUPPORTED_CONTROLLERS: Dict[str, Callable] = {}
+
+
+def register(kind: str):
+    def wrap(factory):
+        SUPPORTED_CONTROLLERS[kind] = factory
+        return factory
+
+    return wrap
+
+
+def enabled_kinds(names=None):
+    """reference EnabledSchemes.FillAll/Set (register_controller.go:52-77)."""
+    if not names:
+        return list(SUPPORTED_CONTROLLERS)
+    unknown = [n for n in names if n not in SUPPORTED_CONTROLLERS]
+    if unknown:
+        raise ValueError(f"unsupported kind(s) {unknown}; supported: {list(SUPPORTED_CONTROLLERS)}")
+    return list(names)
+
+
+def _load_all():
+    from . import tensorflow  # noqa: F401
+
+    try:
+        from . import pytorch, mxnet, xgboost, jax  # noqa: F401
+    except ImportError:
+        pass  # later milestones
+
+
+_load_all()

@@ -1,0 +1,224 @@
+"""Base framework controller: wires hooks + engine + cluster watches.
+
+The reference equivalent is each framework's Reconciler embedding
+common.JobController and implementing ControllerInterface
+(tfjob_controller.go:75-204). Here the shared wiring lives once.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from ..api import KINDS
+from ..api.common import JobObject
+from ..api.defaulting import ValidationError
+from ..api.k8s import Event
+from ..cluster.base import ADDED, DELETED, Cluster, NotFound
+from ..core import constants
+from ..core.control import RealPodControl, RealServiceControl
+from ..core.expectations import ControllerExpectations
+from ..core.job_controller import EngineOptions, FrameworkHooks, JobController
+from ..core.workqueue import WorkQueue
+
+
+class FrameworkController(FrameworkHooks):
+    """One per job kind. Subclasses set kind/container/port constants and
+    implement set_cluster_spec / update_job_status / is_master_role."""
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        queue: Optional[WorkQueue] = None,
+        options: Optional[EngineOptions] = None,
+        clock=time.time,
+        metrics=None,
+    ):
+        self.cluster = cluster
+        self.queue = queue or WorkQueue()
+        self.clock = clock
+        if metrics is None:
+            from ..metrics import METRICS
+
+            metrics = METRICS
+        self.metrics = metrics
+        self.expectations = ControllerExpectations()
+        self.engine = JobController(
+            hooks=self,
+            cluster=cluster,
+            pod_control=RealPodControl(cluster),
+            service_control=RealServiceControl(cluster),
+            expectations=self.expectations,
+            options=options,
+            requeue=lambda key, after: self.queue.add_after(key, after),
+            clock=clock,
+            on_job_restarting=self._record_restart,
+        )
+        self._watch()
+
+    # ---------------------------------------------------------------- glue
+    def _watch(self) -> None:
+        """Job + dependent (pod/service) watches feeding the workqueue — the
+        reference's SetupWithManager watch wiring + expectation-maintaining
+        predicates (tfjob_controller.go:163-204, common/util/reconciler.go)."""
+        self.cluster.watch(self.kind, self._on_job_event)
+        self.cluster.watch("pods", self._on_dependent_event("pods"))
+        self.cluster.watch("services", self._on_dependent_event("services"))
+
+    def _enqueue(self, namespace: str, name: str) -> None:
+        self.queue.add(f"{self.kind}:{namespace}/{name}")
+
+    def _on_job_event(self, event_type: str, job_dict: dict) -> None:
+        meta = job_dict.get("metadata", {})
+        if event_type == ADDED:
+            self.metrics.created_inc(meta.get("namespace", "default"), self.kind)
+        if event_type == DELETED:
+            self.metrics.deleted_inc(meta.get("namespace", "default"), self.kind)
+            return
+        self._enqueue(meta.get("namespace", "default"), meta.get("name", ""))
+
+    def _on_dependent_event(self, dependent_kind: str):
+        def handler(event_type: str, obj) -> None:
+            ref = obj.metadata.controller_ref()
+            labels = obj.metadata.labels
+            if labels.get(constants.LABEL_GROUP_NAME) != constants.GROUP_NAME:
+                return
+            if ref is not None and ref.kind != self.kind:
+                return
+            job_name = labels.get(constants.LABEL_JOB_NAME)
+            if not job_name:
+                return
+            key = f"{obj.metadata.namespace}/{job_name}"
+            if event_type == ADDED:
+                self.expectations.creation_observed(key, dependent_kind)
+            elif event_type == DELETED:
+                self.expectations.deletion_observed(key, dependent_kind)
+            self._enqueue(obj.metadata.namespace, job_name)
+
+        return handler
+
+    def _record_restart(self, job: JobObject, rtype: str) -> None:
+        self.metrics.restarted_inc(job.namespace, self.kind)
+
+    # ------------------------------------------------------------ validate
+    def parse_job(self, job_dict: dict) -> JobObject:
+        cls, set_defaults, _ = KINDS[self.kind]
+        job = cls.parse(job_dict)
+        set_defaults(job)
+        return job
+
+    def validate_job(self, job: JobObject) -> None:
+        _, _, validate = KINDS[self.kind]
+        validate(job.spec)
+
+    # ------------------------------------------------------------- sync
+    def sync(self, namespace: str, name: str) -> None:
+        """One reconcile of one job key (reference Reconcile,
+        tfjob_controller.go:119-160)."""
+        try:
+            job_dict = self.cluster.get_job(self.kind, namespace, name)
+        except NotFound:
+            key = f"{namespace}/{name}"
+            self.expectations.delete_expectations(key, "pods")
+            self.expectations.delete_expectations(key, "services")
+            return
+
+        try:
+            job = self.parse_job(job_dict)
+            self.validate_job(job)
+        except ValidationError as err:
+            # Invalid spec: mark Failed on the stored object, don't crash
+            # (reference's unstructured-informer tolerance, issue #561).
+            self._fail_invalid(job_dict, str(err))
+            return
+
+        key = job.key()
+        if not (
+            self.expectations.satisfied(key, "pods")
+            and self.expectations.satisfied(key, "services")
+        ):
+            # Cache not settled. A watch event normally re-enqueues; also
+            # schedule a fallback resync so a dropped event cannot wedge the
+            # job past the expectation expiry window.
+            self.queue.add_after(f"{self.kind}:{key}", 30.0)
+            return
+
+        self.engine.reconcile_job(job)
+        self._roll_terminal_metrics(job)
+
+    def _fail_invalid(self, job_dict: dict, message: str) -> None:
+        from ..api import common as capi
+
+        meta = job_dict.get("metadata", {})
+        status = job_dict.get("status") or {}
+        job_status = capi.JobStatus(**{})
+        conditions = status.get("conditions") or []
+        already = any(
+            c.get("type") == capi.JOB_FAILED and c.get("status") == capi.CONDITION_TRUE
+            for c in conditions
+        )
+        if already:
+            return
+        capi.update_job_conditions(
+            job_status,
+            capi.JOB_FAILED,
+            constants.job_reason(self.kind, constants.REASON_FAILED),
+            message,
+            now=self.clock(),
+        )
+        from ..api.k8s import to_dict
+
+        new_status = dict(status)
+        new_status["conditions"] = conditions + [to_dict(c) for c in job_status.conditions]
+        try:
+            self.cluster.update_job_status(
+                self.kind, meta.get("namespace", "default"), meta.get("name", ""), new_status
+            )
+        except NotFound:
+            pass
+        self.cluster.record_event(
+            Event(
+                type="Warning",
+                reason=constants.job_reason(self.kind, constants.REASON_FAILED),
+                message=message,
+                involved_object=f"{self.kind}/{meta.get('namespace', 'default')}/{meta.get('name', '')}",
+            )
+        )
+
+    def _roll_terminal_metrics(self, job: JobObject) -> None:
+        from ..api import common as capi
+
+        # Count each terminal transition once: reconcile_job set the condition
+        # this sync iff last_transition moved; cheap approximation — guard via
+        # metrics' dedup of (kind, key, condition).
+        if capi.is_succeeded(job.status):
+            self.metrics.successful_inc_once(job.namespace, self.kind, job.key())
+        elif capi.is_failed(job.status):
+            self.metrics.failed_inc_once(job.namespace, self.kind, job.key())
+
+    # ------------------------------------------------------------ run loop
+    def process_next(self, timeout: float = 0.1) -> bool:
+        """Drain one item; the reference's processNextWorkItem
+        (controller.go:230-286)."""
+        item = self.queue.get(timeout=timeout)
+        if item is None:
+            return False
+        try:
+            kind, _, key = item.partition(":")
+            if kind != self.kind:
+                return True
+            namespace, _, name = key.partition("/")
+            self.sync(namespace, name)
+            self.queue.forget(item)
+        except Exception:
+            self.queue.add_rate_limited(item)
+        finally:
+            self.queue.done(item)
+        return True
+
+    def run_until_idle(self, max_iterations: int = 10_000) -> None:
+        """Synchronously drain the queue (test/e2e harness helper)."""
+        for _ in range(max_iterations):
+            if self.queue.empty_and_idle():
+                return
+            self.process_next(timeout=0.01)

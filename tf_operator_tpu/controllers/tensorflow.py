@@ -1,0 +1,194 @@
+"""TFJob controller.
+
+Reference parity: pkg/controller.v1/tensorflow/tfjob_controller.go —
+TF_CONFIG injection (SetClusterSpec :542-575), master-role selection
+(:588-595), and the TF status state machine (UpdateJobStatus :353-510):
+chief/master presence drives completion, otherwise worker-0 (or all workers
+under SuccessPolicyAllWorkers), Restarting suppresses Failed.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..api import common as capi
+from ..api import tfjob as tfapi
+from ..api.common import JobStatus, ReplicaSpec
+from ..api.k8s import POD_SUCCEEDED, Event
+from ..bootstrap import tf_config
+from ..core import constants
+from ..core.job_controller import (
+    filter_pods_for_replica_type,
+    get_container_exit_code,
+    get_pod_slices,
+)
+from . import register
+from .base import FrameworkController
+
+
+def contain_chief_or_master_spec(replicas: Dict[str, ReplicaSpec]) -> bool:
+    return any(tfapi.is_chief_or_master(rt) for rt in replicas)
+
+
+@register(tfapi.KIND)
+class TFController(FrameworkController):
+    kind = tfapi.KIND
+    default_container_name = tfapi.DEFAULT_CONTAINER_NAME
+    default_port_name = tfapi.DEFAULT_PORT_NAME
+    default_port = tfapi.DEFAULT_PORT
+
+    # ----------------------------------------------------------- env spec
+    def set_cluster_spec(self, job, template, rtype: str, index: int) -> None:
+        """Inject TF_CONFIG into every container of the template
+        (reference SetClusterSpec tfjob_controller.go:542-575). Single-process
+        jobs get none (isDistributed, pod.go:296-319)."""
+        if not tf_config.is_distributed(job):
+            return
+        config = tf_config.gen_tf_config(job, rtype, index)
+        for container in template.spec.containers:
+            if container.get_env("TF_CONFIG") is None:
+                container.set_env("TF_CONFIG", config)
+
+    # -------------------------------------------------------- master role
+    def is_master_role(self, replicas: Dict[str, ReplicaSpec], rtype: str, index: int) -> bool:
+        """Chief/Master replica if declared, else worker-0
+        (reference IsMasterRole tfjob_controller.go:588-595)."""
+        if contain_chief_or_master_spec(replicas):
+            return tfapi.is_chief_or_master(rtype)
+        return rtype == tfapi.REPLICA_TYPE_WORKER and index == 0
+
+    def replica_order(self, replicas: Dict[str, ReplicaSpec]) -> List[str]:
+        """Fixed precedence order (reference tfjob_controller.go:385-391)."""
+        order = [
+            tfapi.REPLICA_TYPE_CHIEF,
+            tfapi.REPLICA_TYPE_EVAL,
+            tfapi.REPLICA_TYPE_MASTER,
+            tfapi.REPLICA_TYPE_PS,
+            tfapi.REPLICA_TYPE_WORKER,
+        ]
+        return [rt for rt in order if rt in replicas] + [
+            rt for rt in sorted(replicas) if rt not in order
+        ]
+
+    # ------------------------------------------------------------- status
+    def _is_worker0_completed(self, job, replicas: Dict[str, ReplicaSpec], pods) -> bool:
+        """True iff the worker-0 pod succeeded with exit code 0 (reference
+        IsWorker0Completed tfjob_controller.go:599-640); vacuously true with
+        no worker group."""
+        if tfapi.REPLICA_TYPE_WORKER not in replicas:
+            return True
+        pods = filter_pods_for_replica_type(pods, tfapi.REPLICA_TYPE_WORKER)
+        slices = get_pod_slices(
+            pods, replicas[tfapi.REPLICA_TYPE_WORKER].replicas or 0
+        )
+        for index, pod_slice in enumerate(slices):
+            if index == 0 and len(pod_slice) == 1:
+                pod = pod_slice[0]
+                exit_code = get_container_exit_code(pod, self.default_container_name)
+                if exit_code == 0 and pod.status.phase == POD_SUCCEEDED:
+                    return True
+        return False
+
+    def update_job_status(
+        self, job, replicas: Dict[str, ReplicaSpec], job_status: JobStatus, pods
+    ) -> None:
+        """The TF condition state machine (reference UpdateJobStatus
+        tfjob_controller.go:353-510)."""
+        now = self.clock()
+        worker0_completed = self._is_worker0_completed(job, replicas, pods)
+        # A retryable restart was initiated this sync: don't set Running (it
+        # would clobber the Restarting condition the failed>0 guard needs).
+        restarting = getattr(job_status, "_restarting_this_sync", False)
+
+        if job_status.start_time is None:
+            job_status.start_time = now
+
+        has_chief = contain_chief_or_master_spec(replicas)
+        for rtype in self.replica_order(replicas):
+            spec = replicas[rtype]
+            status = job_status.replica_statuses.get(rtype)
+            if status is None:
+                continue
+            succeeded = status.succeeded
+            expected = (spec.replicas or 0) - succeeded
+            running = status.active
+            failed = status.failed
+
+            if has_chief:
+                if tfapi.is_chief_or_master(rtype):
+                    if running > 0 and not restarting:
+                        capi.update_job_conditions(
+                            job_status,
+                            capi.JOB_RUNNING,
+                            constants.job_reason(self.kind, constants.REASON_RUNNING),
+                            f"TFJob {job.key()} is running.",
+                            now=now,
+                        )
+                    if expected == 0:
+                        self._mark_succeeded(job, job_status, now)
+            elif rtype == tfapi.REPLICA_TYPE_WORKER:
+                # Succeed when all workers finish, or when worker-0 finishes
+                # under the default success policy (reference :440-470).
+                all_workers_done = expected == 0
+                if all_workers_done or (
+                    worker0_completed
+                    and job.spec.success_policy != tfapi.SUCCESS_POLICY_ALL_WORKERS
+                ):
+                    self._mark_succeeded(job, job_status, now)
+                elif running > 0 and not restarting:
+                    capi.update_job_conditions(
+                        job_status,
+                        capi.JOB_RUNNING,
+                        constants.job_reason(self.kind, constants.REASON_RUNNING),
+                        f"TFJob {job.key()} is running.",
+                        now=now,
+                    )
+
+            if failed > 0:
+                if capi.get_condition(job_status, capi.JOB_RESTARTING) is not None:
+                    # Restarting wins over Failed (reference :473-501). The
+                    # restart counter was already bumped by the engine's
+                    # on_job_restarting callback — don't double count.
+                    pass
+                else:
+                    msg = (
+                        f"TFJob {job.key()} has failed because {failed} {rtype} "
+                        "replica(s) failed."
+                    )
+                    if job_status.completion_time is None:
+                        job_status.completion_time = now
+                    capi.update_job_conditions(
+                        job_status,
+                        capi.JOB_FAILED,
+                        constants.job_reason(self.kind, constants.REASON_FAILED),
+                        msg,
+                        now=now,
+                    )
+                    self.cluster.record_event(
+                        Event(
+                            type="Normal",
+                            reason=constants.job_reason(self.kind, constants.REASON_FAILED),
+                            message=msg,
+                            involved_object=f"{job.kind}/{job.key()}",
+                        )
+                    )
+
+    def _mark_succeeded(self, job, job_status: JobStatus, now: float) -> None:
+        msg = f"TFJob {job.key()} successfully completed."
+        if job_status.completion_time is None:
+            job_status.completion_time = now
+        capi.update_job_conditions(
+            job_status,
+            capi.JOB_SUCCEEDED,
+            constants.job_reason(self.kind, constants.REASON_SUCCEEDED),
+            msg,
+            now=now,
+        )
+        self.cluster.record_event(
+            Event(
+                type="Normal",
+                reason=constants.job_reason(self.kind, constants.REASON_SUCCEEDED),
+                message=msg,
+                involved_object=f"{job.kind}/{job.key()}",
+            )
+        )

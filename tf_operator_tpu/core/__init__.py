@@ -1,0 +1,13 @@
+"""Core reconciler engine — the re-owned kubeflow/common layer (SURVEY.md §2.9)."""
+
+from .expectations import ControllerExpectations
+from .job_controller import FrameworkHooks, JobController, gen_general_name
+from .workqueue import WorkQueue
+
+__all__ = [
+    "ControllerExpectations",
+    "FrameworkHooks",
+    "JobController",
+    "WorkQueue",
+    "gen_general_name",
+]

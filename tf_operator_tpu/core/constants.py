@@ -1,0 +1,54 @@
+"""Engine-wide constants: label keys, event reasons, condition reasons.
+
+Reference parity: kubeflow/common label keys as used at
+tfjob_controller.go:764-770 and pkg/controller.v1/tensorflow/controller.go:55-62.
+"""
+
+# Label keys stamped on every pod/service the operator creates.
+GROUP_NAME = "kubeflow.org"
+LABEL_GROUP_NAME = "group-name"
+LABEL_JOB_NAME = "job-name"
+LABEL_REPLICA_TYPE = "replica-type"
+LABEL_REPLICA_INDEX = "replica-index"
+LABEL_JOB_ROLE = "job-role"
+JOB_ROLE_MASTER = "master"
+
+# TPU-native labels/annotations (no reference counterpart): identify the
+# slice a worker belongs to so schedulers and debuggers can reason per-slice.
+LABEL_SLICE_INDEX = "tpu-slice-index"
+ANNOTATION_TPU_TOPOLOGY = "tpu.kubeflow.org/topology"
+ANNOTATION_TPU_ACCELERATOR = "tpu.kubeflow.org/accelerator-type"
+
+# Gang scheduling (reference pod.go:220-237, tfjob_controller.go:798-815).
+GANG_SCHEDULER_NAME_DEFAULT = "volcano"
+ANNOTATION_GANG_GROUP_NAME = "scheduling.k8s.io/group-name"
+ANNOTATION_GANG_TASK_SPEC = "volcano.sh/task-spec"
+
+# Event reasons (reference pod.go:45-55, status.go:34-45).
+REASON_SUCCESSFUL_CREATE_POD = "SuccessfulCreatePod"
+REASON_FAILED_CREATE_POD = "FailedCreatePod"
+REASON_SUCCESSFUL_DELETE_POD = "SuccessfulDeletePod"
+REASON_FAILED_DELETE_POD = "FailedDeletePod"
+REASON_SUCCESSFUL_CREATE_SERVICE = "SuccessfulCreateService"
+REASON_SUCCESSFUL_DELETE_SERVICE = "SuccessfulDeleteService"
+REASON_EXITED_WITH_CODE = "ExitedWithCode"
+REASON_JOB_DEADLINE_EXCEEDED = "DeadlineExceeded"
+REASON_JOB_BACKOFF_EXCEEDED = "BackoffLimitExceeded"
+
+# Condition reasons; the reference builds "<Kind>Created" etc. per framework
+# (e.g. tfJobCreatedReason). job_reason(kind, suffix) reproduces that.
+
+
+def job_reason(kind: str, suffix: str) -> str:
+    return f"{kind}{suffix}"
+
+
+REASON_CREATED = "Created"
+REASON_RUNNING = "Running"
+REASON_RESTARTING = "Restarting"
+REASON_SUCCEEDED = "Succeeded"
+REASON_FAILED = "Failed"
+
+# Exit code sentinel when the framework container has not terminated
+# (reference tfjob_controller.go:707 "magic number").
+EXIT_CODE_UNSET = 0xBEEF

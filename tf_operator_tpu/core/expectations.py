@@ -1,0 +1,104 @@
+"""Controller expectations cache.
+
+Guards against acting on a stale object cache: after issuing N creates the
+reconciler "expects" to observe N create events before trusting its listing
+again. Without this, an informer-lagged re-sync would double-create pods.
+
+Reference parity: kubeflow/common controller.v1/expectation (embedded into
+every reconciler, gate at tfjob_controller.go:140-147, bumps at :754-758,
+rollback on failed create at :828-833). Semantics match
+k8s.io/kubernetes/pkg/controller.ControllerExpectations.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+# Expectations are forgotten after this long, so a crashed watch channel can
+# never wedge a job forever (same 5-minute timeout as upstream).
+EXPECTATION_TIMEOUT_SECONDS = 5 * 60.0
+
+
+class _Expectation:
+    __slots__ = ("adds", "dels", "timestamp")
+
+    def __init__(self, adds: int, dels: int, now: float):
+        self.adds = adds
+        self.dels = dels
+        self.timestamp = now
+
+    def fulfilled(self) -> bool:
+        return self.adds <= 0 and self.dels <= 0
+
+    def expired(self, now: float) -> bool:
+        return now - self.timestamp > EXPECTATION_TIMEOUT_SECONDS
+
+
+class ControllerExpectations:
+    """Thread-safe store of (controller key, kind) -> outstanding add/del counts.
+
+    Keys look like "<namespace>/<name>"; kind is "pods" or "services" so one
+    store serves both caches (the reference keys them as "<key>/pods").
+    """
+
+    def __init__(self, clock=time.monotonic):
+        self._lock = threading.Lock()
+        self._store: Dict[Tuple[str, str], _Expectation] = {}
+        self._clock = clock
+
+    def expect_creations(self, key: str, kind: str, count: int) -> None:
+        """Raise the outstanding-creation count by `count`. Accumulates on an
+        unfulfilled expectation (the engine issues creates one at a time, so
+        overwriting would under-record all but the last one and let a single
+        observed event unlock a stale re-list -> double creates)."""
+        self._accumulate(key, kind, adds=count)
+
+    def expect_deletions(self, key: str, kind: str, count: int) -> None:
+        self._accumulate(key, kind, dels=count)
+
+    def _accumulate(self, key: str, kind: str, adds: int = 0, dels: int = 0) -> None:
+        with self._lock:
+            now = self._clock()
+            exp = self._store.get((key, kind))
+            if exp is None or exp.fulfilled() or exp.expired(now):
+                self._store[(key, kind)] = _Expectation(max(adds, 0), max(dels, 0), now)
+                return
+            exp.adds = max(exp.adds, 0) + adds
+            exp.dels = max(exp.dels, 0) + dels
+            exp.timestamp = now
+
+    def creation_observed(self, key: str, kind: str) -> None:
+        self._lower(key, kind, add_delta=-1)
+
+    def deletion_observed(self, key: str, kind: str) -> None:
+        self._lower(key, kind, del_delta=-1)
+
+    def _lower(self, key: str, kind: str, add_delta: int = 0, del_delta: int = 0) -> None:
+        with self._lock:
+            exp = self._store.get((key, kind))
+            if exp is None:
+                return
+            exp.adds += add_delta
+            exp.dels += del_delta
+
+    def satisfied(self, key: str, kind: str) -> bool:
+        """True when it is safe to re-list and act: no expectation recorded,
+        expectation fulfilled, or expectation expired."""
+        with self._lock:
+            exp = self._store.get((key, kind))
+            if exp is None:
+                return True
+            if exp.fulfilled():
+                return True
+            return exp.expired(self._clock())
+
+    def delete_expectations(self, key: str, kind: str) -> None:
+        with self._lock:
+            self._store.pop((key, kind), None)
+
+    def get(self, key: str, kind: str) -> Optional[Tuple[int, int]]:
+        with self._lock:
+            exp = self._store.get((key, kind))
+            return (exp.adds, exp.dels) if exp else None

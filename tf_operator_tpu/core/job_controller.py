@@ -1,0 +1,592 @@
+"""The reconciler engine: ReconcileJobs / ReconcilePods / ReconcileServices.
+
+Re-owns the kubeflow/common v0.3.4 `JobController` the reference embeds in
+every framework reconciler (SURVEY.md §2.9 — "the single biggest hidden
+component"): run-policy enforcement (CleanPodPolicy / TTL / BackoffLimit /
+ActiveDeadline), pod-slice bookkeeping, per-index headless services, gang
+(pod-group) creation, expectations-guarded create/delete, and status
+write-back. Framework specifics (env injection, status semantics, master
+roles) enter through the `FrameworkHooks` interface, folding the reference's
+per-framework ReconcilePods override into one engine with policy hooks
+(SURVEY.md §7 anti-goals).
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ..api import common as capi
+from ..api.common import JobObject, JobStatus, ReplicaSpec
+from ..api.k8s import (
+    POD_FAILED,
+    POD_PENDING,
+    POD_RUNNING,
+    POD_SUCCEEDED,
+    Event,
+    Pod,
+    Service,
+    ServicePort,
+    ServiceSpec,
+    to_dict,
+)
+from ..cluster.base import Cluster
+from . import constants
+from .control import PodControl, ServiceControl
+from .expectations import ControllerExpectations
+
+
+def gen_general_name(job_name: str, rtype: str, index) -> str:
+    """"<job>-<rtype lower>-<index>" (reference kubeflow/common
+    GenGeneralName, used at tensorflow.go:158, pytorch.go:92-95)."""
+    return f"{job_name}-{rtype.lower()}-{index}".replace("/", "-")
+
+
+def replica_labels(job: JobObject, rtype: str, index) -> Dict[str, str]:
+    return {
+        constants.LABEL_GROUP_NAME: constants.GROUP_NAME,
+        constants.LABEL_JOB_NAME: job.name,
+        constants.LABEL_REPLICA_TYPE: rtype.lower(),
+        constants.LABEL_REPLICA_INDEX: str(index),
+    }
+
+
+def job_selector(job: JobObject) -> Dict[str, str]:
+    return {
+        constants.LABEL_GROUP_NAME: constants.GROUP_NAME,
+        constants.LABEL_JOB_NAME: job.name,
+    }
+
+
+def get_container_exit_code(pod: Pod, container_name: str) -> int:
+    """Exit code of the framework container, EXIT_CODE_UNSET if not
+    terminated (reference tfjob_controller.go:707-715)."""
+    exit_code = constants.EXIT_CODE_UNSET
+    for status in pod.status.container_statuses:
+        if status.name == container_name and status.state.terminated is not None:
+            exit_code = status.state.terminated.exit_code
+    return exit_code
+
+
+def get_pod_slices(pods: List[Pod], replicas: int) -> List[List[Pod]]:
+    """Bucket pods by their replica-index label. Slice count is
+    max(replicas, max_index+1): empty buckets are pods to create, buckets at
+    index >= replicas are pods to delete (reference GetPodSlices, semantics
+    documented at tfjob_controller.go:672-681)."""
+    size = replicas
+    indexed: List[tuple] = []
+    for pod in pods:
+        try:
+            index = int(pod.metadata.labels.get(constants.LABEL_REPLICA_INDEX, ""))
+        except ValueError:
+            continue
+        if index < 0:
+            continue
+        size = max(size, index + 1)
+        indexed.append((index, pod))
+    slices: List[List[Pod]] = [[] for _ in range(size)]
+    for index, pod in indexed:
+        slices[index].append(pod)
+    return slices
+
+
+def filter_pods_for_replica_type(pods: List[Pod], rtype: str) -> List[Pod]:
+    rt = rtype.lower()
+    return [p for p in pods if p.metadata.labels.get(constants.LABEL_REPLICA_TYPE) == rt]
+
+
+def update_job_replica_statuses(job_status: JobStatus, rtype: str, pod: Pod) -> None:
+    """Roll one pod's phase into the per-type counters (reference
+    status.go:253-262)."""
+    status = job_status.replica_statuses.setdefault(rtype, capi.ReplicaStatus())
+    if pod.status.phase == POD_RUNNING:
+        status.active += 1
+    elif pod.status.phase == POD_SUCCEEDED:
+        status.succeeded += 1
+    elif pod.status.phase == POD_FAILED:
+        status.failed += 1
+
+
+class FrameworkHooks:
+    """Per-framework policy plugged into the engine (the reference's
+    common.ControllerInterface, tfjob_controller.go:206-595)."""
+
+    kind: str = ""
+    default_container_name: str = ""
+    default_port_name: str = ""
+    default_port: int = 0
+
+    def set_cluster_spec(self, job: JobObject, template, rtype: str, index: int) -> None:
+        """Inject the framework's rendezvous env into the pod template
+        (TF_CONFIG / MASTER_ADDR / DMLC_* / JAX coordinator — SURVEY.md §2.5)."""
+        raise NotImplementedError
+
+    def update_job_status(
+        self,
+        job: JobObject,
+        replicas: Dict[str, ReplicaSpec],
+        job_status: JobStatus,
+        pods: List[Pod],
+    ) -> None:
+        """Framework-specific condition semantics (chief/master vs worker-0,
+        scheduler-completion, …). `pods` is the engine's already-fetched pod
+        list so hooks never re-list on the hot path."""
+        raise NotImplementedError
+
+    def is_master_role(self, replicas: Dict[str, ReplicaSpec], rtype: str, index: int) -> bool:
+        return False
+
+    def replica_order(self, replicas: Dict[str, ReplicaSpec]) -> List[str]:
+        """Iteration order over replica types; frameworks with precedence
+        semantics (TF: Chief,Eval,Master,PS,Worker) override."""
+        return sorted(replicas.keys())
+
+
+@dataclass
+class EngineOptions:
+    enable_gang_scheduling: bool = False
+    gang_scheduler_name: str = constants.GANG_SCHEDULER_NAME_DEFAULT
+
+
+class JobController:
+    """The engine. One instance per framework controller."""
+
+    def __init__(
+        self,
+        hooks: FrameworkHooks,
+        cluster: Cluster,
+        pod_control: PodControl,
+        service_control: ServiceControl,
+        expectations: Optional[ControllerExpectations] = None,
+        options: Optional[EngineOptions] = None,
+        requeue: Optional[Callable[[str, float], None]] = None,
+        clock=time.time,
+        on_job_restarting: Optional[Callable[[JobObject, str], None]] = None,
+    ):
+        self.hooks = hooks
+        self.cluster = cluster
+        self.pod_control = pod_control
+        self.service_control = service_control
+        self.expectations = expectations or ControllerExpectations()
+        self.options = options or EngineOptions()
+        self.requeue = requeue or (lambda key, after: None)
+        self.clock = clock
+        self.on_job_restarting = on_job_restarting or (lambda job, rtype: None)
+
+    # ------------------------------------------------------------- listing
+    def get_pods_for_job(self, job: JobObject) -> List[Pod]:
+        """Label-selected pods with adoption/orphaning semantics: keep pods
+        whose controllerRef UID matches the live job, adopt matching orphans
+        (reference tfjob_controller.go:249-332 with uncached UID recheck)."""
+        pods = self.cluster.list_pods(namespace=job.namespace, labels=job_selector(job))
+        out = []
+        for pod in pods:
+            ref = pod.metadata.controller_ref()
+            if ref is not None:
+                if ref.uid == job.metadata.uid:
+                    out.append(pod)
+                continue
+            # Orphan with matching labels: adopt (stamp our controller ref).
+            from .control import owner_ref_for
+
+            pod.metadata.owner_references.append(owner_ref_for(job))
+            try:
+                pod = self.cluster.update_pod(pod)
+            except Exception:
+                continue
+            out.append(pod)
+        return out
+
+    def get_services_for_job(self, job: JobObject) -> List[Service]:
+        services = self.cluster.list_services(namespace=job.namespace, labels=job_selector(job))
+        return [
+            s
+            for s in services
+            if s.metadata.controller_ref() is None
+            or s.metadata.controller_ref().uid == job.metadata.uid
+        ]
+
+    # ----------------------------------------------------------- reconcile
+    def reconcile_job(self, job: JobObject) -> None:
+        """One sync of one job: the reference's ReconcileJobs
+        (SURVEY.md §3.2 call stack)."""
+        key = job.key()
+        old_status = copy.deepcopy(job.status)
+        replicas = job.replica_specs()
+        run_policy = job.run_policy()
+        # Transient per-sync marker (not serialized): set when a retryable
+        # restart is initiated, so status hooks keep the Restarting condition
+        # ahead of Running/Failed for this sync. Without it, setting Running
+        # for the still-healthy peers drops Restarting (they are mutually
+        # exclusive), and the failed>0 check then marks the job Failed —
+        # killing a job that was merely recovering from preemption.
+        job.status._restarting_this_sync = False
+
+        pods = self.get_pods_for_job(job)
+
+        # Seed Created condition (reference sets it in onOwnerCreateFunc,
+        # tfjob_controller.go:839-856; converging here keeps any path safe).
+        if not job.status.conditions:
+            capi.update_job_conditions(
+                job.status,
+                capi.JOB_CREATED,
+                constants.job_reason(self.hooks.kind, constants.REASON_CREATED),
+                f"{self.hooks.kind} {job.name} is created.",
+                now=self.clock(),
+            )
+
+        if capi.is_finished(job.status):
+            self._handle_terminal_job(job, pods, run_policy)
+            self._write_status_if_changed(job, old_status)
+            return
+
+        # Run-policy enforcement before any pod work (library ReconcileJobs).
+        failure_reason = None
+        failure_message = ""
+        if self._past_active_deadline(job, run_policy):
+            failure_reason = constants.REASON_JOB_DEADLINE_EXCEEDED
+            failure_message = f"{self.hooks.kind} {job.name} has failed because it was active longer than specified deadline"
+        elif self._past_backoff_limit(job, run_policy, replicas, pods):
+            failure_reason = constants.REASON_JOB_BACKOFF_EXCEEDED
+            failure_message = f"{self.hooks.kind} {job.name} has failed because it has reached the specified backoff limit"
+
+        if failure_reason is not None:
+            # Honor CleanPodPolicy even on the failure path (the reference's
+            # deletePodsAndServices is the single cleanup for both): policy
+            # None preserves pods for debugging.
+            self._delete_pods_and_services(job, pods, run_policy)
+            if job.status.completion_time is None:
+                job.status.completion_time = self.clock()
+            capi.update_job_conditions(
+                job.status, capi.JOB_FAILED, failure_reason, failure_message, now=self.clock()
+            )
+            self.cluster.record_event(
+                Event(
+                    type="Normal",
+                    reason=failure_reason,
+                    message=failure_message,
+                    involved_object=f"{job.kind}/{key}",
+                )
+            )
+            self._write_status_if_changed(job, old_status)
+            return
+
+        if self.options.enable_gang_scheduling:
+            self._sync_pod_group(job, replicas, run_policy)
+
+        services = self.get_services_for_job(job)
+        for rtype in self.hooks.replica_order(replicas):
+            spec = replicas[rtype]
+            self.reconcile_pods(job, job.status, pods, rtype, spec, replicas)
+            self.reconcile_services(job, services, rtype, spec)
+
+        self.hooks.update_job_status(job, replicas, job.status, pods)
+
+        # ActiveDeadline resync scheduling (reference :373-383).
+        if (
+            job.status.start_time is not None
+            and run_policy.active_deadline_seconds is not None
+        ):
+            elapsed = self.clock() - job.status.start_time
+            remaining = run_policy.active_deadline_seconds - elapsed
+            if remaining > 0:
+                self.requeue(f"{job.kind}:{key}", remaining)
+
+        self._write_status_if_changed(job, old_status)
+
+    # -------------------------------------------------------------- pods
+    def reconcile_pods(
+        self,
+        job: JobObject,
+        job_status: JobStatus,
+        pods: List[Pod],
+        rtype: str,
+        spec: ReplicaSpec,
+        replicas: Dict[str, ReplicaSpec],
+    ) -> None:
+        """Reference ReconcilePods with the TF exit-code override folded in
+        (tfjob_controller.go:646-742)."""
+        typed_pods = filter_pods_for_replica_type(pods, rtype)
+        num_replicas = spec.replicas or 0
+        job_status.replica_statuses[rtype] = capi.ReplicaStatus()
+
+        slices = get_pod_slices(typed_pods, num_replicas)
+        for index, pod_slice in enumerate(slices):
+            if len(pod_slice) > 1:
+                continue  # duplicate pods for an index: wait for cache to settle
+            if not pod_slice:
+                if index < num_replicas:
+                    master_role = self.hooks.is_master_role(replicas, rtype, index)
+                    self.create_new_pod(job, rtype, index, spec, master_role, replicas)
+                continue
+
+            pod = pod_slice[0]
+            if index >= num_replicas:
+                # Out-of-range (scale-down): delete.
+                self._delete_pod(job, pod)
+                continue
+
+            exit_code = get_container_exit_code(pod, self.hooks.default_container_name)
+            if exit_code != constants.EXIT_CODE_UNSET:
+                self.cluster.record_event(
+                    Event(
+                        type="Normal",
+                        reason=constants.REASON_EXITED_WITH_CODE,
+                        message=f"Pod: {pod.metadata.namespace}.{pod.metadata.name} exited with code {exit_code}",
+                        involved_object=f"{job.kind}/{job.key()}",
+                    )
+                )
+
+            if (
+                spec.restart_policy == capi.RESTART_POLICY_EXIT_CODE
+                and pod.status.phase == POD_FAILED
+                and capi.is_retryable_exit_code(exit_code)
+            ):
+                # Retryable failure: delete the pod (recreated next sync) and
+                # mark the job Restarting (reference :717-736).
+                self._delete_pod(job, pod)
+                msg = f"{self.hooks.kind} {job.name} is restarting because {rtype} replica(s) failed."
+                self.cluster.record_event(
+                    Event(
+                        type="Warning",
+                        reason=constants.job_reason(self.hooks.kind, constants.REASON_RESTARTING),
+                        message=msg,
+                        involved_object=f"{job.kind}/{job.key()}",
+                    )
+                )
+                capi.update_job_conditions(
+                    job_status,
+                    capi.JOB_RESTARTING,
+                    constants.job_reason(self.hooks.kind, constants.REASON_RESTARTING),
+                    msg,
+                    now=self.clock(),
+                )
+                job_status._restarting_this_sync = True
+                self.on_job_restarting(job, rtype)
+
+            update_job_replica_statuses(job_status, rtype, pod)
+
+    def create_new_pod(
+        self,
+        job: JobObject,
+        rtype: str,
+        index: int,
+        spec: ReplicaSpec,
+        master_role: bool,
+        replicas: Dict[str, ReplicaSpec],
+    ) -> None:
+        """Reference createNewPod (tfjob_controller.go:746-836)."""
+        key = job.key()
+        self.expectations.expect_creations(key, "pods", 1)
+
+        template = copy.deepcopy(spec.template)
+        labels = replica_labels(job, rtype, index)
+        if master_role:
+            labels[constants.LABEL_JOB_ROLE] = constants.JOB_ROLE_MASTER
+        template.metadata.labels.update(labels)
+        template.metadata.name = gen_general_name(job.name, rtype, index)
+        template.metadata.namespace = job.namespace
+
+        # Framework rendezvous env (TF_CONFIG etc.).
+        self.hooks.set_cluster_spec(job, template, rtype, index)
+
+        # Restart policy mapping: ExitCode is operator-managed, so the pod
+        # itself must never self-restart (reference pod.go:321-328).
+        if spec.restart_policy == capi.RESTART_POLICY_EXIT_CODE:
+            template.spec.restart_policy = capi.RESTART_POLICY_NEVER
+        elif spec.restart_policy:
+            template.spec.restart_policy = spec.restart_policy
+
+        if self.options.enable_gang_scheduling:
+            template.metadata.annotations[constants.ANNOTATION_GANG_GROUP_NAME] = (
+                self.gang_group_name(job, rtype, index)
+            )
+            template.metadata.annotations[constants.ANNOTATION_GANG_TASK_SPEC] = rtype.lower()
+            template.spec.scheduler_name = self.options.gang_scheduler_name
+
+        pod = Pod(metadata=template.metadata, spec=template.spec)
+        try:
+            self.pod_control.create_pod(job.namespace, pod, job)
+        except Exception:
+            # Roll the expectation back so the job is not stuck waiting for a
+            # create event that will never come (reference :828-833).
+            self.expectations.creation_observed(key, "pods")
+            raise
+
+    def gang_group_name(self, job: JobObject, rtype: str = "", index: int = 0) -> str:
+        """Gang (pod-group) a pod belongs to. Default: one gang per job, like
+        the reference. The JAX controller overrides grouping per slice."""
+        return job.name
+
+    def _delete_pod(self, job: JobObject, pod: Pod) -> None:
+        key = job.key()
+        self.expectations.expect_deletions(key, "pods", 1)
+        try:
+            self.pod_control.delete_pod(pod.metadata.namespace, pod.metadata.name, job)
+        except Exception:
+            self.expectations.deletion_observed(key, "pods")
+            raise
+
+    def _delete_pods_and_services(self, job: JobObject, pods: List[Pod], run_policy) -> None:
+        """Apply CleanPodPolicy: None keeps everything; Running deletes only
+        live (running/pending) pods; All deletes all. Services go with any
+        pod cleanup (kubeflow/common deletePodsAndServices semantics)."""
+        policy = run_policy.clean_pod_policy or capi.CLEAN_POD_POLICY_NONE
+        if policy == capi.CLEAN_POD_POLICY_NONE:
+            return
+        for pod in pods:
+            if policy == capi.CLEAN_POD_POLICY_RUNNING and pod.status.phase not in (
+                POD_RUNNING,
+                POD_PENDING,
+            ):
+                continue
+            self._delete_pod(job, pod)
+        for svc in self.get_services_for_job(job):
+            self.service_control.delete_service(svc.metadata.namespace, svc.metadata.name, job)
+
+    # ----------------------------------------------------------- services
+    def reconcile_services(
+        self, job: JobObject, services: List[Service], rtype: str, spec: ReplicaSpec
+    ) -> None:
+        """One headless service per replica index giving each replica a
+        stable DNS identity (library ReconcileServices; DNS contract at
+        tensorflow.go:153-166)."""
+        rt = rtype.lower()
+        typed = [
+            s for s in services if s.metadata.labels.get(constants.LABEL_REPLICA_TYPE) == rt
+        ]
+        num_replicas = spec.replicas or 0
+        by_index: Dict[int, Service] = {}
+        for svc in typed:
+            try:
+                by_index[int(svc.metadata.labels.get(constants.LABEL_REPLICA_INDEX, ""))] = svc
+            except ValueError:
+                continue
+
+        port = self._port_from_spec(spec)
+        for index in range(num_replicas):
+            if index in by_index:
+                continue
+            labels = replica_labels(job, rtype, index)
+            service = Service(
+                metadata=copy.deepcopy(spec.template.metadata),
+                spec=ServiceSpec(
+                    cluster_ip="None",
+                    selector=labels,
+                    ports=[ServicePort(name=self.hooks.default_port_name, port=port)],
+                ),
+            )
+            service.metadata.name = gen_general_name(job.name, rtype, index)
+            service.metadata.namespace = job.namespace
+            service.metadata.labels = dict(service.metadata.labels)
+            service.metadata.labels.update(labels)
+            key = job.key()
+            self.expectations.expect_creations(key, "services", 1)
+            try:
+                self.service_control.create_service(job.namespace, service, job)
+            except Exception:
+                self.expectations.creation_observed(key, "services")
+                raise
+
+        for index, svc in by_index.items():
+            if index >= num_replicas:
+                self.service_control.delete_service(svc.metadata.namespace, svc.metadata.name, job)
+
+    def _port_from_spec(self, spec: ReplicaSpec) -> int:
+        for container in spec.template.spec.containers:
+            if container.name == self.hooks.default_container_name:
+                for p in container.ports:
+                    if p.name == self.hooks.default_port_name:
+                        return p.container_port
+        return self.hooks.default_port
+
+    # ---------------------------------------------------------- run policy
+    def _past_active_deadline(self, job: JobObject, run_policy) -> bool:
+        if run_policy.active_deadline_seconds is None or job.status.start_time is None:
+            return False
+        return self.clock() - job.status.start_time >= run_policy.active_deadline_seconds
+
+    def _past_backoff_limit(
+        self, job: JobObject, run_policy, replicas: Dict[str, ReplicaSpec], pods: List[Pod]
+    ) -> bool:
+        """Sum container restart counts of live pods for restartable replica
+        types (kubeflow/common PastBackoffLimit semantics)."""
+        if run_policy.backoff_limit is None:
+            return False
+        restarts = 0
+        for rtype, spec in replicas.items():
+            if spec.restart_policy not in (
+                capi.RESTART_POLICY_ON_FAILURE,
+                capi.RESTART_POLICY_ALWAYS,
+            ):
+                continue
+            for pod in filter_pods_for_replica_type(pods, rtype):
+                if pod.status.phase in (POD_RUNNING, POD_PENDING):
+                    for cs in pod.status.container_statuses:
+                        restarts += cs.restart_count
+        if run_policy.backoff_limit == 0:
+            return restarts > 0
+        return restarts >= run_policy.backoff_limit
+
+    # ------------------------------------------------------------ terminal
+    def _handle_terminal_job(self, job: JobObject, pods: List[Pod], run_policy) -> None:
+        """CleanPodPolicy + TTL GC once the job reached Succeeded/Failed."""
+        self._delete_pods_and_services(job, pods, run_policy)
+
+        ttl = run_policy.ttl_seconds_after_finished
+        if ttl is not None:
+            finished_at = job.status.completion_time or job.status.last_reconcile_time
+            if finished_at is None:
+                finished_at = self.clock()
+            expiry = finished_at + ttl
+            if self.clock() >= expiry:
+                try:
+                    self.cluster.delete_job(job.kind, job.namespace, job.name)
+                except Exception:
+                    pass
+                self.expectations.delete_expectations(job.key(), "pods")
+                self.expectations.delete_expectations(job.key(), "services")
+            else:
+                self.requeue(f"{job.kind}:{job.key()}", expiry - self.clock())
+
+        if self.options.enable_gang_scheduling:
+            try:
+                self.cluster.delete_pod_group(job.namespace, job.name)
+            except Exception:
+                pass
+
+    # ----------------------------------------------------------- pod group
+    def _sync_pod_group(self, job: JobObject, replicas: Dict[str, ReplicaSpec], run_policy) -> None:
+        """Create the gang unit (volcano PodGroup analog; reference
+        SyncPodGroup via kubeflow/common when EnableGangScheduling)."""
+        total = sum(spec.replicas or 0 for spec in replicas.values())
+        min_member = total
+        sp = run_policy.scheduling_policy
+        if sp is not None and sp.min_available is not None:
+            min_member = sp.min_available
+        group = {
+            "apiVersion": "scheduling.volcano.sh/v1beta1",
+            "kind": "PodGroup",
+            "metadata": {"name": job.name, "namespace": job.namespace},
+            "spec": {
+                "minMember": min_member,
+                "queue": sp.queue if sp else "",
+                "priorityClassName": sp.priority_class if sp else "",
+            },
+        }
+        try:
+            self.cluster.get_pod_group(job.namespace, job.name)
+        except Exception:
+            self.cluster.create_pod_group(group)
+
+    # -------------------------------------------------------------- status
+    def _write_status_if_changed(self, job: JobObject, old_status: JobStatus) -> None:
+        if to_dict(job.status) == to_dict(old_status):
+            return
+        job.status.last_reconcile_time = self.clock()
+        # Propagate write failures: the caller's rate-limited queue must
+        # retry, or a terminal condition computed here is lost forever (a
+        # finished job emits no further events to trigger another sync).
+        self.cluster.update_job_status(job.kind, job.namespace, job.name, to_dict(job.status))

@@ -1,0 +1,125 @@
+"""Rate-limited work queue with deduplication and delayed adds.
+
+Mirrors the semantics the reference gets from client-go's
+RateLimitingInterface (legacy run loop controller.go:193-286): an item
+enqueued while queued is deduplicated; an item enqueued while being processed
+is re-queued after processing ("dirty" set); failures re-add with exponential
+backoff; AddAfter schedules a future enqueue (used for ActiveDeadline and TTL
+resyncs, tfjob_controller.go:381, job.go:174-190).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+
+class WorkQueue:
+    BASE_DELAY = 0.005
+    MAX_DELAY = 16.0
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._cond = threading.Condition()
+        self._queue: List[str] = []
+        self._queued: Set[str] = set()
+        self._processing: Set[str] = set()
+        self._dirty: Set[str] = set()
+        self._delayed: List[Tuple[float, int, str]] = []  # (when, seq, item)
+        self._seq = 0
+        self._failures: Dict[str, int] = {}
+        self._shutdown = False
+
+    def add(self, item: str) -> None:
+        with self._cond:
+            if item in self._queued:
+                return
+            if item in self._processing:
+                self._dirty.add(item)
+                return
+            self._queued.add(item)
+            self._queue.append(item)
+            self._cond.notify()
+
+    def add_after(self, item: str, delay: float) -> None:
+        if delay <= 0:
+            self.add(item)
+            return
+        with self._cond:
+            self._seq += 1
+            heapq.heappush(self._delayed, (self._clock() + delay, self._seq, item))
+            self._cond.notify()
+
+    def add_rate_limited(self, item: str) -> None:
+        with self._cond:
+            failures = self._failures.get(item, 0)
+            self._failures[item] = failures + 1
+        self.add_after(item, min(self.BASE_DELAY * (2 ** failures), self.MAX_DELAY))
+
+    def forget(self, item: str) -> None:
+        with self._cond:
+            self._failures.pop(item, None)
+
+    def _drain_delayed_locked(self) -> Optional[float]:
+        """Move due delayed items into the queue; return wait time to the next
+        delayed item, or None."""
+        now = self._clock()
+        while self._delayed and self._delayed[0][0] <= now:
+            _, _, item = heapq.heappop(self._delayed)
+            if item not in self._queued and item not in self._processing:
+                self._queued.add(item)
+                self._queue.append(item)
+            elif item in self._processing:
+                self._dirty.add(item)
+        return (self._delayed[0][0] - now) if self._delayed else None
+
+    def get(self, timeout: Optional[float] = None) -> Optional[str]:
+        """Pop the next item, blocking up to timeout. Returns None on timeout
+        or shutdown. The caller MUST call done(item) afterwards."""
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._cond:
+            while True:
+                if self._shutdown:
+                    return None
+                next_delay = self._drain_delayed_locked()
+                if self._queue:
+                    item = self._queue.pop(0)
+                    self._queued.discard(item)
+                    self._processing.add(item)
+                    return item
+                wait = next_delay
+                if deadline is not None:
+                    remaining = deadline - self._clock()
+                    if remaining <= 0:
+                        return None
+                    wait = remaining if wait is None else min(wait, remaining)
+                self._cond.wait(wait if wait is not None else 1.0)
+
+    def done(self, item: str) -> None:
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._dirty.discard(item)
+                if item not in self._queued:
+                    self._queued.add(item)
+                    self._queue.append(item)
+                    self._cond.notify()
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def empty_and_idle(self) -> bool:
+        """No immediate work: queue drained and nothing processing. Delayed
+        items whose time has not come do NOT count — a far-future resync
+        (deadline/TTL requeue) must not keep callers spinning."""
+        with self._cond:
+            self._drain_delayed_locked()
+            return not self._queue and not self._processing

@@ -1,0 +1,104 @@
+"""Prometheus-style job metrics.
+
+Reference parity: pkg/common/metrics.go:25-89
+(`training_operator_jobs_{created,deleted,successful,failed,restarted}_total`
+labeled {job_namespace, framework}); exposition here is dependency-free
+Prometheus text format served by the operator CLI.
+
+TPU-native additions: startup/restart latency histograms feeding the
+job-startup p50 and restart-MTTR baselines (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, List, Set, Tuple
+
+
+class Metrics:
+    _COUNTERS = (
+        ("training_operator_jobs_created_total", "The number of created jobs"),
+        ("training_operator_jobs_deleted_total", "The number of deleted jobs"),
+        ("training_operator_jobs_successful_total", "The number of successful jobs"),
+        ("training_operator_jobs_failed_total", "The number of failed jobs"),
+        ("training_operator_jobs_restarted_total", "The number of restarted jobs"),
+    )
+    _HISTOGRAM_BUCKETS = (0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600)
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Dict[Tuple[str, str], int]] = {
+            name: defaultdict(int) for name, _ in self._COUNTERS
+        }
+        self._terminal_seen: Set[Tuple[str, str, str]] = set()
+        self._histograms: Dict[str, Dict[Tuple[str, str], List[float]]] = {
+            "training_operator_job_startup_seconds": defaultdict(list),
+            "training_operator_job_restart_seconds": defaultdict(list),
+        }
+
+    def _inc(self, name: str, namespace: str, framework: str) -> None:
+        with self._lock:
+            self._counters[name][(namespace, framework)] += 1
+
+    def created_inc(self, namespace: str, framework: str) -> None:
+        self._inc("training_operator_jobs_created_total", namespace, framework)
+
+    def deleted_inc(self, namespace: str, framework: str) -> None:
+        self._inc("training_operator_jobs_deleted_total", namespace, framework)
+
+    def restarted_inc(self, namespace: str, framework: str) -> None:
+        self._inc("training_operator_jobs_restarted_total", namespace, framework)
+
+    def successful_inc_once(self, namespace: str, framework: str, job_key: str) -> None:
+        with self._lock:
+            if ("successful", framework, job_key) in self._terminal_seen:
+                return
+            self._terminal_seen.add(("successful", framework, job_key))
+            self._counters["training_operator_jobs_successful_total"][(namespace, framework)] += 1
+
+    def failed_inc_once(self, namespace: str, framework: str, job_key: str) -> None:
+        with self._lock:
+            if ("failed", framework, job_key) in self._terminal_seen:
+                return
+            self._terminal_seen.add(("failed", framework, job_key))
+            self._counters["training_operator_jobs_failed_total"][(namespace, framework)] += 1
+
+    def observe_startup(self, namespace: str, framework: str, seconds: float) -> None:
+        with self._lock:
+            self._histograms["training_operator_job_startup_seconds"][(namespace, framework)].append(seconds)
+
+    def observe_restart(self, namespace: str, framework: str, seconds: float) -> None:
+        with self._lock:
+            self._histograms["training_operator_job_restart_seconds"][(namespace, framework)].append(seconds)
+
+    def counter_value(self, name: str, namespace: str, framework: str) -> int:
+        with self._lock:
+            return self._counters[name][(namespace, framework)]
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        lines: List[str] = []
+        with self._lock:
+            for name, help_text in self._COUNTERS:
+                lines.append(f"# HELP {name} {help_text}")
+                lines.append(f"# TYPE {name} counter")
+                for (ns, fw), value in sorted(self._counters[name].items()):
+                    lines.append(f'{name}{{job_namespace="{ns}",framework="{fw}"}} {value}')
+            for name, series in self._histograms.items():
+                lines.append(f"# HELP {name} {name.replace('_', ' ')}")
+                lines.append(f"# TYPE {name} histogram")
+                for (ns, fw), samples in sorted(series.items()):
+                    label = f'job_namespace="{ns}",framework="{fw}"'
+                    cumulative = 0
+                    for bucket in self._HISTOGRAM_BUCKETS:
+                        cumulative = sum(1 for s in samples if s <= bucket)
+                        lines.append(f'{name}_bucket{{{label},le="{bucket}"}} {cumulative}')
+                    lines.append(f'{name}_bucket{{{label},le="+Inf"}} {len(samples)}')
+                    lines.append(f"{name}_sum{{{label}}} {sum(samples)}")
+                    lines.append(f"{name}_count{{{label}}} {len(samples)}")
+        return "\n".join(lines) + "\n"
+
+
+# Process-wide registry, like the reference's promauto default registry.
+METRICS = Metrics()
