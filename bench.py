@@ -1,0 +1,130 @@
+"""Benchmark harness: Llama training throughput on the available hardware.
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+The reference publishes no performance numbers (BASELINE.md: the operator is
+a control plane). The north-star workload metric is Llama training MFU
+(target >= 45% on v5e); this harness measures tokens/sec/chip and MFU for a
+model sized to the present chip count, so vs_baseline is MFU/0.45.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+# Per-chip peak bf16 TFLOP/s (for MFU accounting).
+PEAK_TFLOPS = {
+    "tpu v5 lite": 197.0,  # v5e
+    "tpu v5e": 197.0,
+    "tpu v5": 459.0,  # v5p
+    "tpu v4": 275.0,
+    "tpu v6 lite": 918.0,  # v6e (trillium)
+    "cpu": 1.0,
+}
+
+
+def peak_tflops_for(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for key, val in PEAK_TFLOPS.items():
+        if kind.startswith(key):
+            return val
+    return 197.0 if device.platform == "tpu" else 1.0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default=None, help="config name from models.llama.CONFIGS")
+    parser.add_argument("--batch", type=int, default=None)
+    parser.add_argument("--seq", type=int, default=2048)
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--warmup", type=int, default=3)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models import llama
+    from tf_operator_tpu.parallel.mesh import standard_mesh
+    from tf_operator_tpu.train.data import SyntheticTokens
+    from tf_operator_tpu.train.train_step import (
+        init_sharded_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+    from tf_operator_tpu.parallel.sharding import batch_sharding
+
+    devices = jax.devices()
+    n = len(devices)
+    on_tpu = devices[0].platform == "tpu"
+
+    # Size the model to the hardware: single chip -> 400M-class; pods -> 7B.
+    if args.model is None:
+        args.model = "llama2-7b" if (on_tpu and n >= 16) else ("llama-400m" if on_tpu else "llama-tiny")
+    config = llama.CONFIGS[args.model]
+    if args.seq and args.seq != config.max_seq_len:
+        config = type(config)(**{**config.__dict__, "max_seq_len": args.seq})
+    seq = min(args.seq, config.max_seq_len)
+    if args.batch is None:
+        args.batch = max(n, 8) if on_tpu else 2
+    if not on_tpu:
+        seq = min(seq, 128)
+        args.steps = min(args.steps, 3)
+
+    mesh = standard_mesh(n)  # pure FSDP by default; tp via env later
+    model = llama.Llama(config)
+    optimizer = make_optimizer(warmup_steps=10, decay_steps=1000)
+    # Born-sharded init: a 7B state never exists unsharded on one chip.
+    state, sharding = init_sharded_train_state(
+        model, jax.random.PRNGKey(0), optimizer, mesh, batch=1, seq=min(seq, 128)
+    )
+    step_fn, _ = make_train_step(model, optimizer, mesh, state, sharding=sharding)
+
+    data = SyntheticTokens(args.batch, seq, config.vocab_size)
+    data_sharding = batch_sharding(mesh, with_sp=False)
+    it = iter(data)
+
+    # Warmup (compile). Synchronize via an actual host fetch of the loss:
+    # on remote-relay PJRT backends block_until_ready can return before the
+    # queued executions run, wildly under-reporting step time — a device->
+    # host value transfer is the only reliable barrier.
+    for _ in range(max(args.warmup, 1)):  # >=1: compile must stay out of the timed region
+        state, loss = step_fn(state, jax.device_put(next(it), data_sharding))
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, loss = step_fn(state, jax.device_put(next(it), data_sharding))
+    final_loss = float(loss)  # barrier: forces the whole chain
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = args.batch * seq
+    tokens_per_sec = tokens_per_step * args.steps / dt
+    tokens_per_sec_chip = tokens_per_sec / n
+
+    achieved_tflops_chip = tokens_per_sec_chip * config.flops_per_token(seq) / 1e12
+    mfu = achieved_tflops_chip / peak_tflops_for(devices[0])
+
+    result = {
+        "metric": f"llama[{args.model}] train tokens/sec/chip (seq={seq}, bs={args.batch}, {n}x {devices[0].device_kind})",
+        "value": round(tokens_per_sec_chip, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "tokens_per_sec_total": round(tokens_per_sec, 1),
+            "achieved_tflops_per_chip": round(achieved_tflops_chip, 2),
+            "loss": round(final_loss, 4),
+            "params": config.param_count(),
+        },
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

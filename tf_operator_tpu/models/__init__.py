@@ -1,0 +1,13 @@
+"""Model zoo for the example/benchmark workloads.
+
+The reference ships training *scripts* as examples (examples/tensorflow/
+dist-mnist, examples/pytorch/mnist, …) because the operator launches user
+containers. This package is their TPU-native equivalent: Flax models used by
+the JAXJob examples and the benchmark harness — `llama` (the flagship,
+BASELINE.md Llama-2-7B target), `mnist` (MLP/CNN parity with dist-mnist),
+`resnet` and `bert` (the ResNet-50 / BERT-base BASELINE configs).
+"""
+
+from . import llama
+
+__all__ = ["llama"]

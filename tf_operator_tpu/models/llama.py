@@ -1,0 +1,251 @@
+"""Llama-family decoder-only transformer (Flax linen), TPU-first.
+
+The flagship model for the JAXJob examples and the benchmark harness
+(BASELINE.md: Llama-2-7B FSDP on v5e-32). Design targets the MXU/HBM:
+
+- bf16 params and activations; fp32 only where numerics demand it
+  (RMSNorm accumulation, rotary tables, softmax, final logits).
+- All FLOPs in large batched matmuls (einsum) that XLA tiles onto the MXU.
+- `remat` on each block trades FLOPs for HBM (checkpointing).
+- No data-dependent Python control flow — one static graph under jit.
+- Attention defaults to `tf_operator_tpu.ops.attention`, which lowers to a
+  Pallas flash-attention kernel on TPU and falls back to a fused XLA path
+  elsewhere.
+
+Reference note: the reference repo contains no model code (it is a control
+plane; workloads live in user containers). Architecture follows the public
+Llama-2 description (RMSNorm, RoPE, SwiGLU, optional GQA).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    ffn_dim: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.bfloat16
+    remat: bool = True
+    # "pallas" (TPU flash kernel), "xla" (einsum softmax), "ring" (sequence-
+    # parallel ring attention over the sp axis; requires shard_map context).
+    attention_impl: str = "xla"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def flops_per_token(self, seq: Optional[int] = None) -> float:
+        """Approximate training FLOPs per token (fwd+bwd ≈ 6 * params +
+        attention term), for MFU accounting. Single source of truth — the
+        bench harness must use this, not its own formula."""
+        p = self.param_count()
+        attn = 12 * self.n_layers * self.dim * (seq or self.max_seq_len)
+        return 6 * p + attn
+
+    def param_count(self) -> int:
+        d, v, f = self.dim, self.vocab_size, self.ffn_dim
+        per_layer = (
+            d * d  # wq
+            + 2 * d * (self.n_kv_heads * self.head_dim)  # wk, wv
+            + d * d  # wo
+            + 3 * d * f / 1  # w1, w2, w3 (w2 transposed but same count)
+            + 2 * d  # norms
+        )
+        return int(v * d + self.n_layers * per_layer + d + d * v)
+
+
+# Canonical configs. 7B matches Llama-2-7B; the smaller ones size the model
+# to chips with less HBM (bench runs on one v5e-lite chip).
+CONFIGS = {
+    "llama2-7b": LlamaConfig(),
+    "llama-1b": LlamaConfig(dim=2048, n_layers=16, n_heads=16, n_kv_heads=16, ffn_dim=5504),
+    "llama-400m": LlamaConfig(dim=1024, n_layers=24, n_heads=16, n_kv_heads=16, ffn_dim=2816),
+    "llama-125m": LlamaConfig(dim=768, n_layers=12, n_heads=12, n_kv_heads=12, ffn_dim=2048),
+    "llama-tiny": LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=128,
+        max_seq_len=128, remat=False,
+    ),
+}
+
+
+class RMSNorm(nn.Module):
+    eps: float
+    param_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param('scale', nn.initializers.ones, (x.shape[-1],), self.param_dtype)
+        x32 = x.astype(jnp.float32)
+        normed = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (normed * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def rope_table(head_dim: int, max_len: int, theta: float):
+    """cos/sin tables, fp32."""
+    freqs = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    angles = jnp.outer(t, freqs)  # [len, head_dim/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x, cos, sin, positions):
+    """x: [b, s, h, d]; rotate pairs (x0,x1) by position-dependent angles."""
+    cos = cos[positions][:, :, None, :]  # [b, s, 1, d/2]
+    sin = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class Attention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        dense = partial(
+            nn.DenseGeneral,
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.initializers.normal(0.02),
+        )
+        b, s, _ = x.shape
+        q = dense(features=(cfg.n_heads, cfg.head_dim), name="wq")(x)
+        k = dense(features=(cfg.n_kv_heads, cfg.head_dim), name="wk")(x)
+        v = dense(features=(cfg.n_kv_heads, cfg.head_dim), name="wv")(x)
+
+        cos, sin = rope_table(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+
+        from ..ops import attention as attn_ops
+
+        if cfg.attention_impl == "pallas":
+            out = attn_ops.flash_attention(q, k, v, causal=True)
+        elif cfg.attention_impl == "ring":
+            from ..ops import ring_attention as ring_ops
+
+            out = ring_ops.ring_attention(q, k, v, axis_name="sp")
+        else:
+            out = attn_ops.xla_attention(q, k, v, causal=True)
+
+        return dense(features=cfg.dim, axis=(-2, -1), name="wo")(out)
+
+
+class MLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dense = partial(
+            nn.Dense,
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.initializers.normal(0.02),
+        )
+        gate = dense(cfg.ffn_dim, name="w1")(x)
+        up = dense(cfg.ffn_dim, name="w3")(x)
+        return dense(cfg.dim, name="w2")(nn.silu(gate) * up)
+
+
+class Block(nn.Module):
+    """One decoder layer. Signature is scan-compatible: carries `x`, passes
+    `positions` through as a second carry-free broadcast input."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        x = x + Attention(cfg, name="attention")(
+            RMSNorm(cfg.norm_eps, cfg.param_dtype, name="attention_norm")(x), positions
+        )
+        x = x + MLP(cfg, name="feed_forward")(
+            RMSNorm(cfg.norm_eps, cfg.param_dtype, name="ffn_norm")(x)
+        )
+        return x, None
+
+
+class Llama(nn.Module):
+    """Decoder stack. Layers run under `nn.scan` over stacked parameters
+    (leading [n_layers] dim) with `nn.remat` on the body: one compiled block
+    regardless of depth (constant compile time) and guaranteed per-layer
+    rematerialization — only block-boundary activations survive the forward
+    pass, the backward recomputes inside one layer at a time. This is the
+    canonical XLA/TPU pattern for deep transformer training."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.config
+        b, s = tokens.shape
+        positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+        x = nn.Embed(
+            cfg.vocab_size,
+            cfg.dim,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            embedding_init=nn.initializers.normal(0.02),
+            name="tok_embeddings",
+        )(tokens)
+
+        block = Block
+        if cfg.remat:
+            block = nn.remat(
+                Block,
+                prevent_cse=False,
+                policy=jax.checkpoint_policies.nothing_saveable,
+            )
+        scanned = nn.scan(
+            block,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=nn.broadcast,  # positions: same every layer
+            length=cfg.n_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )
+        x, _ = scanned(cfg, name="layers")(x, positions)
+
+        x = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="norm")(x)
+        logits = nn.Dense(
+            cfg.vocab_size,
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.initializers.normal(0.02),
+            name="output",
+        )(x)
+        return logits.astype(jnp.float32)
+
+
+def make_model(name_or_config) -> Llama:
+    if isinstance(name_or_config, str):
+        name_or_config = CONFIGS[name_or_config]
+    return Llama(name_or_config)
+
+
+def init_params(model: Llama, rng, batch: int = 1, seq: Optional[int] = None):
+    seq = seq or min(model.config.max_seq_len, 128)
+    tokens = jnp.zeros((batch, seq), dtype=jnp.int32)
+    return model.init(rng, tokens)

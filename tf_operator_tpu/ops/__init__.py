@@ -1,0 +1,11 @@
+"""TPU kernels (Pallas) and fused ops.
+
+The compute-path hot ops: flash attention (Pallas TPU kernel), ring
+attention for sequence parallelism over the ICI ring, and fused helpers.
+Each op degrades to a pure-XLA implementation off-TPU so tests run on the
+CPU mesh.
+"""
+
+from . import attention
+
+__all__ = ["attention"]

@@ -1,0 +1,58 @@
+"""Attention ops: Pallas flash attention with an XLA fallback.
+
+`flash_attention(q, k, v, causal=True)` takes [batch, seq, heads, head_dim]
+(BSHD) and returns the same. On TPU it lowers to a Pallas kernel that
+streams K/V blocks through VMEM with an online softmax (no s×s score
+materialization in HBM); elsewhere it falls back to a fused XLA einsum path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def _repeat_kv(q, k, v):
+    groups = q.shape[2] // k.shape[2]
+    if groups > 1:
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
+    return k, v
+
+
+def xla_attention(q, k, v, causal: bool = True):
+    """Reference implementation: einsum + fp32 softmax (fused by XLA)."""
+    k, v = _repeat_kv(q, k, v)
+    head_dim = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(head_dim).astype(jnp.float32)
+    if causal:
+        s_q, s_k = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+        scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+@functools.cache
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def flash_attention(q, k, v, causal: bool = True):
+    """Dispatch: Pallas TPU kernel when available, XLA fallback otherwise."""
+    if _on_tpu():
+        try:
+            from .flash_pallas import flash_attention_pallas
+
+            return flash_attention_pallas(q, k, v, causal=causal)
+        except ImportError:
+            pass
+    return xla_attention(q, k, v, causal=causal)

@@ -1,0 +1,93 @@
+"""Ring attention: sequence-parallel causal attention over an ICI ring.
+
+Each device holds a sequence shard [b, s_local, h, d] (the `sp` mesh axis).
+K/V blocks rotate around the ring via `ppermute` while every device
+accumulates its queries' attention with an online (flash-style) softmax —
+s_total never materializes on one chip, so context length scales with the
+ring size at constant per-device memory. Communication (neighbor ppermute)
+overlaps with the block compute; on TPU the permutes ride ICI.
+
+Use under shard_map with the sequence axis mapped to `axis_name`:
+
+    out = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="sp"),
+        mesh=mesh,
+        in_specs=P(("dp","fsdp"), "sp", None, None), ...)
+
+Outside a mapped context (axis missing), falls back to plain causal
+attention on the gathered arrays so the same model code runs unsharded.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .attention import NEG_INF, _repeat_kv, xla_attention
+
+
+def _block_scores(q, k, scale):
+    return jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+
+
+def ring_attention(q, k, v, axis_name: str = "sp"):
+    """Causal ring attention. q,k,v: [b, s_local, h(_kv), d] sequence shards,
+    ordered by ring index (shard i holds global positions
+    [i*s_local, (i+1)*s_local))."""
+    try:
+        axis_size = jax.lax.psum(1, axis_name)
+    except NameError:
+        return xla_attention(q, k, v, causal=True)
+
+    k, v = _repeat_kv(q, k, v)
+    b, s, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    my_idx = jax.lax.axis_index(axis_name)
+    q_pos = my_idx * s + jnp.arange(s)  # global positions of my queries
+
+    # Online softmax accumulators (fp32), marked as varying over the ring
+    # axis (loop-carry types must match the body outputs, which depend on
+    # the mapped q/k/v).
+    def pvary(x):
+        try:
+            return jax.lax.pvary(x, (axis_name,))
+        except Exception:
+            return x
+
+    o0 = pvary(jnp.zeros((b, s, h, d), jnp.float32))
+    l0 = pvary(jnp.zeros((b, h, s), jnp.float32))
+    m0 = pvary(jnp.full((b, h, s), NEG_INF, jnp.float32))
+
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def body(i, carry):
+        o, l, m, k_blk, v_blk = carry
+        # After i rotations each device holds the block that started at ring
+        # position (my_idx - i) mod axis_size.
+        kv_idx = (my_idx - i) % axis_size
+        kv_pos = kv_idx * s + jnp.arange(s)
+
+        scores = _block_scores(q, k_blk, scale)  # [b,h,q,k] fp32
+        causal = q_pos[:, None] >= kv_pos[None, :]
+        scores = jnp.where(causal[None, None], scores, NEG_INF)
+
+        m_blk = jnp.max(scores, axis=-1)  # [b,h,q]
+        m_new = jnp.maximum(m, m_blk)
+        # Fully-masked blocks produce -inf rows; keep the exp argument finite.
+        safe_m = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        p = jnp.exp(scores - safe_m[..., None])
+        p = jnp.where(causal[None, None], p, 0.0)
+        corr = jnp.exp(jnp.where(m == NEG_INF, NEG_INF, m - safe_m))
+
+        l = l * corr + p.sum(axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk,
+                        preferred_element_type=jnp.float32)
+        o = o * corr.transpose(0, 2, 1)[..., None] + pv
+
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return o, l, m_new, k_next, v_next
+
+    o, l, m, _, _ = jax.lax.fori_loop(0, axis_size, body, (o0, l0, m0, k, v))
+    l = jnp.maximum(l, 1e-30)
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)

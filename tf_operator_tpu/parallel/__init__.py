@@ -1,0 +1,21 @@
+"""Parallelism: device meshes, sharding rules, collectives.
+
+The reference operator has no in-model parallelism (SURVEY.md §2.5: TP/PP/
+SP/EP are absent — it scales replica count only). In the TPU-native design
+the operator publishes topology (JAXJob `mesh`), and this package turns it
+into `jax.sharding.Mesh` + PartitionSpecs so XLA inserts the collectives:
+DP/FSDP over the data axes, TP over heads/ffn, SP over sequence, and a
+leading DCN axis for multislice.
+"""
+
+from .mesh import MeshSpec, make_mesh, standard_mesh
+from .sharding import batch_sharding, logical_axis_rules, shard_params_spec
+
+__all__ = [
+    "MeshSpec",
+    "make_mesh",
+    "standard_mesh",
+    "batch_sharding",
+    "logical_axis_rules",
+    "shard_params_spec",
+]

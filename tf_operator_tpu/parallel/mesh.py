@@ -1,0 +1,100 @@
+"""Device mesh construction from operator-published topology.
+
+The canonical axes, outermost (DCN) to innermost (ICI minor):
+
+- ``slice`` — across pod-slices (DCN); pure data parallelism.
+- ``dp``    — data parallelism over ICI.
+- ``fsdp``  — data parallelism with parameter/optimizer sharding (ZeRO-3).
+- ``sp``    — sequence/context parallelism (ring attention over an ICI ring).
+- ``tp``    — tensor parallelism (heads/ffn); innermost so its collectives
+              ride the fastest ICI links.
+
+`jax.experimental.mesh_utils.create_device_mesh` lays devices out so
+neighboring mesh coordinates are ICI neighbors.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+AXIS_ORDER = ("slice", "dp", "fsdp", "sp", "tp")
+
+
+@dataclass
+class MeshSpec:
+    """Logical mesh layout, e.g. MeshSpec({"fsdp": 8, "tp": 4})."""
+
+    axes: Dict[str, int] = field(default_factory=dict)
+
+    def __post_init__(self):
+        for name in self.axes:
+            if name not in AXIS_ORDER:
+                raise ValueError(f"unknown mesh axis {name!r}; known: {AXIS_ORDER}")
+
+    def ordered(self) -> List[tuple]:
+        return [(a, self.axes[a]) for a in AXIS_ORDER if a in self.axes]
+
+    @property
+    def size(self) -> int:
+        total = 1
+        for _, n in self.ordered():
+            total *= n
+        return total
+
+
+def make_mesh(spec: MeshSpec, devices: Optional[Sequence] = None) -> jax.sharding.Mesh:
+    """Build a Mesh matching `spec` over `devices` (default: all)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if not spec.axes:
+        # Empty spec: pure data parallelism over every device.
+        spec = MeshSpec({"dp": len(devices)})
+    if spec.size != len(devices):
+        raise ValueError(f"mesh {spec.axes} needs {spec.size} devices, have {len(devices)}")
+    names = tuple(a for a, _ in spec.ordered())
+    shape = tuple(n for _, n in spec.ordered())
+    try:
+        from jax.experimental import mesh_utils
+
+        if devices == list(jax.devices()):
+            device_array = mesh_utils.create_device_mesh(shape)
+        else:
+            device_array = np.array(devices).reshape(shape)
+    except Exception:
+        device_array = np.array(devices).reshape(shape)
+    return jax.sharding.Mesh(device_array, names)
+
+
+def standard_mesh(
+    n_devices: Optional[int] = None,
+    tp: int = 1,
+    sp: int = 1,
+    dp: int = 1,
+    num_slices: int = 1,
+    devices: Optional[Sequence] = None,
+) -> jax.sharding.Mesh:
+    """Mesh with fsdp absorbing whatever the explicit axes don't cover —
+    the right default for LLM training (FSDP-dominant, TP innermost)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = n_devices or len(devices)
+    denom = tp * sp * dp * num_slices
+    if n % denom:
+        raise ValueError(f"{n} devices not divisible by slice*dp*sp*tp={denom}")
+    axes = {}
+    if num_slices > 1:
+        axes["slice"] = num_slices
+    if dp > 1:
+        axes["dp"] = dp
+    axes["fsdp"] = n // denom
+    if sp > 1:
+        axes["sp"] = sp
+    if tp > 1:
+        axes["tp"] = tp
+    return make_mesh(MeshSpec(axes), devices[:n])
+
+
+def axis_size(mesh: jax.sharding.Mesh, name: str) -> int:
+    return mesh.shape.get(name, 1)

@@ -1,0 +1,111 @@
+"""Sharding rules: parameter-path -> PartitionSpec.
+
+Rather than translating a torch-style device-placement scheme, shardings are
+declared once as path rules and XLA inserts the collectives (all-gather for
+FSDP params, reduce-scatter for grads, all-reduce for TP partials) — the
+scaling-book recipe: pick a mesh, annotate, let the compiler work.
+
+Conventions (megatron-style, FSDP on the long axis):
+- embedding [vocab, d]           -> (tp, fsdp)
+- attn qkv  [d, heads*head_dim]  -> (fsdp, tp)
+- attn out  [heads*head_dim, d]  -> (tp, fsdp)
+- mlp in/gate [d, ffn]           -> (fsdp, tp)
+- mlp out  [ffn, d]              -> (tp, fsdp)
+- norms / scalars                -> replicated
+- activations [batch, seq, d]    -> ((slice, dp, fsdp), sp, tp)
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _present(mesh: Mesh, *axes: str) -> Tuple:
+    """Keep only axes that exist (size > 1 handled fine) in this mesh; a rule
+    mentioning an absent axis must degrade to replication on that dim."""
+    out = []
+    for axis in axes:
+        if isinstance(axis, (tuple, list)):
+            sub = tuple(a for a in axis if a in mesh.shape)
+            out.append(sub if sub else None)
+        else:
+            out.append(axis if axis in mesh.shape else None)
+    return tuple(out)
+
+
+# (path regex, spec axes per dim) — first match wins. Paths are joined with
+# '/' and lowercased, e.g. "params/layers_0/attention/wq/kernel". A dict
+# value selects by ndim (attention kernels are [d, heads, head_dim] when the
+# head axes are kept separate, [d, h*hd] when merged).
+_PARAM_RULES = [
+    (r"embed(ding)?s?.*(embedding|kernel)", ("tp", "fsdp")),
+    (r"(wq|wk|wv|qkv|query|key|value).*kernel", {2: ("fsdp", "tp"), 3: ("fsdp", "tp", None)}),
+    (r"(wo|out_proj|o_proj|attn_out).*kernel", {2: ("tp", "fsdp"), 3: ("tp", None, "fsdp")}),
+    (r"(w1|w3|gate_proj|up_proj|gate|up).*kernel", ("fsdp", "tp")),
+    (r"(w2|down_proj|down).*kernel", ("tp", "fsdp")),
+    (r"(lm_head|output|logits).*kernel", ("fsdp", "tp")),
+    (r"(norm|scale|bias|ln)", (None,)),
+]
+
+
+def spec_for_param(path: str, ndim: int, mesh: Mesh) -> P:
+    path = path.lower()
+    for pattern, axes in _PARAM_RULES:
+        if re.search(pattern, path):
+            if isinstance(axes, dict):
+                axes = axes.get(ndim, axes[max(axes)])
+            axes = _present(mesh, *axes)
+            if len(axes) < ndim:
+                axes = (None,) * (ndim - len(axes)) + tuple(axes)
+            return P(*axes[:ndim])
+    return P()  # replicate by default
+
+
+def shard_params_spec(params: Any, mesh: Mesh) -> Any:
+    """PartitionSpec pytree for a param pytree, by path rules."""
+
+    def walk(path_parts, node):
+        if isinstance(node, dict):
+            return {k: walk(path_parts + (k,), v) for k, v in node.items()}
+        path = "/".join(str(p) for p in path_parts)
+        return spec_for_param(path, getattr(node, "ndim", 0), mesh)
+
+    return walk((), params)
+
+
+def params_sharding(params: Any, mesh: Mesh) -> Any:
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        shard_params_spec(params, mesh),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def batch_sharding(mesh: Mesh, with_sp: bool = True) -> NamedSharding:
+    """[batch, seq, ...] data sharding: batch over all data axes, sequence
+    over sp when present (ring-attention sequence parallelism)."""
+    data_axes = tuple(a for a in ("slice", "dp", "fsdp") if a in mesh.shape)
+    seq_axis = "sp" if (with_sp and "sp" in mesh.shape) else None
+    return NamedSharding(mesh, P(data_axes if data_axes else None, seq_axis))
+
+
+def logical_axis_rules(mesh: Mesh):
+    """flax linen logical-axis rules equivalent for the conventions above
+    (for models that use nn.with_logical_partitioning)."""
+    return [
+        ("batch", tuple(a for a in ("slice", "dp", "fsdp") if a in mesh.shape) or None),
+        ("seq", "sp" if "sp" in mesh.shape else None),
+        ("vocab", "tp" if "tp" in mesh.shape else None),
+        ("embed", "fsdp" if "fsdp" in mesh.shape else None),
+        ("heads", "tp" if "tp" in mesh.shape else None),
+        ("kv", None),
+        ("ffn", "tp" if "tp" in mesh.shape else None),
+    ]
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,16 @@
+"""Training loop machinery for the JAX workloads: sharded train step,
+optimizer plumbing (optax), synthetic data, checkpointing (orbax)."""
+
+from .train_step import (
+    TrainState,
+    init_sharded_train_state,
+    init_train_state,
+    make_train_step,
+)
+
+__all__ = [
+    "TrainState",
+    "init_sharded_train_state",
+    "init_train_state",
+    "make_train_step",
+]

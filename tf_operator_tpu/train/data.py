@@ -1,0 +1,29 @@
+"""Data pipelines for the benchmark/example workloads.
+
+Synthetic token streams (deterministic, seeded) so benchmarks measure the
+training path, not disk IO. Batches are produced host-side as numpy and
+device_put onto the data sharding — the one host->device transfer per step.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class SyntheticTokens:
+    """Infinite deterministic stream of token batches [batch, seq+1]
+    (train_step splits input/target internally)."""
+
+    def __init__(self, batch: int, seq: int, vocab_size: int, seed: int = 0):
+        self.batch = batch
+        self.seq = seq
+        self.vocab_size = vocab_size
+        self._rng = np.random.default_rng(seed)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> np.ndarray:
+        return self._rng.integers(
+            0, self.vocab_size, size=(self.batch, self.seq + 1), dtype=np.int32
+        )
