@@ -1,0 +1,359 @@
+"""PyTorch/MXNet/XGBoost/JAX controller tests: env contracts (SURVEY.md
+§2.5), master/scheduler status semantics, TPU pod-slice provisioning and
+per-slice gang scheduling."""
+
+import json
+
+import pytest
+
+from tf_operator_tpu.api.k8s import POD_FAILED, POD_PENDING, POD_RUNNING, POD_SUCCEEDED
+from tf_operator_tpu.cluster.memory import InMemoryCluster
+from tf_operator_tpu.controllers.jax import JAXController
+from tf_operator_tpu.controllers.mxnet import MXController
+from tf_operator_tpu.controllers.pytorch import PyTorchController
+from tf_operator_tpu.controllers.xgboost import XGBoostController
+from tf_operator_tpu.core.job_controller import EngineOptions
+
+
+def container(name, ports=None):
+    return {"name": name, "image": "test:1", "ports": ports or []}
+
+
+def pytorch_manifest(workers=2, name="bert"):
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "PyTorchJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "pytorchReplicaSpecs": {
+                "Master": {"replicas": 1, "template": {"spec": {"containers": [container("pytorch")]}}},
+                "Worker": {"replicas": workers, "template": {"spec": {"containers": [container("pytorch")]}}},
+            }
+        },
+    }
+
+
+def xgboost_manifest(workers=2, name="iris"):
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "XGBoostJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "xgbReplicaSpecs": {
+                "Master": {"replicas": 1, "template": {"spec": {"containers": [container("xgboost")]}}},
+                "Worker": {"replicas": workers, "template": {"spec": {"containers": [container("xgboost")]}}},
+            }
+        },
+    }
+
+
+def mxnet_manifest(name="mx"):
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "MXJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "mxReplicaSpecs": {
+                "Scheduler": {"replicas": 1, "template": {"spec": {"containers": [container("mxnet")]}}},
+                "Server": {"replicas": 2, "template": {"spec": {"containers": [container("mxnet")]}}},
+                "Worker": {"replicas": 2, "template": {"spec": {"containers": [container("mxnet")]}}},
+            }
+        },
+    }
+
+
+def jax_manifest(name="llama", accelerator="v5e-16", num_slices=1, mesh=None):
+    spec = {
+        "tpu": {"acceleratorType": accelerator, "topology": "4x4"},
+        "numSlices": num_slices,
+        "jaxReplicaSpecs": {
+            "Worker": {"template": {"spec": {"containers": [container("jax")]}}}
+        },
+    }
+    if mesh:
+        spec["mesh"] = mesh
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "JAXJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": spec,
+    }
+
+
+class TestPyTorchController:
+    def setup_method(self):
+        self.cluster = InMemoryCluster()
+        self.controller = PyTorchController(self.cluster)
+
+    def test_c10d_env(self):
+        self.cluster.create_job(pytorch_manifest(workers=2))
+        self.controller.run_until_idle()
+        master = self.cluster.get_pod("default", "bert-master-0")
+        env = {e.name: e.value for e in master.spec.containers[0].env}
+        # Master rendezvous on localhost (reference pytorch.go:46-53).
+        assert env["MASTER_ADDR"] == "localhost"
+        assert env["MASTER_PORT"] == "23456"
+        assert env["WORLD_SIZE"] == "3"
+        assert env["RANK"] == "0"
+        worker = self.cluster.get_pod("default", "bert-worker-1")
+        wenv = {e.name: e.value for e in worker.spec.containers[0].env}
+        assert wenv["MASTER_ADDR"] == "bert-master-0"
+        assert wenv["RANK"] == "2"  # +1 offset
+        assert wenv["PYTHONUNBUFFERED"] == "0"
+
+    def test_master_completion_finishes_job(self):
+        self.cluster.create_job(pytorch_manifest(workers=2))
+        self.controller.run_until_idle()
+        self.cluster.set_pod_phase("default", "bert-worker-0", POD_RUNNING)
+        self.cluster.set_pod_phase("default", "bert-worker-1", POD_RUNNING)
+        self.cluster.set_pod_phase("default", "bert-master-0", POD_SUCCEEDED, exit_code=0)
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("PyTorchJob", "default", "bert")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds["Succeeded"]["status"] == "True"
+
+    def test_worker_failure_fails_job(self):
+        self.cluster.create_job(pytorch_manifest(workers=1))
+        self.controller.run_until_idle()
+        self.cluster.set_pod_phase("default", "bert-worker-0", POD_FAILED, exit_code=1)
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("PyTorchJob", "default", "bert")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        # Default restart policy is OnFailure, but a Failed pod phase under
+        # OnFailure means the kubelet gave up -> job failed.
+        assert conds["Failed"]["status"] == "True"
+
+    def test_master_restart_policy_exit_code_retryable(self):
+        m = pytorch_manifest(workers=1)
+        m["spec"]["pytorchReplicaSpecs"]["Master"]["restartPolicy"] = "ExitCode"
+        self.cluster.create_job(m)
+        self.controller.run_until_idle()
+        self.cluster.set_pod_phase("default", "bert-master-0", POD_FAILED, exit_code=137)
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("PyTorchJob", "default", "bert")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert "Failed" not in conds
+        # master recreated
+        assert any(p.metadata.name == "bert-master-0" for p in self.cluster.list_pods())
+
+
+class TestXGBoostController:
+    def setup_method(self):
+        self.cluster = InMemoryCluster()
+        self.controller = XGBoostController(self.cluster)
+
+    def test_rabit_env(self):
+        self.cluster.create_job(xgboost_manifest(workers=2))
+        self.controller.run_until_idle()
+        worker = self.cluster.get_pod("default", "iris-worker-1")
+        env = {e.name: e.value for e in worker.spec.containers[0].env}
+        assert env["MASTER_ADDR"] == "iris-master-0"
+        assert env["MASTER_PORT"] == "9999"
+        assert env["WORLD_SIZE"] == "3"
+        assert env["RANK"] == "2"  # 1 + masters offset
+        # LightGBM extras for multi-replica jobs.
+        assert env["WORKER_PORT"] == "9999"
+        assert env["WORKER_ADDRS"] == "iris-worker-0,iris-worker-1"
+
+    def test_master_based_completion(self):
+        self.cluster.create_job(xgboost_manifest(workers=1))
+        self.controller.run_until_idle()
+        self.cluster.set_pod_phase("default", "iris-master-0", POD_SUCCEEDED, exit_code=0)
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("XGBoostJob", "default", "iris")
+        assert {c["type"] for c in job["status"]["conditions"]} >= {"Created", "Succeeded"}
+
+
+class TestMXController:
+    def setup_method(self):
+        self.cluster = InMemoryCluster()
+        self.controller = MXController(self.cluster)
+
+    def test_dmlc_env(self):
+        self.cluster.create_job(mxnet_manifest())
+        self.controller.run_until_idle()
+        worker = self.cluster.get_pod("default", "mx-worker-1")
+        env = {e.name: e.value for e in worker.spec.containers[0].env}
+        assert env["DMLC_PS_ROOT_URI"] == "mx-scheduler-0"
+        assert env["DMLC_PS_ROOT_PORT"] == "9091"
+        assert env["DMLC_NUM_SERVER"] == "2"
+        assert env["DMLC_NUM_WORKER"] == "2"
+        assert env["DMLC_ROLE"] == "worker"
+        assert env["DMLC_USE_KUBERNETES"] == "1"
+        assert env["DMLC_WORKER_ID"] == "1"  # BytePS extra
+        cfg = json.loads(env["MX_CONFIG"])
+        assert cfg["task"] == {"type": "worker", "index": 1}
+        assert len(cfg["cluster"]["server"]) == 2
+        server = self.cluster.get_pod("default", "mx-server-0")
+        senv = {e.name: e.value for e in server.spec.containers[0].env}
+        assert "DMLC_WORKER_ID" not in senv
+
+    def test_scheduler_completion_finishes_job(self):
+        self.cluster.create_job(mxnet_manifest())
+        self.controller.run_until_idle()
+        self.cluster.set_pod_phase("default", "mx-scheduler-0", POD_SUCCEEDED, exit_code=0)
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("MXJob", "default", "mx")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds["Succeeded"]["status"] == "True"
+
+
+class TestJAXController:
+    def setup_method(self):
+        self.cluster = InMemoryCluster()
+        self.controller = JAXController(
+            self.cluster, options=EngineOptions(enable_gang_scheduling=True)
+        )
+
+    def test_slice_provisioning_v5e16(self):
+        """v5e-16 = 4 hosts x 4 chips: replicas default to 4, each pod asks
+        for 4 TPU chips with GKE selectors."""
+        self.cluster.create_job(jax_manifest(accelerator="v5e-16"))
+        self.controller.run_until_idle()
+        pods = self.cluster.list_pods()
+        assert len(pods) == 4
+        pod = self.cluster.get_pod("default", "llama-worker-2")
+        assert pod.spec.node_selector["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+        assert pod.spec.node_selector["cloud.google.com/gke-tpu-topology"] == "4x4"
+        assert pod.spec.containers[0].resources["limits"]["google.com/tpu"] == "4"
+
+    def test_jax_env_contract(self):
+        self.cluster.create_job(jax_manifest(accelerator="v5e-16", mesh={"fsdp": 4, "tp": 4}))
+        self.controller.run_until_idle()
+        pod = self.cluster.get_pod("default", "llama-worker-2")
+        env = {e.name: e.value for e in pod.spec.containers[0].env}
+        assert env["JAX_COORDINATOR_ADDRESS"] == "llama-worker-0.default.svc:1234"
+        assert env["JAX_NUM_PROCESSES"] == "4"
+        assert env["JAX_PROCESS_ID"] == "2"
+        assert env["TPU_WORKER_ID"] == "2"
+        assert env["TPU_WORKER_HOSTNAMES"].split(",") == [
+            f"llama-worker-{i}.default.svc" for i in range(4)
+        ]
+        assert env["TPU_ACCELERATOR_TYPE"] == "v5e-16"
+        assert json.loads(env["JAX_MESH_SPEC"]) == {"fsdp": 4, "tp": 4}
+        assert "MEGASCALE_COORDINATOR_ADDRESS" not in env  # single slice
+
+    def test_multislice_env_and_gangs(self):
+        """2 x v5e-16: 8 workers, slice-local TPU_WORKER_ID/HOSTNAMES, one
+        gang per slice, megascale coordination env."""
+        self.cluster.create_job(jax_manifest(num_slices=2))
+        self.controller.run_until_idle()
+        assert len(self.cluster.list_pods()) == 8
+        pod = self.cluster.get_pod("default", "llama-worker-5")
+        env = {e.name: e.value for e in pod.spec.containers[0].env}
+        assert env["JAX_PROCESS_ID"] == "5"
+        assert env["TPU_WORKER_ID"] == "1"  # 5 % 4
+        assert env["JAX_SLICE_INDEX"] == "1"
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+        assert env["MEGASCALE_SLICE_ID"] == "1"
+        assert env["TPU_WORKER_HOSTNAMES"].split(",") == [
+            f"llama-worker-{i}.default.svc" for i in range(4, 8)
+        ]
+        # Per-slice gang groups with per-slice minMember.
+        g0 = self.cluster.get_pod_group("default", "llama-slice-0")
+        g1 = self.cluster.get_pod_group("default", "llama-slice-1")
+        assert g0["spec"]["minMember"] == 4 and g1["spec"]["minMember"] == 4
+        assert pod.metadata.annotations["scheduling.k8s.io/group-name"] == "llama-slice-1"
+        assert pod.metadata.labels["tpu-slice-index"] == "1"
+
+    def test_gang_all_or_nothing_scheduling(self):
+        """The simulated scheduler must not bind any pod of a slice until the
+        whole gang exists."""
+        self.cluster.create_job(jax_manifest(accelerator="v5e-16"))
+        # Process only a few queue items so only some pods exist.
+        for _ in range(3):
+            self.controller.process_next(timeout=0.01)
+        pods = self.cluster.list_pods()
+        if len(pods) < 4:  # partial gang: nothing binds
+            self.cluster.step()
+            assert all(p.status.phase == POD_PENDING for p in self.cluster.list_pods())
+        self.controller.run_until_idle()
+        self.cluster.step()
+        assert all(p.status.phase == POD_RUNNING for p in self.cluster.list_pods())
+
+    def test_all_workers_must_succeed(self):
+        self.cluster.create_job(jax_manifest(accelerator="v5e-16"))
+        self.controller.run_until_idle()
+        for i in range(3):
+            self.cluster.set_pod_phase("default", f"llama-worker-{i}", POD_SUCCEEDED, exit_code=0)
+        self.cluster.set_pod_phase("default", "llama-worker-3", POD_RUNNING)
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        assert "Succeeded" not in {c["type"] for c in job["status"]["conditions"]}
+        self.cluster.set_pod_phase("default", "llama-worker-3", POD_SUCCEEDED, exit_code=0)
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds["Succeeded"]["status"] == "True"
+
+    def test_preemption_restarts_by_default(self):
+        """Default restart policy is ExitCode: SIGKILL (137) from a
+        preemption restarts the worker instead of failing the job."""
+        self.cluster.create_job(jax_manifest(accelerator="v5e-16"))
+        self.controller.run_until_idle()
+        for p in self.cluster.list_pods():
+            self.cluster.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+        self.controller.run_until_idle()
+        self.cluster.set_pod_phase("default", "llama-worker-2", POD_FAILED, exit_code=137)
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        conds = {c["type"] for c in job["status"]["conditions"]}
+        assert "Failed" not in conds
+        assert any(p.metadata.name == "llama-worker-2" for p in self.cluster.list_pods())
+        events = {e.reason for e in self.cluster.list_events()}
+        assert "JAXJobRestarting" in events
+
+    def test_permanent_failure_after_restart_still_fails(self):
+        """Regression: a recreated pod that crashes with a permanent exit
+        code before ever being seen Running must fail the job — a stale
+        Restarting condition must not wedge it non-terminal forever."""
+        self.cluster.create_job(jax_manifest(accelerator="v5e-16"))
+        self.controller.run_until_idle()
+        for p in self.cluster.list_pods():
+            self.cluster.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+        self.controller.run_until_idle()
+        # Preemption -> restart initiated, Restarting condition set.
+        self.cluster.set_pod_phase("default", "llama-worker-2", POD_FAILED, exit_code=137)
+        self.controller.run_until_idle()
+        # The recreated pod crashes permanently while still Pending-era.
+        self.cluster.set_pod_phase("default", "llama-worker-2", POD_FAILED, exit_code=1)
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds["Failed"]["status"] == "True"
+
+    def test_multislice_indivisible_replicas_rejected(self):
+        m = jax_manifest(num_slices=2)
+        m["spec"]["tpu"] = None
+        m["spec"]["jaxReplicaSpecs"]["Worker"]["replicas"] = 5
+        self.cluster.create_job(m)
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds["Failed"]["status"] == "True"
+        assert "split" in conds["Failed"]["message"]
+
+    def test_permanent_failure_fails_job(self):
+        self.cluster.create_job(jax_manifest(accelerator="v5e-16"))
+        self.controller.run_until_idle()
+        self.cluster.set_pod_phase("default", "llama-worker-1", POD_FAILED, exit_code=1)
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds["Failed"]["status"] == "True"
+
+
+class TestRegistry:
+    def test_all_kinds_registered(self):
+        from tf_operator_tpu.controllers import SUPPORTED_CONTROLLERS, enabled_kinds
+
+        assert set(SUPPORTED_CONTROLLERS) == {
+            "TFJob",
+            "PyTorchJob",
+            "MXJob",
+            "XGBoostJob",
+            "JAXJob",
+        }
+        assert enabled_kinds() == list(SUPPORTED_CONTROLLERS)
+        with pytest.raises(ValueError, match="unsupported"):
+            enabled_kinds(["NopeJob"])

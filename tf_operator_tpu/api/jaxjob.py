@@ -175,6 +175,20 @@ def validate(spec: JAXJobSpec) -> None:
             raise ValidationError(
                 f"JAXReplicaType is {rtype} but must be one of {list(CANONICAL_REPLICA_TYPES)}"
             )
+    worker = spec.jax_replica_specs.get(REPLICA_TYPE_WORKER)
+    if (
+        spec.num_slices > 1
+        and worker is not None
+        and worker.replicas is not None
+        and worker.replicas % spec.num_slices != 0
+    ):
+        # Slice membership (gang groups, TPU_WORKER_ID, hostnames) is
+        # index // hosts_per_slice; a non-divisible count would put pods in
+        # a slice no gang group exists for.
+        raise ValidationError(
+            f"JAXJobSpec is not valid: {worker.replicas} workers cannot split "
+            f"evenly over {spec.num_slices} slices"
+        )
     if spec.tpu is not None and spec.tpu.accelerator_type:
         if spec.tpu.accelerator_type not in ACCELERATOR_TOPOLOGIES:
             raise ValidationError(

@@ -1,0 +1,48 @@
+"""PyTorch c10d rendezvous env (MASTER_ADDR/PORT, WORLD_SIZE, RANK).
+
+Reference parity: pkg/controller.v1/pytorch/pytorch.go:27-82 (SetPodEnv) —
+including the master-sees-localhost rule and the +1 rank offset for workers.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ..api import pytorchjob as ptapi
+from ..api.pytorchjob import PyTorchJob
+from ..core.job_controller import gen_general_name
+from .ports import get_container_port
+
+
+def get_master_port(job: PyTorchJob) -> int:
+    return get_container_port(
+        job.spec.pytorch_replica_specs,
+        ptapi.REPLICA_TYPE_MASTER,
+        ptapi.DEFAULT_CONTAINER_NAME,
+        ptapi.DEFAULT_PORT_NAME,
+        ptapi.DEFAULT_PORT,
+    )
+
+
+def total_replicas(job: PyTorchJob) -> int:
+    return sum(spec.replicas or 0 for spec in job.spec.pytorch_replica_specs.values())
+
+
+def gen_env(job: PyTorchJob, rtype: str, index: int) -> Dict[str, str]:
+    """Env for one replica. Master (always index 0) rendezvous on localhost;
+    workers get rank index+1 (reference pytorch.go:46-53)."""
+    rank = index
+    master_addr = gen_general_name(job.name, ptapi.REPLICA_TYPE_MASTER, 0)
+    if rtype.lower() == ptapi.REPLICA_TYPE_MASTER.lower():
+        if index != 0:
+            raise ValueError("invalid config: There should be only a single master with index=0")
+        master_addr = "localhost"
+    else:
+        rank = index + 1
+    return {
+        "MASTER_PORT": str(get_master_port(job)),
+        "MASTER_ADDR": master_addr,
+        "WORLD_SIZE": str(total_replicas(job)),
+        "RANK": str(rank),
+        "PYTHONUNBUFFERED": "0",
+    }

@@ -1,0 +1,72 @@
+"""MXNet DMLC / PS-Lite env (MX_CONFIG + DMLC_*).
+
+Reference parity: pkg/controller.v1/mxnet/mxnet.go (genMXConfig,
+SetPodEnv incl. the BytePS DMLC_WORKER_ID extra).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+from ..api import mxjob as mxapi
+from ..api.mxjob import MXJob
+from ..core.job_controller import gen_general_name
+from .ports import get_container_port
+
+
+def get_port(job: MXJob, rtype: str) -> int:
+    return get_container_port(
+        job.spec.mx_replica_specs,
+        rtype,
+        mxapi.DEFAULT_CONTAINER_NAME,
+        mxapi.DEFAULT_PORT_NAME,
+        mxapi.DEFAULT_PORT,
+    )
+
+
+def gen_cluster_spec(job: MXJob) -> Dict[str, List[dict]]:
+    """{"scheduler": [{"url": ..., "port": ...}], ...} (reference
+    genClusterSpec — URLs are bare pod/service names, no namespace suffix)."""
+    cluster: Dict[str, List[dict]] = {}
+    for rtype, spec in job.spec.mx_replica_specs.items():
+        rt = rtype.lower()
+        port = get_port(job, rtype)
+        cluster[rt] = [
+            {"url": gen_general_name(job.name, rt, i), "port": int(port)}
+            for i in range(spec.replicas or 0)
+        ]
+    return cluster
+
+
+def gen_labels_spec(job: MXJob) -> Dict[str, str]:
+    """Per-type tuner-server-key annotations for TVM auto-tuning topologies
+    (reference genLabelsSpec)."""
+    return {
+        rtype.lower(): spec.template.metadata.annotations.get(mxapi.TUNER_SERVER_KEY, "")
+        for rtype, spec in job.spec.mx_replica_specs.items()
+    }
+
+
+def gen_env(job: MXJob, rtype: str, index: int) -> Dict[str, str]:
+    cluster = gen_cluster_spec(job)
+    rt = rtype.lower()
+    mx_config = {
+        "cluster": cluster,
+        "labels": gen_labels_spec(job),
+        "task": {"type": rt, "index": int(index)},
+    }
+    scheduler = (cluster.get(mxapi.REPLICA_TYPE_SCHEDULER.lower()) or [{"url": "", "port": 0}])[0]
+    env = {
+        "MX_CONFIG": json.dumps(mx_config, separators=(",", ":")),
+        "DMLC_PS_ROOT_PORT": str(scheduler["port"]),
+        "DMLC_PS_ROOT_URI": scheduler["url"],
+        "DMLC_NUM_SERVER": str(len(cluster.get(mxapi.REPLICA_TYPE_SERVER.lower(), []))),
+        "DMLC_NUM_WORKER": str(len(cluster.get(mxapi.REPLICA_TYPE_WORKER.lower(), []))),
+        "DMLC_ROLE": rt,
+        "DMLC_USE_KUBERNETES": "1",
+    }
+    # BytePS wants a per-worker id (reference addBytePSEnv).
+    if rt == mxapi.REPLICA_TYPE_WORKER.lower():
+        env["DMLC_WORKER_ID"] = str(index)
+    return env

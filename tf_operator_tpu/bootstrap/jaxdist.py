@@ -1,0 +1,106 @@
+"""JAX/TPU bootstrap env — the TPU-native rendezvous contract.
+
+No reference counterpart: where TF_CONFIG/c10d env wires GPU-era transports,
+this contract wires `jax.distributed` + libtpu:
+
+- JAX_COORDINATOR_ADDRESS  worker-0's headless service (host:port) — the
+                           jax.distributed coordinator.
+- JAX_NUM_PROCESSES        total worker count (all slices).
+- JAX_PROCESS_ID           this worker's global index.
+- TPU_WORKER_ID            index within its slice (libtpu host ordinal).
+- TPU_WORKER_HOSTNAMES     comma list of this slice's worker DNS names
+                           (libtpu uses it to form the ICI mesh).
+- TPU_ACCELERATOR_TYPE /   published topology so the workload can build
+  TPU_TOPOLOGY               meshes without cloud metadata queries.
+- JAX_MESH_SPEC            JSON of the declared logical mesh axes.
+- MEGASCALE_*              multislice (DCN) coordination: coordinator =
+                           slice-0 worker-0, slice id, slice count.
+
+`tf_operator_tpu.runtime.tpu_init` consumes these inside the container.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict
+
+from ..api import jaxjob as jaxapi
+from ..api.jaxjob import JAXJob
+from .ports import get_container_port
+from .tf_config import replica_service_host
+
+ENV_COORDINATOR_ADDRESS = "JAX_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "JAX_NUM_PROCESSES"
+ENV_PROCESS_ID = "JAX_PROCESS_ID"
+ENV_MESH_SPEC = "JAX_MESH_SPEC"
+ENV_TPU_WORKER_ID = "TPU_WORKER_ID"
+ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+ENV_TPU_ACCELERATOR_TYPE = "TPU_ACCELERATOR_TYPE"
+ENV_TPU_TOPOLOGY = "TPU_TOPOLOGY"
+ENV_NUM_SLICES = "JAX_NUM_SLICES"
+ENV_SLICE_INDEX = "JAX_SLICE_INDEX"
+ENV_MEGASCALE_COORDINATOR = "MEGASCALE_COORDINATOR_ADDRESS"
+ENV_MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
+ENV_MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
+
+
+def get_port(job: JAXJob) -> int:
+    return get_container_port(
+        job.spec.jax_replica_specs,
+        jaxapi.REPLICA_TYPE_WORKER,
+        jaxapi.DEFAULT_CONTAINER_NAME,
+        jaxapi.DEFAULT_PORT_NAME,
+        jaxapi.DEFAULT_PORT,
+    )
+
+
+def hosts_per_slice(job: JAXJob) -> int:
+    worker = job.spec.jax_replica_specs.get(jaxapi.REPLICA_TYPE_WORKER)
+    total = (worker.replicas or 1) if worker else 1
+    if job.spec.tpu is not None:
+        hosts = jaxapi.hosts_for(job.spec.tpu)
+        if hosts:
+            return hosts
+    return max(1, total // max(1, job.spec.num_slices))
+
+
+def gen_env(job: JAXJob, rtype: str, index: int) -> Dict[str, str]:
+    worker = job.spec.jax_replica_specs.get(jaxapi.REPLICA_TYPE_WORKER)
+    total = (worker.replicas or 1) if worker else 1
+    port = get_port(job)
+    per_slice = hosts_per_slice(job)
+    slice_index = index // per_slice
+    worker_id = index % per_slice
+
+    rt = jaxapi.REPLICA_TYPE_WORKER.lower()
+    coordinator = f"{replica_service_host(job.name, job.namespace, rt, 0)}:{port}"
+    slice_hosts = [
+        replica_service_host(job.name, job.namespace, rt, slice_index * per_slice + i)
+        for i in range(per_slice)
+    ]
+
+    env = {
+        ENV_COORDINATOR_ADDRESS: coordinator,
+        ENV_NUM_PROCESSES: str(total),
+        ENV_PROCESS_ID: str(index),
+        ENV_TPU_WORKER_ID: str(worker_id),
+        ENV_TPU_WORKER_HOSTNAMES: ",".join(slice_hosts),
+        ENV_NUM_SLICES: str(max(1, job.spec.num_slices)),
+        ENV_SLICE_INDEX: str(slice_index),
+    }
+    if job.spec.tpu is not None:
+        if job.spec.tpu.accelerator_type:
+            env[ENV_TPU_ACCELERATOR_TYPE] = job.spec.tpu.accelerator_type
+        if job.spec.tpu.topology:
+            env[ENV_TPU_TOPOLOGY] = job.spec.tpu.topology
+    if job.spec.mesh:
+        env[ENV_MESH_SPEC] = json.dumps(job.spec.mesh, separators=(",", ":"))
+    if job.spec.num_slices > 1:
+        # DCN-coordinated multislice: megascale coordinator lives on
+        # slice-0 worker-0.
+        env[ENV_MEGASCALE_COORDINATOR] = replica_service_host(
+            job.name, job.namespace, rt, 0
+        )
+        env[ENV_MEGASCALE_NUM_SLICES] = str(job.spec.num_slices)
+        env[ENV_MEGASCALE_SLICE_ID] = str(slice_index)
+    return env

@@ -1,0 +1,30 @@
+"""Shared rendezvous-port lookup.
+
+Every framework contract finds its port the same way the reference does
+(e.g. getPortFromPyTorchJob pytorch.go:97-110): scan the replica type's
+canonical container for the canonically-named port, fall back to the
+framework default.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..api.common import ReplicaSpec
+
+
+def get_container_port(
+    replica_specs: Dict[str, ReplicaSpec],
+    rtype: Optional[str],
+    container_name: str,
+    port_name: str,
+    default: int,
+) -> int:
+    spec = replica_specs.get(rtype) if rtype is not None else None
+    if spec is not None:
+        for container in spec.template.spec.containers:
+            if container.name == container_name:
+                for port in container.ports:
+                    if port.name == port_name:
+                        return port.container_port
+    return default

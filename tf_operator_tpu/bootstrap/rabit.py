@@ -1,0 +1,54 @@
+"""XGBoost Rabit / LightGBM env.
+
+Reference parity: pkg/controller.v1/xgboost/xgboost.go (SetPodEnv) — master
+rendezvous env on every pod, worker ranks offset by the master count, and
+the LightGBM extras (WORKER_PORT/WORKER_ADDRS) for multi-replica jobs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ..api import xgboostjob as xgbapi
+from ..api.xgboostjob import XGBoostJob
+from ..core.job_controller import gen_general_name
+from .ports import get_container_port
+
+
+def get_port(job: XGBoostJob, rtype: str) -> int:
+    return get_container_port(
+        job.spec.xgb_replica_specs,
+        rtype,
+        xgbapi.DEFAULT_CONTAINER_NAME,
+        xgbapi.DEFAULT_PORT_NAME,
+        xgbapi.DEFAULT_PORT,
+    )
+
+
+def total_replicas(job: XGBoostJob) -> int:
+    return sum(spec.replicas or 0 for spec in job.spec.xgb_replica_specs.values())
+
+
+def gen_env(job: XGBoostJob, rtype: str, index: int) -> Dict[str, str]:
+    rank = index
+    master_spec = job.spec.xgb_replica_specs.get(xgbapi.REPLICA_TYPE_MASTER)
+    if rtype.lower() == xgbapi.REPLICA_TYPE_WORKER.lower() and master_spec is not None:
+        rank += master_spec.replicas or 0
+
+    total = total_replicas(job)
+    env = {
+        "MASTER_PORT": str(get_port(job, xgbapi.REPLICA_TYPE_MASTER)),
+        "MASTER_ADDR": gen_general_name(job.name, xgbapi.REPLICA_TYPE_MASTER, 0),
+        "WORLD_SIZE": str(total),
+        "RANK": str(rank),
+        "PYTHONUNBUFFERED": "0",
+    }
+    if total > 1:
+        # LightGBM extras: total-1 worker addresses (reference xgboost.go:95-107;
+        # the -1 assumes the single validated master).
+        env["WORKER_PORT"] = str(get_port(job, xgbapi.REPLICA_TYPE_WORKER))
+        env["WORKER_ADDRS"] = ",".join(
+            gen_general_name(job.name, xgbapi.REPLICA_TYPE_WORKER, i)
+            for i in range(total - 1)
+        )
+    return env

@@ -13,6 +13,7 @@ from typing import Dict, List
 from ..api import tfjob as tfapi
 from ..api.tfjob import TFJob
 from ..core.job_controller import gen_general_name
+from .ports import get_container_port
 
 # Custom cluster DNS domain, e.g. "cluster.local" (reference tensorflow.go:30-33).
 ENV_CUSTOM_CLUSTER_DOMAIN = "CUSTOM_CLUSTER_DOMAIN"
@@ -31,13 +32,13 @@ def replica_service_host(job_name: str, namespace: str, rtype: str, index: int) 
 
 
 def get_port_from_job(job: TFJob, rtype: str) -> int:
-    spec = job.spec.tf_replica_specs[rtype]
-    for container in spec.template.spec.containers:
-        if container.name == tfapi.DEFAULT_CONTAINER_NAME:
-            for port in container.ports:
-                if port.name == tfapi.DEFAULT_PORT_NAME:
-                    return port.container_port
-    return tfapi.DEFAULT_PORT
+    return get_container_port(
+        job.spec.tf_replica_specs,
+        rtype,
+        tfapi.DEFAULT_CONTAINER_NAME,
+        tfapi.DEFAULT_PORT_NAME,
+        tfapi.DEFAULT_PORT,
+    )
 
 
 def gen_cluster_spec(job: TFJob) -> Dict[str, List[str]]:

@@ -30,12 +30,7 @@ def enabled_kinds(names=None):
 
 
 def _load_all():
-    from . import tensorflow  # noqa: F401
-
-    try:
-        from . import pytorch, mxnet, xgboost, jax  # noqa: F401
-    except ImportError:
-        pass  # later milestones
+    from . import jax, mxnet, pytorch, tensorflow, xgboost  # noqa: F401
 
 
 _load_all()

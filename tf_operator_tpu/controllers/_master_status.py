@@ -1,0 +1,102 @@
+"""Shared master-keyed status state machine.
+
+PyTorch, XGBoost and MXNet all key job conditions on one "completion"
+replica (Master / Master / Scheduler): it running -> Running, it fully
+succeeded -> Succeeded; any failure -> Restarting (ExitCode policy) or
+Failed. Reference: pytorchjob_controller.go:317-399,
+xgboostjob_controller.go:330-405, mxjob_controller.go:340-420 (three
+near-identical copies the reference maintains separately; folded here once).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ..api import common as capi
+from ..api.common import JobStatus, ReplicaSpec
+from ..api.k8s import Event
+from ..core import constants
+
+
+def update_master_based_status(
+    controller,
+    job,
+    replicas: Dict[str, ReplicaSpec],
+    job_status: JobStatus,
+    master_type: str,
+) -> None:
+    kind = controller.kind
+    now = controller.clock()
+    restarting = getattr(job_status, "_restarting_this_sync", False)
+
+    if job_status.start_time is None:
+        job_status.start_time = now
+
+    for rtype in controller.replica_order(replicas):
+        spec = replicas[rtype]
+        status = job_status.replica_statuses.get(rtype)
+        if status is None:
+            continue
+        succeeded = status.succeeded
+        expected = (spec.replicas or 0) - succeeded
+        running = status.active
+        failed = status.failed
+
+        if rtype == master_type:
+            if running > 0 and not restarting:
+                capi.update_job_conditions(
+                    job_status,
+                    capi.JOB_RUNNING,
+                    constants.job_reason(kind, constants.REASON_RUNNING),
+                    f"{kind} {job.key()} is running.",
+                    now=now,
+                )
+            if expected == 0:
+                msg = f"{kind} {job.key()} is successfully completed."
+                if job_status.completion_time is None:
+                    job_status.completion_time = now
+                capi.update_job_conditions(
+                    job_status,
+                    capi.JOB_SUCCEEDED,
+                    constants.job_reason(kind, constants.REASON_SUCCEEDED),
+                    msg,
+                    now=now,
+                )
+                controller.cluster.record_event(
+                    Event(
+                        type="Normal",
+                        reason=constants.job_reason(kind, constants.REASON_SUCCEEDED),
+                        message=msg,
+                        involved_object=f"{job.kind}/{job.key()}",
+                    )
+                )
+                return
+
+        if failed > 0:
+            # Suppress Failed only when THIS sync initiated a retryable
+            # restart (the engine deleted the pod and set Restarting). A
+            # stale Restarting condition from a previous sync must not
+            # suppress: a recreated pod failing with a permanent exit code
+            # has failed>0 with restarting=False and must fail the job —
+            # otherwise it wedges non-terminal forever.
+            if restarting:
+                continue
+            msg = f"{kind} {job.key()} is failed because {failed} {rtype} replica(s) failed."
+            if job_status.completion_time is None:
+                job_status.completion_time = now
+            capi.update_job_conditions(
+                job_status,
+                capi.JOB_FAILED,
+                constants.job_reason(kind, constants.REASON_FAILED),
+                msg,
+                now=now,
+            )
+            controller.cluster.record_event(
+                Event(
+                    type="Normal",
+                    reason=constants.job_reason(kind, constants.REASON_FAILED),
+                    message=msg,
+                    involved_object=f"{job.kind}/{job.key()}",
+                )
+            )
+            return

@@ -1,0 +1,192 @@
+"""JAXJob controller — the TPU-native path (no reference counterpart;
+SURVEY.md §7 stages 2 and 5).
+
+Provisions TPU pod-slices as all-or-nothing gangs: each worker pod requests
+its slice share of chips (google.com/tpu) and carries GKE TPU node selectors;
+pods of one slice form one gang (minMember = hosts per slice), so a
+multislice job's free slice can start while another queues. Env injection is
+the JAX/libtpu rendezvous contract (bootstrap/jaxdist.py).
+
+Status: SPMD jobs live and die together — Succeeded when ALL workers
+succeed; Running while any runs; retryable exits (preemption/maintenance,
+128+) restart via the engine's ExitCode handling.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..api import common as capi
+from ..api import jaxjob as jaxapi
+from ..api.common import JobStatus, ReplicaSpec
+from ..api.k8s import Event
+from ..bootstrap import jaxdist
+from ..core import constants
+from . import register
+from .base import FrameworkController
+
+# GKE TPU node-selector label keys.
+NODE_SELECTOR_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
+NODE_SELECTOR_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+TPU_RESOURCE = "google.com/tpu"
+
+# Marketing/GKE accelerator naming: v5e is "tpu-v5-lite-podslice".
+_GKE_ACCELERATOR_NAMES = {
+    "v4": "tpu-v4-podslice",
+    "v5e": "tpu-v5-lite-podslice",
+    "v5p": "tpu-v5p-slice",
+    "v6e": "tpu-v6e-slice",
+}
+
+
+def gke_accelerator_name(accelerator_type: str) -> str:
+    family = accelerator_type.split("-")[0]
+    return _GKE_ACCELERATOR_NAMES.get(family, family)
+
+
+@register(jaxapi.KIND)
+class JAXController(FrameworkController):
+    kind = jaxapi.KIND
+    default_container_name = jaxapi.DEFAULT_CONTAINER_NAME
+    default_port_name = jaxapi.DEFAULT_PORT_NAME
+    default_port = jaxapi.DEFAULT_PORT
+
+    # ------------------------------------------------------------ pod spec
+    def set_cluster_spec(self, job, template, rtype: str, index: int) -> None:
+        env = jaxdist.gen_env(job, rtype, index)
+        for container in template.spec.containers:
+            for name, value in env.items():
+                if container.get_env(name) is None:
+                    container.set_env(name, value)
+        self._attach_tpu_resources(job, template, index)
+
+    def _attach_tpu_resources(self, job, template, index: int) -> None:
+        tpu = job.spec.tpu
+        if tpu is None:
+            return
+        per_slice = jaxdist.hosts_per_slice(job)
+        template.metadata.labels[constants.LABEL_SLICE_INDEX] = str(index // per_slice)
+        template.metadata.annotations[constants.ANNOTATION_TPU_ACCELERATOR] = (
+            tpu.accelerator_type
+        )
+        if tpu.topology:
+            template.metadata.annotations[constants.ANNOTATION_TPU_TOPOLOGY] = tpu.topology
+        if tpu.accelerator_type:
+            template.spec.node_selector.setdefault(
+                NODE_SELECTOR_ACCELERATOR, gke_accelerator_name(tpu.accelerator_type)
+            )
+        if tpu.topology:
+            template.spec.node_selector.setdefault(NODE_SELECTOR_TOPOLOGY, tpu.topology)
+        chips = tpu.chips_per_host
+        if chips is None:
+            info = jaxapi.ACCELERATOR_TOPOLOGIES.get(tpu.accelerator_type)
+            chips = info[1] if info else None
+        if chips:
+            for container in template.spec.containers:
+                if container.name == self.default_container_name:
+                    limits = container.resources.setdefault("limits", {})
+                    limits.setdefault(TPU_RESOURCE, str(chips))
+                    requests = container.resources.setdefault("requests", {})
+                    requests.setdefault(TPU_RESOURCE, str(chips))
+
+    # ---------------------------------------------------------------- gang
+    def gang_group_name(self, job, rtype: str, index: int) -> str:
+        per_slice = jaxdist.hosts_per_slice(job)
+        return f"{job.name}-slice-{index // per_slice}"
+
+    def gang_groups(self, job, replicas: Dict[str, ReplicaSpec], run_policy) -> List[dict]:
+        """One gang per slice: minMember = hosts per slice (a partial slice
+        is useless; an independent slice is not)."""
+        per_slice = jaxdist.hosts_per_slice(job)
+        sp = run_policy.scheduling_policy
+        groups = []
+        for s in range(max(1, job.spec.num_slices)):
+            groups.append(
+                {
+                    "apiVersion": "scheduling.volcano.sh/v1beta1",
+                    "kind": "PodGroup",
+                    "metadata": {"name": f"{job.name}-slice-{s}", "namespace": job.namespace},
+                    "spec": {
+                        "minMember": per_slice,
+                        "queue": sp.queue if sp else "",
+                        "priorityClassName": sp.priority_class if sp else "",
+                    },
+                }
+            )
+        return groups
+
+    # -------------------------------------------------------------- status
+    def is_master_role(self, replicas: Dict[str, ReplicaSpec], rtype: str, index: int) -> bool:
+        """Worker-0 hosts the jax.distributed coordinator."""
+        return rtype == jaxapi.REPLICA_TYPE_WORKER and index == 0
+
+    def update_job_status(
+        self, job, replicas: Dict[str, ReplicaSpec], job_status: JobStatus, pods
+    ) -> None:
+        now = self.clock()
+        restarting = getattr(job_status, "_restarting_this_sync", False)
+        if job_status.start_time is None:
+            job_status.start_time = now
+
+        spec = replicas.get(jaxapi.REPLICA_TYPE_WORKER)
+        status = job_status.replica_statuses.get(jaxapi.REPLICA_TYPE_WORKER)
+        if spec is None or status is None:
+            return
+        expected = (spec.replicas or 0) - status.succeeded
+
+        if expected == 0:
+            # SPMD: every process ran the same program to completion.
+            msg = f"JAXJob {job.key()} successfully completed."
+            if job_status.completion_time is None:
+                job_status.completion_time = now
+            capi.update_job_conditions(
+                job_status,
+                capi.JOB_SUCCEEDED,
+                constants.job_reason(self.kind, constants.REASON_SUCCEEDED),
+                msg,
+                now=now,
+            )
+            self.cluster.record_event(
+                Event(
+                    type="Normal",
+                    reason=constants.job_reason(self.kind, constants.REASON_SUCCEEDED),
+                    message=msg,
+                    involved_object=f"{job.kind}/{job.key()}",
+                )
+            )
+            return
+
+        if status.active > 0 and not restarting:
+            capi.update_job_conditions(
+                job_status,
+                capi.JOB_RUNNING,
+                constants.job_reason(self.kind, constants.REASON_RUNNING),
+                f"JAXJob {job.key()} is running.",
+                now=now,
+            )
+
+        # Suppress Failed only for the sync that initiated a retryable
+        # restart; a stale Restarting condition must not mask a permanent
+        # failure of the recreated pod (it would wedge the job forever).
+        if status.failed > 0 and not restarting:
+            msg = (
+                f"JAXJob {job.key()} has failed because {status.failed} Worker "
+                "replica(s) failed."
+            )
+            if job_status.completion_time is None:
+                job_status.completion_time = now
+            capi.update_job_conditions(
+                job_status,
+                capi.JOB_FAILED,
+                constants.job_reason(self.kind, constants.REASON_FAILED),
+                msg,
+                now=now,
+            )
+            self.cluster.record_event(
+                Event(
+                    type="Normal",
+                    reason=constants.job_reason(self.kind, constants.REASON_FAILED),
+                    message=msg,
+                    involved_object=f"{job.kind}/{job.key()}",
+                )
+            )

@@ -1,0 +1,68 @@
+"""MXJob controller.
+
+Reference parity: pkg/controller.v1/mxnet/mxjob_controller.go — DMLC env
+injection (mxnet.go SetPodEnv incl. BytePS worker ids and TVM tuner labels)
+and scheduler-keyed status for train mode (UpdateJobStatus :340-420).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..api import mxjob as mxapi
+from ..api.common import JobStatus, ReplicaSpec
+from ..bootstrap import dmlc
+from . import register
+from ._master_status import update_master_based_status
+from .base import FrameworkController
+
+
+@register(mxapi.KIND)
+class MXController(FrameworkController):
+    kind = mxapi.KIND
+    default_container_name = mxapi.DEFAULT_CONTAINER_NAME
+    default_port_name = mxapi.DEFAULT_PORT_NAME
+    default_port = mxapi.DEFAULT_PORT
+
+    def set_cluster_spec(self, job, template, rtype: str, index: int) -> None:
+        env = dmlc.gen_env(job, rtype, index)
+        for container in template.spec.containers:
+            for name, value in env.items():
+                if container.get_env(name) is None:
+                    container.set_env(name, value)
+
+    def _completion_key(self, replicas: Dict[str, ReplicaSpec]) -> str:
+        """Train mode completes on the Scheduler; TVM tune mode on the
+        TunerTracker; fall back to Worker."""
+        for rt in (
+            mxapi.REPLICA_TYPE_SCHEDULER,
+            mxapi.REPLICA_TYPE_TUNER_TRACKER,
+            mxapi.REPLICA_TYPE_WORKER,
+        ):
+            if rt in replicas:
+                return rt
+        return next(iter(replicas), mxapi.REPLICA_TYPE_WORKER)
+
+    def is_master_role(self, replicas: Dict[str, ReplicaSpec], rtype: str, index: int) -> bool:
+        """reference mxjob_controller.go:449-452 (scheduler is master)"""
+        return rtype == mxapi.REPLICA_TYPE_SCHEDULER
+
+    def replica_order(self, replicas: Dict[str, ReplicaSpec]) -> List[str]:
+        order = [
+            mxapi.REPLICA_TYPE_SCHEDULER,
+            mxapi.REPLICA_TYPE_TUNER_TRACKER,
+            mxapi.REPLICA_TYPE_SERVER,
+            mxapi.REPLICA_TYPE_TUNER_SERVER,
+            mxapi.REPLICA_TYPE_WORKER,
+            mxapi.REPLICA_TYPE_TUNER,
+        ]
+        return [rt for rt in order if rt in replicas] + [
+            rt for rt in sorted(replicas) if rt not in order
+        ]
+
+    def update_job_status(
+        self, job, replicas: Dict[str, ReplicaSpec], job_status: JobStatus, pods
+    ) -> None:
+        update_master_based_status(
+            self, job, replicas, job_status, self._completion_key(replicas)
+        )

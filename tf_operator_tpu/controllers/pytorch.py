@@ -1,0 +1,51 @@
+"""PyTorchJob controller.
+
+Reference parity: pkg/controller.v1/pytorch/pytorchjob_controller.go —
+c10d env injection (pytorch.go SetPodEnv) and master-based status
+(UpdateJobStatus :317-399). Uses the engine's generic ReconcilePods (the
+reference's PyTorch controller does not override it either).
+
+Divergence (deliberate): a permanent exit code under ExitCode restart policy
+fails the job instead of leaving a stale Restarting condition (upstream sets
+Restarting for any failure under ExitCode, even unretryable ones).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..api import pytorchjob as ptapi
+from ..api.common import JobStatus, ReplicaSpec
+from ..bootstrap import c10d
+from . import register
+from ._master_status import update_master_based_status
+from .base import FrameworkController
+
+
+@register(ptapi.KIND)
+class PyTorchController(FrameworkController):
+    kind = ptapi.KIND
+    default_container_name = ptapi.DEFAULT_CONTAINER_NAME
+    default_port_name = ptapi.DEFAULT_PORT_NAME
+    default_port = ptapi.DEFAULT_PORT
+
+    def set_cluster_spec(self, job, template, rtype: str, index: int) -> None:
+        env = c10d.gen_env(job, rtype, index)
+        for container in template.spec.containers:
+            for name, value in env.items():
+                if container.get_env(name) is None:
+                    container.set_env(name, value)
+
+    def is_master_role(self, replicas: Dict[str, ReplicaSpec], rtype: str, index: int) -> bool:
+        return rtype == ptapi.REPLICA_TYPE_MASTER
+
+    def replica_order(self, replicas: Dict[str, ReplicaSpec]) -> List[str]:
+        order = [ptapi.REPLICA_TYPE_MASTER, ptapi.REPLICA_TYPE_WORKER]
+        return [rt for rt in order if rt in replicas] + [
+            rt for rt in sorted(replicas) if rt not in order
+        ]
+
+    def update_job_status(
+        self, job, replicas: Dict[str, ReplicaSpec], job_status: JobStatus, pods
+    ) -> None:
+        update_master_based_status(self, job, replicas, job_status, ptapi.REPLICA_TYPE_MASTER)

@@ -145,10 +145,12 @@ class TFController(FrameworkController):
                     )
 
             if failed > 0:
-                if capi.get_condition(job_status, capi.JOB_RESTARTING) is not None:
-                    # Restarting wins over Failed (reference :473-501). The
-                    # restart counter was already bumped by the engine's
-                    # on_job_restarting callback — don't double count.
+                if restarting:
+                    # Restarting wins over Failed for the sync that initiated
+                    # it (reference :473-501 checks the stale condition, but
+                    # that wedges a job whose recreated pod fails with a
+                    # permanent code before being seen Running; this-sync
+                    # scoping keeps the reference behavior without the hang).
                     pass
                 else:
                     msg = (

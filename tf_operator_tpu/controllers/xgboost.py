@@ -1,0 +1,47 @@
+"""XGBoostJob controller.
+
+Reference parity: pkg/controller.v1/xgboost/xgboostjob_controller.go —
+Rabit/LightGBM env injection (xgboost.go SetPodEnv) and master-based status
+(UpdateJobStatus :330-405).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..api import xgboostjob as xgbapi
+from ..api.common import JobStatus, ReplicaSpec
+from ..bootstrap import rabit
+from . import register
+from ._master_status import update_master_based_status
+from .base import FrameworkController
+
+
+@register(xgbapi.KIND)
+class XGBoostController(FrameworkController):
+    kind = xgbapi.KIND
+    default_container_name = xgbapi.DEFAULT_CONTAINER_NAME
+    default_port_name = xgbapi.DEFAULT_PORT_NAME
+    default_port = xgbapi.DEFAULT_PORT
+
+    def set_cluster_spec(self, job, template, rtype: str, index: int) -> None:
+        env = rabit.gen_env(job, rtype, index)
+        for container in template.spec.containers:
+            for name, value in env.items():
+                if container.get_env(name) is None:
+                    container.set_env(name, value)
+
+    def is_master_role(self, replicas: Dict[str, ReplicaSpec], rtype: str, index: int) -> bool:
+        """reference xgboostjob_controller.go:446-449"""
+        return rtype == xgbapi.REPLICA_TYPE_MASTER
+
+    def replica_order(self, replicas: Dict[str, ReplicaSpec]) -> List[str]:
+        order = [xgbapi.REPLICA_TYPE_MASTER, xgbapi.REPLICA_TYPE_WORKER]
+        return [rt for rt in order if rt in replicas] + [
+            rt for rt in sorted(replicas) if rt not in order
+        ]
+
+    def update_job_status(
+        self, job, replicas: Dict[str, ReplicaSpec], job_status: JobStatus, pods
+    ) -> None:
+        update_master_based_status(self, job, replicas, job_status, xgbapi.REPLICA_TYPE_MASTER)

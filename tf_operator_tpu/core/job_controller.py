@@ -143,6 +143,33 @@ class FrameworkHooks:
         semantics (TF: Chief,Eval,Master,PS,Worker) override."""
         return sorted(replicas.keys())
 
+    def gang_group_name(self, job: JobObject, rtype: str, index: int) -> str:
+        """Gang (pod group) a replica belongs to. Default: one gang per job
+        (the reference's PodGroup-per-job). The JAX controller groups per
+        pod-slice: a slice is all-or-nothing, but one free slice of a
+        multislice job may start while others queue."""
+        return job.name
+
+    def gang_groups(self, job: JobObject, replicas: Dict[str, ReplicaSpec], run_policy) -> List[dict]:
+        """PodGroup specs to ensure when gang scheduling is on."""
+        total = sum(spec.replicas or 0 for spec in replicas.values())
+        min_member = total
+        sp = run_policy.scheduling_policy
+        if sp is not None and sp.min_available is not None:
+            min_member = sp.min_available
+        return [
+            {
+                "apiVersion": "scheduling.volcano.sh/v1beta1",
+                "kind": "PodGroup",
+                "metadata": {"name": job.name, "namespace": job.namespace},
+                "spec": {
+                    "minMember": min_member,
+                    "queue": sp.queue if sp else "",
+                    "priorityClassName": sp.priority_class if sp else "",
+                },
+            }
+        ]
+
 
 @dataclass
 class EngineOptions:
@@ -238,7 +265,7 @@ class JobController:
             )
 
         if capi.is_finished(job.status):
-            self._handle_terminal_job(job, pods, run_policy)
+            self._handle_terminal_job(job, pods, replicas, run_policy)
             self._write_status_if_changed(job, old_status)
             return
 
@@ -401,7 +428,7 @@ class JobController:
 
         if self.options.enable_gang_scheduling:
             template.metadata.annotations[constants.ANNOTATION_GANG_GROUP_NAME] = (
-                self.gang_group_name(job, rtype, index)
+                self.hooks.gang_group_name(job, rtype, index)
             )
             template.metadata.annotations[constants.ANNOTATION_GANG_TASK_SPEC] = rtype.lower()
             template.spec.scheduler_name = self.options.gang_scheduler_name
@@ -414,11 +441,6 @@ class JobController:
             # create event that will never come (reference :828-833).
             self.expectations.creation_observed(key, "pods")
             raise
-
-    def gang_group_name(self, job: JobObject, rtype: str = "", index: int = 0) -> str:
-        """Gang (pod-group) a pod belongs to. Default: one gang per job, like
-        the reference. The JAX controller overrides grouping per slice."""
-        return job.name
 
     def _delete_pod(self, job: JobObject, pod: Pod) -> None:
         key = job.key()
@@ -531,7 +553,9 @@ class JobController:
         return restarts >= run_policy.backoff_limit
 
     # ------------------------------------------------------------ terminal
-    def _handle_terminal_job(self, job: JobObject, pods: List[Pod], run_policy) -> None:
+    def _handle_terminal_job(
+        self, job: JobObject, pods: List[Pod], replicas: Dict[str, ReplicaSpec], run_policy
+    ) -> None:
         """CleanPodPolicy + TTL GC once the job reached Succeeded/Failed."""
         self._delete_pods_and_services(job, pods, run_policy)
 
@@ -552,34 +576,26 @@ class JobController:
                 self.requeue(f"{job.kind}:{job.key()}", expiry - self.clock())
 
         if self.options.enable_gang_scheduling:
-            try:
-                self.cluster.delete_pod_group(job.namespace, job.name)
-            except Exception:
-                pass
+            for group in self.hooks.gang_groups(job, replicas, run_policy):
+                meta = group.get("metadata", {})
+                try:
+                    self.cluster.delete_pod_group(
+                        meta.get("namespace", job.namespace), meta["name"]
+                    )
+                except Exception:
+                    pass
 
     # ----------------------------------------------------------- pod group
     def _sync_pod_group(self, job: JobObject, replicas: Dict[str, ReplicaSpec], run_policy) -> None:
-        """Create the gang unit (volcano PodGroup analog; reference
-        SyncPodGroup via kubeflow/common when EnableGangScheduling)."""
-        total = sum(spec.replicas or 0 for spec in replicas.values())
-        min_member = total
-        sp = run_policy.scheduling_policy
-        if sp is not None and sp.min_available is not None:
-            min_member = sp.min_available
-        group = {
-            "apiVersion": "scheduling.volcano.sh/v1beta1",
-            "kind": "PodGroup",
-            "metadata": {"name": job.name, "namespace": job.namespace},
-            "spec": {
-                "minMember": min_member,
-                "queue": sp.queue if sp else "",
-                "priorityClassName": sp.priority_class if sp else "",
-            },
-        }
-        try:
-            self.cluster.get_pod_group(job.namespace, job.name)
-        except Exception:
-            self.cluster.create_pod_group(group)
+        """Create the gang unit(s) (volcano PodGroup analog; reference
+        SyncPodGroup via kubeflow/common when EnableGangScheduling). Groups
+        come from the hooks so the JAX controller can gang per slice."""
+        for group in self.hooks.gang_groups(job, replicas, run_policy):
+            meta = group.get("metadata", {})
+            try:
+                self.cluster.get_pod_group(meta.get("namespace", job.namespace), meta["name"])
+            except Exception:
+                self.cluster.create_pod_group(group)
 
     # -------------------------------------------------------------- status
     def _write_status_if_changed(self, job: JobObject, old_status: JobStatus) -> None:
