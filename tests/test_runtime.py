@@ -1,0 +1,151 @@
+"""Runtime shim: injected env → Topology → mesh (SURVEY.md §7 tier 2).
+
+Covers the contract end-to-end in one process: the env jaxdist.gen_env
+injects must be exactly what topology_from_env reconstructs — the analog of
+the reference's estimator_runconfig_tests (observed cluster spec == injected
+TF_CONFIG, py/kubeflow/tf_operator/estimator_runconfig_tests.py:25-100).
+"""
+
+import jax
+import pytest
+
+from tf_operator_tpu.api.common import ReplicaSpec
+from tf_operator_tpu.api.jaxjob import JAXJob, JAXJobSpec, TPUSpec, set_defaults
+from tf_operator_tpu.api.k8s import Container, PodSpec, PodTemplateSpec
+from tf_operator_tpu.bootstrap import jaxdist
+from tf_operator_tpu.runtime import (
+    Topology,
+    global_mesh,
+    initialize,
+    topology_from_env,
+    tpu_init,
+)
+
+
+def make_jaxjob(name="tj", replicas=None, tpu=None, num_slices=1, mesh=None):
+    from tf_operator_tpu.api.k8s import ObjectMeta
+
+    job = JAXJob(
+        metadata=ObjectMeta(name=name, namespace="ns"),
+        spec=JAXJobSpec(
+            jax_replica_specs={
+                "Worker": ReplicaSpec(
+                    replicas=replicas,
+                    template=PodTemplateSpec(
+                        spec=PodSpec(containers=[Container(name="jax", image="img")])
+                    ),
+                )
+            },
+            tpu=tpu,
+            num_slices=num_slices,
+            mesh=mesh or {},
+        ),
+    )
+    set_defaults(job)
+    return job
+
+
+class TestTopologyFromEnv:
+    def test_empty_env_is_local_mode(self):
+        topo = topology_from_env({})
+        assert topo.num_processes == 1
+        assert topo.process_id == 0
+        assert not topo.distributed
+        assert topo.is_coordinator
+
+    def test_roundtrip_through_injected_env(self):
+        job = make_jaxjob(replicas=8, tpu=TPUSpec(accelerator_type="v5e-32"),
+                          mesh={"fsdp": 8, "tp": 4})
+        env = jaxdist.gen_env(job, "Worker", 5)
+        topo = topology_from_env(env)
+        assert topo.num_processes == 8
+        assert topo.process_id == 5
+        assert topo.worker_id == 5  # one slice: worker_id == index
+        assert topo.accelerator_type == "v5e-32"
+        assert topo.mesh_axes == {"fsdp": 8, "tp": 4}
+        assert topo.distributed
+        assert not topo.is_coordinator
+        assert len(topo.worker_hostnames) == 8
+        assert topo.coordinator_address.startswith("tj-worker-0.ns.svc")
+
+    def test_multislice_roundtrip(self):
+        job = make_jaxjob(replicas=8, tpu=TPUSpec(accelerator_type="v5e-16"),
+                          num_slices=2)
+        env = jaxdist.gen_env(job, "Worker", 6)
+        topo = topology_from_env(env)
+        assert topo.num_slices == 2
+        assert topo.slice_index == 1
+        assert topo.worker_id == 2  # 6 % 4 hosts-per-slice
+        assert len(topo.worker_hostnames) == 4  # own slice only
+
+    def test_malformed_values_fall_back(self):
+        topo = topology_from_env(
+            {
+                jaxdist.ENV_NUM_PROCESSES: "not-a-number",
+                jaxdist.ENV_MESH_SPEC: "{broken json",
+            }
+        )
+        assert topo.num_processes == 1
+        assert topo.mesh_axes == {}
+
+
+class TestInitialize:
+    def test_local_mode_noop(self):
+        topo = initialize(Topology())
+        assert topo.num_processes == 1
+        # Safe to call again (idempotent).
+        initialize(Topology())
+
+
+class TestGlobalMesh:
+    def test_declared_mesh_matching_device_count(self):
+        n = jax.device_count()
+        topo = Topology(mesh_axes={"fsdp": n // 2, "tp": 2})
+        mesh = global_mesh(topo)
+        assert dict(mesh.shape) == {"fsdp": n // 2, "tp": 2}
+
+    def test_no_declared_axes_gives_fsdp_default(self):
+        mesh = global_mesh(Topology())
+        assert mesh.shape.get("fsdp") == jax.device_count()
+
+    def test_mismatched_declared_mesh_falls_back(self):
+        # A v5e-32 spec dev-run on 8 CPU devices must not crash.
+        topo = Topology(mesh_axes={"fsdp": 32})
+        mesh = global_mesh(topo)
+        assert mesh.size == jax.device_count()
+
+    def test_multislice_gets_slice_axis(self):
+        n = jax.device_count()
+        topo = Topology(num_slices=2, mesh_axes={"fsdp": n // 2})
+        mesh = global_mesh(topo)
+        assert mesh.shape.get("slice") == 2
+
+    def test_tpu_init_one_call(self):
+        topo, mesh = tpu_init()
+        assert topo.num_processes == 1
+        assert mesh.size == jax.device_count()
+
+
+class TestTrainOverRuntimeMesh:
+    """The mesh the shim builds must actually carry a sharded step."""
+
+    def test_train_step_on_global_mesh(self):
+        from tf_operator_tpu.models import llama
+        from tf_operator_tpu.train.train_step import (
+            init_train_state,
+            make_optimizer,
+            make_train_step,
+            place_state,
+        )
+        import jax.numpy as jnp
+
+        n = jax.device_count()
+        topo = Topology(mesh_axes={"fsdp": n})
+        mesh = global_mesh(topo)
+        model = llama.Llama(llama.CONFIGS["llama-tiny"])
+        opt = make_optimizer(warmup_steps=1, decay_steps=10)
+        state = init_train_state(model, jax.random.PRNGKey(0), opt, batch=n, seq=16)
+        step_fn, sharding = make_train_step(model, opt, mesh, state)
+        state = place_state(state, sharding)
+        state, loss = step_fn(state, jnp.zeros((n, 17), dtype=jnp.int32))
+        assert jnp.isfinite(loss)

@@ -1,0 +1,182 @@
+"""Pallas TPU flash attention (forward).
+
+Blockwise attention with an online softmax: K/V stream through VMEM one
+block at a time while running max/denominator/accumulator live in scratch,
+so the s×s score matrix never exists in HBM. The QKᵀ and PV contractions are
+MXU matmuls; accumulation is fp32 regardless of input dtype.
+
+Grid layout: (batch, q_heads, q_blocks, k_blocks) with the K dimension
+innermost — TPU grids execute the last axis sequentially on one core, which
+is exactly what the online-softmax recurrence needs. GQA is free: the K/V
+index maps collapse a group of query heads onto their shared KV head, so
+grouped heads reread the same K/V block from HBM instead of materializing a
+repeated tensor (the XLA fallback in attention.py pays that repeat).
+
+Causal jobs skip whole blocks above the diagonal (`pl.when`), halving the
+work; the diagonal block applies an iota row/col mask.
+
+The backward pass deliberately stays with XLA: `flash_attention` in
+attention.py is wrapped in `jax.checkpoint` policies by the train step, and
+recomputing the XLA forward for the VJP is within a few percent of a
+hand-written Pallas backward at the sizes we train (head_dim ≤ 128) —
+measured via bench.py before committing to kernel complexity.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Large-but-finite mask value: exp(x - x) on a fully-masked row must not
+# produce inf-inf = nan, so we avoid true -inf in the score matrix.
+MASK_VALUE = -1e30
+
+# Lane width — m/l scratch rows are padded to one full lane register.
+_LANES = 128
+
+
+def _block_size(want: int, total: int) -> int:
+    size = min(want, total)
+    while total % size:
+        size //= 2
+    return max(size, 1)
+
+
+def _flash_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    m_ref,
+    l_ref,
+    acc_ref,
+    *,
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    num_k_blocks: int,
+):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, MASK_VALUE)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _compute():
+        q = q_ref[0, :, 0, :].astype(jnp.float32) * scale
+        k = k_ref[0, :, 0, :].astype(jnp.float32)
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        # (block_q, block_k) scores on the MXU.
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            row = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            col = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(row >= col, s, MASK_VALUE)
+
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_ref[...] = jnp.broadcast_to(
+            alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True), l_ref.shape
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        # Skip blocks strictly above the diagonal: nothing in them is
+        # visible to any query row of this block.
+        visible = q_start + block_q - 1 >= k_start
+        pl.when(visible)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, :, 0, :] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def flash_attention_pallas(
+    q,
+    k,
+    v,
+    causal: bool = True,
+    block_q: int = 256,
+    block_k: int = 512,
+    interpret: bool = False,
+):
+    """BSHD flash attention. q: [b, s_q, h, d]; k/v: [b, s_k, h_kv, d] with
+    h % h_kv == 0 (GQA). Returns [b, s_q, h, d] in q.dtype."""
+    batch, s_q, heads, head_dim = q.shape
+    _, s_k, kv_heads, _ = k.shape
+    if heads % kv_heads:
+        raise ValueError(f"{heads} query heads not divisible by {kv_heads} KV heads")
+    if causal and s_q != s_k:
+        raise ValueError("causal flash kernel requires s_q == s_k (self-attention)")
+    groups = heads // kv_heads
+
+    block_q = _block_size(block_q, s_q)
+    block_k = _block_size(block_k, s_k)
+    num_q_blocks = s_q // block_q
+    num_k_blocks = s_k // block_k
+    grid = (batch, heads, num_q_blocks, num_k_blocks)
+
+    kernel = functools.partial(
+        _flash_kernel,
+        causal=causal,
+        scale=1.0 / (head_dim**0.5),
+        block_q=block_q,
+        block_k=block_k,
+        num_k_blocks=num_k_blocks,
+    )
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, block_q, 1, head_dim), lambda b, h, qi, ki: (b, qi, h, 0)
+            ),
+            pl.BlockSpec(
+                (1, block_k, 1, head_dim),
+                lambda b, h, qi, ki: (b, ki, h // groups, 0),
+            ),
+            pl.BlockSpec(
+                (1, block_k, 1, head_dim),
+                lambda b, h, qi, ki: (b, ki, h // groups, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, 1, head_dim), lambda b, h, qi, ki: (b, qi, h, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running denom l
+            pltpu.VMEM((block_q, head_dim), jnp.float32),  # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v)

@@ -1,0 +1,11 @@
+"""In-container runtime shim.
+
+Tier 2 of the build (SURVEY.md §7): the analog of the reference workloads'
+TF_CONFIG parsing (examples/tensorflow/dist-mnist/dist_mnist.py:102-143),
+done once here instead of in every training script — injected env →
+``jax.distributed.initialize`` → device mesh.
+"""
+
+from .tpu_init import Topology, global_mesh, initialize, topology_from_env, tpu_init
+
+__all__ = ["Topology", "global_mesh", "initialize", "topology_from_env", "tpu_init"]

@@ -1,0 +1,176 @@
+"""Turn operator-injected env into a live JAX distributed runtime + mesh.
+
+The operator's contract ends at env injection and DNS-stable service names
+(SURVEY.md §3.5); this module is the in-container half. Where the reference
+workload does ``json.loads(os.environ["TF_CONFIG"])`` then
+``tf.train.Server(cluster, job_name, task_index)``
+(examples/tensorflow/dist-mnist/dist_mnist.py:102-143), a JAXJob container
+does::
+
+    from tf_operator_tpu.runtime import tpu_init
+    topo, mesh = tpu_init()          # rendezvous + mesh, one call
+    ... pjit over mesh ...
+
+Env consumed (produced by bootstrap/jaxdist.py):
+  JAX_COORDINATOR_ADDRESS, JAX_NUM_PROCESSES, JAX_PROCESS_ID,
+  TPU_WORKER_ID, TPU_WORKER_HOSTNAMES, TPU_ACCELERATOR_TYPE, TPU_TOPOLOGY,
+  JAX_NUM_SLICES, JAX_SLICE_INDEX, JAX_MESH_SPEC, MEGASCALE_*.
+
+Everything degrades to single-process local mode when the env is absent, so
+the same training script runs unmodified on a dev box.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..bootstrap import jaxdist
+
+_initialized = False
+
+
+@dataclass(frozen=True)
+class Topology:
+    """The operator-declared view of this process and its slice."""
+
+    coordinator_address: Optional[str] = None
+    num_processes: int = 1
+    process_id: int = 0
+    worker_id: int = 0  # libtpu host ordinal within the slice
+    worker_hostnames: tuple = ()
+    accelerator_type: str = ""
+    tpu_topology: str = ""
+    num_slices: int = 1
+    slice_index: int = 0
+    mesh_axes: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def distributed(self) -> bool:
+        return self.num_processes > 1 and self.coordinator_address is not None
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+def topology_from_env(env: Optional[Dict[str, str]] = None) -> Topology:
+    """Parse the injected env; absent vars mean single-process local mode."""
+    env = os.environ if env is None else env
+
+    def _int(key: str, default: int) -> int:
+        raw = env.get(key)
+        try:
+            return int(raw) if raw is not None else default
+        except ValueError:
+            return default
+
+    mesh_axes: Dict[str, int] = {}
+    raw_mesh = env.get(jaxdist.ENV_MESH_SPEC)
+    if raw_mesh:
+        try:
+            parsed = json.loads(raw_mesh)
+            if isinstance(parsed, dict):
+                mesh_axes = {str(k): int(v) for k, v in parsed.items()}
+        except (ValueError, TypeError):
+            mesh_axes = {}
+
+    hostnames = tuple(
+        h for h in env.get(jaxdist.ENV_TPU_WORKER_HOSTNAMES, "").split(",") if h
+    )
+    return Topology(
+        coordinator_address=env.get(jaxdist.ENV_COORDINATOR_ADDRESS) or None,
+        num_processes=_int(jaxdist.ENV_NUM_PROCESSES, 1),
+        process_id=_int(jaxdist.ENV_PROCESS_ID, 0),
+        worker_id=_int(jaxdist.ENV_TPU_WORKER_ID, 0),
+        worker_hostnames=hostnames,
+        accelerator_type=env.get(jaxdist.ENV_TPU_ACCELERATOR_TYPE, ""),
+        tpu_topology=env.get(jaxdist.ENV_TPU_TOPOLOGY, ""),
+        num_slices=_int(jaxdist.ENV_NUM_SLICES, 1),
+        slice_index=_int(jaxdist.ENV_SLICE_INDEX, 0),
+        mesh_axes=mesh_axes,
+    )
+
+
+def initialize(
+    topology: Optional[Topology] = None,
+    *,
+    timeout_seconds: Optional[int] = None,
+) -> Topology:
+    """Rendezvous this process: ``jax.distributed.initialize`` against the
+    coordinator the operator published. Idempotent; a no-op single-process.
+
+    Must run before first device use — JAX's backend is frozen at first
+    touch, same constraint the reference's TF gRPC server has at
+    tf.train.Server construction time.
+    """
+    global _initialized
+    topo = topology or topology_from_env()
+    # Local mode must NOT latch: a pre-env probe call (import-time init, a
+    # notebook) would otherwise make the later real rendezvous a silent no-op.
+    if not topo.distributed or _initialized:
+        return topo
+
+    import jax
+
+    kwargs = dict(
+        coordinator_address=topo.coordinator_address,
+        num_processes=topo.num_processes,
+        process_id=topo.process_id,
+    )
+    if timeout_seconds is not None:
+        kwargs["initialization_timeout"] = timeout_seconds
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+    return topo
+
+
+def global_mesh(topology: Optional[Topology] = None):
+    """Build the Mesh the job declared (JAX_MESH_SPEC), over all devices.
+
+    Falls back to a pure-FSDP mesh (the LLM-training default) when the job
+    declared no axes. A multislice job gets its leading DCN ``slice`` axis
+    whether declared or not.
+    """
+    import jax
+
+    from ..parallel.mesh import MeshSpec, make_mesh, standard_mesh
+
+    topo = topology or topology_from_env()
+    n = jax.device_count()
+    axes = dict(topo.mesh_axes)
+    if topo.num_slices > 1 and "slice" not in axes:
+        axes["slice"] = topo.num_slices
+    if not axes:
+        return standard_mesh(n)
+    declared = 1
+    for size in axes.values():
+        declared *= size
+    if declared != n:
+        if jax.devices()[0].platform == "tpu":
+            # On real hardware a size mismatch is a misconfigured job
+            # (e.g. per-slice axes on a multislice spec), not a dev run —
+            # training on a silently different layout would be a sharding
+            # regression, so refuse.
+            raise ValueError(
+                f"declared mesh {axes} has {declared} devices but the TPU "
+                f"backend sees {n}; fix the job's mesh/numSlices"
+            )
+        # CPU dev run of a TPU-sized spec: fall back rather than crash.
+        import warnings
+
+        warnings.warn(
+            f"declared mesh {axes} needs {declared} devices, backend has {n}; "
+            f"falling back to a pure-FSDP mesh (CPU dev mode)",
+            stacklevel=2,
+        )
+        return standard_mesh(n)
+    return make_mesh(MeshSpec(axes))
+
+
+def tpu_init(*, timeout_seconds: Optional[int] = None):
+    """One-call bootstrap: returns (Topology, Mesh)."""
+    topo = initialize(timeout_seconds=timeout_seconds)
+    return topo, global_mesh(topo)
