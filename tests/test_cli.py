@@ -1,0 +1,243 @@
+"""Operator process (L4): flags, manager run loop, health/metrics HTTP,
+leader election, namespace scoping. Reference: cmd/training-operator.v1/
+main.go + cmd/tf-operator.v1/app/{server,options}."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from tf_operator_tpu.cli import (
+    LeaseLock,
+    OperatorManager,
+    OperatorOptions,
+    build_arg_parser,
+    options_from_args,
+)
+from tf_operator_tpu.cluster.memory import InMemoryCluster
+from tf_operator_tpu.metrics import Metrics
+
+
+def jaxjob_manifest(name="tj", namespace="default", replicas=2):
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "JAXJob",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "jaxReplicaSpecs": {
+                "Worker": {
+                    "replicas": replicas,
+                    "template": {"spec": {"containers": [{"name": "jax", "image": "i"}]}},
+                }
+            }
+        },
+    }
+
+
+def wait_for(predicate, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+class TestFlags:
+    def test_defaults_enable_all_schemes(self):
+        opts = options_from_args(build_arg_parser().parse_args([]))
+        manager = OperatorManager(InMemoryCluster(), opts, metrics=Metrics())
+        assert set(manager.controllers) == {
+            "TFJob", "PyTorchJob", "MXJob", "XGBoostJob", "JAXJob",
+        }
+
+    def test_enable_scheme_subset(self):
+        args = build_arg_parser().parse_args(
+            ["--enable-scheme", "JAXJob", "--enable-scheme", "TFJob"]
+        )
+        manager = OperatorManager(InMemoryCluster(), options_from_args(args), metrics=Metrics())
+        assert set(manager.controllers) == {"JAXJob", "TFJob"}
+
+    def test_unknown_scheme_rejected(self):
+        args = build_arg_parser().parse_args(["--enable-scheme", "CaffeJob"])
+        with pytest.raises(ValueError):
+            OperatorManager(InMemoryCluster(), options_from_args(args), metrics=Metrics())
+
+    def test_option_flags_parse(self):
+        args = build_arg_parser().parse_args(
+            [
+                "--namespace", "train", "--threadiness", "4",
+                "--resync-period", "5", "--leader-elect",
+                "--lease-duration", "3", "--bind-address", "127.0.0.1",
+                "--enable-gang-scheduling", "--gang-scheduler-name", "slice-sched",
+            ]
+        )
+        opts = options_from_args(args)
+        assert opts.namespace == "train"
+        assert opts.threadiness == 4
+        assert opts.resync_period == 5.0
+        assert opts.leader_elect
+        assert opts.lease_duration == 3.0
+        assert opts.bind_address == "127.0.0.1"
+        assert opts.enable_gang_scheduling
+        assert opts.gang_scheduler_name == "slice-sched"
+
+
+class TestManagerLifecycle:
+    def setup_method(self):
+        self.cluster = InMemoryCluster()
+        self.manager = OperatorManager(
+            self.cluster,
+            OperatorOptions(enabled_schemes=["JAXJob"], health_port=0, metrics_port=0, resync_period=0.2),
+            metrics=Metrics(),
+        )
+
+    def teardown_method(self):
+        self.manager.stop()
+
+    def test_reconciles_job_submitted_while_running(self):
+        self.manager.start()
+        assert self.manager.ready
+        self.cluster.create_job(jaxjob_manifest(replicas=2))
+        assert wait_for(lambda: len(self.cluster.list_pods("default")) == 2)
+        for pod in self.cluster.list_pods("default"):
+            self.cluster.set_pod_phase("default", pod.metadata.name, "Succeeded", exit_code=0)
+        def succeeded():
+            job = self.cluster.get_job("JAXJob", "default", "tj")
+            conds = (job.get("status") or {}).get("conditions") or []
+            return any(c["type"] == "Succeeded" and c["status"] == "True" for c in conds)
+        assert wait_for(succeeded)
+
+    def test_resync_picks_up_pre_existing_jobs(self):
+        # Job created BEFORE start: only the relist can find it.
+        self.cluster.create_job(jaxjob_manifest(name="early"))
+        self.manager.start()
+        assert wait_for(lambda: len(self.cluster.list_pods("default")) == 2)
+
+
+class TestNamespaceScoping:
+    def test_other_namespace_ignored(self):
+        cluster = InMemoryCluster()
+        manager = OperatorManager(
+            cluster,
+            OperatorOptions(enabled_schemes=["JAXJob"], namespace="train", health_port=0, metrics_port=0),
+            metrics=Metrics(),
+        )
+        try:
+            manager.start()
+            cluster.create_job(jaxjob_manifest(name="in-scope", namespace="train"))
+            cluster.create_job(jaxjob_manifest(name="out-of-scope", namespace="other"))
+            assert wait_for(lambda: len(cluster.list_pods("train")) == 2)
+            time.sleep(0.3)
+            assert cluster.list_pods("other") == []
+        finally:
+            manager.stop()
+
+
+class TestLeaderElection:
+    def test_single_manager_acquires(self):
+        metrics = Metrics()
+        manager = OperatorManager(
+            InMemoryCluster(),
+            OperatorOptions(enabled_schemes=["JAXJob"], leader_elect=True,
+                            lease_duration=0.5, health_port=0, metrics_port=0),
+            metrics=metrics,
+        )
+        try:
+            manager.start()
+            assert wait_for(lambda: manager.is_leader)
+            assert metrics.gauge_value("training_operator_is_leader") == 1.0
+        finally:
+            manager.stop()
+
+    def test_only_one_of_two_leads_and_failover(self):
+        lease = LeaseLock()
+        cluster = InMemoryCluster()
+        opts = OperatorOptions(enabled_schemes=["JAXJob"], leader_elect=True,
+                               lease_duration=0.3, health_port=0, metrics_port=0)
+        m1 = OperatorManager(cluster, opts, metrics=Metrics(), lease=lease, identity="a")
+        m2 = OperatorManager(cluster, opts, metrics=Metrics(), lease=lease, identity="b")
+        try:
+            m1.start()
+            assert wait_for(lambda: m1.is_leader)
+            m2.start()
+            time.sleep(0.5)
+            assert not m2.is_leader  # lease held by m1
+            m1.stop()  # releases the lease
+            assert wait_for(lambda: m2.is_leader, timeout=3.0)
+        finally:
+            m1.stop()
+            m2.stop()
+
+    def test_non_leader_does_not_reconcile(self):
+        lease = LeaseLock()
+        lease.try_acquire("someone-else", duration=60.0)
+        cluster = InMemoryCluster()
+        manager = OperatorManager(
+            cluster,
+            OperatorOptions(enabled_schemes=["JAXJob"], leader_elect=True,
+                            lease_duration=0.2, health_port=0, metrics_port=0),
+            metrics=Metrics(),
+            lease=lease,
+        )
+        try:
+            manager.start()
+            cluster.create_job(jaxjob_manifest())
+            time.sleep(0.5)
+            assert cluster.list_pods("default") == []
+        finally:
+            manager.stop()
+
+
+class TestHealthEndpoints:
+    def test_metrics_healthz_readyz(self):
+        metrics = Metrics()
+        manager = OperatorManager(
+            InMemoryCluster(),
+            OperatorOptions(enabled_schemes=["JAXJob"], health_port=0, metrics_port=0),
+            metrics=metrics,
+        )
+        # Health + metrics are separate servers (reference has separate
+        # --health-probe-bind-address / --metrics-bind-address); spin both on
+        # ephemeral ports directly.
+        import http.server, threading  # noqa: E401
+
+        from tf_operator_tpu.cli import _HealthHandler, _MetricsHandler
+
+        mhandler = type("M", (_MetricsHandler,), {"manager": manager})
+        mserver = http.server.ThreadingHTTPServer(("127.0.0.1", 0), mhandler)
+        mthread = threading.Thread(target=mserver.serve_forever, daemon=True)
+        mthread.start()
+        mbase = f"http://127.0.0.1:{mserver.server_address[1]}"
+
+        handler = type("H", (_HealthHandler,), {"manager": manager})
+        server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            manager.start()
+            metrics.created_inc("ns", "JAXJob")
+            body = urllib.request.urlopen(f"{mbase}/metrics").read().decode()
+            assert 'training_operator_jobs_created_total{job_namespace="ns",framework="JAXJob"} 1' in body
+            assert urllib.request.urlopen(f"{base}/healthz").status == 200
+            assert urllib.request.urlopen(f"{base}/readyz").status == 200
+            # Health server does NOT serve /metrics (separate binds).
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(f"{base}/metrics")
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(f"{base}/nope")
+        finally:
+            for s in (server, mserver):
+                s.shutdown()
+                s.server_close()
+            manager.stop()
+
+    def test_readyz_503_before_start(self):
+        manager = OperatorManager(
+            InMemoryCluster(),
+            OperatorOptions(enabled_schemes=["JAXJob"], health_port=0, metrics_port=0),
+            metrics=Metrics(),
+        )
+        assert not manager.ready

@@ -1,0 +1,142 @@
+"""Manifest generation (L6): schema shape, spec round-trip, RBAC/deployment
+completeness. Reference: manifests/base/** (SURVEY.md §2.8)."""
+
+import pytest
+
+from tf_operator_tpu.api import jaxjob, tfjob
+from tf_operator_tpu.manifests import generate_all, generate_crd, operator_manifests
+
+
+def schema_accepts(schema: dict, value) -> bool:
+    """Tiny structural-schema checker: enough to prove generated schemas
+    describe what the API layer serializes."""
+    if "x-kubernetes-preserve-unknown-fields" in schema and "type" not in schema:
+        return True
+    t = schema.get("type")
+    if t == "object":
+        if not isinstance(value, dict):
+            return False
+        props = schema.get("properties", {})
+        additional = schema.get("additionalProperties")
+        for key, sub in value.items():
+            if key in props:
+                if not schema_accepts(props[key], sub):
+                    return False
+            elif additional is not None:
+                if not schema_accepts(additional, sub):
+                    return False
+            elif not schema.get("x-kubernetes-preserve-unknown-fields"):
+                return False
+        return True
+    if t == "array":
+        return isinstance(value, list) and all(
+            schema_accepts(schema.get("items", {}), v) for v in value
+        )
+    if t == "string":
+        return isinstance(value, str)
+    if t == "integer":
+        return isinstance(value, int) and not isinstance(value, bool)
+    if t == "number":
+        return isinstance(value, (int, float)) and not isinstance(value, bool)
+    if t == "boolean":
+        return isinstance(value, bool)
+    return True
+
+
+def crd_spec_schema(module) -> dict:
+    crd = generate_crd(module)
+    version = crd["spec"]["versions"][0]
+    return version["schema"]["openAPIV3Schema"]["properties"]["spec"]
+
+
+class TestCRDGeneration:
+    def test_all_five_kinds_generated(self):
+        docs = generate_all()
+        crds = [k for k in docs if k.startswith("crds/")]
+        assert len(crds) == 5
+        assert "crds/kubeflow.org_jaxjobs" in docs
+
+    def test_crd_identity_fields(self):
+        crd = generate_crd(tfjob)
+        assert crd["metadata"]["name"] == "tfjobs.kubeflow.org"
+        assert crd["spec"]["names"]["kind"] == "TFJob"
+        version = crd["spec"]["versions"][0]
+        assert version["subresources"] == {"status": {}}
+        assert version["served"] and version["storage"]
+
+    def test_tfjob_schema_has_framework_fields(self):
+        spec = crd_spec_schema(tfjob)["properties"]
+        assert "tfReplicaSpecs" in spec
+        assert "successPolicy" in spec
+        assert "enableDynamicWorker" in spec
+        run_policy = spec["runPolicy"]["properties"]
+        assert {"cleanPodPolicy", "backoffLimit", "activeDeadlineSeconds",
+                "ttlSecondsAfterFinished", "schedulingPolicy"} <= set(run_policy)
+
+    def test_jaxjob_schema_has_tpu_fields(self):
+        spec = crd_spec_schema(jaxjob)["properties"]
+        assert {"tpu", "numSlices", "mesh"} <= set(spec)
+        tpu = spec["tpu"]["properties"]
+        assert {"acceleratorType", "topology", "chipsPerHost"} <= set(tpu)
+        assert spec["mesh"]["additionalProperties"]["type"] == "integer"
+
+    def test_schema_accepts_serialized_job(self):
+        from tf_operator_tpu.api import parse_job
+        from tf_operator_tpu.api.jaxjob import set_defaults
+
+        job = parse_job(
+            {
+                "apiVersion": "kubeflow.org/v1",
+                "kind": "JAXJob",
+                "metadata": {"name": "j", "namespace": "n"},
+                "spec": {
+                    "tpu": {"acceleratorType": "v5e-32", "topology": "4x8"},
+                    "numSlices": 2,
+                    "mesh": {"slice": 2, "fsdp": 8, "tp": 4},
+                    "jaxReplicaSpecs": {
+                        "Worker": {
+                            "template": {"spec": {"containers": [{"name": "jax", "image": "i"}]}}
+                        }
+                    },
+                },
+            }
+        )
+        set_defaults(job)
+        serialized = job.to_dict()["spec"]
+        assert schema_accepts(crd_spec_schema(jaxjob), serialized), serialized
+
+    def test_schema_rejects_wrong_types(self):
+        schema = crd_spec_schema(jaxjob)
+        assert not schema_accepts(schema, {"numSlices": "two"})
+        assert not schema_accepts(schema, {"unknownField": 1})
+
+
+class TestOperatorManifests:
+    def test_rbac_covers_all_plurals_and_status(self):
+        docs = operator_manifests()
+        role = next(d for d in docs if d["kind"] == "ClusterRole")
+        crd_rule = role["rules"][0]
+        for plural in ("tfjobs", "pytorchjobs", "mxjobs", "xgboostjobs", "jaxjobs"):
+            assert plural in crd_rule["resources"]
+            assert f"{plural}/status" in crd_rule["resources"]
+        core_rule = role["rules"][1]
+        assert {"pods", "services", "events"} <= set(core_rule["resources"])
+
+    def test_deployment_probes_and_entrypoint(self):
+        docs = operator_manifests()
+        deploy = next(d for d in docs if d["kind"] == "Deployment")
+        container = deploy["spec"]["template"]["spec"]["containers"][0]
+        assert container["command"] == ["python", "-m", "tf_operator_tpu"]
+        assert container["livenessProbe"]["httpGet"]["path"] == "/healthz"
+        assert container["readinessProbe"]["httpGet"]["path"] == "/readyz"
+
+    def test_yaml_round_trip(self, tmp_path):
+        import yaml
+
+        from tf_operator_tpu.manifests import write_manifests
+
+        paths = write_manifests(str(tmp_path))
+        assert len(paths) == 6
+        for path in paths:
+            docs = list(yaml.safe_load_all(open(path)))
+            assert docs and all(isinstance(d, dict) for d in docs)

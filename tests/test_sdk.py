@@ -1,0 +1,136 @@
+"""SDK (L7) tests: CRUD, waiting, status, pods, logs — against a live
+OperatorManager, mirroring the reference SDK e2e (sdk/python/test/test_e2e.py
+create → wait_for_job → get_logs → delete)."""
+
+import threading
+
+import pytest
+
+from tf_operator_tpu.cli import OperatorManager, OperatorOptions
+from tf_operator_tpu.cluster.memory import InMemoryCluster
+from tf_operator_tpu.metrics import Metrics
+from tf_operator_tpu.sdk import JAXJobClient, TFJobClient, TimeoutError, client_for
+
+
+def tfjob_manifest(name="mnist", workers=2, chief=False):
+    specs = {
+        "Worker": {
+            "replicas": workers,
+            "template": {"spec": {"containers": [{"name": "tensorflow", "image": "tf:1"}]}},
+        }
+    }
+    if chief:
+        specs["Chief"] = {
+            "replicas": 1,
+            "template": {"spec": {"containers": [{"name": "tensorflow", "image": "tf:1"}]}},
+        }
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "TFJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"tfReplicaSpecs": specs},
+    }
+
+
+class TestSDKAgainstLiveOperator:
+    def setup_method(self):
+        self.cluster = InMemoryCluster()
+        self.manager = OperatorManager(
+            self.cluster,
+            OperatorOptions(enabled_schemes=["TFJob", "JAXJob"], health_port=0, metrics_port=0,
+                            resync_period=0.2),
+            metrics=Metrics(),
+        )
+        self.manager.start()
+        self.client = TFJobClient(self.cluster)
+
+    def teardown_method(self):
+        self.manager.stop()
+
+    def _succeed_pods(self, namespace="default"):
+        for pod in self.cluster.list_pods(namespace):
+            self.cluster.set_pod_phase(namespace, pod.metadata.name, "Succeeded", exit_code=0)
+
+    def test_create_wait_logs_delete(self):
+        self.client.create(tfjob_manifest(workers=2))
+        self.client.wait_for_condition("mnist", ["Running", "Created"], timeout=10)
+
+        # Worker pods appear; complete them and wait for the job.
+        def pods_up():
+            return len(self.client.get_pod_names("mnist")) == 2
+
+        wait_until(pods_up)
+        self.cluster.append_pod_log("default", "mnist-worker-0", "step 100 loss 0.1\n")
+        self._succeed_pods()
+        job = self.client.wait_for_job("mnist", timeout=10)
+        assert self.client.is_job_succeeded("mnist")
+        assert not self.client.is_job_failed("mnist")
+        assert job["status"]["replicaStatuses"]["Worker"]["succeeded"] == 2
+
+        logs = self.client.get_logs("mnist", master=False)
+        assert logs["mnist-worker-0"] == "step 100 loss 0.1\n"
+        assert logs["mnist-worker-1"] == ""
+
+        self.client.delete("mnist")
+        self.client.wait_for_deletion("mnist", timeout=10)
+        with pytest.raises(KeyError):
+            self.client.get("mnist")
+
+    def test_pod_name_filters(self):
+        self.client.create(tfjob_manifest(workers=2, chief=True))
+        wait_until(lambda: len(self.client.get_pod_names("mnist")) == 3)
+        assert self.client.get_pod_names("mnist", master=True) == ["mnist-chief-0"]
+        assert self.client.get_pod_names("mnist", replica_type="Worker") == [
+            "mnist-worker-0", "mnist-worker-1",
+        ]
+        assert self.client.get_pod_names("mnist", replica_type="Worker", replica_index=1) == [
+            "mnist-worker-1",
+        ]
+        # get_logs defaults to master.
+        logs = self.client.get_logs("mnist")
+        assert list(logs) == ["mnist-chief-0"]
+
+    def test_patch_replicas(self):
+        self.client.create(tfjob_manifest(workers=1))
+        wait_until(lambda: len(self.client.get_pod_names("mnist")) == 1)
+        self.client.patch(
+            "mnist", {"spec": {"tfReplicaSpecs": {"Worker": {"replicas": 3}}}}
+        )
+        wait_until(lambda: len(self.client.get_pod_names("mnist")) == 3)
+
+    def test_wait_timeout_raises(self):
+        self.client.create(tfjob_manifest(workers=1))
+        with pytest.raises(TimeoutError):
+            self.client.wait_for_job("mnist", timeout=0.3)
+
+    def test_failed_job_status(self):
+        self.client.create(tfjob_manifest(workers=1))
+        wait_until(lambda: len(self.client.get_pod_names("mnist")) == 1)
+        self.cluster.set_pod_phase("default", "mnist-worker-0", "Failed", exit_code=1)
+        self.client.wait_for_condition("mnist", ["Failed"], timeout=10)
+        assert self.client.is_job_failed("mnist")
+        assert self.client.get_job_status("mnist") == "Failed"
+
+
+class TestClientConstruction:
+    def test_client_for(self):
+        cluster = InMemoryCluster()
+        assert isinstance(client_for("JAXJob", cluster), JAXJobClient)
+        with pytest.raises(ValueError):
+            client_for("CaffeJob", cluster)
+
+    def test_kind_mismatch_rejected(self):
+        client = TFJobClient(InMemoryCluster())
+        with pytest.raises(ValueError):
+            client.create({"kind": "JAXJob", "metadata": {"name": "x"}, "spec": {}})
+
+
+def wait_until(predicate, timeout=5.0, interval=0.02):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    assert predicate(), "condition not reached in time"

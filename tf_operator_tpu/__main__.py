@@ -1,0 +1,8 @@
+"""``python -m tf_operator_tpu`` — run the operator process."""
+
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

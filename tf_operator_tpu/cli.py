@@ -1,0 +1,396 @@
+"""Operator process: flags, controller manager, health/metrics endpoints,
+leader election.
+
+The L4 tier (SURVEY.md §2.1): the analog of cmd/training-operator.v1/main.go
+(scheme registration, --enable-scheme, metrics/health binds, leader elect,
+manager start) merged with the legacy server's namespace scoping, resync
+period, threadiness and gang flags (cmd/tf-operator.v1/app/options/
+options.go:27-83) — one binary, not the reference's dual stack (SURVEY.md §7
+anti-goals).
+
+Run: ``python -m tf_operator_tpu --enable-scheme JAXJob --namespace train``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from .cluster.base import Cluster
+from .controllers import SUPPORTED_CONTROLLERS, enabled_kinds
+from .core.job_controller import EngineOptions
+from .metrics import METRICS, Metrics
+
+log = logging.getLogger("tf_operator_tpu.operator")
+
+
+# ------------------------------------------------------------------ options
+
+
+@dataclass
+class OperatorOptions:
+    """Reference ServerOption (options.go:27-43) + new-binary flags
+    (main.go:62-75)."""
+
+    enabled_schemes: List[str] = field(default_factory=list)  # empty = all
+    namespace: str = ""  # empty = all namespaces
+    threadiness: int = 1
+    resync_period: float = 30.0
+    bind_address: str = "0.0.0.0"  # kubelet probes reach the pod IP, not loopback
+    metrics_port: int = 8443
+    health_port: int = 8081
+    leader_elect: bool = False
+    lease_duration: float = 15.0
+    enable_gang_scheduling: bool = False
+    gang_scheduler_name: str = "volcano"
+    json_log_format: bool = False
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tf-operator-tpu",
+        description="TPU-native training operator (control plane for "
+        "TFJob/PyTorchJob/MXJob/XGBoostJob/JAXJob).",
+    )
+    parser.add_argument(
+        "--enable-scheme",
+        action="append",
+        default=[],
+        metavar="KIND",
+        help="Job kind to enable (repeatable); default: all supported kinds.",
+    )
+    parser.add_argument("--namespace", default="", help="Restrict to one namespace (default: all).")
+    parser.add_argument("--threadiness", type=int, default=1, help="Worker threads per controller.")
+    parser.add_argument("--resync-period", type=float, default=30.0, help="Full relist/resync seconds.")
+    parser.add_argument("--bind-address", default="0.0.0.0", help="Address metrics/health servers bind.")
+    parser.add_argument("--metrics-port", type=int, default=8443, help="Prometheus /metrics port (0 = off).")
+    parser.add_argument("--health-port", type=int, default=8081, help="/healthz,/readyz port (0 = off).")
+    parser.add_argument("--leader-elect", action="store_true", help="Require leadership before reconciling.")
+    parser.add_argument("--lease-duration", type=float, default=15.0, help="Leader lease seconds.")
+    parser.add_argument("--enable-gang-scheduling", action="store_true")
+    parser.add_argument("--gang-scheduler-name", default="volcano")
+    parser.add_argument("--json-log-format", action="store_true")
+    return parser
+
+
+def options_from_args(args: argparse.Namespace) -> OperatorOptions:
+    return OperatorOptions(
+        enabled_schemes=list(args.enable_scheme),
+        namespace=args.namespace,
+        threadiness=args.threadiness,
+        resync_period=args.resync_period,
+        bind_address=args.bind_address,
+        metrics_port=args.metrics_port,
+        health_port=args.health_port,
+        leader_elect=args.leader_elect,
+        lease_duration=args.lease_duration,
+        enable_gang_scheduling=args.enable_gang_scheduling,
+        gang_scheduler_name=args.gang_scheduler_name,
+        json_log_format=args.json_log_format,
+    )
+
+
+# ----------------------------------------------------------- leader election
+
+
+class LeaseLock:
+    """A shared lease multiple operator replicas compete for — the analog of
+    the reference's EndpointsLock election (server.go:168-196). Replicas in
+    one process (or tests) share the object; the holder renews, others watch
+    for expiry."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._holder: Optional[str] = None
+        self._expires: float = 0.0
+
+    def try_acquire(self, identity: str, duration: float) -> bool:
+        with self._lock:
+            now = self._clock()
+            if self._holder in (None, identity) or now >= self._expires:
+                self._holder = identity
+                self._expires = now + duration
+                return True
+            return False
+
+    def release(self, identity: str) -> None:
+        with self._lock:
+            if self._holder == identity:
+                self._holder = None
+                self._expires = 0.0
+
+    @property
+    def holder(self) -> Optional[str]:
+        with self._lock:
+            if self._clock() >= self._expires:
+                return None
+            return self._holder
+
+
+# ------------------------------------------------------------ health server
+
+
+class _BaseHandler(BaseHTTPRequestHandler):
+    manager: "OperatorManager"
+
+    def _respond(self, code: int, body: str, content_type: str = "text/plain") -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, fmt, *args):  # silence per-request stderr noise
+        log.debug("http: " + fmt, *args)
+
+
+class _HealthHandler(_BaseHandler):
+    """/healthz + /readyz on --health-port (reference main.go:110-117)."""
+
+    def do_GET(self):  # noqa: N802 (stdlib API)
+        if self.path.startswith("/healthz"):
+            self._respond(200, "ok")
+        elif self.path.startswith("/readyz"):
+            ready = self.manager.ready
+            self._respond(200 if ready else 503, "ok" if ready else "not ready")
+        else:
+            self._respond(404, "not found")
+
+
+class _MetricsHandler(_BaseHandler):
+    """Prometheus /metrics on --metrics-port (reference --metrics-bind-address)."""
+
+    def do_GET(self):  # noqa: N802 (stdlib API)
+        if self.path.startswith("/metrics"):
+            self._respond(200, self.manager.metrics.render(), "text/plain; version=0.0.4")
+        else:
+            self._respond(404, "not found")
+
+
+# ----------------------------------------------------------------- manager
+
+
+class OperatorManager:
+    """Hosts one controller per enabled kind and drains their workqueues —
+    the controller-runtime Manager analog (main.go:78-120)."""
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        options: Optional[OperatorOptions] = None,
+        metrics: Optional[Metrics] = None,
+        lease: Optional[LeaseLock] = None,
+        identity: Optional[str] = None,
+    ):
+        self.cluster = cluster
+        self.options = options or OperatorOptions()
+        self.metrics = metrics if metrics is not None else METRICS
+        self.lease = lease or LeaseLock()
+        self.identity = identity or f"operator-{uuid.uuid4().hex[:8]}"
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._metrics_server: Optional[ThreadingHTTPServer] = None
+        self._started = False
+        self._is_leader = not self.options.leader_elect
+
+        engine_options = EngineOptions(
+            enable_gang_scheduling=self.options.enable_gang_scheduling,
+            gang_scheduler_name=self.options.gang_scheduler_name,
+        )
+        self.controllers: Dict[str, object] = {}
+        for kind in enabled_kinds(self.options.enabled_schemes):
+            self.controllers[kind] = SUPPORTED_CONTROLLERS[kind](
+                cluster,
+                options=engine_options,
+                metrics=self.metrics,
+                namespace=self.options.namespace,
+            )
+        self._set_leader_gauge()
+
+    # ------------------------------------------------------------- status
+    @property
+    def ready(self) -> bool:
+        return self._started and not self._stop.is_set()
+
+    @property
+    def is_leader(self) -> bool:
+        return self._is_leader
+
+    def _set_leader_gauge(self) -> None:
+        self.metrics.set_gauge("training_operator_is_leader", 1.0 if self._is_leader else 0.0)
+
+    # ---------------------------------------------------------- run loops
+    def _elect_loop(self) -> None:
+        duration = self.options.lease_duration
+        while not self._stop.is_set():
+            acquired = self.lease.try_acquire(self.identity, duration)
+            if acquired != self._is_leader:
+                self._is_leader = acquired
+                self._set_leader_gauge()
+                log.info(
+                    "leadership %s (%s)",
+                    "acquired" if acquired else "lost",
+                    self.identity,
+                )
+            self._stop.wait(duration / 3.0)
+        self.lease.release(self.identity)
+
+    def _worker_loop(self, kind: str) -> None:
+        controller = self.controllers[kind]
+        while not self._stop.is_set():
+            if not self._is_leader:
+                self._stop.wait(0.05)
+                continue
+            controller.process_next(timeout=0.1)
+
+    def _resync_loop(self) -> None:
+        """Periodic full relist: re-enqueue every job of every enabled kind
+        (reference resync period, options.go:24). Also the safety net for
+        dropped watch events."""
+        while not self._stop.is_set():
+            self._stop.wait(self.options.resync_period)
+            if self._stop.is_set():
+                return
+            self.resync_once()
+
+    def resync_once(self) -> None:
+        namespace = self.options.namespace or None
+        for kind, controller in self.controllers.items():
+            for job in self.cluster.list_jobs(kind, namespace):
+                meta = job.get("metadata", {})
+                controller.queue.add(
+                    f"{kind}:{meta.get('namespace', 'default')}/{meta.get('name', '')}"
+                )
+
+    # --------------------------------------------------------- http server
+    def _serve(self, handler_cls, port: int) -> Optional[ThreadingHTTPServer]:
+        if port < 0:
+            return None
+        handler = type("Handler", (handler_cls,), {"manager": self})
+        server = ThreadingHTTPServer((self.options.bind_address, port), handler)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        self._threads.append(thread)
+        return server
+
+    def _start_http_servers(self) -> None:
+        # 0 disables a server; port 0 is "disabled" rather than "ephemeral"
+        # to match the reference's bind-address flags.
+        if self.options.health_port > 0:
+            self._server = self._serve(_HealthHandler, self.options.health_port)
+        if self.options.metrics_port > 0:
+            self._metrics_server = self._serve(_MetricsHandler, self.options.metrics_port)
+
+    @property
+    def health_address(self) -> Optional[str]:
+        if self._server is None:
+            return None
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    @property
+    def metrics_address(self) -> Optional[str]:
+        if self._metrics_server is None:
+            return None
+        host, port = self._metrics_server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        if self._started:
+            return
+        if self.options.leader_elect:
+            thread = threading.Thread(target=self._elect_loop, daemon=True)
+            thread.start()
+            self._threads.append(thread)
+        for kind in self.controllers:
+            for _ in range(max(1, self.options.threadiness)):
+                thread = threading.Thread(target=self._worker_loop, args=(kind,), daemon=True)
+                thread.start()
+                self._threads.append(thread)
+        thread = threading.Thread(target=self._resync_loop, daemon=True)
+        thread.start()
+        self._threads.append(thread)
+        self._start_http_servers()
+        self.resync_once()
+        self._started = True
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        for server in (self._server, self._metrics_server):
+            if server is not None:
+                server.shutdown()
+                server.server_close()
+        for thread in self._threads:
+            thread.join(timeout=timeout)
+        self._started = False
+
+    def run_forever(self) -> None:
+        self.start()
+        try:
+            while not self._stop.is_set():
+                time.sleep(0.5)
+        except KeyboardInterrupt:
+            log.info("shutting down")
+        finally:
+            self.stop()
+
+
+# -------------------------------------------------------------------- main
+
+
+def _setup_logging(json_format: bool) -> None:
+    if json_format:
+
+        class JsonFormatter(logging.Formatter):
+            def format(self, record):
+                return json.dumps(
+                    {
+                        "level": record.levelname.lower(),
+                        "time": self.formatTime(record),
+                        "logger": record.name,
+                        "msg": record.getMessage(),
+                    }
+                )
+
+        handler = logging.StreamHandler()
+        handler.setFormatter(JsonFormatter())
+        logging.basicConfig(level=logging.INFO, handlers=[handler], force=True)
+    else:
+        logging.basicConfig(
+            level=logging.INFO,
+            format="%(asctime)s %(levelname)s %(name)s %(filename)s:%(lineno)d %(message)s",
+            force=True,
+        )
+
+
+def main(argv: Optional[List[str]] = None, cluster: Optional[Cluster] = None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    options = options_from_args(args)
+    _setup_logging(options.json_log_format)
+    if cluster is None:
+        # Out of the box the process manages the in-repo cluster runtime; a
+        # real kube-apiserver backend plugs in through the same Cluster
+        # interface (cluster/base.py).
+        from .cluster.memory import InMemoryCluster
+
+        cluster = InMemoryCluster()
+    manager = OperatorManager(cluster, options)
+    log.info(
+        "starting operator: kinds=%s namespace=%s gang=%s",
+        list(manager.controllers),
+        options.namespace or "<all>",
+        options.enable_gang_scheduling,
+    )
+    manager.run_forever()
+    return 0

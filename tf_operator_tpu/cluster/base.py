@@ -61,6 +61,10 @@ class Cluster:
     def update_pod(self, pod: Pod) -> Pod:
         raise NotImplementedError
 
+    def get_pod_log(self, namespace: str, name: str) -> str:
+        """Container log text for a pod (SDK get_logs; kube `pods/log`)."""
+        raise NotImplementedError
+
     def delete_pod(self, namespace: str, name: str) -> None:
         raise NotImplementedError
 

@@ -52,6 +52,7 @@ class InMemoryCluster(base.Cluster):
         self._watchers: Dict[str, List[base.WatchHandler]] = {}
         # pod name -> behavior fn(pod) called on each step() while running
         self._behaviors: Dict[Tuple[str, str], Callable[[Pod], None]] = {}
+        self._pod_logs: Dict[Tuple[str, str], str] = {}
 
     # ------------------------------------------------------------------ util
     def _emit(self, kind: str, event_type: str, obj) -> None:
@@ -173,10 +174,26 @@ class InMemoryCluster(base.Cluster):
         self._emit("pods", MODIFIED, out)
         return out
 
+    def append_pod_log(self, namespace: str, name: str, text: str) -> None:
+        """Test/workload hook: emulate container stdout for get_pod_log."""
+        with self._lock:
+            if (namespace, name) not in self._pods:
+                raise NotFound(f"pod {namespace}/{name}")
+            self._pod_logs[(namespace, name)] = (
+                self._pod_logs.get((namespace, name), "") + text
+            )
+
+    def get_pod_log(self, namespace: str, name: str) -> str:
+        with self._lock:
+            if (namespace, name) not in self._pods:
+                raise NotFound(f"pod {namespace}/{name}")
+            return self._pod_logs.get((namespace, name), "")
+
     def delete_pod(self, namespace: str, name: str) -> None:
         with self._lock:
             pod = self._pods.pop((namespace, name), None)
             self._behaviors.pop((namespace, name), None)
+            self._pod_logs.pop((namespace, name), None)
             if pod is None:
                 raise NotFound(f"pod {namespace}/{name}")
         self._emit("pods", DELETED, pod)

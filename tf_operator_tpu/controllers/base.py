@@ -33,9 +33,12 @@ class FrameworkController(FrameworkHooks):
         options: Optional[EngineOptions] = None,
         clock=time.time,
         metrics=None,
+        namespace: str = "",
     ):
         self.cluster = cluster
         self.queue = queue or WorkQueue()
+        # Namespace scoping (legacy --namespace, options.go:36): empty = all.
+        self.namespace = namespace
         self.clock = clock
         if metrics is None:
             from ..metrics import METRICS
@@ -66,10 +69,16 @@ class FrameworkController(FrameworkHooks):
         self.cluster.watch("services", self._on_dependent_event("services"))
 
     def _enqueue(self, namespace: str, name: str) -> None:
+        if self.namespace and namespace != self.namespace:
+            return
         self.queue.add(f"{self.kind}:{namespace}/{name}")
 
     def _on_job_event(self, event_type: str, job_dict: dict) -> None:
         meta = job_dict.get("metadata", {})
+        if self.namespace and meta.get("namespace", "default") != self.namespace:
+            # Out of scope entirely — a scoped informer would never deliver
+            # this event, so neither metrics nor the queue may see it.
+            return
         if event_type == ADDED:
             self.metrics.created_inc(meta.get("namespace", "default"), self.kind)
         if event_type == DELETED:
@@ -79,6 +88,8 @@ class FrameworkController(FrameworkHooks):
 
     def _on_dependent_event(self, dependent_kind: str):
         def handler(event_type: str, obj) -> None:
+            if self.namespace and obj.metadata.namespace != self.namespace:
+                return
             ref = obj.metadata.controller_ref()
             labels = obj.metadata.labels
             if labels.get(constants.LABEL_GROUP_NAME) != constants.GROUP_NAME:

@@ -1,0 +1,8 @@
+"""Deployment packaging (L6): CRD + operator manifests, generated from the
+API dataclasses. Reference: manifests/base/** (controller-gen output +
+kustomize); here generation is first-party (`python -m
+tf_operator_tpu.manifests`)."""
+
+from .gen import generate_all, generate_crd, operator_manifests, write_manifests
+
+__all__ = ["generate_crd", "generate_all", "operator_manifests", "write_manifests"]

@@ -1,0 +1,264 @@
+"""CRD + deployment manifest generation.
+
+The reference ships controller-gen output (manifests/base/crds/*.yaml,
+~6.9k lines per kind) plus kustomize bases for the Deployment/Service/RBAC
+(manifests/base/*.yaml, SURVEY.md §2.8). Here the openAPIV3Schema is derived
+directly from the API dataclasses, so the schema can never drift from the
+code; the embedded PodTemplateSpec is declared with
+``x-kubernetes-preserve-unknown-fields`` instead of inlining the entire
+core/v1 schema (the one deliberate divergence — the reference's 6.9k-line
+flattened pod schema adds no validation the apiserver doesn't already do).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, Dict, List, Optional, Union
+
+from ..api import jaxjob, mxjob, pytorchjob, tfjob, xgboostjob
+from ..api.k8s import PodTemplateSpec, _to_camel
+
+_KIND_MODULES = (tfjob, pytorchjob, mxjob, xgboostjob, jaxjob)
+
+_PRIMITIVES = {
+    str: {"type": "string"},
+    int: {"type": "integer"},
+    float: {"type": "number"},
+    bool: {"type": "boolean"},
+}
+
+
+def _schema_for_type(tp: Any) -> Dict[str, Any]:
+    origin = typing.get_origin(tp)
+    args = typing.get_args(tp)
+    if origin is Union:  # Optional[T] and friends
+        non_none = [a for a in args if a is not type(None)]
+        if len(non_none) == 1:
+            return _schema_for_type(non_none[0])
+        return {"x-kubernetes-preserve-unknown-fields": True}
+    if origin in (dict, Dict):
+        value_schema = _schema_for_type(args[1]) if len(args) == 2 else {}
+        return {"type": "object", "additionalProperties": value_schema}
+    if origin in (list, List):
+        item_schema = _schema_for_type(args[0]) if args else {}
+        return {"type": "array", "items": item_schema}
+    if tp in _PRIMITIVES:
+        return dict(_PRIMITIVES[tp])
+    if tp is Any or tp is object:
+        return {"x-kubernetes-preserve-unknown-fields": True}
+    if dataclasses.is_dataclass(tp):
+        if tp is PodTemplateSpec:
+            # Embedded pod template: defer validation to the apiserver.
+            return {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+        return dataclass_schema(tp)
+    return {"x-kubernetes-preserve-unknown-fields": True}
+
+
+def dataclass_schema(cls: type) -> Dict[str, Any]:
+    """openAPI v3 structural schema for a dataclass tree."""
+    hints = typing.get_type_hints(cls)
+    properties = {}
+    for f in dataclasses.fields(cls):
+        key = f.metadata.get("json", _to_camel(f.name))
+        properties[key] = _schema_for_type(hints.get(f.name, Any))
+    return {"type": "object", "properties": properties}
+
+
+def generate_crd(module) -> Dict[str, Any]:
+    """CustomResourceDefinition manifest for one job kind module."""
+    spec_cls = getattr(module, f"{module.KIND}Spec")
+    from ..api.common import JobStatus
+
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{module.PLURAL}.{module.GROUP}"},
+        "spec": {
+            "group": module.GROUP,
+            "names": {
+                "kind": module.KIND,
+                "plural": module.PLURAL,
+                "singular": module.SINGULAR,
+            },
+            "scope": "Namespaced",
+            "versions": [
+                {
+                    "name": module.VERSION,
+                    "served": True,
+                    "storage": True,
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "properties": {
+                                "apiVersion": {"type": "string"},
+                                "kind": {"type": "string"},
+                                "metadata": {"type": "object"},
+                                "spec": dataclass_schema(spec_cls),
+                                "status": dataclass_schema(JobStatus),
+                            },
+                        }
+                    },
+                    "subresources": {"status": {}},
+                    "additionalPrinterColumns": [
+                        {
+                            "name": "State",
+                            "type": "string",
+                            "jsonPath": ".status.conditions[-1:].type",
+                        },
+                        {
+                            "name": "Age",
+                            "type": "date",
+                            "jsonPath": ".metadata.creationTimestamp",
+                        },
+                    ],
+                }
+            ],
+        },
+    }
+
+
+def operator_manifests(namespace: str = "kubeflow") -> List[Dict[str, Any]]:
+    """Deployment + Service + RBAC for the operator process (reference
+    manifests/base/{deployment,service,cluster-role,service-account}.yaml)."""
+    labels = {"control-plane": "tf-operator-tpu"}
+    return [
+        {
+            "apiVersion": "v1",
+            "kind": "ServiceAccount",
+            "metadata": {"name": "tf-operator-tpu", "namespace": namespace},
+        },
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRole",
+            "metadata": {"name": "tf-operator-tpu-role"},
+            "rules": [
+                {
+                    "apiGroups": ["kubeflow.org"],
+                    "resources": [
+                        f"{m.PLURAL}" for m in _KIND_MODULES
+                    ] + [f"{m.PLURAL}/status" for m in _KIND_MODULES],
+                    "verbs": ["create", "delete", "get", "list", "patch", "update", "watch"],
+                },
+                {
+                    "apiGroups": [""],
+                    "resources": ["pods", "services", "endpoints", "events"],
+                    "verbs": ["create", "delete", "get", "list", "patch", "update", "watch"],
+                },
+                {
+                    # Gang scheduling: pod-slice gangs materialize as PodGroups
+                    # (volcano analog; reference cluster-role.yaml podgroups rule).
+                    "apiGroups": ["scheduling.volcano.sh"],
+                    "resources": ["podgroups"],
+                    "verbs": ["create", "delete", "get", "list", "update", "watch"],
+                },
+            ],
+        },
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRoleBinding",
+            "metadata": {"name": "tf-operator-tpu-rolebinding"},
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "ClusterRole",
+                "name": "tf-operator-tpu-role",
+            },
+            "subjects": [
+                {"kind": "ServiceAccount", "name": "tf-operator-tpu", "namespace": namespace}
+            ],
+        },
+        {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": "tf-operator-tpu", "namespace": namespace, "labels": labels},
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": labels},
+                "template": {
+                    "metadata": {"labels": labels},
+                    "spec": {
+                        "serviceAccountName": "tf-operator-tpu",
+                        "containers": [
+                            {
+                                "name": "operator",
+                                "image": "tf-operator-tpu:latest",
+                                "command": ["python", "-m", "tf_operator_tpu"],
+                                "ports": [
+                                    {"containerPort": 8443, "name": "metrics"},
+                                    {"containerPort": 8081, "name": "health"},
+                                ],
+                                "livenessProbe": {
+                                    "httpGet": {"path": "/healthz", "port": 8081},
+                                    "initialDelaySeconds": 15,
+                                    "periodSeconds": 20,
+                                },
+                                "readinessProbe": {
+                                    "httpGet": {"path": "/readyz", "port": 8081},
+                                    "initialDelaySeconds": 5,
+                                    "periodSeconds": 10,
+                                },
+                                "resources": {
+                                    "limits": {"cpu": "500m", "memory": "128Mi"},
+                                    "requests": {"cpu": "100m", "memory": "64Mi"},
+                                },
+                                "securityContext": {"allowPrivilegeEscalation": False},
+                            }
+                        ],
+                    },
+                },
+            },
+        },
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": "tf-operator-tpu-metrics",
+                "namespace": namespace,
+                "labels": labels,
+                "annotations": {
+                    "prometheus.io/scrape": "true",
+                    "prometheus.io/port": "8443",
+                    "prometheus.io/path": "/metrics",
+                },
+            },
+            "spec": {
+                "selector": labels,
+                "ports": [{"name": "metrics", "port": 8443, "targetPort": 8443}],
+            },
+        },
+    ]
+
+
+def generate_all() -> Dict[str, List[Dict[str, Any]]]:
+    """All manifests: filename stem -> list of documents."""
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    for module in _KIND_MODULES:
+        out[f"crds/{module.GROUP}_{module.PLURAL}"] = [generate_crd(module)]
+    out["operator"] = operator_manifests()
+    return out
+
+
+def write_manifests(outdir: str) -> List[str]:
+    import os
+
+    import yaml
+
+    written = []
+    for stem, docs in generate_all().items():
+        path = os.path.join(outdir, f"{stem}.yaml")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as fh:
+            yaml.safe_dump_all(docs, fh, sort_keys=False)
+        written.append(path)
+    return written
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="Generate CRD + operator manifests.")
+    parser.add_argument("--outdir", default="manifests")
+    args = parser.parse_args(argv)
+    for path in write_manifests(args.outdir):
+        print(path)
+    return 0

@@ -36,6 +36,9 @@ class Metrics:
             "training_operator_job_startup_seconds": defaultdict(list),
             "training_operator_job_restart_seconds": defaultdict(list),
         }
+        # Unlabeled gauges: leader flag etc. (legacy tf_operator_is_leader,
+        # cmd/tf-operator.v1/app/server.go:66-70).
+        self._gauges: Dict[str, float] = {}
 
     def _inc(self, name: str, namespace: str, framework: str) -> None:
         with self._lock:
@@ -72,6 +75,14 @@ class Metrics:
         with self._lock:
             self._histograms["training_operator_job_restart_seconds"][(namespace, framework)].append(seconds)
 
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def gauge_value(self, name: str) -> float:
+        with self._lock:
+            return self._gauges.get(name, 0.0)
+
     def counter_value(self, name: str, namespace: str, framework: str) -> int:
         with self._lock:
             return self._counters[name][(namespace, framework)]
@@ -97,6 +108,10 @@ class Metrics:
                     lines.append(f'{name}_bucket{{{label},le="+Inf"}} {len(samples)}')
                     lines.append(f"{name}_sum{{{label}}} {sum(samples)}")
                     lines.append(f"{name}_count{{{label}}} {len(samples)}")
+            for name, value in sorted(self._gauges.items()):
+                lines.append(f"# HELP {name} {name.replace('_', ' ')}")
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {value:g}")
         return "\n".join(lines) + "\n"
 
 

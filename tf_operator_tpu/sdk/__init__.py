@@ -1,0 +1,27 @@
+"""Client SDK (L7) — programmatic job submission and monitoring.
+
+The analog of the reference's Python SDK (sdk/python/kubeflow/tfjob):
+``TFJobClient`` and friends built on one generic ``JobClient``.
+"""
+
+from .client import (
+    JAXJobClient,
+    JobClient,
+    MXJobClient,
+    PyTorchJobClient,
+    TFJobClient,
+    TimeoutError,
+    XGBoostJobClient,
+    client_for,
+)
+
+__all__ = [
+    "JobClient",
+    "TFJobClient",
+    "PyTorchJobClient",
+    "MXJobClient",
+    "XGBoostJobClient",
+    "JAXJobClient",
+    "client_for",
+    "TimeoutError",
+]
