@@ -268,8 +268,8 @@ class OperatorManager:
         for kind, controller in self.controllers.items():
             for job in self.cluster.list_jobs(kind, namespace):
                 meta = job.get("metadata", {})
-                controller.queue.add(
-                    f"{kind}:{meta.get('namespace', 'default')}/{meta.get('name', '')}"
+                controller._enqueue(
+                    meta.get("namespace", "default"), meta.get("name", "")
                 )
 
     # --------------------------------------------------------- http server
@@ -309,6 +309,10 @@ class OperatorManager:
     def start(self) -> None:
         if self._started:
             return
+        # Support stop() -> start() cycles: a set _stop Event would make
+        # every new loop thread exit on its first check.
+        self._stop.clear()
+        self._threads = []
         if self.options.leader_elect:
             thread = threading.Thread(target=self._elect_loop, daemon=True)
             thread.start()

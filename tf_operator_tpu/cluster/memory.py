@@ -101,9 +101,17 @@ class InMemoryCluster(base.Cluster):
         meta = job_dict.get("metadata", {})
         ns, name = meta.get("namespace", "default"), meta["name"]
         with self._lock:
-            if (kind, ns, name) not in self._jobs:
+            existing = self._jobs.get((kind, ns, name))
+            if existing is None:
                 raise NotFound(f"{kind} {ns}/{name}")
             stored = copy.deepcopy(job_dict)
+            # Status is a subresource: writes through the main resource must
+            # not clobber it (a stale SDK read-modify-write would otherwise
+            # erase conditions the controller wrote in between).
+            if "status" in existing:
+                stored["status"] = copy.deepcopy(existing["status"])
+            else:
+                stored.pop("status", None)
             stored["metadata"]["resourceVersion"] = str(next(self._rv))
             self._jobs[(kind, ns, name)] = stored
             out = copy.deepcopy(stored)

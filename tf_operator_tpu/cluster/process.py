@@ -1,0 +1,311 @@
+"""Process-backed cluster: pods run as real OS subprocesses.
+
+This is the e2e tier the reference gets from a kind/EKS cluster (SURVEY.md
+§4 T3, §7 stage 3): the operator's full output — pod specs with injected
+bootstrap env, headless services, gang groups — is materialized for real.
+Each Pod's first container is launched as a local subprocess with exactly
+the env the controller injected, so `jax.distributed` rendezvous, exit-code
+restart policies, and log collection are exercised against live processes,
+not simulated phases.
+
+Networking: headless-service DNS ("<job>-<type>-<i>.<ns>.svc[:port]") cannot
+resolve on a dev box, so every env value is rewritten through a loopback
+port map — each (service-host, port) pair gets a stable 127.0.0.1 port, the
+same mapping for every pod that references it. The coordinator address all
+replicas agree on therefore points at the port worker-0 actually binds.
+Tests reach a workload (e.g. the controllable test-server) through
+``resolve(host, port)``.
+
+Scheduling follows InMemoryCluster semantics: pods stay Pending until their
+gang (pod-slice) is complete, then launch; a background reaper promotes
+started pods to Running and rolls exit codes into containerStatuses exactly
+as a kubelet would.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import signal
+import socket
+import subprocess
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..api.k8s import (
+    POD_FAILED,
+    POD_PENDING,
+    POD_RUNNING,
+    POD_SUCCEEDED,
+    ContainerState,
+    ContainerStateTerminated,
+    ContainerStatus,
+    Pod,
+)
+from .memory import InMemoryCluster
+
+_log = logging.getLogger(__name__)
+
+# "<name>.<ns>.svc[.<domain>]" with an optional ":<port>", the shape
+# bootstrap/tf_config.replica_service_host emits.
+_SVC_RE = re.compile(
+    r"\b([a-z0-9]([a-z0-9-]*[a-z0-9])?\.[a-z0-9-]+\.svc(?:\.[a-z0-9.-]+)?)(?::(\d+))?"
+)
+
+
+def _free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class LocalProcessCluster(InMemoryCluster):
+    def __init__(
+        self,
+        clock=time.time,
+        log_dir: Optional[str] = None,
+        poll_interval: float = 0.05,
+        child_env: Optional[Dict[str, str]] = None,
+    ):
+        super().__init__(clock)
+        self._log_dir = log_dir or tempfile.mkdtemp(prefix="tpu-operator-pods-")
+        self._poll_interval = poll_interval
+        # Extra env overlaid on every child (after the pod's own env).
+        self._child_env = dict(child_env or {})
+        self._procs: Dict[Tuple[str, str], subprocess.Popen] = {}
+        self._launching: set = set()
+        self._log_fhs: Dict[Tuple[str, str], object] = {}
+        self._log_paths: Dict[Tuple[str, str], str] = {}
+        self._attempts: Dict[Tuple[str, str], int] = {}
+        self._port_map: Dict[Tuple[str, int], int] = {}
+        self._stopped = threading.Event()
+        self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
+        self._reaper.start()
+
+    # --------------------------------------------------------- port mapping
+    def resolve(self, host: str, port: int) -> Tuple[str, int]:
+        """Loopback address a service DNS name maps to. Stable per
+        (host, port); allocates on first use."""
+        with self._lock:
+            return "127.0.0.1", self._mapped_port_locked(host, port)
+
+    def _mapped_port_locked(self, host: str, port: int) -> int:
+        key = (host, int(port))
+        if key not in self._port_map:
+            self._port_map[key] = _free_port()
+        return self._port_map[key]
+
+    def _rewrite_locked(self, value: str) -> str:
+        def sub(m: re.Match) -> str:
+            host, _, port = m.groups()
+            if port is None:
+                return "127.0.0.1"
+            return f"127.0.0.1:{self._mapped_port_locked(host, int(port))}"
+
+        return _SVC_RE.sub(sub, value)
+
+    # ----------------------------------------------------------- scheduling
+    def create_pod(self, pod: Pod) -> Pod:
+        out = super().create_pod(pod)
+        self._schedule_pass()
+        return out
+
+    def create_pod_group(self, group: dict) -> dict:
+        out = super().create_pod_group(group)
+        self._schedule_pass()
+        return out
+
+    def _schedule_pass(self) -> None:
+        """Launch every Pending pod whose gang is complete.
+
+        fork/exec happens OUTSIDE the cluster lock (it is tens of ms per
+        pod; holding the lock would stall every watch/list during an N-pod
+        gang launch): decide + reserve under the lock, spawn unlocked, then
+        commit the result under the lock again.
+        """
+        plans = []  # (key, cmd, env, cwd, log_path)
+        with self._lock:
+            for key, pod in list(self._pods.items()):
+                if (
+                    pod.status.phase != POD_PENDING
+                    or key in self._procs
+                    or key in self._launching
+                ):
+                    continue
+                if not self._gang_schedulable(pod):
+                    continue
+                container = pod.spec.containers[0] if pod.spec.containers else None
+                cmd = (
+                    (list(container.command) + list(container.args))
+                    if container
+                    else []
+                )
+                if not cmd:
+                    self._mark_start_error_locked(pod, "no container command to execute")
+                    continue
+                env = dict(os.environ)
+                for e in container.env:
+                    env[e.name] = self._rewrite_locked(e.value)
+                env.update(self._child_env)
+                env.setdefault("PYTHONUNBUFFERED", "1")
+                attempt = self._attempts.get(key, 0) + 1
+                self._attempts[key] = attempt
+                log_path = os.path.join(
+                    self._log_dir, f"{key[0]}__{key[1]}.{attempt}.log"
+                )
+                self._launching.add(key)
+                plans.append((key, cmd, env, container.working_dir or None, log_path))
+
+        started: List[Pod] = []
+        for key, cmd, env, cwd, log_path in plans:
+            fh = open(log_path, "ab")
+            proc = None
+            error = None
+            try:
+                proc = subprocess.Popen(
+                    cmd,
+                    env=env,
+                    stdout=fh,
+                    stderr=subprocess.STDOUT,
+                    cwd=cwd,
+                    start_new_session=True,  # own pgid: kill takes the whole tree
+                )
+            except OSError as exc:
+                error = str(exc)
+            with self._lock:
+                self._launching.discard(key)
+                pod = self._pods.get(key)
+                if pod is None or pod.status.phase != POD_PENDING:
+                    # Deleted (or force-phased by a test) while we forked.
+                    fh.close()
+                    if proc is not None:
+                        _kill_tree(proc)
+                    continue
+                if error is not None:
+                    fh.close()
+                    self._mark_start_error_locked(pod, error)
+                    started.append(pod.deep_copy())
+                    continue
+                self._procs[key] = proc
+                self._log_fhs[key] = fh
+                self._log_paths[key] = log_path
+                pod.status.phase = POD_RUNNING
+                pod.status.start_time = self._clock()
+                pod.metadata.resource_version = str(next(self._rv))
+                started.append(pod.deep_copy())
+        for pod in started:
+            self._emit("pods", "MODIFIED", pod)
+
+    def _mark_start_error_locked(self, pod: Pod, message: str) -> None:
+        pod.status.phase = POD_FAILED
+        pod.status.reason = "StartError"
+        pod.status.message = message
+        pod.metadata.resource_version = str(next(self._rv))
+
+    # --------------------------------------------------------------- reaper
+    def _reap_loop(self) -> None:
+        while not self._stopped.wait(self._poll_interval):
+            try:
+                self._schedule_pass()
+                self._reap_once()
+            except Exception:
+                if self._stopped.is_set():  # teardown race: expected
+                    return
+                _log.exception("process-cluster reaper pass failed")
+
+    def _reap_once(self) -> None:
+        finished: List[Pod] = []
+        with self._lock:
+            for key, proc in list(self._procs.items()):
+                code = proc.poll()
+                if code is None:
+                    continue
+                pod = self._pods.get(key)
+                self._procs.pop(key, None)
+                fh = self._log_fhs.pop(key, None)
+                if fh is not None:
+                    fh.close()
+                if pod is None or pod.status.phase not in (POD_RUNNING, POD_PENDING):
+                    continue
+                # Negative returncode = killed by signal; kubelet reports
+                # 128+signum for signal deaths.
+                exit_code = code if code >= 0 else 128 - code
+                pod.status.phase = POD_SUCCEEDED if exit_code == 0 else POD_FAILED
+                cname = pod.spec.containers[0].name if pod.spec.containers else ""
+                pod.status.container_statuses = [
+                    ContainerStatus(
+                        name=cname,
+                        state=ContainerState(
+                            terminated=ContainerStateTerminated(
+                                exit_code=exit_code, finished_at=self._clock()
+                            )
+                        ),
+                    )
+                ]
+                pod.metadata.resource_version = str(next(self._rv))
+                finished.append(pod.deep_copy())
+        for pod in finished:
+            self._emit("pods", "MODIFIED", pod)
+
+    # ------------------------------------------------------------- deletion
+    def delete_pod(self, namespace: str, name: str) -> None:
+        key = (namespace, name)
+        with self._lock:
+            proc = self._procs.pop(key, None)
+            fh = self._log_fhs.pop(key, None)
+            # NotFound contract: a deleted pod has no log (a same-name
+            # recreation gets a fresh attempt file at launch).
+            self._log_paths.pop(key, None)
+        if proc is not None:
+            _kill_tree(proc)
+        if fh is not None:
+            fh.close()
+        super().delete_pod(namespace, name)
+
+    def get_pod_log(self, namespace: str, name: str) -> str:
+        key = (namespace, name)
+        with self._lock:
+            path = self._log_paths.get(key)
+        if path and os.path.exists(path):
+            with open(path, "rb") as f:
+                return f.read().decode("utf-8", errors="replace")
+        return super().get_pod_log(namespace, name)
+
+    def step(self) -> None:
+        """Manual tick: trigger a scheduling pass + reap (the background
+        reaper usually does both)."""
+        self._schedule_pass()
+        self._reap_once()
+
+    def shutdown(self) -> None:
+        """Kill every child process and stop the reaper. Call in teardown."""
+        self._stopped.set()
+        with self._lock:
+            procs = list(self._procs.values())
+            fhs = list(self._log_fhs.values())
+            self._procs.clear()
+            self._log_fhs.clear()
+        for proc in procs:
+            _kill_tree(proc)
+        for fh in fhs:
+            fh.close()
+        self._reaper.join(timeout=2.0)
+
+
+def _kill_tree(proc: subprocess.Popen) -> None:
+    try:
+        os.killpg(proc.pid, signal.SIGTERM)
+    except (ProcessLookupError, PermissionError, OSError):
+        pass
+    try:
+        proc.wait(timeout=2.0)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+        proc.wait(timeout=2.0)

@@ -1,0 +1,6 @@
+"""Controllable test workloads for the e2e tier.
+
+Reference parity: test/test-server (the flask app TFJob e2e suites run as
+the training container — test/test-server/test_app.py:27-58) plus a
+JAX-native rendezvous workload the reference has no equivalent of.
+"""

@@ -1,0 +1,73 @@
+"""Real `jax.distributed` rendezvous workload for the process e2e tier.
+
+What dist_mnist.py is to the reference's e2e suites (SURVEY.md §3.5), this
+is to ours: a container program that consumes ONLY the operator-injected
+env, rendezvouses through `tpu_init`, and proves the collective fabric by
+psum-ing each process's contribution across every device. Exit code 0 only
+if the global sum matches the expected closed form.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main() -> int:
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    # This image's sitecustomize registers a TPU PJRT plugin that ignores a
+    # plain JAX_PLATFORMS env override; force it through the config so the
+    # CPU e2e tier cannot silently grab the real chip.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
+    from tf_operator_tpu.runtime.tpu_init import global_mesh, initialize
+
+    topo = initialize(timeout_seconds=60)
+    print(
+        f"[rendezvous] process_id={topo.process_id} "
+        f"num_processes={topo.num_processes} "
+        f"coordinator={topo.coordinator_address}",
+        flush=True,
+    )
+    n_global = jax.device_count()
+    n_local = jax.local_device_count()
+    print(
+        f"[rendezvous] device_count={n_global} local_device_count={n_local}",
+        flush=True,
+    )
+    if topo.distributed and n_global == n_local:
+        print("[rendezvous] FAIL: rendezvous did not federate devices", flush=True)
+        return 3
+
+    # Every device contributes 1; psum across all must equal device_count.
+    mesh = global_mesh(topo)
+    axis_names = mesh.axis_names
+
+    from jax.sharding import PartitionSpec as P
+
+    def contribute():
+        total = jnp.float32(1.0)
+        for name in axis_names:
+            total = jax.lax.psum(total, name)
+        return total
+
+    summed = jax.jit(
+        jax.shard_map(contribute, mesh=mesh, in_specs=(), out_specs=P())
+    )()
+    got = float(jnp.asarray(summed.addressable_data(0)))
+    want = float(n_global)
+    print(f"[rendezvous] psum={got} want={want}", flush=True)
+    if got != want:
+        print("[rendezvous] FAIL: collective mismatch", flush=True)
+        return 4
+    print("[rendezvous] OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

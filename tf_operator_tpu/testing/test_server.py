@@ -1,0 +1,136 @@
+"""Controllable in-pod test server.
+
+Reference parity: test/test-server/test_app.py — the flask app e2e suites
+run as the TFJob container, with `/runconfig` returning the *observed*
+cluster topology (test_app.py:31-44) and `/exit?exitCode=N` forcing a
+specific exit code (test_app.py:46-58). This version is stdlib-only (fast
+cold start, no flask dependency) and adds `/meshconfig`: the JAX-era view
+of the operator-injected env (process id/count, slice coords, mesh axes).
+
+The server derives its own bind address the same way a TF worker does —
+from TF_CONFIG's cluster spec at [task.type][task.index] — so it listens on
+exactly the address the operator's service DNS points at. Under
+LocalProcessCluster that address has been rewritten to a loopback port.
+
+Endpoints:
+  GET /runconfig          observed TF view: task type/index, cluster spec
+  GET /meshconfig         observed JAX view: topology_from_env() fields
+  GET /healthz            "ok"
+  GET /exit?exitCode=N    responds "exiting N" then exits with code N
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+
+def _own_address() -> tuple:
+    """(host, port) this replica should listen on, from injected env."""
+    raw = os.environ.get("TF_CONFIG")
+    if raw:
+        cfg = json.loads(raw)
+        task = cfg.get("task", {})
+        ttype, tindex = task.get("type", ""), int(task.get("index", 0))
+        cluster = cfg.get("cluster") or {}
+        if ttype in cluster:
+            entry = cluster[ttype][tindex]
+            host, port = entry.rsplit(":", 1)
+            return host, int(port)
+        sparse = cfg.get("sparseCluster") or {}
+        entry = None
+        if ttype in sparse:
+            group = sparse[ttype]
+            if isinstance(group, dict):
+                entry = group.get(str(tindex)) or group.get(tindex)
+            elif isinstance(group, list) and tindex < len(group):
+                entry = group[tindex]
+        if entry:
+            host, port = entry.rsplit(":", 1)
+            return host, int(port)
+    # JAXJob coordinator path: process 0's address is the coordinator's.
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if coord and os.environ.get("JAX_PROCESS_ID", "0") == "0":
+        host, port = coord.rsplit(":", 1)
+        return host, int(port)
+    return "127.0.0.1", int(os.environ.get("TEST_SERVER_PORT", "0"))
+
+
+def _runconfig() -> dict:
+    raw = os.environ.get("TF_CONFIG")
+    if not raw:
+        return {}
+    cfg = json.loads(raw)
+    return {
+        "task_type": cfg.get("task", {}).get("type", ""),
+        "task_id": int(cfg.get("task", {}).get("index", 0)),
+        "cluster_spec": cfg.get("cluster") or cfg.get("sparseCluster") or {},
+        "is_chief": cfg.get("task", {}).get("type") in ("chief", "master"),
+        "environment": cfg.get("environment", ""),
+    }
+
+
+def _meshconfig() -> dict:
+    from ..runtime.tpu_init import topology_from_env
+
+    topo = topology_from_env()
+    return {
+        "coordinator_address": topo.coordinator_address,
+        "num_processes": topo.num_processes,
+        "process_id": topo.process_id,
+        "worker_id": topo.worker_id,
+        "num_slices": topo.num_slices,
+        "slice_index": topo.slice_index,
+        "mesh_axes": topo.mesh_axes,
+        "accelerator_type": topo.accelerator_type,
+    }
+
+
+class Handler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):
+        print(f"[test-server] {fmt % args}", flush=True)
+
+    def _json(self, payload: dict, code: int = 200) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 (stdlib API)
+        url = urlparse(self.path)
+        if url.path == "/runconfig":
+            self._json(_runconfig())
+        elif url.path == "/meshconfig":
+            self._json(_meshconfig())
+        elif url.path == "/healthz":
+            self._json({"status": "ok"})
+        elif url.path == "/exit":
+            code = int(parse_qs(url.query).get("exitCode", ["0"])[0])
+            self._json({"exiting": code})
+            print(f"[test-server] exiting with code {code}", flush=True)
+            # Flush the response before dying (reference test_app.py:46-58
+            # uses a timer for the same reason).
+            threading.Timer(0.2, os._exit, args=(code,)).start()
+        else:
+            self._json({"error": "not found"}, code=404)
+
+
+def main() -> None:
+    host, port = _own_address()
+    server = ThreadingHTTPServer((host, port), Handler)
+    print(
+        f"[test-server] listening on {host}:{port} "
+        f"runconfig={json.dumps(_runconfig())}",
+        flush=True,
+    )
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
