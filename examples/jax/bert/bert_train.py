@@ -1,0 +1,114 @@
+"""BERT-base MLM pretraining — the BASELINE.md "BERT-base v5e-16" config,
+TPU-natively (Flax under pjit; no torch-XLA bridge needed).
+
+Reference counterpart: BERT as a PyTorchJob user container over the c10d
+env contract (pkg/controller.v1/pytorch/pytorch.go:27-82).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+try:
+    import tf_operator_tpu  # noqa: F401
+except ImportError:
+    sys.path.insert(
+        0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default=None)
+    parser.add_argument("--steps", type=int, default=100)
+    parser.add_argument("--batch", type=int, default=64, help="global batch size")
+    parser.add_argument("--seq", type=int, default=512)
+    parser.add_argument("--mask-prob", type=float, default=0.15)
+    parser.add_argument("--lr", type=float, default=1e-4)
+    parser.add_argument("--log-every", type=int, default=10)
+    args = parser.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tf_operator_tpu.models import bert
+    from tf_operator_tpu.runtime.tpu_init import tpu_init
+    from tf_operator_tpu.train.data import shard_batch
+
+    topo, mesh = tpu_init()
+    n = jax.device_count()
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if args.model is None:
+        args.model = "bert-base" if on_tpu else "bert-tiny"
+    cfg = bert.CONFIGS[args.model]
+    if not on_tpu:
+        args.seq = min(args.seq, cfg.max_len)
+        args.batch = min(args.batch, 2 * n)
+    args.seq = min(args.seq, cfg.max_len)
+    print(
+        f"[bert] {args.model} process {topo.process_id}/{topo.num_processes} "
+        f"devices={n} seq={args.seq}",
+        flush=True,
+    )
+
+    model = bert.make_model(cfg)
+    params = bert.init_params(model, jax.random.PRNGKey(0), batch=1, seq=args.seq)
+    tx = optax.adamw(args.lr, weight_decay=0.01)
+    opt_state = tx.init(params)
+
+    MASK_ID = 4  # conventional [MASK]-style id for the synthetic stream
+    data_sharding = NamedSharding(mesh, P(mesh.axis_names))
+    repl = NamedSharding(mesh, P())
+
+    @jax.jit
+    def train_step(params, opt_state, input_ids, labels, mask):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, input_ids, attention_mask=mask)
+            logits = logits.astype(jnp.float32)
+            ll = jnp.take_along_axis(
+                jax.nn.log_softmax(logits), labels[..., None].clip(0), axis=-1
+            )[..., 0]
+            weights = (labels >= 0).astype(jnp.float32)
+            return -(ll * weights).sum() / jnp.maximum(weights.sum(), 1.0)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    params = jax.device_put(params, repl)
+    opt_state = jax.device_put(opt_state, repl)
+
+    if args.batch % topo.num_processes:
+        raise SystemExit("--batch must divide by the process count")
+    local_batch = args.batch // topo.num_processes
+    rng = np.random.default_rng(topo.process_id)
+    t0 = time.perf_counter()
+    for step in range(args.steps):
+        tokens = rng.integers(5, cfg.vocab_size, (local_batch, args.seq)).astype(np.int32)
+        mask_pos = rng.random((local_batch, args.seq)) < args.mask_prob
+        labels = np.where(mask_pos, tokens, -1).astype(np.int32)
+        input_ids = np.where(mask_pos, MASK_ID, tokens).astype(np.int32)
+        attn = np.ones((local_batch, args.seq), dtype=bool)
+        step_args = [
+            shard_batch(x, data_sharding) for x in (input_ids, labels, attn)
+        ]
+        params, opt_state, loss = train_step(params, opt_state, *step_args)
+        if step % args.log_every == 0 or step == args.steps - 1:
+            dt = time.perf_counter() - t0
+            tps = (step + 1) * args.batch * args.seq / max(dt, 1e-9)
+            print(
+                f"[bert] step {step} loss {float(loss):.4f} tokens/sec {tps:,.0f}",
+                flush=True,
+            )
+    print("[bert] done", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

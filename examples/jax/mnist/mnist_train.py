@@ -1,0 +1,93 @@
+"""Distributed MNIST on JAX — parity with the reference's canonical example
+(examples/tensorflow/dist-mnist/dist_mnist.py): same model topology, but
+data-parallel over a device mesh instead of PS/Worker gRPC.
+
+Runs identically as a single process (dev box), one TPU chip, or an
+operator-launched multi-host JAXJob (env injected by bootstrap/jaxdist.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# Containers pip-install the package; running from a source checkout works too.
+try:
+    import tf_operator_tpu  # noqa: F401
+except ImportError:
+    sys.path.insert(
+        0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=200)
+    parser.add_argument("--batch", type=int, default=64, help="global batch size")
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--target-accuracy", type=float, default=0.0)
+    args = parser.parse_args(argv)
+
+    import jax
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tf_operator_tpu.models import mnist
+    from tf_operator_tpu.runtime.tpu_init import tpu_init
+
+    topo, mesh = tpu_init()
+    print(
+        f"[mnist] process {topo.process_id}/{topo.num_processes} "
+        f"devices={jax.device_count()} mesh={dict(zip(mesh.axis_names, mesh.devices.shape))}",
+        flush=True,
+    )
+
+    model = mnist.make_model()
+    params = mnist.init_params(model, jax.random.PRNGKey(0), batch=1)
+    tx = optax.sgd(args.lr, momentum=0.9)
+    opt_state = tx.init(params)
+
+    # Data-parallel: batch sharded over every mesh axis, params replicated.
+    data_sharding = NamedSharding(mesh, P(mesh.axis_names))
+    repl = NamedSharding(mesh, P())
+
+    @jax.jit
+    def train_step(params, opt_state, images, labels):
+        def loss_fn(p):
+            return mnist.loss_and_accuracy(model, p, images, labels)
+
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss, acc
+
+    params = jax.device_put(params, repl)
+    opt_state = jax.device_put(opt_state, repl)
+
+    # Each process feeds its local shard of the global batch; JAX assembles
+    # the global array (no host gathers a full batch).
+    if args.batch % topo.num_processes:
+        raise SystemExit("--batch must divide by the process count")
+    local_batch = args.batch // topo.num_processes
+    data = mnist.SyntheticMnist(local_batch, seed=topo.process_id * 7919)
+    acc = 0.0
+    for step, (images, labels) in zip(range(args.steps), data):
+        images = jax.make_array_from_process_local_data(data_sharding, images)
+        labels = jax.make_array_from_process_local_data(data_sharding, labels)
+        params, opt_state, loss, acc = train_step(params, opt_state, images, labels)
+        if step % 50 == 0 or step == args.steps - 1:
+            print(
+                f"[mnist] step {step} loss {float(loss):.4f} acc {float(acc):.3f}",
+                flush=True,
+            )
+
+    final_acc = float(acc)
+    print(f"[mnist] done final_acc={final_acc:.3f}", flush=True)
+    if args.target_accuracy and final_acc < args.target_accuracy:
+        print(f"[mnist] FAIL: accuracy {final_acc} < {args.target_accuracy}", flush=True)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,114 @@
+"""ResNet-50 data-parallel training — the BASELINE.md "ResNet-50
+TPUStrategy v5e-8" config, TPU-natively (pjit DP instead of TPUStrategy).
+
+Reference counterpart: the TF distribution_strategy examples
+(examples/tensorflow/distribution_strategy/keras-API/
+multi_worker_strategy-with-keras.py) driven through TF_CONFIG.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+try:
+    import tf_operator_tpu  # noqa: F401
+except ImportError:
+    sys.path.insert(
+        0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default=None)
+    parser.add_argument("--steps", type=int, default=100)
+    parser.add_argument("--batch", type=int, default=256, help="global batch size")
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--log-every", type=int, default=10)
+    args = parser.parse_args(argv)
+
+    import jax
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tf_operator_tpu.models import resnet
+    from tf_operator_tpu.runtime.tpu_init import tpu_init
+    from tf_operator_tpu.train.data import shard_batch
+
+    topo, mesh = tpu_init()
+    n = jax.device_count()
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if args.model is None:
+        args.model = "resnet50" if on_tpu else "resnet-tiny"
+    if not on_tpu:
+        args.image_size = min(args.image_size, 32)
+        args.batch = min(args.batch, 2 * n)
+    cfg = resnet.CONFIGS[args.model]
+    print(
+        f"[resnet] {args.model} process {topo.process_id}/{topo.num_processes} "
+        f"devices={n} batch={args.batch}",
+        flush=True,
+    )
+
+    model = resnet.ResNet(cfg)
+    variables = resnet.init_variables(
+        model, jax.random.PRNGKey(0), batch=1, image_size=args.image_size
+    )
+    tx = optax.sgd(args.lr, momentum=0.9, nesterov=True)
+    opt_state = tx.init(variables["params"])
+
+    data_sharding = NamedSharding(mesh, P(mesh.axis_names))
+    repl = NamedSharding(mesh, P())
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state, images, labels):
+        def loss_fn(p):
+            logits, mut = model.apply(
+                {"params": p, "batch_stats": batch_stats},
+                images, train=True, mutable=["batch_stats"],
+            )
+            one_hot = jax.nn.one_hot(labels, cfg.num_classes)
+            loss = -jax.numpy.mean(
+                jax.numpy.sum(one_hot * jax.nn.log_softmax(logits), axis=-1)
+            )
+            return loss, mut["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_stats, opt_state, loss
+
+    params = jax.device_put(variables["params"], repl)
+    batch_stats = jax.device_put(variables["batch_stats"], repl)
+    opt_state = jax.device_put(opt_state, repl)
+
+    if args.batch % topo.num_processes:
+        raise SystemExit("--batch must divide by the process count")
+    local_batch = args.batch // topo.num_processes
+    rng = np.random.default_rng(topo.process_id)
+    t0 = time.perf_counter()
+    for step in range(args.steps):
+        images = rng.normal(0, 1, (local_batch, args.image_size, args.image_size, 3)).astype(np.float32)
+        labels = rng.integers(0, cfg.num_classes, (local_batch,)).astype(np.int32)
+        images = shard_batch(images, data_sharding)
+        labels = shard_batch(labels, data_sharding)
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, images, labels
+        )
+        if step % args.log_every == 0 or step == args.steps - 1:
+            dt = time.perf_counter() - t0
+            ips = (step + 1) * args.batch / max(dt, 1e-9)
+            print(
+                f"[resnet] step {step} loss {float(loss):.4f} images/sec {ips:,.0f}",
+                flush=True,
+            )
+    print("[resnet] done", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,186 @@
+"""Examples tier: every manifest validates through the API layer, the JAX
+scripts run end-to-end in-process, and the PyTorch example executes for
+real through the operator + process cluster (c10d/gloo rendezvous).
+
+Reference parity: the reference's example YAMLs are exercised by its e2e
+DAG (SURVEY.md §4 T3); its jsonnet CI components are replaced by this
+plain pytest module (SURVEY.md §7 anti-goals).
+"""
+
+import os
+import sys
+import time
+
+import pytest
+import yaml
+
+from tf_operator_tpu.api import KINDS, parse_job
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+def example_manifests():
+    out = []
+    for root, _, files in os.walk(EXAMPLES):
+        for f in sorted(files):
+            if f.endswith(".yaml"):
+                out.append(os.path.join(root, f))
+    return out
+
+
+class TestManifestsValidate:
+    @pytest.mark.parametrize("path", example_manifests(), ids=os.path.basename)
+    def test_parses_defaults_validates(self, path):
+        with open(path) as f:
+            manifest = yaml.safe_load(f)
+        job = parse_job(manifest)
+        _, set_defaults, validate = KINDS[job.kind]
+        set_defaults(job)
+        validate(job.spec)
+
+    def test_flagship_llama_config(self):
+        with open(
+            os.path.join(REPO, "examples/jax/llama/jaxjob_llama2_7b_v5e32.yaml")
+        ) as f:
+            job = parse_job(yaml.safe_load(f))
+        _, set_defaults, validate = KINDS[job.kind]
+        set_defaults(job)
+        validate(job.spec)
+        # v5e-32 = 8 hosts x 4 chips; replicas defaulted from the topology.
+        assert job.spec.jax_replica_specs["Worker"].replicas == 8
+        assert job.spec.mesh == {"fsdp": 32}
+
+    def test_multislice_has_slice_axis_and_double_workers(self):
+        with open(
+            os.path.join(REPO, "examples/jax/llama/jaxjob_llama2_7b_multislice.yaml")
+        ) as f:
+            job = parse_job(yaml.safe_load(f))
+        _, set_defaults, validate = KINDS[job.kind]
+        set_defaults(job)
+        validate(job.spec)
+        assert job.spec.num_slices == 2
+        assert job.spec.jax_replica_specs["Worker"].replicas == 16
+        assert job.spec.mesh["slice"] == 2
+
+
+class TestJaxScriptsRun:
+    """Each script's main() runs in-process at CI size (8 virtual devices)."""
+
+    def test_mnist(self):
+        sys.path.insert(0, os.path.join(EXAMPLES, "jax", "mnist"))
+        try:
+            import mnist_train
+        finally:
+            sys.path.pop(0)
+        assert mnist_train.main(["--steps", "40", "--batch", "32",
+                                 "--target-accuracy", "0.5"]) == 0
+
+    def test_resnet(self):
+        sys.path.insert(0, os.path.join(EXAMPLES, "jax", "resnet"))
+        try:
+            import resnet_train
+        finally:
+            sys.path.pop(0)
+        assert resnet_train.main(["--steps", "3", "--batch", "16", "--log-every", "2"]) == 0
+
+    def test_bert(self):
+        sys.path.insert(0, os.path.join(EXAMPLES, "jax", "bert"))
+        try:
+            import bert_train
+        finally:
+            sys.path.pop(0)
+        assert bert_train.main(["--steps", "3", "--batch", "16", "--seq", "64",
+                                "--log-every", "2"]) == 0
+
+    def test_llama_checkpoint_resume(self, tmp_path):
+        sys.path.insert(0, os.path.join(EXAMPLES, "jax", "llama"))
+        try:
+            import llama_train
+        finally:
+            sys.path.pop(0)
+        ckpt = str(tmp_path / "ckpt")
+        assert llama_train.main(["--steps", "4", "--batch", "8", "--seq", "64",
+                                 "--checkpoint-dir", ckpt, "--checkpoint-every", "2"]) == 0
+        # Second run resumes from the saved step instead of restarting.
+        assert llama_train.main(["--steps", "6", "--batch", "8", "--seq", "64",
+                                 "--checkpoint-dir", ckpt]) == 0
+        import orbax.checkpoint as ocp
+
+        mgr = ocp.CheckpointManager(ckpt)
+        assert mgr.latest_step() == 6
+        mgr.close()
+
+
+class TestPytorchExampleE2E:
+    """The c10d contract proven live: a PyTorchJob (1 master + 2 workers)
+    runs the DDP example as real processes; gloo rendezvous rides the
+    operator-injected MASTER_ADDR/PORT through the loopback alias map."""
+
+    def test_ddp_mnist_job_succeeds(self):
+        from tf_operator_tpu.cli import OperatorManager, OperatorOptions
+        from tf_operator_tpu.cluster.process import LocalProcessCluster
+        from tf_operator_tpu.metrics import Metrics
+
+        cmd = [
+            sys.executable,
+            os.path.join(EXAMPLES, "pytorch", "mnist", "pytorch_dist_mnist.py"),
+            "--steps", "4", "--batch", "16",
+        ]
+        replica = lambda n: {  # noqa: E731
+            "replicas": n,
+            "restartPolicy": "OnFailure",
+            "template": {
+                "spec": {
+                    "containers": [
+                        {"name": "pytorch", "image": "local", "command": cmd}
+                    ]
+                }
+            },
+        }
+        cluster = LocalProcessCluster(child_env={"PYTHONPATH": REPO})
+        manager = OperatorManager(
+            cluster,
+            OperatorOptions(enabled_schemes=["PyTorchJob"], health_port=0,
+                            metrics_port=0, resync_period=0.2),
+            metrics=Metrics(),
+        )
+        manager.start()
+        try:
+            cluster.create_job(
+                {
+                    "apiVersion": "kubeflow.org/v1",
+                    "kind": "PyTorchJob",
+                    "metadata": {"name": "ddp", "namespace": "default"},
+                    "spec": {
+                        "pytorchReplicaSpecs": {
+                            "Master": replica(1),
+                            "Worker": replica(2),
+                        }
+                    },
+                }
+            )
+
+            def succeeded():
+                try:
+                    job = cluster.get_job("PyTorchJob", "default", "ddp")
+                except KeyError:
+                    return False
+                conds = (job.get("status") or {}).get("conditions") or []
+                return any(
+                    c["type"] == "Succeeded" and c["status"] == "True" for c in conds
+                )
+
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline and not succeeded():
+                time.sleep(0.2)
+            logs = {
+                p.metadata.name: cluster.get_pod_log("default", p.metadata.name)
+                for p in cluster.list_pods("default")
+            }
+            assert succeeded(), f"job did not succeed; logs: {logs}"
+            master_log = cluster.get_pod_log("default", "ddp-master-0")
+            assert "ranks in sync" in master_log, master_log
+        finally:
+            manager.stop()
+            cluster.shutdown()

@@ -1,0 +1,86 @@
+"""Startup-latency and restart-MTTR histograms (BASELINE.md: job-startup
+p50 and restart MTTR are numbers the build must establish; the reference
+has no latency metrics — SURVEY.md §5.5 lists counters only)."""
+
+from tf_operator_tpu.cluster.memory import InMemoryCluster
+from tf_operator_tpu.controllers.jax import JAXController
+from tf_operator_tpu.metrics import Metrics
+
+
+def jaxjob(name="lat", replicas=1, restart_policy="ExitCode"):
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "JAXJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "jaxReplicaSpecs": {
+                "Worker": {
+                    "replicas": replicas,
+                    "restartPolicy": restart_policy,
+                    "template": {
+                        "spec": {"containers": [{"name": "jax", "image": "i"}]}
+                    },
+                }
+            }
+        },
+    }
+
+
+class FakeClock:
+    def __init__(self, start=1000.0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+def test_startup_and_restart_latency_observed():
+    clock = FakeClock()
+    cluster = InMemoryCluster(clock=clock)
+    metrics = Metrics()
+    ctrl = JAXController(cluster, metrics=metrics, clock=clock)
+
+    cluster.create_job(jaxjob())
+    ctrl.sync("default", "lat")  # creates the pod; Created condition stamped
+
+    clock.advance(7.0)  # pod takes 7s to come up
+    cluster.set_pod_phase("default", "lat-worker-0", "Running")
+    ctrl.sync("default", "lat")
+
+    startups = metrics.histogram_values(
+        "training_operator_job_startup_seconds", "default", "JAXJob"
+    )
+    assert startups and abs(startups[0] - 7.0) < 1e-6
+
+    # Retryable failure (exit 130) -> Restarting; recreated pod Running
+    # again 5s later -> restart MTTR observed.
+    clock.advance(60.0)
+    cluster.set_pod_phase("default", "lat-worker-0", "Failed", exit_code=130)
+    ctrl.sync("default", "lat")  # initiates restart (deletes the pod)
+    ctrl.sync("default", "lat")  # recreates the pod
+    clock.advance(5.0)
+    cluster.set_pod_phase("default", "lat-worker-0", "Running")
+    ctrl.sync("default", "lat")
+
+    restarts = metrics.histogram_values(
+        "training_operator_job_restart_seconds", "default", "JAXJob"
+    )
+    assert restarts and abs(restarts[0] - 5.0) < 1e-6
+    # Startup histogram did not double-count the restart.
+    assert len(
+        metrics.histogram_values(
+            "training_operator_job_startup_seconds", "default", "JAXJob"
+        )
+    ) == 1
+
+
+def test_render_exposes_histograms():
+    metrics = Metrics()
+    metrics.observe_startup("default", "JAXJob", 3.0)
+    metrics.observe_restart("default", "JAXJob", 1.5)
+    text = metrics.render()
+    assert "training_operator_job_startup_seconds" in text
+    assert "training_operator_job_restart_seconds" in text

@@ -1,0 +1,113 @@
+"""Model zoo: the non-flagship BASELINE configs (mnist/resnet/bert).
+
+The reference ships workloads as examples with e2e assertions only; here
+each model family gets direct numerics tests (forward shape, gradient flow,
+loss decrease) at CI-sized configs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tf_operator_tpu.models import bert, mnist, resnet
+
+
+class TestMnist:
+    def test_forward_shape(self):
+        model = mnist.make_model()
+        params = mnist.init_params(model, jax.random.PRNGKey(0), batch=2)
+        logits = model.apply({"params": params}, jnp.zeros((2, 28, 28, 1)))
+        assert logits.shape == (2, 10)
+        assert logits.dtype == jnp.float32
+
+    def test_learns_synthetic_task(self):
+        model = mnist.make_model()
+        params = mnist.init_params(model, jax.random.PRNGKey(0), batch=1)
+        tx = optax.sgd(0.05, momentum=0.9)
+        opt_state = tx.init(params)
+
+        @jax.jit
+        def step(params, opt_state, images, labels):
+            (loss, acc), grads = jax.value_and_grad(
+                lambda p: mnist.loss_and_accuracy(model, p, images, labels),
+                has_aux=True,
+            )(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss, acc
+
+        data = mnist.SyntheticMnist(32, seed=0)
+        first_loss = None
+        for i, (images, labels) in zip(range(60), data):
+            params, opt_state, loss, acc = step(params, opt_state, images, labels)
+            if first_loss is None:
+                first_loss = float(loss)
+        assert float(loss) < first_loss * 0.5
+        assert float(acc) > 0.8
+
+
+class TestResNet:
+    def test_forward_and_batchstats(self):
+        model = resnet.make_model("resnet-tiny")
+        variables = resnet.init_variables(model, jax.random.PRNGKey(0), batch=2, image_size=32)
+        assert "batch_stats" in variables
+        logits, mutated = model.apply(
+            variables, jnp.ones((2, 32, 32, 3)), train=True, mutable=["batch_stats"]
+        )
+        assert logits.shape == (2, 8)
+        # Running statistics must move under train=True.
+        before = jax.tree.leaves(variables["batch_stats"])
+        after = jax.tree.leaves(mutated["batch_stats"])
+        assert any(not np.allclose(b, a) for b, a in zip(before, after))
+
+    def test_eval_deterministic(self):
+        model = resnet.make_model("resnet-tiny")
+        variables = resnet.init_variables(model, jax.random.PRNGKey(0), batch=1, image_size=32)
+        x = jnp.ones((1, 32, 32, 3))
+        a = model.apply(variables, x, train=False)
+        b = model.apply(variables, x, train=False)
+        assert np.allclose(a, b)
+
+    def test_resnet50_config_is_bottleneck_50_layer(self):
+        cfg = resnet.CONFIGS["resnet50"]
+        assert cfg.bottleneck
+        # 3+4+6+3 bottleneck blocks x3 convs + stem + fc = 50
+        assert sum(cfg.stage_sizes) * 3 + 2 == 50
+
+
+class TestBert:
+    def test_forward_shape_and_mask(self):
+        model = bert.make_model("bert-tiny")
+        params = bert.init_params(model, jax.random.PRNGKey(0), batch=2, seq=16)
+        ids = jnp.ones((2, 16), jnp.int32)
+        mask = jnp.ones((2, 16), bool).at[:, 8:].set(False)
+        logits = model.apply({"params": params}, ids, attention_mask=mask)
+        assert logits.shape == (2, 16, model.config.vocab_size)
+
+    def test_padding_does_not_leak(self):
+        """Masked-out positions must not influence visible positions."""
+        model = bert.make_model("bert-tiny")
+        params = bert.init_params(model, jax.random.PRNGKey(0), batch=1, seq=8)
+        mask = jnp.ones((1, 8), bool).at[:, 4:].set(False)
+        a = jnp.array([[5, 6, 7, 8, 9, 9, 9, 9]], jnp.int32)
+        b = jnp.array([[5, 6, 7, 8, 100, 101, 102, 103]], jnp.int32)
+        la = model.apply({"params": params}, a, attention_mask=mask)
+        lb = model.apply({"params": params}, b, attention_mask=mask)
+        assert np.allclose(la[:, :4], lb[:, :4], atol=1e-5)
+
+    def test_base_param_count_matches_published(self):
+        # BERT-base is ~110M parameters.
+        assert 105e6 < bert.CONFIGS["bert-base"].param_count() < 115e6
+
+    def test_gradients_flow(self):
+        model = bert.make_model("bert-tiny")
+        params = bert.init_params(model, jax.random.PRNGKey(0), batch=1, seq=8)
+        ids = jnp.ones((1, 8), jnp.int32)
+
+        def loss_fn(p):
+            return model.apply({"params": p}, ids).astype(jnp.float32).mean()
+
+        grads = jax.grad(loss_fn)(params)
+        norms = [float(jnp.abs(g).max()) for g in jax.tree.leaves(grads)]
+        assert any(n > 0 for n in norms)

@@ -10,11 +10,12 @@ not simulated phases.
 
 Networking: headless-service DNS ("<job>-<type>-<i>.<ns>.svc[:port]") cannot
 resolve on a dev box, so every env value is rewritten through a loopback
-port map — each (service-host, port) pair gets a stable 127.0.0.1 port, the
-same mapping for every pod that references it. The coordinator address all
-replicas agree on therefore points at the port worker-0 actually binds.
-Tests reach a workload (e.g. the controllable test-server) through
-``resolve(host, port)``.
+alias map — each service host gets its own stable 127.0.0.0/8 address
+(bindable and dialable on Linux with no configuration) and keeps its
+declared port, the same mapping for every pod that references it. The
+coordinator address all replicas agree on therefore points at the address
+worker-0 actually binds. Tests reach a workload (e.g. the controllable
+test-server) through ``resolve(host, port)``.
 
 Scheduling follows InMemoryCluster semantics: pods stay Pending until their
 gang (pod-slice) is complete, then launch; a background reaper promotes
@@ -28,7 +29,6 @@ import logging
 import os
 import re
 import signal
-import socket
 import subprocess
 import tempfile
 import threading
@@ -55,12 +55,28 @@ _SVC_RE = re.compile(
     r"\b([a-z0-9]([a-z0-9-]*[a-z0-9])?\.[a-z0-9-]+\.svc(?:\.[a-z0-9.-]+)?)(?::(\d+))?"
 )
 
+# "<job>-<replicatype>-<index>", the gen_general_name shape.
+_BARE_NAME_RE = re.compile(r"[a-z0-9][a-z0-9-]*-[a-z0-9]+-\d+")
 
-def _free_port() -> int:
-    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
-        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+# Env vars whose values are known to carry bare service hostnames (the
+# c10d/DMLC/Rabit/libtpu contracts). Only these get the shape-heuristic
+# rewrite — a user variable that merely looks like "<a>-<b>-<N>" must not
+# be corrupted.
+_HOST_ENV_VARS = {
+    "MASTER_ADDR",
+    "DMLC_PS_ROOT_URI",
+    "WORKER_ADDRS",
+    "TPU_WORKER_HOSTNAMES",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+}
+
+
+# Loopback alias pool: every 127.0.0.0/8 address is bindable/dialable on
+# Linux without configuration, so each service host gets its OWN IP and
+# keeps its declared port — no cross-host port collisions, and env vars
+# that carry host and port separately (MASTER_ADDR / MASTER_PORT) stay
+# consistent after rewriting.
+_IP_BASE = (127, 0, 10, 1)
 
 
 class LocalProcessCluster(InMemoryCluster):
@@ -81,32 +97,63 @@ class LocalProcessCluster(InMemoryCluster):
         self._log_fhs: Dict[Tuple[str, str], object] = {}
         self._log_paths: Dict[Tuple[str, str], str] = {}
         self._attempts: Dict[Tuple[str, str], int] = {}
-        self._port_map: Dict[Tuple[str, int], int] = {}
+        self._ip_map: Dict[Tuple[str, str], str] = {}
         self._stopped = threading.Event()
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
         self._reaper.start()
 
-    # --------------------------------------------------------- port mapping
-    def resolve(self, host: str, port: int) -> Tuple[str, int]:
+    # ----------------------------------------------------------- ip mapping
+    def resolve(self, host: str, port: int = 0, namespace: str = "default") -> Tuple[str, int]:
         """Loopback address a service DNS name maps to. Stable per
-        (host, port); allocates on first use."""
+        (service, namespace); the declared port is preserved. A FQDN carries
+        its own namespace; `namespace` disambiguates bare names."""
         with self._lock:
-            return "127.0.0.1", self._mapped_port_locked(host, port)
+            return self._mapped_ip_locked(host, namespace), int(port)
 
-    def _mapped_port_locked(self, host: str, port: int) -> int:
-        key = (host, int(port))
-        if key not in self._port_map:
-            self._port_map[key] = _free_port()
-        return self._port_map[key]
+    def _mapped_ip_locked(self, host: str, namespace: str) -> str:
+        # Short name and FQDN of the same service must agree; same-named
+        # services in different namespaces must NOT.
+        labels = host.split(".")
+        if len(labels) >= 3 and labels[2] == "svc":
+            key = (labels[0], labels[1])
+        else:
+            key = (labels[0], namespace)
+        if key not in self._ip_map:
+            n = len(self._ip_map)
+            a, b, c, d = _IP_BASE
+            self._ip_map[key] = f"{a}.{b}.{c + (d + n) // 256}.{(d + n) % 256}"
+        return self._ip_map[key]
 
-    def _rewrite_locked(self, value: str) -> str:
+    def _rewrite_locked(self, value: str, namespace: str, allow_bare: bool) -> str:
         def sub(m: re.Match) -> str:
             host, _, port = m.groups()
-            if port is None:
-                return "127.0.0.1"
-            return f"127.0.0.1:{self._mapped_port_locked(host, int(port))}"
+            ip = self._mapped_ip_locked(host, namespace)
+            return ip if port is None else f"{ip}:{port}"
 
-        return _SVC_RE.sub(sub, value)
+        value = _SVC_RE.sub(sub, value)
+        # Known services referenced by bare name — including inside JSON
+        # payloads like MX_CONFIG — rewritten with word boundaries so
+        # "j-worker-0" cannot clobber "j-worker-01".
+        for (svc_ns, name) in list(self._services):
+            if svc_ns == namespace and name in value:
+                value = re.sub(
+                    rf"\b{re.escape(name)}\b",
+                    self._mapped_ip_locked(name, namespace),
+                    value,
+                )
+        if not allow_bare:
+            return value
+        # Host-carrying env vars (c10d/DMLC/Rabit contracts emit
+        # "<job>-<type>-<idx>" relying on the namespace DNS search path —
+        # reference pytorch.go:46-53): rewrite generated-name-shaped items
+        # even before their service object exists.
+        items = []
+        for item in value.split(","):
+            host, sep, port = item.partition(":")
+            if host != "localhost" and _BARE_NAME_RE.fullmatch(host):
+                item = self._mapped_ip_locked(host, namespace) + sep + port
+            items.append(item)
+        return ",".join(items)
 
     # ----------------------------------------------------------- scheduling
     def create_pod(self, pod: Pod) -> Pod:
@@ -149,7 +196,11 @@ class LocalProcessCluster(InMemoryCluster):
                     continue
                 env = dict(os.environ)
                 for e in container.env:
-                    env[e.name] = self._rewrite_locked(e.value)
+                    env[e.name] = self._rewrite_locked(
+                        e.value,
+                        pod.metadata.namespace,
+                        allow_bare=e.name in _HOST_ENV_VARS,
+                    )
                 env.update(self._child_env)
                 env.setdefault("PYTHONUNBUFFERED", "1")
                 attempt = self._attempts.get(key, 0) + 1

@@ -154,8 +154,14 @@ class FrameworkController(FrameworkHooks):
             self.queue.add_after(f"{self.kind}:{key}", 30.0)
             return
 
+        old_conds = {
+            c.get("type"): c
+            for c in (job_dict.get("status") or {}).get("conditions") or []
+            if c.get("status") == "True"
+        }
         self.engine.reconcile_job(job)
         self._roll_terminal_metrics(job)
+        self._observe_transition_latency(job, old_conds)
 
     def _fail_invalid(self, job_dict: dict, message: str) -> None:
         from ..api import common as capi
@@ -195,6 +201,34 @@ class FrameworkController(FrameworkHooks):
                 involved_object=f"{self.kind}/{meta.get('namespace', 'default')}/{meta.get('name', '')}",
             )
         )
+
+    def _observe_transition_latency(self, job: JobObject, old_conds: dict) -> None:
+        """Startup p50 / restart MTTR instrumentation (SURVEY.md §7 stage 5:
+        the reference has no latency metrics; BASELINE.md names job-startup
+        p50 and restart MTTR as numbers this build must establish).
+
+        Fires on the sync that transitions the job to Running: measured from
+        the prior Restarting condition (restart MTTR) or from job creation
+        (first startup).
+        """
+        from ..api import common as capi
+
+        run = capi.get_condition(job.status, capi.JOB_RUNNING)
+        if run is None or run.status != capi.CONDITION_TRUE:
+            return
+        if capi.JOB_RUNNING in old_conds:
+            return  # already Running before this sync
+        now = run.last_transition_time or self.clock()
+        restarting = old_conds.get(capi.JOB_RESTARTING)
+        if restarting is not None:
+            t0 = restarting.get("lastTransitionTime")
+            if t0 is not None:
+                self.metrics.observe_restart(job.namespace, self.kind, max(0.0, now - t0))
+            return
+        created = old_conds.get(capi.JOB_CREATED) or {}
+        t0 = created.get("lastTransitionTime") or job.metadata.creation_timestamp
+        if t0 is not None:
+            self.metrics.observe_startup(job.namespace, self.kind, max(0.0, now - t0))
 
     def _roll_terminal_metrics(self, job: JobObject) -> None:
         from ..api import common as capi

@@ -75,6 +75,10 @@ class Metrics:
         with self._lock:
             self._histograms["training_operator_job_restart_seconds"][(namespace, framework)].append(seconds)
 
+    def histogram_values(self, name: str, namespace: str, framework: str):
+        with self._lock:
+            return list(self._histograms[name][(namespace, framework)])
+
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
             self._gauges[name] = value

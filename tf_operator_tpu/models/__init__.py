@@ -8,6 +8,6 @@ BASELINE.md Llama-2-7B target), `mnist` (MLP/CNN parity with dist-mnist),
 `resnet` and `bert` (the ResNet-50 / BERT-base BASELINE configs).
 """
 
-from . import llama
+from . import bert, llama, mnist, resnet
 
-__all__ = ["llama"]
+__all__ = ["bert", "llama", "mnist", "resnet"]

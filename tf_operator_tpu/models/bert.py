@@ -1,0 +1,171 @@
+"""BERT encoder (Flax) — the BASELINE.md "BERT-base PyTorchJob PJRT/XLA"
+config, built natively instead of routed through torch-XLA.
+
+The reference runs BERT as a PyTorchJob user container over c10d
+(pytorch.go:27-82 env contract). TPU-natively the same workload is this
+Flax encoder trained under `pjit`; the PyTorchJob controller remains for
+genuine torch containers, but the framework's own path needs no bridge.
+
+TPU-first choices mirror the Llama flagship: bf16 params/activations,
+fp32 softmax via the shared attention op (Pallas flash kernel on TPU),
+remat per layer, static shapes (pad/truncate to `max_len`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import flash_attention, xla_attention
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    ffn_dim: int = 3072
+    max_len: int = 512
+    type_vocab_size: int = 2
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.bfloat16
+    remat: bool = True
+    # Bidirectional attention cannot use the causal flash kernel's masking
+    # shortcut with padding masks; "xla" is the safe default off-TPU.
+    attention_impl: str = "xla"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def param_count(self) -> int:
+        d, f = self.dim, self.ffn_dim
+        embed = (self.vocab_size + self.max_len + self.type_vocab_size) * d + 2 * d
+        per_layer = 4 * d * d + 4 * d + 2 * d * f + d + f + 4 * d
+        return int(embed + self.n_layers * per_layer)
+
+    def flops_per_token(self, seq: Optional[int] = None) -> float:
+        p = self.param_count()
+        attn = 12 * self.n_layers * self.dim * (seq or self.max_len)
+        return 6 * p + attn
+
+
+CONFIGS = {
+    "bert-base": BertConfig(),
+    "bert-large": BertConfig(dim=1024, n_layers=24, n_heads=16, ffn_dim=4096),
+    "bert-tiny": BertConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, ffn_dim=128, max_len=128,
+        remat=False,
+    ),
+}
+
+
+class SelfAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask):
+        cfg = self.config
+        b, s, _ = x.shape
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (cfg.n_heads, cfg.head_dim),
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name=name,
+        )
+        q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
+        if cfg.attention_impl == "pallas" and attention_mask is None:
+            out = flash_attention(q, k, v, causal=False)
+        else:
+            # Additive mask folded into the fp32 scores.
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+            ) / jnp.sqrt(cfg.head_dim).astype(jnp.float32)
+            if attention_mask is not None:
+                bias = jnp.where(attention_mask[:, None, None, :], 0.0, -1e9)
+                scores = scores + bias
+            probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = out.reshape(b, s, cfg.dim)
+        return nn.Dense(cfg.dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="out")(out)
+
+
+class Layer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask):
+        cfg = self.config
+        ln = lambda name: nn.LayerNorm(  # noqa: E731
+            epsilon=cfg.norm_eps, dtype=jnp.float32, param_dtype=jnp.float32, name=name
+        )
+        # Post-LN, the original BERT arrangement.
+        attn = SelfAttention(cfg, name="attention")(x, attention_mask)
+        x = ln("ln_attn")((x + attn).astype(jnp.float32)).astype(cfg.dtype)
+        h = nn.Dense(cfg.ffn_dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="ffn_in")(x)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="ffn_out")(h)
+        return ln("ln_ffn")((x + h).astype(jnp.float32)).astype(cfg.dtype)
+
+
+class Bert(nn.Module):
+    """Encoder + tied-embedding MLM head; returns vocab logits (fp32)."""
+
+    config: BertConfig = BertConfig()
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
+        cfg = self.config
+        b, s = input_ids.shape
+        tok = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="tok_embed")
+        pos = nn.Embed(cfg.max_len, cfg.dim, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="pos_embed")
+        typ = nn.Embed(cfg.type_vocab_size, cfg.dim, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="type_embed")
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = tok(input_ids) + pos(jnp.arange(s)[None, :]) + typ(token_type_ids)
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
+                         param_dtype=jnp.float32, name="ln_embed")(
+            x.astype(jnp.float32)
+        ).astype(cfg.dtype)
+
+        layer_cls = Layer
+        if cfg.remat:
+            layer_cls = nn.remat(Layer, static_argnums=())
+        for i in range(cfg.n_layers):
+            x = layer_cls(cfg, name=f"layer_{i}")(x, attention_mask)
+
+        # MLM head with tied input embedding.
+        x = nn.Dense(cfg.dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     name="mlm_transform")(x)
+        x = nn.gelu(x)
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
+                         param_dtype=jnp.float32, name="mlm_ln")(
+            x.astype(jnp.float32)
+        )
+        logits = jnp.einsum(
+            "bsd,vd->bsv", x, tok.embedding.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        bias = self.param("mlm_bias", nn.initializers.zeros, (cfg.vocab_size,), jnp.float32)
+        return logits + bias
+
+
+def make_model(name_or_config="bert-base") -> Bert:
+    if isinstance(name_or_config, str):
+        return Bert(CONFIGS[name_or_config])
+    return Bert(name_or_config)
+
+
+def init_params(model: Bert, rng, batch: int = 1, seq: Optional[int] = None):
+    seq = seq or model.config.max_len
+    ids = jnp.zeros((batch, seq), jnp.int32)
+    return model.init(rng, ids)["params"]

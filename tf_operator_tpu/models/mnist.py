@@ -1,0 +1,93 @@
+"""MNIST ConvNet (Flax) — parity with the reference's dist-mnist workload.
+
+The reference's canonical e2e example is examples/tensorflow/dist-mnist/
+dist_mnist.py (between-graph PS/Worker training, SyncReplicasOptimizer,
+dist_mnist.py:98-143). This is its TPU-native counterpart: the same
+two-conv/two-dense topology, trained data-parallel with `pjit` over the
+mesh that `tpu_init` builds — the BASELINE.md "MNIST single-worker TFJob →
+functional" row.
+
+Runs on anything (CPU dev box → one TPU chip → a slice); images are NHWC
+fp32 in, compute in bf16, logits fp32.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class MnistConfig:
+    num_classes: int = 10
+    hidden: int = 128
+    dtype: Any = jnp.bfloat16
+
+
+class MnistCNN(nn.Module):
+    """conv5x5x32 → pool → conv5x5x64 → pool → dense → logits, the
+    dist_mnist.py topology (dist_mnist.py:148-186)."""
+
+    config: MnistConfig = MnistConfig()
+
+    @nn.compact
+    def __call__(self, images):
+        cfg = self.config
+        x = images.astype(cfg.dtype)
+        if x.ndim == 3:
+            x = x[..., None]  # [b, 28, 28] -> NHWC
+        x = nn.Conv(32, (5, 5), padding="SAME", dtype=cfg.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME", dtype=cfg.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(cfg.hidden, dtype=cfg.dtype)(x)
+        x = nn.relu(x)
+        logits = nn.Dense(cfg.num_classes, dtype=jnp.float32)(x)
+        return logits.astype(jnp.float32)
+
+
+def make_model(config: Optional[MnistConfig] = None) -> MnistCNN:
+    return MnistCNN(config or MnistConfig())
+
+
+def init_params(model: MnistCNN, rng, batch: int = 1):
+    images = jnp.zeros((batch, 28, 28, 1), jnp.float32)
+    return model.init(rng, images)["params"]
+
+
+def loss_and_accuracy(model: MnistCNN, params, images, labels):
+    logits = model.apply({"params": params}, images)
+    one_hot = jax.nn.one_hot(labels, logits.shape[-1])
+    loss = -jnp.mean(jnp.sum(one_hot * jax.nn.log_softmax(logits), axis=-1))
+    accuracy = jnp.mean(jnp.argmax(logits, -1) == labels)
+    return loss, accuracy
+
+
+class SyntheticMnist:
+    """Deterministic synthetic digits: class-dependent blobs, learnable in a
+    few steps — stands in for the real download in hermetic environments
+    (the reference's e2e substitutes a controllable test-server the same
+    way, SURVEY.md §4 T3)."""
+
+    def __init__(self, batch: int, seed: int = 0):
+        self.batch = batch
+        self._rng = np.random.default_rng(seed)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        labels = self._rng.integers(0, 10, size=(self.batch,))
+        images = self._rng.normal(0.1, 0.25, size=(self.batch, 28, 28, 1))
+        # Signal: a bright row per class.
+        for i, lab in enumerate(labels):
+            images[i, 2 + 2 * lab : 4 + 2 * lab, :, 0] += 1.5
+        return images.astype(np.float32), labels.astype(np.int32)

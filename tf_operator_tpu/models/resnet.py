@@ -1,0 +1,133 @@
+"""ResNet (Flax) — the BASELINE.md "ResNet-50 TPUStrategy" config.
+
+The reference drives ResNet through TF's TPUStrategy inside user
+containers; TPU-natively the same job is a JAXJob running this model
+data-parallel under `pjit`. TPU-first choices:
+
+- NHWC layout (XLA:TPU's native conv layout) with bf16 compute.
+- BatchNorm statistics in fp32; `axis_name="batch"` cross-replica sync is
+  the caller's choice (pass use_running_average for eval).
+- All convs stride through `nn.Conv` so XLA fuses conv+BN+relu chains.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)  # ResNet-50
+    num_classes: int = 1000
+    num_filters: int = 64
+    bottleneck: bool = True
+    dtype: Any = jnp.bfloat16
+    # Cross-replica BatchNorm axis (sync-BN). Only valid under
+    # pmap/shard_map with this axis bound; plain pjit data-parallel keeps
+    # per-shard stats (None), which is the usual large-batch choice.
+    sync_bn_axis: Any = None
+
+
+CONFIGS = {
+    "resnet18": ResNetConfig(stage_sizes=(2, 2, 2, 2), bottleneck=False),
+    "resnet50": ResNetConfig(),
+    "resnet101": ResNetConfig(stage_sizes=(3, 4, 23, 3)),
+    # CI/dev-sized: two tiny stages, 8 classes.
+    "resnet-tiny": ResNetConfig(
+        stage_sizes=(1, 1), num_classes=8, num_filters=8, bottleneck=False
+    ),
+}
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides, name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides, name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    config: ResNetConfig = ResNetConfig()
+
+    @nn.compact
+    def __call__(self, images, train: bool = True):
+        cfg = self.config
+        conv = partial(nn.Conv, use_bias=False, dtype=cfg.dtype)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=cfg.dtype,
+            param_dtype=jnp.float32,  # BN stats/params stay fp32
+            axis_name=cfg.sync_bn_axis if train else None,
+        )
+        block = BottleneckBlock if cfg.bottleneck else BasicBlock
+
+        x = images.astype(cfg.dtype)
+        x = conv(cfg.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(cfg.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = block(cfg.num_filters * 2**i, strides, conv, norm)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        logits = nn.Dense(cfg.num_classes, dtype=jnp.float32)(x)
+        return logits.astype(jnp.float32)
+
+
+def make_model(name_or_config="resnet50") -> ResNet:
+    if isinstance(name_or_config, str):
+        return ResNet(CONFIGS[name_or_config])
+    return ResNet(name_or_config)
+
+
+def init_variables(model: ResNet, rng, batch: int = 1, image_size: int = 224):
+    """Returns the full variable dict: {'params', 'batch_stats'}."""
+    images = jnp.zeros((batch, image_size, image_size, 3), jnp.float32)
+    return model.init(rng, images, train=False)

@@ -32,6 +32,22 @@ from ..bootstrap import jaxdist
 _initialized = False
 
 
+def _force_declared_platform() -> None:
+    """Make an explicit JAX_PLATFORMS env choice stick.
+
+    Some images register an out-of-process TPU PJRT plugin from
+    sitecustomize that wins over a plain env override; routing the value
+    through jax.config (before first device use) restores the declared
+    behaviour, so a CPU dev/e2e run cannot silently grab a real chip."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    import jax
+
+    if jax.config.jax_platforms != plat:
+        jax.config.update("jax_platforms", plat)
+
+
 @dataclass(frozen=True)
 class Topology:
     """The operator-declared view of this process and its slice."""
@@ -107,6 +123,7 @@ def initialize(
     tf.train.Server construction time.
     """
     global _initialized
+    _force_declared_platform()
     topo = topology or topology_from_env()
     # Local mode must NOT latch: a pre-env probe call (import-time init, a
     # notebook) would otherwise make the later real rendezvous a silent no-op.

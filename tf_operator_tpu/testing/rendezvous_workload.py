@@ -13,17 +13,8 @@ import sys
 
 
 def main() -> int:
-    import os
-
     import jax
     import jax.numpy as jnp
-
-    # This image's sitecustomize registers a TPU PJRT plugin that ignores a
-    # plain JAX_PLATFORMS env override; force it through the config so the
-    # CPU e2e tier cannot silently grab the real chip.
-    plat = os.environ.get("JAX_PLATFORMS")
-    if plat:
-        jax.config.update("jax_platforms", plat)
 
     from tf_operator_tpu.runtime.tpu_init import global_mesh, initialize
 

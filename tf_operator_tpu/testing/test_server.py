@@ -10,7 +10,8 @@ of the operator-injected env (process id/count, slice coords, mesh axes).
 The server derives its own bind address the same way a TF worker does —
 from TF_CONFIG's cluster spec at [task.type][task.index] — so it listens on
 exactly the address the operator's service DNS points at. Under
-LocalProcessCluster that address has been rewritten to a loopback port.
+LocalProcessCluster that hostname has been rewritten to the service's own
+loopback alias IP (declared port preserved).
 
 Endpoints:
   GET /runconfig          observed TF view: task type/index, cluster spec

@@ -1,0 +1,92 @@
+"""Checkpoint/resume for training workloads (orbax).
+
+The reference deliberately keeps checkpointing OUT of the operator
+(SURVEY.md §5.4): restart semantics assume the framework resumes from its
+own checkpoints, and the operator only contributes restart orchestration
+plus stable identities. This module is the workload half of that contract:
+sharded async orbax checkpoints keyed by step, so a replica recreated by
+the ExitCode restart policy resumes exactly where the gang left off.
+
+TPU-first: saves are async (training continues while the previous state
+streams to storage) and restores are sharding-aware (each host reads only
+its own shards — no host ever materializes the full 7B state).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+
+
+class CheckpointManager:
+    """Thin wrapper over orbax CheckpointManager bound to one TrainState
+    sharding, so save/restore round-trips preserve the mesh layout."""
+
+    def __init__(
+        self,
+        directory: str,
+        sharding: Any = None,
+        max_to_keep: int = 3,
+        save_interval_steps: int = 1,
+    ):
+        import os
+
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.sharding = sharding
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(directory),  # orbax requires absolute paths
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                enable_async_checkpointing=True,
+            ),
+        )
+
+    def save(self, state, force: bool = False) -> bool:
+        """Async save at the state's own step counter. A step that is
+        already on disk is a no-op (a final flush after a periodic save
+        lands on the same step)."""
+        step = int(jax.device_get(state.step))
+        if self._mgr.latest_step() == step:
+            return False
+        return self._mgr.save(
+            step, args=self._ocp.args.StandardSave(state), force=force
+        )
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore_latest(self, state) -> Tuple[Any, Optional[int]]:
+        """Restore the newest checkpoint into `state`'s structure/shardings;
+        returns (state, step) — (input unchanged, None) when no checkpoint
+        exists yet (first boot of the job)."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return state, None
+
+        def as_abstract(leaf, shard):
+            return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=shard)
+
+        if self.sharding is not None:
+            abstract = jax.tree.map(as_abstract, state, self.sharding)
+        else:
+            abstract = jax.tree.map(
+                lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=leaf.sharding)
+                if hasattr(leaf, "sharding")
+                else jax.ShapeDtypeStruct(leaf.shape, leaf.dtype),
+                state,
+            )
+        restored = self._mgr.restore(
+            step, args=self._ocp.args.StandardRestore(abstract)
+        )
+        return restored, step
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()

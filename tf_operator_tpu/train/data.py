@@ -27,3 +27,17 @@ class SyntheticTokens:
         return self._rng.integers(
             0, self.vocab_size, size=(self.batch, self.seq + 1), dtype=np.int32
         )
+
+
+def shard_batch(batch, sharding):
+    """Place one host batch onto its data sharding.
+
+    Single process: a plain transfer. Multi-process: `batch` is this
+    process's LOCAL shard and JAX assembles the global array — no host ever
+    gathers the global batch (the SPMD input path, scaling-book style).
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return jax.device_put(batch, sharding)
+    return jax.make_array_from_process_local_data(sharding, batch)
