@@ -43,9 +43,10 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.bfloat16
     remat: bool = True
-    # "pallas" (TPU flash kernel), "xla" (einsum softmax), "ring" (sequence-
+    # "pallas" (TPU flash kernel w/ custom-VJP backward; auto-falls back to
+    # the XLA path off-TPU), "xla" (einsum softmax), "ring" (sequence-
     # parallel ring attention over the sp axis; requires shard_map context).
-    attention_impl: str = "xla"
+    attention_impl: str = "pallas"
 
     @property
     def head_dim(self) -> int:

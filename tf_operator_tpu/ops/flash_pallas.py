@@ -1,25 +1,43 @@
-"""Pallas TPU flash attention (forward).
+"""Pallas TPU flash attention — forward AND backward kernels.
 
 Blockwise attention with an online softmax: K/V stream through VMEM one
 block at a time while running max/denominator/accumulator live in scratch,
 so the s×s score matrix never exists in HBM. The QKᵀ and PV contractions are
 MXU matmuls; accumulation is fp32 regardless of input dtype.
 
-Grid layout: (batch, q_heads, q_blocks, k_blocks) with the K dimension
+Layout: the public API is BSHD (what the model's DenseGeneral produces), but
+the kernels run in BHSD — TPU block shapes must put the two tiled axes
+(seq, head_dim) last so blocks are (sublane, lane) = (block_q, head_dim)
+aligned; a leading-1 head axis inside the block would violate the (8, 128)
+tiling rule. The wrapper transposes at the boundary (a bandwidth-bound copy
+XLA fuses with neighbors, negligible next to the attention matmuls).
+
+Forward grid: (batch, q_heads, q_blocks, k_blocks) with the K dimension
 innermost — TPU grids execute the last axis sequentially on one core, which
 is exactly what the online-softmax recurrence needs. GQA is free: the K/V
 index maps collapse a group of query heads onto their shared KV head, so
 grouped heads reread the same K/V block from HBM instead of materializing a
 repeated tensor (the XLA fallback in attention.py pays that repeat).
 
-Causal jobs skip whole blocks above the diagonal (`pl.when`), halving the
-work; the diagonal block applies an iota row/col mask.
+The backward is the FlashAttention-2 recurrence, split into two kernels so
+each output has a single sequential accumulation axis:
 
-The backward pass deliberately stays with XLA: `flash_attention` in
-attention.py is wrapped in `jax.checkpoint` policies by the train step, and
-recomputing the XLA forward for the VJP is within a few percent of a
-hand-written Pallas backward at the sizes we train (head_dim ≤ 128) —
-measured via bench.py before committing to kernel complexity.
+- dQ kernel: same grid as the forward (K innermost); recomputes the block's
+  probabilities from the saved per-row logsumexp (no stored s×s matrix),
+  then accumulates dQ += dS·K in fp32 scratch.
+- dK/dV kernel: grid (batch, kv_heads, k_blocks, group, q_blocks) with the
+  query-head group and Q blocks innermost — both axes accumulate into the
+  same dK/dV block, which also sums GQA gradients across the grouped query
+  heads without a separate reduction pass.
+
+Residuals are (q, k, v, o, lse): O(s) extra memory, the defining flash
+property. lse/delta ride along as [b, h, s, 1] so their blocks are
+(block_q, 1) — trailing dim equal to the array's, sublane dim 8-aligned.
+`delta = rowsum(dO∘O)` is precomputed by XLA (one fused elementwise pass)
+rather than a third kernel.
+
+Causal jobs skip whole blocks on the wrong side of the diagonal (`pl.when`),
+halving the work in all three kernels; diagonal blocks apply an iota mask.
 """
 
 from __future__ import annotations
@@ -46,11 +64,13 @@ def _block_size(want: int, total: int) -> int:
     return max(size, 1)
 
 
+# --------------------------------------------------------------- forward
 def _flash_kernel(
-    q_ref,
-    k_ref,
-    v_ref,
-    o_ref,
+    q_ref,  # [1, 1, block_q, d]
+    k_ref,  # [1, 1, block_k, d]
+    v_ref,  # [1, 1, block_k, d]
+    o_ref,  # [1, 1, block_q, d]
+    lse_ref,  # [1, 1, block_q, 1]
     m_ref,
     l_ref,
     acc_ref,
@@ -74,9 +94,9 @@ def _flash_kernel(
     k_start = ki * block_k
 
     def _compute():
-        q = q_ref[0, :, 0, :].astype(jnp.float32) * scale
-        k = k_ref[0, :, 0, :].astype(jnp.float32)
-        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale
+        k = k_ref[0, 0, :, :].astype(jnp.float32)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
         # (block_q, block_k) scores on the MXU.
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -109,30 +129,16 @@ def _flash_kernel(
     @pl.when(ki == num_k_blocks - 1)
     def _finalize():
         l = l_ref[:, :1]
-        l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, :, 0, :] = (acc_ref[...] / l).astype(o_ref.dtype)
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0, :, :] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        # Per-row logsumexp — the only softmax statistic the backward needs.
+        lse_ref[0, 0, :, :] = m_ref[:, :1] + jnp.log(l_safe)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
-)
-def flash_attention_pallas(
-    q,
-    k,
-    v,
-    causal: bool = True,
-    block_q: int = 256,
-    block_k: int = 512,
-    interpret: bool = False,
-):
-    """BSHD flash attention. q: [b, s_q, h, d]; k/v: [b, s_k, h_kv, d] with
-    h % h_kv == 0 (GQA). Returns [b, s_q, h, d] in q.dtype."""
-    batch, s_q, heads, head_dim = q.shape
-    _, s_k, kv_heads, _ = k.shape
-    if heads % kv_heads:
-        raise ValueError(f"{heads} query heads not divisible by {kv_heads} KV heads")
-    if causal and s_q != s_k:
-        raise ValueError("causal flash kernel requires s_q == s_k (self-attention)")
+def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
+    """BHSD forward. Returns (o [b,h,s,d], lse [b,h,s,1] fp32)."""
+    batch, heads, s_q, head_dim = q.shape
+    _, kv_heads, s_k, _ = k.shape
     groups = heads // kv_heads
 
     block_q = _block_size(block_q, s_q)
@@ -150,25 +156,31 @@ def flash_attention_pallas(
         num_k_blocks=num_k_blocks,
     )
 
-    return pl.pallas_call(
+    o, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((batch, heads, s_q, 1), jnp.float32),
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec(
-                (1, block_q, 1, head_dim), lambda b, h, qi, ki: (b, qi, h, 0)
+                (1, 1, block_q, head_dim), lambda b, h, qi, ki: (b, h, qi, 0)
             ),
             pl.BlockSpec(
-                (1, block_k, 1, head_dim),
-                lambda b, h, qi, ki: (b, ki, h // groups, 0),
+                (1, 1, block_k, head_dim),
+                lambda b, h, qi, ki: (b, h // groups, ki, 0),
             ),
             pl.BlockSpec(
-                (1, block_k, 1, head_dim),
-                lambda b, h, qi, ki: (b, ki, h // groups, 0),
+                (1, 1, block_k, head_dim),
+                lambda b, h, qi, ki: (b, h // groups, ki, 0),
             ),
         ],
-        out_specs=pl.BlockSpec(
-            (1, block_q, 1, head_dim), lambda b, h, qi, ki: (b, qi, h, 0)
+        out_specs=(
+            pl.BlockSpec(
+                (1, 1, block_q, head_dim), lambda b, h, qi, ki: (b, h, qi, 0)
+            ),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, qi, ki: (b, h, qi, 0)),
         ),
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max m
@@ -180,3 +192,304 @@ def flash_attention_pallas(
         ),
         interpret=interpret,
     )(q, k, v)
+    return o, lse
+
+
+# --------------------------------------------------------------- backward
+def _dq_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dq_ref,
+    dq_acc_ref,
+    *,
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    num_k_blocks: int,
+):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _compute():
+        q = q_ref[0, 0, :, :].astype(jnp.float32)
+        k = k_ref[0, 0, :, :].astype(jnp.float32)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, :]  # (block_q, 1)
+        delta = delta_ref[0, 0, :, :]
+
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            row = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            col = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(row >= col, s, MASK_VALUE)
+        p = jnp.exp(s - lse)
+        # dP = dO Vᵀ; dS = P ∘ (dP - delta); dQ += scale · dS K
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        dq_acc_ref[...] += scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        visible = q_start + block_q - 1 >= k_start
+        pl.when(visible)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[0, 0, :, :] = dq_acc_ref[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dk_ref,
+    dv_ref,
+    dk_acc_ref,
+    dv_acc_ref,
+    *,
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    groups: int,
+    num_q_blocks: int,
+):
+    ki = pl.program_id(2)
+    g = pl.program_id(3)
+    qi = pl.program_id(4)
+
+    @pl.when((g == 0) & (qi == 0))
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _compute():
+        q = q_ref[0, 0, :, :].astype(jnp.float32)
+        k = k_ref[0, 0, :, :].astype(jnp.float32)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, :]
+        delta = delta_ref[0, 0, :, :]
+
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            row = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            col = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(row >= col, s, MASK_VALUE)
+        p = jnp.exp(s - lse)  # (block_q, block_k)
+        # dV += Pᵀ dO
+        dv_acc_ref[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        # dS = P ∘ (dP - delta); dK += scale · dSᵀ Q
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        dk_acc_ref[...] += scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        visible = q_start + block_q - 1 >= k_start
+        pl.when(visible)(_compute)
+    else:
+        _compute()
+
+    @pl.when((g == groups - 1) & (qi == num_q_blocks - 1))
+    def _finalize():
+        dk_ref[0, 0, :, :] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(causal, block_q, block_k, interpret, residuals, do):
+    q, k, v, o, lse = residuals  # all BHSD / [b,h,s,1]
+    batch, heads, s_q, head_dim = q.shape
+    _, kv_heads, s_k, _ = k.shape
+    groups = heads // kv_heads
+    scale = 1.0 / (head_dim**0.5)
+
+    block_q = _block_size(block_q, s_q)
+    block_k = _block_size(block_k, s_k)
+    num_q_blocks = s_q // block_q
+    num_k_blocks = s_k // block_k
+
+    # delta_i = Σ_d dO ∘ O — one fused XLA elementwise pass, [b, h, s, 1].
+    delta = jnp.einsum(
+        "bhsd,bhsd->bhs",
+        do.astype(jnp.float32),
+        o.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )[..., None]
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel,
+            causal=causal,
+            scale=scale,
+            block_q=block_q,
+            block_k=block_k,
+            num_k_blocks=num_k_blocks,
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=(batch, heads, num_q_blocks, num_k_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec(
+                (1, 1, block_k, head_dim), lambda b, h, qi, ki: (b, h // groups, ki, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, head_dim), lambda b, h, qi, ki: (b, h // groups, ki, 0)
+            ),
+            pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, qi, ki: (b, h, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, head_dim), lambda b, h, qi, ki: (b, h, qi, 0)
+        ),
+        scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel,
+            causal=causal,
+            scale=scale,
+            block_q=block_q,
+            block_k=block_k,
+            groups=groups,
+            num_q_blocks=num_q_blocks,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ),
+        grid=(batch, kv_heads, num_k_blocks, groups, num_q_blocks),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, head_dim),
+                lambda b, kh, ki, g, qi: (b, kh * groups + g, qi, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, head_dim), lambda b, kh, ki, g, qi: (b, kh, ki, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, head_dim), lambda b, kh, ki, g, qi: (b, kh, ki, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q, head_dim),
+                lambda b, kh, ki, g, qi: (b, kh * groups + g, qi, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q, 1), lambda b, kh, ki, g, qi: (b, kh * groups + g, qi, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q, 1), lambda b, kh, ki, g, qi: (b, kh * groups + g, qi, 0)
+            ),
+        ],
+        out_specs=(
+            pl.BlockSpec(
+                (1, 1, block_k, head_dim), lambda b, kh, ki, g, qi: (b, kh, ki, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, head_dim), lambda b, kh, ki, g, qi: (b, kh, ki, 0)
+            ),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=(
+                "parallel",
+                "parallel",
+                "parallel",
+                "arbitrary",
+                "arbitrary",
+            ),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------ public api
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention(q, k, v, causal, block_q, block_k, interpret):
+    o, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_attention_fwd(q, k, v, causal, block_q, block_k, interpret):
+    o, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+_flash_attention.defvjp(_flash_attention_fwd, _flash_backward)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def flash_attention_pallas(
+    q,
+    k,
+    v,
+    causal: bool = True,
+    block_q: int = 256,
+    block_k: int = 512,
+    interpret: bool = False,
+):
+    """BSHD flash attention, differentiable (custom VJP → Pallas backward).
+    q: [b, s_q, h, d]; k/v: [b, s_k, h_kv, d] with h % h_kv == 0 (GQA).
+    Returns [b, s_q, h, d] in q.dtype."""
+    batch, s_q, heads, head_dim = q.shape
+    _, s_k, kv_heads, _ = k.shape
+    if heads % kv_heads:
+        raise ValueError(f"{heads} query heads not divisible by {kv_heads} KV heads")
+    if causal and s_q != s_k:
+        raise ValueError("causal flash kernel requires s_q == s_k (self-attention)")
+    out = _flash_attention(
+        jnp.swapaxes(q, 1, 2),
+        jnp.swapaxes(k, 1, 2),
+        jnp.swapaxes(v, 1, 2),
+        causal,
+        block_q,
+        block_k,
+        interpret,
+    )
+    return jnp.swapaxes(out, 1, 2)
