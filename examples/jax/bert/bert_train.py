@@ -48,7 +48,6 @@ def main(argv=None) -> int:
         args.model = "bert-base" if on_tpu else "bert-tiny"
     cfg = bert.CONFIGS[args.model]
     if not on_tpu:
-        args.seq = min(args.seq, cfg.max_len)
         args.batch = min(args.batch, 2 * n)
     args.seq = min(args.seq, cfg.max_len)
     print(

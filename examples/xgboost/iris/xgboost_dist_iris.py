@@ -34,15 +34,33 @@ def main() -> int:
         # tracker on the Master replica).
         from xgboost.tracker import RabitTracker
 
-        tracker = RabitTracker(host_ip="0.0.0.0", n_workers=world_size, port=port)
-        tracker.start()
+        try:  # xgboost >= 2.x signature
+            tracker = RabitTracker(host_ip="0.0.0.0", n_workers=world_size, port=port)
+            tracker.start()
+        except TypeError:  # 1.x: (hostIP=..., nslave=...), start(nslave)
+            tracker = RabitTracker(hostIP="0.0.0.0", nslave=world_size, port=port)
+            tracker.start(world_size)
 
-    args = [
-        f"DMLC_TRACKER_URI={master}",
-        f"DMLC_TRACKER_PORT={port}",
-        f"DMLC_TASK_ID={rank}",
-    ]
-    with xgb.rabit.RabitContext([a.encode() for a in args]) if world_size > 1 else _null():
+    if world_size > 1 and hasattr(xgb, "collective"):
+        # xgboost >= 2.0: xgb.rabit was removed; join via collective.
+        ctx = xgb.collective.CommunicatorContext(
+            dmlc_communicator="rabit",
+            dmlc_tracker_uri=master,
+            dmlc_tracker_port=port,
+            dmlc_task_id=str(rank),
+        )
+    elif world_size > 1:
+        args = [
+            f"DMLC_TRACKER_URI={master}",
+            f"DMLC_TRACKER_PORT={port}",
+            f"DMLC_TASK_ID={rank}",
+        ]
+        ctx = xgb.rabit.RabitContext([a.encode() for a in args])
+    else:
+        import contextlib
+
+        ctx = contextlib.nullcontext()
+    with ctx:
         rng = np.random.default_rng(rank)
         # Synthetic iris-like data (4 features, 3 classes), sharded by rank.
         n = 50
@@ -59,14 +77,6 @@ def main() -> int:
         acc = float((pred == y).mean())
         print(f"[xgb-iris] rank {rank}/{world_size} accuracy {acc:.3f}", flush=True)
     return 0
-
-
-class _null:
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *a):
-        return False
 
 
 if __name__ == "__main__":

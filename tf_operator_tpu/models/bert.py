@@ -83,15 +83,11 @@ class SelfAttention(nn.Module):
         if cfg.attention_impl == "pallas" and attention_mask is None:
             out = flash_attention(q, k, v, causal=False)
         else:
-            # Additive mask folded into the fp32 scores.
-            scores = jnp.einsum(
-                "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-            ) / jnp.sqrt(cfg.head_dim).astype(jnp.float32)
+            # Additive padding mask folded into the shared fp32-softmax path.
+            bias = None
             if attention_mask is not None:
                 bias = jnp.where(attention_mask[:, None, None, :], 0.0, -1e9)
-                scores = scores + bias
-            probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+            out = xla_attention(q, k, v, causal=False, bias=bias)
         out = out.reshape(b, s, cfg.dim)
         return nn.Dense(cfg.dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="out")(out)
 

@@ -24,12 +24,16 @@ def _repeat_kv(q, k, v):
     return k, v
 
 
-def xla_attention(q, k, v, causal: bool = True):
-    """Reference implementation: einsum + fp32 softmax (fused by XLA)."""
+def xla_attention(q, k, v, causal: bool = True, bias=None):
+    """Reference implementation: einsum + fp32 softmax (fused by XLA).
+    `bias` is an optional additive fp32 score bias broadcastable to
+    [b, h, s_q, s_k] (padding masks etc.)."""
     k, v = _repeat_kv(q, k, v)
     head_dim = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
     scores = scores / jnp.sqrt(head_dim).astype(jnp.float32)
+    if bias is not None:
+        scores = scores + bias
     if causal:
         s_q, s_k = q.shape[1], k.shape[1]
         mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
