@@ -1,0 +1,50 @@
+"""``python -m ci`` — run the CI DAG locally (the reference's Prow/Argo
+entry point, minus the cluster)."""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+from .dag import DagRun, default_dag, run_dag
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="Run the CI DAG")
+    parser.add_argument("--junit", default="", help="Write junit XML here")
+    parser.add_argument("--max-parallel", type=int, default=2)
+    parser.add_argument("--only", nargs="*", default=None, help="Subset of step names (plus their deps)")
+    args = parser.parse_args(argv)
+
+    steps = default_dag()
+    if args.only:
+        by_name = {s.name: s for s in steps}
+        unknown = [n for n in args.only if n not in by_name]
+        if unknown:
+            print(
+                f"unknown step(s) {unknown}; available: {sorted(by_name)}",
+                file=sys.stderr,
+            )
+            return 2
+        keep = set(args.only)
+        changed = True
+        while changed:
+            changed = False
+            for name in list(keep):
+                for d in by_name[name].deps:
+                    if d not in keep:
+                        keep.add(d)
+                        changed = True
+        steps = [s for s in steps if s.name in keep]
+
+    run: DagRun = run_dag(steps, max_parallel=args.max_parallel)
+    for r in run.results.values():
+        print(f"[ci] {r.name}: {r.status} ({r.duration:.1f}s, {r.attempts} attempts)")
+    if args.junit:
+        pathlib.Path(args.junit).write_text(run.junit_xml())
+    return 0 if run.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,210 @@
+"""CI DAG runner — the plain-Python replacement for the reference's
+Argo/ksonnet workflow tree (test/workflows/components/workflows.libsonnet:
+218-300 plus a 95k-LoC vendored jsonnet tree; SURVEY.md §7 anti-goals say:
+don't reintroduce that).
+
+A workflow is a list of Steps with dependencies; the runner executes them in
+dependency order with bounded parallelism, per-step retries (the reference
+test_runner.py:23-67 retries each test `num_trials` times), captures
+per-step logs, and writes a junit-style XML report any CI system ingests.
+
+The default DAG mirrors the reference's Argo step list (build, then the
+test suites fanned out in parallel) with this repo's tiers.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import threading
+import time
+import xml.sax.saxutils as sx
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+
+@dataclass
+class Step:
+    name: str
+    command: Sequence[str]
+    deps: Sequence[str] = ()
+    retries: int = 1  # total attempts
+    timeout: Optional[float] = None
+
+
+@dataclass
+class StepResult:
+    name: str
+    status: str  # "passed" | "failed" | "skipped"
+    attempts: int
+    duration: float
+    log: str = ""
+
+
+class CycleError(ValueError):
+    pass
+
+
+def _validate(steps: Sequence[Step]) -> Dict[str, Step]:
+    by_name = {}
+    for s in steps:
+        if s.name in by_name:
+            raise ValueError(f"duplicate step {s.name!r}")
+        by_name[s.name] = s
+    for s in steps:
+        for d in s.deps:
+            if d not in by_name:
+                raise ValueError(f"step {s.name!r} depends on unknown {d!r}")
+    # Kahn's algorithm for cycle detection.
+    indeg = {n: len(set(s.deps)) for n, s in by_name.items()}
+    ready = [n for n, d in indeg.items() if d == 0]
+    seen = 0
+    while ready:
+        n = ready.pop()
+        seen += 1
+        for s in by_name.values():
+            if n in s.deps:
+                indeg[s.name] -= 1
+                if indeg[s.name] == 0:
+                    ready.append(s.name)
+    if seen != len(by_name):
+        raise CycleError("dependency cycle in DAG")
+    return by_name
+
+
+@dataclass
+class DagRun:
+    results: Dict[str, StepResult] = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return all(r.status == "passed" for r in self.results.values())
+
+    def junit_xml(self) -> str:
+        cases = []
+        for r in self.results.values():
+            body = ""
+            if r.status == "failed":
+                body = (
+                    f'<failure message="failed after {r.attempts} attempts">'
+                    f"{sx.escape(r.log[-4000:])}</failure>"
+                )
+            elif r.status == "skipped":
+                body = "<skipped/>"
+            name = sx.escape(r.name, {'"': "&quot;"})
+            cases.append(
+                f'<testcase name="{name}" time="{r.duration:.2f}">{body}</testcase>'
+            )
+        failures = sum(1 for r in self.results.values() if r.status == "failed")
+        return (
+            '<?xml version="1.0"?>\n'
+            f'<testsuite name="ci-dag" tests="{len(cases)}" failures="{failures}">\n'
+            + "\n".join(cases)
+            + "\n</testsuite>\n"
+        )
+
+
+def run_dag(
+    steps: Sequence[Step],
+    max_parallel: int = 4,
+    log=print,
+    runner=None,
+) -> DagRun:
+    """Execute the DAG. A step whose dependency failed is skipped. `runner`
+    overrides subprocess execution for tests: fn(step) -> (returncode, log)."""
+    by_name = _validate(steps)
+    run = DagRun()
+    lock = threading.Lock()
+    done = threading.Condition(lock)
+
+    def dep_status(step: Step) -> str:
+        with lock:
+            sts = [run.results.get(d) for d in step.deps]
+        if any(s is not None and s.status in ("failed", "skipped") for s in sts):
+            return "blocked"
+        if all(s is not None for s in sts):
+            return "ready"
+        return "waiting"
+
+    def execute(step: Step) -> None:
+        t0 = time.monotonic()
+        attempts = 0
+        status, logtxt = "failed", ""
+        for attempts in range(1, max(step.retries, 1) + 1):
+            if runner is not None:
+                code, logtxt = runner(step)
+            else:
+                try:
+                    proc = subprocess.run(
+                        list(step.command),
+                        capture_output=True,
+                        text=True,
+                        timeout=step.timeout,
+                    )
+                    code, logtxt = proc.returncode, proc.stdout + proc.stderr
+                except subprocess.TimeoutExpired as e:
+                    code, logtxt = 124, f"timeout after {e.timeout}s"
+                except Exception as e:  # missing binary etc. — a crashed
+                    # worker thread must still record a result, or the DAG
+                    # hangs (dependents wait forever) or reports green.
+                    code, logtxt = 127, f"{type(e).__name__}: {e}"
+            if code == 0:
+                status = "passed"
+                break
+            log(f"[ci] {step.name}: attempt {attempts} failed (rc={code})")
+        with done:
+            run.results[step.name] = StepResult(
+                step.name, status, attempts, time.monotonic() - t0, logtxt
+            )
+            done.notify_all()
+
+    pending = dict(by_name)
+    threads: List[threading.Thread] = []
+    sem = threading.Semaphore(max_parallel)
+    while pending:
+        started = []
+        for name, step in pending.items():
+            st = dep_status(step)
+            if st == "blocked":
+                with done:
+                    run.results[name] = StepResult(name, "skipped", 0, 0.0)
+                    done.notify_all()
+                started.append(name)
+            elif st == "ready":
+                def _wrapped(s=step):
+                    with sem:
+                        log(f"[ci] {s.name}: start")
+                        execute(s)
+                        log(f"[ci] {s.name}: {run.results[s.name].status}")
+
+                t = threading.Thread(target=_wrapped, daemon=True)
+                t.start()
+                threads.append(t)
+                started.append(name)
+        for name in started:
+            pending.pop(name)
+        if not started and pending:
+            with done:
+                done.wait(timeout=0.5)
+    for t in threads:
+        t.join()
+    return run
+
+
+PY = sys.executable or "python3"
+
+
+def default_dag() -> List[Step]:
+    """The repo's CI workflow: mirror of the reference Argo step fan-out
+    (workflows.libsonnet:258-291) over this repo's tiers."""
+    pytest = [PY, "-m", "pytest", "-x", "-q"]
+    return [
+        Step("build", [PY, "-m", "compileall", "-q", "tf_operator_tpu", "examples", "ci"]),
+        Step("unit-api", pytest + ["tests/test_api_defaults.py", "tests/test_api_validation.py"], deps=["build"]),
+        Step("unit-controllers", pytest + ["tests/test_controller_tensorflow.py", "tests/test_controllers_frameworks.py"], deps=["build"]),
+        Step("operator-integration", pytest + ["tests/test_cli.py", "tests/test_metrics_latency.py", "tests/test_manifests.py"], deps=["unit-controllers"]),
+        Step("e2e-process", pytest + ["tests/test_e2e_process.py"], deps=["operator-integration"], retries=2),
+        Step("sdk", pytest + ["tests/test_sdk.py"], deps=["unit-api"]),
+        Step("workload", pytest + ["tests/test_models.py", "tests/test_flash_pallas.py", "tests/test_workload_tier.py", "tests/test_runtime.py"], deps=["build"]),
+        Step("examples", pytest + ["tests/test_examples.py"], deps=["workload"]),
+    ]

@@ -34,11 +34,13 @@ def main() -> int:
         # tracker on the Master replica).
         from xgboost.tracker import RabitTracker
 
-        try:  # xgboost >= 2.x signature
+        try:  # >= 1.7 signature
             tracker = RabitTracker(host_ip="0.0.0.0", n_workers=world_size, port=port)
-            tracker.start()
-        except TypeError:  # 1.x: (hostIP=..., nslave=...), start(nslave)
+        except TypeError:  # <= 1.6: (hostIP=..., nslave=...)
             tracker = RabitTracker(hostIP="0.0.0.0", nslave=world_size, port=port)
+        try:  # 2.x: start(); 1.x: start(n_workers)
+            tracker.start()
+        except TypeError:
             tracker.start(world_size)
 
     if world_size > 1 and hasattr(xgb, "collective"):
