@@ -1,6 +1,8 @@
 """Workload-tier tests on the 8-device virtual CPU mesh: mesh construction,
 sharding rules, model forward, and the full sharded train step."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -52,7 +54,12 @@ class TestMesh:
 
     def test_unknown_axis_raises(self):
         with pytest.raises(ValueError, match="unknown mesh axis"):
-            MeshSpec({"pp": 2})
+            MeshSpec({"zz": 2})
+
+    def test_ep_pp_axes(self):
+        mesh = standard_mesh(8, ep=2, pp=2)
+        assert dict(mesh.shape) == {"pp": 2, "fsdp": 2, "ep": 2}
+        assert mesh.axis_names == ("pp", "fsdp", "ep")
 
 
 class TestShardingRules:
@@ -292,6 +299,83 @@ class TestShardedInit:
         step_fn, _ = make_train_step(model, optimizer, mesh, state, sharding=sharding)
         state2, loss = step_fn(state, jnp.zeros((8, 17), jnp.int32))
         assert np.isfinite(float(loss))
+
+
+class TestMoE:
+    """Mixture-of-experts FFN + expert parallelism over the ep axis."""
+
+    def test_forward_shape_and_finite(self):
+        config = llama.CONFIGS["moe-tiny"]
+        model = llama.Llama(config)
+        params = llama.init_params(model, jax.random.PRNGKey(0), batch=2, seq=16)
+        logits = model.apply(params, jnp.zeros((2, 16), jnp.int32))
+        assert logits.shape == (2, 16, config.vocab_size)
+        assert np.isfinite(np.asarray(logits)).all()
+
+    def test_init_params_strips_losses_collection(self):
+        config = llama.CONFIGS["moe-tiny"]
+        model = llama.Llama(config)
+        params = llama.init_params(model, jax.random.PRNGKey(0), batch=1, seq=8)
+        assert set(params.keys()) == {"params"}
+
+    def test_aux_loss_sown_per_layer(self):
+        config = llama.CONFIGS["moe-tiny"]
+        model = llama.Llama(config)
+        params = llama.init_params(model, jax.random.PRNGKey(0), batch=1, seq=8)
+        _, mutated = model.apply(params, jnp.zeros((1, 8), jnp.int32), mutable=["losses"])
+        leaves = jax.tree.leaves(mutated["losses"])
+        # One sown value, scanned over layers -> leading n_layers dim.
+        assert leaves and leaves[0].shape[-1] == config.n_layers
+        # Load-balance loss is >= router_aux_weight (minimum at uniform routing).
+        assert float(jnp.sum(leaves[0])) >= config.router_aux_weight * 0.9
+
+    def test_expert_weights_shard_over_ep(self):
+        mesh = standard_mesh(8, ep=2, tp=2)
+        spec = spec_for_param("params/layers/feed_forward/experts_w1", 4, mesh)
+        assert spec == P(None, "ep", "fsdp", "tp")
+        spec2 = spec_for_param("params/layers/feed_forward/experts_w2", 4, mesh)
+        assert spec2 == P(None, "ep", "tp", "fsdp")
+        router = spec_for_param("params/layers/feed_forward/router/kernel", 3, mesh)
+        assert router == P(None, None, None)
+
+    def test_sharded_moe_train_step_learns(self):
+        mesh = standard_mesh(8, ep=2, tp=2)
+        config = llama.CONFIGS["moe-tiny"]
+        model = llama.Llama(config)
+        optimizer = make_optimizer(learning_rate=1e-2, warmup_steps=1, decay_steps=100)
+        state = init_train_state(model, jax.random.PRNGKey(0), optimizer, batch=4, seq=32)
+        step_fn, sharding = make_train_step(model, optimizer, mesh, state)
+        state = place_state(state, sharding)
+
+        batch = np.tile(np.arange(33, dtype=np.int32) % config.vocab_size, (4, 1))
+        first_loss = None
+        for _ in range(10):
+            state, loss = step_fn(state, jnp.asarray(batch))
+            if first_loss is None:
+                first_loss = float(loss)
+        assert float(loss) < first_loss
+        # Expert weights actually sharded over ep: [layers, e, d, f], e=4/ep=2.
+        w1 = state.params["params"]["layers"]["feed_forward"]["experts_w1"]
+        shard_shapes = {s.data.shape for s in w1.addressable_shards}
+        assert all(sh[1] == config.n_experts // 2 for sh in shard_shapes)
+
+    def test_moe_with_remat(self):
+        config = dataclasses.replace(llama.CONFIGS["moe-tiny"], remat=True)
+        model = llama.Llama(config)
+        mesh = standard_mesh(8, ep=2)
+        optimizer = make_optimizer(warmup_steps=1, decay_steps=10)
+        state = init_train_state(model, jax.random.PRNGKey(0), optimizer, batch=8, seq=16)
+        step_fn, sharding = make_train_step(model, optimizer, mesh, state)
+        state = place_state(state, sharding)
+        _, loss = step_fn(state, jnp.zeros((8, 17), jnp.int32))
+        assert np.isfinite(float(loss))
+
+    def test_active_params_less_than_total(self):
+        config = llama.CONFIGS["mixtral-8x7b"]
+        assert config.active_param_count() < config.param_count()
+        # Mixtral-8x7B ballpark: ~47B total, ~13B active.
+        assert 40e9 < config.param_count() < 55e9
+        assert 10e9 < config.active_param_count() < 16e9
 
 
 class TestGraftEntry:

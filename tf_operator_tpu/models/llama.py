@@ -47,28 +47,46 @@ class LlamaConfig:
     # the XLA path off-TPU), "xla" (einsum softmax), "ring" (sequence-
     # parallel ring attention over the sp axis; requires shard_map context).
     attention_impl: str = "pallas"
+    # Mixture-of-experts FFN (0 = dense). Experts shard over the `ep` mesh
+    # axis; routing is GShard-style top-k with a per-expert capacity.
+    n_experts: int = 0
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
         return self.dim // self.n_heads
 
     def flops_per_token(self, seq: Optional[int] = None) -> float:
-        """Approximate training FLOPs per token (fwd+bwd ≈ 6 * params +
-        attention term), for MFU accounting. Single source of truth — the
+        """Approximate training FLOPs per token (fwd+bwd ≈ 6 * active params
+        + attention term), for MFU accounting. Single source of truth — the
         bench harness must use this, not its own formula."""
-        p = self.param_count()
+        p = self.active_param_count()
         attn = 12 * self.n_layers * self.dim * (seq or self.max_seq_len)
         return 6 * p + attn
 
-    def param_count(self) -> int:
-        d, v, f = self.dim, self.vocab_size, self.ffn_dim
-        per_layer = (
+    def _per_layer_params(self, n_ffn_experts: int) -> float:
+        d, f = self.dim, self.ffn_dim
+        return (
             d * d  # wq
             + 2 * d * (self.n_kv_heads * self.head_dim)  # wk, wv
             + d * d  # wo
-            + 3 * d * f / 1  # w1, w2, w3 (w2 transposed but same count)
+            + 3 * d * f * max(n_ffn_experts, 1)  # w1, w2, w3 (per expert)
+            + (d * self.n_experts if self.n_experts else 0)  # router
             + 2 * d  # norms
         )
+
+    def param_count(self) -> int:
+        d, v = self.dim, self.vocab_size
+        per_layer = self._per_layer_params(self.n_experts)
+        return int(v * d + self.n_layers * per_layer + d + d * v)
+
+    def active_param_count(self) -> int:
+        """Params touched per token (MoE: only the top-k experts)."""
+        d, v = self.dim, self.vocab_size
+        k = self.experts_per_token if self.n_experts else 0
+        per_layer = self._per_layer_params(k)
         return int(v * d + self.n_layers * per_layer + d + d * v)
 
 
@@ -82,6 +100,19 @@ CONFIGS = {
     "llama-tiny": LlamaConfig(
         vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=128,
         max_seq_len=128, remat=False,
+    ),
+    # MoE variants (Mixtral-style: SwiGLU experts, top-2 routing, GQA).
+    "mixtral-8x7b": LlamaConfig(
+        n_kv_heads=8, ffn_dim=14336, max_seq_len=4096, rope_theta=1e6,
+        n_experts=8, experts_per_token=2,
+    ),
+    "moe-125m": LlamaConfig(
+        dim=768, n_layers=12, n_heads=12, n_kv_heads=12, ffn_dim=2048,
+        n_experts=8, experts_per_token=2,
+    ),
+    "moe-tiny": LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=128,
+        max_seq_len=128, remat=False, n_experts=4, experts_per_token=2,
     ),
 }
 
@@ -169,6 +200,87 @@ class MLP(nn.Module):
         return dense(cfg.dim, name="w2")(nn.silu(gate) * up)
 
 
+class MoE(nn.Module):
+    """Mixture-of-experts SwiGLU FFN with GShard-style capacity dispatch.
+
+    Routing is dense-algebra (one-hot dispatch/combine einsums) so the whole
+    layer is static-shaped matmuls the MXU can tile — no gather/scatter, no
+    data-dependent shapes. Expert weights carry a leading [n_experts] dim
+    sharded over the `ep` mesh axis; the dispatch einsum reshards tokens from
+    batch-over-(…,ep) to expert-over-ep, which XLA lowers to the MoE
+    all-to-all on ICI. Tokens beyond an expert's capacity
+    (capacity_factor * s * k / e) are dropped (residual passes them through).
+
+    The Switch-style load-balancing aux loss is sown into the "losses"
+    collection; the train step adds it to the LM loss (router_aux_weight).
+    """
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        from ..parallel.sharding import constrain
+
+        cfg = self.config
+        b, s, d = x.shape
+        e, k = cfg.n_experts, cfg.experts_per_token
+        cap = max(1, int(cfg.capacity_factor * s * k / e))
+
+        xf = x.astype(jnp.float32)
+        router_logits = nn.Dense(
+            e, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32,
+            kernel_init=nn.initializers.normal(0.02), name="router",
+        )(xf)  # [b, s, e]
+        probs = jax.nn.softmax(router_logits, axis=-1)
+        gate, idx = jax.lax.top_k(probs, k)  # [b, s, k]
+        gate = gate / jnp.maximum(gate.sum(-1, keepdims=True), 1e-9)
+
+        # Capacity assignment rank-major (all rank-0 choices win slots before
+        # any rank-1 choice), accumulating the [b, s, e, cap] dispatch and
+        # combine tensors one routing rank at a time — never materializing
+        # the k-times-larger [b, s, k, e, cap] intermediate. k is a static
+        # config constant, so the Python loop unrolls into one XLA graph.
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # [b, s, k, e]
+        dispatch = jnp.zeros((b, s, e, cap), jnp.float32)
+        combine = jnp.zeros((b, s, e, cap), jnp.float32)
+        taken = jnp.zeros((b, 1, e), jnp.float32)  # slots already claimed
+        for j in range(k):
+            oh = onehot[:, :, j, :]  # [b, s, e]
+            pos = jnp.cumsum(oh, axis=1) - oh + taken  # slot index per token
+            keep = (pos < cap).astype(jnp.float32) * oh
+            slot = jax.nn.one_hot(jnp.minimum(pos, cap - 1).astype(jnp.int32),
+                                  cap, dtype=jnp.float32)  # [b, s, e, cap]
+            dispatch = dispatch + keep[..., None] * slot
+            combine = combine + (keep * gate[:, :, j, None])[..., None] * slot
+            taken = taken + oh.sum(axis=1, keepdims=True)
+
+        # Dispatch: tokens -> per-expert slots. The constraint reshards the
+        # expert dim onto ep (all-to-all); batch stays on the other data axes.
+        expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, xf).astype(cfg.dtype)
+        expert_in = constrain(expert_in, "ep", ("slice", "dp", "fsdp"), None, None)
+
+        init = nn.initializers.normal(0.02)
+        w1 = self.param("experts_w1", init, (e, d, cfg.ffn_dim), cfg.param_dtype)
+        w3 = self.param("experts_w3", init, (e, d, cfg.ffn_dim), cfg.param_dtype)
+        w2 = self.param("experts_w2", init, (e, cfg.ffn_dim, d), cfg.param_dtype)
+        gate_h = jnp.einsum("ebcd,edf->ebcf", expert_in, w1.astype(cfg.dtype))
+        up_h = jnp.einsum("ebcd,edf->ebcf", expert_in, w3.astype(cfg.dtype))
+        out = jnp.einsum("ebcf,efd->ebcd", nn.silu(gate_h) * up_h, w2.astype(cfg.dtype))
+        out = constrain(out, "ep", ("slice", "dp", "fsdp"), None, None)
+
+        # Combine: weighted return all-to-all back to token layout.
+        y = jnp.einsum("bsec,ebcd->bsd", combine, out.astype(jnp.float32))
+
+        # Switch load-balance loss: e * Σ_i f_i·P_i (f = dispatch fraction,
+        # P = mean router prob); minimized at uniform routing.
+        f_frac = onehot.sum(axis=2).mean(axis=(0, 1)) / k
+        p_mean = probs.mean(axis=(0, 1))
+        aux = e * jnp.sum(f_frac * p_mean) * cfg.router_aux_weight
+        self.sow("losses", "moe_aux", aux)
+
+        return y.astype(x.dtype)
+
+
 class Block(nn.Module):
     """One decoder layer. Signature is scan-compatible: carries `x`, passes
     `positions` through as a second carry-free broadcast input."""
@@ -177,14 +289,19 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions):
+        from ..parallel.sharding import DATA_AXES, constrain
+
         cfg = self.config
+        # Pin activations to the canonical layout at block boundaries so the
+        # partitioner doesn't oscillate between layouts across the residual
+        # stream (a no-op without a scoped mesh).
+        x = constrain(x, DATA_AXES, "sp", None)
         x = x + Attention(cfg, name="attention")(
             RMSNorm(cfg.norm_eps, cfg.param_dtype, name="attention_norm")(x), positions
         )
-        x = x + MLP(cfg, name="feed_forward")(
-            RMSNorm(cfg.norm_eps, cfg.param_dtype, name="ffn_norm")(x)
-        )
-        return x, None
+        ffn = MoE(cfg, name="feed_forward") if cfg.n_experts else MLP(cfg, name="feed_forward")
+        x = x + ffn(RMSNorm(cfg.norm_eps, cfg.param_dtype, name="ffn_norm")(x))
+        return constrain(x, DATA_AXES, "sp", None), None
 
 
 class Llama(nn.Module):
@@ -220,7 +337,7 @@ class Llama(nn.Module):
             )
         scanned = nn.scan(
             block,
-            variable_axes={"params": 0},
+            variable_axes={"params": 0, "losses": 0},
             split_rngs={"params": True},
             in_axes=nn.broadcast,  # positions: same every layer
             length=cfg.n_layers,
@@ -249,4 +366,7 @@ def make_model(name_or_config) -> Llama:
 def init_params(model: Llama, rng, batch: int = 1, seq: Optional[int] = None):
     seq = seq or min(model.config.max_seq_len, 128)
     tokens = jnp.zeros((batch, seq), dtype=jnp.int32)
-    return model.init(rng, tokens)
+    variables = model.init(rng, tokens)
+    # MoE layers sow a "losses" collection during init; only "params" are
+    # trainable state (anything else here would reach the optimizer).
+    return {"params": variables["params"]}

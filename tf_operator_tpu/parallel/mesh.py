@@ -3,8 +3,12 @@
 The canonical axes, outermost (DCN) to innermost (ICI minor):
 
 - ``slice`` — across pod-slices (DCN); pure data parallelism.
+- ``pp``    — pipeline parallelism (stage-to-stage ppermute; tolerates the
+              slowest links, so it sits outermost after ``slice``).
 - ``dp``    — data parallelism over ICI.
 - ``fsdp``  — data parallelism with parameter/optimizer sharding (ZeRO-3).
+- ``ep``    — expert parallelism (MoE all-to-all dispatch; doubles as a
+              data axis for the non-expert parts of the model).
 - ``sp``    — sequence/context parallelism (ring attention over an ICI ring).
 - ``tp``    — tensor parallelism (heads/ffn); innermost so its collectives
               ride the fastest ICI links.
@@ -15,13 +19,15 @@ neighboring mesh coordinates are ICI neighbors.
 
 from __future__ import annotations
 
+import contextlib
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
 import jax
 import numpy as np
 
-AXIS_ORDER = ("slice", "dp", "fsdp", "sp", "tp")
+AXIS_ORDER = ("slice", "pp", "dp", "fsdp", "ep", "sp", "tp")
 
 
 @dataclass
@@ -73,6 +79,8 @@ def standard_mesh(
     tp: int = 1,
     sp: int = 1,
     dp: int = 1,
+    ep: int = 1,
+    pp: int = 1,
     num_slices: int = 1,
     devices: Optional[Sequence] = None,
 ) -> jax.sharding.Mesh:
@@ -80,15 +88,19 @@ def standard_mesh(
     the right default for LLM training (FSDP-dominant, TP innermost)."""
     devices = list(devices if devices is not None else jax.devices())
     n = n_devices or len(devices)
-    denom = tp * sp * dp * num_slices
+    denom = tp * sp * dp * ep * pp * num_slices
     if n % denom:
-        raise ValueError(f"{n} devices not divisible by slice*dp*sp*tp={denom}")
+        raise ValueError(f"{n} devices not divisible by slice*pp*dp*ep*sp*tp={denom}")
     axes = {}
     if num_slices > 1:
         axes["slice"] = num_slices
+    if pp > 1:
+        axes["pp"] = pp
     if dp > 1:
         axes["dp"] = dp
     axes["fsdp"] = n // denom
+    if ep > 1:
+        axes["ep"] = ep
     if sp > 1:
         axes["sp"] = sp
     if tp > 1:
@@ -98,3 +110,32 @@ def standard_mesh(
 
 def axis_size(mesh: jax.sharding.Mesh, name: str) -> int:
     return mesh.shape.get(name, 1)
+
+
+# --- current-mesh context -------------------------------------------------
+#
+# Model code sometimes needs the active mesh at trace time (to wrap an op in
+# shard_map — ring attention over `sp` — or to place a sharding constraint —
+# MoE all-to-all over `ep`). The train step sets it; model code reads it.
+# Thread-local so concurrent traces (tests) don't interfere.
+
+_MESH_TLS = threading.local()
+
+
+def set_current_mesh(mesh: Optional[jax.sharding.Mesh]) -> None:
+    _MESH_TLS.mesh = mesh
+
+
+def current_mesh() -> Optional[jax.sharding.Mesh]:
+    return getattr(_MESH_TLS, "mesh", None)
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: jax.sharding.Mesh):
+    """Scope `mesh` as the current mesh (see `current_mesh`)."""
+    prev = current_mesh()
+    set_current_mesh(mesh)
+    try:
+        yield mesh
+    finally:
+        set_current_mesh(prev)

@@ -42,6 +42,11 @@ def _present(mesh: Mesh, *axes: str) -> Tuple:
 # value selects by ndim (attention kernels are [d, heads, head_dim] when the
 # head axes are kept separate, [d, h*hd] when merged).
 _PARAM_RULES = [
+    # MoE expert weights [experts, d, ffn] / [experts, ffn, d]: experts over
+    # ep, then the usual megatron layout within each expert.
+    (r"experts.*(w1|w3|gate|up).*", ("ep", "fsdp", "tp")),
+    (r"experts.*(w2|down).*", ("ep", "tp", "fsdp")),
+    (r"router.*kernel", (None, None)),
     (r"embed(ding)?s?.*(embedding|kernel)", ("tp", "fsdp")),
     (r"(wq|wk|wv|qkv|query|key|value).*kernel", {2: ("fsdp", "tp"), 3: ("fsdp", "tp", None)}),
     (r"(wo|out_proj|o_proj|attn_out).*kernel", {2: ("tp", "fsdp"), 3: ("tp", None, "fsdp")}),
@@ -85,19 +90,38 @@ def params_sharding(params: Any, mesh: Mesh) -> Any:
     )
 
 
+DATA_AXES = ("slice", "dp", "fsdp", "ep")
+
+
 def batch_sharding(mesh: Mesh, with_sp: bool = True) -> NamedSharding:
-    """[batch, seq, ...] data sharding: batch over all data axes, sequence
-    over sp when present (ring-attention sequence parallelism)."""
-    data_axes = tuple(a for a in ("slice", "dp", "fsdp") if a in mesh.shape)
+    """[batch, seq, ...] data sharding: batch over all data axes (ep doubles
+    as a data axis outside expert compute), sequence over sp when present
+    (ring-attention sequence parallelism)."""
+    data_axes = tuple(a for a in DATA_AXES if a in mesh.shape)
     seq_axis = "sp" if (with_sp and "sp" in mesh.shape) else None
     return NamedSharding(mesh, P(data_axes if data_axes else None, seq_axis))
+
+
+def constrain(x, *axes):
+    """`with_sharding_constraint` against the current mesh; a no-op when no
+    mesh is scoped (unsharded single-chip runs) or when every named axis is
+    absent from it. Axes may be axis names, tuples of names, or None."""
+    from .mesh import current_mesh
+
+    mesh = current_mesh()
+    if mesh is None:
+        return x
+    spec = P(*_present(mesh, *axes))
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
 def logical_axis_rules(mesh: Mesh):
     """flax linen logical-axis rules equivalent for the conventions above
     (for models that use nn.with_logical_partitioning)."""
     return [
-        ("batch", tuple(a for a in ("slice", "dp", "fsdp") if a in mesh.shape) or None),
+        ("batch", tuple(a for a in DATA_AXES if a in mesh.shape) or None),
+        ("expert", "ep" if "ep" in mesh.shape else None),
+        ("stage", "pp" if "pp" in mesh.shape else None),
         ("seq", "sp" if "sp" in mesh.shape else None),
         ("vocab", "tp" if "tp" in mesh.shape else None),
         ("embed", "fsdp" if "fsdp" in mesh.shape else None),

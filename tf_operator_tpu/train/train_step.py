@@ -72,7 +72,7 @@ def init_sharded_train_state(
     tokens_shape = jnp.zeros((batch, seq), dtype=jnp.int32)
 
     def mk(rng):
-        params = model.init(rng, tokens_shape)
+        params = {"params": model.init(rng, tokens_shape)["params"]}
         return TrainState(
             step=jnp.zeros((), jnp.int32), params=params, opt_state=optimizer.init(params)
         )
@@ -84,9 +84,13 @@ def init_sharded_train_state(
 
 
 def loss_fn(model, params, tokens):
-    """Next-token LM loss: predict tokens[:, 1:] from tokens[:, :-1]."""
-    logits = model.apply(params, tokens[:, :-1])
-    return cross_entropy_loss(logits, tokens[:, 1:])
+    """Next-token LM loss: predict tokens[:, 1:] from tokens[:, :-1].
+    Any auxiliary terms a model sows into its "losses" collection (MoE
+    router load-balancing) are summed in; dense models sow nothing and the
+    collection comes back empty."""
+    logits, mutated = model.apply(params, tokens[:, :-1], mutable=["losses"])
+    aux = sum(jnp.sum(leaf) for leaf in jax.tree.leaves(mutated.get("losses", {})))
+    return cross_entropy_loss(logits, tokens[:, 1:]) + aux
 
 
 def train_step(model, optimizer, state: TrainState, tokens) -> tuple:
@@ -121,8 +125,17 @@ def make_train_step(model, optimizer, mesh: Mesh, state: TrainState, sharding=No
     if sharding is None:
         sharding = state_sharding(state, mesh)
     data = batch_sharding(mesh, with_sp=False)  # tokens: [batch, seq]
+
+    def stepper(state, tokens):
+        # Scope the mesh for trace-time consumers: sharding constraints in
+        # MoE dispatch (`constrain`) and the ring-attention shard_map wrap.
+        from ..parallel.mesh import use_mesh
+
+        with use_mesh(mesh):
+            return train_step(model, optimizer, state, tokens)
+
     step = jax.jit(
-        functools.partial(train_step, model, optimizer),
+        stepper,
         in_shardings=(sharding, data),
         out_shardings=(sharding, NamedSharding(mesh, P())),
         donate_argnums=(0,),
