@@ -303,6 +303,77 @@ class TestJAXController:
         events = {e.reason for e in self.cluster.list_events()}
         assert "JAXJobRestarting" in events
 
+    def test_elastic_slice_resize_restarts_world(self):
+        """Elastic resize (SURVEY.md §2.5 elastic row, TPU-native): scaling
+        a multislice job 2 -> 1 slices deletes EVERY live pod in one batched
+        sync (coordinated re-init), then recreates the smaller world with
+        consistent env; resize up grows it back."""
+        manifest = jax_manifest(num_slices=2)  # 2 x v5e-16 = 8 workers
+        manifest["spec"]["elastic"] = {"minSlices": 1, "maxSlices": 4}
+        self.cluster.create_job(manifest)
+        self.controller.run_until_idle()
+        for p in self.cluster.list_pods():
+            self.cluster.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+        self.controller.run_until_idle()
+        gen0 = {
+            p.metadata.labels["world-generation"] for p in self.cluster.list_pods()
+        }
+        assert len(gen0) == 1
+
+        # Scale down to one slice: numSlices and replicas patched together
+        # (what the SDK scale() helper submits).
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        job["spec"]["numSlices"] = 1
+        job["spec"]["jaxReplicaSpecs"]["Worker"]["replicas"] = 4
+        self.cluster.update_job(job)
+        self.controller.run_until_idle()
+
+        pods = self.cluster.list_pods()
+        assert len(pods) == 4
+        names = {p.metadata.name for p in pods}
+        assert names == {f"llama-worker-{i}" for i in range(4)}
+        env = {
+            e.name: e.value
+            for e in self.cluster.get_pod("default", "llama-worker-3")
+            .spec.containers[0]
+            .env
+        }
+        assert env["JAX_NUM_PROCESSES"] == "4"
+        assert env["JAX_NUM_SLICES"] == "1"
+        assert "MEGASCALE_NUM_SLICES" not in env
+        gen1 = {p.metadata.labels["world-generation"] for p in pods}
+        assert len(gen1) == 1 and gen1 != gen0
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        events = [e.reason for e in self.cluster.list_events()]
+        assert "JAXJobRestarting" in events
+
+        # Scale back up through the SDK helper.
+        from tf_operator_tpu.sdk.client import JobClient
+
+        client = JobClient(self.cluster, kind="JAXJob")
+        client.scale("llama", num_slices=2)
+        self.controller.run_until_idle()
+        pods = self.cluster.list_pods()
+        assert len(pods) == 8
+        env = {
+            e.name: e.value
+            for e in self.cluster.get_pod("default", "llama-worker-7")
+            .spec.containers[0]
+            .env
+        }
+        assert env["JAX_NUM_PROCESSES"] == "8"
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+
+    def test_elastic_bounds_validated(self):
+        manifest = jax_manifest(num_slices=2)
+        manifest["spec"]["elastic"] = {"minSlices": 3}
+        self.cluster.create_job(manifest)
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds["Failed"]["status"] == "True"
+        assert self.cluster.list_pods() == []
+
     def test_permanent_failure_after_restart_still_fails(self):
         """Regression: a recreated pod that crashes with a permanent exit
         code before ever being seen Running must fail the job — a stale

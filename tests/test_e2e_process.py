@@ -220,6 +220,65 @@ class TestTFJobTestServer:
         assert harness.list_pods("default") == []
 
 
+class TestJAXJobElasticResize:
+    def test_scale_up_recreates_world_with_live_processes(self, harness):
+        """Elastic resize against real processes: scaling 2 -> 3 workers
+        kills the whole stale world (batched) and boots a consistent larger
+        one; every surviving pod is a NEW process with the new env."""
+        harness.create_job(
+            {
+                "apiVersion": "kubeflow.org/v1",
+                "kind": "JAXJob",
+                "metadata": {"name": "el", "namespace": "default"},
+                "spec": {
+                    "elastic": {"minSlices": 1},
+                    "jaxReplicaSpecs": {
+                        "Worker": {
+                            "replicas": 2,
+                            "template": {
+                                "spec": {
+                                    "containers": [
+                                        {
+                                            "name": "jax",
+                                            "image": "local",
+                                            "command": TEST_SERVER_CMD,
+                                        }
+                                    ]
+                                }
+                            },
+                        }
+                    },
+                },
+            }
+        )
+        assert wait_for(lambda: len(harness.list_pods("default")) == 2)
+        http_get_json(harness.resolve("el-worker-0.default.svc", 1234), "/healthz")
+        t0 = harness.get_pod("default", "el-worker-0").status.start_time
+
+        job = harness.get_job("JAXJob", "default", "el")
+        job["spec"]["jaxReplicaSpecs"]["Worker"]["replicas"] = 3
+        harness.update_job(job)
+
+        def resized():
+            pods = harness.list_pods("default")
+            if len(pods) != 3:
+                return False
+            return all(p.status.phase == "Running" for p in pods)
+
+        assert wait_for(resized, timeout=60)
+        # worker-0 survived by identity but is a recreated process.
+        pod = harness.get_pod("default", "el-worker-0")
+        assert pod.status.start_time > t0
+        for i in range(3):
+            cfg = http_get_json(
+                harness.resolve(f"el-worker-{i}.default.svc", 1234), "/env"
+            )
+            assert cfg.get("JAX_NUM_PROCESSES") == "3"
+        assert any(
+            "Restarting" in e.reason for e in harness.list_events("JAXJob/default/el")
+        )
+
+
 class TestJAXJobRendezvous:
     def test_two_process_rendezvous_and_psum(self, harness):
         """SURVEY §7 stage 3, the 'minimum e2e slice': two worker processes

@@ -107,10 +107,25 @@ def chips_for(tpu: TPUSpec) -> Optional[int]:
 
 
 @dataclass
+class ElasticPolicy:
+    """Slice-granular elasticity bounds (the TPU generalization of the
+    reference's EnableDynamicWorker, types.go:69-70). The unit of elasticity
+    is a whole slice — partial slices are useless — so resizing means
+    patching numSlices (and replicas with it; SDK `scale` does both). The
+    controller then restarts the job as one gang with the new world env;
+    the workload resumes from its checkpoint."""
+
+    min_slices: int = 1
+    max_slices: Optional[int] = None
+
+
+@dataclass
 class JAXJobSpec:
     run_policy: RunPolicy = field(default_factory=RunPolicy)
     jax_replica_specs: Dict[ReplicaType, ReplicaSpec] = field(default_factory=dict)
     tpu: Optional[TPUSpec] = None
+    # Declares the job resizable in whole-slice units (None = fixed world).
+    elastic: Optional[ElasticPolicy] = None
     # Multislice: number of DCN-connected slices; each slice is one gang of
     # `hosts_for(tpu)` workers and the global mesh gains a leading DCN axis.
     num_slices: int = 1
@@ -170,6 +185,24 @@ def set_defaults(job: JAXJob) -> None:
 
 def validate(spec: JAXJobSpec) -> None:
     validate_replica_specs(spec.jax_replica_specs, DEFAULT_CONTAINER_NAME, KIND)
+    if spec.elastic is not None:
+        el = spec.elastic
+        if el.min_slices < 1:
+            raise ValidationError(
+                f"JAXJobSpec is not valid: elastic.minSlices must be >= 1, got {el.min_slices}"
+            )
+        if el.max_slices is not None and el.max_slices < el.min_slices:
+            raise ValidationError(
+                f"JAXJobSpec is not valid: elastic.maxSlices ({el.max_slices}) "
+                f"< minSlices ({el.min_slices})"
+            )
+        if spec.num_slices < el.min_slices or (
+            el.max_slices is not None and spec.num_slices > el.max_slices
+        ):
+            raise ValidationError(
+                f"JAXJobSpec is not valid: numSlices {spec.num_slices} outside "
+                f"elastic bounds [{el.min_slices}, {el.max_slices}]"
+            )
     for rtype in spec.jax_replica_specs:
         if rtype not in CANONICAL_REPLICA_TYPES:
             raise ValidationError(
@@ -204,14 +237,28 @@ def validate(spec: JAXJobSpec) -> None:
                     f"{spec.num_slices} slice(s) requires {hosts * max(1, spec.num_slices)} "
                     f"workers, got {worker.replicas}"
                 )
+    if spec.mesh and "slice" in spec.mesh and spec.mesh["slice"] != max(1, spec.num_slices):
+        raise ValidationError(
+            f"JAXJobSpec is not valid: mesh slice axis is {spec.mesh['slice']} "
+            f"but numSlices is {spec.num_slices}"
+        )
     if spec.mesh and spec.tpu is not None:
         chips = chips_for(spec.tpu)
         if chips is not None:
             total = 1
             for size in spec.mesh.values():
                 total *= size
-            if total != chips * max(1, spec.num_slices):
+            num_slices = max(1, spec.num_slices)
+            # Two accepted forms (runtime/tpu_init.py:161 auto-prepends the
+            # DCN `slice` axis when absent): a global mesh covering all
+            # chips, or a per-slice mesh covering one slice's chips. The
+            # per-slice form is resize-stable — elastic scale() never has
+            # to rewrite it.
+            global_ok = total == chips * num_slices
+            per_slice_ok = "slice" not in spec.mesh and total == chips
+            if not global_ok and not per_slice_ok:
                 raise ValidationError(
                     f"JAXJobSpec is not valid: mesh {spec.mesh} has {total} devices "
-                    f"but the job provisions {chips * max(1, spec.num_slices)} chips"
+                    f"but the job provisions {chips * num_slices} chips "
+                    f"({chips} per slice x {num_slices} slice(s))"
                 )

@@ -44,6 +44,39 @@ ENV_MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
 ENV_MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
 
 
+def world_generation(job: JAXJob) -> str:
+    """Stable hash of the SPMD world a pod's env encodes: worker count,
+    slice count, coordinator port, and mesh. Stamped as a pod label; a pod
+    whose label differs from the live spec was bootstrapped into a stale
+    world and must be recreated for the membership change to take effect
+    (all processes re-run jax.distributed.initialize — resize is a
+    coordinated re-init, not an in-place membership edit)."""
+    import hashlib
+
+    worker = job.spec.jax_replica_specs.get(jaxapi.REPLICA_TYPE_WORKER)
+    total = (worker.replicas or 1) if worker else 1
+    tpu = job.spec.tpu
+    payload = json.dumps(
+        {
+            "workers": total,
+            "slices": max(1, job.spec.num_slices),
+            "port": get_port(job),
+            "mesh": job.spec.mesh,
+            # tpu fields feed TPU_ACCELERATOR_TYPE/TPU_TOPOLOGY env: a
+            # topology patch must also roll the world, or live pods and
+            # later-recreated ones would disagree on the libtpu mesh.
+            "tpu": (
+                [tpu.accelerator_type, tpu.topology, tpu.chips_per_host]
+                if tpu is not None
+                else None
+            ),
+        },
+        sort_keys=True,
+        separators=(",", ":"),
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()[:10]
+
+
 def get_port(job: JAXJob) -> int:
     return get_container_port(
         job.spec.jax_replica_specs,

@@ -18,6 +18,10 @@ class NotFound(KeyError):
     """Object does not exist (k8s 404 analog)."""
 
 
+class Conflict(Exception):
+    """Stale resourceVersion on a full-object write (k8s 409 analog)."""
+
+
 # Watch event types
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"

@@ -35,7 +35,7 @@ from ..api.k8s import (
     Service,
 )
 from . import base
-from .base import ADDED, DELETED, MODIFIED, NotFound
+from .base import ADDED, DELETED, MODIFIED, Conflict, NotFound
 
 
 class InMemoryCluster(base.Cluster):
@@ -104,6 +104,18 @@ class InMemoryCluster(base.Cluster):
             existing = self._jobs.get((kind, ns, name))
             if existing is None:
                 raise NotFound(f"{kind} {ns}/{name}")
+            # Optimistic concurrency (apiserver semantics): a write carrying
+            # a resourceVersion must match the stored one, or a concurrent
+            # writer's change would be silently reverted by this full-object
+            # replace. Writes without one are "last write wins" (kubectl
+            # replace --force analog).
+            sent_rv = meta.get("resourceVersion")
+            stored_rv = existing.get("metadata", {}).get("resourceVersion")
+            if sent_rv is not None and stored_rv is not None and sent_rv != stored_rv:
+                raise Conflict(
+                    f"{kind} {ns}/{name}: resourceVersion {sent_rv} is stale "
+                    f"(current {stored_rv})"
+                )
             stored = copy.deepcopy(job_dict)
             # Status is a subresource: writes through the main resource must
             # not clobber it (a stale SDK read-modify-write would otherwise

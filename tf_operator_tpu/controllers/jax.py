@@ -58,7 +58,29 @@ class JAXController(FrameworkController):
             for name, value in env.items():
                 if container.get_env(name) is None:
                     container.set_env(name, value)
+        # World stamp: lets stale_world_pods detect pods whose injected env
+        # predates a resize (elastic slice membership — coordinated re-init).
+        template.metadata.labels[constants.LABEL_WORLD_GENERATION] = (
+            jaxdist.world_generation(job)
+        )
         self._attach_tpu_resources(job, template, index)
+
+    def stale_world_pods(self, job, replicas, pods) -> List:
+        """Elastic resize: any pod stamped with a different world generation
+        must be recreated — SPMD membership is global, so the whole job
+        restarts as one gang and resumes from its checkpoint (the operator's
+        obligation is stable identity + batched recreation; persistence is
+        the workload's, via orbax — SURVEY.md §5.4)."""
+        current = jaxdist.world_generation(job)
+        # A pod with no stamp (created by an older operator) is stale too:
+        # its world is unknowable, and "treat as current" would leave it
+        # running old env beside new-world pods — a mixed gang that hangs
+        # at rendezvous instead of re-initializing.
+        return [
+            p
+            for p in pods
+            if p.metadata.labels.get(constants.LABEL_WORLD_GENERATION) != current
+        ]
 
     def _attach_tpu_resources(self, job, template, index: int) -> None:
         tpu = job.spec.tpu

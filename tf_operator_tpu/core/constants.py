@@ -16,6 +16,13 @@ JOB_ROLE_MASTER = "master"
 # TPU-native labels/annotations (no reference counterpart): identify the
 # slice a worker belongs to so schedulers and debuggers can reason per-slice.
 LABEL_SLICE_INDEX = "tpu-slice-index"
+# Hash of the world a pod's rendezvous env was computed from (worker count,
+# slice count, coordinator port, mesh). A pod whose label differs from the
+# current spec belongs to a stale world: SPMD membership changed, and the
+# whole gang must re-init through the coordinator (elastic slice resize —
+# SURVEY.md §2.5 elastic row, generalizing the reference's
+# EnableDynamicWorker to all-or-nothing slices).
+LABEL_WORLD_GENERATION = "world-generation"
 ANNOTATION_TPU_TOPOLOGY = "tpu.kubeflow.org/topology"
 ANNOTATION_TPU_ACCELERATOR = "tpu.kubeflow.org/accelerator-type"
 

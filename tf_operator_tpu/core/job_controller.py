@@ -150,6 +150,15 @@ class FrameworkHooks:
         multislice job may start while others queue."""
         return job.name
 
+    def stale_world_pods(
+        self, job: JobObject, replicas: Dict[str, ReplicaSpec], pods: List[Pod]
+    ) -> List[Pod]:
+        """Pods whose rendezvous env no longer matches the spec (elastic
+        resize). The engine deletes them all in one sync (batched — restart
+        MTTR, SURVEY.md §7 hard parts) and recreates next sync. Default: no
+        framework opts in."""
+        return []
+
     def gang_groups(self, job: JobObject, replicas: Dict[str, ReplicaSpec], run_policy) -> List[dict]:
         """PodGroup specs to ensure when gang scheduling is on."""
         total = sum(spec.replicas or 0 for spec in replicas.values())
@@ -302,6 +311,39 @@ class JobController:
 
         if self.options.enable_gang_scheduling:
             self._sync_pod_group(job, replicas, run_policy)
+
+        # Elastic resize: a membership change (slice added/removed, worker
+        # scale) invalidates every live pod's injected world. Delete ALL
+        # stale pods in this one sync — a gang restarts together, and batched
+        # deletion keeps restart MTTR one informer round-trip instead of one
+        # per pod — then recreate on the next sync once deletions land.
+        stale = self.hooks.stale_world_pods(job, replicas, pods)
+        if stale:
+            for pod in stale:
+                self._delete_pod(job, pod)
+            msg = (
+                f"{self.hooks.kind} {job.name} is restarting to apply a new "
+                f"replica topology ({len(stale)} stale pod(s))."
+            )
+            self.cluster.record_event(
+                Event(
+                    type="Normal",
+                    reason=constants.job_reason(self.hooks.kind, constants.REASON_RESTARTING),
+                    message=msg,
+                    involved_object=f"{job.kind}/{key}",
+                )
+            )
+            capi.update_job_conditions(
+                job.status,
+                capi.JOB_RESTARTING,
+                constants.job_reason(self.hooks.kind, constants.REASON_RESTARTING),
+                msg,
+                now=self.clock(),
+            )
+            job.status._restarting_this_sync = True
+            self.on_job_restarting(job, "")
+            self._write_status_if_changed(job, old_status)
+            return
 
         services = self.get_services_for_job(job)
         for rtype in self.hooks.replica_order(replicas):

@@ -256,7 +256,11 @@ class MoE(nn.Module):
 
         # Dispatch: tokens -> per-expert slots. The constraint reshards the
         # expert dim onto ep (all-to-all); batch stays on the other data axes.
-        expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, xf).astype(cfg.dtype)
+        # dispatch is a 0/1 mask (exactly representable in bf16), so the
+        # largest routing contraction runs at full MXU rate in model dtype.
+        expert_in = jnp.einsum(
+            "bsec,bsd->ebcd", dispatch.astype(cfg.dtype), x.astype(cfg.dtype)
+        )
         expert_in = constrain(expert_in, "ep", ("slice", "dp", "fsdp"), None, None)
 
         init = nn.initializers.normal(0.02)

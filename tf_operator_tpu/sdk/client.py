@@ -18,7 +18,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from ..api import KINDS
-from ..cluster.base import Cluster, NotFound
+from ..cluster.base import Cluster, Conflict, NotFound
 from ..core import constants
 
 TERMINAL_CONDITIONS = ("Succeeded", "Failed")
@@ -30,6 +30,16 @@ class TimeoutError(Exception):  # noqa: A001 — mirrors the reference SDK name
 
 def _conditions(job_dict: dict) -> List[dict]:
     return ((job_dict.get("status") or {}).get("conditions")) or []
+
+
+def _merge_patch(dst: dict, src: dict) -> None:
+    for key, value in src.items():
+        if isinstance(value, dict) and isinstance(dst.get(key), dict):
+            _merge_patch(dst[key], value)
+        elif value is None:
+            dst.pop(key, None)
+        else:
+            dst[key] = value
 
 
 def _has_condition(job_dict: dict, condition_type: str) -> bool:
@@ -70,23 +80,76 @@ class JobClient:
         return self.cluster.list_jobs(self.kind, namespace)
 
     def patch(self, name: str, patch: dict, namespace: str = "default") -> dict:
-        """Strategic-merge-style patch of the spec (reference :150-183)."""
-
-        def merge(dst, src):
-            for key, value in src.items():
-                if isinstance(value, dict) and isinstance(dst.get(key), dict):
-                    merge(dst[key], value)
-                elif value is None:
-                    dst.pop(key, None)
-                else:
-                    dst[key] = value
-
-        job = self.get(name, namespace)
-        merge(job, patch)
-        return self.cluster.update_job(job)
+        """Strategic-merge-style patch of the spec (reference :150-183).
+        Retries on write conflict (the GET-merge-PUT loop every k8s patch
+        client runs under optimistic concurrency)."""
+        last: Optional[Exception] = None
+        for _ in range(5):
+            job = self.get(name, namespace)
+            _merge_patch(job, patch)
+            try:
+                return self.cluster.update_job(job)
+            except Conflict as exc:
+                last = exc
+        raise last  # type: ignore[misc]
 
     def delete(self, name: str, namespace: str = "default") -> None:
         self.cluster.delete_job(self.kind, namespace, name)
+
+    def scale(
+        self,
+        name: str,
+        num_slices: int,
+        namespace: str = "default",
+    ) -> dict:
+        """Elastic resize of a JAXJob in whole-slice units: patches numSlices
+        and the Worker replica count together (they must stay consistent —
+        api/jaxjob.py validate). The controller restarts the gang with the
+        new world env; the workload resumes from its checkpoint."""
+        if self.kind != "JAXJob":
+            raise ValueError(
+                f"scale() resizes JAXJobs in slice units; this client is for "
+                f"{self.kind} (patch replicas directly instead)"
+            )
+        for _ in range(5):
+            try:
+                return self._scale_once(name, num_slices, namespace)
+            except Conflict:
+                continue
+        return self._scale_once(name, num_slices, namespace)
+
+    def _scale_once(self, name: str, num_slices: int, namespace: str) -> dict:
+        job = self.get(name, namespace)
+        spec = job.get("spec", {})
+        replicas = (
+            (spec.get("jaxReplicaSpecs") or {}).get("Worker") or {}
+        ).get("replicas")
+        old_slices = spec.get("numSlices") or 1
+        patch: dict = {"spec": {"numSlices": num_slices}}
+        if replicas is not None and replicas % max(1, old_slices) == 0:
+            per_slice = replicas // max(1, old_slices)
+            patch["spec"]["jaxReplicaSpecs"] = {
+                "Worker": {"replicas": per_slice * num_slices}
+            }
+        mesh = spec.get("mesh") or {}
+        if "slice" in mesh:
+            # A global mesh carries the DCN axis explicitly; rescale it.
+            # (A per-slice mesh — no slice axis — is resize-stable as-is.)
+            patch["spec"]["mesh"] = {**mesh, "slice": num_slices}
+        # Reject an invalid resize HERE, before it reaches the store — a
+        # bad patch on a running job must not push it to a terminal Failed
+        # (the controller marks any invalid live spec Failed, reference
+        # issue-#561 semantics; the apiserver-side guard is this client).
+        candidate = copy.deepcopy(job)
+        _merge_patch(candidate, patch)
+        cls, set_defaults, validate = KINDS[self.kind]
+        parsed = cls.parse(candidate)
+        set_defaults(parsed)
+        validate(parsed.spec)
+        # Write exactly the validated object (optimistic concurrency via
+        # resourceVersion): a re-GET inside patch() could merge onto a spec
+        # another writer changed after validation.
+        return self.cluster.update_job(candidate)
 
     # ------------------------------------------------------------ waiting
     def wait_for_condition(

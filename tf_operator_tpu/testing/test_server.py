@@ -15,6 +15,7 @@ loopback alias IP (declared port preserved).
 
 Endpoints:
   GET /runconfig          observed TF view: task type/index, cluster spec
+  GET /env                injected JAX_/TPU_/MEGASCALE_/TF_CONFIG env dump
   GET /meshconfig         observed JAX view: topology_from_env() fields
   GET /healthz            "ok"
   GET /exit?exitCode=N    responds "exiting N" then exits with code N
@@ -53,10 +54,16 @@ def _own_address() -> tuple:
         if entry:
             host, port = entry.rsplit(":", 1)
             return host, int(port)
-    # JAXJob coordinator path: process 0's address is the coordinator's.
+    # JAXJob path: every worker listens on its own slice hostname at the
+    # coordinator port (worker-0's IS the coordinator address).
     coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
-    if coord and os.environ.get("JAX_PROCESS_ID", "0") == "0":
+    if coord:
         host, port = coord.rsplit(":", 1)
+        if os.environ.get("JAX_PROCESS_ID", "0") != "0":
+            hosts = [h for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
+            wid = int(os.environ.get("TPU_WORKER_ID", "0"))
+            if wid < len(hosts):
+                host = hosts[wid]
         return host, int(port)
     return "127.0.0.1", int(os.environ.get("TEST_SERVER_PORT", "0"))
 
@@ -109,6 +116,16 @@ class Handler(BaseHTTPRequestHandler):
             self._json(_runconfig())
         elif url.path == "/meshconfig":
             self._json(_meshconfig())
+        elif url.path == "/env":
+            # Injected-bootstrap dump: the JAX/TPU rendezvous env exactly as
+            # the operator delivered it (elastic-resize e2e asserts on it).
+            self._json(
+                {
+                    k: v
+                    for k, v in os.environ.items()
+                    if k.startswith(("JAX_", "TPU_", "MEGASCALE_", "TF_CONFIG"))
+                }
+            )
         elif url.path == "/healthz":
             self._json({"status": "ok"})
         elif url.path == "/exit":

@@ -68,11 +68,10 @@ def init_sharded_train_state(
     parameter directly on its own shard. Nothing ever exists unsharded, so a
     7B state (params + two fp32 Adam moments ≈ 70 GB) initializes on chips
     with 16 GB HBM each. Returns (state, sharding)."""
-    seq = seq or min(model.config.max_seq_len, 128)
-    tokens_shape = jnp.zeros((batch, seq), dtype=jnp.int32)
+    from ..models.llama import init_params
 
     def mk(rng):
-        params = {"params": model.init(rng, tokens_shape)["params"]}
+        params = init_params(model, rng, batch=batch, seq=seq)
         return TrainState(
             step=jnp.zeros((), jnp.int32), params=params, opt_state=optimizer.init(params)
         )
