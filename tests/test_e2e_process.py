@@ -255,9 +255,14 @@ class TestJAXJobElasticResize:
         http_get_json(harness.resolve("el-worker-0.default.svc", 1234), "/healthz")
         t0 = harness.get_pod("default", "el-worker-0").status.start_time
 
-        job = harness.get_job("JAXJob", "default", "el")
-        job["spec"]["jaxReplicaSpecs"]["Worker"]["replicas"] = 3
-        harness.update_job(job)
+        # Patch via the SDK (GET-merge-PUT with Conflict retry): the live
+        # controller writes status concurrently, so a raw update_job carrying
+        # the read's resourceVersion can race to a 409.
+        from tf_operator_tpu.sdk.client import JobClient
+
+        JobClient(harness, kind="JAXJob").patch(
+            "el", {"spec": {"jaxReplicaSpecs": {"Worker": {"replicas": 3}}}}
+        )
 
         def resized():
             pods = harness.list_pods("default")

@@ -149,6 +149,39 @@ class TestModel:
 
 
 class TestLoss:
+    def test_chunked_ce_matches_full(self):
+        """The memory-chunked lm-head loss must agree with the plain
+        full-logits cross entropy (same masking, same mean)."""
+        from tf_operator_tpu.train.train_step import (
+            chunked_cross_entropy,
+            cross_entropy_loss,
+        )
+
+        rng = jax.random.PRNGKey(0)
+        b, s, d, v = 2, 37, 16, 29  # deliberately not chunk-aligned
+        hidden = jax.random.normal(rng, (b, s, d), jnp.float32)
+        kernel = jax.random.normal(jax.random.PRNGKey(1), (d, v), jnp.float32)
+        targets = jax.random.randint(jax.random.PRNGKey(2), (b, s), 0, v)
+        targets = targets.at[0, 5:9].set(-1)  # ignored positions
+        full = cross_entropy_loss(hidden @ kernel, targets)
+        chunked = chunked_cross_entropy(hidden, kernel, targets, chunk=8)
+        assert jnp.allclose(full, chunked, rtol=1e-5), (full, chunked)
+
+    def test_loss_fn_uses_hidden_path_for_llama(self):
+        from tf_operator_tpu.train.train_step import loss_fn
+
+        config = llama.CONFIGS["llama-tiny"]
+        model = llama.Llama(config)
+        params = llama.init_params(model, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0, config.vocab_size)
+        loss = loss_fn(model, params, tokens)
+        # Cross-check against the full-logits formula.
+        from tf_operator_tpu.train.train_step import cross_entropy_loss
+
+        logits = model.apply(params, tokens[:, :-1])
+        full = cross_entropy_loss(logits, tokens[:, 1:])
+        assert jnp.allclose(loss, full, rtol=2e-2, atol=1e-2), (loss, full)
+
     def test_cross_entropy_masks_ignored(self):
         logits = jnp.zeros((1, 4, 10))
         targets = jnp.array([[1, 2, -1, -1]])

@@ -120,6 +120,10 @@ class JobStatus:
     start_time: Optional[float] = None
     completion_time: Optional[float] = None
     last_reconcile_time: Optional[float] = None
+    # The rendezvous-world hash the controller last acted on (JAXJob elastic
+    # resize); lets drift warnings fire once per spec change, and records
+    # the live world for operators/debuggers.
+    world_generation: Optional[str] = None
 
 
 # --- Condition helpers (kubeflow/common pkg/util/status.go equivalents) ---

@@ -70,17 +70,43 @@ class JAXController(FrameworkController):
         must be recreated — SPMD membership is global, so the whole job
         restarts as one gang and resumes from its checkpoint (the operator's
         obligation is stable identity + batched recreation; persistence is
-        the workload's, via orbax — SURVEY.md §5.4)."""
+        the workload's, via orbax — SURVEY.md §5.4).
+
+        Only jobs that DECLARED spec.elastic restart; on a fixed-world job a
+        topology patch must not kill a multi-day run — the drift is recorded
+        as a one-shot Warning event instead (status.world_generation dedups
+        it across syncs)."""
         current = jaxdist.world_generation(job)
         # A pod with no stamp (created by an older operator) is stale too:
         # its world is unknowable, and "treat as current" would leave it
         # running old env beside new-world pods — a mixed gang that hangs
-        # at rendezvous instead of re-initializing.
-        return [
+        # at rendezvous instead of re-initializing. Pods already terminating
+        # are skipped so async-deleting backends don't re-delete/re-warn.
+        stale = [
             p
             for p in pods
-            if p.metadata.labels.get(constants.LABEL_WORLD_GENERATION) != current
+            if p.metadata.deletion_timestamp is None
+            and p.metadata.labels.get(constants.LABEL_WORLD_GENERATION) != current
         ]
+        drifted = job.status.world_generation not in (None, current)
+        if stale and job.spec.elastic is None:
+            if drifted:
+                self.cluster.record_event(
+                    Event(
+                        type="Warning",
+                        reason="WorldDriftIgnored",
+                        message=(
+                            f"JAXJob {job.key()} topology changed but the job is "
+                            "not elastic; running pods keep their old world env. "
+                            "Set spec.elastic to allow coordinated resize."
+                        ),
+                        involved_object=f"{job.kind}/{job.key()}",
+                    )
+                )
+            job.status.world_generation = current
+            return []
+        job.status.world_generation = current
+        return stale
 
     def _attach_tpu_resources(self, job, template, index: int) -> None:
         tpu = job.spec.tpu

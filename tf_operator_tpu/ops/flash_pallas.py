@@ -94,11 +94,14 @@ def _flash_kernel(
     k_start = ki * block_k
 
     def _compute():
-        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale
-        k = k_ref[0, 0, :, :].astype(jnp.float32)
-        v = v_ref[0, 0, :, :].astype(jnp.float32)
-        # (block_q, block_k) scores on the MXU.
-        s = jax.lax.dot_general(
+        # Keep matmul inputs in their native dtype: the MXU contracts
+        # bf16 x bf16 -> f32 natively (preferred_element_type); upcasting
+        # inputs to f32 first would halve MXU rate and double VMEM traffic.
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        # (block_q, block_k) scores on the MXU, scaled in f32.
+        s = scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         if causal:
@@ -114,8 +117,11 @@ def _flash_kernel(
             alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True), l_ref.shape
         )
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        # P cast to the input dtype for the PV matmul (FlashAttention-2
+        # practice); the accumulator stays f32.
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     if causal:
@@ -223,10 +229,12 @@ def _dq_kernel(
     k_start = ki * block_k
 
     def _compute():
-        q = q_ref[0, 0, :, :].astype(jnp.float32)
-        k = k_ref[0, 0, :, :].astype(jnp.float32)
-        v = v_ref[0, 0, :, :].astype(jnp.float32)
-        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        # Matmul inputs stay bf16 (MXU-native, f32 accumulate); only the
+        # softmax statistics and dS algebra run in f32.
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
         lse = lse_ref[0, 0, :, :]  # (block_q, 1)
         delta = delta_ref[0, 0, :, :]
 
@@ -244,7 +252,8 @@ def _dq_kernel(
         )
         ds = p * (dp - delta)
         dq_acc_ref[...] += scale * jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     if causal:
@@ -290,10 +299,10 @@ def _dkv_kernel(
     k_start = ki * block_k
 
     def _compute():
-        q = q_ref[0, 0, :, :].astype(jnp.float32)
-        k = k_ref[0, 0, :, :].astype(jnp.float32)
-        v = v_ref[0, 0, :, :].astype(jnp.float32)
-        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
         lse = lse_ref[0, 0, :, :]
         delta = delta_ref[0, 0, :, :]
 
@@ -307,7 +316,8 @@ def _dkv_kernel(
         p = jnp.exp(s - lse)  # (block_q, block_k)
         # dV += Pᵀ dO
         dv_acc_ref[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         # dS = P ∘ (dP - delta); dK += scale · dSᵀ Q
         dp = jax.lax.dot_general(
@@ -315,7 +325,8 @@ def _dkv_kernel(
         )
         ds = p * (dp - delta)
         dk_acc_ref[...] += scale * jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     if causal:
@@ -456,6 +467,15 @@ def _flash_attention(q, k, v, causal, block_q, block_k, interpret):
 
 def _flash_attention_fwd(q, k, v, causal, block_q, block_k, interpret):
     o, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    # Named for remat policies: saving "flash_o"/"flash_lse" (plus q/k/v,
+    # which are dot outputs any dots-saveable policy keeps) lets the
+    # backward replay skip re-running the forward kernel entirely — the
+    # VJP's residuals are then all checkpointed (models/llama.py pairs this
+    # with its "dots" policy).
+    from jax.ad_checkpoint import checkpoint_name
+
+    o = checkpoint_name(o, "flash_o")
+    lse = checkpoint_name(lse, "flash_lse")
     return o, (q, k, v, o, lse)
 
 
@@ -470,8 +490,8 @@ def flash_attention_pallas(
     k,
     v,
     causal: bool = True,
-    block_q: int = 256,
-    block_k: int = 512,
+    block_q: int = 1024,
+    block_k: int = 1024,
     interpret: bool = False,
 ):
     """BSHD flash attention, differentiable (custom VJP → Pallas backward).

@@ -111,16 +111,24 @@ class JobClient:
                 f"scale() resizes JAXJobs in slice units; this client is for "
                 f"{self.kind} (patch replicas directly instead)"
             )
+        last: Optional[Exception] = None
         for _ in range(5):
             try:
                 return self._scale_once(name, num_slices, namespace)
-            except Conflict:
-                continue
-        return self._scale_once(name, num_slices, namespace)
+            except Conflict as exc:
+                last = exc
+        raise last  # type: ignore[misc]
 
     def _scale_once(self, name: str, num_slices: int, namespace: str) -> dict:
         job = self.get(name, namespace)
         spec = job.get("spec", {})
+        # `is None`, not truthiness: `elastic: {}` is a valid declaration
+        # (all-default bounds) and the controller treats it as elastic.
+        if spec.get("elastic") is None:
+            raise ValueError(
+                f"JAXJob {namespace}/{name} is not elastic (spec.elastic unset); "
+                "the controller will not restart a fixed-world job for a resize"
+            )
         replicas = (
             (spec.get("jaxReplicaSpecs") or {}).get("Worker") or {}
         ).get("replicas")

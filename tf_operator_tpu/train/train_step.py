@@ -25,13 +25,20 @@ class TrainState(NamedTuple):
     opt_state: Any
 
 
-def cross_entropy_loss(logits, targets, ignore_id: int = -1):
-    """Mean next-token cross entropy in fp32; `ignore_id` targets masked out."""
+def _masked_nll(logits, targets, ignore_id: int = -1):
+    """(negative-log-likelihood sum, valid-token count) in fp32 — the shared
+    core of both loss paths; `ignore_id` targets masked out."""
     logits = logits.astype(jnp.float32)
     mask = (targets != ignore_id).astype(jnp.float32)
     log_probs = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(log_probs, targets[..., None].clip(0), axis=-1)[..., 0]
-    return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return -(ll * mask).sum(), mask.sum()
+
+
+def cross_entropy_loss(logits, targets, ignore_id: int = -1):
+    """Mean next-token cross entropy in fp32; `ignore_id` targets masked out."""
+    nll, count = _masked_nll(logits, targets, ignore_id)
+    return nll / jnp.maximum(count, 1.0)
 
 
 def make_optimizer(
@@ -82,14 +89,59 @@ def init_sharded_train_state(
     return state, sharding
 
 
+CE_CHUNK = 512  # sequence positions per lm-head/loss chunk
+
+
+def chunked_cross_entropy(hidden, kernel, targets, chunk: int = CE_CHUNK,
+                          ignore_id: int = -1):
+    """Next-token CE where the lm head is applied per sequence chunk under
+    `lax.map`: the [b, s, vocab] fp32 logits tensor never exists whole in
+    HBM (~3 GB at b=8/s=2k/32k vocab), only [b, chunk, vocab] at a time.
+    The backward recomputes each chunk's logits from the (small) hidden —
+    one extra head matmul total, bought for gigabytes of peak memory."""
+    b, s, d = hidden.shape
+    pad = (-s) % chunk
+    if pad:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)), constant_values=ignore_id)
+    n = (s + pad) // chunk
+    hc = hidden.reshape(b, n, chunk, d).swapaxes(0, 1)  # [n, b, chunk, d]
+    tc = targets.reshape(b, n, chunk).swapaxes(0, 1)
+
+    # jax.checkpoint is LOAD-BEARING: without it, lax.map's VJP saves each
+    # chunk's log-softmax residual STACKED across chunks — the full-logits
+    # tensor again, silently defeating the chunking. Checkpointed, the
+    # backward keeps only the (h, t) chunk inputs and recomputes logits.
+    @jax.checkpoint
+    def per_chunk(args):
+        h, t = args
+        return _masked_nll(h @ kernel, t, ignore_id)
+
+    sums, counts = jax.lax.map(per_chunk, (hc, tc))
+    return sums.sum() / jnp.maximum(counts.sum(), 1.0)
+
+
 def loss_fn(model, params, tokens):
     """Next-token LM loss: predict tokens[:, 1:] from tokens[:, :-1].
     Any auxiliary terms a model sows into its "losses" collection (MoE
     router load-balancing) are summed in; dense models sow nothing and the
-    collection comes back empty."""
-    logits, mutated = model.apply(params, tokens[:, :-1], mutable=["losses"])
+    collection comes back empty.
+
+    Models declaring `supports_return_hidden` (the Llama family) take the
+    chunked-CE path; others get the plain full-logits loss. An explicit
+    capability flag, not try/except: a model accepting **kwargs would
+    swallow return_hidden and hand full logits to the hidden-path matmul."""
+    if getattr(model, "supports_return_hidden", False):
+        hidden, mutated = model.apply(
+            params, tokens[:, :-1], mutable=["losses"], return_hidden=True
+        )
+        kernel = params["params"]["output"]["kernel"].astype(hidden.dtype)
+        loss = chunked_cross_entropy(hidden, kernel, tokens[:, 1:])
+    else:
+        logits, mutated = model.apply(params, tokens[:, :-1], mutable=["losses"])
+        loss = cross_entropy_loss(logits, tokens[:, 1:])
     aux = sum(jnp.sum(leaf) for leaf in jax.tree.leaves(mutated.get("losses", {})))
-    return cross_entropy_loss(logits, tokens[:, 1:]) + aux
+    return loss + aux
 
 
 def train_step(model, optimizer, state: TrainState, tokens) -> tuple:
