@@ -39,6 +39,7 @@ def main(argv=None) -> int:
 
     from tf_operator_tpu.models import llama
     from tf_operator_tpu.parallel.sharding import batch_sharding
+    from tf_operator_tpu.runtime.profiling import step_profiler
     from tf_operator_tpu.runtime.tpu_init import tpu_init
     from tf_operator_tpu.train.data import SyntheticTokens, shard_batch
     from tf_operator_tpu.train.train_step import (
@@ -93,6 +94,8 @@ def main(argv=None) -> int:
     for step in range(start_step, args.steps):
         tokens = shard_batch(next(data), data_spec)
         state, loss = step_fn(state, tokens)
+        # XLA trace capture when TPU_PROFILE_DIR is set (no-op otherwise).
+        step_profiler(step)
         if step % args.log_every == 0 or step == args.steps - 1:
             dt = time.perf_counter() - t0
             done = step - start_step + 1

@@ -364,30 +364,32 @@ class TestJAXController:
         assert env["JAX_NUM_PROCESSES"] == "8"
         assert env["MEGASCALE_NUM_SLICES"] == "2"
 
-    def test_non_elastic_job_not_restarted_on_drift(self):
-        """A fixed-world job (spec.elastic unset) must NOT be gang-restarted
-        by a topology patch — drift is recorded as a one-shot Warning."""
+    def test_world_change_restarts_gang_even_without_elastic(self):
+        """Convergence semantics: a world-affecting spec patch restarts the
+        gang whether or not spec.elastic is declared (a mixed-world gang
+        would hang at rendezvous — worse than the visible restart). The
+        elastic policy's job is bounds + the SDK scale() verb, not
+        ignoring desired state."""
         self.cluster.create_job(jax_manifest(num_slices=2))  # no elastic
         self.controller.run_until_idle()
         for p in self.cluster.list_pods():
             self.cluster.set_pod_phase("default", p.metadata.name, POD_RUNNING)
         self.controller.run_until_idle()
-        before = {p.metadata.name for p in self.cluster.list_pods()}
+        gen0 = {p.metadata.labels["world-generation"] for p in self.cluster.list_pods()}
 
         job = self.cluster.get_job("JAXJob", "default", "llama")
         job["spec"]["mesh"] = {"slice": 2, "fsdp": 16}  # world hash changes
         self.cluster.update_job(job)
         self.controller.run_until_idle()
 
-        # Same pods, still running, no Restarting.
-        assert {p.metadata.name for p in self.cluster.list_pods()} == before
-        reasons = [e.reason for e in self.cluster.list_events()]
-        assert "JAXJobRestarting" not in reasons
-        assert reasons.count("WorldDriftIgnored") == 1
-        # Warning is one-shot: further syncs don't re-emit.
-        self.controller.sync("default", "llama")
-        reasons = [e.reason for e in self.cluster.list_events()]
-        assert reasons.count("WorldDriftIgnored") == 1
+        pods = self.cluster.list_pods()
+        assert len(pods) == 8
+        gen1 = {p.metadata.labels["world-generation"] for p in pods}
+        assert len(gen1) == 1 and gen1 != gen0  # whole gang re-stamped
+        assert "JAXJobRestarting" in {e.reason for e in self.cluster.list_events()}
+        # The acted-on world is recorded in status for observability.
+        status = self.cluster.get_job("JAXJob", "default", "llama")["status"]
+        assert status.get("worldGeneration") == next(iter(gen1))
 
     def test_scale_requires_elastic(self):
         from tf_operator_tpu.sdk.client import JobClient

@@ -124,7 +124,10 @@ class JAXJobSpec:
     run_policy: RunPolicy = field(default_factory=RunPolicy)
     jax_replica_specs: Dict[ReplicaType, ReplicaSpec] = field(default_factory=dict)
     tpu: Optional[TPUSpec] = None
-    # Declares the job resizable in whole-slice units (None = fixed world).
+    # Declares intentional resizability in whole-slice units: bounds
+    # numSlices in validation and gates the SDK scale() verb. Any
+    # world-affecting spec patch restarts the gang regardless (k8s
+    # convergence — controllers/jax.py stale_world_pods).
     elastic: Optional[ElasticPolicy] = None
     # Multislice: number of DCN-connected slices; each slice is one gang of
     # `hosts_for(tpu)` workers and the global mesh gains a leading DCN axis.

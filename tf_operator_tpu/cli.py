@@ -167,11 +167,20 @@ class _HealthHandler(_BaseHandler):
 
 
 class _MetricsHandler(_BaseHandler):
-    """Prometheus /metrics on --metrics-port (reference --metrics-bind-address)."""
+    """Prometheus /metrics + /debugz on --metrics-port. /debugz is the
+    analog of the reference's pprof-on-monitoring-port (blank import in
+    cmd/tf-operator.v1/main.go:21): live thread stacks and per-controller
+    workqueue depths for diagnosing a stuck operator."""
 
     def do_GET(self):  # noqa: N802 (stdlib API)
         if self.path.startswith("/metrics"):
             self._respond(200, self.manager.metrics.render(), "text/plain; version=0.0.4")
+        elif self.path.startswith("/debugz"):
+            self._respond(
+                200,
+                json.dumps(self.manager.debug_snapshot(), indent=2),
+                "application/json",
+            )
         else:
             self._respond(404, "not found")
 
@@ -228,6 +237,28 @@ class OperatorManager:
 
     def _set_leader_gauge(self) -> None:
         self.metrics.set_gauge("training_operator_is_leader", 1.0 if self._is_leader else 0.0)
+
+    def debug_snapshot(self) -> dict:
+        """Live diagnostics for /debugz: thread stacks (what pprof's
+        goroutine profile gives the reference) + workqueue depths."""
+        import sys
+        import traceback
+
+        frames = sys._current_frames()
+        threads = {}
+        for thread in threading.enumerate():
+            frame = frames.get(thread.ident)
+            threads[thread.name] = (
+                traceback.format_stack(frame) if frame is not None else []
+            )
+        return {
+            "leader": self._is_leader,
+            "ready": self.ready,
+            "queues": {
+                kind: c.queue.depth() for kind, c in self.controllers.items()
+            },
+            "threads": threads,
+        }
 
     # ---------------------------------------------------------- run loops
     def _elect_loop(self) -> None:

@@ -7,6 +7,7 @@ common.JobController and implementing ControllerInterface
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Dict, Optional
 
@@ -20,6 +21,8 @@ from ..core.control import RealPodControl, RealServiceControl
 from ..core.expectations import ControllerExpectations
 from ..core.job_controller import EngineOptions, FrameworkHooks, JobController
 from ..core.workqueue import WorkQueue
+
+_log = logging.getLogger(__name__)
 
 
 class FrameworkController(FrameworkHooks):
@@ -159,7 +162,13 @@ class FrameworkController(FrameworkHooks):
             for c in (job_dict.get("status") or {}).get("conditions") or []
             if c.get("status") == "True"
         }
+        t0 = time.monotonic()
         self.engine.reconcile_job(job)
+        elapsed = time.monotonic() - t0
+        # Reference logs per-sync latency ("Finished syncing tfjob %q (%v)",
+        # controller.go:306); here it also feeds a histogram.
+        self.metrics.observe_reconcile(namespace, self.kind, elapsed)
+        _log.debug("Finished syncing %s %r (%.1fms)", self.kind, key, elapsed * 1000)
         self._roll_terminal_metrics(job)
         self._observe_transition_latency(job, old_conds)
 

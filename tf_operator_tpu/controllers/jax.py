@@ -72,41 +72,29 @@ class JAXController(FrameworkController):
         obligation is stable identity + batched recreation; persistence is
         the workload's, via orbax — SURVEY.md §5.4).
 
-        Only jobs that DECLARED spec.elastic restart; on a fixed-world job a
-        topology patch must not kill a multi-day run — the drift is recorded
-        as a one-shot Warning event instead (status.world_generation dedups
-        it across syncs)."""
+        Restart applies to EVERY JAXJob, elastic or not — k8s convergence
+        semantics: editing the spec of a running workload changes the
+        workload (a StatefulSet template edit rolls its pods the same way).
+        The alternative (leaving old pods on the old env while scale-ups or
+        crash-recreations get the new one) yields a mixed-world gang that
+        hangs at rendezvous — a silent waste of the slice, strictly worse
+        than the visible restart. `spec.elastic` is the contract for
+        *intentional* resize: it bounds numSlices in validation and gates
+        the SDK scale() verb; fat-fingered patches are caught client-side
+        (SDK pre-validation) and by CRD schema, not by the controller
+        ignoring desired state."""
         current = jaxdist.world_generation(job)
         # A pod with no stamp (created by an older operator) is stale too:
-        # its world is unknowable, and "treat as current" would leave it
-        # running old env beside new-world pods — a mixed gang that hangs
-        # at rendezvous instead of re-initializing. Pods already terminating
-        # are skipped so async-deleting backends don't re-delete/re-warn.
-        stale = [
+        # its world is unknowable beside freshly-stamped peers. Pods already
+        # terminating are skipped so async-deleting backends don't re-delete
+        # and re-emit Restarting every sync until deletions land.
+        job.status.world_generation = current
+        return [
             p
             for p in pods
             if p.metadata.deletion_timestamp is None
             and p.metadata.labels.get(constants.LABEL_WORLD_GENERATION) != current
         ]
-        drifted = job.status.world_generation not in (None, current)
-        if stale and job.spec.elastic is None:
-            if drifted:
-                self.cluster.record_event(
-                    Event(
-                        type="Warning",
-                        reason="WorldDriftIgnored",
-                        message=(
-                            f"JAXJob {job.key()} topology changed but the job is "
-                            "not elastic; running pods keep their old world env. "
-                            "Set spec.elastic to allow coordinated resize."
-                        ),
-                        involved_object=f"{job.kind}/{job.key()}",
-                    )
-                )
-            job.status.world_generation = current
-            return []
-        job.status.world_generation = current
-        return stale
 
     def _attach_tpu_resources(self, job, template, index: int) -> None:
         tpu = job.spec.tpu

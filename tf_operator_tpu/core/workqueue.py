@@ -52,6 +52,16 @@ class WorkQueue:
             heapq.heappush(self._delayed, (self._clock() + delay, self._seq, item))
             self._cond.notify()
 
+    def depth(self) -> dict:
+        """Queue introspection for the operator's /debugz endpoint."""
+        with self._cond:
+            return {
+                "queued": len(self._queue),
+                "processing": len(self._processing),
+                "delayed": len(self._delayed),
+                "failing": len(self._failures),
+            }
+
     def add_rate_limited(self, item: str) -> None:
         with self._cond:
             failures = self._failures.get(item, 0)

@@ -25,6 +25,12 @@ class Metrics:
         ("training_operator_jobs_restarted_total", "The number of restarted jobs"),
     )
     _HISTOGRAM_BUCKETS = (0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600)
+    # Reconciles are ms-scale; startup/restart are seconds-scale.
+    _BUCKETS_BY_NAME = {
+        "training_operator_reconcile_duration_seconds": (
+            0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 5,
+        ),
+    }
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -35,6 +41,9 @@ class Metrics:
         self._histograms: Dict[str, Dict[Tuple[str, str], List[float]]] = {
             "training_operator_job_startup_seconds": defaultdict(list),
             "training_operator_job_restart_seconds": defaultdict(list),
+            # Per-sync latency (the reference logs "Finished syncing tfjob
+            # %q (%v)", controller.go:306; here it is also a histogram).
+            "training_operator_reconcile_duration_seconds": defaultdict(list),
         }
         # Unlabeled gauges: leader flag etc. (legacy tf_operator_is_leader,
         # cmd/tf-operator.v1/app/server.go:66-70).
@@ -71,6 +80,10 @@ class Metrics:
         with self._lock:
             self._histograms["training_operator_job_startup_seconds"][(namespace, framework)].append(seconds)
 
+    def observe_reconcile(self, namespace: str, framework: str, seconds: float) -> None:
+        with self._lock:
+            self._histograms["training_operator_reconcile_duration_seconds"][(namespace, framework)].append(seconds)
+
     def observe_restart(self, namespace: str, framework: str, seconds: float) -> None:
         with self._lock:
             self._histograms["training_operator_job_restart_seconds"][(namespace, framework)].append(seconds)
@@ -103,10 +116,11 @@ class Metrics:
             for name, series in self._histograms.items():
                 lines.append(f"# HELP {name} {name.replace('_', ' ')}")
                 lines.append(f"# TYPE {name} histogram")
+                buckets = self._BUCKETS_BY_NAME.get(name, self._HISTOGRAM_BUCKETS)
                 for (ns, fw), samples in sorted(series.items()):
                     label = f'job_namespace="{ns}",framework="{fw}"'
                     cumulative = 0
-                    for bucket in self._HISTOGRAM_BUCKETS:
+                    for bucket in buckets:
                         cumulative = sum(1 for s in samples if s <= bucket)
                         lines.append(f'{name}_bucket{{{label},le="{bucket}"}} {cumulative}')
                     lines.append(f'{name}_bucket{{{label},le="+Inf"}} {len(samples)}')

@@ -1,0 +1,97 @@
+"""Workload-side TPU profiling hooks.
+
+The reference operator exposes Go pprof on its monitoring port
+(cmd/tf-operator.v1/main.go:21,39-50) but offers nothing for the training
+processes themselves (SURVEY.md §5.1: "no per-job profiling"). On TPU the
+valuable trace is the XLA one — jax.profiler captures device timelines,
+HLO cost attribution, and host<->device transfers viewable in TensorBoard
+or Perfetto.
+
+Two triggers, both zero-cost when unused:
+
+- Step window (env-driven): the operator (or user) sets
+  ``TPU_PROFILE_DIR`` [+ ``TPU_PROFILE_START_STEP`` / ``TPU_PROFILE_NUM_STEPS``]
+  on the pod; the train loop calls ``step_profiler(step)`` once per step.
+- On-demand: ``install_sigusr1_handler()`` arms SIGUSR1; signaling the
+  process (kubectl exec kill -USR1 1) captures a fixed-duration trace —
+  the moral analog of hitting pprof on a live server.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+
+_log = logging.getLogger(__name__)
+
+ENV_PROFILE_DIR = "TPU_PROFILE_DIR"
+ENV_PROFILE_START_STEP = "TPU_PROFILE_START_STEP"
+ENV_PROFILE_NUM_STEPS = "TPU_PROFILE_NUM_STEPS"
+
+_state = threading.Lock()
+_active = False
+
+
+def profile_window() -> tuple:
+    """(dir, start_step, num_steps) from env, or (None, 0, 0)."""
+    out_dir = os.environ.get(ENV_PROFILE_DIR)
+    if not out_dir:
+        return None, 0, 0
+    start = int(os.environ.get(ENV_PROFILE_START_STEP, "10"))
+    num = int(os.environ.get(ENV_PROFILE_NUM_STEPS, "5"))
+    return out_dir, start, num
+
+
+def step_profiler(step: int) -> None:
+    """Call once per train step; starts/stops the env-declared window.
+    No-op (one int compare) when TPU_PROFILE_DIR is unset."""
+    global _active
+    out_dir, start, num = profile_window()
+    if out_dir is None:
+        return
+    import jax
+
+    with _state:
+        if step == start and not _active:
+            _log.info("profiler: starting trace -> %s (steps %d..%d)", out_dir, start, start + num)
+            jax.profiler.start_trace(out_dir)
+            _active = True
+        elif _active and step >= start + num:
+            jax.profiler.stop_trace()
+            _active = False
+            _log.info("profiler: trace written to %s", out_dir)
+
+
+def capture(out_dir: str, seconds: float = 3.0) -> None:
+    """Fixed-duration trace, usable from any thread."""
+    import time
+
+    import jax
+
+    global _active
+    with _state:
+        if _active:
+            return
+        _active = True
+    try:
+        jax.profiler.start_trace(out_dir)
+        time.sleep(seconds)
+        jax.profiler.stop_trace()
+        _log.info("profiler: on-demand trace written to %s", out_dir)
+    finally:
+        with _state:
+            _active = False
+
+
+def install_sigusr1_handler(out_dir: str = "/tmp/tpu-profile", seconds: float = 3.0) -> None:
+    """SIGUSR1 -> capture a trace in a background thread (signal-safe:
+    the handler only spawns the thread)."""
+
+    def _handler(signum, frame):
+        threading.Thread(
+            target=capture, args=(out_dir, seconds), daemon=True, name="tpu-profile"
+        ).start()
+
+    signal.signal(signal.SIGUSR1, _handler)
