@@ -112,6 +112,57 @@ class TestSDKAgainstLiveOperator:
         assert self.client.get_job_status("mnist") == "Failed"
 
 
+class TestSDKObservation:
+    def setup_method(self):
+        self.cluster = InMemoryCluster()
+        self.manager = OperatorManager(
+            self.cluster,
+            OperatorOptions(enabled_schemes=["TFJob"], health_port=0, metrics_port=0,
+                            resync_period=0.2),
+            metrics=Metrics(),
+        )
+        self.manager.start()
+        self.client = TFJobClient(self.cluster)
+
+    def teardown_method(self):
+        self.manager.stop()
+
+    def test_watch_streams_condition_transitions(self):
+        self.client.create(tfjob_manifest("w", workers=1))
+        seen = []
+
+        def consume():
+            for job in self.client.watch("w", timeout=20):
+                conds = (job.get("status") or {}).get("conditions") or []
+                seen.append(conds[-1]["type"] if conds else None)
+
+        t = threading.Thread(target=consume)
+        t.start()
+        wait_until(lambda: len(self.cluster.list_pods()) == 1)
+        self.cluster.set_pod_phase("default", "w-worker-0", "Running")
+        wait_until(lambda: "Running" in seen)
+        self.cluster.set_pod_phase("default", "w-worker-0", "Succeeded", exit_code=0)
+        t.join(timeout=20)
+        assert not t.is_alive()
+        assert seen[-1] == "Succeeded"
+        assert "Running" in seen
+
+    def test_get_events_and_creation_failures(self):
+        self.client.create(tfjob_manifest("ev", workers=1))
+        wait_until(lambda: len(self.cluster.list_pods()) == 1)
+        self.cluster.set_pod_phase("default", "ev-worker-0", "Succeeded", exit_code=0)
+        wait_until(lambda: self.client.is_job_succeeded("ev"))
+        reasons = {e.reason for e in self.client.get_events("ev")}
+        assert "ExitedWithCode" in reasons
+        # No creation failures in the happy path.
+        assert self.client.get_creation_failures("ev") == []
+
+    def test_terminate_replica_requires_resolving_backend(self):
+        self.client.create(tfjob_manifest("tr", workers=1))
+        with pytest.raises(NotImplementedError):
+            self.client.terminate_replica("tr", "worker", 0, exit_code=0)
+
+
 class TestClientConstruction:
     def test_client_for(self):
         cluster = InMemoryCluster()

@@ -120,9 +120,8 @@ class JobStatus:
     start_time: Optional[float] = None
     completion_time: Optional[float] = None
     last_reconcile_time: Optional[float] = None
-    # The rendezvous-world hash the controller last acted on (JAXJob elastic
-    # resize); lets drift warnings fire once per spec change, and records
-    # the live world for operators/debuggers.
+    # The rendezvous-world hash the controller last acted on (JAXJob resize
+    # — surfaced as status.worldGeneration for operators/debuggers).
     world_generation: Optional[str] = None
 
 

@@ -45,6 +45,7 @@ from ..api.k8s import (
     ContainerStatus,
     Pod,
 )
+from .base import NotFound
 from .memory import InMemoryCluster
 
 _log = logging.getLogger(__name__)
@@ -301,6 +302,18 @@ class LocalProcessCluster(InMemoryCluster):
                 finished.append(pod.deep_copy())
         for pod in finished:
             self._emit("pods", "MODIFIED", pod)
+
+    def kill_pod(self, namespace: str, name: str, sig: int = signal.SIGKILL) -> None:
+        """Fault injection: signal the pod's process WITHOUT deleting the
+        pod object — the reaper then observes the death exactly as a kubelet
+        would a preempted container (SIGKILL -> exit 137, retryable under
+        ExitCode policy). This is the e2e lever for restart-MTTR and
+        resume-from-checkpoint scenarios."""
+        with self._lock:
+            proc = self._procs.get((namespace, name))
+        if proc is None:
+            raise NotFound(f"pod {namespace}/{name} has no live process")
+        proc.send_signal(sig)
 
     # ------------------------------------------------------------- deletion
     def delete_pod(self, namespace: str, name: str) -> None:

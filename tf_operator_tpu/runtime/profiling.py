@@ -31,7 +31,11 @@ ENV_PROFILE_START_STEP = "TPU_PROFILE_START_STEP"
 ENV_PROFILE_NUM_STEPS = "TPU_PROFILE_NUM_STEPS"
 
 _state = threading.Lock()
-_active = False
+# Which trigger owns the live jax trace (only one can exist process-wide):
+# None, "window" (env-driven step window), or "capture" (SIGUSR1). Separate
+# ownership, not a bare bool — otherwise the step loop's stop branch would
+# truncate an on-demand capture in flight (and vice versa).
+_owner: str | None = None
 
 
 def profile_window() -> tuple:
@@ -47,42 +51,43 @@ def profile_window() -> tuple:
 def step_profiler(step: int) -> None:
     """Call once per train step; starts/stops the env-declared window.
     No-op (one int compare) when TPU_PROFILE_DIR is unset."""
-    global _active
+    global _owner
     out_dir, start, num = profile_window()
     if out_dir is None:
         return
     import jax
 
     with _state:
-        if step == start and not _active:
+        if step == start and _owner is None:
             _log.info("profiler: starting trace -> %s (steps %d..%d)", out_dir, start, start + num)
             jax.profiler.start_trace(out_dir)
-            _active = True
-        elif _active and step >= start + num:
+            _owner = "window"
+        elif _owner == "window" and step >= start + num:
             jax.profiler.stop_trace()
-            _active = False
+            _owner = None
             _log.info("profiler: trace written to %s", out_dir)
 
 
 def capture(out_dir: str, seconds: float = 3.0) -> None:
-    """Fixed-duration trace, usable from any thread."""
+    """Fixed-duration trace, usable from any thread. Skipped (not queued)
+    if any trace is already live."""
     import time
 
     import jax
 
-    global _active
+    global _owner
     with _state:
-        if _active:
+        if _owner is not None:
             return
-        _active = True
-    try:
+        _owner = "capture"
         jax.profiler.start_trace(out_dir)
+    try:
         time.sleep(seconds)
-        jax.profiler.stop_trace()
-        _log.info("profiler: on-demand trace written to %s", out_dir)
     finally:
         with _state:
-            _active = False
+            jax.profiler.stop_trace()
+            _owner = None
+        _log.info("profiler: on-demand trace written to %s", out_dir)
 
 
 def install_sigusr1_handler(out_dir: str = "/tmp/tpu-profile", seconds: float = 3.0) -> None:

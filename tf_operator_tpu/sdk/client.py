@@ -42,6 +42,31 @@ def _merge_patch(dst: dict, src: dict) -> None:
             dst[key] = value
 
 
+def _default_port_for(kind: str) -> int:
+    """The kind's rendezvous port (TFJob 2222, PyTorchJob 23456, ...)."""
+    import importlib
+
+    module = importlib.import_module(f"..api.{kind.lower()}", __package__)
+    return module.DEFAULT_PORT
+
+
+def _first_container_port(job_dict: dict) -> Optional[int]:
+    """First declared containerPort in any replica template, if any."""
+    spec = job_dict.get("spec") or {}
+    for key, value in spec.items():
+        if not key.endswith("ReplicaSpecs") or not isinstance(value, dict):
+            continue
+        for rspec in value.values():
+            containers = (
+                ((rspec or {}).get("template") or {}).get("spec") or {}
+            ).get("containers") or []
+            for c in containers:
+                for p in c.get("ports") or []:
+                    if p.get("containerPort"):
+                        return int(p["containerPort"])
+    return None
+
+
 def _has_condition(job_dict: dict, condition_type: str) -> bool:
     return any(
         c.get("type") == condition_type and c.get("status") == "True"
@@ -220,6 +245,93 @@ class JobClient:
                 return
             time.sleep(polling_interval)
         raise TimeoutError(f"timeout waiting for {namespace}/{name} deletion")
+
+    def watch(
+        self,
+        name: str,
+        namespace: str = "default",
+        timeout: float = 600,
+        polling_interval: float = 0.1,
+    ):
+        """Generator yielding the job dict on every condition transition,
+        ending when the job is terminal or deleted (the reference's
+        TFJobWatch / get-with-watch, tf_job_client.py:98-117)."""
+        deadline = time.monotonic() + timeout
+        seen: Optional[str] = None
+        while time.monotonic() < deadline:
+            try:
+                job = self.get(name, namespace)
+            except NotFound:
+                return
+            conds = _conditions(job)
+            latest = conds[-1]["type"] if conds else None
+            if latest != seen:
+                seen = latest
+                yield job
+                if latest in TERMINAL_CONDITIONS:
+                    return
+            time.sleep(polling_interval)
+        raise TimeoutError(f"watch timeout on {self.kind} {namespace}/{name}")
+
+    # ------------------------------------------------------------- events
+    def get_events(self, name: str, namespace: str = "default") -> List:
+        """Cluster events recorded against this job."""
+        return self.cluster.list_events(f"{self.kind}/{namespace}/{name}")
+
+    def get_creation_failures(self, name: str, namespace: str = "default") -> List[str]:
+        """Warning-event messages for failed pod/service creation (reference
+        get_creation_failures_from_tfjob, tf_job_client.py:363-401)."""
+        return [
+            e.message
+            for e in self.get_events(name, namespace)
+            if e.type == "Warning" and "FailedCreate" in e.reason
+        ]
+
+    # ---------------------------------------------------- fault injection
+    def terminate_replica(
+        self,
+        name: str,
+        replica_type: str = "worker",
+        replica_index: int = 0,
+        exit_code: int = 0,
+        port: int = 0,
+        namespace: str = "default",
+        timeout: float = 10.0,
+    ) -> None:
+        """Ask a replica running the controllable test-server to exit with
+        `exit_code` via its /exit endpoint (the reference drives the same
+        flask endpoint through the apiserver proxy, tf_job_client.py:301-351).
+        Exercises shutdown-policy / restart-policy paths end-to-end."""
+        import urllib.request
+
+        resolve = getattr(self.cluster, "resolve", None)
+        if resolve is None:
+            raise NotImplementedError(
+                "terminate_replica needs a cluster backend with service "
+                "resolution (LocalProcessCluster or a real cluster)"
+            )
+        if not port:
+            job = self.get(name, namespace)
+            # Declared container port, else the kind's default port.
+            port = _first_container_port(job) or _default_port_for(self.kind)
+        # Canonical service-name builder (honors CUSTOM_CLUSTER_DOMAIN), the
+        # same one the operator's env injection uses.
+        from ..bootstrap.tf_config import replica_service_host
+
+        host = replica_service_host(name, namespace, replica_type.lower(), replica_index)
+        ip, p = resolve(host, port)
+        deadline = time.monotonic() + timeout
+        last: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://{ip}:{p}/exit?exitCode={exit_code}", timeout=2
+                ):
+                    return
+            except Exception as exc:  # noqa: BLE001 — replica may be booting
+                last = exc
+                time.sleep(0.1)
+        raise TimeoutError(f"terminate_replica: {host}:{p} unreachable: {last}")
 
     # ------------------------------------------------------------- status
     def get_job_status(self, name: str, namespace: str = "default") -> Optional[str]:
