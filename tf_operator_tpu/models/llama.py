@@ -193,7 +193,7 @@ class Attention(nn.Module):
         elif cfg.attention_impl == "ring":
             from ..ops import ring_attention as ring_ops
 
-            out = ring_ops.ring_attention(q, k, v, axis_name="sp")
+            out = ring_ops.sharded_ring_attention(q, k, v)
         else:
             out = attn_ops.xla_attention(q, k, v, causal=True)
 

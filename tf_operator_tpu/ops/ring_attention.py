@@ -30,10 +30,13 @@ def _block_scores(q, k, scale):
     return jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
 
 
-def ring_attention(q, k, v, axis_name: str = "sp"):
+def ring_attention(q, k, v, axis_name: str = "sp", vary_axes=None):
     """Causal ring attention. q,k,v: [b, s_local, h(_kv), d] sequence shards,
     ordered by ring index (shard i holds global positions
-    [i*s_local, (i+1)*s_local))."""
+    [i*s_local, (i+1)*s_local)). `vary_axes`: every manual (shard_map) axis
+    in scope — the loop carry must be marked varying over all of them, not
+    just the ring axis, or the fori_loop carry types mismatch. Defaults to
+    (axis_name,) for a shard_map mapping only the ring axis."""
     try:
         axis_size = jax.lax.psum(1, axis_name)
     except NameError:
@@ -48,10 +51,17 @@ def ring_attention(q, k, v, axis_name: str = "sp"):
     # Online softmax accumulators (fp32), marked as varying over the ring
     # axis (loop-carry types must match the body outputs, which depend on
     # the mapped q/k/v).
+    axes = tuple(vary_axes) if vary_axes else (axis_name,)
+
     def pvary(x):
+        # pcast is the current API; pvary the deprecated spelling. NameError
+        # (axis not bound — unmapped fallback path) leaves x unmarked.
+        fn = getattr(jax.lax, "pcast", None)
         try:
-            return jax.lax.pvary(x, (axis_name,))
-        except Exception:
+            if fn is not None:
+                return fn(x, axes, to="varying")
+            return jax.lax.pvary(x, axes)
+        except NameError:
             return x
 
     o0 = pvary(jnp.zeros((b, s, h, d), jnp.float32))
@@ -91,3 +101,35 @@ def ring_attention(q, k, v, axis_name: str = "sp"):
     o, l, m, _, _ = jax.lax.fori_loop(0, axis_size, body, (o0, l0, m0, k, v))
     l = jnp.maximum(l, 1e-30)
     return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def sharded_ring_attention(q, k, v):
+    """Ring attention wrapped in its own shard_map over the scoped mesh
+    (parallel.mesh.use_mesh), so model code can call it from inside a
+    plain-jit train step: activations enter sequence-sharded over `sp`
+    (batch over data axes, heads over tp), the ring runs per-shard, and
+    XLA stitches the region into the surrounding computation. Falls back
+    to full causal attention when no mesh is scoped or it has no sp axis."""
+    from functools import partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import current_mesh
+    from ..parallel.sharding import DATA_AXES, _present
+
+    mesh = current_mesh()
+    if mesh is None or "sp" not in mesh.shape:
+        return xla_attention(q, k, v, causal=True)
+    # Batch over the canonical data axes (DATA_AXES includes ep — it doubles
+    # as a data axis outside expert compute; a divergent hardcoded tuple
+    # here would crash sp+ep meshes at trace time).
+    spec = P(*_present(mesh, DATA_AXES, "sp", "tp", None))
+    return shard_map(
+        partial(
+            ring_attention, axis_name="sp", vary_axes=tuple(mesh.axis_names)
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )(q, k, v)
