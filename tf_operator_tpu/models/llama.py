@@ -59,6 +59,10 @@ class LlamaConfig:
     experts_per_token: int = 2
     capacity_factor: float = 1.25
     router_aux_weight: float = 0.01
+    # Microbatches per pipeline round when the mesh has a pp axis
+    # (0 = one per stage). More microbatches shrink the GPipe bubble
+    # ((pp-1)/(M+pp-1)) at the cost of smaller per-stage matmuls.
+    pp_microbatches: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -328,6 +332,21 @@ class Block(nn.Module):
         return constrain(x, DATA_AXES, "sp", None), None
 
 
+def _remat_policy(cfg: LlamaConfig):
+    """Checkpoint policy under remat. "dots" additionally saves the
+    flash-attention outputs (tagged flash_o/flash_lse in
+    ops/flash_pallas.py): with q/k/v already dot-saveable, every VJP
+    residual is checkpointed and the backward replay skips re-running the
+    forward kernel."""
+    return {
+        "nothing": jax.checkpoint_policies.nothing_saveable,
+        "dots": jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names("flash_o", "flash_lse"),
+        ),
+    }[cfg.remat_policy]
+
+
 class Llama(nn.Module):
     """Decoder stack. Layers run under `nn.scan` over stacked parameters
     (leading [n_layers] dim) with `nn.remat` on the body: one compiled block
@@ -346,7 +365,10 @@ class Llama(nn.Module):
     def __call__(self, tokens, return_hidden: bool = False):
         cfg = self.config
         b, s = tokens.shape
-        positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+        # Rope tables gathered batch-agnostically (positions identical per
+        # row): [1, s, 1, d/2] broadcasts over any batch — including the
+        # pipeline's microbatches, whose row count differs from b.
+        positions = jnp.broadcast_to(jnp.arange(s), (1, s))
         rope = gather_rope(cfg, positions)
         x = nn.Embed(
             cfg.vocab_size,
@@ -357,31 +379,75 @@ class Llama(nn.Module):
             name="tok_embeddings",
         )(tokens)
 
-        block = Block
-        if cfg.remat:
-            # "dots" additionally saves the flash-attention outputs (tagged
-            # flash_o/flash_lse in ops/flash_pallas.py): with q/k/v already
-            # dot-saveable, every VJP residual is checkpointed and the
-            # backward replay skips re-running the forward kernel.
-            policy = {
-                "nothing": jax.checkpoint_policies.nothing_saveable,
-                "dots": jax.checkpoint_policies.save_from_both_policies(
-                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-                    jax.checkpoint_policies.save_only_these_names(
-                        "flash_o", "flash_lse"
-                    ),
-                ),
-            }[cfg.remat_policy]
-            block = nn.remat(Block, prevent_cse=False, policy=policy)
-        scanned = nn.scan(
-            block,
-            variable_axes={"params": 0, "losses": 0},
-            split_rngs={"params": True},
-            in_axes=nn.broadcast,  # rope tables: same every layer
-            length=cfg.n_layers,
-            metadata_params={nn.PARTITION_NAME: "layers"},
-        )
-        x, _ = scanned(cfg, name="layers")(x, rope)
+        from ..parallel.mesh import current_mesh
+
+        mesh = current_mesh()
+        pp = int(mesh.shape.get("pp", 1)) if mesh is not None else 1
+        if pp > 1 and not self.is_initializing():
+            # Pipeline-parallel apply: the scanned params (created by the
+            # init path below, stacked [n_layers, ...]) are split into pp
+            # contiguous stages and driven through the GPipe schedule
+            # (parallel/pipeline.py). Param STRUCTURE is identical to the
+            # scan path, so checkpoints are interchangeable.
+            if cfg.n_experts:
+                raise NotImplementedError(
+                    "MoE + pipeline parallelism is not supported yet "
+                    "(the blocks' sown aux losses don't cross the pipeline)"
+                )
+            if cfg.attention_impl == "ring" and "sp" in mesh.shape:
+                raise NotImplementedError(
+                    "ring attention + pipeline parallelism is not supported "
+                    "yet (a nested full-mesh shard_map is illegal inside "
+                    "the pp-manual region)"
+                )
+            from ..parallel.pipeline import pipeline_apply, split_stages
+
+            layer_params = self.scope.get_variable("params", "layers")
+            # parent=None: a detached (pure) Block — created inside this
+            # compact __call__, it would otherwise auto-register as a child
+            # module and its .apply would corrupt the trace.
+            blk = Block(cfg, parent=None)
+
+            def apply_one(p, carry, cos, sin):
+                y, _ = blk.apply({"params": p}, carry, (cos, sin))
+                return y
+
+            if cfg.remat:
+                apply_one = jax.checkpoint(
+                    apply_one, prevent_cse=False, policy=_remat_policy(cfg)
+                )
+
+            def stage_fn(p_stage, xm, cos, sin):
+                def body(carry, p):
+                    return apply_one(p, carry, cos, sin), None
+
+                y, _ = jax.lax.scan(body, xm, p_stage)
+                return y
+
+            x = pipeline_apply(
+                stage_fn,
+                split_stages(layer_params, pp),
+                x,
+                rope[0],
+                rope[1],
+                num_microbatches=cfg.pp_microbatches or pp,
+                mesh=mesh,
+            )
+        else:
+            block = Block
+            if cfg.remat:
+                block = nn.remat(
+                    Block, prevent_cse=False, policy=_remat_policy(cfg)
+                )
+            scanned = nn.scan(
+                block,
+                variable_axes={"params": 0, "losses": 0},
+                split_rngs={"params": True},
+                in_axes=nn.broadcast,  # rope tables: same every layer
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )
+            x, _ = scanned(cfg, name="layers")(x, rope)
 
         x = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="norm")(x)
         if return_hidden:

@@ -51,18 +51,12 @@ def ring_attention(q, k, v, axis_name: str = "sp", vary_axes=None):
     # Online softmax accumulators (fp32), marked as varying over the ring
     # axis (loop-carry types must match the body outputs, which depend on
     # the mapped q/k/v).
+    from ..parallel.mesh import mark_varying
+
     axes = tuple(vary_axes) if vary_axes else (axis_name,)
 
     def pvary(x):
-        # pcast is the current API; pvary the deprecated spelling. NameError
-        # (axis not bound — unmapped fallback path) leaves x unmarked.
-        fn = getattr(jax.lax, "pcast", None)
-        try:
-            if fn is not None:
-                return fn(x, axes, to="varying")
-            return jax.lax.pvary(x, axes)
-        except NameError:
-            return x
+        return mark_varying(x, axes)
 
     o0 = pvary(jnp.zeros((b, s, h, d), jnp.float32))
     l0 = pvary(jnp.zeros((b, h, s), jnp.float32))

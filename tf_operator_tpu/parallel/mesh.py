@@ -139,3 +139,18 @@ def use_mesh(mesh: jax.sharding.Mesh):
         yield mesh
     finally:
         set_current_mesh(prev)
+
+
+def mark_varying(x, axes):
+    """Mark `x` varying over the given manual (shard_map) axes — the loop
+    carries of collective schedules (ring attention, the pp pipeline) must
+    match their body outputs' varying-axes type. Uses `jax.lax.pcast`
+    (current API) with `pvary` fallback; NameError (axis not bound — an
+    unmapped fallback path) leaves x unmarked."""
+    fn = getattr(jax.lax, "pcast", None)
+    try:
+        if fn is not None:
+            return fn(x, tuple(axes), to="varying")
+        return jax.lax.pvary(x, tuple(axes))
+    except NameError:
+        return x

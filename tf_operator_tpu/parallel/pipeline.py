@@ -1,0 +1,145 @@
+"""Pipeline parallelism (pp mesh axis): GPipe schedule over an ICI chain.
+
+No reference counterpart (SURVEY.md §2.5: the reference predates model
+parallelism entirely); this is TPU-native scheduling. The layer stack is
+split into `pp` contiguous stages; a batch is split into M microbatches
+that flow stage -> stage over `lax.ppermute` (neighbor hops ride ICI).
+With T = M + pp - 1 ticks, each stage computes every tick (the classic
+GPipe bubble of (pp-1)/T idle work); activations for at most one
+microbatch per stage are live at a time.
+
+Implementation notes, all load-bearing:
+
+- `shard_map(..., axis_names={axis_name})` maps ONLY the pp axis; every
+  other mesh axis (fsdp/tp/dp) stays automatic, so the stage function's
+  internal sharding constraints keep working and the partitioner still
+  shards the per-stage compute.
+- Stage params enter with the stage axis as leading dim, in_spec
+  P("pp") — each stage holds only its own layers (true model-memory
+  scaling, not replication).
+- The tick loop is a `lax.fori_loop` with `dynamic_slice` /
+  `dynamic_update_slice` and `where`-masked injection — no Python-level
+  data-dependent control flow, one compiled tick body regardless of M.
+- Differentiable end-to-end: ppermute's transpose is the reverse
+  permute, so jax.grad produces the 1F1B-equivalent backward schedule
+  automatically.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    x,
+    *consts,
+    num_microbatches: int,
+    axis_name: str = "pp",
+    mesh=None,
+):
+    """Run `stage_fn` as a `pp`-stage pipeline over microbatches of `x`.
+
+    stage_fn(params_one_stage, x_mb, *consts) -> y_mb — applies ONE stage's
+    layers to one microbatch (same activation shape in and out).
+    stage_params: pytree whose leaves have a leading [pp] stage axis.
+    x: [batch, ...] activations; batch % num_microbatches == 0.
+    consts: extra broadcast inputs (e.g. rope tables) — passed through the
+    shard_map explicitly (closure-capturing traced values across the
+    manual region is asking for trouble).
+    Returns [batch, ...] outputs (the last stage's results).
+    """
+    from .mesh import current_mesh
+
+    mesh = mesh or current_mesh()
+    if mesh is None or axis_name not in mesh.shape:
+        # Unsharded fallback: sequential stages (same math, no pipeline).
+        n_stages = jax.tree.leaves(stage_params)[0].shape[0]
+        for s in range(n_stages):
+            x = stage_fn(jax.tree.map(lambda p: p[s], stage_params), x, *consts)
+        return x
+
+    pp = mesh.shape[axis_name]
+    batch = x.shape[0]
+    if batch % num_microbatches:
+        raise ValueError(f"batch {batch} not divisible by {num_microbatches} microbatches")
+    mb = batch // num_microbatches
+    x_mb = x.reshape(num_microbatches, mb, *x.shape[1:])
+
+    # bf16 workaround: XLA (this jax/libtpu vintage) CHECK-fails
+    # ("Invalid binary instruction opcode copy") when partitioning the
+    # backward of the pipeline loop with bf16 activations flowing through
+    # ppermute/where/dynamic-update inside the manual region — empirically,
+    # params and boundary dtypes are fine, in-region bf16 activations are
+    # not. So the LOOP-level tensors (injected microbatches, ring carry,
+    # output buffer) run in f32, and the stage computation casts to the
+    # model dtype internally. Cost: 2x ppermute payload; the per-stage
+    # matmuls still run in bf16.
+    compute_dtype = x_mb.dtype
+    if compute_dtype == jnp.bfloat16:
+        x_mb = x_mb.astype(jnp.float32)
+
+    def pipelined(params_local, x_all, *consts):
+        # params_local: [1, per_stage, ...] (pp-mapped); squeeze the stage dim.
+        params_local = jax.tree.map(lambda p: p[0], params_local)
+        stage = jax.lax.axis_index(axis_name)
+        ticks = num_microbatches + pp - 1
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+        from .mesh import mark_varying
+
+        zero = jnp.zeros_like(x_all[0])
+        outputs0 = mark_varying(jnp.zeros_like(x_all), (axis_name,))
+        recv0 = mark_varying(zero, (axis_name,))
+
+        def tick(t, carry):
+            recv, outputs = carry
+            # Stage 0 injects microbatch t (clamped; masked out past M).
+            mb_idx = jnp.clip(t, 0, num_microbatches - 1)
+            injected = jax.lax.dynamic_index_in_dim(x_all, mb_idx, keepdims=False)
+            x_in = jnp.where(stage == 0, injected, recv)
+            y = stage_fn(params_local, x_in.astype(compute_dtype), *consts)
+            y = y.astype(x_all.dtype)
+            # The last stage finished microbatch (t - pp + 1) this tick.
+            out_idx = jnp.clip(t - pp + 1, 0, num_microbatches - 1)
+            take = jnp.logical_and(stage == pp - 1, t >= pp - 1)
+            current = jax.lax.dynamic_index_in_dim(outputs, out_idx, keepdims=False)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(take, y, current), out_idx, 0
+            )
+            recv = jax.lax.ppermute(y, axis_name, perm)
+            return recv, outputs
+
+        _, outputs = jax.lax.fori_loop(0, ticks, tick, (recv0, outputs0))
+        return outputs
+
+    params_spec = jax.tree.map(lambda _: P(axis_name), stage_params)
+    out = shard_map(
+        pipelined,
+        mesh=mesh,
+        axis_names={axis_name},
+        in_specs=(params_spec, P(), *(P() for _ in consts)),
+        out_specs=P(axis_name),  # stacked per-stage: [pp, M, mb, ...]
+    )(stage_params, x_mb, *consts)
+    # Only the last stage's slot holds real outputs.
+    out = out.reshape(pp, num_microbatches, mb, *x.shape[1:])[-1]
+    return out.reshape(batch, *x.shape[1:]).astype(compute_dtype)
+
+
+def split_stages(stacked_params, pp: int):
+    """[n_layers, ...] leaves -> [pp, n_layers/pp, ...] (contiguous stages)."""
+
+    def reshape(p):
+        n = p.shape[0]
+        if n % pp:
+            raise ValueError(f"{n} layers not divisible by {pp} pipeline stages")
+        return p.reshape(pp, n // pp, *p.shape[1:])
+
+    return jax.tree.map(reshape, stacked_params)

@@ -65,7 +65,12 @@ def spec_for_param(path: str, ndim: int, mesh: Mesh) -> P:
                 axes = axes.get(ndim, axes[max(axes)])
             axes = _present(mesh, *axes)
             if len(axes) < ndim:
-                axes = (None,) * (ndim - len(axes)) + tuple(axes)
+                pad = [None] * (ndim - len(axes))
+                # The scanned stack's leading layer dim shards over pp when
+                # pipelining: each stage stores only its own layers.
+                if pad and "pp" in mesh.shape and "layers" in path:
+                    pad[0] = "pp"
+                axes = tuple(pad) + tuple(axes)
             return P(*axes[:ndim])
     return P()  # replicate by default
 
@@ -105,12 +110,31 @@ def batch_sharding(mesh: Mesh, with_sp: bool = True) -> NamedSharding:
 def constrain(x, *axes):
     """`with_sharding_constraint` against the current mesh; a no-op when no
     mesh is scoped (unsharded single-chip runs) or when every named axis is
-    absent from it. Axes may be axis names, tuples of names, or None."""
+    absent from it. Axes may be axis names, tuples of names, or None.
+
+    Inside a shard_map region (e.g. the pp pipeline) the trace's abstract
+    mesh marks the mapped axes Manual; the constraint must be built on THAT
+    mesh — a NamedSharding on the concrete all-Auto mesh is rejected for
+    arrays varying over a manual axis."""
     from .mesh import current_mesh
 
     mesh = current_mesh()
     if mesh is None:
         return x
+    abstract = jax.sharding.get_abstract_mesh()
+    if abstract is not None and abstract.shape:
+        manual = {
+            name
+            for name, kind in zip(abstract.axis_names, abstract.axis_types)
+            if kind == jax.sharding.AxisType.Manual
+        }
+        if manual:
+            # Inside the region the mapped axes are per-shard and the rest
+            # is still auto-partitioned; the boundary constraint is only a
+            # layout hint, so skip it rather than fight the manual trace
+            # (constraining on the abstract mesh here trips an XLA
+            # invalid-opcode CHECK as of jax 0.9 / this libtpu).
+            return x
     spec = P(*_present(mesh, *axes))
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
