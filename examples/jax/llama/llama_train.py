@@ -33,6 +33,8 @@ def main(argv=None) -> int:
     parser.add_argument("--log-every", type=int, default=10)
     parser.add_argument("--checkpoint-dir", default=os.environ.get("CHECKPOINT_DIR", ""))
     parser.add_argument("--checkpoint-every", type=int, default=200)
+    parser.add_argument("--data", default="", help="token shard file (raw ids); synthetic when empty")
+    parser.add_argument("--data-dtype", default="int32", choices=["int32", "uint16"])
     args = parser.parse_args(argv)
 
     import jax
@@ -85,11 +87,42 @@ def main(argv=None) -> int:
     if args.batch % topo.num_processes:
         raise SystemExit("--batch must divide by the process count")
     local_batch = args.batch // topo.num_processes
-    data = SyntheticTokens(local_batch, args.seq, config.vocab_size,
-                           seed=topo.process_id)
+    start_step = int(state.step)
+    if args.data:
+        # Real token shards through the native (C++ mmap + prefetch) loader;
+        # each process reads a disjoint window stream of the same file. On
+        # checkpoint resume, skip the windows already consumed — otherwise
+        # the resumed run double-trains early data and never sees the rest.
+        from tf_operator_tpu.train.data import TokenFileDataset
+
+        data = TokenFileDataset(
+            args.data, local_batch, args.seq,
+            dtype=args.data_dtype,
+            process_id=topo.process_id, num_processes=topo.num_processes,
+            skip_windows=start_step * local_batch,
+        )
+        probe = next(data)
+        if int(probe.max()) >= config.vocab_size or int(probe.min()) < 0:
+            raise SystemExit(
+                f"--data token ids span [{int(probe.min())}, {int(probe.max())}] "
+                f"but {args.model or 'the selected model'} has vocab_size="
+                f"{config.vocab_size}; the embedding gather would silently "
+                "clamp them — pick a matching --model/config"
+            )
+        # The probe consumed one batch; reopen at the exact resume point so
+        # the window counter stays step-aligned across preemptions.
+        data.close()
+        data = TokenFileDataset(
+            args.data, local_batch, args.seq,
+            dtype=args.data_dtype,
+            process_id=topo.process_id, num_processes=topo.num_processes,
+            skip_windows=start_step * local_batch,
+        )
+    else:
+        data = SyntheticTokens(local_batch, args.seq, config.vocab_size,
+                               seed=topo.process_id)
     data_spec = batch_sharding(mesh, with_sp=False)
 
-    start_step = int(state.step)
     t0 = time.perf_counter()
     for step in range(start_step, args.steps):
         tokens = shard_batch(next(data), data_spec)

@@ -1,0 +1,10 @@
+"""Native (C++) host-side components, loaded via ctypes.
+
+Built on demand with the system toolchain (g++ is in the base image; pip
+installs are not) and cached next to the source keyed by a source hash, so
+a source edit rebuilds and a cold cache is a one-time ~2s compile. Every
+consumer has a pure-Python fallback — the native tier is a performance
+floor-raiser, never a hard dependency.
+"""
+
+from .build import load_library  # noqa: F401

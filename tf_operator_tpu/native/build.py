@@ -1,0 +1,54 @@
+"""On-demand g++ build + ctypes load for the native components."""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+_log = logging.getLogger(__name__)
+_lock = threading.Lock()
+_cache: dict = {}
+
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _build_dir() -> str:
+    d = os.environ.get("TPU_OPERATOR_NATIVE_CACHE") or os.path.join(
+        tempfile.gettempdir(), "tf-operator-tpu-native"
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load_library(name: str) -> Optional[ctypes.CDLL]:
+    """Compile native/<name>.cc (cached by source hash) and dlopen it.
+    Returns None when the toolchain or compile fails — callers fall back
+    to their Python implementation."""
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        src = os.path.join(_SRC_DIR, f"{name}.cc")
+        try:
+            with open(src, "rb") as f:
+                digest = hashlib.sha256(f.read()).hexdigest()[:16]
+            out = os.path.join(_build_dir(), f"{name}-{digest}.so")
+            if not os.path.exists(out):
+                tmp = f"{out}.build-{os.getpid()}"
+                cmd = [
+                    "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                    "-pthread", src, "-o", tmp,
+                ]
+                subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+                os.replace(tmp, out)  # atomic: concurrent builders race safely
+            lib = ctypes.CDLL(out)
+        except (OSError, subprocess.SubprocessError) as exc:
+            _log.warning("native %s unavailable (%s); using Python fallback", name, exc)
+            lib = None
+        _cache[name] = lib
+        return lib

@@ -7,6 +7,8 @@ device_put onto the data sharding — the one host->device transfer per step.
 
 from __future__ import annotations
 
+import ctypes
+
 import numpy as np
 
 
@@ -27,6 +29,126 @@ class SyntheticTokens:
         return self._rng.integers(
             0, self.vocab_size, size=(self.batch, self.seq + 1), dtype=np.int32
         )
+
+
+class TokenFileDataset:
+    """Batches [batch, seq+1] from a raw token shard file ("tokens v1":
+    headerless little-endian int32 or uint16 ids).
+
+    Backed by the native C++ loader (native/dataloader.cc — mmap +
+    background prefetch ring, so batch assembly overlaps the device step)
+    with a numpy-mmap fallback when the toolchain is unavailable. Both
+    paths produce IDENTICAL batches: window w of this process is
+    w_global = w * num_processes + process_id, starting at
+    (w_global * 1000003) mod (n_tokens - seq - 1).
+    """
+
+    _STRIDE = 1000003  # keep in sync with kStride in dataloader.cc
+
+    def __init__(
+        self,
+        path: str,
+        batch: int,
+        seq: int,
+        dtype="int32",
+        process_id: int = 0,
+        num_processes: int = 1,
+        prefetch_depth: int = 4,
+        skip_windows: int = 0,
+        force_python: bool = False,
+    ):
+        self.path = path
+        self.batch = batch
+        self.seq = seq
+        self.dtype = np.dtype(dtype)
+        if self.dtype.itemsize not in (2, 4):
+            raise ValueError(f"token dtype must be uint16 or int32, got {dtype}")
+        self.process_id = process_id
+        self.num_processes = num_processes
+        # Checkpoint resume: windows this process already consumed
+        # (steps_done * local_batch) — both backends skip them.
+        self._window = skip_windows
+        self._handle = None
+        self._lib = None
+        self._mm = None
+
+        if not force_python:
+            from ..native import load_library
+
+            lib = load_library("dataloader")
+            if lib is not None:
+                lib.tl_open.restype = ctypes.c_void_p
+                lib.tl_open.argtypes = [
+                    ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+                    ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
+                    ctypes.c_int64, ctypes.c_int64,
+                ]
+                lib.tl_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32)]
+                lib.tl_next.restype = ctypes.c_int
+                lib.tl_token_count.argtypes = [ctypes.c_void_p]
+                lib.tl_token_count.restype = ctypes.c_int64
+                lib.tl_close.argtypes = [ctypes.c_void_p]
+                handle = lib.tl_open(
+                    path.encode(), batch, seq, self.dtype.itemsize,
+                    process_id, num_processes, prefetch_depth, skip_windows,
+                )
+                if handle:
+                    self._lib, self._handle = lib, handle
+        if self._handle is None:
+            self._mm = np.memmap(path, dtype=self.dtype, mode="r")
+            if len(self._mm) <= seq + 1:
+                raise ValueError(
+                    f"{path}: {len(self._mm)} tokens < one window ({seq + 1})"
+                )
+
+    @property
+    def native(self) -> bool:
+        return self._handle is not None
+
+    @property
+    def n_tokens(self) -> int:
+        if self.native:
+            return int(self._lib.tl_token_count(self._handle))
+        return len(self._mm)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> np.ndarray:
+        out = np.empty((self.batch, self.seq + 1), dtype=np.int32)
+        if self.native:
+            rc = self._lib.tl_next(
+                self._handle, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+            )
+            if rc != 0:
+                raise StopIteration
+            return out
+        usable = len(self._mm) - (self.seq + 1)
+        for b in range(self.batch):
+            w = self._window * self.num_processes + self.process_id
+            self._window += 1
+            start = (w * self._STRIDE) % usable
+            out[b] = self._mm[start : start + self.seq + 1].astype(np.int32)
+        return out
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.tl_close(self._handle)
+            self._handle = None
+
+    def __del__(self):  # best-effort: stop the producer thread
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def write_token_file(path: str, tokens: np.ndarray) -> None:
+    """Write a "tokens v1" shard (headerless raw ids, native byte order)."""
+    arr = np.asarray(tokens)
+    if arr.dtype not in (np.dtype("int32"), np.dtype("uint16")):
+        raise ValueError(f"token dtype must be uint16 or int32, got {arr.dtype}")
+    arr.tofile(path)
 
 
 def shard_batch(batch, sharding):
