@@ -95,23 +95,24 @@ def main(argv=None) -> int:
         # the resumed run double-trains early data and never sees the rest.
         from tf_operator_tpu.train.data import TokenFileDataset
 
-        data = TokenFileDataset(
-            args.data, local_batch, args.seq,
-            dtype=args.data_dtype,
-            process_id=topo.process_id, num_processes=topo.num_processes,
-            skip_windows=start_step * local_batch,
-        )
-        probe = next(data)
-        if int(probe.max()) >= config.vocab_size or int(probe.min()) < 0:
+        # Vocab sanity BEFORE any collective: every process scans the SAME
+        # file prefix (deterministic verdict on all hosts — a per-process
+        # probe of disjoint windows would exit on some hosts and hang the
+        # rest at the first collective), via memmap, without constructing
+        # or consuming the loader.
+        import numpy as np
+
+        head = np.memmap(args.data, dtype=args.data_dtype, mode="r")
+        head = head[: min(len(head), 10_000_000)]
+        lo, hi = int(head.min()), int(head.max())
+        if hi >= config.vocab_size or lo < 0:
             raise SystemExit(
-                f"--data token ids span [{int(probe.min())}, {int(probe.max())}] "
-                f"but {args.model or 'the selected model'} has vocab_size="
+                f"--data token ids span [{lo}, {hi}] but "
+                f"{args.model or 'the selected model'} has vocab_size="
                 f"{config.vocab_size}; the embedding gather would silently "
                 "clamp them — pick a matching --model/config"
             )
-        # The probe consumed one batch; reopen at the exact resume point so
-        # the window counter stays step-aligned across preemptions.
-        data.close()
+        del head
         data = TokenFileDataset(
             args.data, local_batch, args.seq,
             dtype=args.data_dtype,

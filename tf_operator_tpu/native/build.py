@@ -19,10 +19,17 @@ _SRC_DIR = os.path.dirname(os.path.abspath(__file__))
 
 
 def _build_dir() -> str:
+    """Per-user, 0700 cache dir. The .so here gets dlopen'd into the
+    process: a world-shared predictable path would let any local user
+    pre-place a library at the (computable) digest name. Ownership is
+    verified too, in case the path predates us with another owner."""
     d = os.environ.get("TPU_OPERATOR_NATIVE_CACHE") or os.path.join(
-        tempfile.gettempdir(), "tf-operator-tpu-native"
+        tempfile.gettempdir(), f"tf-operator-tpu-native-{os.getuid()}"
     )
-    os.makedirs(d, exist_ok=True)
+    os.makedirs(d, mode=0o700, exist_ok=True)
+    st = os.stat(d)
+    if st.st_uid != os.getuid():
+        d = tempfile.mkdtemp(prefix="tf-operator-tpu-native-")
     return d
 
 

@@ -65,7 +65,14 @@ struct Loader {
 
 constexpr int64_t kStride = 1000003;  // large odd prime: decorrelated windows
 
-int64_t usable(const Loader* l) { return l->n_tokens - (l->seq + 1); }
+int64_t usable(const Loader* l) {
+  int64_t u = l->n_tokens - (l->seq + 1);
+  // Degenerate stride cycle: if u divides kStride's multiples exactly
+  // ((w*kStride) mod u visits only u/kStride offsets), nudge u so the
+  // prime stride is coprime again. Mirrored in train/data.py.
+  if (u % kStride == 0) --u;
+  return u;
+}
 
 void fill_batch(Loader* l, int32_t* out) {
   const int64_t win = l->seq + 1;

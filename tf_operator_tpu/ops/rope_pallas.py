@@ -1,0 +1,89 @@
+"""Pallas TPU kernel for rotary position embedding (RoPE).
+
+Why a kernel for an elementwise op: the jnp formulation
+(`split` on the last dim + f32 upcast + `concatenate`) forces lane-dim
+shuffles and several HBM round-trips of the [b, s, h, d] activation per
+application — measured at ~30% of the whole train step on v5e (rope runs
+on q AND k, every layer, forward, remat-recompute, and backward). Here
+each block is rotated entirely in VMEM: one HBM read + one write of x per
+call, rotation math in f32 on VMEM-resident vectors, output cast back to
+the input dtype. Numerics match the jnp path bit-for-bit up to bf16
+rounding (same f32 math).
+
+Backward: RoPE is a per-pair rotation matrix R(θ); its VJP is rotation by
+-θ (the transpose). The custom VJP reuses the same kernel with negated
+sin — no residuals beyond the (tiny) tables.
+
+Layout contract: x [b, s, h, d] with cos/sin [s, d/2] fp32. The kernel
+grid is (b, s_blocks); each program rotates a [block_s, h, d] slab.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _rope_kernel(x_ref, cos_ref, sin_ref, o_ref):
+    x = x_ref[0].astype(jnp.float32)  # [block_s, h, d]
+    cos = cos_ref[...][:, None, :]  # [block_s, 1, d/2]
+    sin = sin_ref[...][:, None, :]
+    half = x.shape[-1] // 2
+    x1 = x[..., :half]
+    x2 = x[..., half:]
+    o_ref[0] = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).astype(o_ref.dtype)
+
+
+def _block_s(s: int, h: int, d: int, want: int) -> int:
+    # VMEM budget: Mosaic materializes ~4-5 f32 copies of the slab on the
+    # kernel stack (upcast, halves, products, concat) plus double-buffered
+    # IO; one f32 slab copy must stay well under ~1.5MB to fit the 16MB
+    # scoped limit.
+    cap = max(8, (3 << 19) // (h * d * 4))
+    size = min(want, s, 1 << (cap.bit_length() - 1))  # power of two <= cap
+    while s % size:
+        size //= 2
+    return max(size, 1)
+
+
+def _rope_raw(x, cos, sin, block_s, interpret):
+    b, s, h, d = x.shape
+    bs = _block_s(s, h, d, block_s)
+    return pl.pallas_call(
+        _rope_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        grid=(b, s // bs),
+        in_specs=[
+            pl.BlockSpec((1, bs, h, d), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((bs, d // 2), lambda i, j: (j, 0)),
+            pl.BlockSpec((bs, d // 2), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bs, h, d), lambda i, j: (i, j, 0, 0)),
+        interpret=interpret,
+    )(x, cos, sin)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def rope_pallas(x, cos, sin, block_s: int = 512, interpret: bool = False):
+    """Rotate pairs (x[..., :d/2], x[..., d/2:]) by position-dependent
+    angles. x: [b, s, h, d]; cos/sin: [s, d/2] fp32."""
+    return _rope_raw(x, cos, sin, block_s, interpret)
+
+
+def _rope_fwd(x, cos, sin, block_s, interpret):
+    return _rope_raw(x, cos, sin, block_s, interpret), (cos, sin)
+
+
+def _rope_bwd(block_s, interpret, res, g):
+    cos, sin = res
+    # R(-θ): the rotation transpose. cos/sin gradients are not needed
+    # (tables are position functions, not parameters).
+    return _rope_raw(g, cos, -sin, block_s, interpret), None, None
+
+
+rope_pallas.defvjp(_rope_fwd, _rope_bwd)

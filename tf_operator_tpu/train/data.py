@@ -61,7 +61,7 @@ class TokenFileDataset:
         self.batch = batch
         self.seq = seq
         self.dtype = np.dtype(dtype)
-        if self.dtype.itemsize not in (2, 4):
+        if self.dtype not in (np.dtype("int32"), np.dtype("uint16")):
             raise ValueError(f"token dtype must be uint16 or int32, got {dtype}")
         self.process_id = process_id
         self.num_processes = num_processes
@@ -124,6 +124,10 @@ class TokenFileDataset:
                 raise StopIteration
             return out
         usable = len(self._mm) - (self.seq + 1)
+        if usable % self._STRIDE == 0:
+            # Degenerate stride cycle: (w*STRIDE) mod usable would visit
+            # only usable/STRIDE offsets. Mirrored in dataloader.cc.
+            usable -= 1
         for b in range(self.batch):
             w = self._window * self.num_processes + self.process_id
             self._window += 1
