@@ -16,6 +16,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import threading
 import time
 import uuid
@@ -51,6 +52,10 @@ class OperatorOptions:
     enable_gang_scheduling: bool = False
     gang_scheduler_name: str = "volcano"
     json_log_format: bool = False
+    # Client write throttling (reference QPS 5 / burst 10 defaults are for
+    # a remote apiserver; in-process default is unlimited).
+    qps: float = 0.0
+    burst: int = 0
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -66,7 +71,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
         metavar="KIND",
         help="Job kind to enable (repeatable); default: all supported kinds.",
     )
-    parser.add_argument("--namespace", default="", help="Restrict to one namespace (default: all).")
+    parser.add_argument(
+        "--namespace",
+        default=os.environ.get("KUBEFLOW_NAMESPACE", ""),
+        help="Restrict to one namespace (default: $KUBEFLOW_NAMESPACE, else all).",
+    )
     parser.add_argument("--threadiness", type=int, default=1, help="Worker threads per controller.")
     parser.add_argument("--resync-period", type=float, default=30.0, help="Full relist/resync seconds.")
     parser.add_argument("--bind-address", default="0.0.0.0", help="Address metrics/health servers bind.")
@@ -77,6 +86,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     parser.add_argument("--enable-gang-scheduling", action="store_true")
     parser.add_argument("--gang-scheduler-name", default="volcano")
     parser.add_argument("--json-log-format", action="store_true")
+    parser.add_argument("--qps", type=float, default=0.0,
+                        help="Client write QPS limit (0 = unlimited; reference default 5).")
+    parser.add_argument("--burst", type=int, default=0,
+                        help="Client write burst (reference default 10).")
     return parser
 
 
@@ -94,6 +107,8 @@ def options_from_args(args: argparse.Namespace) -> OperatorOptions:
         enable_gang_scheduling=args.enable_gang_scheduling,
         gang_scheduler_name=args.gang_scheduler_name,
         json_log_format=args.json_log_format,
+        qps=args.qps,
+        burst=args.burst,
     )
 
 
@@ -215,7 +230,12 @@ class OperatorManager:
         engine_options = EngineOptions(
             enable_gang_scheduling=self.options.enable_gang_scheduling,
             gang_scheduler_name=self.options.gang_scheduler_name,
+            qps=self.options.qps,
+            burst=self.options.burst,
         )
+        from .core.control import TokenBucket
+
+        shared_limiter = TokenBucket(self.options.qps, self.options.burst)
         self.controllers: Dict[str, object] = {}
         for kind in enabled_kinds(self.options.enabled_schemes):
             self.controllers[kind] = SUPPORTED_CONTROLLERS[kind](
@@ -223,6 +243,7 @@ class OperatorManager:
                 options=engine_options,
                 metrics=self.metrics,
                 namespace=self.options.namespace,
+                limiter=shared_limiter,
             )
         self._set_leader_gauge()
 

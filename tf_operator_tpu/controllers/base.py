@@ -17,7 +17,7 @@ from ..api.defaulting import ValidationError
 from ..api.k8s import Event
 from ..cluster.base import ADDED, DELETED, Cluster, NotFound
 from ..core import constants
-from ..core.control import RealPodControl, RealServiceControl
+from ..core.control import RealPodControl, RealServiceControl, TokenBucket
 from ..core.expectations import ControllerExpectations
 from ..core.job_controller import EngineOptions, FrameworkHooks, JobController
 from ..core.workqueue import WorkQueue
@@ -37,6 +37,7 @@ class FrameworkController(FrameworkHooks):
         clock=time.time,
         metrics=None,
         namespace: str = "",
+        limiter: Optional[TokenBucket] = None,
     ):
         self.cluster = cluster
         self.queue = queue or WorkQueue()
@@ -49,11 +50,18 @@ class FrameworkController(FrameworkHooks):
             metrics = METRICS
         self.metrics = metrics
         self.expectations = ControllerExpectations()
+        opts = options or EngineOptions()
+        # ONE client budget per operator process: the manager passes a
+        # shared bucket to every controller (a per-controller bucket would
+        # multiply --qps by the number of enabled kinds). Standalone
+        # construction builds its own.
+        if limiter is None:
+            limiter = TokenBucket(opts.qps, opts.burst)
         self.engine = JobController(
             hooks=self,
             cluster=cluster,
-            pod_control=RealPodControl(cluster),
-            service_control=RealServiceControl(cluster),
+            pod_control=RealPodControl(cluster, limiter),
+            service_control=RealServiceControl(cluster, limiter),
             expectations=self.expectations,
             options=options,
             requeue=lambda key, after: self.queue.add_after(key, after),

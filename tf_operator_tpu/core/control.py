@@ -8,6 +8,8 @@ tests at controller_test.go:63-64).
 
 from __future__ import annotations
 
+import threading
+import time
 from typing import List, Optional
 
 from ..api.common import JobObject
@@ -18,6 +20,37 @@ from . import constants
 
 def owner_ref_for(job: JobObject):
     return new_owner_reference(job.api_version, job.kind, job.name, job.metadata.uid)
+
+
+class TokenBucket:
+    """Client-side write throttling — the reference's --qps/--burst client
+    rate limits (options.go:73-83, defaults QPS 5 / burst 10 against the
+    apiserver). qps <= 0 disables (unlimited)."""
+
+    def __init__(self, qps: float = 0.0, burst: int = 0, clock=time.monotonic):
+        self.qps = qps
+        self.burst = max(1, burst) if qps > 0 else 0
+        self._tokens = float(self.burst)
+        self._last = clock()
+        self._clock = clock
+        self._lock = threading.Lock()
+
+    def acquire(self) -> None:
+        """Block until a token is available (no-op when disabled)."""
+        if self.qps <= 0:
+            return
+        while True:
+            with self._lock:
+                now = self._clock()
+                self._tokens = min(
+                    float(self.burst), self._tokens + (now - self._last) * self.qps
+                )
+                self._last = now
+                if self._tokens >= 1.0:
+                    self._tokens -= 1.0
+                    return
+                wait = (1.0 - self._tokens) / self.qps
+            time.sleep(min(wait, 0.1))
 
 
 class PodControl:
@@ -37,10 +70,12 @@ class ServiceControl:
 
 
 class RealPodControl(PodControl):
-    def __init__(self, cluster: Cluster):
+    def __init__(self, cluster: Cluster, limiter: Optional[TokenBucket] = None):
         self.cluster = cluster
+        self.limiter = limiter or TokenBucket()
 
     def create_pod(self, namespace: str, pod: Pod, job: JobObject) -> None:
+        self.limiter.acquire()
         pod.metadata.namespace = namespace
         pod.metadata.owner_references.append(owner_ref_for(job))
         self.cluster.create_pod(pod)
@@ -54,6 +89,7 @@ class RealPodControl(PodControl):
         )
 
     def delete_pod(self, namespace: str, name: str, job: JobObject) -> None:
+        self.limiter.acquire()
         self.cluster.delete_pod(namespace, name)
         self.cluster.record_event(
             Event(
@@ -66,10 +102,12 @@ class RealPodControl(PodControl):
 
 
 class RealServiceControl(ServiceControl):
-    def __init__(self, cluster: Cluster):
+    def __init__(self, cluster: Cluster, limiter: Optional[TokenBucket] = None):
         self.cluster = cluster
+        self.limiter = limiter or TokenBucket()
 
     def create_service(self, namespace: str, service: Service, job: JobObject) -> None:
+        self.limiter.acquire()
         service.metadata.namespace = namespace
         service.metadata.owner_references.append(owner_ref_for(job))
         self.cluster.create_service(service)
@@ -83,6 +121,7 @@ class RealServiceControl(ServiceControl):
         )
 
     def delete_service(self, namespace: str, name: str, job: JobObject) -> None:
+        self.limiter.acquire()
         self.cluster.delete_service(namespace, name)
         self.cluster.record_event(
             Event(

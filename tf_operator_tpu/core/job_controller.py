@@ -184,6 +184,9 @@ class FrameworkHooks:
 class EngineOptions:
     enable_gang_scheduling: bool = False
     gang_scheduler_name: str = constants.GANG_SCHEDULER_NAME_DEFAULT
+    # Client-side write throttling (reference --qps/--burst; 0 = unlimited).
+    qps: float = 0.0
+    burst: int = 0
 
 
 class JobController:

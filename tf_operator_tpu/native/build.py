@@ -28,7 +28,10 @@ def _build_dir() -> str:
     )
     os.makedirs(d, mode=0o700, exist_ok=True)
     st = os.stat(d)
-    if st.st_uid != os.getuid():
+    # Ownership AND permissions: exist_ok skips the mode on a pre-existing
+    # dir, so a user-owned but group/world-writable path would still let
+    # another local user pre-place the .so at its computable digest name.
+    if st.st_uid != os.getuid() or (st.st_mode & 0o022):
         d = tempfile.mkdtemp(prefix="tf-operator-tpu-native-")
     return d
 
