@@ -1,0 +1,40 @@
+# Developer entry points (reference Makefile: manifests/generate/test/
+# build/run/docker-build/deploy, Makefile:40-87).
+
+IMG ?= tf-operator-tpu:latest
+PY ?= python
+
+.PHONY: all test unit e2e manifests run docker-build deploy bench dryrun
+
+all: test
+
+test:            ## full suite (unit + process e2e), CPU virtual mesh
+	$(PY) -m pytest tests/ -q
+
+unit:            ## fast tier only
+	$(PY) -m pytest tests/ -q --ignore=tests/test_e2e_process.py \
+	  --ignore=tests/test_models.py --ignore=tests/test_workload_tier.py \
+	  --ignore=tests/test_flash_pallas.py --ignore=tests/test_examples.py \
+	  --ignore=tests/test_pipeline.py
+
+e2e:             ## process-backed e2e tier
+	$(PY) -m pytest tests/test_e2e_process.py -q
+
+manifests:       ## regenerate CRDs + operator deployment from the API dataclasses
+	$(PY) -m tf_operator_tpu.manifests --out manifests
+
+run:             ## run the operator against the in-memory dev cluster
+	$(PY) -m tf_operator_tpu
+
+docker-build:    ## operator image
+	docker build -f build/images/tf-operator-tpu/Dockerfile -t $(IMG) .
+
+deploy:          ## apply CRDs + operator to the current kube context
+	kubectl apply -f manifests/crds/ && kubectl apply -f manifests/operator.yaml
+
+bench:           ## single-chip training benchmark (prints one JSON line)
+	$(PY) bench.py
+
+dryrun:          ## compile-check every sharding on an 8-device virtual mesh
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  $(PY) -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Establish the control-plane latency baselines BASELINE.md calls for:
+
+- job-startup p50: kubectl-apply -> all replicas Running
+- restart MTTR:    replica killed (SIGKILL, retryable) -> replacement Running
+
+Measured against the process-backed cluster (real subprocesses, real
+operator loop — the same fabric the e2e tier uses), so the numbers bound
+the operator's own contribution: informer round-trips, expectation gating,
+pod/service creation, NOT container-image pulls or node scheduling.
+
+Prints one JSON object.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tf_operator_tpu.cli import OperatorManager, OperatorOptions  # noqa: E402
+from tf_operator_tpu.cluster.process import LocalProcessCluster  # noqa: E402
+from tf_operator_tpu.metrics import Metrics  # noqa: E402
+
+CHILD_ENV = {"PYTHONPATH": REPO}
+SERVER = [sys.executable, "-m", "tf_operator_tpu.testing.test_server"]
+
+
+def wait_for(pred, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+def manifest(name, workers=2):
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "TFJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "tfReplicaSpecs": {
+                "Worker": {
+                    "replicas": workers,
+                    "restartPolicy": "ExitCode",
+                    "template": {
+                        "spec": {
+                            "containers": [
+                                {"name": "tensorflow", "image": "local", "command": SERVER}
+                            ]
+                        }
+                    },
+                }
+            }
+        },
+    }
+
+
+def main(trials: int = 10) -> int:
+    metrics = Metrics()
+    cluster = LocalProcessCluster(child_env=CHILD_ENV)
+    manager = OperatorManager(
+        cluster,
+        OperatorOptions(enabled_schemes=["TFJob"], health_port=0, metrics_port=0,
+                        resync_period=0.2),
+        metrics=metrics,
+    )
+    manager.start()
+
+    startup, mttr = [], []
+    try:
+        for i in range(trials):
+            name = f"m{i}"
+            t0 = time.monotonic()
+            cluster.create_job(manifest(name))
+            ok = wait_for(
+                lambda: len(
+                    [p for p in cluster.list_pods("default")
+                     if p.metadata.labels.get("job-name") == name
+                     and p.status.phase == "Running"]
+                ) == 2
+            )
+            if not ok:
+                raise SystemExit(f"{name}: never reached 2 running pods")
+            startup.append(time.monotonic() - t0)
+
+            # Preemption: SIGKILL worker-1, time to a RUNNING replacement.
+            victim = f"{name}-worker-1"
+            born = cluster.get_pod("default", victim).status.start_time
+            t1 = time.monotonic()
+            cluster.kill_pod("default", victim)
+            ok = wait_for(
+                lambda: (lambda p: p is not None and p.status.phase == "Running"
+                         and p.status.start_time and p.status.start_time > born)(
+                    _get(cluster, victim))
+            )
+            if not ok:
+                raise SystemExit(f"{name}: replacement never came up")
+            mttr.append(time.monotonic() - t1)
+            cluster.delete_job("TFJob", "default", name)
+    finally:
+        manager.stop()
+        cluster.shutdown()
+
+    def pct(xs, q):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+    out = {
+        "trials": trials,
+        "startup_p50_s": round(statistics.median(startup), 3),
+        "startup_p90_s": round(pct(startup, 0.9), 3),
+        "restart_mttr_p50_s": round(statistics.median(mttr), 3),
+        "restart_mttr_p90_s": round(pct(mttr, 0.9), 3),
+    }
+    print(json.dumps(out))
+    return 0
+
+
+def _get(cluster, name):
+    try:
+        return cluster.get_pod("default", name)
+    except KeyError:
+        return None
+
+
+if __name__ == "__main__":
+    sys.exit(main(int(sys.argv[1]) if len(sys.argv) > 1 else 10))

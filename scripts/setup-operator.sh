@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Install the operator into the current kube context (the analog of the
+# reference's scripts/setup-training-operator.sh).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python -m tf_operator_tpu.manifests --out manifests
+kubectl apply -f manifests/crds/
+kubectl apply -f manifests/operator.yaml
+kubectl -n kubeflow rollout status deployment/tf-operator-tpu --timeout=120s

@@ -277,8 +277,14 @@ def test_qps_flag_reaches_engine():
 
     mgr = OperatorManager(InMemoryCluster(), opts, metrics=Metrics())
     ctrl = next(iter(mgr.controllers.values()))
-    assert ctrl.engine.pod_control.limiter.qps == 5.0
-    assert ctrl.engine.pod_control.limiter is ctrl.engine.service_control.limiter
+    from tf_operator_tpu.cluster.throttled import ThrottledCluster
+
+    assert isinstance(ctrl.cluster, ThrottledCluster)
+    assert ctrl.cluster._limiter.qps == 5.0
+    # The SAME throttled boundary serves engine, pod and service control,
+    # so events and status writes pay the budget too.
+    assert ctrl.engine.cluster is ctrl.cluster
+    assert ctrl.engine.pod_control.cluster is ctrl.cluster
 
 
 def test_qps_budget_shared_across_kinds():
@@ -293,6 +299,5 @@ def test_qps_budget_shared_across_kinds():
         OperatorOptions(health_port=0, metrics_port=0, qps=5, burst=10),
         metrics=Metrics(),
     )
-    limiters = {id(c.engine.pod_control.limiter) for c in mgr.controllers.values()}
-    limiters |= {id(c.engine.service_control.limiter) for c in mgr.controllers.values()}
+    limiters = {id(c.cluster._limiter) for c in mgr.controllers.values()}
     assert len(limiters) == 1

@@ -1,0 +1,50 @@
+"""Write-throttling cluster proxy.
+
+The reference enforces --qps/--burst at the rest-client layer
+(options.go:73-83, client-go rate limiter), so EVERY apiserver write —
+pods, services, events, status patches, pod groups — draws from one
+budget. This proxy reproduces that: controllers talk to the cluster
+through it, and each write acquires from the shared TokenBucket before
+delegating. Reads and watches pass through unthrottled (informer traffic
+is cache-backed in both worlds).
+"""
+
+from __future__ import annotations
+
+from ..core.control import TokenBucket
+from .base import Cluster
+
+_WRITE_METHODS = (
+    "create_job",
+    "update_job",
+    "update_job_status",
+    "delete_job",
+    "create_pod",
+    "update_pod",
+    "delete_pod",
+    "create_service",
+    "delete_service",
+    "record_event",
+    "create_pod_group",
+    "delete_pod_group",
+)
+
+
+class ThrottledCluster:
+    """Delegates everything to `inner`; write methods pay the bucket."""
+
+    def __init__(self, inner: Cluster, limiter: TokenBucket):
+        self._inner = inner
+        self._limiter = limiter
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name in _WRITE_METHODS and callable(attr):
+            limiter = self._limiter
+
+            def throttled(*args, **kwargs):
+                limiter.acquire()
+                return attr(*args, **kwargs)
+
+            return throttled
+        return attr

@@ -39,6 +39,17 @@ class FrameworkController(FrameworkHooks):
         namespace: str = "",
         limiter: Optional[TokenBucket] = None,
     ):
+        opts = options or EngineOptions()
+        # ONE client budget per operator process, enforced at the cluster
+        # boundary so EVERY write (pods, services, events, status) pays it
+        # — reference rest-client semantics. The manager passes a shared
+        # bucket; standalone construction builds one from the options.
+        if limiter is None and opts.qps > 0:
+            limiter = TokenBucket(opts.qps, opts.burst)
+        if limiter is not None and limiter.qps > 0:
+            from ..cluster.throttled import ThrottledCluster
+
+            cluster = ThrottledCluster(cluster, limiter)
         self.cluster = cluster
         self.queue = queue or WorkQueue()
         # Namespace scoping (legacy --namespace, options.go:36): empty = all.
@@ -50,18 +61,11 @@ class FrameworkController(FrameworkHooks):
             metrics = METRICS
         self.metrics = metrics
         self.expectations = ControllerExpectations()
-        opts = options or EngineOptions()
-        # ONE client budget per operator process: the manager passes a
-        # shared bucket to every controller (a per-controller bucket would
-        # multiply --qps by the number of enabled kinds). Standalone
-        # construction builds its own.
-        if limiter is None:
-            limiter = TokenBucket(opts.qps, opts.burst)
         self.engine = JobController(
             hooks=self,
-            cluster=cluster,
-            pod_control=RealPodControl(cluster, limiter),
-            service_control=RealServiceControl(cluster, limiter),
+            cluster=self.cluster,
+            pod_control=RealPodControl(self.cluster),
+            service_control=RealServiceControl(self.cluster),
             expectations=self.expectations,
             options=options,
             requeue=lambda key, after: self.queue.add_after(key, after),

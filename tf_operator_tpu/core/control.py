@@ -29,6 +29,11 @@ class TokenBucket:
 
     def __init__(self, qps: float = 0.0, burst: int = 0, clock=time.monotonic):
         self.qps = qps
+        # Reference defaults are QPS 5 / burst 10: with qps set but burst
+        # unset, default to 2x qps rather than a burst-less bucket that
+        # would serialize every batch of writes.
+        if qps > 0 and burst <= 0:
+            burst = max(1, int(2 * qps))
         self.burst = max(1, burst) if qps > 0 else 0
         self._tokens = float(self.burst)
         self._last = clock()
@@ -70,12 +75,10 @@ class ServiceControl:
 
 
 class RealPodControl(PodControl):
-    def __init__(self, cluster: Cluster, limiter: Optional[TokenBucket] = None):
+    def __init__(self, cluster: Cluster):
         self.cluster = cluster
-        self.limiter = limiter or TokenBucket()
 
     def create_pod(self, namespace: str, pod: Pod, job: JobObject) -> None:
-        self.limiter.acquire()
         pod.metadata.namespace = namespace
         pod.metadata.owner_references.append(owner_ref_for(job))
         self.cluster.create_pod(pod)
@@ -89,7 +92,6 @@ class RealPodControl(PodControl):
         )
 
     def delete_pod(self, namespace: str, name: str, job: JobObject) -> None:
-        self.limiter.acquire()
         self.cluster.delete_pod(namespace, name)
         self.cluster.record_event(
             Event(
@@ -102,12 +104,10 @@ class RealPodControl(PodControl):
 
 
 class RealServiceControl(ServiceControl):
-    def __init__(self, cluster: Cluster, limiter: Optional[TokenBucket] = None):
+    def __init__(self, cluster: Cluster):
         self.cluster = cluster
-        self.limiter = limiter or TokenBucket()
 
     def create_service(self, namespace: str, service: Service, job: JobObject) -> None:
-        self.limiter.acquire()
         service.metadata.namespace = namespace
         service.metadata.owner_references.append(owner_ref_for(job))
         self.cluster.create_service(service)
@@ -121,7 +121,6 @@ class RealServiceControl(ServiceControl):
         )
 
     def delete_service(self, namespace: str, name: str, job: JobObject) -> None:
-        self.limiter.acquire()
         self.cluster.delete_service(namespace, name)
         self.cluster.record_event(
             Event(
