@@ -110,8 +110,12 @@ def main(trials: int = 10) -> int:
         cluster.shutdown()
 
     def pct(xs, q):
+        import math
+
         xs = sorted(xs)
-        return xs[min(len(xs) - 1, int(q * len(xs)))]
+        # Nearest-rank percentile: ceil(q*n)-1 (int(q*n) would index one
+        # past it — p90 of 10 samples must be the 9th, not the max).
+        return xs[min(len(xs) - 1, max(0, math.ceil(q * len(xs)) - 1))]
 
     out = {
         "trials": trials,

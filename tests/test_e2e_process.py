@@ -376,6 +376,50 @@ class TestCheckpointResumeAfterPreemption:
         )
 
 
+class TestDistributedLlamaTraining:
+    def test_two_process_llama_train_to_completion(self, harness):
+        """Capstone distributed e2e (SURVEY.md §7 stage 3 'minimum e2e
+        slice', grown up): the operator boots TWO worker processes that
+        rendezvous via the injected coordinator env, build one federated
+        8-device mesh, and run REAL sharded Llama training steps (each
+        process feeding its local batch shard) to completion."""
+        train_cmd = [
+            sys.executable,
+            os.path.join(REPO_ROOT, "examples", "jax", "llama", "llama_train.py"),
+            "--model", "llama-tiny", "--steps", "6", "--batch", "8",
+            "--seq", "32", "--log-every", "3",
+        ]
+        harness.create_job(
+            {
+                "apiVersion": "kubeflow.org/v1",
+                "kind": "JAXJob",
+                "metadata": {"name": "dist", "namespace": "default"},
+                "spec": {
+                    "jaxReplicaSpecs": {
+                        "Worker": {
+                            "replicas": 2,
+                            "template": {
+                                "spec": {
+                                    "containers": [
+                                        {"name": "jax", "image": "local", "command": train_cmd}
+                                    ]
+                                }
+                            },
+                        }
+                    }
+                },
+            }
+        )
+        assert wait_for(
+            lambda: job_condition(harness, "JAXJob", "dist", "Succeeded"),
+            timeout=240,
+        ), harness.get_pod_log("default", "dist-worker-0")
+        for i in range(2):
+            log = harness.get_pod_log("default", f"dist-worker-{i}")
+            assert f"process {i}/2 devices=8" in log, log
+            assert "[llama] done" in log, log
+
+
 class TestJAXJobRendezvous:
     def test_two_process_rendezvous_and_psum(self, harness):
         """SURVEY §7 stage 3, the 'minimum e2e slice': two worker processes

@@ -184,3 +184,23 @@ class TestPytorchExampleE2E:
         finally:
             manager.stop()
             cluster.shutdown()
+
+
+def test_sdk_notebook_executes():
+    """The SDK tour notebook (reference examples/kubeflow-tfjob-sdk.ipynb
+    analog) must execute top to bottom against the dev cluster."""
+    import nbformat
+    from nbclient import NotebookClient
+
+    path = os.path.join(EXAMPLES, "sdk_tour.ipynb")
+    nb = nbformat.read(path, as_version=4)
+    client = NotebookClient(nb, timeout=120, kernel_name="python3",
+                            resources={"metadata": {"path": EXAMPLES}})
+    client.execute()
+    text = "\n".join(
+        out.get("text", "")
+        for cell in nb.cells if cell.cell_type == "code"
+        for out in cell.get("outputs", [])
+    )
+    assert "final: Succeeded" in text
+    assert "workers after : 12" in text

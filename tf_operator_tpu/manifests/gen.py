@@ -119,10 +119,17 @@ def generate_crd(module) -> Dict[str, Any]:
 
 
 def operator_manifests(namespace: str = "kubeflow") -> List[Dict[str, Any]]:
+    # The Namespace object leads the list: a fresh cluster has no
+    # "kubeflow" namespace, and every other object here targets it.
     """Deployment + Service + RBAC for the operator process (reference
     manifests/base/{deployment,service,cluster-role,service-account}.yaml)."""
     labels = {"control-plane": "tf-operator-tpu"}
     return [
+        {
+            "apiVersion": "v1",
+            "kind": "Namespace",
+            "metadata": {"name": namespace},
+        },
         {
             "apiVersion": "v1",
             "kind": "ServiceAccount",
