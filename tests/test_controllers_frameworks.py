@@ -464,3 +464,78 @@ class TestRegistry:
         assert enabled_kinds() == list(SUPPORTED_CONTROLLERS)
         with pytest.raises(ValueError, match="unsupported"):
             enabled_kinds(["NopeJob"])
+
+
+class TestSuspend:
+    """RunPolicy.suspend (training-operator v1.7 parity): tear down without
+    failing; resume restarts with a fresh lifecycle window. On TPU a
+    suspended JAXJob releases its whole slice (gang groups included)."""
+
+    def setup_method(self):
+        self.cluster = InMemoryCluster()
+        self.controller = JAXController(
+            self.cluster, options=EngineOptions(enable_gang_scheduling=True)
+        )
+
+    def _running_job(self, name="s"):
+        self.cluster.create_job(jax_manifest(name, accelerator="v5e-16"))
+        self.controller.run_until_idle()
+        for p in self.cluster.list_pods():
+            self.cluster.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+        self.controller.run_until_idle()
+
+    def test_suspend_tears_down_and_resume_recreates(self):
+        self._running_job()
+        assert len(self.cluster.list_pods()) == 4
+        job = self.cluster.get_job("JAXJob", "default", "s")
+        first_start = job["status"]["startTime"]
+
+        job["spec"]["runPolicy"] = {"suspend": True}
+        self.cluster.update_job(job)
+        self.controller.run_until_idle()
+
+        assert self.cluster.list_pods() == []
+        assert self.cluster.list_services() == []
+        with pytest.raises(Exception):
+            self.cluster.get_pod_group("default", "s-slice-0")
+        conds = {c["type"]: c["status"] for c in self.cluster.get_job("JAXJob", "default", "s")["status"]["conditions"]}
+        assert conds["Suspended"] == "True"
+        assert conds.get("Failed") != "True"
+        assert "JAXJobSuspended" in {e.reason for e in self.cluster.list_events()}
+
+        # Resume: pods recreated, Suspended flips False, startTime is fresh.
+        job = self.cluster.get_job("JAXJob", "default", "s")
+        job["spec"]["runPolicy"]["suspend"] = False
+        self.cluster.update_job(job)
+        self.controller.run_until_idle()
+        for p in self.cluster.list_pods():
+            self.cluster.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+        self.controller.run_until_idle()
+
+        assert len(self.cluster.list_pods()) == 4
+        status = self.cluster.get_job("JAXJob", "default", "s")["status"]
+        conds = {c["type"]: c["status"] for c in status["conditions"]}
+        assert conds["Suspended"] == "False"
+        assert conds["Running"] == "True"
+        assert status["startTime"] != first_start
+        assert "JAXJobResumed" in {e.reason for e in self.cluster.list_events()}
+
+    def test_created_suspended_never_starts_pods(self):
+        manifest = jax_manifest("cold", accelerator="v5e-16")
+        manifest["spec"]["runPolicy"] = {"suspend": True}
+        self.cluster.create_job(manifest)
+        self.controller.run_until_idle()
+        assert self.cluster.list_pods() == []
+        conds = {c["type"]: c["status"] for c in self.cluster.get_job("JAXJob", "default", "cold")["status"]["conditions"]}
+        assert conds["Suspended"] == "True"
+
+    def test_suspend_zeroes_replica_statuses(self):
+        self._running_job("z")
+        status = self.cluster.get_job("JAXJob", "default", "z")["status"]
+        assert status["replicaStatuses"]["Worker"]["active"] == 4
+        job = self.cluster.get_job("JAXJob", "default", "z")
+        job["spec"]["runPolicy"] = {"suspend": True}
+        self.cluster.update_job(job)
+        self.controller.run_until_idle()
+        status = self.cluster.get_job("JAXJob", "default", "z")["status"]
+        assert status["replicaStatuses"]["Worker"]["active"] == 0

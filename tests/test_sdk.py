@@ -185,3 +185,32 @@ def wait_until(predicate, timeout=5.0, interval=0.02):
             return
         time.sleep(interval)
     assert predicate(), "condition not reached in time"
+
+
+def test_suspend_resume_via_sdk():
+    from tf_operator_tpu.cli import OperatorManager, OperatorOptions
+    from tf_operator_tpu.cluster.memory import InMemoryCluster
+    from tf_operator_tpu.metrics import Metrics
+    from tf_operator_tpu.sdk import TFJobClient
+
+    cluster = InMemoryCluster()
+    manager = OperatorManager(
+        cluster,
+        OperatorOptions(enabled_schemes=["TFJob"], health_port=0, metrics_port=0, resync_period=0.2),
+        metrics=Metrics(),
+    )
+    manager.start()
+    try:
+        client = TFJobClient(cluster)
+        client.create(tfjob_manifest("sz", workers=2))
+        wait_until(lambda: len(cluster.list_pods()) == 2)
+        client.suspend("sz")
+        wait_until(lambda: cluster.list_pods() == [])
+        conds = {c["type"]: c["status"] for c in client.get("sz")["status"]["conditions"]}
+        assert conds["Suspended"] == "True" and conds.get("Failed") != "True"
+        client.resume("sz")
+        wait_until(lambda: len(cluster.list_pods()) == 2)
+        conds = {c["type"]: c["status"] for c in client.get("sz")["status"]["conditions"]}
+        assert conds["Suspended"] == "False"
+    finally:
+        manager.stop()

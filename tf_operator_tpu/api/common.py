@@ -44,6 +44,9 @@ JOB_RUNNING = "Running"
 JOB_RESTARTING = "Restarting"
 JOB_SUCCEEDED = "Succeeded"
 JOB_FAILED = "Failed"
+# Suspension (training-operator RunPolicy.suspend): on TPU, a suspended
+# job releases its whole pod-slice back to the scheduler.
+JOB_SUSPENDED = "Suspended"
 
 CONDITION_TRUE = "True"
 CONDITION_FALSE = "False"
@@ -79,6 +82,10 @@ class RunPolicy:
     active_deadline_seconds: Optional[int] = None
     backoff_limit: Optional[int] = None
     scheduling_policy: Optional[SchedulingPolicy] = None
+    # Suspend (training-operator v1.7 RunPolicy.suspend): true tears down
+    # every pod (and gang groups — on TPU this releases the whole slice)
+    # without failing the job; false/None resumes with a fresh startTime.
+    suspend: Optional[bool] = None
 
 
 @dataclass
@@ -196,14 +203,24 @@ def update_job_conditions(
         drop.add(JOB_RESTARTING)
     if cond_type == JOB_RESTARTING:
         drop.add(JOB_RUNNING)
+    if cond_type == JOB_SUSPENDED:
+        drop.add(JOB_RESTARTING)
     kept = [c for c in status.conditions if c.type not in drop]
 
-    if cond_type in (JOB_SUCCEEDED, JOB_FAILED):
+    # Flip (not drop) the mutually-exclusive observers so the history stays
+    # visible: terminal conditions and Suspended set Running=False; Running
+    # sets Suspended=False (the resumed record remains in conditions).
+    def _flip(target: str) -> None:
         for c in kept:
-            if c.type == JOB_RUNNING and c.status == CONDITION_TRUE:
+            if c.type == target and c.status == CONDITION_TRUE:
                 c.status = CONDITION_FALSE
                 c.last_transition_time = now
                 c.last_update_time = now
+
+    if cond_type in (JOB_SUCCEEDED, JOB_FAILED, JOB_SUSPENDED):
+        _flip(JOB_RUNNING)
+    if cond_type == JOB_RUNNING:
+        _flip(JOB_SUSPENDED)
 
     kept.append(new_cond)
     status.conditions = kept

@@ -55,6 +55,8 @@ REASON_RUNNING = "Running"
 REASON_RESTARTING = "Restarting"
 REASON_SUCCEEDED = "Succeeded"
 REASON_FAILED = "Failed"
+REASON_SUSPENDED = "Suspended"
+REASON_RESUMED = "Resumed"
 
 # Exit code sentinel when the framework container has not terminated
 # (reference tfjob_controller.go:707 "magic number").

@@ -281,6 +281,39 @@ class JobController:
             self._write_status_if_changed(job, old_status)
             return
 
+        # Suspension (RunPolicy.suspend): tear everything down WITHOUT
+        # failing the job — on TPU the whole pod-slice goes back to the
+        # scheduler. Resume resets startTime (fresh ActiveDeadline window,
+        # training-operator semantics).
+        if run_policy.suspend:
+            self._suspend_job(job, pods, replicas, run_policy)
+            self._write_status_if_changed(job, old_status)
+            return
+        suspended = capi.get_condition(job.status, capi.JOB_SUSPENDED)
+        if suspended is not None and suspended.status == capi.CONDITION_TRUE:
+            # Resuming: clear the suspension and start a fresh lifecycle
+            # window before the normal pod reconcile below recreates.
+            now = self.clock()
+            suspended.status = capi.CONDITION_FALSE
+            suspended.last_transition_time = now
+            suspended.last_update_time = now
+            job.status.start_time = None
+            capi.update_job_conditions(
+                job.status,
+                capi.JOB_CREATED,
+                constants.job_reason(self.hooks.kind, constants.REASON_RESUMED),
+                f"{self.hooks.kind} {job.name} is resumed.",
+                now=self.clock(),
+            )
+            self.cluster.record_event(
+                Event(
+                    type="Normal",
+                    reason=constants.job_reason(self.hooks.kind, constants.REASON_RESUMED),
+                    message=f"{self.hooks.kind} {job.name} is resumed.",
+                    involved_object=f"{job.kind}/{key}",
+                )
+            )
+
         # Run-policy enforcement before any pod work (library ReconcileJobs).
         failure_reason = None
         failure_message = ""
@@ -596,6 +629,51 @@ class JobController:
         if run_policy.backoff_limit == 0:
             return restarts > 0
         return restarts >= run_policy.backoff_limit
+
+    # ----------------------------------------------------------- suspension
+    def _suspend_job(
+        self, job: JobObject, pods: List[Pod], replicas: Dict[str, ReplicaSpec], run_policy
+    ) -> None:
+        """Delete every pod and service (and gang groups) of a live job
+        without marking it Failed; the Suspended condition records why
+        nothing is running."""
+        # Zero the per-type counters: the normal sync path rebuilds them in
+        # reconcile_pods, which a suspended job never reaches — stale
+        # `active` counts would report live workers on a released slice.
+        for rtype in replicas:
+            job.status.replica_statuses[rtype] = capi.ReplicaStatus()
+        for pod in pods:
+            if pod.metadata.deletion_timestamp is None:
+                self._delete_pod(job, pod)
+        for svc in self.get_services_for_job(job):
+            self.service_control.delete_service(svc.metadata.namespace, svc.metadata.name, job)
+        if self.options.enable_gang_scheduling:
+            for group in self.hooks.gang_groups(job, replicas, run_policy):
+                meta = group.get("metadata", {})
+                try:
+                    self.cluster.delete_pod_group(
+                        meta.get("namespace", job.namespace), meta["name"]
+                    )
+                except Exception:
+                    pass
+        already = capi.get_condition(job.status, capi.JOB_SUSPENDED)
+        if already is None or already.status != capi.CONDITION_TRUE:
+            msg = f"{self.hooks.kind} {job.name} is suspended."
+            capi.update_job_conditions(
+                job.status,
+                capi.JOB_SUSPENDED,
+                constants.job_reason(self.hooks.kind, constants.REASON_SUSPENDED),
+                msg,
+                now=self.clock(),
+            )
+            self.cluster.record_event(
+                Event(
+                    type="Normal",
+                    reason=constants.job_reason(self.hooks.kind, constants.REASON_SUSPENDED),
+                    message=msg,
+                    involved_object=f"{job.kind}/{job.key()}",
+                )
+            )
 
     # ------------------------------------------------------------ terminal
     def _handle_terminal_job(

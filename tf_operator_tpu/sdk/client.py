@@ -121,6 +121,15 @@ class JobClient:
     def delete(self, name: str, namespace: str = "default") -> None:
         self.cluster.delete_job(self.kind, namespace, name)
 
+    def suspend(self, name: str, namespace: str = "default") -> dict:
+        """Tear the job down (pods, services, gang groups — on TPU the whole
+        slice) without failing it; resume() brings it back with a fresh
+        lifecycle window."""
+        return self.patch(name, {"spec": {"runPolicy": {"suspend": True}}}, namespace)
+
+    def resume(self, name: str, namespace: str = "default") -> dict:
+        return self.patch(name, {"spec": {"runPolicy": {"suspend": False}}}, namespace)
+
     def scale(
         self,
         name: str,
