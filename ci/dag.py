@@ -206,5 +206,7 @@ def default_dag() -> List[Step]:
         Step("e2e-process", pytest + ["tests/test_e2e_process.py"], deps=["operator-integration"], retries=2),
         Step("sdk", pytest + ["tests/test_sdk.py"], deps=["unit-api"]),
         Step("workload", pytest + ["tests/test_models.py", "tests/test_flash_pallas.py", "tests/test_workload_tier.py", "tests/test_runtime.py"], deps=["build"]),
+        Step("parallelism", pytest + ["tests/test_pipeline.py"], deps=["workload"]),
+        Step("native", pytest + ["tests/test_native_dataloader.py"], deps=["build"]),
         Step("examples", pytest + ["tests/test_examples.py"], deps=["workload"]),
     ]

@@ -637,6 +637,16 @@ class JobController:
         """Delete every pod and service (and gang groups) of a live job
         without marking it Failed; the Suspended condition records why
         nothing is running."""
+        already = capi.get_condition(job.status, capi.JOB_SUSPENDED)
+        settled = (
+            already is not None
+            and already.status == capi.CONDITION_TRUE
+            and not pods
+        )
+        if settled:
+            # Steady-state suspension: nothing to tear down — repeating the
+            # deletes every resync would burn the QPS budget on NotFounds.
+            return
         # Zero the per-type counters: the normal sync path rebuilds them in
         # reconcile_pods, which a suspended job never reaches — stale
         # `active` counts would report live workers on a released slice.
@@ -648,15 +658,7 @@ class JobController:
         for svc in self.get_services_for_job(job):
             self.service_control.delete_service(svc.metadata.namespace, svc.metadata.name, job)
         if self.options.enable_gang_scheduling:
-            for group in self.hooks.gang_groups(job, replicas, run_policy):
-                meta = group.get("metadata", {})
-                try:
-                    self.cluster.delete_pod_group(
-                        meta.get("namespace", job.namespace), meta["name"]
-                    )
-                except Exception:
-                    pass
-        already = capi.get_condition(job.status, capi.JOB_SUSPENDED)
+            self._delete_gang_groups(job, replicas, run_policy)
         if already is None or already.status != capi.CONDITION_TRUE:
             msg = f"{self.hooks.kind} {job.name} is suspended."
             capi.update_job_conditions(
@@ -699,14 +701,22 @@ class JobController:
                 self.requeue(f"{job.kind}:{job.key()}", expiry - self.clock())
 
         if self.options.enable_gang_scheduling:
-            for group in self.hooks.gang_groups(job, replicas, run_policy):
-                meta = group.get("metadata", {})
-                try:
-                    self.cluster.delete_pod_group(
-                        meta.get("namespace", job.namespace), meta["name"]
-                    )
-                except Exception:
-                    pass
+            self._delete_gang_groups(job, replicas, run_policy)
+
+    def _delete_gang_groups(self, job: JobObject, replicas: Dict[str, ReplicaSpec], run_policy) -> None:
+        """Tear down the gang units (terminal cleanup and suspension).
+        Only NotFound is tolerated — a real API failure (RBAC, network)
+        must surface, or the PodGroup leaks in the scheduler silently."""
+        from ..cluster.base import NotFound
+
+        for group in self.hooks.gang_groups(job, replicas, run_policy):
+            meta = group.get("metadata", {})
+            try:
+                self.cluster.delete_pod_group(
+                    meta.get("namespace", job.namespace), meta["name"]
+                )
+            except NotFound:
+                pass
 
     # ----------------------------------------------------------- pod group
     def _sync_pod_group(self, job: JobObject, replicas: Dict[str, ReplicaSpec], run_policy) -> None:
