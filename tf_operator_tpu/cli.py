@@ -90,6 +90,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         help="Client write QPS limit (0 = unlimited; reference default 5).")
     parser.add_argument("--burst", type=int, default=0,
                         help="Client write burst (reference default 10).")
+    parser.add_argument("--kube", action="store_true",
+                        help="Reconcile a real cluster via the kube-apiserver "
+                        "(in-cluster service-account auth, or --kube-url/--kube-token).")
+    parser.add_argument("--kube-url", default="", help="Apiserver base URL (default: in-cluster).")
+    parser.add_argument("--kube-token", default="", help="Bearer token (default: service-account file).")
+    parser.add_argument("--kube-insecure", action="store_true", help="Skip TLS verification.")
     return parser
 
 
@@ -435,12 +441,20 @@ def main(argv: Optional[List[str]] = None, cluster: Optional[Cluster] = None) ->
     options = options_from_args(args)
     _setup_logging(options.json_log_format)
     if cluster is None:
-        # Out of the box the process manages the in-repo cluster runtime; a
-        # real kube-apiserver backend plugs in through the same Cluster
-        # interface (cluster/base.py).
-        from .cluster.memory import InMemoryCluster
+        if getattr(args, "kube", False) or args.kube_url:
+            from .cluster.kube import KubeCluster
 
-        cluster = InMemoryCluster()
+            cluster = KubeCluster(
+                base_url=args.kube_url or None,
+                token=args.kube_token or None,
+                insecure=args.kube_insecure,
+            )
+        else:
+            # Dev default: the in-repo cluster runtime; the real apiserver
+            # backend plugs in through the same Cluster interface.
+            from .cluster.memory import InMemoryCluster
+
+            cluster = InMemoryCluster()
     manager = OperatorManager(cluster, options)
     log.info(
         "starting operator: kinds=%s namespace=%s gang=%s",

@@ -1,0 +1,405 @@
+"""Kube-apiserver Cluster backend: the production adapter.
+
+The in-memory and process backends serve tests and dev; this one speaks
+the real Kubernetes REST API so the SAME operator binary reconciles a
+real cluster (`python -m tf_operator_tpu --kube`). Dependency-free by
+design (stdlib http.client + ssl): the image rules out pip installs, and
+the API surface we need — typed CRUD on five CRDs, core pods/services/
+events, volcano PodGroups, streaming watches — is plain JSON over HTTPS.
+
+Auth: in-cluster service-account (token + CA from
+/var/run/secrets/kubernetes.io/serviceaccount, apiserver from
+KUBERNETES_SERVICE_HOST/PORT), or explicit base_url/token/ca_file for
+tests and kubeconfig-less setups.
+
+Watches: one daemon thread per watched kind runs the list-then-watch
+loop (GET ?watch=true streaming newline-delimited {type, object} events,
+resuming from the last resourceVersion; 410 Gone → relist). Handlers
+receive the same (event_type, object) shapes the other backends emit, so
+controllers cannot tell the difference.
+"""
+
+from __future__ import annotations
+
+import calendar
+import http.client
+import json
+import logging
+import os
+import ssl
+import threading
+import time
+import urllib.parse
+from typing import Dict, List, Optional
+
+from ..api.k8s import Event, Pod, Service, from_dict, to_dict
+from .base import ADDED, DELETED, MODIFIED, Cluster, Conflict, NotFound
+
+_log = logging.getLogger(__name__)
+
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+# kind -> (group, version, plural). Jobs come from the API registry.
+_CORE = ("", "v1")
+_PODGROUP = ("scheduling.volcano.sh", "v1beta1", "podgroups")
+
+
+def _job_plural(kind: str) -> str:
+    from .. import api
+
+    module = getattr(api, kind.lower())
+    return module.PLURAL
+
+
+def _iso_to_epoch(value):
+    """k8s RFC3339 timestamps -> epoch floats (our dataclasses hold floats)."""
+    if not isinstance(value, str):
+        return value
+    try:
+        return calendar.timegm(time.strptime(value, "%Y-%m-%dT%H:%M:%SZ"))
+    except ValueError:
+        return None
+
+
+def _normalize_times(obj: dict) -> dict:
+    meta = obj.get("metadata") or {}
+    if "creationTimestamp" in meta:
+        meta["creationTimestamp"] = _iso_to_epoch(meta["creationTimestamp"])
+    if "deletionTimestamp" in meta:
+        meta["deletionTimestamp"] = _iso_to_epoch(meta["deletionTimestamp"])
+    status = obj.get("status") or {}
+    if "startTime" in status:
+        status["startTime"] = _iso_to_epoch(status["startTime"])
+    return obj
+
+
+class KubeCluster(Cluster):
+    def __init__(
+        self,
+        base_url: Optional[str] = None,
+        token: Optional[str] = None,
+        ca_file: Optional[str] = None,
+        insecure: bool = False,
+        timeout: float = 30.0,
+    ):
+        if base_url is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise RuntimeError(
+                    "KubeCluster: no base_url and not in-cluster "
+                    "(KUBERNETES_SERVICE_HOST unset)"
+                )
+            base_url = f"https://{host}:{port}"
+        if token is None and os.path.exists(f"{_SA_DIR}/token"):
+            with open(f"{_SA_DIR}/token") as f:
+                token = f.read().strip()
+        if ca_file is None and os.path.exists(f"{_SA_DIR}/ca.crt"):
+            ca_file = f"{_SA_DIR}/ca.crt"
+        self._url = urllib.parse.urlparse(base_url)
+        self._token = token
+        self._timeout = timeout
+        if self._url.scheme == "https":
+            if insecure:
+                self._ssl = ssl._create_unverified_context()
+            else:
+                self._ssl = ssl.create_default_context(cafile=ca_file)
+        else:
+            self._ssl = None
+        self._stop = threading.Event()
+        self._watch_threads: List[threading.Thread] = []
+
+    # ------------------------------------------------------------- plumbing
+    def _connect(self) -> http.client.HTTPConnection:
+        host = self._url.hostname
+        port = self._url.port or (443 if self._url.scheme == "https" else 80)
+        if self._url.scheme == "https":
+            return http.client.HTTPSConnection(
+                host, port, context=self._ssl, timeout=self._timeout
+            )
+        return http.client.HTTPConnection(host, port, timeout=self._timeout)
+
+    def _headers(self, content_type: Optional[str] = None) -> Dict[str, str]:
+        headers = {"Accept": "application/json"}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
+        if content_type:
+            headers["Content-Type"] = content_type
+        return headers
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None,
+                 content_type: str = "application/json") -> dict:
+        conn = self._connect()
+        try:
+            conn.request(
+                method,
+                path,
+                body=None if body is None else json.dumps(body),
+                headers=self._headers(content_type if body is not None else None),
+            )
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status == 404:
+                raise NotFound(f"{method} {path}: 404")
+            if resp.status == 409:
+                raise Conflict(f"{method} {path}: 409 {data[:200]!r}")
+            if resp.status >= 400:
+                raise RuntimeError(f"{method} {path}: {resp.status} {data[:300]!r}")
+            return json.loads(data) if data else {}
+        finally:
+            conn.close()
+
+    # ---------------------------------------------------------------- paths
+    def _job_path(self, kind: str, namespace: str, name: str = "") -> str:
+        plural = _job_plural(kind)
+        base = f"/apis/kubeflow.org/v1/namespaces/{namespace}/{plural}"
+        return f"{base}/{name}" if name else base
+
+    def _core_path(self, resource: str, namespace: Optional[str], name: str = "") -> str:
+        base = (
+            f"/api/v1/namespaces/{namespace}/{resource}"
+            if namespace
+            else f"/api/v1/{resource}"
+        )
+        return f"{base}/{name}" if name else base
+
+    # ----------------------------------------------------------------- jobs
+    def create_job(self, job_dict: dict) -> dict:
+        meta = job_dict.get("metadata", {})
+        return self._request(
+            "POST",
+            self._job_path(job_dict["kind"], meta.get("namespace", "default")),
+            job_dict,
+        )
+
+    def get_job(self, kind: str, namespace: str, name: str) -> dict:
+        return _normalize_times(self._request("GET", self._job_path(kind, namespace, name)))
+
+    def list_jobs(self, kind: str, namespace: Optional[str] = None) -> List[dict]:
+        if namespace:
+            path = self._job_path(kind, namespace)
+        else:
+            path = f"/apis/kubeflow.org/v1/{_job_plural(kind)}"
+        return [_normalize_times(i) for i in self._request("GET", path).get("items", [])]
+
+    def update_job(self, job_dict: dict) -> dict:
+        meta = job_dict.get("metadata", {})
+        return self._request(
+            "PUT",
+            self._job_path(job_dict["kind"], meta.get("namespace", "default"), meta["name"]),
+            job_dict,
+        )
+
+    def update_job_status(self, kind: str, namespace: str, name: str, status: dict) -> dict:
+        # REPLACE semantics via PUT on the status subresource: the engine
+        # sends the entire intended status, and cleared fields (startTime
+        # reset on resume) must actually clear — a merge-patch would keep
+        # any key to_dict omitted as None, silently resurrecting stale
+        # values on the server. Read-modify-write with the current rv;
+        # Conflict propagates and the workqueue retries.
+        job = self.get_job(kind, namespace, name)
+        job["status"] = status
+        return self._request(
+            "PUT", self._job_path(kind, namespace, name) + "/status", job
+        )
+
+    def delete_job(self, kind: str, namespace: str, name: str) -> None:
+        self._request("DELETE", self._job_path(kind, namespace, name))
+
+    # ----------------------------------------------------------------- pods
+    def create_pod(self, pod: Pod) -> Pod:
+        body = to_dict(pod)
+        body.setdefault("apiVersion", "v1")
+        body.setdefault("kind", "Pod")
+        out = self._request(
+            "POST", self._core_path("pods", pod.metadata.namespace), body
+        )
+        return from_dict(Pod, _normalize_times(out))
+
+    def get_pod(self, namespace: str, name: str) -> Pod:
+        out = self._request("GET", self._core_path("pods", namespace, name))
+        return from_dict(Pod, _normalize_times(out))
+
+    def list_pods(self, namespace: Optional[str] = None,
+                  labels: Optional[Dict[str, str]] = None) -> List[Pod]:
+        path = self._core_path("pods", namespace)
+        if labels:
+            selector = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+            path += "?" + urllib.parse.urlencode({"labelSelector": selector})
+        items = self._request("GET", path).get("items", [])
+        return [from_dict(Pod, _normalize_times(i)) for i in items]
+
+    def update_pod(self, pod: Pod) -> Pod:
+        body = to_dict(pod)
+        body.setdefault("apiVersion", "v1")
+        body.setdefault("kind", "Pod")
+        out = self._request(
+            "PUT",
+            self._core_path("pods", pod.metadata.namespace, pod.metadata.name),
+            body,
+        )
+        return from_dict(Pod, _normalize_times(out))
+
+    def get_pod_log(self, namespace: str, name: str) -> str:
+        conn = self._connect()
+        try:
+            conn.request("GET", self._core_path("pods", namespace, name) + "/log",
+                         headers=self._headers())
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status == 404:
+                raise NotFound(f"pod {namespace}/{name}")
+            if resp.status >= 400:
+                # An RBAC/auth error body must not masquerade as log text.
+                raise RuntimeError(f"pod log {namespace}/{name}: {resp.status} {data[:200]!r}")
+            return data.decode("utf-8", errors="replace")
+        finally:
+            conn.close()
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        self._request("DELETE", self._core_path("pods", namespace, name))
+
+    # ------------------------------------------------------------- services
+    def create_service(self, service: Service) -> Service:
+        body = to_dict(service)
+        body.setdefault("apiVersion", "v1")
+        body.setdefault("kind", "Service")
+        out = self._request(
+            "POST", self._core_path("services", service.metadata.namespace), body
+        )
+        return from_dict(Service, _normalize_times(out))
+
+    def list_services(self, namespace: Optional[str] = None,
+                      labels: Optional[Dict[str, str]] = None) -> List[Service]:
+        path = self._core_path("services", namespace)
+        if labels:
+            selector = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+            path += "?" + urllib.parse.urlencode({"labelSelector": selector})
+        items = self._request("GET", path).get("items", [])
+        return [from_dict(Service, _normalize_times(i)) for i in items]
+
+    def delete_service(self, namespace: str, name: str) -> None:
+        self._request("DELETE", self._core_path("services", namespace, name))
+
+    # ----------------------------------------------------------- pod groups
+    def create_pod_group(self, group: dict) -> dict:
+        ns = group.get("metadata", {}).get("namespace", "default")
+        return self._request(
+            "POST",
+            f"/apis/{_PODGROUP[0]}/{_PODGROUP[1]}/namespaces/{ns}/{_PODGROUP[2]}",
+            group,
+        )
+
+    def get_pod_group(self, namespace: str, name: str) -> dict:
+        return self._request(
+            "GET",
+            f"/apis/{_PODGROUP[0]}/{_PODGROUP[1]}/namespaces/{namespace}/{_PODGROUP[2]}/{name}",
+        )
+
+    def delete_pod_group(self, namespace: str, name: str) -> None:
+        self._request(
+            "DELETE",
+            f"/apis/{_PODGROUP[0]}/{_PODGROUP[1]}/namespaces/{namespace}/{_PODGROUP[2]}/{name}",
+        )
+
+    # --------------------------------------------------------------- events
+    def record_event(self, event: Event) -> None:
+        kind, _, key = event.involved_object.partition("/")
+        namespace, _, name = key.partition("/")
+        namespace = namespace or "default"
+        body = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {"generateName": f"{name or 'job'}-", "namespace": namespace},
+            "type": event.type,
+            "reason": event.reason,
+            "message": event.message,
+            "involvedObject": {"kind": kind, "namespace": namespace, "name": name},
+            "source": {"component": "tf-operator-tpu"},
+        }
+        try:
+            self._request("POST", self._core_path("events", namespace), body)
+        except Exception:  # noqa: BLE001 — events are best-effort everywhere
+            _log.debug("event write failed", exc_info=True)
+
+    def list_events(self, involved_object: Optional[str] = None) -> List[Event]:
+        items = self._request("GET", self._core_path("events", None)).get("items", [])
+        out = []
+        for i in items:
+            inv = i.get("involvedObject", {})
+            key = f"{inv.get('kind', '')}/{inv.get('namespace', 'default')}/{inv.get('name', '')}"
+            if involved_object and key != involved_object:
+                continue
+            out.append(Event(type=i.get("type", ""), reason=i.get("reason", ""),
+                             message=i.get("message", ""), involved_object=key))
+        return out
+
+    # -------------------------------------------------------------- watches
+    def watch(self, kind: str, handler) -> None:
+        thread = threading.Thread(
+            target=self._watch_loop, args=(kind, handler),
+            daemon=True, name=f"kube-watch-{kind}",
+        )
+        self._watch_threads.append(thread)
+        thread.start()
+
+    def _watch_paths(self, kind: str):
+        if kind == "pods":
+            return "/api/v1/pods", lambda o: from_dict(Pod, _normalize_times(o))
+        if kind == "services":
+            return "/api/v1/services", lambda o: from_dict(Service, _normalize_times(o))
+        return f"/apis/kubeflow.org/v1/{_job_plural(kind)}", _normalize_times
+
+    def _watch_loop(self, kind: str, handler) -> None:
+        path, convert = self._watch_paths(kind)
+        while not self._stop.is_set():
+            try:
+                listing = self._request("GET", path)
+                rv = listing.get("metadata", {}).get("resourceVersion", "")
+                for item in listing.get("items", []):
+                    handler(ADDED, convert(item))
+                self._stream(kind, path, rv, convert, handler)
+            except Exception:
+                if self._stop.is_set():
+                    return
+                _log.debug("watch %s: reconnecting", kind, exc_info=True)
+                time.sleep(1.0)
+
+    def _stream(self, kind: str, path: str, rv: str, convert, handler) -> None:
+        query = urllib.parse.urlencode(
+            {"watch": "true", "resourceVersion": rv, "allowWatchBookmarks": "true"}
+        )
+        conn = self._connect()
+        try:
+            conn.request("GET", f"{path}?{query}", headers=self._headers())
+            resp = conn.getresponse()
+            if resp.status == 410:  # Gone: relist
+                return
+            if resp.status >= 400:
+                raise RuntimeError(f"watch {kind}: {resp.status}")
+            buffer = b""
+            while not self._stop.is_set():
+                chunk = resp.read1(65536)
+                if not chunk:
+                    return  # server closed: relist + rewatch
+                buffer += chunk
+                while b"\n" in buffer:
+                    line, buffer = buffer.split(b"\n", 1)
+                    if not line.strip():
+                        continue
+                    evt = json.loads(line)
+                    etype = evt.get("type", "")
+                    if etype == "BOOKMARK":
+                        continue
+                    obj = evt.get("object", {})
+                    mapped = {
+                        "ADDED": ADDED, "MODIFIED": MODIFIED, "DELETED": DELETED,
+                    }.get(etype)
+                    if mapped is None:
+                        continue
+                    handler(mapped, convert(obj))
+        finally:
+            conn.close()
+
+    def shutdown(self) -> None:
+        self._stop.set()

@@ -1,0 +1,305 @@
+"""A minimal kube-apiserver stub for exercising KubeCluster.
+
+Translates the REST surface the operator uses — CRD jobs, core
+pods/services/events, volcano PodGroups, streaming watches — onto an
+InMemoryCluster, so the full operator stack can run over real HTTP
+without a cluster. The analog of controller-runtime's envtest
+(SURVEY.md §4 T2: real apiserver, no kubelet), minus etcd.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..api.k8s import Event, Pod, Service, from_dict, to_dict
+from .. import api as api_pkg
+from ..cluster.base import Conflict
+from ..cluster.memory import InMemoryCluster
+
+_PLURAL_TO_KIND = {
+    getattr(api_pkg, m).PLURAL: getattr(api_pkg, m).KIND
+    for m in ("tfjob", "pytorchjob", "mxjob", "xgboostjob", "jaxjob")
+}
+
+_JOB_RE = re.compile(
+    r"^/apis/kubeflow\.org/v1/namespaces/(?P<ns>[^/]+)/(?P<plural>[^/]+)"
+    r"(?:/(?P<name>[^/]+))?(?P<status>/status)?$"
+)
+_JOB_ALL_RE = re.compile(r"^/apis/kubeflow\.org/v1/(?P<plural>[^/]+)$")
+_CORE_RE = re.compile(
+    r"^/api/v1/namespaces/(?P<ns>[^/]+)/(?P<resource>pods|services|events)"
+    r"(?:/(?P<name>[^/]+))?(?P<log>/log)?$"
+)
+_CORE_ALL_RE = re.compile(r"^/api/v1/(?P<resource>pods|services|events)$")
+_PG_RE = re.compile(
+    r"^/apis/scheduling\.volcano\.sh/v1beta1/namespaces/(?P<ns>[^/]+)/podgroups"
+    r"(?:/(?P<name>[^/]+))?$"
+)
+
+
+class StubApiServer:
+    """HTTP facade over an InMemoryCluster. `mem` stays accessible so tests
+    can simulate the kubelet (set_pod_phase) and inspect state."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.mem = InMemoryCluster()
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, code: int, payload) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> dict:
+                length = int(self.headers.get("Content-Length", "0"))
+                return json.loads(self.rfile.read(length)) if length else {}
+
+            def _dispatch(self, method: str) -> None:
+                try:
+                    stub._route(self, method)
+                except Conflict as exc:
+                    self._json(409, {"kind": "Status", "code": 409, "message": str(exc)})
+                except KeyError:
+                    self._json(404, {"kind": "Status", "code": 404})
+                except Exception as exc:  # noqa: BLE001 — surface as 500
+                    self._json(500, {"kind": "Status", "message": str(exc)})
+
+            def do_GET(self):  # noqa: N802
+                self._dispatch("GET")
+
+            def do_POST(self):  # noqa: N802
+                self._dispatch("POST")
+
+            def do_PUT(self):  # noqa: N802
+                self._dispatch("PUT")
+
+            def do_PATCH(self):  # noqa: N802
+                self._dispatch("PATCH")
+
+            def do_DELETE(self):  # noqa: N802
+                self._dispatch("DELETE")
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+
+    # ------------------------------------------------------------- routing
+    def _route(self, handler, method: str) -> None:
+        parsed = urlparse(handler.path)
+        path, q = parsed.path, parse_qs(parsed.query)
+        watching = q.get("watch", ["false"])[0] == "true"
+
+        m = _JOB_RE.match(path)
+        if m:
+            return self._jobs(handler, method, m, watching)
+        m = _JOB_ALL_RE.match(path)
+        if m:
+            return self._collection(handler, m["plural"], watching, kind_space="jobs")
+        m = _CORE_RE.match(path)
+        if m:
+            return self._core(handler, method, m, q)
+        m = _CORE_ALL_RE.match(path)
+        if m:
+            return self._collection(handler, m["resource"], watching, kind_space="core")
+        m = _PG_RE.match(path)
+        if m:
+            return self._podgroups(handler, method, m)
+        raise KeyError(path)
+
+    def _jobs(self, handler, method, m, watching) -> None:
+        kind = _PLURAL_TO_KIND[m["plural"]]
+        ns, name = m["ns"], m["name"]
+        if method == "GET" and not name:
+            items = self.mem.list_jobs(kind, ns)
+            return handler._json(200, {"items": items, "metadata": {"resourceVersion": "0"}})
+        if method == "GET":
+            return handler._json(200, self.mem.get_job(kind, ns, name))
+        if method == "POST":
+            return handler._json(201, self.mem.create_job(handler._body()))
+        if method == "PUT" and m["status"]:
+            # Status subresource PUT: replace status, ignore spec changes.
+            status = handler._body().get("status", {})
+            return handler._json(200, self.mem.update_job_status(kind, ns, name, status))
+        if method == "PUT":
+            return handler._json(200, self.mem.update_job(handler._body()))
+        if method == "PATCH" and m["status"]:
+            status = handler._body().get("status", {})
+            return handler._json(200, self.mem.update_job_status(kind, ns, name, status))
+        if method == "DELETE":
+            self.mem.delete_job(kind, ns, name)
+            return handler._json(200, {})
+        raise KeyError(method)
+
+    def _core(self, handler, method, m, q) -> None:
+        ns, resource, name = m["ns"], m["resource"], m["name"]
+        if resource == "pods":
+            if method == "GET" and name and m["log"]:
+                log = self.mem.get_pod_log(ns, name)
+                body = log.encode()
+                handler.send_response(200)
+                handler.send_header("Content-Length", str(len(body)))
+                handler.end_headers()
+                handler.wfile.write(body)
+                return
+            if method == "GET" and name:
+                return handler._json(200, to_dict(self.mem.get_pod(ns, name)))
+            if method == "GET":
+                labels = _selector(q)
+                items = [to_dict(p) for p in self.mem.list_pods(ns, labels=labels)]
+                return handler._json(200, {"items": items})
+            if method == "POST":
+                pod = from_dict(Pod, handler._body())
+                return handler._json(201, to_dict(self.mem.create_pod(pod)))
+            if method == "PUT":
+                pod = from_dict(Pod, handler._body())
+                return handler._json(200, to_dict(self.mem.update_pod(pod)))
+            if method == "DELETE":
+                self.mem.delete_pod(ns, name)
+                return handler._json(200, {})
+        if resource == "services":
+            if method == "GET":
+                labels = _selector(q)
+                items = [to_dict(s) for s in self.mem.list_services(ns, labels=labels)]
+                return handler._json(200, {"items": items})
+            if method == "POST":
+                svc = from_dict(Service, handler._body())
+                return handler._json(201, to_dict(self.mem.create_service(svc)))
+            if method == "DELETE":
+                self.mem.delete_service(ns, name)
+                return handler._json(200, {})
+        if resource == "events":
+            if method == "POST":
+                body = handler._body()
+                inv = body.get("involvedObject", {})
+                self.mem.record_event(Event(
+                    type=body.get("type", ""), reason=body.get("reason", ""),
+                    message=body.get("message", ""),
+                    involved_object=f"{inv.get('kind')}/{inv.get('namespace')}/{inv.get('name')}",
+                ))
+                return handler._json(201, {})
+            if method == "GET":
+                items = [
+                    {
+                        "type": e.type, "reason": e.reason, "message": e.message,
+                        "involvedObject": dict(zip(
+                            ("kind", "namespace", "name"), e.involved_object.split("/")
+                        )),
+                    }
+                    for e in self.mem.list_events()
+                ]
+                return handler._json(200, {"items": items})
+        raise KeyError(resource)
+
+    def _podgroups(self, handler, method, m) -> None:
+        ns, name = m["ns"], m["name"]
+        if method == "POST":
+            return handler._json(201, self.mem.create_pod_group(handler._body()))
+        if method == "GET":
+            return handler._json(200, self.mem.get_pod_group(ns, name))
+        if method == "DELETE":
+            self.mem.delete_pod_group(ns, name)
+            return handler._json(200, {})
+        raise KeyError(method)
+
+    # -------------------------------------------------------------- watches
+    def _collection(self, handler, resource_or_plural, watching, kind_space) -> None:
+        """Cluster-scope GET, with ?watch=true streaming support."""
+        if kind_space == "jobs":
+            kind = _PLURAL_TO_KIND[resource_or_plural]
+            convert = lambda o: o  # noqa: E731
+            items = self.mem.list_jobs(kind)
+        elif resource_or_plural == "pods":
+            kind = "pods"
+            convert = to_dict
+            items = [to_dict(p) for p in self.mem.list_pods()]
+        elif resource_or_plural == "services":
+            kind = "services"
+            convert = to_dict
+            items = [to_dict(s) for s in self.mem.list_services()]
+        else:  # events (list-only; no watch support needed)
+            kind = None
+            convert = None
+            items = [
+                {
+                    "type": e.type, "reason": e.reason, "message": e.message,
+                    "involvedObject": dict(zip(
+                        ("kind", "namespace", "name"), e.involved_object.split("/")
+                    )),
+                }
+                for e in self.mem.list_events()
+            ]
+
+        if not watching:
+            return handler._json(
+                200, {"items": items, "metadata": {"resourceVersion": "0"}}
+            )
+
+        # Streaming watch: subscribe FIRST, then replay the current state as
+        # synthetic ADDED events — closing the client's list->watch gap the
+        # way a real apiserver's resourceVersion replay does (handlers are
+        # idempotent enqueuers, so duplicates are harmless). The `dead` flag
+        # neuters the subscription after disconnect: InMemoryCluster has no
+        # unsubscribe, and a leaked live queue would grow forever.
+        events: "queue.Queue" = queue.Queue()
+        dead = threading.Event()
+
+        def relay(etype, obj):
+            if not dead.is_set():
+                events.put((etype, obj))
+
+        self.mem.watch(kind, relay)
+        for snapshot in items:
+            events.put(("ADDED", snapshot))
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Transfer-Encoding", "chunked")
+        handler.end_headers()
+
+        def send(payload: dict) -> None:
+            line = (json.dumps(payload) + "\n").encode()
+            handler.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+            handler.wfile.flush()
+
+        try:
+            while True:
+                etype, obj = events.get()
+                body = obj if isinstance(obj, dict) else convert(obj)
+                send({"type": etype, "object": body})
+        except (BrokenPipeError, ConnectionResetError):
+            return
+        finally:
+            dead.set()
+
+
+def _selector(q) -> Optional[dict]:
+    raw = q.get("labelSelector", [None])[0]
+    if not raw:
+        return None
+    out = {}
+    for part in raw.split(","):
+        k, _, v = part.partition("=")
+        out[k] = v
+    return out
