@@ -104,6 +104,25 @@ def test_reconcile_duration_observed():
     assert 'le="0.005"' in text  # ms-scale buckets, not the seconds-scale set
 
 
+def test_histograms_are_streaming_not_unbounded():
+    """Aggregates stay exact while raw retention is bounded: a long-running
+    operator's per-sync observations must not grow memory without limit."""
+    metrics = Metrics()
+    for i in range(10_000):
+        metrics.observe_reconcile("default", "JAXJob", (i % 100) / 1000.0)
+    retained = metrics.histogram_values(
+        "training_operator_reconcile_duration_seconds", "default", "JAXJob"
+    )
+    assert len(retained) <= 256
+    text = metrics.render()
+    assert "training_operator_reconcile_duration_seconds_count" in text
+    assert " 10000" in text  # exact count survives the bounded window
+    # le-boundary semantics: value == bucket bound counts into that bucket.
+    m2 = Metrics()
+    m2.observe_startup("d", "f", 0.5)
+    assert 'training_operator_job_startup_seconds_bucket{job_namespace="d",framework="f",le="0.5"} 1' in m2.render()
+
+
 def test_debugz_snapshot():
     """/debugz exposes thread stacks and workqueue depths."""
     from tf_operator_tpu.cli import OperatorManager, OperatorOptions

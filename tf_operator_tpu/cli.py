@@ -49,6 +49,8 @@ class OperatorOptions:
     health_port: int = 8081
     leader_elect: bool = False
     lease_duration: float = 15.0
+    lease_name: str = "tf-operator-tpu-lock"
+    enable_debugz: bool = False  # /debugz exposes thread stacks: opt-in only
     enable_gang_scheduling: bool = False
     gang_scheduler_name: str = "volcano"
     json_log_format: bool = False
@@ -83,6 +85,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     parser.add_argument("--health-port", type=int, default=8081, help="/healthz,/readyz port (0 = off).")
     parser.add_argument("--leader-elect", action="store_true", help="Require leadership before reconciling.")
     parser.add_argument("--lease-duration", type=float, default=15.0, help="Leader lease seconds.")
+    parser.add_argument("--lease-name", default="tf-operator-tpu-lock",
+                        help="Name of the coordination.k8s.io Lease used for election.")
+    parser.add_argument("--enable-debugz", action="store_true",
+                        help="Expose /debugz (thread stacks, queue depths) on the metrics port.")
     parser.add_argument("--enable-gang-scheduling", action="store_true")
     parser.add_argument("--gang-scheduler-name", default="volcano")
     parser.add_argument("--json-log-format", action="store_true")
@@ -110,6 +116,8 @@ def options_from_args(args: argparse.Namespace) -> OperatorOptions:
         health_port=args.health_port,
         leader_elect=args.leader_elect,
         lease_duration=args.lease_duration,
+        lease_name=args.lease_name,
+        enable_debugz=args.enable_debugz,
         enable_gang_scheduling=args.enable_gang_scheduling,
         gang_scheduler_name=args.gang_scheduler_name,
         json_log_format=args.json_log_format,
@@ -122,10 +130,12 @@ def options_from_args(args: argparse.Namespace) -> OperatorOptions:
 
 
 class LeaseLock:
-    """A shared lease multiple operator replicas compete for — the analog of
-    the reference's EndpointsLock election (server.go:168-196). Replicas in
-    one process (or tests) share the object; the holder renews, others watch
-    for expiry."""
+    """In-process lock for tests that want a controllable election without a
+    cluster. Production replicas use ClusterLeaseLock (core/leaderelection.py)
+    — an apiserver-backed coordination.k8s.io/v1 Lease with optimistic-
+    concurrency acquire/renew/steal, the analog of the reference's
+    EndpointsLock election (server.go:168-196). OperatorManager defaults to
+    the cluster-backed lock; pass this one explicitly to simulate."""
 
     def __init__(self, clock=time.monotonic):
         self._clock = clock
@@ -197,6 +207,12 @@ class _MetricsHandler(_BaseHandler):
         if self.path.startswith("/metrics"):
             self._respond(200, self.manager.metrics.render(), "text/plain; version=0.0.4")
         elif self.path.startswith("/debugz"):
+            # Thread stacks leak file paths and internal state; the port
+            # binds 0.0.0.0 for Prometheus, so diagnostics are opt-in
+            # (--enable-debugz), mirroring how pprof exposure is gated.
+            if not self.manager.options.enable_debugz:
+                self._respond(404, "debugz disabled (--enable-debugz)")
+                return
             self._respond(
                 200,
                 json.dumps(self.manager.debug_snapshot(), indent=2),
@@ -224,8 +240,26 @@ class OperatorManager:
         self.cluster = cluster
         self.options = options or OperatorOptions()
         self.metrics = metrics if metrics is not None else METRICS
-        self.lease = lease or LeaseLock()
-        self.identity = identity or f"operator-{uuid.uuid4().hex[:8]}"
+        if lease is None:
+            # Production default: the election is arbitrated by the cluster
+            # (coordination.k8s.io Lease), so two operator PROCESSES cannot
+            # both lead — the in-process LeaseLock is only for tests that
+            # inject it.
+            from .core.leaderelection import ClusterLeaseLock
+
+            # Lease lives in the scoped namespace, else the operator pod's
+            # own namespace (where election RBAC is granted in-cluster).
+            lease = ClusterLeaseLock(
+                cluster,
+                namespace=self.options.namespace or None,
+                name=self.options.lease_name,
+            )
+        self.lease = lease
+        # Identity = pod name in-cluster (reference uses hostname), plus a
+        # uuid suffix so colliding local runs stay distinct.
+        self.identity = identity or (
+            f"{os.environ.get('HOSTNAME', 'operator')}-{uuid.uuid4().hex[:8]}"
+        )
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._server: Optional[ThreadingHTTPServer] = None
@@ -448,6 +482,7 @@ def main(argv: Optional[List[str]] = None, cluster: Optional[Cluster] = None) ->
                 base_url=args.kube_url or None,
                 token=args.kube_token or None,
                 insecure=args.kube_insecure,
+                namespace=options.namespace,
             )
         else:
             # Dev default: the in-repo cluster runtime; the real apiserver

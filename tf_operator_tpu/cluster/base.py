@@ -26,6 +26,12 @@ class Conflict(Exception):
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
+# Informer relist replay: the object existed before the (re)list — handlers
+# must treat it as "state, not news" (no created-counter increments, no
+# expectation observations). Emitted by cache-backed backends (KubeCluster)
+# when a watch reconnect replays current state; the reference gets the same
+# effect from client-go's informer DeltaFIFO Sync deltas.
+SYNC = "SYNC"
 
 WatchHandler = Callable[[str, object], None]  # (event_type, object) -> None
 
@@ -90,6 +96,22 @@ class Cluster:
         raise NotImplementedError
 
     def delete_pod_group(self, namespace: str, name: str) -> None:
+        raise NotImplementedError
+
+    # ---- leases (coordination.k8s.io/v1 analog; leader election) ----
+    def get_lease(self, namespace: str, name: str) -> dict:
+        """Fetch a Lease object ({metadata, spec{holderIdentity, renewTime,
+        leaseDurationSeconds, leaseTransitions}}). NotFound if absent."""
+        raise NotImplementedError
+
+    def create_lease(self, lease: dict) -> dict:
+        """Create a Lease; Conflict if it already exists (apiserver 409)."""
+        raise NotImplementedError
+
+    def update_lease(self, lease: dict) -> dict:
+        """Full-object Lease replace with optimistic concurrency: a stale
+        metadata.resourceVersion raises Conflict — the mechanism that makes
+        two replicas racing for the lock safe."""
         raise NotImplementedError
 
     # ---- events ----

@@ -5,18 +5,26 @@ the real Kubernetes REST API so the SAME operator binary reconciles a
 real cluster (`python -m tf_operator_tpu --kube`). Dependency-free by
 design (stdlib http.client + ssl): the image rules out pip installs, and
 the API surface we need — typed CRUD on five CRDs, core pods/services/
-events, volcano PodGroups, streaming watches — is plain JSON over HTTPS.
+events, volcano PodGroups, coordination Leases, streaming watches — is
+plain JSON over HTTPS.
 
 Auth: in-cluster service-account (token + CA from
 /var/run/secrets/kubernetes.io/serviceaccount, apiserver from
 KUBERNETES_SERVICE_HOST/PORT), or explicit base_url/token/ca_file for
 tests and kubeconfig-less setups.
 
-Watches: one daemon thread per watched kind runs the list-then-watch
-loop (GET ?watch=true streaming newline-delimited {type, object} events,
-resuming from the last resourceVersion; 410 Gone → relist). Handlers
-receive the same (event_type, object) shapes the other backends emit, so
-controllers cannot tell the difference.
+Informer semantics (reference: client-go SharedInformer feeding the
+controllers, scoped at cmd/tf-operator.v1/app/server.go:129): ONE watch
+thread per kind regardless of how many controllers subscribe; the stream
+feeds an in-process store; `list_pods`/`list_services` serve from that
+store once primed, so a reconcile costs zero apiserver round-trips for
+its relists. Watches are namespace-scoped when the operator is, and
+pod/service watches carry the operator's label selector
+(`group-name=kubeflow.org`) so unrelated cluster traffic never reaches
+us. Relist replays emit SYNC — not ADDED — so event-derived counters
+(jobs_created_total) cannot inflate on reconnect, and MODIFIED events
+whose resourceVersion matches the stored object are dropped (the
+reference's same-RV resync filter, common/util/reconciler.go:80-123).
 """
 
 from __future__ import annotations
@@ -30,18 +38,25 @@ import ssl
 import threading
 import time
 import urllib.parse
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..api.k8s import Event, Pod, Service, from_dict, to_dict
-from .base import ADDED, DELETED, MODIFIED, Cluster, Conflict, NotFound
+from ..core import constants
+from .base import ADDED, DELETED, MODIFIED, SYNC, Cluster, Conflict, NotFound
 
 _log = logging.getLogger(__name__)
 
 _SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
-# kind -> (group, version, plural). Jobs come from the API registry.
-_CORE = ("", "v1")
 _PODGROUP = ("scheduling.volcano.sh", "v1beta1", "podgroups")
+_LEASE = ("coordination.k8s.io", "v1", "leases")
+
+# Server-side watch window: the apiserver closes the stream cleanly after
+# this many seconds and we resume from the last seen resourceVersion — no
+# relist, no replay. The socket timeout is set slightly above so a healthy
+# but idle stream never trips the client timeout (ADVICE r1: a 30s socket
+# timeout degraded every watch into 30s full-relist polling).
+_WATCH_TIMEOUT_SECONDS = 240
 
 
 def _job_plural(kind: str) -> str:
@@ -73,6 +88,19 @@ def _normalize_times(obj: dict) -> dict:
     return obj
 
 
+def _meta_of(obj) -> Tuple[str, str, str]:
+    """(namespace, name, resourceVersion) for dict jobs and typed pods/services."""
+    if isinstance(obj, dict):
+        meta = obj.get("metadata") or {}
+        return (
+            meta.get("namespace", "default"),
+            meta.get("name", ""),
+            meta.get("resourceVersion", ""),
+        )
+    meta = obj.metadata
+    return (meta.namespace, meta.name, meta.resource_version or "")
+
+
 class KubeCluster(Cluster):
     def __init__(
         self,
@@ -81,6 +109,8 @@ class KubeCluster(Cluster):
         ca_file: Optional[str] = None,
         insecure: bool = False,
         timeout: float = 30.0,
+        namespace: str = "",
+        label_selector: Optional[str] = None,
     ):
         if base_url is None:
             host = os.environ.get("KUBERNETES_SERVICE_HOST")
@@ -99,6 +129,17 @@ class KubeCluster(Cluster):
         self._url = urllib.parse.urlparse(base_url)
         self._token = token
         self._timeout = timeout
+        # Operator scope: restricts watch paths (and therefore the cache) to
+        # one namespace when set — the legacy factory's namespace filter
+        # (server.go:129).
+        self._namespace = namespace
+        # Dependent watches only see objects this operator stamped
+        # (tfjob_controller.go:764-770 labels) unless overridden.
+        self._label_selector = (
+            label_selector
+            if label_selector is not None
+            else f"{constants.LABEL_GROUP_NAME}={constants.GROUP_NAME}"
+        )
         if self._url.scheme == "https":
             if insecure:
                 self._ssl = ssl._create_unverified_context()
@@ -107,17 +148,25 @@ class KubeCluster(Cluster):
         else:
             self._ssl = None
         self._stop = threading.Event()
-        self._watch_threads: List[threading.Thread] = []
+        self._local = threading.local()  # per-thread keep-alive connection
+        # ---- informer state: one watch loop per kind, N handlers ----
+        self._informer_lock = threading.Lock()
+        self._handlers: Dict[str, List[Callable]] = {}
+        self._stores: Dict[str, Dict[Tuple[str, str], Tuple[str, object]]] = {}
+        self._synced: Dict[str, threading.Event] = {}
+        self._watch_threads: Dict[str, threading.Thread] = {}
+        self._stream_conns: Dict[str, http.client.HTTPConnection] = {}
 
     # ------------------------------------------------------------- plumbing
-    def _connect(self) -> http.client.HTTPConnection:
+    def _connect(self, timeout: Optional[float] = None) -> http.client.HTTPConnection:
         host = self._url.hostname
         port = self._url.port or (443 if self._url.scheme == "https" else 80)
+        timeout = self._timeout if timeout is None else timeout
         if self._url.scheme == "https":
             return http.client.HTTPSConnection(
-                host, port, context=self._ssl, timeout=self._timeout
+                host, port, context=self._ssl, timeout=timeout
             )
-        return http.client.HTTPConnection(host, port, timeout=self._timeout)
+        return http.client.HTTPConnection(host, port, timeout=timeout)
 
     def _headers(self, content_type: Optional[str] = None) -> Dict[str, str]:
         headers = {"Accept": "application/json"}
@@ -129,16 +178,40 @@ class KubeCluster(Cluster):
 
     def _request(self, method: str, path: str, body: Optional[dict] = None,
                  content_type: str = "application/json") -> dict:
-        conn = self._connect()
-        try:
-            conn.request(
-                method,
-                path,
-                body=None if body is None else json.dumps(body),
-                headers=self._headers(content_type if body is not None else None),
-            )
-            resp = conn.getresponse()
-            data = resp.read()
+        # Keep-alive: one connection per calling thread, reused across
+        # requests (ADVICE r1: fresh TCP+TLS per call made every reconcile
+        # pay several handshakes). Retry-on-a-fresh-socket is bounded by
+        # idempotency: a mutation whose response was lost MAY have committed
+        # server-side, so POST/PUT/DELETE only retry when the send itself
+        # failed on a reused (stale keep-alive) connection — never after
+        # bytes could have reached the server twice.
+        while True:
+            conn = getattr(self._local, "conn", None)
+            reused = conn is not None
+            if conn is None:
+                conn = self._connect()
+                self._local.conn = conn
+            sent = False
+            try:
+                conn.request(
+                    method,
+                    path,
+                    body=None if body is None else json.dumps(body),
+                    headers=self._headers(content_type if body is not None else None),
+                )
+                sent = True
+                resp = conn.getresponse()
+                data = resp.read()
+            except (http.client.HTTPException, ConnectionError, OSError) as exc:
+                self._local.conn = None
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                retry_safe = reused and (method == "GET" or not sent)
+                if retry_safe:
+                    continue
+                raise RuntimeError(f"{method} {path}: connection failed ({exc})")
             if resp.status == 404:
                 raise NotFound(f"{method} {path}: 404")
             if resp.status == 409:
@@ -146,8 +219,6 @@ class KubeCluster(Cluster):
             if resp.status >= 400:
                 raise RuntimeError(f"{method} {path}: {resp.status} {data[:300]!r}")
             return json.loads(data) if data else {}
-        finally:
-            conn.close()
 
     # ---------------------------------------------------------------- paths
     def _job_path(self, kind: str, namespace: str, name: str = "") -> str:
@@ -176,6 +247,9 @@ class KubeCluster(Cluster):
         return _normalize_times(self._request("GET", self._job_path(kind, namespace, name)))
 
     def list_jobs(self, kind: str, namespace: Optional[str] = None) -> List[dict]:
+        store = self._store_list(kind, namespace)
+        if store is not None:
+            return store
         if namespace:
             path = self._job_path(kind, namespace)
         else:
@@ -222,6 +296,9 @@ class KubeCluster(Cluster):
 
     def list_pods(self, namespace: Optional[str] = None,
                   labels: Optional[Dict[str, str]] = None) -> List[Pod]:
+        store = self._store_list("pods", namespace, labels)
+        if store is not None:
+            return store
         path = self._core_path("pods", namespace)
         if labels:
             selector = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
@@ -271,6 +348,9 @@ class KubeCluster(Cluster):
 
     def list_services(self, namespace: Optional[str] = None,
                       labels: Optional[Dict[str, str]] = None) -> List[Service]:
+        store = self._store_list("services", namespace, labels)
+        if store is not None:
+            return store
         path = self._core_path("services", namespace)
         if labels:
             selector = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
@@ -302,6 +382,28 @@ class KubeCluster(Cluster):
             f"/apis/{_PODGROUP[0]}/{_PODGROUP[1]}/namespaces/{namespace}/{_PODGROUP[2]}/{name}",
         )
 
+    # --------------------------------------------------------------- leases
+    def _lease_path(self, namespace: str, name: str = "") -> str:
+        base = f"/apis/{_LEASE[0]}/{_LEASE[1]}/namespaces/{namespace}/{_LEASE[2]}"
+        return f"{base}/{name}" if name else base
+
+    def get_lease(self, namespace: str, name: str) -> dict:
+        return self._request("GET", self._lease_path(namespace, name))
+
+    def create_lease(self, lease: dict) -> dict:
+        meta = lease.get("metadata", {})
+        return self._request(
+            "POST", self._lease_path(meta.get("namespace", "default")), lease
+        )
+
+    def update_lease(self, lease: dict) -> dict:
+        meta = lease.get("metadata", {})
+        return self._request(
+            "PUT",
+            self._lease_path(meta.get("namespace", "default"), meta["name"]),
+            lease,
+        )
+
     # --------------------------------------------------------------- events
     def record_event(self, event: Event) -> None:
         kind, _, key = event.involved_object.partition("/")
@@ -323,7 +425,16 @@ class KubeCluster(Cluster):
             _log.debug("event write failed", exc_info=True)
 
     def list_events(self, involved_object: Optional[str] = None) -> List[Event]:
-        items = self._request("GET", self._core_path("events", None)).get("items", [])
+        path = self._core_path("events", None)
+        if involved_object:
+            # Server-side narrowing: without this a busy cluster returns
+            # thousands of unrelated events per call.
+            kind, _, key = involved_object.partition("/")
+            namespace, _, name = key.partition("/")
+            path = self._core_path("events", namespace or "default")
+            selector = f"involvedObject.kind={kind},involvedObject.name={name}"
+            path += "?" + urllib.parse.urlencode({"fieldSelector": selector})
+        items = self._request("GET", path).get("items", [])
         out = []
         for i in items:
             inv = i.get("involvedObject", {})
@@ -334,54 +445,175 @@ class KubeCluster(Cluster):
                              message=i.get("message", ""), involved_object=key))
         return out
 
-    # -------------------------------------------------------------- watches
+    # ------------------------------------------------------------- informer
     def watch(self, kind: str, handler) -> None:
-        thread = threading.Thread(
-            target=self._watch_loop, args=(kind, handler),
-            daemon=True, name=f"kube-watch-{kind}",
-        )
-        self._watch_threads.append(thread)
-        thread.start()
+        """Subscribe to events for `kind`. The first subscriber starts the
+        kind's single list+watch loop; later subscribers share it and get
+        the current store replayed as SYNC so they start complete."""
+        with self._informer_lock:
+            self._handlers.setdefault(kind, []).append(handler)
+            synced = self._synced.setdefault(kind, threading.Event())
+            replay = (
+                list(self._stores.get(kind, {}).values()) if synced.is_set() else []
+            )
+            if kind not in self._watch_threads:
+                thread = threading.Thread(
+                    target=self._watch_loop, args=(kind,),
+                    daemon=True, name=f"kube-watch-{kind}",
+                )
+                self._watch_threads[kind] = thread
+                thread.start()
+        for _, obj in replay:
+            handler(SYNC, obj)
+
+    def _store_list(self, kind: str, namespace: Optional[str],
+                    labels: Optional[Dict[str, str]] = None):
+        """Serve a list from the informer store when primed AND the query
+        falls within the watch's scope; None = caller must do a live GET
+        (no watch running — e.g. SDK usage — or a query broader than the
+        cache: other namespace, or labels outside the watch selector)."""
+        synced = self._synced.get(kind)
+        if synced is None or not synced.is_set():
+            return None
+        if self._namespace and namespace != self._namespace:
+            return None  # cache only holds the scoped namespace
+        if kind in ("pods", "services") and self._label_selector:
+            # The watch stream is selector-filtered; only queries that imply
+            # the selector (engine calls pass the full label stamp) can be
+            # answered completely from the store.
+            implied = dict(
+                part.partition("=")[::2] for part in self._label_selector.split(",")
+            )
+            if not labels or any(labels.get(k) != v for k, v in implied.items()):
+                return None
+        with self._informer_lock:
+            entries = [obj for _, obj in self._stores.get(kind, {}).values()]
+        out = []
+        for obj in entries:
+            if isinstance(obj, dict):
+                meta = obj.get("metadata") or {}
+                if namespace and meta.get("namespace", "default") != namespace:
+                    continue
+                out.append(json.loads(json.dumps(obj)))  # caller-safe copy
+            else:
+                if namespace and obj.metadata.namespace != namespace:
+                    continue
+                if labels and any(
+                    obj.metadata.labels.get(k) != v for k, v in labels.items()
+                ):
+                    continue
+                out.append(obj.deep_copy())
+        return out
 
     def _watch_paths(self, kind: str):
+        ns = self._namespace
         if kind == "pods":
-            return "/api/v1/pods", lambda o: from_dict(Pod, _normalize_times(o))
+            return (
+                self._core_path("pods", ns or None),
+                self._label_selector,
+                lambda o: from_dict(Pod, _normalize_times(o)),
+            )
         if kind == "services":
-            return "/api/v1/services", lambda o: from_dict(Service, _normalize_times(o))
-        return f"/apis/kubeflow.org/v1/{_job_plural(kind)}", _normalize_times
+            return (
+                self._core_path("services", ns or None),
+                self._label_selector,
+                lambda o: from_dict(Service, _normalize_times(o)),
+            )
+        plural = _job_plural(kind)
+        path = (
+            f"/apis/kubeflow.org/v1/namespaces/{ns}/{plural}"
+            if ns
+            else f"/apis/kubeflow.org/v1/{plural}"
+        )
+        return path, None, _normalize_times
 
-    def _watch_loop(self, kind: str, handler) -> None:
-        path, convert = self._watch_paths(kind)
+    def _emit(self, kind: str, event_type: str, obj) -> None:
+        with self._informer_lock:
+            handlers = list(self._handlers.get(kind, []))
+        for handler in handlers:
+            try:
+                handler(event_type, obj)
+            except Exception:
+                _log.exception("watch handler for %s failed", kind)
+
+    def _relist(self, kind: str, path: str, selector: Optional[str], convert) -> str:
+        """List, diff against the store, emit ADDED/MODIFIED/SYNC/DELETED
+        deltas, replace the store. Returns the collection resourceVersion to
+        stream from."""
+        query = {"labelSelector": selector} if selector else {}
+        full = f"{path}?{urllib.parse.urlencode(query)}" if query else path
+        listing = self._request("GET", full)
+        rv = listing.get("metadata", {}).get("resourceVersion", "")
+        # Conversion happens outside the lock: a large relist must not stall
+        # every cached read and event emission across the operator.
+        fresh: Dict[Tuple[str, str], Tuple[str, object]] = {}
+        for item in listing.get("items", []):
+            obj = convert(item)
+            ns, name, obj_rv = _meta_of(obj)
+            fresh[(ns, name)] = (obj_rv, obj)
+        events: List[Tuple[str, object]] = []
+        with self._informer_lock:
+            old = self._stores.get(kind, {})
+            for key, (obj_rv, obj) in fresh.items():
+                stale = old.get(key)
+                if stale is None:
+                    events.append((ADDED, obj))
+                elif stale[0] != obj_rv:
+                    events.append((MODIFIED, obj))
+                else:
+                    events.append((SYNC, obj))
+            for key, (_, obj) in old.items():
+                if key not in fresh:
+                    events.append((DELETED, obj))
+            self._stores[kind] = fresh
+            self._synced.setdefault(kind, threading.Event()).set()
+        for event_type, obj in events:
+            self._emit(kind, event_type, obj)
+        return rv
+
+    def _watch_loop(self, kind: str) -> None:
+        path, selector, convert = self._watch_paths(kind)
+        rv = ""
         while not self._stop.is_set():
             try:
-                listing = self._request("GET", path)
-                rv = listing.get("metadata", {}).get("resourceVersion", "")
-                for item in listing.get("items", []):
-                    handler(ADDED, convert(item))
-                self._stream(kind, path, rv, convert, handler)
+                if not rv:
+                    rv = self._relist(kind, path, selector, convert)
+                rv = self._stream(kind, path, selector, rv, convert)
             except Exception:
                 if self._stop.is_set():
                     return
                 _log.debug("watch %s: reconnecting", kind, exc_info=True)
+                rv = ""  # relist (diffed against the store: no ADDED replay)
                 time.sleep(1.0)
 
-    def _stream(self, kind: str, path: str, rv: str, convert, handler) -> None:
-        query = urllib.parse.urlencode(
-            {"watch": "true", "resourceVersion": rv, "allowWatchBookmarks": "true"}
-        )
-        conn = self._connect()
+    def _stream(self, kind: str, path: str, selector: Optional[str], rv: str,
+                convert) -> str:
+        """One streaming watch connection. Returns the resourceVersion to
+        resume from (empty = relist needed)."""
+        query = {
+            "watch": "true",
+            "resourceVersion": rv,
+            "allowWatchBookmarks": "true",
+            "timeoutSeconds": str(_WATCH_TIMEOUT_SECONDS),
+        }
+        if selector:
+            query["labelSelector"] = selector
+        conn = self._connect(timeout=_WATCH_TIMEOUT_SECONDS + 30)
+        with self._informer_lock:
+            self._stream_conns[kind] = conn
         try:
-            conn.request("GET", f"{path}?{query}", headers=self._headers())
+            conn.request("GET", f"{path}?{urllib.parse.urlencode(query)}",
+                         headers=self._headers())
             resp = conn.getresponse()
-            if resp.status == 410:  # Gone: relist
-                return
+            if resp.status == 410:  # Gone: our rv aged out server-side
+                return ""
             if resp.status >= 400:
                 raise RuntimeError(f"watch {kind}: {resp.status}")
             buffer = b""
             while not self._stop.is_set():
                 chunk = resp.read1(65536)
                 if not chunk:
-                    return  # server closed: relist + rewatch
+                    return rv  # clean server close: resume from last rv
                 buffer += chunk
                 while b"\n" in buffer:
                     line, buffer = buffer.split(b"\n", 1)
@@ -389,17 +621,47 @@ class KubeCluster(Cluster):
                         continue
                     evt = json.loads(line)
                     etype = evt.get("type", "")
+                    obj_raw = evt.get("object", {})
                     if etype == "BOOKMARK":
+                        rv = obj_raw.get("metadata", {}).get("resourceVersion", rv)
                         continue
-                    obj = evt.get("object", {})
-                    mapped = {
-                        "ADDED": ADDED, "MODIFIED": MODIFIED, "DELETED": DELETED,
-                    }.get(etype)
-                    if mapped is None:
+                    if etype == "ERROR":
+                        return ""  # e.g. expired rv delivered in-stream
+                    if etype not in (ADDED, MODIFIED, DELETED):
                         continue
-                    handler(mapped, convert(obj))
+                    obj = convert(obj_raw)
+                    ns, name, obj_rv = _meta_of(obj)
+                    key = (ns, name)
+                    rv = obj_rv or rv
+                    with self._informer_lock:
+                        store = self._stores.setdefault(kind, {})
+                        stale = store.get(key)
+                        if etype == DELETED:
+                            store.pop(key, None)
+                        elif stale is not None and stale[0] == obj_rv:
+                            continue  # same-RV duplicate (resync echo): drop
+                        elif stale is not None:
+                            store[key] = (obj_rv, obj)
+                            etype = MODIFIED  # replayed ADDED of a known object
+                        else:
+                            store[key] = (obj_rv, obj)
+                    self._emit(kind, etype, obj)
+            return rv
         finally:
+            with self._informer_lock:
+                self._stream_conns.pop(kind, None)
             conn.close()
+
+    def _force_reconnect(self) -> None:
+        """Test hook: sever every active watch stream; loops resume/relist."""
+        with self._informer_lock:
+            conns = list(self._stream_conns.values())
+        for conn in conns:
+            try:
+                conn.sock and conn.sock.close()
+            except Exception:
+                pass
 
     def shutdown(self) -> None:
         self._stop.set()
+        self._force_reconnect()

@@ -48,6 +48,7 @@ class InMemoryCluster(base.Cluster):
         self._pods: Dict[Tuple[str, str], Pod] = {}
         self._services: Dict[Tuple[str, str], Service] = {}
         self._pod_groups: Dict[Tuple[str, str], dict] = {}
+        self._leases: Dict[Tuple[str, str], dict] = {}
         self._events: List[Event] = []
         self._watchers: Dict[str, List[base.WatchHandler]] = {}
         # pod name -> behavior fn(pod) called on each step() while running
@@ -269,6 +270,44 @@ class InMemoryCluster(base.Cluster):
     def delete_pod_group(self, namespace: str, name: str) -> None:
         with self._lock:
             self._pod_groups.pop((namespace, name), None)
+
+    # ---------------------------------------------------------------- leases
+    def get_lease(self, namespace: str, name: str) -> dict:
+        with self._lock:
+            try:
+                return copy.deepcopy(self._leases[(namespace, name)])
+            except KeyError:
+                raise NotFound(f"lease {namespace}/{name}")
+
+    def create_lease(self, lease: dict) -> dict:
+        lease = copy.deepcopy(lease)
+        meta = lease.setdefault("metadata", {})
+        meta.setdefault("namespace", "default")
+        key = (meta["namespace"], meta["name"])
+        with self._lock:
+            if key in self._leases:
+                raise Conflict(f"lease {key} already exists")
+            meta["resourceVersion"] = str(next(self._rv))
+            self._leases[key] = lease
+            return copy.deepcopy(lease)
+
+    def update_lease(self, lease: dict) -> dict:
+        meta = lease.get("metadata", {})
+        key = (meta.get("namespace", "default"), meta["name"])
+        with self._lock:
+            existing = self._leases.get(key)
+            if existing is None:
+                raise NotFound(f"lease {key}")
+            sent_rv = meta.get("resourceVersion")
+            stored_rv = existing.get("metadata", {}).get("resourceVersion")
+            if sent_rv is not None and sent_rv != stored_rv:
+                raise Conflict(
+                    f"lease {key}: resourceVersion {sent_rv} is stale (current {stored_rv})"
+                )
+            stored = copy.deepcopy(lease)
+            stored["metadata"]["resourceVersion"] = str(next(self._rv))
+            self._leases[key] = stored
+            return copy.deepcopy(stored)
 
     # ---------------------------------------------------------------- events
     def record_event(self, event: Event) -> None:

@@ -1,0 +1,223 @@
+"""Apiserver-backed leader election over a coordination.k8s.io/v1 Lease.
+
+The reference elects through an EndpointsLock RunOrDie loop
+(cmd/tf-operator.v1/app/server.go:168-196): replicas race to write a
+holder identity into a shared API object, the winner renews, the rest
+retry and take over when the lease expires. Same protocol here, on the
+modern Lease resource, built on the Cluster seam's optimistic-concurrency
+writes — so the identical lock runs against the real apiserver
+(KubeCluster), the HTTP stub, and the in-memory cluster in tests.
+
+Cross-process safety comes from the backend, not this class: every
+acquire/renew/steal is a full-object update carrying the resourceVersion
+we read, and a concurrent writer's bump turns our write into a Conflict
+(= we lost the race, return False and retry next tick).
+
+Two client-go behaviors are deliberately reproduced:
+
+- **Expiry is measured on the local clock from the moment a renewTime
+  change is OBSERVED**, never by comparing the remote timestamp against
+  local now — otherwise a standby with a skewed clock would "see" a
+  freshly renewed lease as expired and steal it while the leader still
+  reconciles (dual leaders).
+- **A renewing leader survives transient apiserver errors** inside a
+  renew-deadline window (0.8 × lease duration from the last successful
+  write): one 500/timeout must not halt reconciling while the live lease
+  still blocks every standby. Past the deadline it abdicates, by which
+  time standbys' own observation timers are about to free the lease.
+"""
+
+from __future__ import annotations
+
+import calendar
+import logging
+import time
+from typing import Optional, Tuple
+
+from ..cluster.base import Cluster, Conflict, NotFound
+
+log = logging.getLogger(__name__)
+
+# Fraction of the lease duration a holder keeps claiming leadership while
+# renew attempts fail (client-go's RenewDeadline is similarly < LeaseDuration
+# so leadership lapses before any standby's steal timer can fire).
+_RENEW_DEADLINE_FRACTION = 0.8
+
+
+def _format_microtime(epoch: float) -> str:
+    """RFC3339 with microseconds — the wire format of Lease spec.renewTime
+    (metav1.MicroTime)."""
+    return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(epoch)) + (
+        ".%06dZ" % int((epoch % 1) * 1e6)
+    )
+
+
+def _parse_microtime(value) -> Optional[float]:
+    if value is None:
+        return None
+    if isinstance(value, (int, float)):
+        return float(value)
+    whole, _, frac = str(value).rstrip("Z").partition(".")
+    try:
+        base = calendar.timegm(time.strptime(whole, "%Y-%m-%dT%H:%M:%S"))
+    except ValueError:
+        return None
+    return base + (float("0." + frac) if frac else 0.0)
+
+
+def _pod_namespace() -> str:
+    """The namespace this operator pod runs in — where election RBAC is
+    granted (downward-API env, else the service-account mount, else
+    'default' for out-of-cluster runs)."""
+    import os
+
+    ns = os.environ.get("POD_NAMESPACE")
+    if ns:
+        return ns
+    sa_ns = "/var/run/secrets/kubernetes.io/serviceaccount/namespace"
+    if os.path.exists(sa_ns):
+        with open(sa_ns) as f:
+            return f.read().strip() or "default"
+    return "default"
+
+
+class ClusterLeaseLock:
+    """The lock OperatorManager's elect loop drives: try_acquire each tick,
+    release on shutdown. Holder identity should be unique per replica
+    (reference uses hostname = pod name)."""
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        namespace: Optional[str] = None,
+        name: str = "tf-operator-tpu-lock",
+        clock=time.time,
+    ):
+        self.cluster = cluster
+        self.namespace = namespace or _pod_namespace()
+        self.name = name
+        self._clock = clock
+        # (holder, renewTime-raw) last seen + the LOCAL time we saw it
+        # change: the basis for skew-free expiry.
+        self._observed: Optional[Tuple[str, str]] = None
+        self._observed_at: float = 0.0
+        # Local deadline until which we keep claiming leadership across
+        # transient renew errors (0 = not holding).
+        self._renew_ok_until: float = 0.0
+
+    # ----------------------------------------------------------------- api
+    def try_acquire(self, identity: str, duration: float) -> bool:
+        """One election round. True iff `identity` holds the lease after the
+        call: fresh create, own renewal, steal of an expired lease — or a
+        still-inside-deadline hold across a transient apiserver error."""
+        now = self._clock()
+        try:
+            lease = self.cluster.get_lease(self.namespace, self.name)
+        except NotFound:
+            return self._create(identity, duration, now)
+        except Exception:
+            log.warning("lease get failed", exc_info=True)
+            return self._survives_error(now)
+
+        spec = lease.setdefault("spec", {})
+        holder = spec.get("holderIdentity")
+        renew_raw = str(spec.get("renewTime"))
+        held_duration = spec.get("leaseDurationSeconds", duration)
+
+        if holder and holder != identity:
+            # Skew-safe expiry: restart the local timer whenever the remote
+            # record changes; only a lease that has sat UNCHANGED for its
+            # full duration on OUR clock is stealable.
+            if self._observed != (holder, renew_raw):
+                self._observed = (holder, renew_raw)
+                self._observed_at = now
+            if now < self._observed_at + held_duration:
+                self._renew_ok_until = 0.0
+                return False
+        if holder != identity:
+            # Steal/first-claim: count the transition like client-go does.
+            spec["leaseTransitions"] = int(spec.get("leaseTransitions") or 0) + 1
+            spec["acquireTime"] = _format_microtime(now)
+        spec["holderIdentity"] = identity
+        spec["renewTime"] = _format_microtime(now)
+        spec["leaseDurationSeconds"] = int(duration)
+        try:
+            self.cluster.update_lease(lease)
+        except Conflict:
+            # Someone else wrote concurrently — the unambiguous "you are not
+            # the holder" signal. Abdicate immediately (safe direction: an
+            # extra standby tick beats dual leaders).
+            self._renew_ok_until = 0.0
+            return False
+        except Exception:
+            log.warning("lease update failed", exc_info=True)
+            return self._survives_error(now)
+        self._observed = (identity, spec["renewTime"])
+        self._observed_at = now
+        self._renew_ok_until = now + duration * _RENEW_DEADLINE_FRACTION
+        return True
+
+    def _survives_error(self, now: float) -> bool:
+        """Transient-error policy: keep leading inside the renew deadline,
+        abdicate after (the live lease still blocks standbys meanwhile)."""
+        return now < self._renew_ok_until
+
+    def release(self, identity: str) -> None:
+        """Voluntary handoff on clean shutdown (reference ReleaseOnCancel):
+        clear the holder so a standby wins the very next tick instead of
+        waiting out the lease duration."""
+        self._renew_ok_until = 0.0
+        try:
+            lease = self.cluster.get_lease(self.namespace, self.name)
+        except Exception:
+            return
+        spec = lease.setdefault("spec", {})
+        if spec.get("holderIdentity") != identity:
+            return
+        spec["holderIdentity"] = ""
+        spec["renewTime"] = None
+        try:
+            self.cluster.update_lease(lease)
+        except Exception:
+            log.debug("lease release failed", exc_info=True)
+
+    @property
+    def holder(self) -> Optional[str]:
+        """Advisory view of the current holder (observability/tests). Uses
+        the remote timestamps directly — election decisions never do."""
+        try:
+            lease = self.cluster.get_lease(self.namespace, self.name)
+        except Exception:
+            return None
+        spec = lease.get("spec", {})
+        renew = _parse_microtime(spec.get("renewTime"))
+        duration = spec.get("leaseDurationSeconds", 0)
+        if renew is None or self._clock() >= renew + duration:
+            return None
+        return spec.get("holderIdentity") or None
+
+    # ------------------------------------------------------------ internals
+    def _create(self, identity: str, duration: float, now: float) -> bool:
+        lease = {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"namespace": self.namespace, "name": self.name},
+            "spec": {
+                "holderIdentity": identity,
+                "leaseDurationSeconds": int(duration),
+                "acquireTime": _format_microtime(now),
+                "renewTime": _format_microtime(now),
+                "leaseTransitions": 0,
+            },
+        }
+        try:
+            self.cluster.create_lease(lease)
+        except Conflict:
+            return False  # another replica created it first
+        except Exception:
+            log.warning("lease create failed", exc_info=True)
+            return self._survives_error(now)
+        self._observed = (identity, lease["spec"]["renewTime"])
+        self._observed_at = now
+        self._renew_ok_until = now + duration * _RENEW_DEADLINE_FRACTION
+        return True

@@ -11,9 +11,40 @@ job-startup p50 and restart-MTTR baselines (BASELINE.md).
 
 from __future__ import annotations
 
+import bisect
 import threading
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Dict, List, Set, Tuple
+
+
+class _Histogram:
+    """Streaming Prometheus histogram: per-bucket counts + sum/count, O(1)
+    memory per series no matter how many observations (ADVICE r1: raw
+    sample lists grew without bound — observe_reconcile fires on every sync
+    of every job). A small bounded `recent` window is kept for tests and
+    debug introspection only."""
+
+    __slots__ = ("bounds", "counts", "total", "count", "recent")
+
+    def __init__(self, bounds: Tuple[float, ...]):
+        self.bounds = bounds
+        self.counts = [0] * (len(bounds) + 1)  # +1 = the +Inf bucket
+        self.total = 0.0
+        self.count = 0
+        self.recent = deque(maxlen=256)
+
+    def observe(self, value: float) -> None:
+        self.counts[bisect.bisect_left(self.bounds, value)] += 1
+        self.total += value
+        self.count += 1
+        self.recent.append(value)
+
+    def cumulative(self) -> List[int]:
+        out, running = [], 0
+        for c in self.counts[:-1]:
+            running += c
+            out.append(running)
+        return out
 
 
 class Metrics:
@@ -38,12 +69,20 @@ class Metrics:
             name: defaultdict(int) for name, _ in self._COUNTERS
         }
         self._terminal_seen: Set[Tuple[str, str, str]] = set()
-        self._histograms: Dict[str, Dict[Tuple[str, str], List[float]]] = {
-            "training_operator_job_startup_seconds": defaultdict(list),
-            "training_operator_job_restart_seconds": defaultdict(list),
-            # Per-sync latency (the reference logs "Finished syncing tfjob
-            # %q (%v)", controller.go:306; here it is also a histogram).
-            "training_operator_reconcile_duration_seconds": defaultdict(list),
+
+        def series(name: str):
+            bounds = self._BUCKETS_BY_NAME.get(name, self._HISTOGRAM_BUCKETS)
+            return defaultdict(lambda: _Histogram(bounds))
+
+        self._histograms: Dict[str, Dict[Tuple[str, str], _Histogram]] = {
+            name: series(name)
+            for name in (
+                "training_operator_job_startup_seconds",
+                "training_operator_job_restart_seconds",
+                # Per-sync latency (the reference logs "Finished syncing
+                # tfjob %q (%v)", controller.go:306; here a histogram).
+                "training_operator_reconcile_duration_seconds",
+            )
         }
         # Unlabeled gauges: leader flag etc. (legacy tf_operator_is_leader,
         # cmd/tf-operator.v1/app/server.go:66-70).
@@ -78,19 +117,21 @@ class Metrics:
 
     def observe_startup(self, namespace: str, framework: str, seconds: float) -> None:
         with self._lock:
-            self._histograms["training_operator_job_startup_seconds"][(namespace, framework)].append(seconds)
+            self._histograms["training_operator_job_startup_seconds"][(namespace, framework)].observe(seconds)
 
     def observe_reconcile(self, namespace: str, framework: str, seconds: float) -> None:
         with self._lock:
-            self._histograms["training_operator_reconcile_duration_seconds"][(namespace, framework)].append(seconds)
+            self._histograms["training_operator_reconcile_duration_seconds"][(namespace, framework)].observe(seconds)
 
     def observe_restart(self, namespace: str, framework: str, seconds: float) -> None:
         with self._lock:
-            self._histograms["training_operator_job_restart_seconds"][(namespace, framework)].append(seconds)
+            self._histograms["training_operator_job_restart_seconds"][(namespace, framework)].observe(seconds)
 
     def histogram_values(self, name: str, namespace: str, framework: str):
+        """Recent raw observations (bounded window) — test/debug hook; the
+        exposition path uses the streaming aggregates."""
         with self._lock:
-            return list(self._histograms[name][(namespace, framework)])
+            return list(self._histograms[name][(namespace, framework)].recent)
 
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
@@ -116,16 +157,13 @@ class Metrics:
             for name, series in self._histograms.items():
                 lines.append(f"# HELP {name} {name.replace('_', ' ')}")
                 lines.append(f"# TYPE {name} histogram")
-                buckets = self._BUCKETS_BY_NAME.get(name, self._HISTOGRAM_BUCKETS)
-                for (ns, fw), samples in sorted(series.items()):
+                for (ns, fw), hist in sorted(series.items()):
                     label = f'job_namespace="{ns}",framework="{fw}"'
-                    cumulative = 0
-                    for bucket in buckets:
-                        cumulative = sum(1 for s in samples if s <= bucket)
-                        lines.append(f'{name}_bucket{{{label},le="{bucket}"}} {cumulative}')
-                    lines.append(f'{name}_bucket{{{label},le="+Inf"}} {len(samples)}')
-                    lines.append(f"{name}_sum{{{label}}} {sum(samples)}")
-                    lines.append(f"{name}_count{{{label}}} {len(samples)}")
+                    for bound, cum in zip(hist.bounds, hist.cumulative()):
+                        lines.append(f'{name}_bucket{{{label},le="{bound}"}} {cum}')
+                    lines.append(f'{name}_bucket{{{label},le="+Inf"}} {hist.count}')
+                    lines.append(f"{name}_sum{{{label}}} {hist.total}")
+                    lines.append(f"{name}_count{{{label}}} {hist.count}")
             for name, value in sorted(self._gauges.items()):
                 lines.append(f"# HELP {name} {name.replace('_', ' ')}")
                 lines.append(f"# TYPE {name} gauge")

@@ -1,7 +1,8 @@
 """A minimal kube-apiserver stub for exercising KubeCluster.
 
 Translates the REST surface the operator uses — CRD jobs, core
-pods/services/events, volcano PodGroups, streaming watches — onto an
+pods/services/events, volcano PodGroups, coordination Leases, streaming
+watches (cluster- and namespace-scoped, labelSelector-filtered) — onto an
 InMemoryCluster, so the full operator stack can run over real HTTP
 without a cluster. The analog of controller-runtime's envtest
 (SURVEY.md §4 T2: real apiserver, no kubelet), minus etcd.
@@ -39,6 +40,10 @@ _CORE_RE = re.compile(
 _CORE_ALL_RE = re.compile(r"^/api/v1/(?P<resource>pods|services|events)$")
 _PG_RE = re.compile(
     r"^/apis/scheduling\.volcano\.sh/v1beta1/namespaces/(?P<ns>[^/]+)/podgroups"
+    r"(?:/(?P<name>[^/]+))?$"
+)
+_LEASE_RE = re.compile(
+    r"^/apis/coordination\.k8s\.io/v1/namespaces/(?P<ns>[^/]+)/leases"
     r"(?:/(?P<name>[^/]+))?$"
 )
 
@@ -111,30 +116,42 @@ class StubApiServer:
         parsed = urlparse(handler.path)
         path, q = parsed.path, parse_qs(parsed.query)
         watching = q.get("watch", ["false"])[0] == "true"
+        labels = _selector(q)
 
         m = _JOB_RE.match(path)
         if m:
             return self._jobs(handler, method, m, watching)
         m = _JOB_ALL_RE.match(path)
-        if m:
-            return self._collection(handler, m["plural"], watching, kind_space="jobs")
+        if m and method == "GET":
+            kind = _PLURAL_TO_KIND[m["plural"]]
+            return self._jobs_collection(handler, kind, watching, ns=None)
         m = _CORE_RE.match(path)
         if m:
+            if method == "GET" and not m["name"] and m["resource"] in ("pods", "services"):
+                return self._core_collection(
+                    handler, m["resource"], watching, ns=m["ns"], labels=labels
+                )
             return self._core(handler, method, m, q)
         m = _CORE_ALL_RE.match(path)
         if m:
-            return self._collection(handler, m["resource"], watching, kind_space="core")
+            if m["resource"] == "events":
+                return self._events_list(handler, q)
+            return self._core_collection(
+                handler, m["resource"], watching, ns=None, labels=labels
+            )
         m = _PG_RE.match(path)
         if m:
             return self._podgroups(handler, method, m)
+        m = _LEASE_RE.match(path)
+        if m:
+            return self._leases(handler, method, m)
         raise KeyError(path)
 
     def _jobs(self, handler, method, m, watching) -> None:
         kind = _PLURAL_TO_KIND[m["plural"]]
         ns, name = m["ns"], m["name"]
         if method == "GET" and not name:
-            items = self.mem.list_jobs(kind, ns)
-            return handler._json(200, {"items": items, "metadata": {"resourceVersion": "0"}})
+            return self._jobs_collection(handler, kind, watching, ns=ns)
         if method == "GET":
             return handler._json(200, self.mem.get_job(kind, ns, name))
         if method == "POST":
@@ -166,10 +183,6 @@ class StubApiServer:
                 return
             if method == "GET" and name:
                 return handler._json(200, to_dict(self.mem.get_pod(ns, name)))
-            if method == "GET":
-                labels = _selector(q)
-                items = [to_dict(p) for p in self.mem.list_pods(ns, labels=labels)]
-                return handler._json(200, {"items": items})
             if method == "POST":
                 pod = from_dict(Pod, handler._body())
                 return handler._json(201, to_dict(self.mem.create_pod(pod)))
@@ -180,10 +193,6 @@ class StubApiServer:
                 self.mem.delete_pod(ns, name)
                 return handler._json(200, {})
         if resource == "services":
-            if method == "GET":
-                labels = _selector(q)
-                items = [to_dict(s) for s in self.mem.list_services(ns, labels=labels)]
-                return handler._json(200, {"items": items})
             if method == "POST":
                 svc = from_dict(Service, handler._body())
                 return handler._json(201, to_dict(self.mem.create_service(svc)))
@@ -201,17 +210,32 @@ class StubApiServer:
                 ))
                 return handler._json(201, {})
             if method == "GET":
-                items = [
-                    {
-                        "type": e.type, "reason": e.reason, "message": e.message,
-                        "involvedObject": dict(zip(
-                            ("kind", "namespace", "name"), e.involved_object.split("/")
-                        )),
-                    }
-                    for e in self.mem.list_events()
-                ]
-                return handler._json(200, {"items": items})
+                return self._events_list(handler, q, ns=ns)
         raise KeyError(resource)
+
+    def _events_list(self, handler, q, ns: Optional[str] = None) -> None:
+        # fieldSelector narrowing (involvedObject.kind/name), the server-side
+        # filter KubeCluster.list_events relies on.
+        selector = {}
+        raw = q.get("fieldSelector", [None])[0]
+        if raw:
+            for part in raw.split(","):
+                k, _, v = part.partition("=")
+                selector[k] = v
+        items = []
+        for e in self.mem.list_events():
+            kind, namespace, name = (e.involved_object.split("/") + ["", "", ""])[:3]
+            if ns and namespace != ns:
+                continue
+            if selector.get("involvedObject.kind") not in (None, kind):
+                continue
+            if selector.get("involvedObject.name") not in (None, name):
+                continue
+            items.append({
+                "type": e.type, "reason": e.reason, "message": e.message,
+                "involvedObject": {"kind": kind, "namespace": namespace, "name": name},
+            })
+        handler._json(200, {"items": items})
 
     def _podgroups(self, handler, method, m) -> None:
         ns, name = m["ns"], m["name"]
@@ -224,54 +248,69 @@ class StubApiServer:
             return handler._json(200, {})
         raise KeyError(method)
 
-    # -------------------------------------------------------------- watches
-    def _collection(self, handler, resource_or_plural, watching, kind_space) -> None:
-        """Cluster-scope GET, with ?watch=true streaming support."""
-        if kind_space == "jobs":
-            kind = _PLURAL_TO_KIND[resource_or_plural]
-            convert = lambda o: o  # noqa: E731
-            items = self.mem.list_jobs(kind)
-        elif resource_or_plural == "pods":
-            kind = "pods"
-            convert = to_dict
-            items = [to_dict(p) for p in self.mem.list_pods()]
-        elif resource_or_plural == "services":
-            kind = "services"
-            convert = to_dict
-            items = [to_dict(s) for s in self.mem.list_services()]
-        else:  # events (list-only; no watch support needed)
-            kind = None
-            convert = None
-            items = [
-                {
-                    "type": e.type, "reason": e.reason, "message": e.message,
-                    "involvedObject": dict(zip(
-                        ("kind", "namespace", "name"), e.involved_object.split("/")
-                    )),
-                }
-                for e in self.mem.list_events()
-            ]
+    def _leases(self, handler, method, m) -> None:
+        ns, name = m["ns"], m["name"]
+        if method == "GET":
+            return handler._json(200, self.mem.get_lease(ns, name))
+        if method == "POST":
+            return handler._json(201, self.mem.create_lease(handler._body()))
+        if method == "PUT":
+            return handler._json(200, self.mem.update_lease(handler._body()))
+        raise KeyError(method)
 
+    # -------------------------------------------------------------- watches
+    def _jobs_collection(self, handler, kind: str, watching: bool,
+                         ns: Optional[str]) -> None:
+        def keep(obj: dict) -> bool:
+            meta = obj.get("metadata") or {}
+            return ns is None or meta.get("namespace", "default") == ns
+
+        self._serve(
+            handler, kind, lambda: self.mem.list_jobs(kind, ns),
+            lambda o: o, keep, watching,
+        )
+
+    def _core_collection(self, handler, resource: str, watching: bool,
+                         ns: Optional[str], labels: Optional[dict]) -> None:
+        lister = self.mem.list_pods if resource == "pods" else self.mem.list_services
+
+        def keep(obj) -> bool:
+            if ns is not None and obj.metadata.namespace != ns:
+                return False
+            if labels and any(
+                obj.metadata.labels.get(k) != v for k, v in labels.items()
+            ):
+                return False
+            return True
+
+        self._serve(
+            handler, resource,
+            lambda: [to_dict(o) for o in lister(ns, labels=labels)],
+            to_dict, keep, watching,
+        )
+
+    def _serve(self, handler, kind, items_fn, convert, keep, watching) -> None:
         if not watching:
             return handler._json(
-                200, {"items": items, "metadata": {"resourceVersion": "0"}}
+                200, {"items": items_fn(), "metadata": {"resourceVersion": "0"}}
             )
 
-        # Streaming watch: subscribe FIRST, then replay the current state as
-        # synthetic ADDED events — closing the client's list->watch gap the
-        # way a real apiserver's resourceVersion replay does (handlers are
-        # idempotent enqueuers, so duplicates are harmless). The `dead` flag
-        # neuters the subscription after disconnect: InMemoryCluster has no
-        # unsubscribe, and a leaked live queue would grow forever.
+        # Streaming watch: subscribe FIRST, then list + replay the current
+        # state as synthetic ADDED events — an object created in between
+        # appears in both, and the client's informer dedups the replay by
+        # resourceVersion; listing before subscribing would lose it for the
+        # whole stream lifetime. The `dead` flag neuters the subscription
+        # after disconnect: InMemoryCluster has no unsubscribe, and a leaked
+        # live queue would grow forever.
         events: "queue.Queue" = queue.Queue()
         dead = threading.Event()
 
         def relay(etype, obj):
-            if not dead.is_set():
+            if not dead.is_set() and keep(obj):
                 events.put((etype, obj))
 
         self.mem.watch(kind, relay)
-        for snapshot in items:
+        for snapshot in items_fn():
             events.put(("ADDED", snapshot))
         handler.send_response(200)
         handler.send_header("Content-Type", "application/json")
@@ -288,7 +327,7 @@ class StubApiServer:
                 etype, obj = events.get()
                 body = obj if isinstance(obj, dict) else convert(obj)
                 send({"type": etype, "object": body})
-        except (BrokenPipeError, ConnectionResetError):
+        except (BrokenPipeError, ConnectionResetError, OSError):
             return
         finally:
             dead.set()
