@@ -148,10 +148,13 @@ def rope_table(head_dim: int, max_len: int, theta: float):
 
 
 def gather_rope(cfg: "LlamaConfig", positions):
-    """Pre-gathered per-position cos/sin, [b, s, 1, d/2] fp32. Computed ONCE
-    at the stack level and passed into the scanned block as a broadcast
-    input — inside the block it would be rebuilt (table + gather) per layer
-    per pass, and again in every remat recompute."""
+    """Pre-gathered per-position cos/sin, [b, s, 1, d/2] fp32. Computed
+    INSIDE each block (not hoisted to the stack as a scan-broadcast input):
+    a broadcast input becomes a residual crossing the forward/backward
+    while-loop boundary, and the SPMD partitioner picks conflicting
+    shardings for it on the two sides — an involuntary full remat per step.
+    Recomputing is a few KB of VPU work per layer; the remat was the real
+    cost."""
     cos, sin = rope_table(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
     return cos[positions][:, :, None, :], sin[positions][:, :, None, :]
 
@@ -168,6 +171,17 @@ def apply_rope(x, cos, sin):
         from ..ops.rope_pallas import rope_pallas
 
         return rope_pallas(x, cos[0, :, 0, :], sin[0, :, 0, :])
+    from ..parallel.sharding import constrain
+
+    # Materialize the per-head broadcast explicitly and pin it to the layout
+    # attention actually uses (heads over tp, batch replicated — the tables
+    # are position-only). Left implicit, XLA hoists the broadcast multiplier
+    # out of the layer loop as a residual whose sharding is then propagated
+    # batch-ish on the forward side but head-tp inside the backward while —
+    # a conflict SPMD resolves with an involuntary full remat every step.
+    b, s, h, hd = x.shape
+    cos = constrain(jnp.broadcast_to(cos, (1, s, h, hd // 2)), None, "sp", "tp", None)
+    sin = constrain(jnp.broadcast_to(sin, (1, s, h, hd // 2)), None, "sp", "tp", None)
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return out.astype(x.dtype)
@@ -177,8 +191,11 @@ class Attention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, rope):
+    def __call__(self, x):
         cfg = self.config
+        # Per-position rope, recomputed here (see gather_rope docstring).
+        positions = jnp.broadcast_to(jnp.arange(x.shape[1]), (1, x.shape[1]))
+        rope = gather_rope(cfg, positions)
         dense = partial(
             nn.DenseGeneral,
             use_bias=False,
@@ -319,13 +336,13 @@ class MoE(nn.Module):
 
 
 class Block(nn.Module):
-    """One decoder layer. Signature is scan-compatible: carries `x`, passes
-    the pre-gathered rope tables through as a carry-free broadcast input."""
+    """One decoder layer. Signature is scan-compatible: carries `x` only
+    (rope is recomputed inside Attention — see gather_rope)."""
 
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, rope):
+    def __call__(self, x):
         from ..parallel.sharding import DATA_AXES, constrain
 
         cfg = self.config
@@ -334,7 +351,7 @@ class Block(nn.Module):
         # stream (a no-op without a scoped mesh).
         x = constrain(x, DATA_AXES, "sp", None)
         x = x + Attention(cfg, name="attention")(
-            RMSNorm(cfg.norm_eps, cfg.param_dtype, name="attention_norm")(x), rope
+            RMSNorm(cfg.norm_eps, cfg.param_dtype, name="attention_norm")(x)
         )
         ffn = MoE(cfg, name="feed_forward") if cfg.n_experts else MLP(cfg, name="feed_forward")
         x = x + ffn(RMSNorm(cfg.norm_eps, cfg.param_dtype, name="ffn_norm")(x))
@@ -374,11 +391,8 @@ class Llama(nn.Module):
     def __call__(self, tokens, return_hidden: bool = False):
         cfg = self.config
         b, s = tokens.shape
-        # Rope tables gathered batch-agnostically (positions identical per
-        # row): [1, s, 1, d/2] broadcasts over any batch — including the
-        # pipeline's microbatches, whose row count differs from b.
-        positions = jnp.broadcast_to(jnp.arange(s), (1, s))
-        rope = gather_rope(cfg, positions)
+        from ..parallel.sharding import DATA_AXES, constrain
+
         x = nn.Embed(
             cfg.vocab_size,
             cfg.dim,
@@ -387,6 +401,10 @@ class Llama(nn.Module):
             embedding_init=nn.initializers.normal(0.02),
             name="tok_embeddings",
         )(tokens)
+        # Land the lookup output directly in the canonical activation layout
+        # (batch over data axes) instead of letting the vocab-sharded gather
+        # output's layout propagate into the first block.
+        x = constrain(x, DATA_AXES, "sp", None)
 
         from ..parallel.mesh import current_mesh
 
@@ -417,8 +435,8 @@ class Llama(nn.Module):
             # module and its .apply would corrupt the trace.
             blk = Block(cfg, parent=None)
 
-            def apply_one(p, carry, cos, sin):
-                y, _ = blk.apply({"params": p}, carry, (cos, sin))
+            def apply_one(p, carry):
+                y, _ = blk.apply({"params": p}, carry)
                 return y
 
             if cfg.remat:
@@ -426,9 +444,9 @@ class Llama(nn.Module):
                     apply_one, prevent_cse=False, policy=_remat_policy(cfg)
                 )
 
-            def stage_fn(p_stage, xm, cos, sin):
+            def stage_fn(p_stage, xm):
                 def body(carry, p):
-                    return apply_one(p, carry, cos, sin), None
+                    return apply_one(p, carry), None
 
                 y, _ = jax.lax.scan(body, xm, p_stage)
                 return y
@@ -437,8 +455,6 @@ class Llama(nn.Module):
                 stage_fn,
                 split_stages(layer_params, pp),
                 x,
-                rope[0],
-                rope[1],
                 num_microbatches=cfg.pp_microbatches or pp,
                 mesh=mesh,
             )
@@ -452,11 +468,10 @@ class Llama(nn.Module):
                 block,
                 variable_axes={"params": 0, "losses": 0},
                 split_rngs={"params": True},
-                in_axes=nn.broadcast,  # rope tables: same every layer
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )
-            x, _ = scanned(cfg, name="layers")(x, rope)
+            x, _ = scanned(cfg, name="layers")(x)
 
         x = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="norm")(x)
         if return_hidden:

@@ -6,7 +6,7 @@ FSDP params, reduce-scatter for grads, all-reduce for TP partials) — the
 scaling-book recipe: pick a mesh, annotate, let the compiler work.
 
 Conventions (megatron-style, FSDP on the long axis):
-- embedding [vocab, d]           -> (tp, fsdp)
+- embedding [vocab, d]           -> (fsdp, tp)
 - attn qkv  [d, heads*head_dim]  -> (fsdp, tp)
 - attn out  [heads*head_dim, d]  -> (tp, fsdp)
 - mlp in/gate [d, ffn]           -> (fsdp, tp)
@@ -47,7 +47,12 @@ _PARAM_RULES = [
     (r"experts.*(w1|w3|gate|up).*", ("ep", "fsdp", "tp")),
     (r"experts.*(w2|down).*", ("ep", "tp", "fsdp")),
     (r"router.*kernel", (None, None)),
-    (r"embed(ding)?s?.*(embedding|kernel)", ("tp", "fsdp")),
+    # Embedding [vocab, d]: vocab over fsdp, d over tp. The reverse
+    # (vocab/tp, d/fsdp) makes both the fwd token gather and the bwd
+    # grad-scatter prefer d-over-fsdp activation layouts that clash with
+    # the canonical batch-sharded layout — SPMD bridges the clash with an
+    # involuntary full remat of the embedding boundary every step.
+    (r"embed(ding)?s?.*(embedding|kernel)", ("fsdp", "tp")),
     (r"(wq|wk|wv|qkv|query|key|value).*kernel", {2: ("fsdp", "tp"), 3: ("fsdp", "tp", None)}),
     (r"(wo|out_proj|o_proj|attn_out).*kernel", {2: ("tp", "fsdp"), 3: ("tp", None, "fsdp")}),
     (r"(w1|w3|gate_proj|up_proj|gate|up).*kernel", ("fsdp", "tp")),
