@@ -47,6 +47,10 @@ JOB_FAILED = "Failed"
 # Suspension (training-operator RunPolicy.suspend): on TPU, a suspended
 # job releases its whole pod-slice back to the scheduler.
 JOB_SUSPENDED = "Suspended"
+# Gang waiting for scheduler capacity (PodGroup phase Pending/Inqueue):
+# makes a queued slice observable instead of indistinguishable from a
+# stuck job. No reference counterpart (its PodGroup is fire-and-forget).
+JOB_QUEUED = "Queued"
 
 CONDITION_TRUE = "True"
 CONDITION_FALSE = "False"
@@ -219,8 +223,10 @@ def update_job_conditions(
 
     if cond_type in (JOB_SUCCEEDED, JOB_FAILED, JOB_SUSPENDED):
         _flip(JOB_RUNNING)
+        _flip(JOB_QUEUED)  # a terminal/suspended job is not waiting in queue
     if cond_type == JOB_RUNNING:
         _flip(JOB_SUSPENDED)
+        _flip(JOB_QUEUED)  # the gang got capacity: queue record stays, False
 
     kept.append(new_cond)
     status.conditions = kept

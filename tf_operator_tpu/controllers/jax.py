@@ -14,6 +14,7 @@ succeed; Running while any runs; retryable exits (preemption/maintenance,
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Dict, List
 
 from ..api import common as capi
@@ -133,8 +134,32 @@ class JAXController(FrameworkController):
     def gang_groups(self, job, replicas: Dict[str, ReplicaSpec], run_policy) -> List[dict]:
         """One gang per slice: minMember = hosts per slice (a partial slice
         is useless; an independent slice is not)."""
+        from ..core.job_controller import aggregate_min_resources
+
         per_slice = jaxdist.hosts_per_slice(job)
         sp = run_policy.scheduling_policy
+        # Per-slice capacity: one slice's share of the worker topology (the
+        # scheduler must be able to reserve a whole slice, not the whole
+        # multislice job, for a free slice to start independently).
+        slice_replicas = {
+            rtype: dataclasses.replace(spec, replicas=per_slice)
+            for rtype, spec in replicas.items()
+        }
+        min_resources = (
+            dict(sp.min_resources) if sp is not None and sp.min_resources
+            else aggregate_min_resources(slice_replicas)
+        )
+        # The per-pod chip ask is injected at pod-creation time (mutate
+        # hook), so the template aggregation misses it — add the slice's
+        # chips explicitly: hosts/slice x chips/host.
+        if sp is None or not sp.min_resources:
+            tpu = job.spec.tpu
+            chips = tpu.chips_per_host if tpu else None
+            if chips is None and tpu and tpu.accelerator_type:
+                info = jaxapi.ACCELERATOR_TOPOLOGIES.get(tpu.accelerator_type)
+                chips = info[1] if info else None
+            if chips:
+                min_resources.setdefault(TPU_RESOURCE, str(per_slice * chips))
         groups = []
         for s in range(max(1, job.spec.num_slices)):
             groups.append(
@@ -144,6 +169,7 @@ class JAXController(FrameworkController):
                     "metadata": {"name": f"{job.name}-slice-{s}", "namespace": job.namespace},
                     "spec": {
                         "minMember": per_slice,
+                        "minResources": min_resources,
                         "queue": sp.queue if sp else "",
                         "priorityClassName": sp.priority_class if sp else "",
                     },

@@ -57,6 +57,7 @@ REASON_SUCCEEDED = "Succeeded"
 REASON_FAILED = "Failed"
 REASON_SUSPENDED = "Suspended"
 REASON_RESUMED = "Resumed"
+REASON_QUEUED = "GangQueued"
 
 # Exit code sentinel when the framework container has not terminated
 # (reference tfjob_controller.go:707 "magic number").

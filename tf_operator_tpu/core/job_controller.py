@@ -60,6 +60,47 @@ def job_selector(job: JobObject) -> Dict[str, str]:
     }
 
 
+# Kubernetes resource.Quantity arithmetic (the subset PodGroup minResources
+# aggregation needs): parse "100m"/"2Gi"/"4" to floats, sum, format back.
+_QUANTITY_SUFFIXES = {
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50, "Ei": 2**60,
+    "n": 1e-9, "u": 1e-6, "m": 1e-3,
+    "k": 1e3, "K": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15, "E": 1e18,
+}
+
+
+def parse_quantity(value) -> float:
+    s = str(value).strip()
+    for suffix in ("Ki", "Mi", "Gi", "Ti", "Pi", "Ei"):
+        if s.endswith(suffix):
+            return float(s[: -2]) * _QUANTITY_SUFFIXES[suffix]
+    if s and s[-1] in _QUANTITY_SUFFIXES:
+        return float(s[:-1]) * _QUANTITY_SUFFIXES[s[-1]]
+    return float(s)
+
+
+def format_quantity(value: float) -> str:
+    if value == int(value):
+        return str(int(value))
+    return f"{int(round(value * 1000))}m"  # fractional (cpu-style) -> milli
+
+
+def aggregate_min_resources(replicas: Dict[str, ReplicaSpec]) -> Dict[str, str]:
+    """Sum per-replica container requests (falling back to limits) across
+    the whole topology — the reference kubeflow/common SyncPodGroup fills
+    PodGroup.spec.minResources the same way so the gang scheduler can
+    reserve capacity for the entire job at once."""
+    totals: Dict[str, float] = {}
+    for spec in replicas.values():
+        n = spec.replicas or 0
+        for container in spec.template.spec.containers:
+            resources = container.resources or {}
+            requests = resources.get("requests") or resources.get("limits") or {}
+            for name, value in requests.items():
+                totals[name] = totals.get(name, 0.0) + n * parse_quantity(value)
+    return {name: format_quantity(v) for name, v in sorted(totals.items())}
+
+
 def get_container_exit_code(pod: Pod, container_name: str) -> int:
     """Exit code of the framework container, EXIT_CODE_UNSET if not
     terminated (reference tfjob_controller.go:707-715)."""
@@ -166,6 +207,12 @@ class FrameworkHooks:
         sp = run_policy.scheduling_policy
         if sp is not None and sp.min_available is not None:
             min_member = sp.min_available
+        # minResources: the user's schedulingPolicy value verbatim when set,
+        # else the summed per-replica requests (kubeflow/common SyncPodGroup).
+        min_resources = (
+            dict(sp.min_resources) if sp is not None and sp.min_resources
+            else aggregate_min_resources(replicas)
+        )
         return [
             {
                 "apiVersion": "scheduling.volcano.sh/v1beta1",
@@ -173,6 +220,7 @@ class FrameworkHooks:
                 "metadata": {"name": job.name, "namespace": job.namespace},
                 "spec": {
                     "minMember": min_member,
+                    "minResources": min_resources,
                     "queue": sp.queue if sp else "",
                     "priorityClassName": sp.priority_class if sp else "",
                 },
@@ -216,27 +264,85 @@ class JobController:
 
     # ------------------------------------------------------------- listing
     def get_pods_for_job(self, job: JobObject) -> List[Pod]:
-        """Label-selected pods with adoption/orphaning semantics: keep pods
-        whose controllerRef UID matches the live job, adopt matching orphans
-        (reference tfjob_controller.go:249-332 with uncached UID recheck)."""
-        pods = self.cluster.list_pods(namespace=job.namespace, labels=job_selector(job))
+        """Label-selected pods with full claim semantics (reference
+        ControllerRefManager, tfjob_controller.go:249-332):
+
+        - owned (controllerRef UID matches) + labels still match -> keep;
+        - owned but labels no longer match -> RELEASE: remove our
+          controllerRef with an uncached UID recheck (the list may be
+          served by the informer cache; never patch a pod we haven't
+          re-read live);
+        - orphan + labels match -> ADOPT, gated on an uncached job GET
+          proving the job still exists with the same UID (an operator
+          holding a stale cached job must not stamp refs for a deleted/
+          recreated one) and on the job not being mid-deletion;
+        - owned by someone else -> ignore.
+
+        Adoption/release write failures are narrowed to NotFound/Conflict
+        (the pod moved under us — skip this sync, the watch re-enqueues);
+        real API errors propagate to the rate-limited queue."""
+        from ..cluster.base import Conflict, NotFound
+        from .control import owner_ref_for
+
+        selector = job_selector(job)
+        # List at OPERATOR scope (group-name only), claim per-pod: a pod we
+        # own whose job-name label was mutated away must still be seen here,
+        # or it could never be released (a full-selector list hides it).
+        pods = self.cluster.list_pods(
+            namespace=job.namespace,
+            labels={constants.LABEL_GROUP_NAME: constants.GROUP_NAME},
+        )
         out = []
         for pod in pods:
             ref = pod.metadata.controller_ref()
-            if ref is not None:
-                if ref.uid == job.metadata.uid:
-                    out.append(pod)
+            matches = all(
+                pod.metadata.labels.get(k) == v for k, v in selector.items()
+            )
+            if ref is not None and ref.uid == job.metadata.uid:
+                if not matches:
+                    self._release_pod(job, pod)
+                    continue
+                out.append(pod)
                 continue
-            # Orphan with matching labels: adopt (stamp our controller ref).
-            from .control import owner_ref_for
-
+            if ref is not None:
+                continue  # owned by another controller
+            if not matches or job.metadata.deletion_timestamp is not None:
+                continue
+            # Uncached recheck before adopting (reference util/client.go
+            # delegating reader): the job must still exist with our UID.
+            try:
+                live = self.cluster.get_job(job.kind, job.namespace, job.name)
+            except NotFound:
+                continue
+            if (live.get("metadata") or {}).get("uid") != job.metadata.uid:
+                continue
             pod.metadata.owner_references.append(owner_ref_for(job))
             try:
                 pod = self.cluster.update_pod(pod)
-            except Exception:
+            except (NotFound, Conflict):
                 continue
             out.append(pod)
         return out
+
+    def _release_pod(self, job: JobObject, pod: Pod) -> None:
+        """Remove our controllerRef from a pod whose labels stopped matching
+        (reference ReleasePods): re-read live first so a cache-stale view
+        never drives the patch, and confirm the UID is the pod we saw."""
+        from ..cluster.base import Conflict, NotFound
+
+        try:
+            live = self.cluster.get_pod(pod.metadata.namespace, pod.metadata.name)
+        except NotFound:
+            return
+        if live.metadata.uid != pod.metadata.uid:
+            return
+        live.metadata.owner_references = [
+            r for r in live.metadata.owner_references if r.uid != job.metadata.uid
+        ]
+        try:
+            self.cluster.update_pod(live)
+        except (NotFound, Conflict):
+            pass  # pod changed/vanished concurrently; next sync re-evaluates
 
     def get_services_for_job(self, job: JobObject) -> List[Service]:
         services = self.cluster.list_services(namespace=job.namespace, labels=job_selector(job))
@@ -722,13 +828,43 @@ class JobController:
     def _sync_pod_group(self, job: JobObject, replicas: Dict[str, ReplicaSpec], run_policy) -> None:
         """Create the gang unit(s) (volcano PodGroup analog; reference
         SyncPodGroup via kubeflow/common when EnableGangScheduling). Groups
-        come from the hooks so the JAX controller can gang per slice."""
+        come from the hooks so the JAX controller can gang per slice.
+
+        Only NotFound triggers a create: a transient GET failure (500,
+        timeout) must NOT cause a blind create — it would race a live group
+        and mask the real error. Conflict on create (another worker won the
+        race) is fine. Anything else propagates to the rate-limited queue.
+
+        A gang sitting in the scheduler queue is surfaced as a Queued job
+        condition (observable backpressure — no reference counterpart; the
+        reference's PodGroup is fire-and-forget)."""
+        from ..cluster.base import Conflict, NotFound
+
+        queued_phases = []
         for group in self.hooks.gang_groups(job, replicas, run_policy):
             meta = group.get("metadata", {})
             try:
-                self.cluster.get_pod_group(meta.get("namespace", job.namespace), meta["name"])
-            except Exception:
-                self.cluster.create_pod_group(group)
+                live = self.cluster.get_pod_group(
+                    meta.get("namespace", job.namespace), meta["name"]
+                )
+            except NotFound:
+                try:
+                    self.cluster.create_pod_group(group)
+                except Conflict:
+                    pass  # concurrent creator; next sync reads it back
+                continue
+            phase = ((live.get("status") or {}).get("phase")) or ""
+            if phase in ("Pending", "Inqueue"):
+                queued_phases.append((meta.get("name", job.name), phase))
+        if queued_phases and not capi.is_running(job.status):
+            names = ", ".join(f"{n}={p}" for n, p in queued_phases)
+            capi.update_job_conditions(
+                job.status,
+                capi.JOB_QUEUED,
+                constants.job_reason(job.kind, constants.REASON_QUEUED),
+                f"gang(s) waiting for scheduler capacity: {names}",
+                now=self.clock(),
+            )
 
     # -------------------------------------------------------------- status
     def _write_status_if_changed(self, job: JobObject, old_status: JobStatus) -> None:

@@ -92,11 +92,21 @@ class ClusterLeaseLock:
         namespace: Optional[str] = None,
         name: str = "tf-operator-tpu-lock",
         clock=time.time,
+        mono=None,
     ):
         self.cluster = cluster
         self.namespace = namespace or _pod_namespace()
         self.name = name
         self._clock = clock
+        # Local observation/deadline timers run on the MONOTONIC clock: a
+        # wall-clock NTP step would otherwise age a freshly renewed lease
+        # past its duration and let a standby steal it (the same split-brain
+        # the renewTime-observation design exists to prevent). Wall clock is
+        # only for the wire-format renewTime. Tests injecting a fake clock
+        # get it for both, keeping time fully controlled.
+        self._mono = mono if mono is not None else (
+            time.monotonic if clock is time.time else clock
+        )
         # (holder, renewTime-raw) last seen + the LOCAL time we saw it
         # change: the basis for skew-free expiry.
         self._observed: Optional[Tuple[str, str]] = None
@@ -111,13 +121,14 @@ class ClusterLeaseLock:
         call: fresh create, own renewal, steal of an expired lease — or a
         still-inside-deadline hold across a transient apiserver error."""
         now = self._clock()
+        local = self._mono()
         try:
             lease = self.cluster.get_lease(self.namespace, self.name)
         except NotFound:
-            return self._create(identity, duration, now)
+            return self._create(identity, duration, now, local)
         except Exception:
             log.warning("lease get failed", exc_info=True)
-            return self._survives_error(now)
+            return self._survives_error(local)
 
         spec = lease.setdefault("spec", {})
         holder = spec.get("holderIdentity")
@@ -130,8 +141,8 @@ class ClusterLeaseLock:
             # full duration on OUR clock is stealable.
             if self._observed != (holder, renew_raw):
                 self._observed = (holder, renew_raw)
-                self._observed_at = now
-            if now < self._observed_at + held_duration:
+                self._observed_at = local
+            if local < self._observed_at + held_duration:
                 self._renew_ok_until = 0.0
                 return False
         if holder != identity:
@@ -151,16 +162,16 @@ class ClusterLeaseLock:
             return False
         except Exception:
             log.warning("lease update failed", exc_info=True)
-            return self._survives_error(now)
+            return self._survives_error(local)
         self._observed = (identity, spec["renewTime"])
-        self._observed_at = now
-        self._renew_ok_until = now + duration * _RENEW_DEADLINE_FRACTION
+        self._observed_at = local
+        self._renew_ok_until = local + duration * _RENEW_DEADLINE_FRACTION
         return True
 
-    def _survives_error(self, now: float) -> bool:
+    def _survives_error(self, local: float) -> bool:
         """Transient-error policy: keep leading inside the renew deadline,
         abdicate after (the live lease still blocks standbys meanwhile)."""
-        return now < self._renew_ok_until
+        return local < self._renew_ok_until
 
     def release(self, identity: str) -> None:
         """Voluntary handoff on clean shutdown (reference ReleaseOnCancel):
@@ -197,7 +208,9 @@ class ClusterLeaseLock:
         return spec.get("holderIdentity") or None
 
     # ------------------------------------------------------------ internals
-    def _create(self, identity: str, duration: float, now: float) -> bool:
+    def _create(self, identity: str, duration: float, now: float,
+                local: Optional[float] = None) -> bool:
+        local = self._mono() if local is None else local
         lease = {
             "apiVersion": "coordination.k8s.io/v1",
             "kind": "Lease",
@@ -216,8 +229,8 @@ class ClusterLeaseLock:
             return False  # another replica created it first
         except Exception:
             log.warning("lease create failed", exc_info=True)
-            return self._survives_error(now)
+            return self._survives_error(local)
         self._observed = (identity, lease["spec"]["renewTime"])
-        self._observed_at = now
-        self._renew_ok_until = now + duration * _RENEW_DEADLINE_FRACTION
+        self._observed_at = local
+        self._renew_ok_until = local + duration * _RENEW_DEADLINE_FRACTION
         return True
