@@ -466,6 +466,47 @@ class TestGraftEntry:
 
         __graft_entry__.dryrun_multichip(8)
 
+    def test_llama2_7b_v5e32_aot_readiness(self):
+        """7B-scale readiness without a pod (VERDICT r1 #9): the flagship
+        llama-2-7b config (layer count scaled down — per-layer shapes, and
+        therefore shardings, are depth-independent under nn.scan) lowers
+        and compiles through make_train_step on an 8-way FSDP mesh shaped
+        like one v5e-32 host row, with params actually sharded: per-device
+        argument bytes must be ~1/8 of the full state."""
+        from tf_operator_tpu.train.train_step import (
+            init_train_state,
+            make_optimizer,
+            make_train_step,
+        )
+
+        config = dataclasses.replace(llama.CONFIGS["llama2-7b"], n_layers=2)
+        model = llama.Llama(config)
+        optimizer = make_optimizer(warmup_steps=1, decay_steps=10)
+        mesh = standard_mesh(8)
+        state = init_train_state(
+            model, jax.random.PRNGKey(0), optimizer, batch=1, seq=64
+        )
+        step_fn, sharding = make_train_step(model, optimizer, mesh, state)
+        tokens = jnp.zeros((8, 65), jnp.int32)
+        compiled = step_fn.lower(state, tokens).compile()
+
+        # Total state: params bf16 + adam mu/nu fp32 ≈ 10 bytes/param.
+        n_params = sum(x.size for x in jax.tree.leaves(state.params))
+        state_bytes = sum(
+            x.size * x.dtype.itemsize for x in jax.tree.leaves(state)
+        )
+        mem = compiled.memory_analysis()
+        per_device_args = mem.argument_size_in_bytes
+        # Full-depth config is 7B-scale; the 2-layer stand-in still carries
+        # the full per-layer/embedding shapes (what sharding compiles over).
+        assert llama.CONFIGS["llama2-7b"].param_count() > 6e9
+        assert n_params > 5e8
+        # Sharded: within 20% of state/8 (norm scales replicate; tokens tiny).
+        assert per_device_args < state_bytes / 8 * 1.2, (
+            f"args {per_device_args/1e9:.2f}GB vs state/8 "
+            f"{state_bytes/8/1e9:.2f}GB — params not actually sharded"
+        )
+
     def test_dryrun_multichip_reshard_clean(self):
         """Regression guard: the sharded train step must compile with ZERO
         SPMD involuntary-full-rematerialization warnings on every mesh

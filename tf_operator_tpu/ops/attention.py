@@ -50,13 +50,29 @@ def _on_tpu() -> bool:
         return False
 
 
+@functools.cache
+def _flash_blocks() -> tuple:
+    """Kernel block sizes, env-overridable for tuning sweeps
+    (TF_OPERATOR_FLASH_BLOCK_Q/K). Defaults chosen by measurement on v5e
+    (llama-400m, seq 2048): see BASELINE.md perf notes."""
+    import os
+
+    return (
+        int(os.environ.get("TF_OPERATOR_FLASH_BLOCK_Q", "1024")),
+        int(os.environ.get("TF_OPERATOR_FLASH_BLOCK_K", "1024")),
+    )
+
+
 def flash_attention(q, k, v, causal: bool = True):
     """Dispatch: Pallas TPU kernel when available, XLA fallback otherwise."""
     if _on_tpu():
         try:
             from .flash_pallas import flash_attention_pallas
 
-            return flash_attention_pallas(q, k, v, causal=causal)
+            block_q, block_k = _flash_blocks()
+            return flash_attention_pallas(
+                q, k, v, causal=causal, block_q=block_q, block_k=block_k
+            )
         except ImportError:
             pass
     return xla_attention(q, k, v, causal=causal)

@@ -89,7 +89,11 @@ def init_sharded_train_state(
     return state, sharding
 
 
-CE_CHUNK = 512  # sequence positions per lm-head/loss chunk
+import os
+
+# Sequence positions per lm-head/loss chunk (env-overridable for tuning
+# sweeps; default chosen by measurement on v5e — see BASELINE.md).
+CE_CHUNK = int(os.environ.get("TF_OPERATOR_CE_CHUNK", "512"))
 
 
 def chunked_cross_entropy(hidden, kernel, targets, chunk: int = CE_CHUNK,
@@ -144,13 +148,6 @@ def loss_fn(model, params, tokens):
     return loss + aux
 
 
-def train_step(model, optimizer, state: TrainState, tokens) -> tuple:
-    loss, grads = jax.value_and_grad(functools.partial(loss_fn, model))(state.params, tokens)
-    updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
-    params = optax.apply_updates(state.params, updates)
-    return TrainState(step=state.step + 1, params=params, opt_state=opt_state), loss
-
-
 def state_sharding(state: TrainState, mesh: Mesh) -> TrainState:
     """Shardings for the whole TrainState via one path-based map: optimizer
     moments (mu/nu) have the parameter's name in their tree path, so the same
@@ -170,20 +167,29 @@ def state_sharding(state: TrainState, mesh: Mesh) -> TrainState:
     return jax.tree_util.tree_map_with_path(leaf_sharding, state)
 
 
-def make_train_step(model, optimizer, mesh: Mesh, state: TrainState, sharding=None):
-    """jit the step over `mesh` with explicit in/out shardings, donating the
-    state so params/opt buffers update in place."""
+def make_train_step_for(custom_loss_fn, optimizer, mesh: Mesh, state: TrainState,
+                        sharding=None):
+    """Generic sharded step for ANY loss_fn(params, batch) -> scalar: jit
+    over `mesh` with explicit in/out shardings, state donated so params/opt
+    buffers update in place. The Llama path and the bench's BERT path both
+    ride this."""
     if sharding is None:
         sharding = state_sharding(state, mesh)
-    data = batch_sharding(mesh, with_sp=False)  # tokens: [batch, seq]
+    data = batch_sharding(mesh, with_sp=False)  # [batch, seq(+1)]
 
-    def stepper(state, tokens):
+    def stepper(state, batch):
         # Scope the mesh for trace-time consumers: sharding constraints in
         # MoE dispatch (`constrain`) and the ring-attention shard_map wrap.
         from ..parallel.mesh import use_mesh
 
         with use_mesh(mesh):
-            return train_step(model, optimizer, state, tokens)
+            loss, grads = jax.value_and_grad(custom_loss_fn)(state.params, batch)
+            updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            return (
+                TrainState(step=state.step + 1, params=params, opt_state=opt_state),
+                loss,
+            )
 
     step = jax.jit(
         stepper,
@@ -192,6 +198,13 @@ def make_train_step(model, optimizer, mesh: Mesh, state: TrainState, sharding=No
         donate_argnums=(0,),
     )
     return step, sharding
+
+
+def make_train_step(model, optimizer, mesh: Mesh, state: TrainState, sharding=None):
+    """jit the model LM step over `mesh` (see make_train_step_for)."""
+    return make_train_step_for(
+        functools.partial(loss_fn, model), optimizer, mesh, state, sharding
+    )
 
 
 def place_state(state: TrainState, sharding: TrainState) -> TrainState:
