@@ -1,0 +1,99 @@
+"""Keras MultiWorkerMirroredStrategy MNIST — reference parity with
+examples/tensorflow/distribution_strategy/keras-API/
+multi_worker_strategy-with-keras.py.
+
+The operator injects TF_CONFIG (bootstrap/tf_config.py) with every
+worker's stable headless-service DNS name; MultiWorkerMirroredStrategy
+reads it and runs collective all-reduce data parallelism. Checkpoints go
+through a per-worker temp dir so non-chief workers never race the chief's
+writes (the standard MWMS filepath dance).
+
+Run under the operator with `tf_job_mwms_keras.yaml`; standalone it trains
+single-worker.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def mnist_dataset(batch_size: int, synthetic: bool):
+    import numpy as np
+    import tensorflow as tf
+
+    if synthetic:
+        x = np.random.default_rng(0).random((2048, 28, 28), dtype=np.float32)
+        y = np.random.default_rng(1).integers(0, 10, size=(2048,))
+    else:
+        (x, y), _ = tf.keras.datasets.mnist.load_data()
+        x = (x / 255.0).astype("float32")
+    return (
+        tf.data.Dataset.from_tensor_slices((x, y))
+        .shuffle(len(x))
+        .repeat()
+        .batch(batch_size)
+    )
+
+
+def build_model():
+    import tensorflow as tf
+
+    return tf.keras.Sequential(
+        [
+            tf.keras.layers.Flatten(input_shape=(28, 28)),
+            tf.keras.layers.Dense(128, activation="relu"),
+            tf.keras.layers.Dense(10),
+        ]
+    )
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--steps-per-epoch", type=int, default=70)
+    parser.add_argument("--per-worker-batch", type=int, default=64)
+    parser.add_argument("--model-dir", default="/tmp/mwms-model")
+    parser.add_argument("--synthetic-data", action="store_true",
+                        help="skip the MNIST download (hermetic clusters)")
+    args = parser.parse_args()
+
+    import tensorflow as tf
+
+    tf_config = json.loads(os.environ.get("TF_CONFIG", "{}"))
+    n_workers = len(tf_config.get("cluster", {}).get("worker", [1]))
+
+    strategy = tf.distribute.MultiWorkerMirroredStrategy()
+    global_batch = args.per_worker_batch * n_workers
+    with strategy.scope():
+        model = build_model()
+        model.compile(
+            loss=tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True),
+            optimizer=tf.keras.optimizers.SGD(learning_rate=0.001),
+            metrics=["accuracy"],
+        )
+
+    model.fit(
+        mnist_dataset(global_batch, args.synthetic_data),
+        epochs=args.epochs,
+        steps_per_epoch=args.steps_per_epoch,
+    )
+
+    # Chief writes the real model dir; workers write (and discard) temp
+    # dirs — everyone must call save() because it is a collective op.
+    task = tf_config.get("task", {})
+    is_chief = task.get("type") in (None, "chief") or (
+        task.get("type") == "worker" and task.get("index") == 0
+        and "chief" not in tf_config.get("cluster", {})
+    )
+    path = args.model_dir if is_chief else os.path.join(
+        args.model_dir, f"worker-tmp-{task.get('index', 0)}"
+    )
+    model.save(os.path.join(path, "model.keras"))
+    print("saved:", path, "chief:", is_chief)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

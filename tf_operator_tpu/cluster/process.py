@@ -69,6 +69,7 @@ _HOST_ENV_VARS = {
     "WORKER_ADDRS",
     "TPU_WORKER_HOSTNAMES",
     "MEGASCALE_COORDINATOR_ADDRESS",
+    "MX_CONFIG",  # JSON: cluster urls are bare generated names
 }
 
 
@@ -144,6 +145,13 @@ class LocalProcessCluster(InMemoryCluster):
                 )
         if not allow_bare:
             return value
+        if value.lstrip().startswith("{"):
+            # JSON payload (MX_CONFIG): bare generated-name-shaped hosts sit
+            # inside quoted "url" strings, possibly before their service
+            # object exists — rewrite them in place with word boundaries.
+            return _BARE_NAME_RE.sub(
+                lambda m: self._mapped_ip_locked(m.group(0), namespace), value
+            )
         # Host-carrying env vars (c10d/DMLC/Rabit contracts emit
         # "<job>-<type>-<idx>" relying on the namespace DNS search path —
         # reference pytorch.go:46-53): rewrite generated-name-shaped items

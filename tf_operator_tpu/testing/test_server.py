@@ -54,6 +54,16 @@ def _own_address() -> tuple:
         if entry:
             host, port = entry.rsplit(":", 1)
             return host, int(port)
+    # MXJob path: MX_CONFIG carries {cluster: {type: [{url, port}]}, task}.
+    raw = os.environ.get("MX_CONFIG")
+    if raw:
+        cfg = json.loads(raw)
+        task = cfg.get("task", {})
+        entries = (cfg.get("cluster") or {}).get(task.get("type", ""), [])
+        tindex = int(task.get("index", 0))
+        if tindex < len(entries):
+            entry = entries[tindex]
+            return entry["url"], int(entry["port"])
     # JAXJob path: every worker listens on its own slice hostname at the
     # coordinator port (worker-0's IS the coordinator address).
     coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
@@ -123,7 +133,10 @@ class Handler(BaseHTTPRequestHandler):
                 {
                     k: v
                     for k, v in os.environ.items()
-                    if k.startswith(("JAX_", "TPU_", "MEGASCALE_", "TF_CONFIG"))
+                    if k.startswith(
+                        ("JAX_", "TPU_", "MEGASCALE_", "TF_CONFIG",
+                         "DMLC_", "MX_CONFIG", "MASTER_", "WORLD_SIZE", "RANK")
+                    )
                 }
             )
         elif url.path == "/healthz":
