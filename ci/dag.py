@@ -209,4 +209,15 @@ def default_dag() -> List[Step]:
         Step("parallelism", pytest + ["tests/test_pipeline.py"], deps=["workload"]),
         Step("native", pytest + ["tests/test_native_dataloader.py"], deps=["build"]),
         Step("examples", pytest + ["tests/test_examples.py"], deps=["workload"]),
+        # Release tier (reference py/release.py exercised by release_test.py):
+        # the bundle must regenerate + assemble cleanly on every change.
+        Step("release-bundle", [PY, "scripts/release.py", "--version", "v0.0.0-ci",
+                                "--outdir", "/tmp/ci-dist"], deps=["build"]),
+        # Production-path smoke: the real operator over REST + leader
+        # election against the stub apiserver (tests/test_leader_election.py
+        # drives two replicas end-to-end).
+        Step("kube-smoke", pytest + ["tests/test_kube_cluster.py",
+                                     "tests/test_leader_election.py",
+                                     "tests/test_gang_and_claims.py"],
+             deps=["operator-integration"]),
     ]

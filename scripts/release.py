@@ -1,0 +1,128 @@
+#!/usr/bin/env python3
+"""Release tooling: version stamp -> manifest bundle -> operator image.
+
+The analog of the reference's py/release.py + py/build_and_push_image.py,
+minus their Prow/GCB coupling: one self-contained script that
+
+1. stamps a version (git describe, or --version),
+2. regenerates manifests from the API dataclasses and bundles them into a
+   single apply-able YAML (dist/tf-operator-tpu-<version>.yaml) with the
+   image pinned to the versioned tag,
+3. builds the operator image when a container tool is available
+   (docker/podman; skipped with a note otherwise — CI images often have
+   no daemon), optionally pushing with --push,
+4. writes sha256 checksums next to the artifacts.
+
+Usage:
+  python scripts/release.py                    # bundle only, auto version
+  python scripts/release.py --version v1.3.0 --image-repo ghcr.io/x/tf-operator-tpu
+  python scripts/release.py --build --push
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import os
+import shutil
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def git_version() -> str:
+    try:
+        out = subprocess.run(
+            ["git", "describe", "--tags", "--always", "--dirty"],
+            cwd=REPO, capture_output=True, text=True, check=True,
+        ).stdout.strip()
+        return out or "v0.0.0-dev"
+    except Exception:
+        return "v0.0.0-dev"
+
+
+def bundle_manifests(version: str, image: str, outdir: str) -> str:
+    """One apply-able YAML: CRDs first (the operator's preflight needs
+    them registered), then the operator stack with the pinned image."""
+    import yaml
+
+    from tf_operator_tpu.manifests.gen import generate_all
+
+    docs = []
+    generated = generate_all()
+    for name in sorted(generated):
+        if name.startswith("crds/"):
+            docs.extend(generated[name])
+    for doc in generated["operator"]:
+        if doc.get("kind") == "Deployment":
+            for container in doc["spec"]["template"]["spec"]["containers"]:
+                container["image"] = image
+            meta = doc.setdefault("metadata", {})
+            meta.setdefault("labels", {})["app.kubernetes.io/version"] = version
+        docs.append(doc)
+    path = os.path.join(outdir, f"tf-operator-tpu-{version}.yaml")
+    with open(path, "w") as f:
+        f.write(f"# tf-operator-tpu {version}\n")
+        yaml.safe_dump_all(docs, f, sort_keys=False)
+    return path
+
+
+def container_tool() -> str:
+    for tool in ("docker", "podman"):
+        if shutil.which(tool):
+            return tool
+    return ""
+
+
+def build_image(image: str, push: bool) -> bool:
+    tool = container_tool()
+    if not tool:
+        print("NOTE: no docker/podman on PATH — image build skipped")
+        return False
+    dockerfile = os.path.join(REPO, "build/images/tf-operator-tpu/Dockerfile")
+    subprocess.run(
+        [tool, "build", "-f", dockerfile, "-t", image, REPO], check=True
+    )
+    if push:
+        subprocess.run([tool, "push", image], check=True)
+    return True
+
+
+def checksum(path: str) -> str:
+    digest = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            digest.update(chunk)
+    out = f"{path}.sha256"
+    with open(out, "w") as f:
+        f.write(f"{digest.hexdigest()}  {os.path.basename(path)}\n")
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--version", default=None, help="default: git describe")
+    parser.add_argument("--image-repo", default="tf-operator-tpu")
+    parser.add_argument("--outdir", default=os.path.join(REPO, "dist"))
+    parser.add_argument("--build", action="store_true", help="build the operator image")
+    parser.add_argument("--push", action="store_true", help="push after building")
+    args = parser.parse_args(argv)
+
+    version = args.version or git_version()
+    image = f"{args.image_repo}:{version}"
+    os.makedirs(args.outdir, exist_ok=True)
+
+    bundle = bundle_manifests(version, image, args.outdir)
+    print("bundle:", bundle)
+    print("checksum:", checksum(bundle))
+    if args.build:
+        if build_image(image, args.push):
+            print("image:", image, "(pushed)" if args.push else "")
+    print(f"release {version} done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

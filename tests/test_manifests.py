@@ -126,7 +126,9 @@ class TestOperatorManifests:
         docs = operator_manifests()
         deploy = next(d for d in docs if d["kind"] == "Deployment")
         container = deploy["spec"]["template"]["spec"]["containers"][0]
-        assert container["command"] == ["python", "-m", "tf_operator_tpu"]
+        assert container["command"] == [
+            "python", "-m", "tf_operator_tpu", "--kube", "--leader-elect",
+        ]  # in-cluster: real apiserver + Lease election (2 replicas)
         assert container["livenessProbe"]["httpGet"]["path"] == "/healthz"
         assert container["readinessProbe"]["httpGet"]["path"] == "/readyz"
 
