@@ -128,6 +128,10 @@ class JobStatus:
 
     conditions: List[JobCondition] = field(default_factory=list)
     replica_statuses: Dict[str, ReplicaStatus] = field(default_factory=dict)
+    # Operator-initiated restarts per replica type (policy ExitCode deletes
+    # + recreates pods, so kubelet restartCounts never see them; backoffLimit
+    # must still count them — persisted here across pod generations).
+    restart_counts: Dict[str, int] = field(default_factory=dict)
     start_time: Optional[float] = None
     completion_time: Optional[float] = None
     last_reconcile_time: Optional[float] = None
@@ -181,6 +185,16 @@ def update_job_conditions(
     semantics as exercised by the reference's status_test.go.
     """
     now = time.time() if now is None else now
+    # One terminal verdict per job: the first of Succeeded/Failed to land
+    # wins and the other can never overwrite it in a later (or even the
+    # same) sync — e.g. a chief's success and a straggler worker's failure
+    # observed together must resolve by replica-type precedence, not
+    # last-writer-wins (reference fixed iteration order,
+    # tfjob_controller.go:385-501).
+    if cond_type == JOB_FAILED and has_condition(status, JOB_SUCCEEDED):
+        return
+    if cond_type == JOB_SUCCEEDED and has_condition(status, JOB_FAILED):
+        return
     new_cond = JobCondition(
         type=cond_type,
         status=CONDITION_TRUE,

@@ -404,6 +404,12 @@ class JobController:
             suspended.last_transition_time = now
             suspended.last_update_time = now
             job.status.start_time = None
+            # Fresh lifecycle window = fresh restart budget too: kubelet
+            # counters reset with the recreated pods (reference behavior),
+            # so the durable ExitCode counter must reset alongside or
+            # pre-suspension restarts would eat the resumed job's
+            # backoffLimit.
+            job.status.restart_counts = {}
             capi.update_job_conditions(
                 job.status,
                 capi.JOB_CREATED,
@@ -575,6 +581,12 @@ class JobController:
                     now=self.clock(),
                 )
                 job_status._restarting_this_sync = True
+                # Durable restart accounting: the deleted pod's kubelet
+                # counter dies with it, but backoffLimit must see the
+                # restart (checked at the next sync's run-policy gate).
+                job_status.restart_counts[rtype] = (
+                    job_status.restart_counts.get(rtype, 0) + 1
+                )
                 self.on_job_restarting(job, rtype)
 
             update_job_replica_statuses(job_status, rtype, pod)
@@ -717,11 +729,14 @@ class JobController:
     def _past_backoff_limit(
         self, job: JobObject, run_policy, replicas: Dict[str, ReplicaSpec], pods: List[Pod]
     ) -> bool:
-        """Sum container restart counts of live pods for restartable replica
-        types (kubeflow/common PastBackoffLimit semantics)."""
+        """Total restarts across both restart mechanisms (kubeflow/common
+        PastBackoffLimit, extended): kubelet container restartCounts for
+        OnFailure/Always replicas, plus the job's durable
+        status.restartCounts for operator-managed ExitCode restarts (whose
+        recreated pods always report kubelet count 0)."""
         if run_policy.backoff_limit is None:
             return False
-        restarts = 0
+        restarts = sum(job.status.restart_counts.values())
         for rtype, spec in replicas.items():
             if spec.restart_policy not in (
                 capi.RESTART_POLICY_ON_FAILURE,
