@@ -220,4 +220,8 @@ def default_dag() -> List[Step]:
                                      "tests/test_leader_election.py",
                                      "tests/test_gang_and_claims.py"],
              deps=["operator-integration"]),
+        # Race coverage (SURVEY §5.2): threaded workers + chaos under an
+        # aggressive resync; retried because timing-sensitive by nature.
+        Step("concurrency-stress", pytest + ["tests/test_concurrency_stress.py"],
+             deps=["operator-integration"], retries=2),
     ]

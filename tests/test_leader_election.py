@@ -307,6 +307,33 @@ class TestInformerWatchSemantics:
         finally:
             kube.shutdown()
 
+    def test_get_job_cached_but_uncached_read_is_live(self, stub):
+        """get_job serves the informer store once primed (reconciles cost
+        zero live reads), but get_job_uncached MUST bypass it — the
+        adoption UID recheck depends on seeing a delete+recreate the watch
+        hasn't delivered yet."""
+        kube = KubeCluster(base_url=stub.url, token="t")
+        try:
+            kube.create_job(tfjob("j"))
+            kube.watch("TFJob", lambda et, obj: None)
+            assert wait_until(lambda: kube._synced["TFJob"].is_set())
+            assert wait_until(
+                lambda: ("default", "j") in kube._stores.get("TFJob", {})
+            )
+            # Freeze the watch loops, then delete+recreate server-side: the
+            # cache is now authentically stale.
+            kube._stop.set()
+            kube._force_reconnect()
+            time.sleep(0.2)
+            old_uid = kube.get_job("TFJob", "default", "j")["metadata"]["uid"]
+            stub.mem.delete_job("TFJob", "default", "j")
+            stub.mem.create_job(tfjob("j"))
+            assert kube.get_job("TFJob", "default", "j")["metadata"]["uid"] == old_uid
+            live_uid = kube.get_job_uncached("TFJob", "default", "j")["metadata"]["uid"]
+            assert live_uid != old_uid
+        finally:
+            kube.shutdown()
+
     def test_list_pods_served_from_cache(self, stub):
         """Once the pod watch is primed, reconcile relists cost zero
         apiserver round-trips (informer-cache reads, SURVEY §3.2)."""

@@ -46,6 +46,13 @@ class Cluster:
     def get_job(self, kind: str, namespace: str, name: str) -> dict:
         raise NotImplementedError
 
+    def get_job_uncached(self, kind: str, namespace: str, name: str) -> dict:
+        """Authoritative read that MUST bypass any informer cache (the
+        reference's delegating uncached reader, pkg/common/util/client.go):
+        the adoption UID recheck depends on it. Backends whose get_job is
+        already authoritative (memory/process) inherit this default."""
+        return self.get_job(kind, namespace, name)
+
     def list_jobs(self, kind: str, namespace: Optional[str] = None) -> List[dict]:
         raise NotImplementedError
 

@@ -244,7 +244,23 @@ class KubeCluster(Cluster):
         )
 
     def get_job(self, kind: str, namespace: str, name: str) -> dict:
+        """Cache-served once the kind's watch is primed (the reference syncs
+        from the informer lister, tfjob_controller.go:222-235): a reconcile
+        then costs zero live reads. Store misses fall back to a live GET —
+        never a synthesized 404 from a cold cache."""
+        synced = self._synced.get(kind)
+        if synced is not None and synced.is_set():
+            with self._informer_lock:
+                entry = self._stores.get(kind, {}).get((namespace, name))
+            if entry is not None:
+                return json.loads(json.dumps(entry[1]))  # caller-safe copy
+        return self._get_job_live(kind, namespace, name)
+
+    def _get_job_live(self, kind: str, namespace: str, name: str) -> dict:
         return _normalize_times(self._request("GET", self._job_path(kind, namespace, name)))
+
+    def get_job_uncached(self, kind: str, namespace: str, name: str) -> dict:
+        return self._get_job_live(kind, namespace, name)
 
     def list_jobs(self, kind: str, namespace: Optional[str] = None) -> List[dict]:
         store = self._store_list(kind, namespace)
@@ -270,8 +286,10 @@ class KubeCluster(Cluster):
         # reset on resume) must actually clear — a merge-patch would keep
         # any key to_dict omitted as None, silently resurrecting stale
         # values on the server. Read-modify-write with the current rv;
-        # Conflict propagates and the workqueue retries.
-        job = self.get_job(kind, namespace, name)
+        # Conflict propagates and the workqueue retries. The read MUST be
+        # live: a cache-served (possibly stale) resourceVersion would turn
+        # every status write into a conflict until the watch caught up.
+        job = self._get_job_live(kind, namespace, name)
         job["status"] = status
         return self._request(
             "PUT", self._job_path(kind, namespace, name) + "/status", job

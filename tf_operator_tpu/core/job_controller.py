@@ -310,8 +310,11 @@ class JobController:
                 continue
             # Uncached recheck before adopting (reference util/client.go
             # delegating reader): the job must still exist with our UID.
+            # get_job_uncached bypasses the informer cache — a cached read
+            # would defeat the recheck exactly when it matters (job deleted
+            # and recreated before the watch delivers the events).
             try:
-                live = self.cluster.get_job(job.kind, job.namespace, job.name)
+                live = self.cluster.get_job_uncached(job.kind, job.namespace, job.name)
             except NotFound:
                 continue
             if (live.get("metadata") or {}).get("uid") != job.metadata.uid:
