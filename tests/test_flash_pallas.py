@@ -176,3 +176,110 @@ class TestRopePallas:
         kernel = functools.partial(rope_pallas, interpret=True)
         back = kernel(kernel(x, cos, sin), cos, -sin)
         np.testing.assert_allclose(np.asarray(back), np.asarray(x), atol=1e-5)
+
+
+class TestFlashWithLse:
+    """flash_attention_with_lse: the (o, lse) building block for ring/
+    blockwise composition — both outputs must match the reference AND be
+    differentiable (the combine weights carry lse cotangents through the
+    delta-folding in _flash_backward)."""
+
+    @staticmethod
+    def _reference_with_lse(q, k, v, causal):
+        from tf_operator_tpu.ops.attention import NEG_INF, _repeat_kv
+
+        k, v = _repeat_kv(q, k, v)
+        d = q.shape[-1]
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(float(d))
+        if causal:
+            s_q, s_k = q.shape[1], k.shape[1]
+            mask = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+            scores = jnp.where(mask, scores, NEG_INF)
+        lse = jax.nn.logsumexp(scores, axis=-1)  # [b,h,q]
+        p = jnp.exp(scores - lse[..., None])
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v), lse
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_forward_o_and_lse(self, causal):
+        from tf_operator_tpu.ops.flash_pallas import flash_attention_with_lse
+
+        q, k, v = rand_qkv(jax.random.PRNGKey(3), 1, 128, 4, 4, 64)
+        o, lse = flash_attention_with_lse(
+            q, k, v, causal=causal, block_q=64, block_k=64, interpret=True
+        )
+        ref_o, ref_lse = self._reference_with_lse(q, k, v, causal)
+        np.testing.assert_allclose(o, ref_o, atol=2e-5, rtol=2e-5)
+        np.testing.assert_allclose(lse, ref_lse, atol=2e-5, rtol=2e-5)
+
+    def test_gradients_including_lse_cotangent(self):
+        """Loss touching BOTH o and lse: dq/dk/dv must match the einsum
+        reference — this exercises the dS += p*dlse fold."""
+        from tf_operator_tpu.ops.flash_pallas import flash_attention_with_lse
+
+        q, k, v = rand_qkv(jax.random.PRNGKey(4), 1, 64, 2, 2, 32)
+
+        def loss_flash(q, k, v):
+            o, lse = flash_attention_with_lse(
+                q, k, v, causal=True, block_q=32, block_k=32, interpret=True
+            )
+            return (o**2).sum() + (lse * jnp.sin(lse)).sum()
+
+        def loss_ref(q, k, v):
+            o, lse = self._reference_with_lse(q, k, v, True)
+            return (o**2).sum() + (lse * jnp.sin(lse)).sum()
+
+        got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for g, r, name in zip(got, ref, "qkv"):
+            np.testing.assert_allclose(
+                g, r, atol=5e-4, rtol=5e-4,
+                err_msg=f"d{name} mismatch (lse-cotangent path)",
+            )
+
+
+class TestRingWithFlashBlocks:
+    def test_ring_flash_interpret_matches_reference(self):
+        """The TPU ring path (per-block Pallas flash + lse combine), run in
+        interpret mode on the CPU mesh, must equal full causal attention —
+        fwd AND grad (the combine's lse algebra is differentiable)."""
+        from functools import partial
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from tf_operator_tpu.parallel.mesh import standard_mesh
+        from tf_operator_tpu.ops.ring_attention import ring_attention
+
+        mesh = standard_mesh(8, sp=4)
+        b, s, h, d = 1, 64, 2, 16
+        rng = np.random.default_rng(5)
+        q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+
+        spec = P(None, "sp", None, None)
+        # check_vma=False: the Pallas INTERPRETER (CPU stand-in for the TPU
+        # kernel) does not propagate varying-mesh-axes through its internal
+        # dynamic slices; the compiled TPU path needs no such relaxation.
+        ring = jax.jit(shard_map(
+            partial(ring_attention, axis_name="sp",
+                    block_impl="flash_interpret"),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        ))
+        expected = xla_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(ring(q, k, v)), np.asarray(expected), atol=2e-5
+        )
+
+        got_grads = jax.grad(lambda *a: (ring(*a) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+        ref_grads = jax.grad(
+            lambda *a: (xla_attention(*a, causal=True) ** 2).sum(), argnums=(0, 1, 2)
+        )(q, k, v)
+        for g, r, name in zip(got_grads, ref_grads, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(r), atol=5e-4,
+                err_msg=f"ring d{name} mismatch",
+            )

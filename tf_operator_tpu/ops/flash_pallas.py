@@ -57,6 +57,21 @@ MASK_VALUE = -1e30
 _LANES = 128
 
 
+def _sds_like(x, shape=None, dtype=None):
+    """ShapeDtypeStruct inheriting `x`'s varying-mesh-axes (vma): inside a
+    shard_map region (ring attention) pallas_call outputs must declare how
+    they vary across the manual axes or tracing rejects them."""
+    shape = x.shape if shape is None else shape
+    dtype = x.dtype if dtype is None else dtype
+    try:
+        vma = jax.typeof(x).vma
+    except Exception:  # older jax / concrete arrays: no vma concept
+        vma = None
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _block_size(want: int, total: int) -> int:
     size = min(want, total)
     while total % size:
@@ -165,8 +180,8 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     o, lse = pl.pallas_call(
         kernel,
         out_shape=(
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((batch, heads, s_q, 1), jnp.float32),
+            _sds_like(q),
+            _sds_like(q, (batch, heads, s_q, 1), jnp.float32),
         ),
         grid=grid,
         in_specs=[
@@ -246,7 +261,10 @@ def _dq_kernel(
             col = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(row >= col, s, MASK_VALUE)
         p = jnp.exp(s - lse)
-        # dP = dO Vᵀ; dS = P ∘ (dP - delta); dQ += scale · dS K
+        # dP = dO Vᵀ; dS = P ∘ (dP - delta); dQ += scale · dS K.
+        # `delta` arrives as rowsum(dO∘O) - dLSE: ∂lse/∂s_j = p_j, so a
+        # cotangent on lse adds p∘dlse to dS — folded into the same
+        # per-row subtrahend (zero dlse for the plain attention API).
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -341,7 +359,8 @@ def _dkv_kernel(
         dv_ref[0, 0, :, :] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
-def _flash_backward(causal, block_q, block_k, interpret, residuals, do):
+def _flash_backward(causal, block_q, block_k, interpret, residuals, do,
+                    dlse=None):
     q, k, v, o, lse = residuals  # all BHSD / [b,h,s,1]
     batch, heads, s_q, head_dim = q.shape
     _, kv_heads, s_k, _ = k.shape
@@ -354,12 +373,17 @@ def _flash_backward(causal, block_q, block_k, interpret, residuals, do):
     num_k_blocks = s_k // block_k
 
     # delta_i = Σ_d dO ∘ O — one fused XLA elementwise pass, [b, h, s, 1].
+    # A cotangent on lse (flash_attention_with_lse consumers: the ring's
+    # log-sum-exp combine) folds in here: dS = p∘(dP - delta + dlse), so
+    # delta := rowsum(dO∘O) - dlse reuses the kernels unchanged.
     delta = jnp.einsum(
         "bhsd,bhsd->bhs",
         do.astype(jnp.float32),
         o.astype(jnp.float32),
         preferred_element_type=jnp.float32,
     )[..., None]
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
 
     dq = pl.pallas_call(
         functools.partial(
@@ -370,7 +394,7 @@ def _flash_backward(causal, block_q, block_k, interpret, residuals, do):
             block_k=block_k,
             num_k_blocks=num_k_blocks,
         ),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=_sds_like(q),
         grid=(batch, heads, num_q_blocks, num_k_blocks),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, qi, ki: (b, h, qi, 0)),
@@ -405,8 +429,8 @@ def _flash_backward(causal, block_q, block_k, interpret, residuals, do):
             num_q_blocks=num_q_blocks,
         ),
         out_shape=(
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            _sds_like(k),
+            _sds_like(v),
         ),
         grid=(batch, kv_heads, num_k_blocks, groups, num_q_blocks),
         in_specs=[
@@ -480,6 +504,59 @@ def _flash_attention_fwd(q, k, v, causal, block_q, block_k, interpret):
 
 
 _flash_attention.defvjp(_flash_attention_fwd, _flash_backward)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention_lse(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_attention_lse_fwd(q, k, v, causal, block_q, block_k, interpret):
+    o, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    # Same residual naming as the plain variant: under the model's
+    # dots+names remat policy these are checkpointed, so the backward
+    # replay never re-runs the forward kernel — per RING STEP here, so the
+    # saving multiplies by the ring size.
+    from jax.ad_checkpoint import checkpoint_name
+
+    o = checkpoint_name(o, "flash_o")
+    lse = checkpoint_name(lse, "flash_lse")
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_attention_lse_bwd(causal, block_q, block_k, interpret, residuals,
+                             cotangents):
+    do, dlse = cotangents
+    return _flash_backward(causal, block_q, block_k, interpret, residuals,
+                           do, dlse=dlse)
+
+
+_flash_attention_lse.defvjp(_flash_attention_lse_fwd, _flash_attention_lse_bwd)
+
+
+def flash_attention_with_lse(q, k, v, causal: bool = True,
+                             block_q: int = 1024, block_k: int = 1024,
+                             interpret: bool = False):
+    """BSHD flash attention that also returns the per-row logsumexp
+    ([b, h, s] fp32) and is differentiable in BOTH outputs — the building
+    block for blockwise/ring composition, where partial results merge via
+    log-sum-exp algebra and the combine weights carry lse gradients."""
+    batch, s_q, heads, head_dim = q.shape
+    _, s_k, kv_heads, _ = k.shape
+    if heads % kv_heads:
+        raise ValueError(f"{heads} query heads not divisible by {kv_heads} KV heads")
+    if causal and s_q != s_k:
+        raise ValueError("causal flash kernel requires s_q == s_k (self-attention)")
+    o, lse = _flash_attention_lse(
+        jnp.swapaxes(q, 1, 2),
+        jnp.swapaxes(k, 1, 2),
+        jnp.swapaxes(v, 1, 2),
+        causal,
+        block_q,
+        block_k,
+        interpret,
+    )
+    return jnp.swapaxes(o, 1, 2), lse[..., 0]
 
 
 @functools.partial(

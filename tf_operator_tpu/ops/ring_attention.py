@@ -2,10 +2,19 @@
 
 Each device holds a sequence shard [b, s_local, h, d] (the `sp` mesh axis).
 K/V blocks rotate around the ring via `ppermute` while every device
-accumulates its queries' attention with an online (flash-style) softmax —
-s_total never materializes on one chip, so context length scales with the
-ring size at constant per-device memory. Communication (neighbor ppermute)
+combines per-block attention results with log-sum-exp algebra — s_total
+never materializes on one chip, so context length scales with the ring
+size at constant per-device memory. Communication (neighbor ppermute)
 overlaps with the block compute; on TPU the permutes ride ICI.
+
+The per-block compute is the Pallas flash kernel on TPU
+(flash_attention_with_lse — O(s_local) memory inside the block, MXU
+matmuls, lse-differentiable for the combine weights), with a fused-XLA
+einsum fallback elsewhere. Block visibility is decided per ring step
+(`lax.switch`): blocks left of the diagonal are fully visible (no mask
+work), the diagonal block runs the causal kernel, blocks right of it are
+skipped entirely — the ring-level analog of the kernel's own
+block-skipping.
 
 Use under shard_map with the sequence axis mapped to `axis_name`:
 
@@ -23,20 +32,57 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .attention import NEG_INF, _repeat_kv, xla_attention
+from .attention import NEG_INF, _on_tpu, _repeat_kv, xla_attention
 
 
-def _block_scores(q, k, scale):
-    return jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+def _block_attn_xla(q, k_blk, v_blk, causal_mask):
+    """Fallback per-block attention -> (o [b,s,h,d] f32 normalized,
+    lse [b,h,s] f32). `causal_mask` [s_q, s_k] bool or None (= all
+    visible)."""
+    d = q.shape[-1]
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k_blk, preferred_element_type=jnp.float32
+    ) * (1.0 / d**0.5)
+    if causal_mask is not None:
+        scores = jnp.where(causal_mask[None, None], scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)  # [b,h,q]
+    safe_m = jnp.where(m == NEG_INF, 0.0, m)
+    p = jnp.exp(scores - safe_m[..., None])
+    if causal_mask is not None:
+        p = jnp.where(causal_mask[None, None], p, 0.0)
+    l = p.sum(axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk,
+                   preferred_element_type=jnp.float32)
+    l_safe = jnp.maximum(l, 1e-30)
+    o = o / l_safe.transpose(0, 2, 1)[..., None]
+    lse = jnp.where(l > 0, safe_m + jnp.log(l_safe), NEG_INF)
+    return o, lse
 
 
-def ring_attention(q, k, v, axis_name: str = "sp", vary_axes=None):
+def _combine(o, lse, o_blk, lse_blk):
+    """Merge two normalized partials by log-sum-exp weights. -inf rows
+    (nothing visible yet / skipped block) contribute weight zero without
+    producing inf-inf NaNs."""
+    lse_new = jnp.logaddexp(lse, lse_blk)
+    safe = jnp.where(lse_new == NEG_INF, 0.0, lse_new)
+
+    def weight(x):
+        w = jnp.exp(jnp.where(x == NEG_INF, NEG_INF, x - safe))
+        return w.transpose(0, 2, 1)[..., None]  # [b,h,s] -> [b,s,h,1]
+
+    return o * weight(lse) + o_blk * weight(lse_blk), lse_new
+
+
+def ring_attention(q, k, v, axis_name: str = "sp", vary_axes=None,
+                   block_impl: str = "auto"):
     """Causal ring attention. q,k,v: [b, s_local, h(_kv), d] sequence shards,
     ordered by ring index (shard i holds global positions
     [i*s_local, (i+1)*s_local)). `vary_axes`: every manual (shard_map) axis
     in scope — the loop carry must be marked varying over all of them, not
     just the ring axis, or the fori_loop carry types mismatch. Defaults to
-    (axis_name,) for a shard_map mapping only the ring axis."""
+    (axis_name,) for a shard_map mapping only the ring axis.
+    `block_impl`: "auto" (flash kernel on TPU, einsum elsewhere), "xla",
+    "flash_interpret" (Pallas interpret mode — CPU numerics tests)."""
     try:
         axis_size = jax.lax.psum(1, axis_name)
     except NameError:
@@ -44,13 +90,36 @@ def ring_attention(q, k, v, axis_name: str = "sp", vary_axes=None):
 
     k, v = _repeat_kv(q, k, v)
     b, s, h, d = q.shape
-    scale = 1.0 / (d ** 0.5)
     my_idx = jax.lax.axis_index(axis_name)
-    q_pos = my_idx * s + jnp.arange(s)  # global positions of my queries
 
-    # Online softmax accumulators (fp32), marked as varying over the ring
-    # axis (loop-carry types must match the body outputs, which depend on
-    # the mapped q/k/v).
+    if block_impl == "auto":
+        block_impl = "flash" if _on_tpu() else "xla"
+    interpret = block_impl == "flash_interpret"
+    use_flash = block_impl in ("flash", "flash_interpret")
+
+    if use_flash:
+        from .flash_pallas import flash_attention_with_lse
+
+        def full_block(k_blk, v_blk):
+            o_blk, lse_blk = flash_attention_with_lse(
+                q, k_blk, v_blk, causal=False, interpret=interpret
+            )
+            return o_blk.astype(jnp.float32), lse_blk  # switch branches: one type
+
+        def diag_block(k_blk, v_blk):
+            o_blk, lse_blk = flash_attention_with_lse(
+                q, k_blk, v_blk, causal=True, interpret=interpret
+            )
+            return o_blk.astype(jnp.float32), lse_blk
+    else:
+        causal_mask = jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]
+
+        def full_block(k_blk, v_blk):
+            return _block_attn_xla(q, k_blk, v_blk, None)
+
+        def diag_block(k_blk, v_blk):
+            return _block_attn_xla(q, k_blk, v_blk, causal_mask)
+
     from ..parallel.mesh import mark_varying
 
     axes = tuple(vary_axes) if vary_axes else (axis_name,)
@@ -58,43 +127,39 @@ def ring_attention(q, k, v, axis_name: str = "sp", vary_axes=None):
     def pvary(x):
         return mark_varying(x, axes)
 
-    o0 = pvary(jnp.zeros((b, s, h, d), jnp.float32))
-    l0 = pvary(jnp.zeros((b, h, s), jnp.float32))
-    m0 = pvary(jnp.full((b, h, s), NEG_INF, jnp.float32))
+    def skip_block(k_blk, v_blk):
+        # Constants must still carry the manual-axes varying mark or the
+        # switch branches' output types disagree with the flash branches'.
+        return (
+            pvary(jnp.zeros((b, s, h, d), jnp.float32)),
+            pvary(jnp.full((b, h, s), NEG_INF, jnp.float32)),
+        )
 
+    o0 = pvary(jnp.zeros((b, s, h, d), jnp.float32))
+    lse0 = pvary(jnp.full((b, h, s), NEG_INF, jnp.float32))
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
 
     def body(i, carry):
-        o, l, m, k_blk, v_blk = carry
+        o, lse, k_blk, v_blk = carry
         # After i rotations each device holds the block that started at ring
         # position (my_idx - i) mod axis_size.
         kv_idx = (my_idx - i) % axis_size
-        kv_pos = kv_idx * s + jnp.arange(s)
-
-        scores = _block_scores(q, k_blk, scale)  # [b,h,q,k] fp32
-        causal = q_pos[:, None] >= kv_pos[None, :]
-        scores = jnp.where(causal[None, None], scores, NEG_INF)
-
-        m_blk = jnp.max(scores, axis=-1)  # [b,h,q]
-        m_new = jnp.maximum(m, m_blk)
-        # Fully-masked blocks produce -inf rows; keep the exp argument finite.
-        safe_m = jnp.where(m_new == NEG_INF, 0.0, m_new)
-        p = jnp.exp(scores - safe_m[..., None])
-        p = jnp.where(causal[None, None], p, 0.0)
-        corr = jnp.exp(jnp.where(m == NEG_INF, NEG_INF, m - safe_m))
-
-        l = l * corr + p.sum(axis=-1)
-        pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk,
-                        preferred_element_type=jnp.float32)
-        o = o * corr.transpose(0, 2, 1)[..., None] + pv
-
+        # 0 = fully visible (kv block strictly left of ours), 1 = diagonal
+        # (ours: causal), 2 = strictly right: invisible, skipped.
+        mode = jnp.where(kv_idx < my_idx, 0, jnp.where(kv_idx == my_idx, 1, 2))
+        o_blk_f, lse_blk = jax.lax.switch(
+            mode,
+            [full_block, diag_block, skip_block],
+            k_blk,
+            v_blk,
+        )
+        o, lse = _combine(o, lse, o_blk_f.astype(jnp.float32), lse_blk)
         k_next = jax.lax.ppermute(k_blk, axis_name, perm)
         v_next = jax.lax.ppermute(v_blk, axis_name, perm)
-        return o, l, m_new, k_next, v_next
+        return o, lse, k_next, v_next
 
-    o, l, m, _, _ = jax.lax.fori_loop(0, axis_size, body, (o0, l0, m0, k, v))
-    l = jnp.maximum(l, 1e-30)
-    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+    o, lse, _, _ = jax.lax.fori_loop(0, axis_size, body, (o0, lse0, k, v))
+    return o.astype(q.dtype)
 
 
 def sharded_ring_attention(q, k, v):

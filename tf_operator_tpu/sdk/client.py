@@ -107,10 +107,13 @@ class JobClient:
     def patch(self, name: str, patch: dict, namespace: str = "default") -> dict:
         """Strategic-merge-style patch of the spec (reference :150-183).
         Retries on write conflict (the GET-merge-PUT loop every k8s patch
-        client runs under optimistic concurrency)."""
+        client runs under optimistic concurrency). The read is the
+        AUTHORITATIVE one: on a cache-backed cluster (KubeCluster with
+        watches primed) a cached read would hand every retry the same stale
+        resourceVersion and the loop would exhaust on phantom conflicts."""
         last: Optional[Exception] = None
         for _ in range(5):
-            job = self.get(name, namespace)
+            job = self.cluster.get_job_uncached(self.kind, namespace, name)
             _merge_patch(job, patch)
             try:
                 return self.cluster.update_job(job)
@@ -154,7 +157,8 @@ class JobClient:
         raise last  # type: ignore[misc]
 
     def _scale_once(self, name: str, num_slices: int, namespace: str) -> dict:
-        job = self.get(name, namespace)
+        # Uncached read: same stale-resourceVersion hazard as patch().
+        job = self.cluster.get_job_uncached(self.kind, namespace, name)
         spec = job.get("spec", {})
         # `is None`, not truthiness: `elastic: {}` is a valid declaration
         # (all-default bounds) and the controller treats it as elastic.
