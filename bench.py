@@ -178,6 +178,78 @@ def bench_bert(config_name, batch, seq, steps, warmup, mesh, devices):
     }
 
 
+def _emit_error(stage: str, exc: BaseException) -> None:
+    """The driver parses our last stdout line as JSON; a traceback instead
+    of a line erased all of round 2's perf evidence (BENCH_r02 rc=1,
+    parsed=null). Whatever fails, the line gets printed."""
+    print(json.dumps({
+        "metric": "bench-error",
+        "value": 0,
+        "unit": "error",
+        "vs_baseline": 0,
+        "extra": {
+            "stage": stage,
+            "error": f"{type(exc).__name__}: {exc}"[:500],
+        },
+    }))
+
+
+class _BackendInitHang(RuntimeError):
+    """Backend init blocked past the deadline inside a C call (observed: the
+    TPU tunnel can *hang* rather than raise UNAVAILABLE). The probe thread
+    cannot be interrupted; the caller must os._exit after reporting."""
+
+
+def _init_devices(total_timeout: float = 180.0):
+    """jax.devices() with retry/backoff in a watchdog thread.
+
+    Two observed failure modes of the remote TPU backend at capture time:
+    raising UNAVAILABLE (round 2 — jax then caches the *failure*, so each
+    retry clears the backend cache first), and hanging indefinitely inside
+    PJRT client creation (no exception ever surfaces). The probe runs in a
+    daemon thread so the second mode still yields a parseable error line.
+    """
+    import threading
+
+    import jax
+
+    result: dict = {}
+
+    def probe_loop() -> None:
+        deadline = time.monotonic() + total_timeout
+        delay = 5.0
+        while True:
+            try:
+                result["devices"] = jax.devices()
+                return
+            except Exception as exc:  # noqa: BLE001 — UNAVAILABLE etc.
+                result["exc"] = exc
+                if time.monotonic() >= deadline:
+                    return
+            try:
+                from jax.extend import backend as _jax_backend
+
+                _jax_backend.clear_backends()
+            except Exception:  # noqa: BLE001 — best effort; private fallback
+                try:
+                    jax._src.xla_bridge._clear_backends()
+                except Exception:
+                    pass
+            time.sleep(min(delay, max(0.0, deadline - time.monotonic())))
+            delay = min(delay * 1.6, 30.0)
+
+    thread = threading.Thread(target=probe_loop, daemon=True, name="bench-init")
+    thread.start()
+    thread.join(total_timeout + 30.0)
+    if "devices" in result:
+        return result["devices"]
+    if thread.is_alive():
+        raise _BackendInitHang(
+            f"backend init still blocked after {total_timeout + 30.0:.0f}s"
+        )
+    raise result.get("exc") or RuntimeError("backend init failed")
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--model", default=None, help="headline config (models.llama.CONFIGS)")
@@ -202,26 +274,46 @@ def main() -> int:
 
     from tf_operator_tpu.parallel.mesh import standard_mesh
 
-    devices = jax.devices()
-    n = len(devices)
-    on_tpu = devices[0].platform == "tpu"
+    try:
+        init_timeout = float(os.environ.get("TF_OPERATOR_BENCH_INIT_TIMEOUT", "180"))
+    except ValueError:
+        init_timeout = 180.0
+    try:
+        devices = _init_devices(init_timeout)
+    except _BackendInitHang as exc:
+        _emit_error("backend-init", exc)
+        sys.stdout.flush()
+        os._exit(1)  # a thread is wedged in PJRT init; normal exit can hang
+    except Exception as exc:  # noqa: BLE001
+        _emit_error("backend-init", exc)
+        return 1
+    try:
+        n = len(devices)
+        on_tpu = devices[0].platform == "tpu"
 
-    # Size the model to the hardware: single chip -> 400M-class; pods -> 7B.
-    if args.model is None:
-        args.model = "llama2-7b" if (on_tpu and n >= 16) else ("llama-400m" if on_tpu else "llama-tiny")
-    seq = args.seq
-    if args.batch is None:
-        args.batch = max(n, 8) if on_tpu else 2
-    if not on_tpu:
-        seq = min(seq, 128)
-        args.steps = min(args.steps, 3)
-    suite = args.suite or ("full" if on_tpu else "headline")
+        # Size the model to the hardware: single chip -> 400M-class; pods -> 7B.
+        if args.model is None:
+            args.model = "llama2-7b" if (on_tpu and n >= 16) else ("llama-400m" if on_tpu else "llama-tiny")
+        seq = args.seq
+        if args.batch is None:
+            args.batch = max(n, 8) if on_tpu else 2
+        if not on_tpu:
+            seq = min(seq, 128)
+            args.steps = min(args.steps, 3)
+        suite = args.suite or ("full" if on_tpu else "headline")
 
-    mesh = standard_mesh(n)  # pure FSDP by default
+        mesh = standard_mesh(n)  # pure FSDP by default
+    except Exception as exc:  # noqa: BLE001 — empty device list, mesh factory
+        _emit_error("setup", exc)
+        return 1
 
-    headline = bench_llama(
-        args.model, args.batch, seq, args.steps, args.warmup, mesh, devices
-    )
+    try:
+        headline = bench_llama(
+            args.model, args.batch, seq, args.steps, args.warmup, mesh, devices
+        )
+    except Exception as exc:  # noqa: BLE001
+        _emit_error(f"headline[{args.model}]", exc)
+        return 1
 
     configs = {}
     if suite == "full":
