@@ -79,7 +79,9 @@ def main(argv=None) -> int:
     if args.checkpoint_dir:
         from tf_operator_tpu.train.checkpoint import CheckpointManager
 
-        ckpt = CheckpointManager(args.checkpoint_dir, sharding=sharding)
+        ckpt = CheckpointManager(
+            args.checkpoint_dir, sharding=sharding, model_meta=config.geometry()
+        )
         state, restored_step = ckpt.restore_latest(state)
         if restored_step is not None:
             print(f"[llama] resumed from step {restored_step}", flush=True)

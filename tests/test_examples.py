@@ -111,6 +111,43 @@ class TestJaxScriptsRun:
         assert mgr.latest_step() == 6
         mgr.close()
 
+    def test_checkpoint_geometry_mismatch_refused(self, tmp_path):
+        """Configs with identical flattened kernel shapes but different head
+        grouping (16x64 vs 8x128) restore cleanly and silently compute
+        different attention — the geometry sidecar must refuse (ADVICE r2)."""
+        import jax.numpy as jnp
+        import pytest
+
+        from tf_operator_tpu.models import llama
+        from tf_operator_tpu.train.checkpoint import CheckpointManager
+        from tf_operator_tpu.train.train_step import TrainState
+
+        state = TrainState(
+            step=jnp.ones((), jnp.int32),
+            params={"w": jnp.ones((2,))},
+            opt_state={"m": jnp.zeros((2,))},
+        )
+        geo = llama.CONFIGS["llama-400m"].geometry()
+        path = str(tmp_path / "ckpt")
+        mgr = CheckpointManager(path, model_meta=geo)
+        assert mgr.save(state, force=True)
+        mgr.close()
+
+        # Same flattened shapes, regrouped heads: must be refused.
+        regrouped = llama.LlamaConfig(
+            dim=1024, n_layers=24, n_heads=16, n_kv_heads=16, ffn_dim=2816
+        )
+        bad = CheckpointManager(path, model_meta=regrouped.geometry())
+        with pytest.raises(ValueError, match="geometry mismatch"):
+            bad.restore_latest(state)
+        bad.close()
+
+        # Matching geometry restores.
+        ok = CheckpointManager(path, model_meta=geo)
+        restored, step = ok.restore_latest(state)
+        assert step == 1
+        ok.close()
+
 
 class TestPytorchExampleE2E:
     """The c10d contract proven live: a PyTorchJob (1 master + 2 workers)

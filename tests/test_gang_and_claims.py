@@ -54,7 +54,19 @@ class TestQuantities:
         assert parse_quantity("4") == 4.0
         assert format_quantity(4.0) == "4"
         assert format_quantity(0.3) == "300m"
-        assert format_quantity(3 * 2**30) == str(3 * 2**30)
+        # Memory-style integral totals render with binary suffixes.
+        assert format_quantity(3 * 2**30) == "3Gi"
+        assert format_quantity(parse_quantity("1.5Gi")) == "1536Mi"
+
+    def test_exact_arithmetic_no_float_drift(self):
+        """Hundreds of Gi summed must stay integral: float math turns the
+        total fractional and renders milli-byte strings (ADVICE r2)."""
+        from fractions import Fraction
+
+        total = sum((parse_quantity("1.5Gi") for _ in range(300)), Fraction(0))
+        assert format_quantity(total) == "450Gi"
+        cpu = sum((parse_quantity("100m") for _ in range(3)), Fraction(0))
+        assert format_quantity(cpu) == "300m"
 
 
 class TestMinResources:
@@ -69,7 +81,7 @@ class TestMinResources:
         group = cluster.get_pod_group("default", "tj")
         assert group["spec"]["minMember"] == 3
         assert group["spec"]["minResources"] == {
-            "cpu": "1500m", "memory": str(3 * 2**30),
+            "cpu": "1500m", "memory": "3Gi",
         }
 
     def test_limits_fallback_when_no_requests(self):

@@ -325,7 +325,16 @@ class OperatorManager:
     def _elect_loop(self) -> None:
         duration = self.options.lease_duration
         while not self._stop.is_set():
-            acquired = self.lease.try_acquire(self.identity, duration)
+            # An exception escaping an election round must not kill this
+            # thread: _is_leader would stay latched at its last value and a
+            # latched-True leader keeps reconciling without renewing while a
+            # standby steals the expired lease — dual leaders. Abdicating is
+            # the safe direction (an extra standby tick beats split-brain).
+            try:
+                acquired = self.lease.try_acquire(self.identity, duration)
+            except Exception:  # noqa: BLE001
+                log.warning("election round raised; abdicating", exc_info=True)
+                acquired = False
             if acquired != self._is_leader:
                 self._is_leader = acquired
                 self._set_leader_gauge()

@@ -468,12 +468,30 @@ class KubeCluster(Cluster):
         """Subscribe to events for `kind`. The first subscriber starts the
         kind's single list+watch loop; later subscribers share it and get
         the current store replayed as SYNC so they start complete."""
+        # The subscriber must see the snapshot BEFORE any live event — a
+        # live MODIFIED delivered ahead of the older SYNC replay of the same
+        # object would regress it — and must not MISS events emitted during
+        # the replay (a healthy watch stream never relists, so a dropped
+        # ADDED/DELETED here would stay invisible until the next resync).
+        # Both at once: register a gated wrapper immediately (nothing is
+        # missed), replay the snapshot directly, then flush the buffered
+        # live events in arrival order and open the gate.
+        gate_lock = threading.Lock()
+        gate = {"open": False, "buffer": []}
+
+        def gated(event_type, obj):
+            with gate_lock:
+                if not gate["open"]:
+                    gate["buffer"].append((event_type, obj))
+                    return
+            handler(event_type, obj)
+
         with self._informer_lock:
-            self._handlers.setdefault(kind, []).append(handler)
             synced = self._synced.setdefault(kind, threading.Event())
             replay = (
                 list(self._stores.get(kind, {}).values()) if synced.is_set() else []
             )
+            self._handlers.setdefault(kind, []).append(gated)
             if kind not in self._watch_threads:
                 thread = threading.Thread(
                     target=self._watch_loop, args=(kind,),
@@ -483,6 +501,14 @@ class KubeCluster(Cluster):
                 thread.start()
         for _, obj in replay:
             handler(SYNC, obj)
+        while True:
+            with gate_lock:
+                if not gate["buffer"]:
+                    gate["open"] = True
+                    break
+                pending, gate["buffer"] = gate["buffer"], []
+            for event_type, obj in pending:
+                handler(event_type, obj)
 
     def _store_list(self, kind: str, namespace: Optional[str],
                     labels: Optional[Dict[str, str]] = None):

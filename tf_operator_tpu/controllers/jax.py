@@ -137,12 +137,25 @@ class JAXController(FrameworkController):
         from ..core.job_controller import aggregate_min_resources
 
         per_slice = jaxdist.hosts_per_slice(job)
+        num_slices = max(1, job.spec.num_slices)
         sp = run_policy.scheduling_policy
         # Per-slice capacity: one slice's share of the worker topology (the
         # scheduler must be able to reserve a whole slice, not the whole
-        # multislice job, for a free slice to start independently).
+        # multislice job, for a free slice to start independently). Only the
+        # Worker type is slice-shaped (per_slice hosts each); any auxiliary
+        # type divides its own replica count across slices — counting it
+        # per_slice times per gang would inflate every reservation.
+        # (JAXJob validation currently permits only Worker; if the type set
+        # is ever widened, gang_group_name must also learn to assign
+        # auxiliary pods across slices to match this even-spread accounting.)
         slice_replicas = {
-            rtype: dataclasses.replace(spec, replicas=per_slice)
+            rtype: dataclasses.replace(
+                spec,
+                replicas=(
+                    per_slice if rtype == jaxapi.REPLICA_TYPE_WORKER
+                    else -(-(spec.replicas or 0) // num_slices)
+                ),
+            )
             for rtype, spec in replicas.items()
         }
         min_resources = (
@@ -161,7 +174,7 @@ class JAXController(FrameworkController):
             if chips:
                 min_resources.setdefault(TPU_RESOURCE, str(per_slice * chips))
         groups = []
-        for s in range(max(1, job.spec.num_slices)):
+        for s in range(num_slices):
             groups.append(
                 {
                     "apiVersion": "scheduling.volcano.sh/v1beta1",

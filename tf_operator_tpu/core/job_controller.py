@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import copy
 import time
+from fractions import Fraction
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
@@ -61,28 +62,56 @@ def job_selector(job: JobObject) -> Dict[str, str]:
 
 
 # Kubernetes resource.Quantity arithmetic (the subset PodGroup minResources
-# aggregation needs): parse "100m"/"2Gi"/"4" to floats, sum, format back.
+# aggregation needs). Exact rational arithmetic throughout: float sums of
+# large memory asks (hundreds of Gi across a big gang) accumulate binary
+# error that turns an integral byte total fractional and renders it as a
+# legal-but-bizarre milli-byte string ("1610612736000m").
 _QUANTITY_SUFFIXES = {
-    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50, "Ei": 2**60,
-    "n": 1e-9, "u": 1e-6, "m": 1e-3,
-    "k": 1e3, "K": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15, "E": 1e18,
+    "Ki": Fraction(2**10), "Mi": Fraction(2**20), "Gi": Fraction(2**30),
+    "Ti": Fraction(2**40), "Pi": Fraction(2**50), "Ei": Fraction(2**60),
+    "n": Fraction(1, 10**9), "u": Fraction(1, 10**6), "m": Fraction(1, 1000),
+    "k": Fraction(10**3), "K": Fraction(10**3), "M": Fraction(10**6),
+    "G": Fraction(10**9), "T": Fraction(10**12), "P": Fraction(10**15),
+    "E": Fraction(10**18),
 }
 
+_BINARY_SUFFIXES = (
+    ("Ei", 2**60), ("Pi", 2**50), ("Ti", 2**40),
+    ("Gi", 2**30), ("Mi", 2**20), ("Ki", 2**10),
+)
 
-def parse_quantity(value) -> float:
+
+def _to_fraction(value) -> Fraction:
+    if isinstance(value, float):
+        return Fraction(str(value))  # exact decimal reading, not the binary repr
+    return Fraction(value)
+
+
+def parse_quantity(value) -> Fraction:
     s = str(value).strip()
     for suffix in ("Ki", "Mi", "Gi", "Ti", "Pi", "Ei"):
         if s.endswith(suffix):
-            return float(s[: -2]) * _QUANTITY_SUFFIXES[suffix]
+            return Fraction(s[:-2]) * _QUANTITY_SUFFIXES[suffix]
     if s and s[-1] in _QUANTITY_SUFFIXES:
-        return float(s[:-1]) * _QUANTITY_SUFFIXES[s[-1]]
-    return float(s)
+        return Fraction(s[:-1]) * _QUANTITY_SUFFIXES[s[-1]]
+    return Fraction(s)
 
 
-def format_quantity(value: float) -> str:
-    if value == int(value):
-        return str(int(value))
-    return f"{int(round(value * 1000))}m"  # fractional (cpu-style) -> milli
+def format_quantity(value) -> str:
+    value = _to_fraction(value)
+    if value.denominator == 1:
+        n = value.numerator
+        # Memory-style totals come back out in binary suffixes (8Gi, not
+        # 8589934592) so schedulers and humans can read them.
+        for suffix, mult in _BINARY_SUFFIXES:
+            if n >= mult and n % mult == 0:
+                return f"{n // mult}{suffix}"
+        return str(n)
+    milli = value * 1000
+    if milli.denominator == 1:
+        return f"{milli.numerator}m"  # fractional cpu-style -> milli
+    nano = round(value * 10**9)
+    return f"{nano}n"
 
 
 def aggregate_min_resources(replicas: Dict[str, ReplicaSpec]) -> Dict[str, str]:
@@ -90,14 +119,14 @@ def aggregate_min_resources(replicas: Dict[str, ReplicaSpec]) -> Dict[str, str]:
     the whole topology — the reference kubeflow/common SyncPodGroup fills
     PodGroup.spec.minResources the same way so the gang scheduler can
     reserve capacity for the entire job at once."""
-    totals: Dict[str, float] = {}
+    totals: Dict[str, Fraction] = {}
     for spec in replicas.values():
         n = spec.replicas or 0
         for container in spec.template.spec.containers:
             resources = container.resources or {}
             requests = resources.get("requests") or resources.get("limits") or {}
             for name, value in requests.items():
-                totals[name] = totals.get(name, 0.0) + n * parse_quantity(value)
+                totals[name] = totals.get(name, Fraction(0)) + n * parse_quantity(value)
     return {name: format_quantity(v) for name, v in sorted(totals.items())}
 
 

@@ -133,7 +133,14 @@ class ClusterLeaseLock:
         spec = lease.setdefault("spec", {})
         holder = spec.get("holderIdentity")
         renew_raw = str(spec.get("renewTime"))
-        held_duration = spec.get("leaseDurationSeconds", duration)
+        # A foreign/malformed lease can carry an explicit null or garbage
+        # leaseDurationSeconds; arithmetic on it must never escape an
+        # election round (the exception would kill the elect thread while
+        # _is_leader stays latched — dual leaders).
+        try:
+            held_duration = float(spec.get("leaseDurationSeconds"))
+        except (TypeError, ValueError):
+            held_duration = duration
 
         if holder and holder != identity:
             # Skew-safe expiry: restart the local timer whenever the remote

@@ -92,6 +92,24 @@ class LlamaConfig:
         per_layer = self._per_layer_params(self.n_experts)
         return int(v * d + self.n_layers * per_layer + d + d * v)
 
+    def geometry(self) -> dict:
+        """Shape-invisible geometry for checkpoint metadata: the flattened
+        [dim, heads*head_dim] kernels are identical across head regroupings
+        (16x64 vs 8x128), so an old checkpoint loads cleanly under a new
+        grouping and silently computes different attention. Record + validate
+        this at restore (train.checkpoint.CheckpointManager(model_meta=...))."""
+        return {
+            "dim": self.dim,
+            "n_layers": self.n_layers,
+            "n_heads": self.n_heads,
+            "n_kv_heads": self.n_kv_heads,
+            "head_dim": self.head_dim,
+            "ffn_dim": self.ffn_dim,
+            "vocab_size": self.vocab_size,
+            "n_experts": self.n_experts,
+            "experts_per_token": self.experts_per_token,
+        }
+
     def active_param_count(self) -> int:
         """Params touched per token (MoE: only the top-k experts)."""
         d, v = self.dim, self.vocab_size

@@ -29,6 +29,7 @@ class CheckpointManager:
         sharding: Any = None,
         max_to_keep: int = 3,
         save_interval_steps: int = 1,
+        model_meta: Optional[dict] = None,
     ):
         import os
 
@@ -36,6 +37,14 @@ class CheckpointManager:
 
         self._ocp = ocp
         self.sharding = sharding
+        # Model-geometry sidecar: configs with identical flattened kernel
+        # shapes but different head grouping (e.g. 16x64 vs 8x128 attention)
+        # load each other's checkpoints cleanly and silently compute a
+        # differently-grouped attention — no shape error ever flags it.
+        # Recording the geometry and validating at restore is the only
+        # guard (ADVICE r2).
+        self._model_meta = model_meta
+        self._meta_path = os.path.join(os.path.abspath(directory), "model_meta.json")
         self._mgr = ocp.CheckpointManager(
             os.path.abspath(directory),  # orbax requires absolute paths
             options=ocp.CheckpointManagerOptions(
@@ -45,6 +54,37 @@ class CheckpointManager:
             ),
         )
 
+    def _write_meta(self) -> None:
+        import json
+        import os
+
+        if self._model_meta is None or os.path.exists(self._meta_path):
+            return
+        tmp = f"{self._meta_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(self._model_meta, f, sort_keys=True)
+        os.replace(tmp, self._meta_path)
+
+    def _validate_meta(self) -> None:
+        import json
+        import os
+
+        if self._model_meta is None or not os.path.exists(self._meta_path):
+            return
+        with open(self._meta_path) as f:
+            on_disk = json.load(f)
+        mismatched = {
+            k: (on_disk[k], self._model_meta[k])
+            for k in on_disk.keys() & self._model_meta.keys()
+            if on_disk[k] != self._model_meta[k]
+        }
+        if mismatched:
+            raise ValueError(
+                "checkpoint model geometry mismatch (saved vs current): "
+                f"{mismatched} — refusing to mix checkpoints trained "
+                "under different head/layer geometries in one directory"
+            )
+
     def save(self, state, force: bool = False) -> bool:
         """Async save at the state's own step counter. A step that is
         already on disk is a no-op (a final flush after a periodic save
@@ -52,9 +92,15 @@ class CheckpointManager:
         step = int(jax.device_get(state.step))
         if self._mgr.latest_step() == step:
             return False
-        return self._mgr.save(
+        # Save-only runs reusing a directory must not mix geometries under
+        # one sidecar: validate against any existing record before writing.
+        self._validate_meta()
+        saved = self._mgr.save(
             step, args=self._ocp.args.StandardSave(state), force=force
         )
+        if saved:
+            self._write_meta()
+        return saved
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -66,6 +112,7 @@ class CheckpointManager:
         step = self._mgr.latest_step()
         if step is None:
             return state, None
+        self._validate_meta()
 
         def as_abstract(leaf, shard):
             return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=shard)
