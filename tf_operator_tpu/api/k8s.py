@@ -87,21 +87,64 @@ def _class_schema(cls: type):
 
 
 def _coerce(hint: Any, val: Any) -> Any:
+    """Coerce ``val`` toward ``hint``; raise ValueError on type-level garbage.
+
+    A CR that reaches the operator may carry wrong *types* (``replicas:
+    "two"``, ``containers: {}``) that a full structural schema would have
+    rejected server-side. Failing here with a clear message lets the
+    controller map it to a Failed condition instead of crashing deep in the
+    engine and hot-requeueing forever (the reference's unstructured-informer
+    tolerance, pkg/common/util/v1/unstructured/informer.go:41-80).
+    Unambiguous coercions (``"2"`` -> 2) are accepted the way YAML users
+    expect.
+    """
     import typing
 
+    if val is None:
+        return None  # explicit null = unset; nullability is validation's job
     origin = typing.get_origin(hint)
     args = typing.get_args(hint)
     if origin is typing.Union:  # Optional[X]
         inner = [a for a in args if a is not type(None)]
         return _coerce(inner[0], val) if inner else val
     if origin in (list, List):
+        if not isinstance(val, (list, tuple)):
+            raise ValueError(f"expected a list, got {type(val).__name__}: {val!r}")
         return [_coerce(args[0], v) for v in val] if args else list(val)
     if origin in (dict, Dict):
+        if not isinstance(val, dict):
+            raise ValueError(f"expected an object, got {type(val).__name__}: {val!r}")
         if args and dataclasses.is_dataclass(args[1]):
             return {k: from_dict(args[1], v) for k, v in val.items()}
         return dict(val)
-    if dataclasses.is_dataclass(hint) and isinstance(val, dict):
-        return from_dict(hint, val)
+    if dataclasses.is_dataclass(hint):
+        if isinstance(val, dict):
+            return from_dict(hint, val)
+        raise ValueError(
+            f"expected a {getattr(hint, '__name__', hint)} object, "
+            f"got {type(val).__name__}: {val!r}"
+        )
+    if hint is bool:
+        if isinstance(val, bool):
+            return val
+        if isinstance(val, str) and val.lower() in ("true", "false"):
+            return val.lower() == "true"
+        raise ValueError(f"expected a boolean, got {type(val).__name__}: {val!r}")
+    if hint is int:
+        if isinstance(val, bool):
+            raise ValueError(f"expected an integer, got boolean: {val!r}")
+        try:
+            out = int(val)
+        except (TypeError, ValueError):
+            raise ValueError(f"expected an integer, got {type(val).__name__}: {val!r}")
+        if isinstance(val, float) and val != out:
+            raise ValueError(f"expected an integer, got non-integral number: {val!r}")
+        return out
+    if hint is float:
+        try:
+            return float(val)
+        except (TypeError, ValueError):
+            raise ValueError(f"expected a number, got {type(val).__name__}: {val!r}")
     return val
 
 

@@ -89,7 +89,13 @@ class Cluster:
     def create_service(self, service: Service) -> Service:
         raise NotImplementedError
 
+    def get_service(self, namespace: str, name: str) -> Service:
+        raise NotImplementedError
+
     def list_services(self, namespace: Optional[str] = None, labels: Optional[Dict[str, str]] = None) -> List[Service]:
+        raise NotImplementedError
+
+    def update_service(self, service: Service) -> Service:
         raise NotImplementedError
 
     def delete_service(self, namespace: str, name: str) -> None:

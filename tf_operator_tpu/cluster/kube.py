@@ -364,6 +364,23 @@ class KubeCluster(Cluster):
         )
         return from_dict(Service, _normalize_times(out))
 
+    def get_service(self, namespace: str, name: str) -> Service:
+        out = self._request("GET", self._core_path("services", namespace, name))
+        return from_dict(Service, _normalize_times(out))
+
+    def update_service(self, service: Service) -> Service:
+        body = to_dict(service)
+        body.setdefault("apiVersion", "v1")
+        body.setdefault("kind", "Service")
+        out = self._request(
+            "PUT",
+            self._core_path(
+                "services", service.metadata.namespace, service.metadata.name
+            ),
+            body,
+        )
+        return from_dict(Service, _normalize_times(out))
+
     def list_services(self, namespace: Optional[str] = None,
                       labels: Optional[Dict[str, str]] = None) -> List[Service]:
         store = self._store_list("services", namespace, labels)
@@ -499,8 +516,18 @@ class KubeCluster(Cluster):
                 )
                 self._watch_threads[kind] = thread
                 thread.start()
+        # Handler exceptions here log-and-continue like _emit's steady-state
+        # delivery: one bad object must not abort the replay with the gate
+        # still closed (the wrapper would then buffer every future event
+        # forever, and the subscriber would never hear another one).
+        def deliver(event_type, obj):
+            try:
+                handler(event_type, obj)
+            except Exception:
+                _log.exception("watch handler for %s failed", kind)
+
         for _, obj in replay:
-            handler(SYNC, obj)
+            deliver(SYNC, obj)
         while True:
             with gate_lock:
                 if not gate["buffer"]:
@@ -508,7 +535,7 @@ class KubeCluster(Cluster):
                     break
                 pending, gate["buffer"] = gate["buffer"], []
             for event_type, obj in pending:
-                handler(event_type, obj)
+                deliver(event_type, obj)
 
     def _store_list(self, kind: str, namespace: Optional[str],
                     labels: Optional[Dict[str, str]] = None):

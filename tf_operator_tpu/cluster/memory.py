@@ -233,6 +233,13 @@ class InMemoryCluster(base.Cluster):
         self._emit("services", ADDED, out)
         return out
 
+    def get_service(self, namespace: str, name: str) -> Service:
+        with self._lock:
+            try:
+                return self._services[(namespace, name)].deep_copy()
+            except KeyError:
+                raise NotFound(f"service {namespace}/{name}")
+
     def list_services(self, namespace=None, labels=None) -> List[Service]:
         with self._lock:
             out = []
@@ -243,6 +250,18 @@ class InMemoryCluster(base.Cluster):
                     continue
                 out.append(svc.deep_copy())
             return out
+
+    def update_service(self, service: Service) -> Service:
+        key = (service.metadata.namespace, service.metadata.name)
+        with self._lock:
+            if key not in self._services:
+                raise NotFound(f"service {key}")
+            service = service.deep_copy()
+            service.metadata.resource_version = str(next(self._rv))
+            self._services[key] = service
+            out = service.deep_copy()
+        self._emit("services", MODIFIED, out)
+        return out
 
     def delete_service(self, namespace: str, name: str) -> None:
         with self._lock:

@@ -128,14 +128,36 @@ class FrameworkController(FrameworkHooks):
 
     # ------------------------------------------------------------ validate
     def parse_job(self, job_dict: dict) -> JobObject:
+        """Convert + default one stored CR. Conversion boundary: ANY failure
+        in here means a malformed resource — re-raised as ValidationError so
+        sync() marks the job Failed instead of the blanket process_next
+        except re-queueing it forever (a hot-looping job that never reports;
+        the reference's unstructured-informer path exists for exactly this
+        tolerance, issue #561)."""
         cls, set_defaults, _ = KINDS[self.kind]
-        job = cls.parse(job_dict)
-        set_defaults(job)
+        try:
+            job = cls.parse(job_dict)
+            set_defaults(job)
+        except ValidationError:
+            raise
+        except Exception as err:
+            raise ValidationError(
+                f"malformed {self.kind} resource: {type(err).__name__}: {err}"
+            ) from err
         return job
 
     def validate_job(self, job: JobObject) -> None:
         _, _, validate = KINDS[self.kind]
-        validate(job.spec)
+        try:
+            validate(job.spec)
+        except ValidationError:
+            raise
+        except Exception as err:
+            # Same conversion boundary as parse_job: a validator tripping
+            # over absent structure (null template, etc.) is an invalid spec.
+            raise ValidationError(
+                f"invalid {self.kind} spec: {type(err).__name__}: {err}"
+            ) from err
 
     # ------------------------------------------------------------- sync
     def sync(self, namespace: str, name: str) -> None:

@@ -294,26 +294,8 @@ class JobController:
     # ------------------------------------------------------------- listing
     def get_pods_for_job(self, job: JobObject) -> List[Pod]:
         """Label-selected pods with full claim semantics (reference
-        ControllerRefManager, tfjob_controller.go:249-332):
-
-        - owned (controllerRef UID matches) + labels still match -> keep;
-        - owned but labels no longer match -> RELEASE: remove our
-          controllerRef with an uncached UID recheck (the list may be
-          served by the informer cache; never patch a pod we haven't
-          re-read live);
-        - orphan + labels match -> ADOPT, gated on an uncached job GET
-          proving the job still exists with the same UID (an operator
-          holding a stale cached job must not stamp refs for a deleted/
-          recreated one) and on the job not being mid-deletion;
-        - owned by someone else -> ignore.
-
-        Adoption/release write failures are narrowed to NotFound/Conflict
-        (the pod moved under us — skip this sync, the watch re-enqueues);
-        real API errors propagate to the rate-limited queue."""
-        from ..cluster.base import Conflict, NotFound
-        from .control import owner_ref_for
-
-        selector = job_selector(job)
+        ControllerRefManager, tfjob_controller.go:249-332); see
+        _claim_objects for the protocol."""
         # List at OPERATOR scope (group-name only), claim per-pod: a pod we
         # own whose job-name label was mutated away must still be seen here,
         # or it could never be released (a full-selector list hides it).
@@ -321,69 +303,104 @@ class JobController:
             namespace=job.namespace,
             labels={constants.LABEL_GROUP_NAME: constants.GROUP_NAME},
         )
+        return self._claim_objects(
+            job, pods, self.cluster.get_pod, self.cluster.update_pod
+        )
+
+    def get_services_for_job(self, job: JobObject) -> List[Service]:
+        """Services are claimed through the identical protocol (the
+        reference runs them through the same ControllerRefManager,
+        tfjob_controller.go:290-332)."""
+        services = self.cluster.list_services(
+            namespace=job.namespace,
+            labels={constants.LABEL_GROUP_NAME: constants.GROUP_NAME},
+        )
+        return self._claim_objects(
+            job, services, self.cluster.get_service, self.cluster.update_service
+        )
+
+    def _claim_objects(self, job: JobObject, objects, get_live, update) -> list:
+        """The ControllerRefManager claim protocol, single-sourced for pods
+        and services:
+
+        - owned (controllerRef UID matches) + labels still match -> keep;
+        - owned but labels no longer match -> RELEASE: re-read live (the
+          list may be cache-served; never patch an object we haven't
+          re-read), confirm its UID, then strip our controllerRef;
+        - orphan + labels match -> ADOPT, gated on an uncached job GET
+          proving the job still exists with the same UID (an operator
+          holding a stale cached job must not stamp refs for a deleted/
+          recreated one) and on the job not being mid-deletion; the
+          recheck's verdict is invariant for the sync, so it runs at most
+          once per call (reference canAdoptOnce), not once per orphan;
+        - owned by someone else -> ignore.
+
+        Adoption/release write failures are narrowed to NotFound/Conflict
+        (the object moved under us — skip this sync, the watch re-enqueues);
+        real API errors propagate to the rate-limited queue."""
+        from ..cluster.base import Conflict, NotFound
+        from .control import owner_ref_for
+
+        selector = job_selector(job)
+        can_adopt: Optional[bool] = None
         out = []
-        for pod in pods:
-            ref = pod.metadata.controller_ref()
+        for obj in objects:
+            ref = obj.metadata.controller_ref()
             matches = all(
-                pod.metadata.labels.get(k) == v for k, v in selector.items()
+                obj.metadata.labels.get(k) == v for k, v in selector.items()
             )
             if ref is not None and ref.uid == job.metadata.uid:
                 if not matches:
-                    self._release_pod(job, pod)
+                    self._release_object(job, obj, get_live, update)
                     continue
-                out.append(pod)
+                out.append(obj)
                 continue
             if ref is not None:
                 continue  # owned by another controller
             if not matches or job.metadata.deletion_timestamp is not None:
                 continue
-            # Uncached recheck before adopting (reference util/client.go
-            # delegating reader): the job must still exist with our UID.
-            # get_job_uncached bypasses the informer cache — a cached read
-            # would defeat the recheck exactly when it matters (job deleted
-            # and recreated before the watch delivers the events).
-            try:
-                live = self.cluster.get_job_uncached(job.kind, job.namespace, job.name)
-            except NotFound:
+            if can_adopt is None:
+                # get_job_uncached bypasses the informer cache — a cached
+                # read would defeat the recheck exactly when it matters (job
+                # deleted and recreated before the watch delivers events).
+                try:
+                    live = self.cluster.get_job_uncached(
+                        job.kind, job.namespace, job.name
+                    )
+                    can_adopt = (
+                        (live.get("metadata") or {}).get("uid") == job.metadata.uid
+                    )
+                except NotFound:
+                    can_adopt = False
+            if not can_adopt:
                 continue
-            if (live.get("metadata") or {}).get("uid") != job.metadata.uid:
-                continue
-            pod.metadata.owner_references.append(owner_ref_for(job))
+            obj.metadata.owner_references.append(owner_ref_for(job))
             try:
-                pod = self.cluster.update_pod(pod)
+                obj = update(obj)
             except (NotFound, Conflict):
                 continue
-            out.append(pod)
+            out.append(obj)
         return out
 
-    def _release_pod(self, job: JobObject, pod: Pod) -> None:
-        """Remove our controllerRef from a pod whose labels stopped matching
-        (reference ReleasePods): re-read live first so a cache-stale view
-        never drives the patch, and confirm the UID is the pod we saw."""
+    def _release_object(self, job: JobObject, obj, get_live, update) -> None:
+        """Remove our controllerRef from an object whose labels stopped
+        matching (reference ReleasePods): re-read live first so a
+        cache-stale view never drives the patch, confirm the UID."""
         from ..cluster.base import Conflict, NotFound
 
         try:
-            live = self.cluster.get_pod(pod.metadata.namespace, pod.metadata.name)
+            live = get_live(obj.metadata.namespace, obj.metadata.name)
         except NotFound:
             return
-        if live.metadata.uid != pod.metadata.uid:
+        if live.metadata.uid != obj.metadata.uid:
             return
         live.metadata.owner_references = [
             r for r in live.metadata.owner_references if r.uid != job.metadata.uid
         ]
         try:
-            self.cluster.update_pod(live)
+            update(live)
         except (NotFound, Conflict):
-            pass  # pod changed/vanished concurrently; next sync re-evaluates
-
-    def get_services_for_job(self, job: JobObject) -> List[Service]:
-        services = self.cluster.list_services(namespace=job.namespace, labels=job_selector(job))
-        return [
-            s
-            for s in services
-            if s.metadata.controller_ref() is None
-            or s.metadata.controller_ref().uid == job.metadata.uid
-        ]
+            pass  # object changed/vanished concurrently; next sync re-evaluates
 
     # ----------------------------------------------------------- reconcile
     def reconcile_job(self, job: JobObject) -> None:

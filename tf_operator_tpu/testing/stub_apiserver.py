@@ -193,9 +193,14 @@ class StubApiServer:
                 self.mem.delete_pod(ns, name)
                 return handler._json(200, {})
         if resource == "services":
+            if method == "GET" and name:
+                return handler._json(200, to_dict(self.mem.get_service(ns, name)))
             if method == "POST":
                 svc = from_dict(Service, handler._body())
                 return handler._json(201, to_dict(self.mem.create_service(svc)))
+            if method == "PUT":
+                svc = from_dict(Service, handler._body())
+                return handler._json(200, to_dict(self.mem.update_service(svc)))
             if method == "DELETE":
                 self.mem.delete_service(ns, name)
                 return handler._json(200, {})
