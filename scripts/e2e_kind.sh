@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# Real-cluster walkthrough: run the operator against a kind (or any)
+# cluster from a kubeconfig, submit a TFJob, watch it complete.
+#
+# The in-repo CI exercises the HTTP path against testing/stub_apiserver.py
+# (real serialization, watches, status subresource, 401 rotation); this
+# script is the documented recipe for the genuine-apiserver tier the
+# reference ran via its Argo DAG (test/workflows/components/
+# workflows.libsonnet:218-300) — TLS, RBAC, CRD registration and all.
+#
+# Prereqs on the host (NOT installed by this script): kind, kubectl, docker.
+set -euo pipefail
+
+CLUSTER_NAME="${CLUSTER_NAME:-tf-operator-tpu-e2e}"
+REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+KUBECONFIG_PATH="${KUBECONFIG_PATH:-$(mktemp -d)/kubeconfig}"
+
+echo "=== 1. kind cluster"
+kind create cluster --name "$CLUSTER_NAME" --kubeconfig "$KUBECONFIG_PATH"
+
+cleanup() { kind delete cluster --name "$CLUSTER_NAME" || true; }
+trap cleanup EXIT
+
+echo "=== 2. CRDs + RBAC"
+kubectl --kubeconfig "$KUBECONFIG_PATH" apply -f "$REPO_ROOT/manifests/crds/"
+kubectl --kubeconfig "$KUBECONFIG_PATH" apply -f "$REPO_ROOT/manifests/operator.yaml" || true
+
+echo "=== 3. operator (out-of-cluster, kubeconfig auth, rotating-token safe)"
+python -m tf_operator_tpu --kubeconfig "$KUBECONFIG_PATH" \
+    --metrics-port 0 --health-port 0 &
+OPERATOR_PID=$!
+trap 'kill $OPERATOR_PID 2>/dev/null || true; cleanup' EXIT
+sleep 3
+
+echo "=== 4. submit a 2-worker TFJob and wait for completion"
+kubectl --kubeconfig "$KUBECONFIG_PATH" apply -f - <<'EOF'
+apiVersion: kubeflow.org/v1
+kind: TFJob
+metadata:
+  name: kind-smoke
+  namespace: default
+spec:
+  tfReplicaSpecs:
+    Worker:
+      replicas: 2
+      template:
+        spec:
+          containers:
+            - name: tensorflow
+              image: busybox:1.36
+              command: ["sh", "-c", "echo TF_CONFIG=$TF_CONFIG && sleep 5"]
+EOF
+
+kubectl --kubeconfig "$KUBECONFIG_PATH" wait tfjob/kind-smoke \
+    --for=jsonpath='{.status.conditions[?(@.type=="Succeeded")].status}'=True \
+    --timeout=300s
+
+echo "=== PASS: TFJob completed on a real apiserver"
+kubectl --kubeconfig "$KUBECONFIG_PATH" get tfjob kind-smoke -o yaml | sed -n '/status:/,$p'

@@ -102,6 +102,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     parser.add_argument("--kube-url", default="", help="Apiserver base URL (default: in-cluster).")
     parser.add_argument("--kube-token", default="", help="Bearer token (default: service-account file).")
     parser.add_argument("--kube-insecure", action="store_true", help="Skip TLS verification.")
+    parser.add_argument("--kubeconfig", default="",
+                        help="Path to a kubeconfig file (default: $KUBECONFIG, "
+                        "then ~/.kube/config; the reference's clientcmd "
+                        "resolution, server.go:97-107). Implies --kube.")
+    parser.add_argument("--kube-context", default="",
+                        help="Kubeconfig context to use (default: current-context).")
     return parser
 
 
@@ -484,15 +490,50 @@ def main(argv: Optional[List[str]] = None, cluster: Optional[Cluster] = None) ->
     options = options_from_args(args)
     _setup_logging(options.json_log_format)
     if cluster is None:
-        if getattr(args, "kube", False) or args.kube_url:
+        kubeconfig = getattr(args, "kubeconfig", "")
+        if kubeconfig:
             from .cluster.kube import KubeCluster
 
-            cluster = KubeCluster(
-                base_url=args.kube_url or None,
-                token=args.kube_token or None,
-                insecure=args.kube_insecure,
-                namespace=options.namespace,
+            cluster = KubeCluster.from_kubeconfig(
+                kubeconfig,
+                context=getattr(args, "kube_context", "") or None,
+                **({"namespace": options.namespace} if options.namespace else {}),
             )
+        elif getattr(args, "kube", False) or args.kube_url:
+            from .cluster.kube import KubeCluster
+
+            # Out-of-cluster with no explicit URL AND no explicit credential
+            # flags: fall back to the ambient kubeconfig before failing,
+            # like the reference's clientcmd. Explicit --kube-token /
+            # --kube-insecure mean the user is describing a connection
+            # directly — honoring an ambient kubeconfig instead would
+            # silently connect somewhere else with other credentials.
+            if (
+                not args.kube_url
+                and not args.kube_token
+                and not args.kube_insecure
+                and "KUBERNETES_SERVICE_HOST" not in os.environ
+            ):
+                from .cluster.kubeconfig import resolve_kubeconfig_path
+
+                ambient = resolve_kubeconfig_path(None)
+                if ambient is not None:
+                    cluster = KubeCluster.from_kubeconfig(
+                        ambient,
+                        context=getattr(args, "kube_context", "") or None,
+                        **(
+                            {"namespace": options.namespace}
+                            if options.namespace
+                            else {}
+                        ),
+                    )
+            if cluster is None:
+                cluster = KubeCluster(
+                    base_url=args.kube_url or None,
+                    token=args.kube_token or None,
+                    insecure=args.kube_insecure,
+                    namespace=options.namespace,
+                )
         else:
             # Dev default: the in-repo cluster runtime; the real apiserver
             # backend plugs in through the same Cluster interface.

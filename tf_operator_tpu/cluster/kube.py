@@ -111,6 +111,9 @@ class KubeCluster(Cluster):
         timeout: float = 30.0,
         namespace: str = "",
         label_selector: Optional[str] = None,
+        token_file: Optional[str] = None,
+        client_cert_file: Optional[str] = None,
+        client_key_file: Optional[str] = None,
     ):
         if base_url is None:
             host = os.environ.get("KUBERNETES_SERVICE_HOST")
@@ -121,13 +124,26 @@ class KubeCluster(Cluster):
                     "(KUBERNETES_SERVICE_HOST unset)"
                 )
             base_url = f"https://{host}:{port}"
-        if token is None and os.path.exists(f"{_SA_DIR}/token"):
-            with open(f"{_SA_DIR}/token") as f:
-                token = f.read().strip()
+        # File-backed tokens (in-cluster SA, kubeconfig tokenFile) are
+        # RE-READABLE: bound SA tokens rotate (~1h), so a token read once at
+        # init would start taking 401s mid-run and never recover. The file
+        # path is kept and re-read on 401 (_refresh_token).
+        if token is None and token_file is None and os.path.exists(f"{_SA_DIR}/token"):
+            token_file = f"{_SA_DIR}/token"
         if ca_file is None and os.path.exists(f"{_SA_DIR}/ca.crt"):
             ca_file = f"{_SA_DIR}/ca.crt"
         self._url = urllib.parse.urlparse(base_url)
+        self._token_file = token_file
+        if token is None and token_file is not None:
+            try:
+                with open(token_file) as f:
+                    token = f.read().strip()
+            except OSError as exc:
+                raise RuntimeError(
+                    f"KubeCluster: cannot read token file {token_file!r}: {exc}"
+                )
         self._token = token
+        self._token_lock = threading.Lock()
         self._timeout = timeout
         # Operator scope: restricts watch paths (and therefore the cache) to
         # one namespace when set — the legacy factory's namespace filter
@@ -145,6 +161,9 @@ class KubeCluster(Cluster):
                 self._ssl = ssl._create_unverified_context()
             else:
                 self._ssl = ssl.create_default_context(cafile=ca_file)
+            if client_cert_file:
+                # mTLS client auth (kubeconfig client-certificate/key).
+                self._ssl.load_cert_chain(client_cert_file, client_key_file)
         else:
             self._ssl = None
         self._stop = threading.Event()
@@ -168,10 +187,12 @@ class KubeCluster(Cluster):
             )
         return http.client.HTTPConnection(host, port, timeout=timeout)
 
-    def _headers(self, content_type: Optional[str] = None) -> Dict[str, str]:
+    def _headers(self, content_type: Optional[str] = None,
+                 token: Optional[str] = None) -> Dict[str, str]:
         headers = {"Accept": "application/json"}
-        if self._token:
-            headers["Authorization"] = f"Bearer {self._token}"
+        token = self._token if token is None else token
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
         if content_type:
             headers["Content-Type"] = content_type
         return headers
@@ -185,6 +206,7 @@ class KubeCluster(Cluster):
         # server-side, so POST/PUT/DELETE only retry when the send itself
         # failed on a reused (stale keep-alive) connection — never after
         # bytes could have reached the server twice.
+        refreshed = False
         while True:
             conn = getattr(self._local, "conn", None)
             reused = conn is not None
@@ -192,12 +214,16 @@ class KubeCluster(Cluster):
                 conn = self._connect()
                 self._local.conn = conn
             sent = False
+            token_sent = self._token
             try:
                 conn.request(
                     method,
                     path,
                     body=None if body is None else json.dumps(body),
-                    headers=self._headers(content_type if body is not None else None),
+                    headers=self._headers(
+                        content_type if body is not None else None,
+                        token=token_sent,
+                    ),
                 )
                 sent = True
                 resp = conn.getresponse()
@@ -212,6 +238,20 @@ class KubeCluster(Cluster):
                 if retry_safe:
                     continue
                 raise RuntimeError(f"{method} {path}: connection failed ({exc})")
+            if resp.status == 401 and not refreshed and self._refresh_token(token_sent):
+                # Bound SA tokens rotate (~1h): the mounted file has fresh
+                # credentials — re-read once and replay. Safe for mutations:
+                # a 401 means the apiserver rejected the request before
+                # processing it. Replay on a FRESH connection — a server
+                # that rejected at the auth layer may not have drained the
+                # request body, leaving the keep-alive stream desynced.
+                refreshed = True
+                self._local.conn = None
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                continue
             if resp.status == 404:
                 raise NotFound(f"{method} {path}: 404")
             if resp.status == 409:
@@ -219,6 +259,53 @@ class KubeCluster(Cluster):
             if resp.status >= 400:
                 raise RuntimeError(f"{method} {path}: {resp.status} {data[:300]!r}")
             return json.loads(data) if data else {}
+
+    def _refresh_token(self, rejected: Optional[str]) -> bool:
+        """Re-read the token file after a 401. True iff the file yields a
+        token DIFFERENT from the one the failed request actually sent
+        (otherwise retrying is pointless and the 401 should surface).
+        Comparing against `rejected` rather than self._token keeps
+        concurrent 401s correct: a thread whose peer already refreshed
+        still gets True and replays with the current credentials."""
+        if not self._token_file:
+            return False
+        try:
+            with open(self._token_file) as f:
+                fresh = f.read().strip()
+        except OSError:
+            return False
+        if not fresh or fresh == rejected:
+            return False
+        with self._token_lock:
+            if self._token != fresh:
+                self._token = fresh
+                _log.info(
+                    "bearer token rotated (re-read %s after 401)", self._token_file
+                )
+        return True
+
+    @classmethod
+    def from_kubeconfig(
+        cls,
+        path: Optional[str] = None,
+        context: Optional[str] = None,
+        **kwargs,
+    ) -> "KubeCluster":
+        """Build a client from a kubeconfig (--kubeconfig > $KUBECONFIG >
+        ~/.kube/config), the reference's clientcmd resolution
+        (cmd/tf-operator.v1/app/server.go:97-107). Extra kwargs (namespace,
+        label_selector, timeout) override the kubeconfig's."""
+        from .kubeconfig import load_kubeconfig, resolve_kubeconfig_path
+
+        resolved = resolve_kubeconfig_path(path)
+        if resolved is None:
+            raise RuntimeError(
+                "KubeCluster.from_kubeconfig: no kubeconfig found "
+                "(no --kubeconfig, $KUBECONFIG, or ~/.kube/config)"
+            )
+        conf = load_kubeconfig(resolved, context=context)
+        conf.update(kwargs)
+        return cls(**conf)
 
     # ---------------------------------------------------------------- paths
     def _job_path(self, kind: str, namespace: str, name: str = "") -> str:
@@ -673,11 +760,17 @@ class KubeCluster(Cluster):
         with self._informer_lock:
             self._stream_conns[kind] = conn
         try:
+            token_sent = self._token
             conn.request("GET", f"{path}?{urllib.parse.urlencode(query)}",
-                         headers=self._headers())
+                         headers=self._headers(token=token_sent))
             resp = conn.getresponse()
             if resp.status == 410:  # Gone: our rv aged out server-side
                 return ""
+            if resp.status == 401:
+                # Rotated SA token: refresh; the loop's error path re-opens
+                # the stream with the fresh credentials.
+                self._refresh_token(token_sent)
+                raise RuntimeError(f"watch {kind}: 401 (token refreshed, retrying)")
             if resp.status >= 400:
                 raise RuntimeError(f"watch {kind}: {resp.status}")
             buffer = b""

@@ -1,0 +1,120 @@
+"""KUBECONFIG resolution for the HTTP apiserver client.
+
+The reference resolves its client config from KUBECONFIG/--kubeconfig or
+falls back to in-cluster (cmd/tf-operator.v1/app/server.go:97-107 via
+clientcmd). This module reads the same YAML shape — clusters/users/contexts
+with `current-context` — and reduces the selected context to the keyword
+arguments `KubeCluster` takes: server URL, bearer token (inline or file),
+CA bundle (path or inline base64 data), client certificate pair, TLS skip,
+and context namespace.
+
+Inline `*-data` fields are materialized to private temp files (the ssl
+module only loads from paths); they live for the process lifetime.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import tempfile
+from typing import Optional
+
+__all__ = ["load_kubeconfig", "resolve_kubeconfig_path", "KubeconfigError"]
+
+
+class KubeconfigError(ValueError):
+    """Malformed or unusable kubeconfig."""
+
+
+def resolve_kubeconfig_path(explicit: Optional[str] = None) -> Optional[str]:
+    """--kubeconfig flag > $KUBECONFIG (first entry) > ~/.kube/config.
+    Returns None when nothing exists (caller falls back to in-cluster)."""
+    if explicit:
+        return explicit
+    env = os.environ.get("KUBECONFIG", "")
+    if env:
+        # Path-list semantics: kubectl merges; we take the first existing
+        # entry (merging multiple configs is out of scope for an operator
+        # that selects exactly one context).
+        for part in env.split(os.pathsep):
+            if part and os.path.exists(part):
+                return part
+        return None
+    default = os.path.expanduser("~/.kube/config")
+    return default if os.path.exists(default) else None
+
+
+def _named(entries, name: str, section: str) -> dict:
+    for entry in entries or []:
+        if entry.get("name") == name:
+            return entry
+    raise KubeconfigError(f"kubeconfig: {section} {name!r} not found")
+
+
+def _materialize(data_b64: str, suffix: str) -> str:
+    """Write base64 inline data to a 0600 temp file, return its path."""
+    try:
+        raw = base64.b64decode(data_b64)
+    except Exception as exc:  # noqa: BLE001
+        raise KubeconfigError(f"kubeconfig: invalid base64 {suffix} data: {exc}")
+    fd, path = tempfile.mkstemp(prefix="kubeconfig-", suffix=suffix)
+    try:
+        os.write(fd, raw)
+    finally:
+        os.close(fd)
+    return path
+
+
+def load_kubeconfig(path: str, context: Optional[str] = None) -> dict:
+    """Parse `path` and reduce `context` (default: current-context) to
+    KubeCluster keyword arguments."""
+    import yaml
+
+    with open(path) as f:
+        config = yaml.safe_load(f) or {}
+
+    ctx_name = context or config.get("current-context")
+    if not ctx_name:
+        raise KubeconfigError(
+            "kubeconfig: no context selected (no current-context and no "
+            "--kube-context)"
+        )
+    ctx = _named(config.get("contexts"), ctx_name, "context").get("context") or {}
+    cluster = _named(
+        config.get("clusters"), ctx.get("cluster", ""), "cluster"
+    ).get("cluster") or {}
+    user = _named(config.get("users"), ctx.get("user", ""), "user").get("user") or {}
+
+    server = cluster.get("server")
+    if not server:
+        raise KubeconfigError(f"kubeconfig: cluster for context {ctx_name!r} has no server")
+
+    out: dict = {"base_url": server}
+    if ctx.get("namespace"):
+        out["namespace"] = ctx["namespace"]
+    if cluster.get("insecure-skip-tls-verify"):
+        out["insecure"] = True
+    if cluster.get("certificate-authority"):
+        out["ca_file"] = cluster["certificate-authority"]
+    elif cluster.get("certificate-authority-data"):
+        out["ca_file"] = _materialize(cluster["certificate-authority-data"], ".ca.crt")
+
+    if user.get("token"):
+        out["token"] = user["token"]
+    elif user.get("tokenFile"):
+        out["token_file"] = user["tokenFile"]
+
+    cert = user.get("client-certificate")
+    key = user.get("client-key")
+    if not cert and user.get("client-certificate-data"):
+        cert = _materialize(user["client-certificate-data"], ".client.crt")
+    if not key and user.get("client-key-data"):
+        key = _materialize(user["client-key-data"], ".client.key")
+    if bool(cert) != bool(key):
+        raise KubeconfigError(
+            "kubeconfig: client-certificate and client-key must both be set"
+        )
+    if cert:
+        out["client_cert_file"] = cert
+        out["client_key_file"] = key
+    return out

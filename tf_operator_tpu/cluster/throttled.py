@@ -23,6 +23,7 @@ _WRITE_METHODS = (
     "update_pod",
     "delete_pod",
     "create_service",
+    "update_service",
     "delete_service",
     "record_event",
     "create_pod_group",

@@ -52,8 +52,15 @@ class StubApiServer:
     """HTTP facade over an InMemoryCluster. `mem` stays accessible so tests
     can simulate the kubelet (set_pod_phase) and inspect state."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 required_token: Optional[str] = None):
         self.mem = InMemoryCluster()
+        # Auth enforcement (None = accept anything): set/replace via
+        # set_required_token to exercise bearer rotation — requests carrying
+        # any other token get 401, like an apiserver after the bound SA
+        # token expired.
+        self._required_token = required_token
+        self._auth_lock = threading.Lock()
         stub = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -75,6 +82,15 @@ class StubApiServer:
                 return json.loads(self.rfile.read(length)) if length else {}
 
             def _dispatch(self, method: str) -> None:
+                with stub._auth_lock:
+                    required = stub._required_token
+                if required is not None:
+                    got = self.headers.get("Authorization", "")
+                    if got != f"Bearer {required}":
+                        return self._json(
+                            401, {"kind": "Status", "code": 401,
+                                  "message": "Unauthorized"}
+                        )
                 try:
                     stub._route(self, method)
                 except Conflict as exc:
@@ -107,6 +123,11 @@ class StubApiServer:
     @property
     def url(self) -> str:
         return f"http://127.0.0.1:{self.port}"
+
+    def set_required_token(self, token: Optional[str]) -> None:
+        """Rotate the accepted bearer token (None disables auth)."""
+        with self._auth_lock:
+            self._required_token = token
 
     def shutdown(self) -> None:
         self.httpd.shutdown()
