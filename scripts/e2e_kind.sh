@@ -51,9 +51,18 @@ spec:
               command: ["sh", "-c", "echo TF_CONFIG=$TF_CONFIG && sleep 5"]
 EOF
 
-kubectl --kubeconfig "$KUBECONFIG_PATH" wait tfjob/kind-smoke \
-    --for=jsonpath='{.status.conditions[?(@.type=="Succeeded")].status}'=True \
-    --timeout=300s
+# Poll for the Succeeded condition (kubectl wait's jsonpath filter form
+# needs >= 1.31; this loop works on any version).
+for _ in $(seq 60); do
+    state="$(kubectl --kubeconfig "$KUBECONFIG_PATH" get tfjob kind-smoke \
+        -o jsonpath='{.status.conditions[*].type}' 2>/dev/null || true)"
+    case " $state " in *" Succeeded "*) break ;; esac
+    sleep 5
+done
+case " $state " in
+    *" Succeeded "*) ;;
+    *) echo "FAIL: TFJob did not reach Succeeded (conditions: $state)"; exit 1 ;;
+esac
 
 echo "=== PASS: TFJob completed on a real apiserver"
 kubectl --kubeconfig "$KUBECONFIG_PATH" get tfjob kind-smoke -o yaml | sed -n '/status:/,$p'

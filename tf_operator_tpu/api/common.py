@@ -100,6 +100,8 @@ class ReplicaSpec:
     template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
     restart_policy: str = ""
 
+    __schema_required__ = ("template",)
+
 
 @dataclass
 class ReplicaStatus:

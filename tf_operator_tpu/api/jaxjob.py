@@ -137,6 +137,8 @@ class JAXJobSpec:
     # to the global chip count when both are known.
     mesh: Dict[str, int] = field(default_factory=dict)
 
+    __schema_required__ = ("jaxReplicaSpecs",)
+
 
 @dataclass
 class JAXJob(JobObject):

@@ -181,24 +181,57 @@ class ObjectMeta:
 class EnvVar:
     name: str = ""
     value: str = ""
+    # downward API / configMapKeyRef / secretKeyRef / fieldRef
+    value_from: Optional[Dict[str, Any]] = None
+
+    __schema_required__ = ("name",)
 
 
 @dataclass
 class ContainerPort:
     name: str = ""
     container_port: int = 0
+    protocol: str = ""
+
+
+@dataclass
+class VolumeMount:
+    name: str = ""
+    mount_path: str = ""
+    sub_path: str = ""
+    read_only: Optional[bool] = None
+
+    __schema_required__ = ("name", "mountPath")
 
 
 @dataclass
 class Container:
+    """The consumed subset of core/v1 Container, at the granularity the
+    reference's flattened CRD schema validates (manifests/base/crds/
+    kubeflow.org_tfjobs.yaml containers block). Fields beyond this subset
+    survive round-trips via the template-level preserve-unknown schema."""
+
     name: str = ""
     image: str = ""
     command: List[str] = field(default_factory=list)
     args: List[str] = field(default_factory=list)
     env: List[EnvVar] = field(default_factory=list)
+    env_from: List[Dict[str, Any]] = field(default_factory=list)
     ports: List[ContainerPort] = field(default_factory=list)
-    resources: Dict[str, Dict[str, str]] = field(default_factory=dict)
+    # Values stay loose (Any): resource quantities are int-or-string in
+    # core/v1 (cpu: 2 and cpu: "2" are both legal) and `claims` is a list —
+    # a Dict[str, Dict[str, str]] schema would 422 valid manifests.
+    resources: Dict[str, Any] = field(default_factory=dict)
+    volume_mounts: List[VolumeMount] = field(default_factory=list)
     working_dir: str = ""
+    image_pull_policy: str = ""
+    liveness_probe: Optional[Dict[str, Any]] = None
+    readiness_probe: Optional[Dict[str, Any]] = None
+    startup_probe: Optional[Dict[str, Any]] = None
+    security_context: Optional[Dict[str, Any]] = None
+    lifecycle: Optional[Dict[str, Any]] = None
+
+    __schema_required__ = ("name",)
 
     def set_env(self, name: str, value: str) -> None:
         self.env.append(EnvVar(name=name, value=str(value)))
@@ -213,14 +246,26 @@ class Container:
 @dataclass
 class PodSpec:
     containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
     restart_policy: str = ""
     scheduler_name: str = ""
     node_selector: Dict[str, str] = field(default_factory=dict)
     host_network: Optional[bool] = None
     subdomain: str = ""
+    hostname: str = ""
+    service_account_name: str = ""
+    priority_class_name: str = ""
+    termination_grace_period_seconds: Optional[int] = None
+    active_deadline_seconds: Optional[int] = None
+    volumes: List[Dict[str, Any]] = field(default_factory=list)
+    affinity: Optional[Dict[str, Any]] = None
+    security_context: Optional[Dict[str, Any]] = None
+    image_pull_secrets: List[Dict[str, Any]] = field(default_factory=list)
     # TPU-native: pod-slice topology request (maps to GKE's
     # cloud.google.com/gke-tpu-topology nodeSelector + google.com/tpu resource)
     tolerations: List[Dict[str, Any]] = field(default_factory=list)
+
+    __schema_required__ = ("containers",)
 
 
 @dataclass

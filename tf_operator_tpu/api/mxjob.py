@@ -66,6 +66,8 @@ class MXJobSpec:
     job_mode: str = JOB_MODE_TRAIN
     mx_replica_specs: Dict[ReplicaType, ReplicaSpec] = field(default_factory=dict)
 
+    __schema_required__ = ("mxReplicaSpecs",)
+
 
 @dataclass
 class MXJob(JobObject):

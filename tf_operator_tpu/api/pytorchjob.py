@@ -47,6 +47,8 @@ class PyTorchJobSpec:
     run_policy: RunPolicy = field(default_factory=RunPolicy)
     pytorch_replica_specs: Dict[ReplicaType, ReplicaSpec] = field(default_factory=dict)
 
+    __schema_required__ = ("pytorchReplicaSpecs",)
+
 
 @dataclass
 class PyTorchJob(JobObject):

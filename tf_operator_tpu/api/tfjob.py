@@ -82,6 +82,8 @@ class TFJobSpec:
     # tensorflow.go:62-83).
     enable_dynamic_worker: bool = False
 
+    __schema_required__ = ("tfReplicaSpecs",)
+
 
 @dataclass
 class TFJob(JobObject):

@@ -48,6 +48,8 @@ class XGBoostJobSpec:
     run_policy: RunPolicy = field(default_factory=RunPolicy)
     xgb_replica_specs: Dict[ReplicaType, ReplicaSpec] = field(default_factory=dict)
 
+    __schema_required__ = ("xgbReplicaSpecs",)
+
 
 @dataclass
 class XGBoostJob(JobObject):

@@ -491,6 +491,23 @@ def main(argv: Optional[List[str]] = None, cluster: Optional[Cluster] = None) ->
     _setup_logging(options.json_log_format)
     if cluster is None:
         kubeconfig = getattr(args, "kubeconfig", "")
+        if (
+            not kubeconfig
+            and getattr(args, "kube", False)
+            and not args.kube_url
+            and not args.kube_token
+            and not args.kube_insecure
+            and "KUBERNETES_SERVICE_HOST" not in os.environ
+        ):
+            # Out-of-cluster --kube with no explicit URL AND no explicit
+            # credential flags: fall back to the ambient kubeconfig before
+            # failing, like the reference's clientcmd. Explicit flags mean
+            # the user is describing a connection directly — honoring an
+            # ambient kubeconfig instead would silently connect somewhere
+            # else with other credentials.
+            from .cluster.kubeconfig import resolve_kubeconfig_path
+
+            kubeconfig = resolve_kubeconfig_path(None) or ""
         if kubeconfig:
             from .cluster.kube import KubeCluster
 
@@ -502,38 +519,12 @@ def main(argv: Optional[List[str]] = None, cluster: Optional[Cluster] = None) ->
         elif getattr(args, "kube", False) or args.kube_url:
             from .cluster.kube import KubeCluster
 
-            # Out-of-cluster with no explicit URL AND no explicit credential
-            # flags: fall back to the ambient kubeconfig before failing,
-            # like the reference's clientcmd. Explicit --kube-token /
-            # --kube-insecure mean the user is describing a connection
-            # directly — honoring an ambient kubeconfig instead would
-            # silently connect somewhere else with other credentials.
-            if (
-                not args.kube_url
-                and not args.kube_token
-                and not args.kube_insecure
-                and "KUBERNETES_SERVICE_HOST" not in os.environ
-            ):
-                from .cluster.kubeconfig import resolve_kubeconfig_path
-
-                ambient = resolve_kubeconfig_path(None)
-                if ambient is not None:
-                    cluster = KubeCluster.from_kubeconfig(
-                        ambient,
-                        context=getattr(args, "kube_context", "") or None,
-                        **(
-                            {"namespace": options.namespace}
-                            if options.namespace
-                            else {}
-                        ),
-                    )
-            if cluster is None:
-                cluster = KubeCluster(
-                    base_url=args.kube_url or None,
-                    token=args.kube_token or None,
-                    insecure=args.kube_insecure,
-                    namespace=options.namespace,
-                )
+            cluster = KubeCluster(
+                base_url=args.kube_url or None,
+                token=args.kube_token or None,
+                insecure=args.kube_insecure,
+                namespace=options.namespace,
+            )
         else:
             # Dev default: the in-repo cluster runtime; the real apiserver
             # backend plugs in through the same Cluster interface.

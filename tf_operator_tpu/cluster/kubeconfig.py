@@ -106,6 +106,22 @@ def load_kubeconfig(path: str, context: Optional[str] = None) -> dict:
 
     cert = user.get("client-certificate")
     key = user.get("client-key")
+    has_supported_auth = bool(
+        user.get("token") or user.get("tokenFile") or cert or key
+        or user.get("client-certificate-data") or user.get("client-key-data")
+    )
+    unsupported = [
+        k for k in ("exec", "auth-provider", "username", "password")
+        if user.get(k)
+    ]
+    if unsupported and not has_supported_auth:
+        # Silently producing an anonymous client here would start the
+        # operator and fail every request with an opaque 401.
+        raise KubeconfigError(
+            f"kubeconfig: user {ctx.get('user')!r} uses unsupported auth "
+            f"({', '.join(unsupported)}); supported: token, tokenFile, "
+            "client certificates"
+        )
     if not cert and user.get("client-certificate-data"):
         cert = _materialize(user["client-certificate-data"], ".client.crt")
     if not key and user.get("client-key-data"):

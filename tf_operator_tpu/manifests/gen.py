@@ -29,19 +29,19 @@ _PRIMITIVES = {
 }
 
 
-def _schema_for_type(tp: Any) -> Dict[str, Any]:
+def _schema_for_type(tp: Any, preserve: bool = False) -> Dict[str, Any]:
     origin = typing.get_origin(tp)
     args = typing.get_args(tp)
     if origin is Union:  # Optional[T] and friends
         non_none = [a for a in args if a is not type(None)]
         if len(non_none) == 1:
-            return _schema_for_type(non_none[0])
+            return _schema_for_type(non_none[0], preserve)
         return {"x-kubernetes-preserve-unknown-fields": True}
     if origin in (dict, Dict):
-        value_schema = _schema_for_type(args[1]) if len(args) == 2 else {}
+        value_schema = _schema_for_type(args[1], preserve) if len(args) == 2 else {}
         return {"type": "object", "additionalProperties": value_schema}
     if origin in (list, List):
-        item_schema = _schema_for_type(args[0]) if args else {}
+        item_schema = _schema_for_type(args[0], preserve) if args else {}
         return {"type": "array", "items": item_schema}
     if tp in _PRIMITIVES:
         return dict(_PRIMITIVES[tp])
@@ -49,20 +49,37 @@ def _schema_for_type(tp: Any) -> Dict[str, Any]:
         return {"x-kubernetes-preserve-unknown-fields": True}
     if dataclasses.is_dataclass(tp):
         if tp is PodTemplateSpec:
-            # Embedded pod template: defer validation to the apiserver.
-            return {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
-        return dataclass_schema(tp)
+            # The embedded pod template gets the full structural schema of
+            # the consumed subset (reference granularity: the flattened
+            # containers/env/resources/volumes block of
+            # manifests/base/crds/kubeflow.org_tfjobs.yaml) — a typo'd type
+            # is rejected at kubectl-apply time. Every object node below
+            # carries x-kubernetes-preserve-unknown-fields so VALID core/v1
+            # fields we don't model are preserved, not pruned.
+            preserve = True
+        return dataclass_schema(tp, preserve=preserve)
     return {"x-kubernetes-preserve-unknown-fields": True}
 
 
-def dataclass_schema(cls: type) -> Dict[str, Any]:
-    """openAPI v3 structural schema for a dataclass tree."""
+def dataclass_schema(cls: type, preserve: bool = False) -> Dict[str, Any]:
+    """openAPI v3 structural schema for a dataclass tree.
+
+    `preserve` marks this object (and its object descendants) with
+    x-kubernetes-preserve-unknown-fields: known fields are still
+    type-validated, unknown ones survive pruning. Dataclasses may declare
+    `__schema_required__` (camelCase names) for required fields."""
     hints = typing.get_type_hints(cls)
     properties = {}
     for f in dataclasses.fields(cls):
         key = f.metadata.get("json", _to_camel(f.name))
-        properties[key] = _schema_for_type(hints.get(f.name, Any))
-    return {"type": "object", "properties": properties}
+        properties[key] = _schema_for_type(hints.get(f.name, Any), preserve)
+    out: Dict[str, Any] = {"type": "object", "properties": properties}
+    required = list(getattr(cls, "__schema_required__", ()))
+    if required:
+        out["required"] = required
+    if preserve:
+        out["x-kubernetes-preserve-unknown-fields"] = True
+    return out
 
 
 def generate_crd(module) -> Dict[str, Any]:

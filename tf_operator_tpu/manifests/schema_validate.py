@@ -1,0 +1,96 @@
+"""Structural-schema validation (the server-side half of the CRD contract).
+
+Validates an object against the openAPIV3Schema subset `manifests/gen.py`
+emits — type checks on object/array/string/integer/number/boolean,
+`required` fields, recursion through properties/items/additionalProperties.
+Unknown fields follow apiextensions semantics: allowed (they would be
+pruned or preserved server-side), never a validation error.
+
+Used by the stub apiserver so a bad-field CR is rejected at create/update
+exactly as a real apiserver with the reference's flattened schema would
+reject it (manifests/base/crds/kubeflow.org_tfjobs.yaml)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+
+class SchemaError(ValueError):
+    """Object does not conform to the structural schema."""
+
+
+def _type_ok(expected: str, value: Any) -> bool:
+    if expected == "object":
+        return isinstance(value, dict)
+    if expected == "array":
+        return isinstance(value, (list, tuple))
+    if expected == "string":
+        return isinstance(value, str)
+    if expected == "boolean":
+        return isinstance(value, bool)
+    if expected == "integer":
+        return isinstance(value, int) and not isinstance(value, bool)
+    if expected == "number":
+        return (
+            isinstance(value, (int, float)) and not isinstance(value, bool)
+        )
+    return True  # unknown declared type: accept
+
+
+def validate_schema(schema: Dict[str, Any], obj: Any, path: str = "") -> None:
+    """Raise SchemaError at the first violation, naming the field path."""
+    if obj is None:
+        return  # null = unset; requiredness is enforced by the parent
+    expected = schema.get("type")
+    if expected and not _type_ok(expected, obj):
+        raise SchemaError(
+            f"{path or '<root>'}: expected {expected}, "
+            f"got {type(obj).__name__}: {obj!r}"
+        )
+    if isinstance(obj, dict):
+        for req in schema.get("required", ()):
+            if obj.get(req) is None:
+                raise SchemaError(f"{path or '<root>'}: missing required field {req!r}")
+        props = schema.get("properties") or {}
+        extra = schema.get("additionalProperties")
+        for key, val in obj.items():
+            if key in props:
+                validate_schema(props[key], val, f"{path}.{key}" if path else key)
+            elif isinstance(extra, dict) and extra:
+                validate_schema(extra, val, f"{path}.{key}" if path else key)
+            # unknown field: prune/preserve semantics — never an error
+    elif isinstance(obj, (list, tuple)):
+        items = schema.get("items")
+        if isinstance(items, dict) and items:
+            for i, val in enumerate(obj):
+                validate_schema(items, val, f"{path}[{i}]")
+
+
+_CRD_SCHEMAS: Dict[str, Dict[str, Any]] = {}
+
+
+def crd_schema_for(kind: str) -> Dict[str, Any]:
+    """The generated openAPIV3Schema for a job kind (cached)."""
+    if not _CRD_SCHEMAS:
+        from . import gen
+
+        # Build complete, then swap in atomically: a concurrent reader must
+        # never observe a partially-populated cache (ThreadingHTTPServer
+        # validates different kinds from different threads).
+        built = {
+            module.KIND: gen.generate_crd(module)["spec"]["versions"][0][
+                "schema"
+            ]["openAPIV3Schema"]
+            for module in gen._KIND_MODULES
+        }
+        _CRD_SCHEMAS.update(built)
+    try:
+        return _CRD_SCHEMAS[kind]
+    except KeyError:
+        raise SchemaError(f"no CRD schema for kind {kind!r}")
+
+
+def validate_job_dict(job_dict: dict) -> None:
+    """Validate a full CR dict against its kind's generated CRD schema."""
+    kind = job_dict.get("kind", "")
+    validate_schema(crd_schema_for(kind), job_dict)

@@ -22,6 +22,7 @@ from ..api.k8s import Event, Pod, Service, from_dict, to_dict
 from .. import api as api_pkg
 from ..cluster.base import Conflict
 from ..cluster.memory import InMemoryCluster
+from ..manifests.schema_validate import SchemaError, validate_job_dict
 
 _PLURAL_TO_KIND = {
     getattr(api_pkg, m).PLURAL: getattr(api_pkg, m).KIND
@@ -93,6 +94,11 @@ class StubApiServer:
                         )
                 try:
                     stub._route(self, method)
+                except SchemaError as exc:
+                    # Real apiservers answer 422 Unprocessable Entity for
+                    # schema violations on structurally-validated CRDs.
+                    self._json(422, {"kind": "Status", "code": 422,
+                                     "reason": "Invalid", "message": str(exc)})
                 except Conflict as exc:
                     self._json(409, {"kind": "Status", "code": 409, "message": str(exc)})
                 except KeyError:
@@ -123,6 +129,11 @@ class StubApiServer:
     @property
     def url(self) -> str:
         return f"http://127.0.0.1:{self.port}"
+
+    def _enforce_schema(self, handler, body: dict) -> None:
+        """CRD structural validation on writes (real-apiserver parity):
+        raises SchemaError -> 422 before anything is stored."""
+        validate_job_dict(body)
 
     def set_required_token(self, token: Optional[str]) -> None:
         """Rotate the accepted bearer token (None disables auth)."""
@@ -176,13 +187,17 @@ class StubApiServer:
         if method == "GET":
             return handler._json(200, self.mem.get_job(kind, ns, name))
         if method == "POST":
-            return handler._json(201, self.mem.create_job(handler._body()))
+            body = handler._body()
+            self._enforce_schema(handler, body)
+            return handler._json(201, self.mem.create_job(body))
         if method == "PUT" and m["status"]:
             # Status subresource PUT: replace status, ignore spec changes.
             status = handler._body().get("status", {})
             return handler._json(200, self.mem.update_job_status(kind, ns, name, status))
         if method == "PUT":
-            return handler._json(200, self.mem.update_job(handler._body()))
+            body = handler._body()
+            self._enforce_schema(handler, body)
+            return handler._json(200, self.mem.update_job(body))
         if method == "PATCH" and m["status"]:
             status = handler._body().get("status", {})
             return handler._json(200, self.mem.update_job_status(kind, ns, name, status))
