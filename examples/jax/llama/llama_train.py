@@ -52,9 +52,12 @@ def main(argv=None) -> int:
 
     topo, mesh = tpu_init()
     n = jax.device_count()
+    slice_note = (
+        f" slice={topo.slice_index}/{topo.num_slices}" if topo.num_slices > 1 else ""
+    )
     print(
         f"[llama] process {topo.process_id}/{topo.num_processes} devices={n} "
-        f"mesh={dict(zip(mesh.axis_names, mesh.devices.shape))}",
+        f"mesh={dict(zip(mesh.axis_names, mesh.devices.shape))}{slice_note}",
         flush=True,
     )
 

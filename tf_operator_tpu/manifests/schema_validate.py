@@ -29,7 +29,13 @@ def _type_ok(expected: str, value: Any) -> bool:
     if expected == "boolean":
         return isinstance(value, bool)
     if expected == "integer":
-        return isinstance(value, int) and not isinstance(value, bool)
+        if isinstance(value, bool):
+            return False
+        if isinstance(value, int):
+            return True
+        # JSON decoders may surface whole numbers as floats; go-openapi
+        # treats whole float64s as integers, so the stub must too.
+        return isinstance(value, float) and value.is_integer()
     if expected == "number":
         return (
             isinstance(value, (int, float)) and not isinstance(value, bool)
@@ -91,6 +97,11 @@ def crd_schema_for(kind: str) -> Dict[str, Any]:
 
 
 def validate_job_dict(job_dict: dict) -> None:
-    """Validate a full CR dict against its kind's generated CRD schema."""
+    """Validate a CR dict against its kind's generated CRD schema, with
+    status-subresource semantics: a main-resource write never validates (or
+    persists) .status — the apiserver strips it before validation, so a
+    re-applied exported CR carrying RFC3339 condition timestamps must not
+    422 here when a real apiserver would accept it."""
     kind = job_dict.get("kind", "")
-    validate_schema(crd_schema_for(kind), job_dict)
+    body = {k: v for k, v in job_dict.items() if k != "status"}
+    validate_schema(crd_schema_for(kind), body)

@@ -130,11 +130,6 @@ class StubApiServer:
     def url(self) -> str:
         return f"http://127.0.0.1:{self.port}"
 
-    def _enforce_schema(self, handler, body: dict) -> None:
-        """CRD structural validation on writes (real-apiserver parity):
-        raises SchemaError -> 422 before anything is stored."""
-        validate_job_dict(body)
-
     def set_required_token(self, token: Optional[str]) -> None:
         """Rotate the accepted bearer token (None disables auth)."""
         with self._auth_lock:
@@ -188,7 +183,7 @@ class StubApiServer:
             return handler._json(200, self.mem.get_job(kind, ns, name))
         if method == "POST":
             body = handler._body()
-            self._enforce_schema(handler, body)
+            validate_job_dict(body)
             return handler._json(201, self.mem.create_job(body))
         if method == "PUT" and m["status"]:
             # Status subresource PUT: replace status, ignore spec changes.
@@ -196,7 +191,7 @@ class StubApiServer:
             return handler._json(200, self.mem.update_job_status(kind, ns, name, status))
         if method == "PUT":
             body = handler._body()
-            self._enforce_schema(handler, body)
+            validate_job_dict(body)
             return handler._json(200, self.mem.update_job(body))
         if method == "PATCH" and m["status"]:
             status = handler._body().get("status", {})
