@@ -380,3 +380,46 @@ class TestServerSideSchemaValidation:
         job["spec"]["tfReplicaSpecs"]["Worker"]["replicas"] = "three"
         with pytest.raises(RuntimeError, match="422"):
             kube.update_job(job)
+
+
+class TestLogStreaming:
+    """KubeCluster pods/log?follow=true: a real chunked HTTP stream that
+    delivers increments live and closes on pod termination."""
+
+    def test_stream_follow_delivers_increments_then_closes(self, stub, kube):
+        import threading
+        import time
+
+        from tf_operator_tpu.api.k8s import Container, ObjectMeta, Pod, PodSpec
+
+        stub.mem.create_pod(Pod(
+            metadata=ObjectMeta(name="p0", namespace="default"),
+            spec=PodSpec(containers=[Container(name="c", image="i")]),
+        ))
+        stub.mem.set_pod_phase("default", "p0", "Running")
+        stub.mem.append_pod_log("default", "p0", "early\n")
+
+        chunks = []
+        done = threading.Event()
+
+        def consume():
+            for chunk in kube.stream_pod_log("default", "p0", follow=True):
+                chunks.append((time.monotonic(), chunk))
+            done.set()
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 10
+        while not chunks and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert chunks, "no live chunk before termination"
+
+        stub.mem.append_pod_log("default", "p0", "mid\n")
+        time.sleep(0.3)
+        stub.mem.append_pod_log("default", "p0", "late\n")
+        stub.mem.set_pod_phase("default", "p0", "Succeeded")
+        assert done.wait(10), "stream did not close on termination"
+        text = "".join(c for _, c in chunks)
+        assert text == "early\nmid\nlate\n"
+        # Live-ness: the first chunk arrived well before the final append.
+        assert len(chunks) >= 2

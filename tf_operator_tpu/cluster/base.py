@@ -9,9 +9,10 @@ in-memory simulator and a real API server.
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Dict, List, Optional
 
-from ..api.k8s import Event, Pod, Service
+from ..api.k8s import POD_FAILED, POD_SUCCEEDED, Event, Pod, Service
 
 
 class NotFound(KeyError):
@@ -81,6 +82,42 @@ class Cluster:
     def get_pod_log(self, namespace: str, name: str) -> str:
         """Container log text for a pod (SDK get_logs; kube `pods/log`)."""
         raise NotImplementedError
+
+    def stream_pod_log(self, namespace: str, name: str, follow: bool = False,
+                       poll_interval: float = 0.2):
+        """Yield log text chunks; with ``follow``, keep yielding as the log
+        grows until the pod reaches a terminal phase or vanishes (then flush
+        the remainder and stop) — kube `pods/log?follow=true`.
+
+        Default implementation polls get_pod_log/get_pod (correct for the
+        in-memory and process backends); the HTTP backend overrides with a
+        real streaming request."""
+        offset = 0
+        while True:
+            try:
+                text = self.get_pod_log(namespace, name)
+            except NotFound:
+                return
+            if len(text) > offset:
+                yield text[offset:]
+                offset = len(text)
+            if not follow:
+                return
+            try:
+                pod = self.get_pod(namespace, name)
+            except NotFound:
+                return
+            if pod.status.phase in (POD_SUCCEEDED, POD_FAILED):
+                # One final read: flush anything written between the log
+                # read above and the phase observation.
+                try:
+                    final = self.get_pod_log(namespace, name)
+                except NotFound:
+                    return
+                if len(final) > offset:
+                    yield final[offset:]
+                return
+            time.sleep(poll_interval)
 
     def delete_pod(self, namespace: str, name: str) -> None:
         raise NotImplementedError

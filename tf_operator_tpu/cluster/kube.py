@@ -438,6 +438,41 @@ class KubeCluster(Cluster):
         finally:
             conn.close()
 
+    def stream_pod_log(self, namespace: str, name: str, follow: bool = False,
+                       poll_interval: float = 0.2):
+        """Real `pods/log?follow=true` streaming: one long-lived chunked
+        response, yielded as it arrives; the apiserver closes the stream
+        when the container terminates."""
+        if not follow:
+            yield self.get_pod_log(namespace, name)
+            return
+        # A quiet pod (training between log lines) must not kill the
+        # stream: _connect(None) would apply the default 30s socket
+        # timeout, so pass an explicitly long one (same workaround as the
+        # watch path); the server closes the stream on pod termination.
+        conn = self._connect(timeout=86400.0)
+        try:
+            conn.request(
+                "GET",
+                self._core_path("pods", namespace, name) + "/log?follow=true",
+                headers=self._headers(),
+            )
+            resp = conn.getresponse()
+            if resp.status == 404:
+                raise NotFound(f"pod {namespace}/{name}")
+            if resp.status >= 400:
+                data = resp.read()
+                raise RuntimeError(
+                    f"pod log {namespace}/{name}: {resp.status} {data[:200]!r}"
+                )
+            while True:
+                chunk = resp.read1(65536)
+                if not chunk:
+                    return
+                yield chunk.decode("utf-8", errors="replace")
+        finally:
+            conn.close()
+
     def delete_pod(self, namespace: str, name: str) -> None:
         self._request("DELETE", self._core_path("pods", namespace, name))
 

@@ -347,6 +347,39 @@ class LocalProcessCluster(InMemoryCluster):
                 return f.read().decode("utf-8", errors="replace")
         return super().get_pod_log(namespace, name)
 
+    def stream_pod_log(self, namespace: str, name: str, follow: bool = False,
+                       poll_interval: float = 0.2):
+        """Seek-based tail of the pod's log file: each poll reads only the
+        appended bytes (the generic base implementation re-reads the whole
+        log every poll — O(n^2) over a long follow)."""
+        import time as time_mod
+
+        key = (namespace, name)
+        with self._lock:
+            path = self._log_paths.get(key)
+        if not (path and os.path.exists(path)):
+            yield from super().stream_pod_log(
+                namespace, name, follow=follow, poll_interval=poll_interval
+            )
+            return
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read()
+                if chunk:
+                    yield chunk.decode("utf-8", errors="replace")
+                if not follow:
+                    return
+                try:
+                    phase = self.get_pod(namespace, name).status.phase
+                except NotFound:
+                    return
+                if phase in ("Succeeded", "Failed"):
+                    final = f.read()
+                    if final:
+                        yield final.decode("utf-8", errors="replace")
+                    return
+                time_mod.sleep(poll_interval)
+
     def step(self) -> None:
         """Manual tick: trigger a scheduling pass + reap (the background
         reaper usually does both)."""

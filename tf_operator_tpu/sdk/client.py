@@ -391,9 +391,16 @@ class JobClient:
         master: bool = True,
         replica_type: Optional[str] = None,
         replica_index: Optional[int] = None,
-    ) -> Dict[str, str]:
+        follow: bool = False,
+        timeout: Optional[float] = None,
+    ):
         """Pod name -> log text. Defaults to the master pod, falling back to
-        all pods when no master exists (reference get_logs :403-441)."""
+        all pods when no master exists (reference get_logs :403-441).
+
+        With ``follow=True``, returns an iterator of ``(pod_name, line)``
+        multiplexing every selected replica's live stream (the reference
+        follows multiple pods' streams, tf_job_client.py:387-441); it ends
+        when every followed pod terminates, or at ``timeout`` seconds."""
         pod_names = self.get_pod_names(
             name, namespace, master=master,
             replica_type=replica_type, replica_index=replica_index,
@@ -402,7 +409,90 @@ class JobClient:
             pod_names = self.get_pod_names(
                 name, namespace, replica_type=replica_type, replica_index=replica_index
             )
+        if follow:
+            return self._follow_logs(namespace, sorted(pod_names), timeout)
         return {p: self.cluster.get_pod_log(namespace, p) for p in pod_names}
+
+    def _follow_logs(self, namespace: str, pod_names, timeout: Optional[float]):
+        """One reader thread per pod feeding a shared bounded queue; lines
+        yield in arrival order, tagged with their pod. When the consumer
+        stops (timeout, break, GC of the generator), readers are signalled
+        and wind down — no leaked connections or unbounded buffering. A pod
+        that vanishes mid-follow ends its stream quietly (matching the
+        polling backend); other stream errors are logged, never injected
+        into the output as fake log lines."""
+        import logging
+        import queue as queue_mod
+        import threading
+        import time as time_mod
+
+        out: queue_mod.Queue = queue_mod.Queue(maxsize=1024)
+        stopped = threading.Event()
+        sentinel = object()
+        log = logging.getLogger(__name__)
+
+        def emit(item) -> bool:
+            while not stopped.is_set():
+                try:
+                    out.put(item, timeout=0.2)
+                    return True
+                except queue_mod.Full:
+                    continue
+            return False
+
+        def reader(pod: str) -> None:
+            from ..cluster.base import NotFound
+
+            buf = ""
+            try:
+                for chunk in self.cluster.stream_pod_log(
+                    namespace, pod, follow=True
+                ):
+                    if stopped.is_set():
+                        return
+                    buf += chunk
+                    while "\n" in buf:
+                        line, buf = buf.split("\n", 1)
+                        if not emit((pod, line)):
+                            return
+            except NotFound:
+                pass  # pod vanished mid-follow: clean end of stream
+            except Exception:  # noqa: BLE001 — log, don't fake pod output
+                log.warning("log stream for %s/%s failed", namespace, pod,
+                            exc_info=True)
+            finally:
+                if buf:
+                    emit((pod, buf))
+                emit((pod, sentinel))
+
+        threads = [
+            threading.Thread(target=reader, args=(p,), daemon=True,
+                             name=f"log-follow-{p}")
+            for p in pod_names
+        ]
+        for t in threads:
+            t.start()
+        alive = len(threads)
+        deadline = (
+            time_mod.monotonic() + timeout if timeout is not None else None
+        )
+        try:
+            while alive:
+                wait = 0.2
+                if deadline is not None:
+                    wait = min(wait, deadline - time_mod.monotonic())
+                    if wait <= 0:
+                        return
+                try:
+                    pod, item = out.get(timeout=wait)
+                except queue_mod.Empty:
+                    continue
+                if item is sentinel:
+                    alive -= 1
+                    continue
+                yield pod, item
+        finally:
+            stopped.set()
 
 
 class TFJobClient(JobClient):

@@ -184,6 +184,10 @@ class StubApiServer:
         if method == "POST":
             body = handler._body()
             validate_job_dict(body)
+            # Status-subresource semantics: a main-resource write never
+            # persists client-supplied .status (a re-applied exported CR
+            # must not seed a stale Succeeded no controller wrote).
+            body.pop("status", None)
             return handler._json(201, self.mem.create_job(body))
         if method == "PUT" and m["status"]:
             # Status subresource PUT: replace status, ignore spec changes.
@@ -205,6 +209,8 @@ class StubApiServer:
         ns, resource, name = m["ns"], m["resource"], m["name"]
         if resource == "pods":
             if method == "GET" and name and m["log"]:
+                if q.get("follow", ["false"])[0] == "true":
+                    return self._stream_log(handler, ns, name)
                 log = self.mem.get_pod_log(ns, name)
                 body = log.encode()
                 handler.send_response(200)
@@ -248,6 +254,45 @@ class StubApiServer:
             if method == "GET":
                 return self._events_list(handler, q, ns=ns)
         raise KeyError(resource)
+
+    def _stream_log(self, handler, ns: str, name: str) -> None:
+        """`pods/log?follow=true`: chunked streaming that tracks the growing
+        log and closes when the pod reaches a terminal phase (what a real
+        apiserver does when the container exits)."""
+        handler.send_response(200)
+        handler.send_header("Transfer-Encoding", "chunked")
+        handler.end_headers()
+
+        def send_chunk(text: str) -> None:
+            data = text.encode()
+            handler.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            handler.wfile.flush()
+
+        offset = 0
+        try:
+            while True:
+                try:
+                    text = self.mem.get_pod_log(ns, name)
+                    phase = self.mem.get_pod(ns, name).status.phase
+                except Exception:  # noqa: BLE001 — pod vanished mid-follow
+                    break
+                if len(text) > offset:
+                    send_chunk(text[offset:])
+                    offset = len(text)
+                if phase in ("Succeeded", "Failed"):
+                    final = self.mem.get_pod_log(ns, name)
+                    if len(final) > offset:
+                        send_chunk(final[offset:])
+                    break
+                import time
+
+                time.sleep(0.05)
+        finally:
+            try:
+                handler.wfile.write(b"0\r\n\r\n")
+                handler.wfile.flush()
+            except Exception:  # noqa: BLE001 — client hung up
+                pass
 
     def _events_list(self, handler, q, ns: Optional[str] = None) -> None:
         # fieldSelector narrowing (involvedObject.kind/name), the server-side
