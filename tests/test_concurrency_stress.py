@@ -169,3 +169,133 @@ def test_counters_exact_under_concurrency():
         ) == 15
     finally:
         manager.stop()
+
+
+def test_hundred_jobs_with_churn_scale_proof(capsys):
+    """The reference design point is O(100) concurrent jobs per cluster
+    (docs/design/tf_job_design_doc.md:24-29). 100 jobs x 3 workers under
+    8 worker threads with live churn — retryable kills, mid-run deletions,
+    permanent failures — must converge to exact terminal states and exact
+    counters, with reconcile latency fit for the scale (p90 published to
+    BASELINE.md)."""
+    cluster = InMemoryCluster()
+    metrics = Metrics()
+    manager = OperatorManager(
+        cluster,
+        OperatorOptions(enabled_schemes=["TFJob"], threadiness=8,
+                        resync_period=0.5, health_port=0, metrics_port=0),
+        metrics=metrics,
+    )
+    manager.start()
+    N = 100
+    try:
+        # Concurrent submission from 4 threads.
+        def submit(base):
+            for i in range(base, N, 4):
+                cluster.create_job(tfjob(f"s{i}"))
+
+        threads = [threading.Thread(target=submit, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        assert wait_until(
+            lambda: len(cluster.list_pods("default")) == 3 * N, timeout=120
+        ), f"pods: {len(cluster.list_pods('default'))}"
+        for pod in cluster.list_pods("default"):
+            cluster.set_pod_phase("default", pod.metadata.name, "Running")
+
+        # Churn, concurrently:
+        #   s0-s69: run to success (s40-s69 first lose worker-1 to a
+        #           retryable exit 130 and must restart it);
+        #   s70-s89: deleted mid-run;
+        #   s90-s99: worker-0 exits 1 -> permanent failure.
+        def kill_retryable():
+            for i in range(40, 70):
+                cluster.set_pod_phase("default", f"s{i}-worker-1", "Failed",
+                                      exit_code=130, container_name="tensorflow")
+
+        def delete_mid_run():
+            for i in range(70, 90):
+                cluster.delete_job("TFJob", "default", f"s{i}")
+
+        def fail_permanent():
+            for i in range(90, 100):
+                cluster.set_pod_phase("default", f"s{i}-worker-0", "Failed",
+                                      exit_code=1, container_name="tensorflow")
+
+        churn = [threading.Thread(target=f)
+                 for f in (kill_retryable, delete_mid_run, fail_permanent)]
+        for t in churn:
+            t.start()
+        for t in churn:
+            t.join()
+
+        # Every killed worker-1 must be recreated and Running again.
+        def all_restarted():
+            for i in range(40, 70):
+                try:
+                    pod = cluster.get_pod("default", f"s{i}-worker-1")
+                except Exception:
+                    return False
+                if pod.status.phase != "Running":
+                    if pod.status.phase == "Pending":
+                        cluster.set_pod_phase(
+                            "default", pod.metadata.name, "Running")
+                    return False
+            return True
+
+        assert wait_until(all_restarted, timeout=120), "restarts incomplete"
+
+        # Drive the survivors to completion: worker-0 exit 0.
+        for i in range(0, 70):
+            cluster.set_pod_phase("default", f"s{i}-worker-0", "Succeeded",
+                                  exit_code=0, container_name="tensorflow")
+
+        def conds(name):
+            try:
+                job = cluster.get_job("TFJob", "default", name)
+            except Exception:
+                return {}
+            return {c["type"]: c["status"]
+                    for c in (job.get("status") or {}).get("conditions") or []}
+
+        assert wait_until(
+            lambda: all(conds(f"s{i}").get("Succeeded") == "True"
+                        for i in range(0, 70)),
+            timeout=120,
+        ), "not all survivors Succeeded"
+        assert wait_until(
+            lambda: all(conds(f"s{i}").get("Failed") == "True"
+                        for i in range(90, 100)),
+            timeout=60,
+        ), "not all permanent failures Failed"
+        for i in range(70, 90):
+            assert conds(f"s{i}") == {}, f"deleted job s{i} still has status"
+
+        # Exact terminal counters (framework label = TFJob).
+        def counter(name):
+            return metrics.counter_value(
+                f"training_operator_jobs_{name}_total", "default", "TFJob")
+
+        assert counter("created") == N
+        assert counter("successful") == 70
+        assert counter("failed") == 10
+        assert counter("restarted") >= 30  # one per retryable kill, at least
+
+        # Reconcile latency at scale, published for BASELINE.md.
+        samples = metrics.histogram_values(
+            "training_operator_reconcile_duration_seconds", "default", "TFJob")
+        assert samples, "no reconcile samples recorded"
+        import math
+
+        xs = sorted(samples)
+        p50 = xs[max(0, math.ceil(0.5 * len(xs)) - 1)]
+        p90 = xs[max(0, math.ceil(0.9 * len(xs)) - 1)]
+        with capsys.disabled():
+            print(f"\n[scale-proof] 100 jobs churn: reconcile p50={p50*1000:.1f}ms "
+                  f"p90={p90*1000:.1f}ms samples={len(xs)}")
+        assert p90 < 1.0, f"reconcile p90 {p90:.3f}s is not O(100)-jobs fit"
+    finally:
+        manager.stop()

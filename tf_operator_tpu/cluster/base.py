@@ -84,16 +84,22 @@ class Cluster:
         raise NotImplementedError
 
     def stream_pod_log(self, namespace: str, name: str, follow: bool = False,
-                       poll_interval: float = 0.2):
+                       poll_interval: float = 0.2, stop=None):
         """Yield log text chunks; with ``follow``, keep yielding as the log
-        grows until the pod reaches a terminal phase or vanishes (then flush
-        the remainder and stop) — kube `pods/log?follow=true`.
+        grows until the pod reaches a terminal phase, vanishes, or is
+        REPLACED (same name, new UID — the stream follows one pod
+        incarnation, like `kubectl logs -f` ending when its pod goes away) —
+        kube `pods/log?follow=true`. ``stop`` (a threading.Event) cancels a
+        follow promptly so abandoned consumers don't leak pollers.
 
         Default implementation polls get_pod_log/get_pod (correct for the
-        in-memory and process backends); the HTTP backend overrides with a
-        real streaming request."""
+        in-memory backend); the HTTP and process backends override."""
+        try:
+            uid = self.get_pod(namespace, name).metadata.uid
+        except NotFound:
+            return
         offset = 0
-        while True:
+        while not (stop is not None and stop.is_set()):
             try:
                 text = self.get_pod_log(namespace, name)
             except NotFound:
@@ -107,6 +113,8 @@ class Cluster:
                 pod = self.get_pod(namespace, name)
             except NotFound:
                 return
+            if pod.metadata.uid != uid:
+                return  # replaced by a same-name pod: this stream is over
             if pod.status.phase in (POD_SUCCEEDED, POD_FAILED):
                 # One final read: flush anything written between the log
                 # read above and the phase observation.

@@ -439,10 +439,17 @@ class KubeCluster(Cluster):
             conn.close()
 
     def stream_pod_log(self, namespace: str, name: str, follow: bool = False,
-                       poll_interval: float = 0.2):
+                       poll_interval: float = 0.2, stop=None):
         """Real `pods/log?follow=true` streaming: one long-lived chunked
         response, yielded as it arrives; the apiserver closes the stream
-        when the container terminates."""
+        when the container terminates. ``stop`` severs the socket from a
+        sidecar watcher — a reader blocked in read1 on a quiet pod cannot
+        check an event cooperatively, and without the sever an abandoned
+        follow would leak the connection for up to the 86400s socket
+        timeout. Incremental UTF-8 decode: a multibyte char split across a
+        read boundary must not become U+FFFD."""
+        import codecs
+
         if not follow:
             yield self.get_pod_log(namespace, name)
             return
@@ -451,6 +458,32 @@ class KubeCluster(Cluster):
         # timeout, so pass an explicitly long one (same workaround as the
         # watch path); the server closes the stream on pod termination.
         conn = self._connect(timeout=86400.0)
+        done = threading.Event()
+        if stop is not None:
+            def sever() -> None:
+                import socket as socket_mod
+
+                while not done.is_set():
+                    if stop.wait(0.2):
+                        if not done.is_set():
+                            sock = conn.sock
+                            try:
+                                # shutdown() interrupts a recv blocked in
+                                # another thread; close() alone does not.
+                                sock and sock.shutdown(socket_mod.SHUT_RDWR)
+                            except Exception:  # noqa: BLE001
+                                pass
+                            try:
+                                sock and sock.close()
+                            except Exception:  # noqa: BLE001
+                                pass
+                        return
+                    if done.is_set():
+                        return
+
+            threading.Thread(target=sever, daemon=True,
+                             name=f"log-sever-{name}").start()
+        decoder = codecs.getincrementaldecoder("utf-8")(errors="replace")
         try:
             conn.request(
                 "GET",
@@ -466,11 +499,20 @@ class KubeCluster(Cluster):
                     f"pod log {namespace}/{name}: {resp.status} {data[:200]!r}"
                 )
             while True:
-                chunk = resp.read1(65536)
+                try:
+                    chunk = resp.read1(65536)
+                except (OSError, http.client.HTTPException):
+                    return  # severed by stop, or the server went away
                 if not chunk:
+                    text = decoder.decode(b"", final=True)
+                    if text:
+                        yield text
                     return
-                yield chunk.decode("utf-8", errors="replace")
+                text = decoder.decode(chunk)
+                if text:
+                    yield text
         finally:
+            done.set()
             conn.close()
 
     def delete_pod(self, namespace: str, name: str) -> None:

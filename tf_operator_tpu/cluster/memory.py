@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import copy
 import itertools
+import logging
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
@@ -37,6 +38,8 @@ from ..api.k8s import (
 from . import base
 from .base import ADDED, DELETED, MODIFIED, Conflict, NotFound
 
+_log = logging.getLogger(__name__)
+
 
 class InMemoryCluster(base.Cluster):
     def __init__(self, clock=time.time):
@@ -51,14 +54,36 @@ class InMemoryCluster(base.Cluster):
         self._leases: Dict[Tuple[str, str], dict] = {}
         self._events: List[Event] = []
         self._watchers: Dict[str, List[base.WatchHandler]] = {}
+        self._emit_local = threading.local()
         # pod name -> behavior fn(pod) called on each step() while running
         self._behaviors: Dict[Tuple[str, str], Callable[[Pod], None]] = {}
         self._pod_logs: Dict[Tuple[str, str], str] = {}
 
     # ------------------------------------------------------------------ util
     def _emit(self, kind: str, event_type: str, obj) -> None:
-        for handler in self._watchers.get(kind, []):
-            handler(event_type, copy.deepcopy(obj))
+        """Deliver to subscribers in CAUSAL order even when a handler writes
+        back: a handler that mutates state mid-dispatch (e.g. a kubelet sim
+        marking a new pod Running) triggers a nested emit, and delivering
+        that nested event inline would hand later subscribers the MODIFIED
+        before the ADDED that caused it — regressing their view of the
+        object. Nested emits queue behind the in-flight event; the
+        outermost call drains in order. Handler errors log-and-continue
+        (one bad subscriber must not corrupt the stream for the rest)."""
+        queue = self._emit_local.__dict__.setdefault("queue", [])
+        queue.append((kind, event_type, obj))
+        if self._emit_local.__dict__.get("active"):
+            return
+        self._emit_local.active = True
+        try:
+            while queue:
+                k, e, o = queue.pop(0)
+                for handler in self._watchers.get(k, []):
+                    try:
+                        handler(e, copy.deepcopy(o))
+                    except Exception:  # noqa: BLE001
+                        _log.exception("watch handler for %s failed", k)
+        finally:
+            self._emit_local.active = False
 
     def watch(self, kind: str, handler: base.WatchHandler) -> None:
         with self._lock:

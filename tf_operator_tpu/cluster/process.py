@@ -348,10 +348,15 @@ class LocalProcessCluster(InMemoryCluster):
         return super().get_pod_log(namespace, name)
 
     def stream_pod_log(self, namespace: str, name: str, follow: bool = False,
-                       poll_interval: float = 0.2):
+                       poll_interval: float = 0.2, stop=None):
         """Seek-based tail of the pod's log file: each poll reads only the
         appended bytes (the generic base implementation re-reads the whole
-        log every poll — O(n^2) over a long follow)."""
+        log every poll — O(n^2) over a long follow). The stream is bound to
+        one pod incarnation: a same-name replacement (restart flow) has a
+        new log file, so a UID change ends this stream rather than silently
+        tailing the dead file forever. Multibyte UTF-8 split across read
+        boundaries decodes incrementally, not per-chunk."""
+        import codecs
         import time as time_mod
 
         key = (namespace, name)
@@ -359,24 +364,34 @@ class LocalProcessCluster(InMemoryCluster):
             path = self._log_paths.get(key)
         if not (path and os.path.exists(path)):
             yield from super().stream_pod_log(
-                namespace, name, follow=follow, poll_interval=poll_interval
+                namespace, name, follow=follow, poll_interval=poll_interval,
+                stop=stop,
             )
             return
+        try:
+            uid = self.get_pod(namespace, name).metadata.uid
+        except NotFound:
+            return
+        decoder = codecs.getincrementaldecoder("utf-8")(errors="replace")
         with open(path, "rb") as f:
-            while True:
+            while not (stop is not None and stop.is_set()):
                 chunk = f.read()
                 if chunk:
-                    yield chunk.decode("utf-8", errors="replace")
+                    text = decoder.decode(chunk)
+                    if text:
+                        yield text
                 if not follow:
                     return
                 try:
-                    phase = self.get_pod(namespace, name).status.phase
+                    pod = self.get_pod(namespace, name)
                 except NotFound:
                     return
-                if phase in ("Succeeded", "Failed"):
-                    final = f.read()
+                if pod.metadata.uid != uid:
+                    return  # replaced: its output lives in a new file
+                if pod.status.phase in ("Succeeded", "Failed"):
+                    final = decoder.decode(f.read(), final=True)
                     if final:
-                        yield final.decode("utf-8", errors="replace")
+                        yield final
                     return
                 time_mod.sleep(poll_interval)
 

@@ -446,7 +446,7 @@ class JobClient:
             buf = ""
             try:
                 for chunk in self.cluster.stream_pod_log(
-                    namespace, pod, follow=True
+                    namespace, pod, follow=True, stop=stopped
                 ):
                     if stopped.is_set():
                         return

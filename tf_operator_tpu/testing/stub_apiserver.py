@@ -256,37 +256,23 @@ class StubApiServer:
         raise KeyError(resource)
 
     def _stream_log(self, handler, ns: str, name: str) -> None:
-        """`pods/log?follow=true`: chunked streaming that tracks the growing
-        log and closes when the pod reaches a terminal phase (what a real
-        apiserver does when the container exits)."""
+        """`pods/log?follow=true`: chunked streaming over the backend's own
+        follow generator (single-sourced semantics — growth tracking,
+        terminal flush, replacement-pod cutoff all live in
+        Cluster.stream_pod_log). A client hangup is noticed at the next
+        chunk write, like a real apiserver's log stream."""
         handler.send_response(200)
         handler.send_header("Transfer-Encoding", "chunked")
         handler.end_headers()
-
-        def send_chunk(text: str) -> None:
-            data = text.encode()
-            handler.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
-            handler.wfile.flush()
-
-        offset = 0
         try:
-            while True:
-                try:
-                    text = self.mem.get_pod_log(ns, name)
-                    phase = self.mem.get_pod(ns, name).status.phase
-                except Exception:  # noqa: BLE001 — pod vanished mid-follow
-                    break
-                if len(text) > offset:
-                    send_chunk(text[offset:])
-                    offset = len(text)
-                if phase in ("Succeeded", "Failed"):
-                    final = self.mem.get_pod_log(ns, name)
-                    if len(final) > offset:
-                        send_chunk(final[offset:])
-                    break
-                import time
-
-                time.sleep(0.05)
+            for text in self.mem.stream_pod_log(ns, name, follow=True,
+                                                poll_interval=0.05):
+                data = text.encode()
+                handler.wfile.write(
+                    f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                handler.wfile.flush()
+        except Exception:  # noqa: BLE001 — client hung up / pod vanished
+            pass
         finally:
             try:
                 handler.wfile.write(b"0\r\n\r\n")
