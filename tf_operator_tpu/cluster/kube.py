@@ -465,18 +465,24 @@ class KubeCluster(Cluster):
 
                 while not done.is_set():
                     if stop.wait(0.2):
-                        if not done.is_set():
+                        # Keep waiting for the socket if the reader is
+                        # still mid connection setup — returning on a None
+                        # sock would make the follow uncancellable.
+                        while not done.is_set():
                             sock = conn.sock
-                            try:
-                                # shutdown() interrupts a recv blocked in
-                                # another thread; close() alone does not.
-                                sock and sock.shutdown(socket_mod.SHUT_RDWR)
-                            except Exception:  # noqa: BLE001
-                                pass
-                            try:
-                                sock and sock.close()
-                            except Exception:  # noqa: BLE001
-                                pass
+                            if sock is not None:
+                                try:
+                                    # shutdown() interrupts a recv blocked
+                                    # in another thread; close() does not.
+                                    sock.shutdown(socket_mod.SHUT_RDWR)
+                                except Exception:  # noqa: BLE001
+                                    pass
+                                try:
+                                    sock.close()
+                                except Exception:  # noqa: BLE001
+                                    pass
+                                return
+                            done.wait(0.1)
                         return
                     if done.is_set():
                         return
@@ -502,7 +508,10 @@ class KubeCluster(Cluster):
                 try:
                     chunk = resp.read1(65536)
                 except (OSError, http.client.HTTPException):
-                    return  # severed by stop, or the server went away
+                    if stop is not None and stop.is_set():
+                        return  # severed by stop: clean cancellation
+                    raise  # real network failure: a silent return would
+                    # masquerade as pod completion and truncate the follow
                 if not chunk:
                     text = decoder.decode(b"", final=True)
                     if text:
