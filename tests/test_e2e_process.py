@@ -157,6 +157,40 @@ class TestTFJobTestServer:
             assert len(cfg["cluster_spec"]["worker"]) == 2
             assert not cfg["is_chief"]
 
+    def test_chief_topology_master_is_chief(self, harness):
+        """distributed_training_tests analog (master_is_chief): with a Chief
+        replica, ITS completion ends the job even while workers run, and
+        every replica's observed RunConfig reflects the chief topology
+        (reference shutdown_policy_tests.py:85-96 + estimator_runconfig)."""
+        manifest = tfjob_manifest("ct", workers=2, clean_pod_policy="None")
+        manifest["spec"]["tfReplicaSpecs"]["Chief"] = {
+            "replicas": 1,
+            "template": {"spec": {"containers": [
+                {"name": "tensorflow", "image": "local",
+                 "command": TEST_SERVER_CMD}]}},
+        }
+        harness.create_job(manifest)
+        assert wait_for(lambda: len(harness.list_pods("default")) == 3)
+
+        chief_addr = harness.resolve("ct-chief-0.default.svc", 2222)
+        cfg = http_get_json(chief_addr, "/runconfig")
+        assert cfg["task_type"] == "chief" and cfg["is_chief"], cfg
+        worker_cfg = http_get_json(worker_addr(harness, "ct", 1), "/runconfig")
+        assert not worker_cfg["is_chief"]
+        assert len(worker_cfg["cluster_spec"]["chief"]) == 1
+        assert len(worker_cfg["cluster_spec"]["worker"]) == 2
+
+        # Chief exits 0: job Succeeded while both workers still run.
+        http_get_json(chief_addr, "/exit?exitCode=0")
+        assert wait_for(
+            lambda: job_condition(harness, "TFJob", "ct", "Succeeded"),
+            timeout=30,
+        )
+        phases = {p.metadata.name: p.status.phase
+                  for p in harness.list_pods("default")}
+        assert phases["ct-worker-0"] == "Running", phases
+        assert phases["ct-worker-1"] == "Running", phases
+
     def test_shutdown_worker0_completes_job_and_cleans_running(self, harness):
         """shutdown_policy + cleanpod(Running) analog: worker-0 exit 0 ends
         the job; the still-running worker-1 is torn down."""

@@ -37,6 +37,21 @@ SYNC = "SYNC"
 WatchHandler = Callable[[str, object], None]  # (event_type, object) -> None
 
 
+def matches_claim_view(obj, labels, owner_uid) -> bool:
+    """The claim protocol's listing predicate, single-sourced: label-match
+    OR controller-owned-by-uid (an owned object whose labels were mutated
+    away must still be visible, or it could never be released)."""
+    selected = not labels or all(
+        obj.metadata.labels.get(k) == v for k, v in labels.items()
+    )
+    if selected:
+        return True
+    return owner_uid is not None and any(
+        r.uid == owner_uid and r.controller
+        for r in obj.metadata.owner_references
+    )
+
+
 class Cluster:
     """Abstract cluster backend."""
 
@@ -73,7 +88,10 @@ class Cluster:
     def get_pod(self, namespace: str, name: str) -> Pod:
         raise NotImplementedError
 
-    def list_pods(self, namespace: Optional[str] = None, labels: Optional[Dict[str, str]] = None) -> List[Pod]:
+    def list_pods(self, namespace: Optional[str] = None, labels: Optional[Dict[str, str]] = None,
+                  owner_uid: Optional[str] = None) -> List[Pod]:
+        """Label-selected pods; `owner_uid` widens the match to label-match
+        OR controller-owned-by-uid (the claim protocol's release view)."""
         raise NotImplementedError
 
     def update_pod(self, pod: Pod) -> Pod:
@@ -137,7 +155,8 @@ class Cluster:
     def get_service(self, namespace: str, name: str) -> Service:
         raise NotImplementedError
 
-    def list_services(self, namespace: Optional[str] = None, labels: Optional[Dict[str, str]] = None) -> List[Service]:
+    def list_services(self, namespace: Optional[str] = None, labels: Optional[Dict[str, str]] = None,
+                      owner_uid: Optional[str] = None) -> List[Service]:
         raise NotImplementedError
 
     def update_service(self, service: Service) -> Service:

@@ -197,15 +197,19 @@ class InMemoryCluster(base.Cluster):
             except KeyError:
                 raise NotFound(f"pod {namespace}/{name}")
 
-    def list_pods(self, namespace=None, labels=None) -> List[Pod]:
+    def list_pods(self, namespace=None, labels=None, owner_uid=None) -> List[Pod]:
+        """Label-selected pods; with ``owner_uid`` the match widens to
+        label-match OR controller-owned-by-uid (the claim protocol's view:
+        an owned pod whose labels were mutated away must still be seen, or
+        it could never be released — without paying a full-scope deep copy
+        of every operator pod per sync)."""
         with self._lock:
             out = []
             for (ns, _), pod in self._pods.items():
                 if namespace is not None and ns != namespace:
                     continue
-                if labels and any(pod.metadata.labels.get(k) != v for k, v in labels.items()):
-                    continue
-                out.append(pod.deep_copy())
+                if base.matches_claim_view(pod, labels, owner_uid):
+                    out.append(pod.deep_copy())
             return out
 
     def update_pod(self, pod: Pod) -> Pod:
@@ -265,15 +269,14 @@ class InMemoryCluster(base.Cluster):
             except KeyError:
                 raise NotFound(f"service {namespace}/{name}")
 
-    def list_services(self, namespace=None, labels=None) -> List[Service]:
+    def list_services(self, namespace=None, labels=None, owner_uid=None) -> List[Service]:
         with self._lock:
             out = []
             for (ns, _), svc in self._services.items():
                 if namespace is not None and ns != namespace:
                     continue
-                if labels and any(svc.metadata.labels.get(k) != v for k, v in labels.items()):
-                    continue
-                out.append(svc.deep_copy())
+                if base.matches_claim_view(svc, labels, owner_uid):
+                    out.append(svc.deep_copy())
             return out
 
     def update_service(self, service: Service) -> Service:

@@ -296,12 +296,15 @@ class JobController:
         """Label-selected pods with full claim semantics (reference
         ControllerRefManager, tfjob_controller.go:249-332); see
         _claim_objects for the protocol."""
-        # List at OPERATOR scope (group-name only), claim per-pod: a pod we
-        # own whose job-name label was mutated away must still be seen here,
-        # or it could never be released (a full-selector list hides it).
+        # Selector-match OR owned-by-job: a pod we own whose job-name label
+        # was mutated away must still be seen here (or it could never be
+        # released), without paying a full operator-scope copy of EVERY
+        # job's pods per sync — at 100 jobs x 3 workers that copy was 95%
+        # of reconcile latency.
         pods = self.cluster.list_pods(
             namespace=job.namespace,
-            labels={constants.LABEL_GROUP_NAME: constants.GROUP_NAME},
+            labels=job_selector(job),
+            owner_uid=job.metadata.uid,
         )
         return self._claim_objects(
             job, pods, self.cluster.get_pod, self.cluster.update_pod
@@ -313,7 +316,8 @@ class JobController:
         tfjob_controller.go:290-332)."""
         services = self.cluster.list_services(
             namespace=job.namespace,
-            labels={constants.LABEL_GROUP_NAME: constants.GROUP_NAME},
+            labels=job_selector(job),
+            owner_uid=job.metadata.uid,
         )
         return self._claim_objects(
             job, services, self.cluster.get_service, self.cluster.update_service

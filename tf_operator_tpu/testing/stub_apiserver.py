@@ -66,6 +66,13 @@ class StubApiServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # Unbuffered wfile (the BaseHTTPRequestHandler default) makes
+            # every status/header line its own TCP send; with Nagle +
+            # delayed ACKs each response then costs ~40ms — which tripled
+            # measured restart MTTR. Buffer responses; streaming paths
+            # (watches, log follow) flush explicitly.
+            wbufsize = -1
+            disable_nagle_algorithm = True
 
             def log_message(self, fmt, *args):
                 pass
@@ -264,6 +271,7 @@ class StubApiServer:
         handler.send_response(200)
         handler.send_header("Transfer-Encoding", "chunked")
         handler.end_headers()
+        handler.wfile.flush()  # quiet pod: headers must not sit in the buffer
         try:
             for text in self.mem.stream_pod_log(ns, name, follow=True,
                                                 poll_interval=0.05):
@@ -383,6 +391,11 @@ class StubApiServer:
         handler.send_header("Content-Type", "application/json")
         handler.send_header("Transfer-Encoding", "chunked")
         handler.end_headers()
+        # Buffered wfile (wbufsize=-1): push the headers out NOW — a watch
+        # on an empty collection blocks before its first chunk, and the
+        # client would otherwise sit in getresponse() with nothing on the
+        # wire until the first event.
+        handler.wfile.flush()
 
         def send(payload: dict) -> None:
             line = (json.dumps(payload) + "\n").encode()
