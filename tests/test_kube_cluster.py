@@ -423,3 +423,129 @@ class TestLogStreaming:
         assert text == "early\nmid\nlate\n"
         # Live-ness: the first chunk arrived well before the final append.
         assert len(chunks) >= 2
+
+
+class TestRealTLS:
+    """The production TLS path over a genuine handshake (the slice of a
+    kind run that the HTTP stub tier cannot cover): CA verification, a
+    wrong-CA rejection, and mTLS client-certificate auth — all through the
+    same KubeCluster/kubeconfig code a real apiserver would see."""
+
+    @pytest.fixture(scope="class")
+    def pki(self, tmp_path_factory):
+        import shutil
+        import subprocess
+
+        if shutil.which("openssl") is None:
+            pytest.skip("openssl binary not available")
+        tmp_path = tmp_path_factory.mktemp("pki")
+
+        def run(*args):
+            subprocess.run(args, check=True, capture_output=True)
+
+        ca_key, ca = tmp_path / "ca.key", tmp_path / "ca.crt"
+        run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(ca_key), "-out", str(ca), "-days", "1",
+            "-subj", "/CN=stub-ca")
+        srv_key, srv_csr, srv = (tmp_path / "srv.key", tmp_path / "srv.csr",
+                                 tmp_path / "srv.crt")
+        run("openssl", "req", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(srv_key), "-out", str(srv_csr),
+            "-subj", "/CN=127.0.0.1")
+        ext = tmp_path / "san.cnf"
+        ext.write_text("subjectAltName=IP:127.0.0.1\n")
+        run("openssl", "x509", "-req", "-in", str(srv_csr), "-CA", str(ca),
+            "-CAkey", str(ca_key), "-CAcreateserial", "-days", "1",
+            "-extfile", str(ext), "-out", str(srv))
+        cli_key, cli_csr, cli = (tmp_path / "cli.key", tmp_path / "cli.csr",
+                                 tmp_path / "cli.crt")
+        run("openssl", "req", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(cli_key), "-out", str(cli_csr),
+            "-subj", "/CN=operator-client")
+        run("openssl", "x509", "-req", "-in", str(cli_csr), "-CA", str(ca),
+            "-CAkey", str(ca_key), "-CAcreateserial", "-days", "1",
+            "-out", str(cli))
+        other_ca = tmp_path / "other-ca.crt"
+        run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(tmp_path / "other.key"), "-out", str(other_ca),
+            "-days", "1", "-subj", "/CN=not-the-ca")
+        return {"ca": str(ca), "server_cert": str(srv), "server_key": str(srv_key),
+                "client_cert": str(cli), "client_key": str(cli_key),
+                "other_ca": str(other_ca)}
+
+    def _tls_stub(self, pki, require_client_cert=False):
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(pki["server_cert"], pki["server_key"])
+        if require_client_cert:
+            ctx.load_verify_locations(pki["ca"])
+            ctx.verify_mode = ssl.CERT_REQUIRED
+        return StubApiServer(ssl_context=ctx)
+
+    def test_ca_verified_roundtrip_and_wrong_ca_rejected(self, pki):
+        stub = self._tls_stub(pki)
+        try:
+            kube = KubeCluster(base_url=stub.url, token="t", ca_file=pki["ca"])
+            kube.create_job(tfjob("tls-job"))
+            assert stub.mem.get_job("TFJob", "default", "tls-job")
+            kube.shutdown()
+
+            # A client trusting a different CA must refuse the server.
+            bad = KubeCluster(base_url=stub.url, token="t",
+                              ca_file=pki["other_ca"])
+            with pytest.raises(RuntimeError, match="connection failed"):
+                bad.create_job(tfjob("never"))
+            bad.shutdown()
+        finally:
+            stub.shutdown()
+
+    def test_mtls_client_certificate_auth(self, pki, tmp_path):
+        stub = self._tls_stub(pki, require_client_cert=True)
+        try:
+            # Without a client cert the handshake is refused.
+            anon = KubeCluster(base_url=stub.url, token="t", ca_file=pki["ca"])
+            with pytest.raises(RuntimeError, match="connection failed"):
+                anon.create_job(tfjob("never"))
+            anon.shutdown()
+
+            # Through a kubeconfig with client-certificate/key — the full
+            # production resolution path.
+            cfg = tmp_path / "kubeconfig"
+            cfg.write_text(f"""
+apiVersion: v1
+current-context: tls
+clusters:
+- name: c
+  cluster:
+    server: {stub.url}
+    certificate-authority: {pki['ca']}
+contexts:
+- name: tls
+  context: {{cluster: c, user: u}}
+users:
+- name: u
+  user:
+    client-certificate: {pki['client_cert']}
+    client-key: {pki['client_key']}
+""")
+            kube = KubeCluster.from_kubeconfig(str(cfg))
+            kube.create_job(tfjob("mtls-job"))
+            assert stub.mem.get_job("TFJob", "default", "mtls-job")
+            # Watches ride the same TLS session: reconcile works end to end.
+            manager = OperatorManager(
+                kube,
+                OperatorOptions(enabled_schemes=["TFJob"], health_port=0,
+                                metrics_port=0, resync_period=0.5),
+                metrics=Metrics(),
+            )
+            manager.start()
+            try:
+                assert wait_until(
+                    lambda: len(stub.mem.list_pods("default")) == 2
+                ), "operator never reconciled over mTLS"
+            finally:
+                manager.stop()
+            kube.shutdown()
+        finally:
+            stub.shutdown()

@@ -54,7 +54,8 @@ class StubApiServer:
     can simulate the kubelet (set_pod_phase) and inspect state."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 required_token: Optional[str] = None):
+                 required_token: Optional[str] = None,
+                 ssl_context=None):
         self.mem = InMemoryCluster()
         # Auth enforcement (None = accept anything): set/replace via
         # set_required_token to exercise bearer rotation — requests carrying
@@ -129,13 +130,22 @@ class StubApiServer:
                 self._dispatch("DELETE")
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
+        # Real-TLS tier: wrap the listener so the production client's ssl
+        # context (CA verification, mTLS client certs) is exercised over a
+        # genuine handshake — what a kind/real apiserver run would cover.
+        self._tls = ssl_context is not None
+        if ssl_context is not None:
+            self.httpd.socket = ssl_context.wrap_socket(
+                self.httpd.socket, server_side=True
+            )
         self.port = self.httpd.server_address[1]
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
         self._thread.start()
 
     @property
     def url(self) -> str:
-        return f"http://127.0.0.1:{self.port}"
+        scheme = "https" if self._tls else "http"
+        return f"{scheme}://127.0.0.1:{self.port}"
 
     def set_required_token(self, token: Optional[str]) -> None:
         """Rotate the accepted bearer token (None disables auth)."""
