@@ -326,3 +326,78 @@ class TestClaimSemantics:
         untouched = cluster.get_pod("default", "tj-worker-1")
         assert untouched.metadata.owner_references == []
         assert all(p.metadata.name != "tj-worker-1" for p in pods)
+
+
+class TestGangScaleDownConvergence:
+    def test_multislice_scale_down_releases_stale_slice_groups(self):
+        """numSlices 3 -> 2: slice-2's PodGroup must be deleted, or the
+        gang scheduler keeps reserving a slice no pod will ever join."""
+        cluster = InMemoryCluster()
+        ctrl = JAXController(cluster, options=EngineOptions(enable_gang_scheduling=True))
+        cluster.create_job({
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "JAXJob",
+            "metadata": {"name": "sd", "namespace": "default"},
+            "spec": {
+                "tpu": {"acceleratorType": "v5e-16"},  # 4 hosts/slice
+                "numSlices": 3,
+                "elastic": {"minSlices": 1, "maxSlices": 4},
+                "jaxReplicaSpecs": {"Worker": {"template": {"spec": {
+                    "containers": [{"name": "jax", "image": "i"}]}}}},
+            },
+        })
+        ctrl.run_until_idle()
+        names = {g["metadata"]["name"]
+                 for g in cluster.list_pod_groups("default")}
+        assert names == {"sd-slice-0", "sd-slice-1", "sd-slice-2"}
+
+        job = cluster.get_job("JAXJob", "default", "sd")
+        job["spec"]["numSlices"] = 2
+        job["spec"]["jaxReplicaSpecs"]["Worker"]["replicas"] = 8
+        cluster.update_job(job)
+        ctrl.run_until_idle()
+        names = {g["metadata"]["name"]
+                 for g in cluster.list_pod_groups("default")}
+        assert names == {"sd-slice-0", "sd-slice-1"}, names
+
+    def test_terminal_cleanup_sweeps_labeled_groups(self):
+        """A group left by a pre-resize topology is swept at terminal
+        cleanup through the label stamp, not just the declared names."""
+        cluster = InMemoryCluster()
+        ctrl = TFController(cluster, options=EngineOptions(enable_gang_scheduling=True))
+        cluster.create_job(tfjob("tc", workers=1, ps=0))
+        ctrl.run_until_idle()
+        # Plant a leftover group from an older topology: labeled AND owned
+        # by this job's UID (the sweep requires the ownerRef discriminator —
+        # a same-name job of another kind must never have its group swept).
+        uid = cluster.get_job("TFJob", "default", "tc")["metadata"]["uid"]
+        cluster.create_pod_group({
+            "apiVersion": "scheduling.volcano.sh/v1beta1",
+            "kind": "PodGroup",
+            "metadata": {"name": "tc-old-shape", "namespace": "default",
+                         "labels": {"group-name": "kubeflow.org",
+                                    "job-name": "tc"},
+                         "ownerReferences": [{"apiVersion": "kubeflow.org/v1",
+                                              "kind": "TFJob", "name": "tc",
+                                              "uid": uid, "controller": True}]},
+            "spec": {"minMember": 9},
+        })
+        # A same-labeled group owned by a DIFFERENT uid must survive.
+        cluster.create_pod_group({
+            "apiVersion": "scheduling.volcano.sh/v1beta1",
+            "kind": "PodGroup",
+            "metadata": {"name": "tc-foreign", "namespace": "default",
+                         "labels": {"group-name": "kubeflow.org",
+                                    "job-name": "tc"},
+                         "ownerReferences": [{"apiVersion": "kubeflow.org/v1",
+                                              "kind": "JAXJob", "name": "tc",
+                                              "uid": "uid-other",
+                                              "controller": True}]},
+            "spec": {"minMember": 1},
+        })
+        cluster.set_pod_phase("default", "tc-worker-0", "Succeeded",
+                              exit_code=0, container_name="tensorflow")
+        ctrl.run_until_idle()
+        leftover = {g["metadata"]["name"]
+                    for g in cluster.list_pod_groups("default")}
+        assert leftover == {"tc-foreign"}, leftover

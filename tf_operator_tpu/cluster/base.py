@@ -172,6 +172,10 @@ class Cluster:
     def get_pod_group(self, namespace: str, name: str) -> dict:
         raise NotImplementedError
 
+    def list_pod_groups(self, namespace: Optional[str] = None,
+                        labels: Optional[Dict[str, str]] = None) -> List[dict]:
+        raise NotImplementedError
+
     def delete_pod_group(self, namespace: str, name: str) -> None:
         raise NotImplementedError
 

@@ -343,6 +343,12 @@ class KubeCluster(Cluster):
         conf.update(kwargs)
         return cls(**conf)
 
+    @staticmethod
+    def _selector_query(labels: Dict[str, str]) -> str:
+        """`?labelSelector=k=v,...` suffix (sorted for stable URLs)."""
+        selector = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+        return "?" + urllib.parse.urlencode({"labelSelector": selector})
+
     # ---------------------------------------------------------------- paths
     def _job_path(self, kind: str, namespace: str, name: str = "") -> str:
         plural = _job_plural(kind)
@@ -447,8 +453,7 @@ class KubeCluster(Cluster):
             query_labels = {constants.LABEL_GROUP_NAME: constants.GROUP_NAME}
         path = self._core_path("pods", namespace)
         if query_labels:
-            selector = ",".join(f"{k}={v}" for k, v in sorted(query_labels.items()))
-            path += "?" + urllib.parse.urlencode({"labelSelector": selector})
+            path += self._selector_query(query_labels)
         items = self._request("GET", path).get("items", [])
         out = [from_dict(Pod, _normalize_times(i)) for i in items]
         if owner_uid is not None:
@@ -609,8 +614,7 @@ class KubeCluster(Cluster):
             query_labels = {constants.LABEL_GROUP_NAME: constants.GROUP_NAME}
         path = self._core_path("services", namespace)
         if query_labels:
-            selector = ",".join(f"{k}={v}" for k, v in sorted(query_labels.items()))
-            path += "?" + urllib.parse.urlencode({"labelSelector": selector})
+            path += self._selector_query(query_labels)
         items = self._request("GET", path).get("items", [])
         out = [from_dict(Service, _normalize_times(i)) for i in items]
         if owner_uid is not None:
@@ -634,6 +638,21 @@ class KubeCluster(Cluster):
             "GET",
             f"/apis/{_PODGROUP[0]}/{_PODGROUP[1]}/namespaces/{namespace}/{_PODGROUP[2]}/{name}",
         )
+
+    def list_pod_groups(self, namespace: Optional[str] = None,
+                        labels: Optional[Dict[str, str]] = None) -> List[dict]:
+        if namespace:
+            path = (
+                f"/apis/{_PODGROUP[0]}/{_PODGROUP[1]}/namespaces/{namespace}"
+                f"/{_PODGROUP[2]}"
+            )
+        else:
+            # Base-contract parity with the memory backend: no namespace
+            # means ALL namespaces (cluster-scoped path), not "default".
+            path = f"/apis/{_PODGROUP[0]}/{_PODGROUP[1]}/{_PODGROUP[2]}"
+        if labels:
+            path += self._selector_query(labels)
+        return self._request("GET", path).get("items", [])
 
     def delete_pod_group(self, namespace: str, name: str) -> None:
         self._request(

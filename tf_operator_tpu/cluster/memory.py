@@ -314,6 +314,18 @@ class InMemoryCluster(base.Cluster):
             except KeyError:
                 raise NotFound(f"podgroup {namespace}/{name}")
 
+    def list_pod_groups(self, namespace=None, labels=None) -> List[dict]:
+        with self._lock:
+            out = []
+            for (ns, _), group in self._pod_groups.items():
+                if namespace is not None and ns != namespace:
+                    continue
+                glabels = (group.get("metadata") or {}).get("labels") or {}
+                if labels and any(glabels.get(k) != v for k, v in labels.items()):
+                    continue
+                out.append(copy.deepcopy(group))
+            return out
+
     def delete_pod_group(self, namespace: str, name: str) -> None:
         with self._lock:
             self._pod_groups.pop((namespace, name), None)

@@ -134,7 +134,11 @@ class JAXController(FrameworkController):
     def gang_groups(self, job, replicas: Dict[str, ReplicaSpec], run_policy) -> List[dict]:
         """One gang per slice: minMember = hosts per slice (a partial slice
         is useless; an independent slice is not)."""
-        from ..core.job_controller import aggregate_min_resources
+        from ..core.job_controller import (
+            aggregate_min_resources,
+            gang_owner_ref,
+            job_selector,
+        )
 
         per_slice = jaxdist.hosts_per_slice(job)
         num_slices = max(1, job.spec.num_slices)
@@ -179,7 +183,12 @@ class JAXController(FrameworkController):
                 {
                     "apiVersion": "scheduling.volcano.sh/v1beta1",
                     "kind": "PodGroup",
-                    "metadata": {"name": f"{job.name}-slice-{s}", "namespace": job.namespace},
+                    "metadata": {
+                        "name": f"{job.name}-slice-{s}",
+                        "namespace": job.namespace,
+                        "labels": job_selector(job),
+                        "ownerReferences": [gang_owner_ref(job)],
+                    },
                     "spec": {
                         "minMember": per_slice,
                         "minResources": min_resources,

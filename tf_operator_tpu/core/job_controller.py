@@ -61,6 +61,19 @@ def job_selector(job: JobObject) -> Dict[str, str]:
     }
 
 
+def gang_owner_ref(job: JobObject) -> dict:
+    """ownerReference dict for PodGroup metadata (plain dicts, not typed):
+    cascading GC on a real cluster + the UID discriminator for the
+    stale-group sweep."""
+    return {
+        "apiVersion": job.api_version,
+        "kind": job.kind,
+        "name": job.name,
+        "uid": job.metadata.uid,
+        "controller": True,
+    }
+
+
 # Kubernetes resource.Quantity arithmetic (the subset PodGroup minResources
 # aggregation needs). Exact rational arithmetic throughout: float sums of
 # large memory asks (hundreds of Gi across a big gang) accumulate binary
@@ -246,7 +259,16 @@ class FrameworkHooks:
             {
                 "apiVersion": "scheduling.volcano.sh/v1beta1",
                 "kind": "PodGroup",
-                "metadata": {"name": job.name, "namespace": job.namespace},
+                "metadata": {
+                    "name": job.name,
+                    "namespace": job.namespace,
+                    # Label + ownerReference stamp: lets the engine
+                    # enumerate THIS job's groups and converge away stale
+                    # ones (scale-down) — the UID keeps a same-name job of
+                    # another kind from being swept by our sweep.
+                    "labels": job_selector(job),
+                    "ownerReferences": [gang_owner_ref(job)],
+                },
                 "spec": {
                     "minMember": min_member,
                     "minResources": min_resources,
@@ -290,6 +312,10 @@ class JobController:
         self.requeue = requeue or (lambda key, after: None)
         self.clock = clock
         self.on_job_restarting = on_job_restarting or (lambda job, rtype: None)
+        # (job key, uid) -> last-declared gang-group names: gates the stale
+        # sweep's uncached LIST to declared-set changes (and once per
+        # operator lifetime per job, since this cache is in-memory).
+        self._gang_declared: Dict[tuple, set] = {}
 
     # ------------------------------------------------------------- listing
     def get_pods_for_job(self, job: JobObject) -> List[Pod]:
@@ -880,7 +906,9 @@ class JobController:
     def _delete_gang_groups(self, job: JobObject, replicas: Dict[str, ReplicaSpec], run_policy) -> None:
         """Tear down the gang units (terminal cleanup and suspension).
         Only NotFound is tolerated — a real API failure (RBAC, network)
-        must surface, or the PodGroup leaks in the scheduler silently."""
+        must surface, or the PodGroup leaks in the scheduler silently.
+        Deletes the declared set AND anything else carrying the job's label
+        stamp (groups from a pre-resize topology)."""
         from ..cluster.base import NotFound
 
         for group in self.hooks.gang_groups(job, replicas, run_policy):
@@ -891,6 +919,35 @@ class JobController:
                 )
             except NotFound:
                 pass
+        self._delete_stale_gang_groups(job, declared=set())
+
+    def _delete_stale_gang_groups(self, job: JobObject, declared: set) -> None:
+        """Delete THIS job's PodGroups not in `declared` — membership is
+        decided by the ownerReference UID, not the name labels alone (a
+        same-name job of a different kind shares the label stamp and must
+        not have its live group swept). Groups created by an older operator
+        (no stamp) are invisible here — they converge at terminal cleanup
+        via the declared-name path."""
+        try:
+            existing = self.cluster.list_pod_groups(
+                namespace=job.namespace, labels=job_selector(job)
+            )
+        except NotImplementedError:
+            return  # backend predates group listing; declared-name path only
+        from ..cluster.base import NotFound
+
+        for group in existing:
+            meta = group.get("metadata") or {}
+            name = meta.get("name", "")
+            owned = any(
+                ref.get("uid") == job.metadata.uid and ref.get("controller")
+                for ref in meta.get("ownerReferences") or []
+            )
+            if owned and name and name not in declared:
+                try:
+                    self.cluster.delete_pod_group(job.namespace, name)
+                except NotFound:
+                    pass
 
     # ----------------------------------------------------------- pod group
     def _sync_pod_group(self, job: JobObject, replicas: Dict[str, ReplicaSpec], run_policy) -> None:
@@ -909,8 +966,10 @@ class JobController:
         from ..cluster.base import Conflict, NotFound
 
         queued_phases = []
+        declared = set()
         for group in self.hooks.gang_groups(job, replicas, run_policy):
             meta = group.get("metadata", {})
+            declared.add(meta["name"])
             try:
                 live = self.cluster.get_pod_group(
                     meta.get("namespace", job.namespace), meta["name"]
@@ -924,6 +983,16 @@ class JobController:
             phase = ((live.get("status") or {}).get("phase")) or ""
             if phase in ("Pending", "Inqueue"):
                 queued_phases.append((meta.get("name", job.name), phase))
+        # Converge away groups the current spec no longer declares (e.g. a
+        # multislice scale-down: numSlices 3 -> 2 must release slice-2's
+        # reservation, or the scheduler keeps honoring a gang that no pod
+        # will ever join). The sweep costs an uncached LIST, so it runs
+        # only when the declared set changes (plus once per operator
+        # lifetime per job — the cache is in-memory, so a restart re-checks).
+        cache_key = (job.key(), job.metadata.uid)
+        if self._gang_declared.get(cache_key) != declared:
+            self._delete_stale_gang_groups(job, declared)
+            self._gang_declared[cache_key] = declared
         if queued_phases and not capi.is_running(job.status):
             names = ", ".join(f"{n}={p}" for n, p in queued_phases)
             capi.update_job_conditions(

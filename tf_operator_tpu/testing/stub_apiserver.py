@@ -185,7 +185,7 @@ class StubApiServer:
             )
         m = _PG_RE.match(path)
         if m:
-            return self._podgroups(handler, method, m)
+            return self._podgroups(handler, method, m, labels=labels)
         m = _LEASE_RE.match(path)
         if m:
             return self._leases(handler, method, m)
@@ -322,10 +322,14 @@ class StubApiServer:
             })
         handler._json(200, {"items": items})
 
-    def _podgroups(self, handler, method, m) -> None:
+    def _podgroups(self, handler, method, m, labels=None) -> None:
         ns, name = m["ns"], m["name"]
         if method == "POST":
             return handler._json(201, self.mem.create_pod_group(handler._body()))
+        if method == "GET" and not name:
+            return handler._json(
+                200, {"items": self.mem.list_pod_groups(ns, labels)}
+            )
         if method == "GET":
             return handler._json(200, self.mem.get_pod_group(ns, name))
         if method == "DELETE":
