@@ -43,6 +43,7 @@ _PG_RE = re.compile(
     r"^/apis/scheduling\.volcano\.sh/v1beta1/namespaces/(?P<ns>[^/]+)/podgroups"
     r"(?:/(?P<name>[^/]+))?$"
 )
+_PG_ALL_RE = re.compile(r"^/apis/scheduling\.volcano\.sh/v1beta1/podgroups$")
 _LEASE_RE = re.compile(
     r"^/apis/coordination\.k8s\.io/v1/namespaces/(?P<ns>[^/]+)/leases"
     r"(?:/(?P<name>[^/]+))?$"
@@ -186,6 +187,11 @@ class StubApiServer:
         m = _PG_RE.match(path)
         if m:
             return self._podgroups(handler, method, m, labels=labels)
+        if _PG_ALL_RE.match(path) and method == "GET":
+            # Cluster-scoped listing (list_pod_groups with no namespace).
+            return handler._json(
+                200, {"items": self.mem.list_pod_groups(None, labels)}
+            )
         m = _LEASE_RE.match(path)
         if m:
             return self._leases(handler, method, m)
