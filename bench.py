@@ -1,7 +1,11 @@
 """Benchmark harness: training throughput on the available hardware.
 
-Prints ONE JSON line:
+Stdout contract: the LAST line is the result JSON —
   {"metric": "...", "value": N, "unit": "...", "vs_baseline": N, "extra": {...}}
+A full-suite run prints the headline-only line EARLY (extra.configs =
+{"status": "secondaries running"}) and the complete line at the end, so a
+capture killed mid-secondary still ends on a valid measurement. Consumers
+must parse the last line (the driver and ci/check_bench_7b.py do).
 
 The headline metric is the Llama-400M training MFU on the present chip
 (north star >= 45% — BASELINE.md; the reference publishes no numbers, it is
@@ -323,6 +327,30 @@ def main() -> int:
         _emit_error(f"headline[{args.model}]", exc)
         return 1
 
+    def result_line(configs_so_far):
+        mfu = headline["mfu"]
+        return {
+            "metric": f"llama[{args.model}] train tokens/sec/chip (seq={seq}, bs={args.batch}, {n}x {devices[0].device_kind})",
+            "value": headline["tokens_per_sec_chip"],
+            "unit": "tokens/sec/chip",
+            "vs_baseline": round(mfu / 0.45, 4),
+            "extra": {
+                "mfu": mfu,
+                "tokens_per_sec_total": round(headline["tokens_per_sec_chip"] * n, 1),
+                "achieved_tflops_per_chip": headline["achieved_tflops_per_chip"],
+                "loss": headline["loss"],
+                "params": headline["params"],
+                "configs": configs_so_far,
+            },
+        }
+
+    # Emit the headline IMMEDIATELY: if the capture is killed mid-secondary
+    # (driver timeout, infra flake), the last stdout line is still a valid
+    # measurement rather than nothing. The full line replaces it at the end.
+    print(json.dumps(result_line({"status": "secondaries running"}
+                                 if suite == "full" else {})))
+    sys.stdout.flush()
+
     configs = {}
     if suite == "full":
         sub_steps = max(6, args.steps // 2)
@@ -379,22 +407,7 @@ def main() -> int:
                 "llama-1b", 4, seq, sub_steps, args.warmup, mesh, devices,
             ))
 
-    mfu = headline["mfu"]
-    result = {
-        "metric": f"llama[{args.model}] train tokens/sec/chip (seq={seq}, bs={args.batch}, {n}x {devices[0].device_kind})",
-        "value": headline["tokens_per_sec_chip"],
-        "unit": "tokens/sec/chip",
-        "vs_baseline": round(mfu / 0.45, 4),
-        "extra": {
-            "mfu": mfu,
-            "tokens_per_sec_total": round(headline["tokens_per_sec_chip"] * n, 1),
-            "achieved_tflops_per_chip": headline["achieved_tflops_per_chip"],
-            "loss": headline["loss"],
-            "params": headline["params"],
-            "configs": configs,
-        },
-    }
-    print(json.dumps(result))
+    print(json.dumps(result_line(configs)))
     return 0
 
 
