@@ -32,7 +32,7 @@ docker-build:    ## operator image
 deploy:          ## apply CRDs + operator to the current kube context
 	kubectl apply -f manifests/crds/ && kubectl apply -f manifests/operator.yaml
 
-bench:           ## single-chip training benchmark (prints one JSON line)
+bench:           ## single-chip training benchmark (last stdout line = result JSON)
 	$(PY) bench.py
 
 dryrun:          ## compile-check every sharding on an 8-device virtual mesh

@@ -344,12 +344,13 @@ def main() -> int:
             },
         }
 
-    # Emit the headline IMMEDIATELY: if the capture is killed mid-secondary
-    # (driver timeout, infra flake), the last stdout line is still a valid
-    # measurement rather than nothing. The full line replaces it at the end.
-    print(json.dumps(result_line({"status": "secondaries running"}
-                                 if suite == "full" else {})))
-    sys.stdout.flush()
+    if suite == "full":
+        # Emit the headline IMMEDIATELY: if the capture is killed
+        # mid-secondary (driver timeout, infra flake), the last stdout line
+        # is still a valid measurement rather than nothing. The complete
+        # line replaces it at the end.
+        print(json.dumps(result_line({"status": "secondaries running"})))
+        sys.stdout.flush()
 
     configs = {}
     if suite == "full":

@@ -549,3 +549,53 @@ users:
             kube.shutdown()
         finally:
             stub.shutdown()
+
+
+class TestOperatorRestartMidJob:
+    def test_takeover_without_duplicate_pods(self, stub):
+        """SURVEY hard part: adoption/orphaning exists for operator
+        restarts mid-job. A replacement operator process (fresh informers,
+        fresh expectations cache) must take over a running job without
+        recreating or duplicating its pods, and then drive it to
+        completion."""
+        opts = OperatorOptions(enabled_schemes=["TFJob"], health_port=0,
+                               metrics_port=0, resync_period=0.3)
+        kube1 = KubeCluster(base_url=stub.url, token="t")
+        m1 = OperatorManager(kube1, opts, metrics=Metrics(), identity="gen-1")
+        m1.start()
+        try:
+            kube1.create_job(tfjob("steady"))
+            assert wait_until(lambda: len(stub.mem.list_pods("default")) == 2)
+            for pod in stub.mem.list_pods("default"):
+                stub.mem.set_pod_phase("default", pod.metadata.name, "Running")
+        finally:
+            m1.stop()
+            kube1.shutdown()
+
+        uids_before = {p.metadata.name: p.metadata.uid
+                       for p in stub.mem.list_pods("default")}
+
+        kube2 = KubeCluster(base_url=stub.url, token="t")
+        m2 = OperatorManager(kube2, opts, metrics=Metrics(), identity="gen-2")
+        m2.start()
+        try:
+            # Several resync rounds: no churn, identical pods.
+            time.sleep(1.2)
+            uids_after = {p.metadata.name: p.metadata.uid
+                          for p in stub.mem.list_pods("default")}
+            assert uids_after == uids_before, (uids_before, uids_after)
+
+            # The successor owns the lifecycle: worker-0 success ends the job.
+            stub.mem.set_pod_phase("default", "steady-worker-0", "Succeeded",
+                                   exit_code=0, container_name="tensorflow")
+
+            def succeeded():
+                job = stub.mem.get_job("TFJob", "default", "steady")
+                conds = (job.get("status") or {}).get("conditions") or []
+                return any(c["type"] == "Succeeded" and c["status"] == "True"
+                           for c in conds)
+
+            assert wait_until(succeeded), "successor never completed the job"
+        finally:
+            m2.stop()
+            kube2.shutdown()
