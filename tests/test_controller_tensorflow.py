@@ -88,8 +88,12 @@ def tfjob_manifest(
 
 @pytest.fixture
 def env():
+    from tf_operator_tpu.metrics import Metrics
+
     cluster = InMemoryCluster()
-    controller = TFController(cluster)
+    # Fresh metrics per test: the default is the process-wide METRICS
+    # singleton, which any other test completing a TFJob would pollute.
+    controller = TFController(cluster, metrics=Metrics())
     return cluster, controller
 
 

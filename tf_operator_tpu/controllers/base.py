@@ -98,6 +98,13 @@ class FrameworkController(FrameworkHooks):
             self.metrics.created_inc(meta.get("namespace", "default"), self.kind)
         if event_type == DELETED:
             self.metrics.deleted_inc(meta.get("namespace", "default"), self.kind)
+            # The job is gone and is never enqueued again: drop its
+            # in-memory bookkeeping HERE — the sync-path NotFound cleanup
+            # only runs if some later event enqueues the dead key.
+            self._forget(
+                f"{meta.get('namespace', 'default')}/{meta.get('name', '')}",
+                uid=meta.get("uid", ""),
+            )
             return
         self._enqueue(meta.get("namespace", "default"), meta.get("name", ""))
 
@@ -122,6 +129,17 @@ class FrameworkController(FrameworkHooks):
             self._enqueue(obj.metadata.namespace, job_name)
 
         return handler
+
+    def _forget(self, key: str, uid: str = "") -> None:
+        """Drop every piece of per-job in-memory bookkeeping (expectations,
+        the engine's gang-sweep cache, the metrics terminal-dedup entries) —
+        one helper so the DELETED-event and NotFound-sync cleanup paths
+        cannot drift."""
+        self.expectations.delete_expectations(key, "pods")
+        self.expectations.delete_expectations(key, "services")
+        self.engine.forget_job(key)
+        if uid:
+            self.metrics.forget_terminal(self.kind, uid)
 
     def _record_restart(self, job: JobObject, rtype: str) -> None:
         self.metrics.restarted_inc(job.namespace, self.kind)
@@ -166,9 +184,7 @@ class FrameworkController(FrameworkHooks):
         try:
             job_dict = self.cluster.get_job(self.kind, namespace, name)
         except NotFound:
-            key = f"{namespace}/{name}"
-            self.expectations.delete_expectations(key, "pods")
-            self.expectations.delete_expectations(key, "services")
+            self._forget(f"{namespace}/{name}")
             return
 
         try:
@@ -280,9 +296,9 @@ class FrameworkController(FrameworkHooks):
         # this sync iff last_transition moved; cheap approximation — guard via
         # metrics' dedup of (kind, key, condition).
         if capi.is_succeeded(job.status):
-            self.metrics.successful_inc_once(job.namespace, self.kind, job.key())
+            self.metrics.successful_inc_once(job.namespace, self.kind, job.metadata.uid)
         elif capi.is_failed(job.status):
-            self.metrics.failed_inc_once(job.namespace, self.kind, job.key())
+            self.metrics.failed_inc_once(job.namespace, self.kind, job.metadata.uid)
 
     # ------------------------------------------------------------ run loop
     def process_next(self, timeout: float = 0.1) -> bool:

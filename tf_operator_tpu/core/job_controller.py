@@ -14,6 +14,7 @@ per-framework ReconcilePods override into one engine with policy hooks
 from __future__ import annotations
 
 import copy
+import threading
 import time
 from fractions import Fraction
 from dataclasses import dataclass, field
@@ -315,7 +316,19 @@ class JobController:
         # (job key, uid) -> last-declared gang-group names: gates the stale
         # sweep's uncached LIST to declared-set changes (and once per
         # operator lifetime per job, since this cache is in-memory).
+        # Pruned via forget_job when the job vanishes, so a long-lived
+        # operator with job churn doesn't accumulate entries forever.
+        # Lock: inserts happen on worker threads, prunes on the watch
+        # thread delivering DELETED — unsynchronized iteration would race.
         self._gang_declared: Dict[tuple, set] = {}
+        self._gang_declared_lock = threading.Lock()
+
+    def forget_job(self, key: str) -> None:
+        """Drop per-job in-memory bookkeeping after the job is gone
+        (called from the controller's deletion/NotFound cleanup)."""
+        with self._gang_declared_lock:
+            for cache_key in [k for k in self._gang_declared if k[0] == key]:
+                self._gang_declared.pop(cache_key, None)
 
     # ------------------------------------------------------------- listing
     def get_pods_for_job(self, job: JobObject) -> List[Pod]:
@@ -990,9 +1003,12 @@ class JobController:
         # only when the declared set changes (plus once per operator
         # lifetime per job — the cache is in-memory, so a restart re-checks).
         cache_key = (job.key(), job.metadata.uid)
-        if self._gang_declared.get(cache_key) != declared:
+        with self._gang_declared_lock:
+            unchanged = self._gang_declared.get(cache_key) == declared
+        if not unchanged:
             self._delete_stale_gang_groups(job, declared)
-            self._gang_declared[cache_key] = declared
+            with self._gang_declared_lock:
+                self._gang_declared[cache_key] = declared
         if queued_phases and not capi.is_running(job.status):
             names = ", ".join(f"{n}={p}" for n, p in queued_phases)
             capi.update_job_conditions(

@@ -102,6 +102,9 @@ class Metrics:
         self._inc("training_operator_jobs_restarted_total", namespace, framework)
 
     def successful_inc_once(self, namespace: str, framework: str, job_key: str) -> None:
+        """`job_key` should be the job UID (unique per incarnation): a
+        ns/name key would dedup a deleted-and-recreated job against its
+        predecessor and undercount the new instance's completion."""
         with self._lock:
             if ("successful", framework, job_key) in self._terminal_seen:
                 return
@@ -114,6 +117,13 @@ class Metrics:
                 return
             self._terminal_seen.add(("failed", framework, job_key))
             self._counters["training_operator_jobs_failed_total"][(namespace, framework)] += 1
+
+    def forget_terminal(self, framework: str, job_key: str) -> None:
+        """Prune the dedup entries of a deleted job so churn doesn't grow
+        the set forever (same leak class as the engine's gang cache)."""
+        with self._lock:
+            self._terminal_seen.discard(("successful", framework, job_key))
+            self._terminal_seen.discard(("failed", framework, job_key))
 
     def observe_startup(self, namespace: str, framework: str, seconds: float) -> None:
         with self._lock:
