@@ -190,7 +190,7 @@ def bench_bert(config_name, batch, seq, steps, warmup, mesh, devices):
     }
 
 
-def _emit_error(stage: str, exc: BaseException) -> None:
+def _emit_error(stage: str, exc: BaseException, extra: dict | None = None) -> None:
     """The driver parses our last stdout line as JSON; a traceback instead
     of a line erased all of round 2's perf evidence (BENCH_r02 rc=1,
     parsed=null). Whatever fails, the line gets printed."""
@@ -202,8 +202,67 @@ def _emit_error(stage: str, exc: BaseException) -> None:
         "extra": {
             "stage": stage,
             "error": f"{type(exc).__name__}: {exc}"[:500],
+            **(extra or {}),
         },
     }))
+
+
+def _wait_for_backend(window: float, probe_timeout: float = 120.0,
+                      interval: float = 60.0, require_tpu: bool = True) -> list:
+    """Probe backend init in a FRESH subprocess every ~`interval` s until one
+    succeeds or `window` closes; returns the attempt log (last entry
+    ``ok=True`` on success).
+
+    A fresh process per probe is the only reliable reset for both observed
+    tunnel failure modes: a *hang* wedges the probing process inside PJRT
+    client creation forever (a thread in this process would pin the backend
+    cache in a poisoned state), and a raised UNAVAILABLE is cached by jax
+    in-process. Round 2 and round 3 both lost their capture to a tunnel
+    outage that a single-shot init couldn't outlast; a tunnel that recovers
+    mid-window now still yields a measurement.
+
+    `require_tpu`: a probe that "succeeds" by silently falling back to the
+    CPU backend (jax does this when the TPU plugin raises UNAVAILABLE) is
+    NOT success — benching llama-tiny on CPU and emitting a plausible
+    headline would be worse than an honest error line."""
+    import subprocess
+
+    attempts = []
+    start = time.monotonic()
+    deadline = start + window
+    while True:
+        t0 = time.monotonic()
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; d = jax.devices(); print(len(d), d[0].platform)"],
+                capture_output=True, text=True, timeout=probe_timeout,
+            )
+            ok = proc.returncode == 0
+            tail = (proc.stdout if ok else proc.stderr).strip()
+            detail = tail.splitlines()[-1][:200] if tail else f"rc={proc.returncode}"
+            if ok and require_tpu and detail.endswith(" cpu"):
+                ok = False
+                detail = f"cpu fallback (tpu backend unavailable): {detail}"
+        except subprocess.TimeoutExpired:
+            ok = False
+            detail = f"hang: probe subprocess killed after {probe_timeout:.0f}s"
+        attempts.append({
+            "at_s": round(t0 - start, 1),
+            "took_s": round(time.monotonic() - t0, 1),
+            "ok": ok,
+            "detail": detail,
+        })
+        if ok or time.monotonic() >= deadline:
+            return attempts
+        remaining = deadline - time.monotonic()
+        print(
+            f"bench: backend unavailable ({detail}); retrying, "
+            f"{remaining:.0f}s left in wait window",
+            file=sys.stderr,
+        )
+        sys.stderr.flush()
+        time.sleep(max(0.0, min(interval - (time.monotonic() - t0), remaining)))
 
 
 class _BackendInitHang(RuntimeError):
@@ -286,18 +345,78 @@ def main() -> int:
 
     from tf_operator_tpu.parallel.mesh import standard_mesh
 
-    try:
-        init_timeout = float(os.environ.get("TF_OPERATOR_BENCH_INIT_TIMEOUT", "180"))
-    except ValueError:
-        init_timeout = 180.0
+    def _envf(name: str, default: float) -> float:
+        try:
+            return float(os.environ.get(name, str(default)))
+        except ValueError:
+            return default
+
+    init_timeout = _envf("TF_OPERATOR_BENCH_INIT_TIMEOUT", 180.0)
+    # Bounded wait-for-backend (VERDICT r3 #1): the happy path pays NOTHING
+    # extra — _init_devices runs directly. Only when init fails or hangs
+    # does bench re-exec itself into a clean process (a hang wedges a
+    # thread inside PJRT client creation, poisoning this process's backend
+    # state forever — exec is the only real reset) where fresh-subprocess
+    # probes every ~60 s cover the rest of a shared deadline, so a tunnel
+    # that recovers mid-window still yields a measurement.
+    expect_tpu = os.environ.get("JAX_PLATFORMS", "").lower() != "cpu"
+    wait_window = _envf("TF_OPERATOR_BENCH_WAIT", 1800.0)
+    attempt = int(os.environ.get("TF_OPERATOR_BENCH_ATTEMPT", "0"))
+    if expect_tpu and wait_window > 0 and attempt > 0:
+        # Re-exec after a failed/hung init: wait for a TPU-positive probe
+        # before touching jax in this process. The deadline is shared
+        # across re-execs (set below on first failure) so flapping cannot
+        # extend the total window.
+        deadline = _envf("TF_OPERATOR_BENCH_DEADLINE", 0.0)
+        remaining = deadline - time.time() if deadline else wait_window
+        if remaining <= 0:
+            remaining = 60.0  # one last short probe pass
+        probe_log = _wait_for_backend(
+            remaining, _envf("TF_OPERATOR_BENCH_PROBE_TIMEOUT", 120.0)
+        )
+        if not probe_log[-1]["ok"]:
+            _emit_error(
+                "backend-wait",
+                RuntimeError(
+                    f"backend never became available across "
+                    f"{len(probe_log)} probes in {remaining:.0f}s "
+                    f"(attempt {attempt})"
+                ),
+                extra={
+                    "attempts": len(probe_log),
+                    "window_s": round(remaining, 1),
+                    "probe_log": probe_log[-20:],
+                },
+            )
+            return 1
     try:
         devices = _init_devices(init_timeout)
-    except _BackendInitHang as exc:
-        _emit_error("backend-init", exc)
-        sys.stdout.flush()
-        os._exit(1)  # a thread is wedged in PJRT init; normal exit can hang
-    except Exception as exc:  # noqa: BLE001
-        _emit_error("backend-init", exc)
+        if expect_tpu and devices and devices[0].platform == "cpu":
+            # Silent CPU fallback after an UNAVAILABLE from the TPU plugin:
+            # a llama-tiny CPU number with a plausible-looking headline
+            # would be worse than an honest retry/error.
+            raise RuntimeError("backend fell back to cpu; tpu unavailable")
+        init_failed = None
+    except Exception as exc:  # noqa: BLE001 — incl. _BackendInitHang
+        init_failed = exc
+    if init_failed is not None:
+        if expect_tpu and wait_window > 0 and attempt < 3:
+            os.environ["TF_OPERATOR_BENCH_ATTEMPT"] = str(attempt + 1)
+            os.environ.setdefault(
+                "TF_OPERATOR_BENCH_DEADLINE", str(time.time() + wait_window)
+            )
+            print(
+                f"bench: backend init failed ({type(init_failed).__name__}: "
+                f"{init_failed}); re-exec attempt {attempt + 1}",
+                file=sys.stderr,
+            )
+            sys.stderr.flush()
+            sys.stdout.flush()
+            os.execv(sys.executable, [sys.executable] + sys.argv)
+        _emit_error("backend-init", init_failed)
+        if isinstance(init_failed, _BackendInitHang):
+            sys.stdout.flush()
+            os._exit(1)  # a thread is wedged in PJRT init; exit can hang
         return 1
     try:
         n = len(devices)

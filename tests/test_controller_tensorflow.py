@@ -451,6 +451,31 @@ class TestInvalidSpecs:
         assert cluster.list_pods() == []
         assert controller.queue.empty_and_idle(), f"{mutate}: queue not settled"
 
+    def test_explicit_null_fields_keep_defaults(self, env):
+        """A trailing `env:` / `command:` in YAML arrives as explicit null.
+        Non-Optional fields must keep their dataclass defaults — assigning
+        None used to crash in Container.set_env during reconcile, past the
+        ValidationError boundary, hot-requeueing forever (ADVICE r3)."""
+        cluster, controller = env
+        manifest = tfjob_manifest(worker=2)
+        container = manifest["spec"]["tfReplicaSpecs"]["Worker"]["template"]["spec"][
+            "containers"][0]
+        container["env"] = None
+        container["command"] = None
+        manifest["spec"]["tfReplicaSpecs"]["Worker"]["template"]["spec"][
+            "nodeSelector"] = None
+        cluster.create_job(manifest)
+        controller.run_until_idle()
+        # Reconcile succeeded: pods created with TF_CONFIG injected via set_env.
+        pods = cluster.list_pods()
+        assert len(pods) == 2
+        env_names = {e.name for e in pods[0].spec.containers[0].env}
+        assert "TF_CONFIG" in env_names
+        job = cluster.get_job("TFJob", "default", "test-tfjob")
+        conds = {c["type"]: c for c in job.get("status", {}).get("conditions", [])}
+        assert "Failed" not in conds
+        assert controller.queue.empty_and_idle()
+
     def test_string_replicas_coerced_when_numeric(self, env):
         """YAML users write replicas: "2" — unambiguous, so it works."""
         cluster, controller = env

@@ -58,6 +58,24 @@ class TestQuantities:
         assert format_quantity(3 * 2**30) == "3Gi"
         assert format_quantity(parse_quantity("1.5Gi")) == "1536Mi"
 
+    def test_binary_suffix_only_for_binary_inputs(self):
+        """An aggregated cpu of 1024 must render '1024', not '1Ki' —
+        binary suffixes are value-equal but bizarre for cpu (ADVICE r3)."""
+        assert format_quantity(1024, binary=False) == "1024"
+        assert format_quantity(1024, binary=True) == "1Ki"
+
+    def test_aggregate_cpu_1024_not_binary(self):
+        """128 hosts x 8 cpu: plain integer cpu, binary memory."""
+        from tf_operator_tpu.api.tfjob import TFJob
+
+        job = TFJob.parse(tfjob(workers=128, ps=0, resources={
+            "requests": {"cpu": "8", "memory": "1Gi"},
+        }))
+        out = aggregate_min_resources(
+            {"Worker": job.spec.tf_replica_specs["Worker"]}
+        )
+        assert out == {"cpu": "1024", "memory": "128Gi"}
+
     def test_exact_arithmetic_no_float_drift(self):
         """Hundreds of Gi summed must stay integral: float math turns the
         total fractional and renders milli-byte strings (ADVICE r2)."""

@@ -114,6 +114,63 @@ class TestOperatorOverKube:
             manager.stop()
 
 
+class TestStatusSubresourceSemantics:
+    def test_main_resource_put_cannot_clobber_status(self, stub, kube):
+        """Re-applying an exported CR (kubectl replace analog) carries the
+        stale status it was exported with; a real apiserver ignores it on
+        main-resource writes — the stub must too (ADVICE r3)."""
+        kube.create_job(tfjob("st"))
+        kube.update_job_status(
+            "TFJob", "default", "st",
+            {"conditions": [{"type": "Running", "status": "True"}]},
+        )
+        body = kube.get_job("TFJob", "default", "st")
+        body["spec"]["tfReplicaSpecs"]["Worker"]["replicas"] = 3
+        body["status"] = {"conditions": [{"type": "Succeeded", "status": "True"}]}
+        kube.update_job(body)
+        got = kube.get_job("TFJob", "default", "st")
+        assert got["spec"]["tfReplicaSpecs"]["Worker"]["replicas"] == 3
+        assert {c["type"] for c in got["status"]["conditions"]} == {"Running"}
+
+
+class TestClaimViewWithCustomSelector:
+    def test_released_pod_reachable_when_watch_selector_is_narrower(self, stub):
+        """An operator built with a narrower label selector still must see
+        owned objects whose labels were mutated away (the release scenario):
+        they fall out of the selector-filtered watch cache, so the claim
+        view has to fall back to the live operator-scope query (ADVICE r3)."""
+        from tf_operator_tpu.api.k8s import ObjectMeta, OwnerReference, Pod
+
+        cluster = KubeCluster(
+            base_url=stub.url, token="t",
+            label_selector="group-name=kubeflow.org,team=ml",
+        )
+        try:
+            stamped = {"group-name": "kubeflow.org", "team": "ml", "job-name": "j"}
+            pod = Pod(metadata=ObjectMeta(
+                name="owned", namespace="default", labels=dict(stamped),
+                owner_references=[OwnerReference(
+                    api_version="kubeflow.org/v1", kind="TFJob", name="j",
+                    uid="uid-1", controller=True,
+                )],
+            ))
+            cluster.create_pod(pod)
+            # Prime the selector-filtered watch cache.
+            cluster.watch("pods", lambda *_: None)
+            assert wait_until(lambda: len(
+                cluster.list_pods("default", labels=dict(stamped))) == 1)
+            # Release scenario: the team label is mutated away, dropping the
+            # pod from the watch; the claim view must still surface it.
+            pod.metadata.labels = {"group-name": "kubeflow.org", "job-name": "j"}
+            cluster.update_pod(pod)
+            assert wait_until(lambda: [
+                p.metadata.name for p in cluster.list_pods(
+                    "default", labels=dict(stamped), owner_uid="uid-1")
+            ] == ["owned"])
+        finally:
+            cluster.shutdown()
+
+
 class TestKubeconfig:
     """KUBECONFIG resolution (reference clientcmd, server.go:97-107)."""
 

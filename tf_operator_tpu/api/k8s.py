@@ -69,8 +69,24 @@ def from_dict(cls: type, data: Any) -> Any:
         fname = json_names.get(key, _to_snake(key))
         if fname not in hints:
             continue
+        if val is None and not _is_optional(hints[fname]):
+            # Explicit YAML null on a non-Optional field (a trailing `env:`
+            # or `command:`) keeps the dataclass default — assigning None
+            # would crash far from here (Container.set_env) during
+            # reconcile, past the ValidationError conversion boundary.
+            continue
         kwargs[fname] = _coerce(hints[fname], val)
     return cls(**kwargs)
+
+
+def _is_optional(hint: Any) -> bool:
+    import types
+    import typing
+
+    return (
+        typing.get_origin(hint) in (typing.Union, types.UnionType)
+        and type(None) in typing.get_args(hint)
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -98,13 +114,14 @@ def _coerce(hint: Any, val: Any) -> Any:
     Unambiguous coercions (``"2"`` -> 2) are accepted the way YAML users
     expect.
     """
+    import types
     import typing
 
     if val is None:
         return None  # explicit null = unset; nullability is validation's job
     origin = typing.get_origin(hint)
     args = typing.get_args(hint)
-    if origin is typing.Union:  # Optional[X]
+    if origin in (typing.Union, types.UnionType):  # Optional[X] / X | None
         inner = [a for a in args if a is not type(None)]
         return _coerce(inner[0], val) if inner else val
     if origin in (list, List):

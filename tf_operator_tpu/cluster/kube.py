@@ -788,21 +788,38 @@ class KubeCluster(Cluster):
         (no watch running — e.g. SDK usage — or a query broader than the
         cache: other namespace, or labels outside the watch selector).
         `owner_uid` widens the match to label-match OR owned-by-uid (claim
-        protocol view) — still within scope, since owned objects carry the
-        operator's label stamp."""
+        protocol view); with a selector-filtered watch that OR cannot be
+        served from the cache (released objects drop out of it), so those
+        queries always go live."""
         synced = self._synced.get(kind)
         if synced is None or not synced.is_set():
             return None
         if self._namespace and namespace != self._namespace:
             return None  # cache only holds the scoped namespace
         if kind in ("pods", "services") and self._label_selector:
+            selector = {}
+            for part in self._label_selector.split(","):
+                if part.strip():
+                    k, _, v = part.partition("=")
+                    selector[k.strip()] = v.strip()
+            operator_scope = {constants.LABEL_GROUP_NAME: constants.GROUP_NAME}
+            if owner_uid is not None and selector != operator_scope:
+                # Claim view is label-match OR owned-by-uid. With the default
+                # operator-scope selector the cache holds every object the
+                # live query would return (a released object keeps its
+                # group-name stamp, so it stays in the watch and the
+                # owned-by branch of matches_claim_view surfaces it). A
+                # NARROWER custom selector, though, drops released-but-owned
+                # objects from the watch, so the OR must go to the live
+                # operator-scope query. (If the group-name label itself was
+                # stripped, even the live query misses it and the object
+                # stays orphaned until GC — matching reference informer
+                # limits.)
+                return None
             # The watch stream is selector-filtered; only queries that imply
             # the selector (engine calls pass the full label stamp) can be
             # answered completely from the store.
-            implied = dict(
-                part.partition("=")[::2] for part in self._label_selector.split(",")
-            )
-            if not labels or any(labels.get(k) != v for k, v in implied.items()):
+            if not labels or any(labels.get(k) != v for k, v in selector.items()):
                 return None
         with self._informer_lock:
             entries = [obj for _, obj in self._stores.get(kind, {}).values()]

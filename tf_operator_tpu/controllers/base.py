@@ -61,6 +61,11 @@ class FrameworkController(FrameworkHooks):
             metrics = METRICS
         self.metrics = metrics
         self.expectations = ControllerExpectations()
+        # key -> uid of the last job seen at that key, so the sync-path
+        # NotFound cleanup can prune UID-keyed terminal-metrics entries even
+        # when the DELETED watch event was missed. Bounded by live jobs:
+        # pruned in _forget.
+        self._known_uids: Dict[str, str] = {}
         self.engine = JobController(
             hooks=self,
             cluster=self.cluster,
@@ -106,6 +111,9 @@ class FrameworkController(FrameworkHooks):
                 uid=meta.get("uid", ""),
             )
             return
+        if meta.get("uid"):
+            key = f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
+            self._note_uid(key, meta["uid"])
         self._enqueue(meta.get("namespace", "default"), meta.get("name", ""))
 
     def _on_dependent_event(self, dependent_kind: str):
@@ -130,6 +138,16 @@ class FrameworkController(FrameworkHooks):
 
         return handler
 
+    def _note_uid(self, key: str, uid: str) -> None:
+        """Remember the uid living at a key; a DIFFERENT uid appearing there
+        means the old job was deleted and the name reused — prune the old
+        uid's terminal-metrics entries now, since the NotFound sync that
+        would have done it can no longer learn the old uid."""
+        old = self._known_uids.get(key)
+        if old and old != uid:
+            self.metrics.forget_terminal(self.kind, old)
+        self._known_uids[key] = uid
+
     def _forget(self, key: str, uid: str = "") -> None:
         """Drop every piece of per-job in-memory bookkeeping (expectations,
         the engine's gang-sweep cache, the metrics terminal-dedup entries) —
@@ -138,6 +156,8 @@ class FrameworkController(FrameworkHooks):
         self.expectations.delete_expectations(key, "pods")
         self.expectations.delete_expectations(key, "services")
         self.engine.forget_job(key)
+        uid = uid or self._known_uids.get(key, "")
+        self._known_uids.pop(key, None)
         if uid:
             self.metrics.forget_terminal(self.kind, uid)
 
@@ -186,6 +206,9 @@ class FrameworkController(FrameworkHooks):
         except NotFound:
             self._forget(f"{namespace}/{name}")
             return
+        uid = (job_dict.get("metadata") or {}).get("uid")
+        if uid:
+            self._note_uid(f"{namespace}/{name}", uid)
 
         try:
             job = self.parse_job(job_dict)

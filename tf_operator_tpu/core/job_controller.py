@@ -111,15 +111,18 @@ def parse_quantity(value) -> Fraction:
     return Fraction(s)
 
 
-def format_quantity(value) -> str:
+def format_quantity(value, binary: bool = True) -> str:
     value = _to_fraction(value)
     if value.denominator == 1:
         n = value.numerator
         # Memory-style totals come back out in binary suffixes (8Gi, not
-        # 8589934592) so schedulers and humans can read them.
-        for suffix, mult in _BINARY_SUFFIXES:
-            if n >= mult and n % mult == 0:
-                return f"{n // mult}{suffix}"
+        # 8589934592) so schedulers and humans can read them — but only
+        # when the inputs used binary suffixes; an aggregated cpu of 1024
+        # must not render as "1Ki" on a scheduler dashboard.
+        if binary:
+            for suffix, mult in _BINARY_SUFFIXES:
+                if n >= mult and n % mult == 0:
+                    return f"{n // mult}{suffix}"
         return str(n)
     milli = value * 1000
     if milli.denominator == 1:
@@ -134,6 +137,7 @@ def aggregate_min_resources(replicas: Dict[str, ReplicaSpec]) -> Dict[str, str]:
     PodGroup.spec.minResources the same way so the gang scheduler can
     reserve capacity for the entire job at once."""
     totals: Dict[str, Fraction] = {}
+    binary: Dict[str, bool] = {}
     for spec in replicas.values():
         n = spec.replicas or 0
         for container in spec.template.spec.containers:
@@ -141,7 +145,22 @@ def aggregate_min_resources(replicas: Dict[str, ReplicaSpec]) -> Dict[str, str]:
             requests = resources.get("requests") or resources.get("limits") or {}
             for name, value in requests.items():
                 totals[name] = totals.get(name, Fraction(0)) + n * parse_quantity(value)
-    return {name: format_quantity(v) for name, v in sorted(totals.items())}
+                if str(value).strip().endswith(("Ki", "Mi", "Gi", "Ti", "Pi", "Ei")):
+                    binary[name] = True
+
+    def memory_like(name: str) -> bool:
+        # Byte-denominated resources render in binary suffixes even when
+        # requested as bare byte counts; cpu/pod-count style resources
+        # never do (an aggregated cpu of 1024 must not print "1Ki").
+        return (
+            name in ("memory", "ephemeral-storage")
+            or name.startswith("hugepages-")
+        )
+
+    return {
+        name: format_quantity(v, binary=binary.get(name, memory_like(name)))
+        for name, v in sorted(totals.items())
+    }
 
 
 def get_container_exit_code(pod: Pod, container_name: str) -> int:

@@ -219,6 +219,8 @@ class StubApiServer:
         if method == "PUT":
             body = handler._body()
             validate_job_dict(body)
+            # Status-subresource semantics on update (client-supplied
+            # .status ignored) are enforced by mem.update_job itself.
             return handler._json(200, self.mem.update_job(body))
         if method == "PATCH" and m["status"]:
             status = handler._body().get("status", {})

@@ -1,0 +1,77 @@
+"""Real-torch.distributed gloo rendezvous workload.
+
+Run as a PyTorchJob container command in the process-backed e2e tier:
+genuine torch.distributed reads the operator-injected MASTER_ADDR /
+MASTER_PORT / RANK / WORLD_SIZE (bootstrap/c10d.py, reference
+pytorch.go:27-82) through init_process_group's env:// rendezvous — the
+exact consumption path `torchrun`-less reference jobs use (reference
+examples/pytorch/smoke-dist/dist_sendrecv.py) — then proves the process
+group with one allreduce and one send/recv ring.
+
+Log lines the e2e asserts on:
+  GLOO_ENV {json}     — the env contract as torch consumed it
+  GLOO_ALLREDUCE v    — sum of (rank+1) across the world
+  GLOO_RING v         — received value from the left neighbor
+  GLOO_OK             — all checks passed in-process
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import sys
+
+
+def main() -> int:
+    import torch
+    import torch.distributed as dist
+
+    env = {k: os.environ.get(k) for k in
+           ("MASTER_ADDR", "MASTER_PORT", "RANK", "WORLD_SIZE")}
+    print(f"GLOO_ENV {json.dumps(env)}", flush=True)
+
+    # env:// rendezvous — torch reads MASTER_ADDR/PORT/RANK/WORLD_SIZE
+    # itself; passing them explicitly would defeat the contract test.
+    dist.init_process_group(
+        backend="gloo", init_method="env://",
+        timeout=datetime.timedelta(seconds=60),
+    )
+    rank, world = dist.get_rank(), dist.get_world_size()
+    if rank != int(env["RANK"]) or world != int(env["WORLD_SIZE"]):
+        print(f"GLOO_FAIL rank/world mismatch: {rank}/{world} vs env", flush=True)
+        return 1
+
+    t = torch.tensor([float(rank + 1)])
+    dist.all_reduce(t, op=dist.ReduceOp.SUM)
+    expect = world * (world + 1) / 2
+    print(f"GLOO_ALLREDUCE {t.item()}", flush=True)
+    if t.item() != expect:
+        print(f"GLOO_FAIL allreduce {t.item()} != {expect}", flush=True)
+        return 1
+
+    # Send/recv ring (smoke-dist parity): pass rank to the right neighbor.
+    # Degenerate world=1 has no neighbor — send-to-self would deadlock.
+    if world > 1:
+        recv = torch.zeros(1)
+        send = torch.tensor([float(rank)])
+        right, left = (rank + 1) % world, (rank - 1) % world
+        if rank % 2 == 0:
+            dist.send(send, dst=right)
+            dist.recv(recv, src=left)
+        else:
+            dist.recv(recv, src=left)
+            dist.send(send, dst=right)
+        print(f"GLOO_RING {recv.item()}", flush=True)
+        if int(recv.item()) != left:
+            print(f"GLOO_FAIL ring recv {recv.item()} != {left}", flush=True)
+            return 1
+
+    dist.barrier()
+    dist.destroy_process_group()
+    print("GLOO_OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
