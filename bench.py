@@ -255,6 +255,14 @@ def _wait_for_backend(window: float, probe_timeout: float = 120.0,
         })
         if ok or time.monotonic() >= deadline:
             return attempts
+        if not ok and any(
+            marker in detail
+            for marker in ("ModuleNotFoundError", "ImportError", "SyntaxError")
+        ):
+            # Deterministic environment breakage, not a tunnel outage:
+            # every retry would fail identically — emit the error line now
+            # rather than after the full wait window.
+            return attempts
         remaining = deadline - time.monotonic()
         print(
             f"bench: backend unavailable ({detail}); retrying, "

@@ -1,0 +1,88 @@
+"""BERT-base MLM training over PJRT/XLA on TPU (BASELINE config #3).
+
+Runs inside the PyTorchJob of pytorchjob_bert_pjrt_v5e16.yaml: each host
+pod gets PJRT_DEVICE=TPU + libtpu identity from the operator, so torch_xla
+brings up the slice with no torchrun and no cloud metadata. Off-TPU (smoke
+runs, CI) it falls back to plain torch.distributed gloo over the injected
+c10d env — the same model step, CPU tensors.
+
+The GPU-era ancestor is the reference's pytorch mnist DDP example
+(examples/pytorch/mnist/mnist.py); PJRT replaces the NCCL process group
+with XLA's, which is the point of the CRD extension.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def build_model(vocab: int = 30522, hidden: int = 256, layers: int = 4):
+    import torch
+
+    encoder_layer = torch.nn.TransformerEncoderLayer(
+        d_model=hidden, nhead=8, dim_feedforward=hidden * 4, batch_first=True
+    )
+    return torch.nn.Sequential(
+        torch.nn.Embedding(vocab, hidden),
+        torch.nn.TransformerEncoder(encoder_layer, num_layers=layers),
+        torch.nn.Linear(hidden, vocab),
+    )
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--per-host-batch", type=int, default=8)
+    parser.add_argument("--seq", type=int, default=128)
+    parser.add_argument("--steps", type=int, default=10)
+    args = parser.parse_args()
+
+    import torch
+
+    on_tpu = os.environ.get("PJRT_DEVICE") == "TPU"
+    if on_tpu:
+        import torch_xla.core.xla_model as xm  # type: ignore
+        import torch_xla.distributed.xla_backend  # noqa: F401
+        import torch.distributed as dist
+
+        dist.init_process_group("xla", init_method="xla://")
+        device = xm.xla_device()
+    else:
+        import torch.distributed as dist
+
+        dist.init_process_group("gloo", init_method="env://")
+        device = torch.device("cpu")
+
+    model = build_model().to(device)
+    model = torch.nn.parallel.DistributedDataParallel(model)
+    optimizer = torch.optim.AdamW(model.parameters(), lr=1e-4)
+    loss_fn = torch.nn.CrossEntropyLoss()
+
+    g = torch.Generator().manual_seed(int(os.environ.get("RANK", "0")))
+    for step in range(args.steps):
+        ids = torch.randint(
+            0, 30522, (args.per_host_batch, args.seq), generator=g
+        ).to(device)
+        targets = torch.roll(ids, -1, dims=1)
+        optimizer.zero_grad()
+        logits = model(ids)
+        loss = loss_fn(logits.reshape(-1, logits.size(-1)), targets.reshape(-1))
+        loss.backward()
+        optimizer.step()
+        if on_tpu:
+            import torch_xla.core.xla_model as xm
+
+            xm.mark_step()
+        if step % 5 == 0 or step == args.steps - 1:
+            print(f"step {step} loss {loss.item():.4f}", flush=True)
+
+    import torch.distributed as dist
+
+    dist.barrier()
+    dist.destroy_process_group()
+    print("done", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

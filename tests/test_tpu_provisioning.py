@@ -1,0 +1,267 @@
+"""TPU pod-slice provisioning on the GPU-era kinds (spec.tpu on TFJob /
+PyTorchJob / MXJob) — the north-star CRD extension.
+
+Covers: replica defaulting from the slice topology, the libtpu identity +
+per-kind accelerator env contract (TPUStrategy env for TF, PJRT for torch),
+GKE selectors + chip resources on host pods only, validation, and gang
+all-or-nothing semantics matching JAXJob's (reference env-injection anchor:
+tensorflow.go:97-173; JAXJob analog: controllers/jax.py).
+"""
+
+import pytest
+
+from tf_operator_tpu.api import parse_job, KINDS
+from tf_operator_tpu.api.defaulting import ValidationError
+from tf_operator_tpu.cluster.memory import InMemoryCluster
+from tf_operator_tpu.controllers.mxnet import MXController
+from tf_operator_tpu.controllers.pytorch import PyTorchController
+from tf_operator_tpu.controllers.tensorflow import TFController
+from tf_operator_tpu.core.job_controller import EngineOptions
+
+
+def tfjob(tpu=None, workers=None, extra_types=None, name="tj"):
+    spec = {"tfReplicaSpecs": {}}
+    worker = {"template": {"spec": {"containers": [
+        {"name": "tensorflow", "image": "tf:1"}]}}}
+    if workers is not None:
+        worker["replicas"] = workers
+    spec["tfReplicaSpecs"]["Worker"] = worker
+    for t in extra_types or ():
+        spec["tfReplicaSpecs"][t] = {
+            "replicas": 1,
+            "template": {"spec": {"containers": [
+                {"name": "tensorflow", "image": "tf:1"}]}},
+        }
+    if tpu is not None:
+        spec["tpu"] = tpu
+    return {
+        "apiVersion": "kubeflow.org/v1", "kind": "TFJob",
+        "metadata": {"name": name, "namespace": "default"}, "spec": spec,
+    }
+
+
+def ptjob(tpu=None, workers=None, name="pj"):
+    def replica(n=None):
+        out = {"template": {"spec": {"containers": [
+            {"name": "pytorch", "image": "pt:1"}]}}}
+        if n is not None:
+            out["replicas"] = n
+        return out
+
+    spec = {"pytorchReplicaSpecs": {
+        "Master": {**replica(), "replicas": 1},
+        "Worker": replica(workers),
+    }}
+    if tpu is not None:
+        spec["tpu"] = tpu
+    return {
+        "apiVersion": "kubeflow.org/v1", "kind": "PyTorchJob",
+        "metadata": {"name": name, "namespace": "default"}, "spec": spec,
+    }
+
+
+def parsed(manifest):
+    job = parse_job(manifest)
+    _, set_defaults, validate = KINDS[job.kind]
+    set_defaults(job)
+    validate(job.spec)
+    return job
+
+
+class TestDefaulting:
+    def test_tfjob_worker_count_defaults_from_topology(self):
+        # v5e-8: one host with 8 chips -> 1 worker.
+        job = parsed(tfjob(tpu={"acceleratorType": "v5e-8"}))
+        assert job.spec.tf_replica_specs["Worker"].replicas == 1
+        # v5e-16: 4 hosts x 4 chips -> 4 workers.
+        job = parsed(tfjob(tpu={"acceleratorType": "v5e-16"}))
+        assert job.spec.tf_replica_specs["Worker"].replicas == 4
+        # 2 slices double the worker count.
+        job = parsed(tfjob(tpu={"acceleratorType": "v5e-16", "numSlices": 2}))
+        assert job.spec.tf_replica_specs["Worker"].replicas == 8
+
+    def test_pytorchjob_workers_default_to_hosts_minus_master(self):
+        job = parsed(ptjob(tpu={"acceleratorType": "v5e-16"}))
+        assert job.spec.pytorch_replica_specs["Worker"].replicas == 3
+
+    def test_mxjob_worker_count_defaults_from_topology(self):
+        job = parsed({
+            "apiVersion": "kubeflow.org/v1", "kind": "MXJob",
+            "metadata": {"name": "mx", "namespace": "default"},
+            "spec": {
+                "tpu": {"acceleratorType": "v5e-16"},
+                "mxReplicaSpecs": {
+                    "Scheduler": {"replicas": 1, "template": {"spec": {
+                        "containers": [{"name": "mxnet", "image": "mx:1"}]}}},
+                    "Worker": {"template": {"spec": {
+                        "containers": [{"name": "mxnet", "image": "mx:1"}]}}},
+                },
+            },
+        })
+        assert job.spec.mx_replica_specs["Worker"].replicas == 4
+
+
+class TestValidation:
+    def test_unknown_accelerator_rejected(self):
+        with pytest.raises(ValidationError, match="unknown TPU accelerator"):
+            parsed(tfjob(tpu={"acceleratorType": "v9-999"}))
+
+    def test_tf_ps_with_tpu_rejected(self):
+        with pytest.raises(ValidationError, match="PS replicas cannot"):
+            parsed(tfjob(tpu={"acceleratorType": "v5e-8"}, extra_types=("PS",)))
+
+    def test_wrong_host_count_rejected(self):
+        with pytest.raises(ValidationError, match="requires 4 TPU host"):
+            parsed(tfjob(tpu={"acceleratorType": "v5e-16"}, workers=3))
+        with pytest.raises(ValidationError, match="requires 4 TPU host"):
+            parsed(ptjob(tpu={"acceleratorType": "v5e-16"}, workers=5))
+
+    def test_jaxjob_rejects_tpu_num_slices(self):
+        with pytest.raises(ValidationError, match="use spec.numSlices"):
+            parsed({
+                "apiVersion": "kubeflow.org/v1", "kind": "JAXJob",
+                "metadata": {"name": "jj", "namespace": "default"},
+                "spec": {
+                    "tpu": {"acceleratorType": "v5e-16", "numSlices": 2},
+                    "jaxReplicaSpecs": {"Worker": {"template": {"spec": {
+                        "containers": [{"name": "jax", "image": "j:1"}]}}}},
+                },
+            })
+
+
+class TestEnvAndProvisioning:
+    def _reconcile(self, controller_cls, manifest, schemes=None):
+        cluster = InMemoryCluster()
+        ctrl = controller_cls(
+            cluster, options=EngineOptions(enable_gang_scheduling=True)
+        )
+        cluster.create_job(manifest)
+        ctrl.run_until_idle()
+        return cluster
+
+    def test_tfjob_worker_pods_get_libtpu_env_and_chips(self):
+        cluster = self._reconcile(
+            TFController,
+            tfjob(tpu={"acceleratorType": "v5e-16", "topology": "4x4"},
+                  extra_types=("Chief",)),
+        )
+        pods = {p.metadata.name: p for p in cluster.list_pods("default")}
+        assert len(pods) == 5  # 4 workers + 1 chief
+        w1 = pods["tj-worker-1"].spec.containers[0]
+        assert w1.get_env("TPU_WORKER_ID") == "1"
+        hostnames = w1.get_env("TPU_WORKER_HOSTNAMES").split(",")
+        assert hostnames == [
+            f"tj-worker-{i}.default.svc" for i in range(4)
+        ]
+        assert w1.get_env("TPU_ACCELERATOR_TYPE") == "v5e-16"
+        assert w1.get_env("TPU_TOPOLOGY") == "4x4"
+        assert w1.get_env("TF_CONFIG") is not None
+        assert w1.resources["limits"]["google.com/tpu"] == "4"
+        sel = pods["tj-worker-1"].spec.node_selector
+        assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+        assert sel["cloud.google.com/gke-tpu-topology"] == "4x4"
+        # The chief is a CPU coordinator: TF_CONFIG yes, TPU nothing.
+        chief = pods["tj-chief-0"].spec.containers[0]
+        assert chief.get_env("TF_CONFIG") is not None
+        assert chief.get_env("TPU_WORKER_ID") is None
+        assert "google.com/tpu" not in (chief.resources.get("limits") or {})
+        assert "cloud.google.com/gke-tpu-accelerator" not in (
+            pods["tj-chief-0"].spec.node_selector
+        )
+
+    def test_pytorchjob_hosts_get_pjrt_and_rank_ordered_ids(self):
+        cluster = self._reconcile(
+            PyTorchController, ptjob(tpu={"acceleratorType": "v5e-16"})
+        )
+        pods = {p.metadata.name: p for p in cluster.list_pods("default")}
+        assert len(pods) == 4  # master + 3 workers
+        master = pods["pj-master-0"].spec.containers[0]
+        assert master.get_env("PJRT_DEVICE") == "TPU"
+        assert master.get_env("TPU_WORKER_ID") == "0"
+        # Master is rank-0 host; workers follow in order.
+        w0 = pods["pj-worker-0"].spec.containers[0]
+        assert w0.get_env("TPU_WORKER_ID") == "1"
+        assert w0.get_env("PJRT_DEVICE") == "TPU"
+        hostnames = w0.get_env("TPU_WORKER_HOSTNAMES").split(",")
+        assert hostnames[0] == "pj-master-0.default.svc"
+        assert hostnames[1:] == [
+            f"pj-worker-{i}.default.svc" for i in range(3)
+        ]
+        # c10d contract still present alongside.
+        assert w0.get_env("MASTER_ADDR") is not None
+        assert master.resources["limits"]["google.com/tpu"] == "4"
+
+    def test_mxjob_workers_get_chips_scheduler_does_not(self):
+        cluster = self._reconcile(MXController, {
+            "apiVersion": "kubeflow.org/v1", "kind": "MXJob",
+            "metadata": {"name": "mx", "namespace": "default"},
+            "spec": {
+                "tpu": {"acceleratorType": "v5e-8"},
+                "mxReplicaSpecs": {
+                    "Scheduler": {"replicas": 1, "template": {"spec": {
+                        "containers": [{"name": "mxnet", "image": "mx:1"}]}}},
+                    "Worker": {"template": {"spec": {
+                        "containers": [{"name": "mxnet", "image": "mx:1"}]}}},
+                },
+            },
+        })
+        pods = {p.metadata.name: p for p in cluster.list_pods("default")}
+        worker = pods["mx-worker-0"].spec.containers[0]
+        assert worker.get_env("TPU_WORKER_ID") == "0"
+        assert worker.resources["limits"]["google.com/tpu"] == "8"
+        sched = pods["mx-scheduler-0"].spec.containers[0]
+        assert sched.get_env("TPU_WORKER_ID") is None
+        assert "google.com/tpu" not in (sched.resources.get("limits") or {})
+
+
+class TestGangAllOrNothing:
+    def test_tfjob_slice_gangs_like_jaxjob(self):
+        """One PodGroup, minMember = every pod (workers + chief), chips in
+        minResources — a partial slice must not schedule (JAXJob parity)."""
+        cluster = InMemoryCluster()
+        ctrl = TFController(
+            cluster, options=EngineOptions(enable_gang_scheduling=True)
+        )
+        cluster.create_job(tfjob(
+            tpu={"acceleratorType": "v5e-16"}, extra_types=("Chief",)
+        ))
+        ctrl.run_until_idle()
+        group = cluster.get_pod_group("default", "tj")
+        assert group["spec"]["minMember"] == 5
+        assert group["spec"]["minResources"]["google.com/tpu"] == "16"
+
+    def test_tfjob_multislice_one_gang_per_slice(self):
+        cluster = InMemoryCluster()
+        ctrl = TFController(
+            cluster, options=EngineOptions(enable_gang_scheduling=True)
+        )
+        cluster.create_job(tfjob(
+            tpu={"acceleratorType": "v5e-16", "numSlices": 2}
+        ))
+        ctrl.run_until_idle()
+        for s in (0, 1):
+            group = cluster.get_pod_group("default", f"tj-slice-{s}")
+            assert group["spec"]["minMember"] == 4
+            assert group["spec"]["minResources"]["google.com/tpu"] == "16"
+        # Pods are annotated into their slice's gang.
+        from tf_operator_tpu.core import constants as C
+
+        slices = {
+            p.metadata.name: p.metadata.annotations[C.ANNOTATION_GANG_GROUP_NAME]
+            for p in cluster.list_pods("default")
+        }
+        assert slices["tj-worker-0"] == "tj-slice-0"
+        assert slices["tj-worker-3"] == "tj-slice-0"
+        assert slices["tj-worker-4"] == "tj-slice-1"
+        assert slices["tj-worker-7"] == "tj-slice-1"
+
+    def test_pytorchjob_gang_includes_master_and_chips(self):
+        cluster = InMemoryCluster()
+        ctrl = PyTorchController(
+            cluster, options=EngineOptions(enable_gang_scheduling=True)
+        )
+        cluster.create_job(ptjob(tpu={"acceleratorType": "v5e-16"}))
+        ctrl.run_until_idle()
+        group = cluster.get_pod_group("default", "pj")
+        assert group["spec"]["minMember"] == 4
+        assert group["spec"]["minResources"]["google.com/tpu"] == "16"

@@ -53,57 +53,15 @@ DEFAULT_RESTART_POLICY = "ExitCode"
 REPLICA_TYPE_WORKER = "Worker"
 CANONICAL_REPLICA_TYPES = (REPLICA_TYPE_WORKER,)
 
-# Known accelerator types -> (chips per slice, chips per host). Used to
-# default replicas (hosts = chips/chips_per_host) and gang minAvailable.
-ACCELERATOR_TOPOLOGIES: Dict[str, tuple] = {
-    "v4-8": (4, 4),
-    "v4-16": (8, 4),
-    "v4-32": (16, 4),
-    "v5e-1": (1, 1),
-    "v5e-4": (4, 4),
-    "v5e-8": (8, 8),
-    "v5e-16": (16, 4),
-    "v5e-32": (32, 4),
-    "v5e-64": (64, 4),
-    "v5e-128": (128, 4),
-    "v5e-256": (256, 4),
-    "v5p-8": (4, 4),
-    "v5p-16": (8, 4),
-    "v5p-32": (16, 4),
-    "v6e-8": (8, 8),
-    "v6e-16": (16, 4),
-    "v6e-32": (32, 4),
-    "v6e-64": (64, 4),
-    "v6e-256": (256, 4),
-}
-
-
-@dataclass
-class TPUSpec:
-    """The pod-slice request attached to the Worker replica group."""
-
-    # e.g. "v5e-32" — see ACCELERATOR_TOPOLOGIES.
-    accelerator_type: str = ""
-    # Physical topology string, e.g. "4x8" (v5e-32) or "2x2x2" (v4-16);
-    # published to pods and used as the GKE topology node selector.
-    topology: str = ""
-    # Chips handed to each worker pod (google.com/tpu resource).
-    chips_per_host: Optional[int] = None
-
-
-def hosts_for(tpu: TPUSpec) -> Optional[int]:
-    """Host (pod) count a slice requires, or None when unknown."""
-    info = ACCELERATOR_TOPOLOGIES.get(tpu.accelerator_type)
-    if info is None:
-        return None
-    chips, default_chips_per_host = info
-    per_host = tpu.chips_per_host or default_chips_per_host
-    return max(1, chips // per_host)
-
-
-def chips_for(tpu: TPUSpec) -> Optional[int]:
-    info = ACCELERATOR_TOPOLOGIES.get(tpu.accelerator_type)
-    return info[0] if info else None
+# The TPU vocabulary is shared across kinds (api/tpu.py, north star: TPU
+# pod-slice provisioning on TFJob/PyTorchJob/MXJob too); re-exported here
+# because JAXJob is where it originated.
+from .tpu import (  # noqa: F401  (re-export)
+    ACCELERATOR_TOPOLOGIES,
+    TPUSpec,
+    chips_for,
+    hosts_for,
+)
 
 
 @dataclass
@@ -226,6 +184,11 @@ def validate(spec: JAXJobSpec) -> None:
         raise ValidationError(
             f"JAXJobSpec is not valid: {worker.replicas} workers cannot split "
             f"evenly over {spec.num_slices} slices"
+        )
+    if spec.tpu is not None and spec.tpu.num_slices != 1:
+        raise ValidationError(
+            "JAXJobSpec is not valid: use spec.numSlices (which also drives "
+            "MEGASCALE env), not tpu.numSlices"
         )
     if spec.tpu is not None and spec.tpu.accelerator_type:
         if spec.tpu.accelerator_type not in ACCELERATOR_TOPOLOGIES:

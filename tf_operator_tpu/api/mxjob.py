@@ -22,6 +22,12 @@ from .defaulting import (
     set_default_port,
     set_default_replicas,
 )
+from .tpu import (
+    TPUSpec,
+    default_host_replicas,
+    validate_accelerator,
+    validate_host_count,
+)
 
 # Constants (reference pkg/apis/mxnet/v1/constants.go:20-28)
 KIND = "MXJob"
@@ -65,6 +71,10 @@ class MXJobSpec:
     run_policy: RunPolicy = field(default_factory=RunPolicy)
     job_mode: str = JOB_MODE_TRAIN
     mx_replica_specs: Dict[ReplicaType, ReplicaSpec] = field(default_factory=dict)
+    # TPU pod-slice provisioning (north star: extend the GPU-era CRDs).
+    # The Worker group becomes the slice's host pods; Scheduler/Server
+    # stay CPU pods and gang with slice 0.
+    tpu: Optional[TPUSpec] = None
 
     __schema_required__ = ("mxReplicaSpecs",)
 
@@ -93,7 +103,9 @@ def set_defaults(job: MXJob) -> None:
     if not job.spec.job_mode:
         job.spec.job_mode = JOB_MODE_TRAIN
     normalize_replica_type_names(job.spec.mx_replica_specs, CANONICAL_REPLICA_TYPES)
-    for spec in job.spec.mx_replica_specs.values():
+    for rtype, spec in job.spec.mx_replica_specs.items():
+        if spec.replicas is None and rtype == REPLICA_TYPE_WORKER:
+            spec.replicas = default_host_replicas(job.spec.tpu)
         set_default_replicas(spec, DEFAULT_RESTART_POLICY)
         set_default_port(spec.template.spec, DEFAULT_CONTAINER_NAME, DEFAULT_PORT_NAME, DEFAULT_PORT)
 
@@ -122,3 +134,13 @@ def validate(spec: MXJobSpec) -> None:
             )
     if found_scheduler > 1:
         raise ValidationError("more than 1 scheduler found")
+    if spec.tpu is not None:
+        validate_accelerator(spec.tpu, KIND)
+        worker = spec.mx_replica_specs.get(REPLICA_TYPE_WORKER)
+        if worker is None:
+            raise ValidationError(
+                "MXJobSpec is not valid: spec.tpu requires a Worker replica "
+                "group (the slice's host pods)"
+            )
+        if worker.replicas is not None:
+            validate_host_count(spec.tpu, KIND, worker.replicas)

@@ -7,7 +7,7 @@ and pkg/apis/pytorch/validation/validation.go.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict
+from typing import Dict, Optional
 
 from .common import (
     CLEAN_POD_POLICY_RUNNING,
@@ -22,6 +22,12 @@ from .defaulting import (
     set_default_port,
     set_default_replicas,
     validate_replica_specs,
+)
+from .tpu import (
+    TPUSpec,
+    default_host_replicas,
+    validate_accelerator,
+    validate_host_count,
 )
 
 # Constants (reference pkg/apis/pytorch/v1/constants.go:22-30)
@@ -46,6 +52,13 @@ CANONICAL_REPLICA_TYPES = (REPLICA_TYPE_MASTER, REPLICA_TYPE_WORKER)
 class PyTorchJobSpec:
     run_policy: RunPolicy = field(default_factory=RunPolicy)
     pytorch_replica_specs: Dict[ReplicaType, ReplicaSpec] = field(default_factory=dict)
+    # TPU pod-slice provisioning (north star: extend the GPU-era CRDs).
+    # Master + Workers together are the slice's host pods in rank order
+    # (master = rank 0 host): Worker replicas default to hosts-1, every
+    # host pod gets GKE selectors + google.com/tpu chips + libtpu identity
+    # env + PJRT_DEVICE=TPU (the torch_xla PJRT contract), and the job
+    # gangs all-or-nothing per slice.
+    tpu: Optional[TPUSpec] = None
 
     __schema_required__ = ("pytorchReplicaSpecs",)
 
@@ -67,7 +80,14 @@ def set_defaults(job: PyTorchJob) -> None:
     if job.spec.run_policy.clean_pod_policy is None:
         job.spec.run_policy.clean_pod_policy = CLEAN_POD_POLICY_RUNNING
     normalize_replica_type_names(job.spec.pytorch_replica_specs, CANONICAL_REPLICA_TYPES)
-    for spec in job.spec.pytorch_replica_specs.values():
+    for rtype, spec in job.spec.pytorch_replica_specs.items():
+        # TPU jobs: master + workers are the slice's hosts — workers
+        # default to the remaining host count after the single master.
+        if spec.replicas is None and rtype == REPLICA_TYPE_WORKER:
+            masters = REPLICA_TYPE_MASTER in job.spec.pytorch_replica_specs
+            spec.replicas = default_host_replicas(
+                job.spec.tpu, reserve=1 if masters else 0
+            )
         set_default_replicas(spec, DEFAULT_RESTART_POLICY)
         set_default_port(spec.template.spec, DEFAULT_CONTAINER_NAME, DEFAULT_PORT_NAME, DEFAULT_PORT)
 
@@ -89,3 +109,11 @@ def validate(spec: PyTorchJobSpec) -> None:
         raise ValidationError("PyTorchJobSpec is not valid: Master ReplicaSpec must be present")
     if master.replicas is not None and master.replicas != 1:
         raise ValidationError("PyTorchJobSpec is not valid: There must be only 1 master replica")
+    if spec.tpu is not None:
+        validate_accelerator(spec.tpu, KIND)
+        worker = spec.pytorch_replica_specs.get(REPLICA_TYPE_WORKER)
+        total = (master.replicas or 1) + (
+            (worker.replicas or 0) if worker is not None else 0
+        )
+        if worker is None or worker.replicas is not None:
+            validate_host_count(spec.tpu, KIND, total)

@@ -23,6 +23,12 @@ from .defaulting import (
     set_default_replicas,
     validate_replica_specs,
 )
+from .tpu import (
+    TPUSpec,
+    default_host_replicas,
+    validate_accelerator,
+    validate_host_count,
+)
 
 # Constants (reference pkg/apis/tensorflow/v1/constants.go:21-39)
 KIND = "TFJob"
@@ -81,6 +87,14 @@ class TFJobSpec:
     # without restarting the world (reference types.go:69-70,
     # tensorflow.go:62-83).
     enable_dynamic_worker: bool = False
+    # TPU pod-slice provisioning (north star: extend the GPU-era CRDs).
+    # The Worker group becomes the slice's host pods — replicas default to
+    # the topology's host count, each pod gets GKE selectors + google.com/
+    # tpu chips + libtpu identity env (TPUStrategy reads the same libtpu
+    # layer JAX does), and the job gangs all-or-nothing per slice.
+    # Chief/Master/Evaluator stay CPU pods; PS is rejected (parameter
+    # servers are a GPU/CPU-era topology — TPU training is all-reduce).
+    tpu: Optional[TPUSpec] = None
 
     __schema_required__ = ("tfReplicaSpecs",)
 
@@ -105,7 +119,11 @@ def set_defaults(tfjob: TFJob) -> None:
     if tfjob.spec.success_policy is None:
         tfjob.spec.success_policy = SUCCESS_POLICY_DEFAULT
     normalize_replica_type_names(tfjob.spec.tf_replica_specs, CANONICAL_REPLICA_TYPES)
-    for spec in tfjob.spec.tf_replica_specs.values():
+    for rtype, spec in tfjob.spec.tf_replica_specs.items():
+        # TPU jobs: the Worker group IS the slice — replicas default to the
+        # host count the topology implies (x slices), like JAXJob.
+        if spec.replicas is None and rtype == REPLICA_TYPE_WORKER:
+            spec.replicas = default_host_replicas(tfjob.spec.tpu)
         set_default_replicas(spec, DEFAULT_RESTART_POLICY)
         set_default_port(spec.template.spec, DEFAULT_CONTAINER_NAME, DEFAULT_PORT_NAME, DEFAULT_PORT)
 
@@ -116,3 +134,18 @@ def validate(spec: TFJobSpec) -> None:
     found_chief = sum(1 for rt in spec.tf_replica_specs if is_chief_or_master(rt))
     if found_chief > 1:
         raise ValidationError("TFJobSpec is not valid: more than 1 chief/master found")
+    if spec.tpu is not None:
+        validate_accelerator(spec.tpu, KIND)
+        if REPLICA_TYPE_PS in spec.tf_replica_specs:
+            raise ValidationError(
+                "TFJobSpec is not valid: PS replicas cannot be combined with "
+                "spec.tpu (TPU training is all-reduce, not parameter-server)"
+            )
+        worker = spec.tf_replica_specs.get(REPLICA_TYPE_WORKER)
+        if worker is None:
+            raise ValidationError(
+                "TFJobSpec is not valid: spec.tpu requires a Worker replica "
+                "group (the slice's host pods)"
+            )
+        if worker.replicas is not None:
+            validate_host_count(spec.tpu, KIND, worker.replicas)

@@ -1,0 +1,126 @@
+"""Shared TPU pod-slice vocabulary — `spec.tpu` on every training kind.
+
+The north star extends the GPU-era CRDs (TFJob/PyTorchJob/MXJob) with TPU
+pod-slice provisioning, not just the TPU-native JAXJob: a slice is the
+all-or-nothing scheduling unit regardless of which framework runs on it.
+This module owns the spec type and topology math; each kind's API module
+wires it into its own defaults/validation, and `controllers/_tpu.py` turns
+it into node selectors, chip resources, gangs, and libtpu identity env.
+
+Reference anchor: the env-injection pattern the GPU-era reference applies
+per framework (tensorflow.go:97-173) — here generalized so TPU provisioning
+is one vocabulary across kinds.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from .defaulting import ValidationError
+
+# Known accelerator types -> (chips per slice, chips per host). Used to
+# default replicas (hosts = chips/chips_per_host) and gang minAvailable.
+ACCELERATOR_TOPOLOGIES: Dict[str, tuple] = {
+    "v4-8": (4, 4),
+    "v4-16": (8, 4),
+    "v4-32": (16, 4),
+    "v5e-1": (1, 1),
+    "v5e-4": (4, 4),
+    "v5e-8": (8, 8),
+    "v5e-16": (16, 4),
+    "v5e-32": (32, 4),
+    "v5e-64": (64, 4),
+    "v5e-128": (128, 4),
+    "v5e-256": (256, 4),
+    "v5p-8": (4, 4),
+    "v5p-16": (8, 4),
+    "v5p-32": (16, 4),
+    "v6e-8": (8, 8),
+    "v6e-16": (16, 4),
+    "v6e-32": (32, 4),
+    "v6e-64": (64, 4),
+    "v6e-256": (256, 4),
+}
+
+
+@dataclass
+class TPUSpec:
+    """The pod-slice request attached to a job's compute replica group."""
+
+    # e.g. "v5e-32" — see ACCELERATOR_TOPOLOGIES.
+    accelerator_type: str = ""
+    # Physical topology string, e.g. "4x8" (v5e-32) or "2x2x2" (v4-16);
+    # published to pods and used as the GKE topology node selector.
+    topology: str = ""
+    # Chips handed to each worker pod (google.com/tpu resource).
+    chips_per_host: Optional[int] = None
+    # Multi-slice provisioning for the GPU-era kinds (TFJob/PyTorchJob/
+    # MXJob): each slice is its own gang of hosts_for() pods. JAXJob keeps
+    # its top-level spec.numSlices (which also drives MEGASCALE env) and
+    # must leave this at 1.
+    num_slices: int = 1
+
+
+def hosts_for(tpu: TPUSpec) -> Optional[int]:
+    """Host (pod) count a slice requires, or None when unknown."""
+    info = ACCELERATOR_TOPOLOGIES.get(tpu.accelerator_type)
+    if info is None:
+        return None
+    chips, default_chips_per_host = info
+    per_host = tpu.chips_per_host or default_chips_per_host
+    return max(1, chips // per_host)
+
+
+def chips_for(tpu: TPUSpec) -> Optional[int]:
+    info = ACCELERATOR_TOPOLOGIES.get(tpu.accelerator_type)
+    return info[0] if info else None
+
+
+def per_host_chips(tpu: TPUSpec) -> Optional[int]:
+    """Chips each host pod should request (google.com/tpu)."""
+    if tpu.chips_per_host:
+        return tpu.chips_per_host
+    info = ACCELERATOR_TOPOLOGIES.get(tpu.accelerator_type)
+    return info[1] if info else None
+
+
+def default_host_replicas(tpu: Optional[TPUSpec], reserve: int = 0) -> Optional[int]:
+    """Default replica count for a kind's TPU host group: the topology's
+    host count × slices, minus `reserve` hosts provided by another group
+    (PyTorch's single master is host 0). None when unknowable."""
+    if tpu is None:
+        return None
+    hosts = hosts_for(tpu)
+    if hosts is None:
+        return None
+    return max(0, hosts * max(1, tpu.num_slices) - reserve)
+
+
+def validate_accelerator(tpu: TPUSpec, kind: str) -> None:
+    if tpu.accelerator_type and tpu.accelerator_type not in ACCELERATOR_TOPOLOGIES:
+        raise ValidationError(
+            f"{kind}Spec is not valid: unknown TPU accelerator type "
+            f"{tpu.accelerator_type!r}"
+        )
+    if tpu.num_slices < 1:
+        raise ValidationError(
+            f"{kind}Spec is not valid: tpu.numSlices must be >= 1, "
+            f"got {tpu.num_slices}"
+        )
+
+
+def validate_host_count(tpu: TPUSpec, kind: str, total_hosts: int) -> None:
+    """The TPU replica groups must together provide exactly the pod count
+    the slice topology implies — a partial slice is useless and an
+    oversubscribed one cannot schedule."""
+    hosts = hosts_for(tpu)
+    if hosts is None:
+        return
+    want = hosts * max(1, tpu.num_slices)
+    if total_hosts != want:
+        raise ValidationError(
+            f"{kind}Spec is not valid: {tpu.accelerator_type} × "
+            f"{max(1, tpu.num_slices)} slice(s) requires {want} TPU host "
+            f"pod(s), got {total_hosts}"
+        )

@@ -1,0 +1,270 @@
+"""Shared TPU pod-slice provisioning — used by every kind with `spec.tpu`.
+
+One implementation of: GKE node selectors + chip resources on host pods,
+slice-membership labels, libtpu identity env (TPU_WORKER_ID /
+TPU_WORKER_HOSTNAMES), per-slice all-or-nothing gangs, and the per-kind
+accelerator env (TPUStrategy-compatible for TF, PJRT/XLA for PyTorch).
+
+JAXController grew this first (controllers/jax.py); the north star extends
+the same provisioning to TFJob/PyTorchJob/MXJob (reference env-injection
+anchor: tensorflow.go:97-173), so the mechanics live here once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+from ..api import tpu as tpuapi
+from ..api.common import ReplicaSpec
+from ..bootstrap.tf_config import replica_service_host
+from ..core import constants
+
+# GKE TPU node-selector label keys.
+NODE_SELECTOR_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
+NODE_SELECTOR_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+TPU_RESOURCE = "google.com/tpu"
+
+# Marketing/GKE accelerator naming: v5e is "tpu-v5-lite-podslice".
+_GKE_ACCELERATOR_NAMES = {
+    "v4": "tpu-v4-podslice",
+    "v5e": "tpu-v5-lite-podslice",
+    "v5p": "tpu-v5p-slice",
+    "v6e": "tpu-v6e-slice",
+}
+
+
+def gke_accelerator_name(accelerator_type: str) -> str:
+    family = accelerator_type.split("-")[0]
+    return _GKE_ACCELERATOR_NAMES.get(family, family)
+
+
+def tpu_of(job) -> Optional[tpuapi.TPUSpec]:
+    return getattr(job.spec, "tpu", None)
+
+
+def host_rank(
+    replicas: Dict[str, ReplicaSpec],
+    host_types: Sequence[str],
+    rtype: str,
+    index: int,
+) -> Optional[int]:
+    """Global TPU-host ordinal of (rtype, index) across the job's host
+    replica groups in declared rank order (e.g. Master before Worker for
+    PyTorch — rank 0 is the master host); None for non-host types (PS,
+    Chief, Evaluator, Scheduler, Server — CPU pods)."""
+    if rtype not in host_types:
+        return None
+    rank = 0
+    for t in host_types:
+        if t == rtype:
+            return rank + index
+        spec = replicas.get(t)
+        rank += (spec.replicas or 0) if spec else 0
+    return None
+
+
+def host_service_names(
+    job, replicas: Dict[str, ReplicaSpec], host_types: Sequence[str]
+) -> List[str]:
+    """Headless-service DNS names of every TPU host pod, in rank order —
+    the TPU_WORKER_HOSTNAMES libtpu uses to form the ICI mesh."""
+    names = []
+    for t in host_types:
+        spec = replicas.get(t)
+        for i in range(spec.replicas or 0 if spec else 0):
+            names.append(replica_service_host(job.name, job.namespace, t.lower(), i))
+    return names
+
+
+def attach_tpu_to_template(
+    tpu: tpuapi.TPUSpec, template, slice_index: int, container_name: str
+) -> None:
+    """Node selectors, slice label, annotations, and the per-pod chip ask.
+    Values already present (user-set) are never overwritten."""
+    template.metadata.labels[constants.LABEL_SLICE_INDEX] = str(slice_index)
+    template.metadata.annotations[constants.ANNOTATION_TPU_ACCELERATOR] = (
+        tpu.accelerator_type
+    )
+    if tpu.topology:
+        template.metadata.annotations[constants.ANNOTATION_TPU_TOPOLOGY] = tpu.topology
+    if tpu.accelerator_type:
+        template.spec.node_selector.setdefault(
+            NODE_SELECTOR_ACCELERATOR, gke_accelerator_name(tpu.accelerator_type)
+        )
+    if tpu.topology:
+        template.spec.node_selector.setdefault(NODE_SELECTOR_TOPOLOGY, tpu.topology)
+    chips = tpuapi.per_host_chips(tpu)
+    if chips:
+        for container in template.spec.containers:
+            if container.name == container_name:
+                limits = container.resources.setdefault("limits", {})
+                limits.setdefault(TPU_RESOURCE, str(chips))
+                requests = container.resources.setdefault("requests", {})
+                requests.setdefault(TPU_RESOURCE, str(chips))
+
+
+def libtpu_identity_env(
+    tpu: tpuapi.TPUSpec, rank: int, hostnames: List[str], hosts_per_slice: int
+) -> Dict[str, str]:
+    """The libtpu host-identity contract, slice-local: worker id within the
+    slice plus the slice's member hostnames (libtpu forms the ICI mesh from
+    them). Shared by JAX, TF (TPUStrategy reads the same libtpu layer), and
+    torch_xla (PJRT on TPU)."""
+    per_slice = max(1, hosts_per_slice)
+    slice_index = rank // per_slice
+    env = {
+        "TPU_WORKER_ID": str(rank % per_slice),
+        "TPU_WORKER_HOSTNAMES": ",".join(
+            hostnames[slice_index * per_slice:(slice_index + 1) * per_slice]
+        ),
+    }
+    if tpu.accelerator_type:
+        env["TPU_ACCELERATOR_TYPE"] = tpu.accelerator_type
+    if tpu.topology:
+        env["TPU_TOPOLOGY"] = tpu.topology
+    return env
+
+
+def inject_tpu_env(
+    job,
+    template,
+    replicas: Dict[str, ReplicaSpec],
+    host_types: Sequence[str],
+    rtype: str,
+    index: int,
+    container_name: str,
+    extra: Optional[Dict[str, str]] = None,
+) -> None:
+    """Inject the libtpu identity (+ per-kind `extra`) into a host pod's
+    containers and attach the slice provisioning (selectors/chips); no-op
+    for CPU replica types or jobs without spec.tpu."""
+    tpu = tpu_of(job)
+    if tpu is None:
+        return
+    rank = host_rank(replicas, host_types, rtype, index)
+    if rank is None:
+        return
+    hostnames = host_service_names(job, replicas, host_types)
+    hosts = tpuapi.hosts_for(tpu) or max(
+        1, len(hostnames) // max(1, tpu.num_slices)
+    )
+    env = libtpu_identity_env(tpu, rank, hostnames, hosts)
+    if extra:
+        env.update(extra)
+    for container in template.spec.containers:
+        for name, value in env.items():
+            if container.get_env(name) is None:
+                container.set_env(name, value)
+    attach_tpu_to_template(tpu, template, rank // max(1, hosts), container_name)
+
+
+def tpu_gang_groups(
+    job,
+    replicas: Dict[str, ReplicaSpec],
+    run_policy,
+    host_types: Sequence[str],
+) -> Optional[List[dict]]:
+    """Per-slice all-or-nothing PodGroups for a job with spec.tpu — the
+    TFJob/PyTorchJob/MXJob analog of JAXController.gang_groups: minMember =
+    this slice's host count (+ the job's CPU pods, which ride with slice 0),
+    minResources includes the slice's chips (injected per-pod at creation,
+    so template aggregation alone misses them). Returns None when the job
+    has no spec.tpu (caller falls back to the generic single gang)."""
+    from ..core.job_controller import (
+        aggregate_min_resources,
+        gang_owner_ref,
+        job_selector,
+    )
+
+    tpu = tpu_of(job)
+    if tpu is None:
+        return None
+    num_slices = max(1, tpu.num_slices)
+    total_hosts = sum(
+        (replicas.get(t).replicas or 0) if replicas.get(t) else 0
+        for t in host_types
+    )
+    per_slice = tpuapi.hosts_for(tpu) or max(1, total_hosts // num_slices)
+    chips = tpuapi.per_host_chips(tpu)
+    sp = run_policy.scheduling_policy
+
+    # Host ranks are assigned by host_rank() in host_types order; slice s
+    # owns ranks [s*per_slice, (s+1)*per_slice). Per-type membership in a
+    # slice is therefore the overlap of the type's rank range with the
+    # slice's — keeping gang membership (tpu_gang_group_name) and gang
+    # accounting (minMember/minResources) consistent by construction.
+    type_ranges = {}
+    offset = 0
+    for t in host_types:
+        spec = replicas.get(t)
+        n = (spec.replicas or 0) if spec else 0
+        type_ranges[t] = (offset, offset + n)
+        offset += n
+
+    groups = []
+    for s in range(num_slices):
+        lo, hi = s * per_slice, (s + 1) * per_slice
+        slice_replicas = {}
+        for rtype, spec in replicas.items():
+            if rtype in host_types:
+                t_lo, t_hi = type_ranges[rtype]
+                n = max(0, min(hi, t_hi) - max(lo, t_lo))
+            else:
+                # CPU pods (PS, Chief, Evaluator, ...) gang with slice 0:
+                # the job cannot run without them, and a multislice job's
+                # later slices must not each re-reserve them.
+                n = (spec.replicas or 0) if s == 0 else 0
+            slice_replicas[rtype] = dataclasses.replace(spec, replicas=n)
+        min_member = sum(r.replicas or 0 for r in slice_replicas.values())
+        min_resources = (
+            dict(sp.min_resources) if sp is not None and sp.min_resources
+            else aggregate_min_resources(slice_replicas)
+        )
+        if (sp is None or not sp.min_resources) and chips:
+            slice_hosts = sum(
+                slice_replicas[t].replicas or 0
+                for t in host_types
+                if t in slice_replicas
+            )
+            if slice_hosts:
+                min_resources.setdefault(TPU_RESOURCE, str(slice_hosts * chips))
+        name = job.name if num_slices == 1 else f"{job.name}-slice-{s}"
+        groups.append({
+            "apiVersion": "scheduling.volcano.sh/v1beta1",
+            "kind": "PodGroup",
+            "metadata": {
+                "name": name,
+                "namespace": job.namespace,
+                "labels": job_selector(job),
+                "ownerReferences": [gang_owner_ref(job)],
+            },
+            "spec": {
+                "minMember": min_member,
+                "minResources": min_resources,
+                "queue": sp.queue if sp else "",
+                "priorityClassName": sp.priority_class if sp else "",
+            },
+        })
+    return groups
+
+
+def tpu_gang_group_name(job, host_types, rtype: str, index: int) -> Optional[str]:
+    """Which slice gang a pod belongs to (None = no spec.tpu, use the
+    generic job gang). CPU pods and slice-0 hosts share the base group."""
+    tpu = tpu_of(job)
+    if tpu is None:
+        return None
+    replicas = job.replica_specs()
+    num_slices = max(1, tpu.num_slices)
+    if num_slices == 1:
+        return job.name
+    rank = host_rank(replicas, host_types, rtype, index)
+    if rank is None:
+        return f"{job.name}-slice-0"
+    total_hosts = sum(
+        (replicas.get(t).replicas or 0) if replicas.get(t) else 0
+        for t in host_types
+    )
+    per_slice = tpuapi.hosts_for(tpu) or max(1, total_hosts // num_slices)
+    return f"{job.name}-slice-{min(rank // per_slice, num_slices - 1)}"

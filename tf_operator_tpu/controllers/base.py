@@ -27,7 +27,48 @@ _log = logging.getLogger(__name__)
 
 class FrameworkController(FrameworkHooks):
     """One per job kind. Subclasses set kind/container/port constants and
-    implement set_cluster_spec / update_job_status / is_master_role."""
+    implement set_cluster_spec / update_job_status / is_master_role.
+
+    Kinds whose CRD carries `spec.tpu` declare which replica types are the
+    slice's host pods via `tpu_host_types` (rank order; empty = kind has no
+    TPU extension): the gang hooks then provision per-slice all-or-nothing
+    PodGroups through controllers/_tpu.py, and set_cluster_spec can inject
+    the libtpu identity with self._inject_tpu. JAXJob keeps its own gang
+    override (top-level numSlices drives MEGASCALE semantics)."""
+
+    # Replica types that are TPU slice hosts, in rank order. () = none.
+    tpu_host_types: tuple = ()
+
+    def gang_group_name(self, job, rtype: str, index: int) -> str:
+        if self.tpu_host_types:
+            from . import _tpu
+
+            name = _tpu.tpu_gang_group_name(job, self.tpu_host_types, rtype, index)
+            if name is not None:
+                return name
+        return super().gang_group_name(job, rtype, index)
+
+    def gang_groups(self, job, replicas, run_policy):
+        if self.tpu_host_types:
+            from . import _tpu
+
+            groups = _tpu.tpu_gang_groups(job, replicas, run_policy, self.tpu_host_types)
+            if groups is not None:
+                return groups
+        return super().gang_groups(job, replicas, run_policy)
+
+    def _inject_tpu(self, job, template, replicas, rtype: str, index: int,
+                    extra=None) -> None:
+        """libtpu identity env + slice provisioning for a host pod; no-op
+        without spec.tpu or for CPU replica types."""
+        if not self.tpu_host_types:
+            return
+        from . import _tpu
+
+        _tpu.inject_tpu_env(
+            job, template, replicas, self.tpu_host_types, rtype, index,
+            self.default_container_name, extra=extra,
+        )
 
     def __init__(
         self,

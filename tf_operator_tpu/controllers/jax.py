@@ -26,23 +26,10 @@ from ..core import constants
 from . import register
 from .base import FrameworkController
 
-# GKE TPU node-selector label keys.
-NODE_SELECTOR_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
-NODE_SELECTOR_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
-TPU_RESOURCE = "google.com/tpu"
-
-# Marketing/GKE accelerator naming: v5e is "tpu-v5-lite-podslice".
-_GKE_ACCELERATOR_NAMES = {
-    "v4": "tpu-v4-podslice",
-    "v5e": "tpu-v5-lite-podslice",
-    "v5p": "tpu-v5p-slice",
-    "v6e": "tpu-v6e-slice",
-}
-
-
-def gke_accelerator_name(accelerator_type: str) -> str:
-    family = accelerator_type.split("-")[0]
-    return _GKE_ACCELERATOR_NAMES.get(family, family)
+# The slice-provisioning mechanics (GKE selectors, chip resources, naming)
+# are shared with the TPU-extended GPU-era kinds — controllers/_tpu.py.
+from ._tpu import TPU_RESOURCE, gke_accelerator_name  # noqa: F401 (re-export)
+from . import _tpu
 
 
 @register(jaxapi.KIND)
@@ -102,29 +89,9 @@ class JAXController(FrameworkController):
         if tpu is None:
             return
         per_slice = jaxdist.hosts_per_slice(job)
-        template.metadata.labels[constants.LABEL_SLICE_INDEX] = str(index // per_slice)
-        template.metadata.annotations[constants.ANNOTATION_TPU_ACCELERATOR] = (
-            tpu.accelerator_type
+        _tpu.attach_tpu_to_template(
+            tpu, template, index // per_slice, self.default_container_name
         )
-        if tpu.topology:
-            template.metadata.annotations[constants.ANNOTATION_TPU_TOPOLOGY] = tpu.topology
-        if tpu.accelerator_type:
-            template.spec.node_selector.setdefault(
-                NODE_SELECTOR_ACCELERATOR, gke_accelerator_name(tpu.accelerator_type)
-            )
-        if tpu.topology:
-            template.spec.node_selector.setdefault(NODE_SELECTOR_TOPOLOGY, tpu.topology)
-        chips = tpu.chips_per_host
-        if chips is None:
-            info = jaxapi.ACCELERATOR_TOPOLOGIES.get(tpu.accelerator_type)
-            chips = info[1] if info else None
-        if chips:
-            for container in template.spec.containers:
-                if container.name == self.default_container_name:
-                    limits = container.resources.setdefault("limits", {})
-                    limits.setdefault(TPU_RESOURCE, str(chips))
-                    requests = container.resources.setdefault("requests", {})
-                    requests.setdefault(TPU_RESOURCE, str(chips))
 
     # ---------------------------------------------------------------- gang
     def gang_group_name(self, job, rtype: str, index: int) -> str:
@@ -170,11 +137,9 @@ class JAXController(FrameworkController):
         # hook), so the template aggregation misses it — add the slice's
         # chips explicitly: hosts/slice x chips/host.
         if sp is None or not sp.min_resources:
-            tpu = job.spec.tpu
-            chips = tpu.chips_per_host if tpu else None
-            if chips is None and tpu and tpu.accelerator_type:
-                info = jaxapi.ACCELERATOR_TOPOLOGIES.get(tpu.accelerator_type)
-                chips = info[1] if info else None
+            from ..api import tpu as tpuapi
+
+            chips = tpuapi.per_host_chips(job.spec.tpu) if job.spec.tpu else None
             if chips:
                 min_resources.setdefault(TPU_RESOURCE, str(per_slice * chips))
         groups = []

@@ -23,6 +23,8 @@ class MXController(FrameworkController):
     default_container_name = mxapi.DEFAULT_CONTAINER_NAME
     default_port_name = mxapi.DEFAULT_PORT_NAME
     default_port = mxapi.DEFAULT_PORT
+    # Worker pods are the TPU slice hosts; Scheduler/Server stay CPU pods.
+    tpu_host_types = (mxapi.REPLICA_TYPE_WORKER,)
 
     def set_cluster_spec(self, job, template, rtype: str, index: int) -> None:
         env = dmlc.gen_env(job, rtype, index)
@@ -30,6 +32,7 @@ class MXController(FrameworkController):
             for name, value in env.items():
                 if container.get_env(name) is None:
                     container.set_env(name, value)
+        self._inject_tpu(job, template, job.spec.mx_replica_specs, rtype, index)
 
     def _completion_key(self, replicas: Dict[str, ReplicaSpec]) -> str:
         """Train mode completes on the Scheduler; TVM tune mode on the

@@ -28,6 +28,9 @@ class PyTorchController(FrameworkController):
     default_container_name = ptapi.DEFAULT_CONTAINER_NAME
     default_port_name = ptapi.DEFAULT_PORT_NAME
     default_port = ptapi.DEFAULT_PORT
+    # Master + Workers together are the slice's host pods (master = rank 0
+    # host — PJRT/XLA on TPU has no CPU-only coordinator role).
+    tpu_host_types = (ptapi.REPLICA_TYPE_MASTER, ptapi.REPLICA_TYPE_WORKER)
 
     def set_cluster_spec(self, job, template, rtype: str, index: int) -> None:
         env = c10d.gen_env(job, rtype, index)
@@ -35,6 +38,12 @@ class PyTorchController(FrameworkController):
             for name, value in env.items():
                 if container.get_env(name) is None:
                     container.set_env(name, value)
+        # spec.tpu: every host pod also gets the libtpu identity plus the
+        # torch_xla PJRT contract (PJRT_DEVICE=TPU) and slice provisioning.
+        self._inject_tpu(
+            job, template, job.spec.pytorch_replica_specs, rtype, index,
+            extra={"PJRT_DEVICE": "TPU"},
+        )
 
     def is_master_role(self, replicas: Dict[str, ReplicaSpec], rtype: str, index: int) -> bool:
         return rtype == ptapi.REPLICA_TYPE_MASTER

@@ -36,18 +36,23 @@ class TFController(FrameworkController):
     default_container_name = tfapi.DEFAULT_CONTAINER_NAME
     default_port_name = tfapi.DEFAULT_PORT_NAME
     default_port = tfapi.DEFAULT_PORT
+    # Worker pods are the TPU slice hosts; Chief/Master/Evaluator stay CPU
+    # coordinators (PS is rejected with spec.tpu at validation).
+    tpu_host_types = (tfapi.REPLICA_TYPE_WORKER,)
 
     # ----------------------------------------------------------- env spec
     def set_cluster_spec(self, job, template, rtype: str, index: int) -> None:
         """Inject TF_CONFIG into every container of the template
         (reference SetClusterSpec tfjob_controller.go:542-575). Single-process
-        jobs get none (isDistributed, pod.go:296-319)."""
-        if not tf_config.is_distributed(job):
-            return
-        config = tf_config.gen_tf_config(job, rtype, index)
-        for container in template.spec.containers:
-            if container.get_env("TF_CONFIG") is None:
-                container.set_env("TF_CONFIG", config)
+        jobs get none (isDistributed, pod.go:296-319). With spec.tpu, worker
+        pods additionally get the libtpu identity env (TPUStrategy reads the
+        same libtpu layer JAX does) and the slice provisioning."""
+        if tf_config.is_distributed(job):
+            config = tf_config.gen_tf_config(job, rtype, index)
+            for container in template.spec.containers:
+                if container.get_env("TF_CONFIG") is None:
+                    container.set_env("TF_CONFIG", config)
+        self._inject_tpu(job, template, job.spec.tf_replica_specs, rtype, index)
 
     # -------------------------------------------------------- master role
     def is_master_role(self, replicas: Dict[str, ReplicaSpec], rtype: str, index: int) -> bool:
