@@ -201,7 +201,7 @@ def default_dag() -> List[Step]:
     return [
         Step("build", [PY, "-m", "compileall", "-q", "tf_operator_tpu", "examples", "ci"]),
         Step("unit-api", pytest + ["tests/test_api_defaults.py", "tests/test_api_validation.py"], deps=["build"]),
-        Step("unit-controllers", pytest + ["tests/test_controller_tensorflow.py", "tests/test_controllers_frameworks.py"], deps=["build"]),
+        Step("unit-controllers", pytest + ["tests/test_controller_tensorflow.py", "tests/test_controllers_frameworks.py", "tests/test_tpu_provisioning.py"], deps=["build"]),
         Step("operator-integration", pytest + ["tests/test_cli.py", "tests/test_metrics_latency.py", "tests/test_manifests.py"], deps=["unit-controllers"]),
         Step("e2e-process", pytest + ["tests/test_e2e_process.py"], deps=["operator-integration"], retries=2),
         Step("sdk", pytest + ["tests/test_sdk.py"], deps=["unit-api"]),
@@ -230,4 +230,12 @@ def default_dag() -> List[Step]:
         # path's maiden execution (VERDICT r2 weak #7). Asserts the one
         # JSON line parses and carries the 7B config name.
         Step("bench-7b-path", [PY, "ci/check_bench_7b.py"], deps=["workload"]),
+        # Packaging (reference sdk/python/setup.py): the distribution must
+        # install and expose the console script. --no-deps/--no-build-isolation
+        # because CI runs air-gapped with every dependency preinstalled.
+        Step("package-install",
+             ["/bin/sh", "-c",
+              f"{PY} -m pip install -e . --no-deps --no-build-isolation -q"
+              " && tf-operator-tpu --help >/dev/null"],
+             deps=["build"]),
     ]

@@ -2,9 +2,13 @@
 
 Runs inside the PyTorchJob of pytorchjob_bert_pjrt_v5e16.yaml: each host
 pod gets PJRT_DEVICE=TPU + libtpu identity from the operator, so torch_xla
-brings up the slice with no torchrun and no cloud metadata. Off-TPU (smoke
-runs, CI) it falls back to plain torch.distributed gloo over the injected
-c10d env — the same model step, CPU tensors.
+brings up the slice with no torchrun and no cloud metadata. PJRT wants one
+process per chip, so on TPU the entrypoint fans out with xmp.spawn (4
+processes on a v5e host) and each process joins the xla:// rendezvous;
+the injected c10d env (RANK/WORLD_SIZE) describes hosts, the xla world
+describes chips. Off-TPU (smoke runs, CI) it falls back to a single plain
+torch.distributed gloo process over the injected c10d env — the same
+model step, CPU tensors.
 
 The GPU-era ancestor is the reference's pytorch mnist DDP example
 (examples/pytorch/mnist/mnist.py); PJRT replaces the NCCL process group
@@ -30,26 +34,17 @@ def build_model(vocab: int = 30522, hidden: int = 256, layers: int = 4):
     )
 
 
-def main() -> int:
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--per-host-batch", type=int, default=8)
-    parser.add_argument("--seq", type=int, default=128)
-    parser.add_argument("--steps", type=int, default=10)
-    args = parser.parse_args()
-
+def train(args, on_tpu: bool, batch: int) -> None:
     import torch
+    import torch.distributed as dist
 
-    on_tpu = os.environ.get("PJRT_DEVICE") == "TPU"
     if on_tpu:
         import torch_xla.core.xla_model as xm  # type: ignore
         import torch_xla.distributed.xla_backend  # noqa: F401
-        import torch.distributed as dist
 
         dist.init_process_group("xla", init_method="xla://")
         device = xm.xla_device()
     else:
-        import torch.distributed as dist
-
         dist.init_process_group("gloo", init_method="env://")
         device = torch.device("cpu")
 
@@ -58,11 +53,9 @@ def main() -> int:
     optimizer = torch.optim.AdamW(model.parameters(), lr=1e-4)
     loss_fn = torch.nn.CrossEntropyLoss()
 
-    g = torch.Generator().manual_seed(int(os.environ.get("RANK", "0")))
+    g = torch.Generator().manual_seed(dist.get_rank())
     for step in range(args.steps):
-        ids = torch.randint(
-            0, 30522, (args.per_host_batch, args.seq), generator=g
-        ).to(device)
+        ids = torch.randint(0, 30522, (batch, args.seq), generator=g).to(device)
         targets = torch.roll(ids, -1, dims=1)
         optimizer.zero_grad()
         logits = model(ids)
@@ -74,13 +67,36 @@ def main() -> int:
 
             xm.mark_step()
         if step % 5 == 0 or step == args.steps - 1:
-            print(f"step {step} loss {loss.item():.4f}", flush=True)
-
-    import torch.distributed as dist
+            print(f"rank {dist.get_rank()} step {step} loss {loss.item():.4f}",
+                  flush=True)
 
     dist.barrier()
     dist.destroy_process_group()
     print("done", flush=True)
+
+
+def _tpu_worker(index: int, args, batch: int) -> None:
+    train(args, on_tpu=True, batch=batch)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--per-host-batch", type=int, default=8)
+    parser.add_argument("--seq", type=int, default=128)
+    parser.add_argument("--steps", type=int, default=10)
+    args = parser.parse_args()
+
+    if os.environ.get("PJRT_DEVICE") == "TPU":
+        # One process per chip: a single un-spawned process would leave the
+        # xla:// rendezvous waiting on ranks that never start (world size =
+        # chips, not hosts). xmp.spawn sizes itself from the PJRT runtime.
+        import torch_xla.distributed.xla_multiprocessing as xmp  # type: ignore
+
+        chips = int(os.environ.get("TPU_CHIPS_PER_HOST", "4"))
+        batch = max(1, args.per_host_batch // chips)
+        xmp.spawn(_tpu_worker, args=(args, batch))
+    else:
+        train(args, on_tpu=False, batch=args.per_host_batch)
     return 0
 
 

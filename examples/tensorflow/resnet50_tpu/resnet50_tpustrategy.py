@@ -19,10 +19,18 @@ import argparse
 import os
 
 
+# Detect the slice from env only the OPERATOR injects: the per-pod
+# TPU_WORKER_ID (or an explicit TPU_NAME). The broader libtpu vars are
+# unreliable markers — tensorflow's import and single-host TPU runtimes
+# set TPU_WORKER_HOSTNAMES/TPU_ACCELERATOR_TYPE on any machine with a
+# libtpu, slice job or not.
+_ON_TPU = bool(os.environ.get("TPU_WORKER_ID") or os.environ.get("TPU_NAME"))
+
+
 def build_strategy():
     import tensorflow as tf
 
-    if os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get("TPU_NAME"):
+    if _ON_TPU:
         resolver = tf.distribute.cluster_resolver.TPUClusterResolver(tpu="local")
         tf.config.experimental_connect_to_cluster(resolver)
         tf.tpu.experimental.initialize_tpu_system(resolver)
@@ -30,7 +38,7 @@ def build_strategy():
     return tf.distribute.get_strategy()  # CPU/GPU fallback for smoke runs
 
 
-def synthetic_dataset(global_batch: int, steps: int, image_size: int):
+def synthetic_dataset(global_batch: int, image_size: int):
     import tensorflow as tf
 
     images = tf.random.stateless_uniform(
@@ -40,8 +48,10 @@ def synthetic_dataset(global_batch: int, steps: int, image_size: int):
         [global_batch], seed=(0, 1), maxval=1000, dtype=tf.int32
     )
     return (
+        # Unbounded repeat: steps_per_epoch bounds each epoch, so a finite
+        # repeat(steps) would starve model.fit after the first epoch.
         tf.data.Dataset.from_tensors((images, labels))
-        .repeat(steps)
+        .repeat()
         .prefetch(tf.data.AUTOTUNE)
     )
 
@@ -71,9 +81,7 @@ def main() -> int:
             loss=tf.keras.losses.SparseCategoricalCrossentropy(from_logits=False),
         )
 
-    dataset = synthetic_dataset(
-        args.global_batch, args.steps_per_epoch, args.image_size
-    )
+    dataset = synthetic_dataset(args.global_batch, args.image_size)
     history = model.fit(
         dataset, epochs=args.epochs, steps_per_epoch=args.steps_per_epoch,
         verbose=2,

@@ -301,3 +301,23 @@ def test_qps_budget_shared_across_kinds():
     )
     limiters = {id(c.cluster._limiter) for c in mgr.controllers.values()}
     assert len(limiters) == 1
+
+
+def test_packaging_console_script_resolves():
+    """pyproject.toml ships the operator as an installable distribution
+    (reference parity: sdk/python/setup.py). The console-script entry and
+    the dynamic version attr must resolve against the live package, so an
+    install can't succeed and then crash at `tf-operator-tpu` launch."""
+    import importlib
+    import pathlib
+    import tomllib
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    data = tomllib.loads((repo / "pyproject.toml").read_text())
+    mod_name, _, attr = data["project"]["scripts"]["tf-operator-tpu"].partition(":")
+    assert callable(getattr(importlib.import_module(mod_name), attr))
+    ver_attr = data["tool"]["setuptools"]["dynamic"]["version"]["attr"]
+    pkg, _, name = ver_attr.rpartition(".")
+    assert isinstance(getattr(importlib.import_module(pkg), name), str)
+    # The native dataloader source must travel with the wheel.
+    assert "*.cc" in data["tool"]["setuptools"]["package-data"]["tf_operator_tpu.native"]

@@ -211,7 +211,11 @@ class TestRealTorchDistributedGloo:
             "apiVersion": "kubeflow.org/v1",
             "kind": "PyTorchJob",
             "metadata": {"name": "gloo", "namespace": "default"},
-            "spec": {"pytorchReplicaSpecs": {
+            # cleanPodPolicy None: the default (Running) races log
+            # collection — the job completes on the master's success and
+            # can reap a worker that is still flushing its last lines.
+            "spec": {"runPolicy": {"cleanPodPolicy": "None"},
+                     "pytorchReplicaSpecs": {
                 "Master": {"replicas": 1, **replica()},
                 "Worker": {"replicas": 1, **replica()},
             }},

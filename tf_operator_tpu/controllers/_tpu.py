@@ -123,6 +123,11 @@ def libtpu_identity_env(
         env["TPU_ACCELERATOR_TYPE"] = tpu.accelerator_type
     if tpu.topology:
         env["TPU_TOPOLOGY"] = tpu.topology
+    chips = tpuapi.per_host_chips(tpu)
+    if chips:
+        # Per-chip launchers (torch_xla xmp.spawn) size their local fan-out
+        # from this rather than probing the runtime pre-fork.
+        env["TPU_CHIPS_PER_HOST"] = str(chips)
     return env
 
 
