@@ -1,0 +1,58 @@
+"""Point-to-point smoke test for the c10d contract (master <-> workers).
+
+The smallest possible proof that the operator's injected rendezvous env
+(MASTER_ADDR / MASTER_PORT / RANK / WORLD_SIZE — bootstrap/c10d.py) forms
+a working process group: rank 0 sends each worker a tensor, the worker
+squares it elementwise and sends it back, rank 0 checks the arithmetic.
+Unlike an allreduce, send/recv exercises every pairwise master<->worker
+path individually, so a single broken address mapping is attributable.
+
+Re-design of the reference's pytorch smoke-dist example
+(examples/pytorch/smoke-dist/dist_sendrecv.py): same topology and
+behavior, rebuilt on torch.distributed's modern env:// init with explicit
+verification (the original only logged the tensors).
+"""
+
+from __future__ import annotations
+
+import os
+
+import torch
+import torch.distributed as dist
+
+
+def run() -> None:
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    if rank == 0:
+        for peer in range(1, world):
+            payload = torch.full((2, 2), float(peer))
+            dist.send(tensor=payload, dst=peer)
+            result = torch.zeros(2, 2)
+            dist.recv(tensor=result, src=peer)
+            expected = payload * payload
+            assert torch.equal(result, expected), (
+                f"worker {peer} returned {result}, expected {expected}"
+            )
+            print(f"SENDRECV_OK peer={peer}", flush=True)
+    else:
+        payload = torch.zeros(2, 2)
+        dist.recv(tensor=payload, src=0)
+        dist.send(tensor=payload * payload, dst=0)
+        print("SENDRECV_OK worker", flush=True)
+
+
+def main() -> int:
+    env = {k: os.environ.get(k, "") for k in
+           ("MASTER_ADDR", "MASTER_PORT", "RANK", "WORLD_SIZE")}
+    print(f"SENDRECV_ENV {env}", flush=True)
+    dist.init_process_group("gloo", init_method="env://")
+    run()
+    dist.barrier()
+    dist.destroy_process_group()
+    print("done", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,186 @@
+"""Chief/Worker/Evaluator topology with keras — the modern re-design of
+the reference's estimator-API example (examples/tensorflow/
+distribution_strategy/estimator-API/keras_model_to_estimator.py).
+
+That example existed to demo `tf.estimator.train_and_evaluate`: workers
+train under a collective strategy while a separate `evaluator` task
+evaluates checkpoints as they appear. The estimator API is gone from
+TF >= 2.16, so the same topology is rebuilt on its modern form:
+
+- Chief + workers: MultiWorkerMirroredStrategy over the operator-injected
+  TF_CONFIG; the chief publishes per-epoch weights to --model-dir.
+- Evaluator: a TFJob `Evaluator` replica (TF_CONFIG task type
+  "evaluator", which TF excludes from the collective world). It tails the
+  model dir, evaluates each new weights file, and exits when the chief's
+  DONE marker lands — sidecar evaluation, estimator semantics without
+  estimator.
+
+Run under the operator with `tf_job_train_and_evaluate.yaml`; standalone
+it trains single-worker and skips the evaluator loop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def dataset(batch: int, seed: int = 0):
+    import numpy as np
+    import tensorflow as tf
+
+    rng = np.random.default_rng(seed)
+    x = rng.random((1024, 10), dtype=np.float32)
+    y = (x.sum(axis=1) > 5.0).astype(np.int32).reshape(-1, 1)
+    return (
+        tf.data.Dataset.from_tensor_slices((x, y))
+        .repeat()
+        .batch(batch)
+    )
+
+
+def build_model():
+    import tensorflow as tf
+
+    return tf.keras.Sequential([
+        tf.keras.layers.Dense(16, activation="relu", input_shape=(10,)),
+        tf.keras.layers.Dense(1, activation="sigmoid"),
+    ])
+
+
+def compile_model(model):
+    import tensorflow as tf
+
+    model.compile(
+        loss=tf.keras.losses.BinaryCrossentropy(),
+        optimizer=tf.keras.optimizers.SGD(0.2),
+        metrics=["accuracy"],
+    )
+
+
+def run_evaluator(args) -> int:
+    """Sidecar evaluation: evaluate every weights file the chief publishes,
+    newest-first, until the DONE marker appears."""
+    model = build_model()
+    compile_model(model)
+    data = dataset(64, seed=1)
+    seen = set()
+    evaluated = 0
+    done_marker = os.path.join(args.model_dir, "DONE")
+    deadline = time.monotonic() + args.evaluator_timeout
+    while time.monotonic() < deadline:
+        fresh = []
+        if os.path.isdir(args.model_dir):
+            fresh = sorted(
+                f for f in os.listdir(args.model_dir)
+                # Skip the chief's in-progress ".tmp-*" files: only the
+                # rename-committed names are safe to load.
+                if f.endswith(".weights.h5") and not f.startswith(".")
+                and f not in seen
+            )
+        for fname in fresh:
+            seen.add(fname)
+            try:
+                model.load_weights(os.path.join(args.model_dir, fname))
+            except Exception:
+                continue  # chief mid-write; next pass retries a newer file
+            loss, acc = model.evaluate(data, steps=8, verbose=0)
+            evaluated += 1
+            print(f"EVAL file={fname} loss={loss:.4f} acc={acc:.4f}",
+                  flush=True)
+        if os.path.exists(done_marker) and not fresh:
+            print(f"EVAL_DONE count={evaluated}", flush=True)
+            return 0
+        time.sleep(0.5)
+    print(f"EVAL_TIMEOUT count={evaluated}", flush=True)
+    return 1
+
+
+def run_trainer(args, tf_config: dict) -> int:
+    import numpy as np
+    import tensorflow as tf
+
+    strategy = tf.distribute.MultiWorkerMirroredStrategy()
+    with strategy.scope():
+        model = build_model()
+
+    task = tf_config.get("task", {})
+    cluster = tf_config.get("cluster", {})
+    is_chief = task.get("type") in (None, "chief") or (
+        task.get("type") == "worker" and task.get("index") == 0
+        and "chief" not in cluster
+    )
+    n_sync = int(strategy.num_replicas_in_sync)
+    print(f"trainer task={task} replicas_in_sync={n_sync}", flush=True)
+
+    # Custom synchronized loop: Keras 3's model.fit cannot drive
+    # MultiWorkerMirroredStrategy, so the step runs under strategy.run and
+    # the mean gradient is applied in cross-replica context (updates every
+    # mirrored copy identically).
+    loss_fn = tf.keras.losses.BinaryCrossentropy()
+    rng = np.random.default_rng(0)
+    x_np = rng.random((1024, 10), dtype=np.float32)
+    y_np = (x_np.sum(axis=1) > 5.0).astype(np.float32).reshape(-1, 1)
+    lr = 0.2
+    batch = args.per_worker_batch
+
+    @tf.function
+    def train_step(xb, yb):
+        def step_fn(xb, yb):
+            with tf.GradientTape() as tape:
+                loss = loss_fn(yb, model(xb, training=True))
+            return tape.gradient(loss, model.trainable_variables), loss
+
+        per_grads, per_loss = strategy.run(step_fn, args=(xb, yb))
+        grads = [
+            strategy.reduce(tf.distribute.ReduceOp.MEAN, g, axis=None)
+            for g in per_grads
+        ]
+        for v, g in zip(model.trainable_variables, grads):
+            v.assign_sub(lr * g)
+        return strategy.reduce(tf.distribute.ReduceOp.MEAN, per_loss, axis=None)
+
+    def publish(epoch: int) -> None:
+        """Write-then-rename so the evaluator never loads a partial file."""
+        os.makedirs(args.model_dir, exist_ok=True)
+        tmp = os.path.join(args.model_dir, f".tmp-{epoch}.weights.h5")
+        model.save_weights(tmp)
+        os.replace(tmp, os.path.join(
+            args.model_dir, f"epoch-{epoch:04d}.weights.h5"))
+
+    step = 0
+    for epoch in range(args.epochs):
+        for _ in range(args.steps_per_epoch):
+            lo = step * batch % (len(x_np) - batch)
+            loss = train_step(x_np[lo:lo + batch], y_np[lo:lo + batch])
+            step += 1
+        print(f"epoch {epoch} loss {float(loss):.4f}", flush=True)
+        if is_chief:
+            publish(epoch)
+    if is_chief:
+        with open(os.path.join(args.model_dir, "DONE"), "w") as f:
+            f.write("ok")
+    print("trainer done", flush=True)
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--steps-per-epoch", type=int, default=20)
+    parser.add_argument("--per-worker-batch", type=int, default=32)
+    parser.add_argument("--model-dir", default="/tmp/train-and-evaluate")
+    parser.add_argument("--evaluator-timeout", type=float, default=300.0)
+    args = parser.parse_args()
+
+    os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+    tf_config = json.loads(os.environ.get("TF_CONFIG", "{}"))
+    if tf_config.get("task", {}).get("type") == "evaluator":
+        return run_evaluator(args)
+    return run_trainer(args, tf_config)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

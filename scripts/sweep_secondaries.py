@@ -1,0 +1,68 @@
+"""One-off sweep for the bench's secondary configs on the live chip:
+bert-base (attention impl x batch) and moe-125m (batch), printing one
+JSON line per point. Used to pick the shipped bench defaults; keep —
+rerunnable whenever the kernels or models change.
+
+Usage: python scripts/sweep_secondaries.py [--steps 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--warmup", type=int, default=3)
+    parser.add_argument("--only", default="", help="bert|moe")
+    args = parser.parse_args()
+
+    import jax
+
+    sys.path.insert(0, ".")
+    import bench
+    from tf_operator_tpu.models import bert as bert_models
+
+    devices = jax.devices()
+    mesh = jax.sharding.Mesh(devices, ("fsdp",))
+
+    if args.only in ("", "bert"):
+        for impl in ("xla", "pallas"):
+            for batch in (8, 16, 32):
+                name = f"bert-base[{impl},bs={batch}]"
+                try:
+                    cfg = dataclasses.replace(
+                        bert_models.CONFIGS["bert-base"], attention_impl=impl
+                    )
+                    bert_models.CONFIGS[name] = cfg
+                    out = bench.bench_bert(
+                        name, batch, 512, args.steps, args.warmup, mesh, devices
+                    )
+                    print(json.dumps({"config": name, **out}), flush=True)
+                except Exception as exc:  # noqa: BLE001 — OOM etc: keep sweeping
+                    print(json.dumps({"config": name,
+                                      "error": f"{type(exc).__name__}: {exc}"[:200]}),
+                          flush=True)
+
+    if args.only in ("", "moe"):
+        for batch in (8, 16):
+            name = "moe-125m"
+            try:
+                out = bench.bench_llama(
+                    name, batch, 2048, args.steps, args.warmup, mesh, devices
+                )
+                print(json.dumps({"config": f"moe-125m[bs={batch}]", **out}),
+                      flush=True)
+            except Exception as exc:  # noqa: BLE001
+                print(json.dumps({"config": f"moe-125m[bs={batch}]",
+                                  "error": f"{type(exc).__name__}: {exc}"[:200]}),
+                      flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

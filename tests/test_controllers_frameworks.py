@@ -303,6 +303,34 @@ class TestJAXController:
         events = {e.reason for e in self.cluster.list_events()}
         assert "JAXJobRestarting" in events
 
+    def test_retryable_failure_restarts_whole_gang(self):
+        """SPMD gang restart: ONE preempted worker (exit 137) takes all
+        four down in one batched sync — survivors cannot re-admit a lone
+        restarted process into a live jax.distributed world — and the
+        restart budget counts ONE world restart, not four pod restarts."""
+        self.cluster.create_job(jax_manifest(accelerator="v5e-16"))
+        self.controller.run_until_idle()
+        for p in self.cluster.list_pods():
+            self.cluster.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+        self.controller.run_until_idle()
+        uids_before = {p.metadata.name: p.metadata.uid
+                       for p in self.cluster.list_pods()}
+        assert len(uids_before) == 4
+        self.cluster.set_pod_phase("default", "llama-worker-2", POD_FAILED,
+                                   exit_code=137)
+        self.controller.run_until_idle()
+        pods = {p.metadata.name: p.metadata.uid for p in self.cluster.list_pods()}
+        assert set(pods) == set(uids_before)
+        # Every pod was recreated, not just the failed one.
+        assert all(pods[name] != uids_before[name] for name in pods), (
+            "gang restart must replace survivors too")
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert "Failed" not in conds or conds["Failed"]["status"] != "True"
+        assert job["status"]["restartCounts"] == {"Worker": 1}
+        events = [e.reason for e in self.cluster.list_events()]
+        assert "JAXJobRestarting" in events
+
     def test_elastic_slice_resize_restarts_world(self):
         """Elastic resize (SURVEY.md §2.5 elastic row, TPU-native): scaling
         a multislice job 2 -> 1 slices deletes EVERY live pod in one batched

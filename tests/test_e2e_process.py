@@ -745,3 +745,92 @@ class TestMXTuneTopology:
         tcfg = json.loads(tuner["MX_CONFIG"])
         assert tcfg["task"] == {"type": "tuner", "index": 0}
         assert tcfg["labels"]["tunerserver"] == "1080ti"
+
+
+class TestGangFailureChaosFourProc:
+    def test_kill_one_of_four_restarts_world_and_resumes(self, tmp_path):
+        """VERDICT r3 next-round #6: 4-process JAXJob gang chaos. SIGKILL
+        ONE worker mid-training; the operator's SPMD gang restart must take
+        all four down in one batched sync (a jax.distributed world cannot
+        re-admit a lone newcomer), recreate the full world, resume from the
+        shared orbax checkpoint, run to Succeeded, and land the restart
+        MTTR in the histogram."""
+        metrics = Metrics()
+        cluster = LocalProcessCluster(child_env=CHILD_ENV)
+        manager = OperatorManager(
+            cluster,
+            OperatorOptions(enabled_schemes=["JAXJob"], health_port=0,
+                            metrics_port=0, resync_period=0.2),
+            metrics=metrics,
+        )
+        manager.start()
+        ckpt_dir = str(tmp_path / "ckpt")
+        train_cmd = [
+            sys.executable,
+            os.path.join(REPO_ROOT, "examples", "jax", "llama", "llama_train.py"),
+            "--model", "llama-tiny", "--steps", "100", "--batch", "16",
+            "--seq", "32", "--checkpoint-every", "10", "--log-every", "50",
+            "--checkpoint-dir", ckpt_dir,
+        ]
+        try:
+            cluster.create_job({
+                "apiVersion": "kubeflow.org/v1",
+                "kind": "JAXJob",
+                "metadata": {"name": "chaos4", "namespace": "default"},
+                "spec": {"jaxReplicaSpecs": {"Worker": {
+                    "replicas": 4,
+                    "template": {"spec": {"containers": [
+                        {"name": "jax", "image": "local", "command": train_cmd}
+                    ]}},
+                }}},
+            })
+            names = [f"chaos4-worker-{i}" for i in range(4)]
+
+            def committed_checkpoint():
+                if not os.path.isdir(ckpt_dir):
+                    return False
+                return any(e.name.isdigit() for e in os.scandir(ckpt_dir))
+
+            assert wait_for(committed_checkpoint, timeout=180), (
+                "no committed checkpoint before the kill")
+            starts_before = {
+                n: cluster.get_pod("default", n).status.start_time for n in names
+            }
+            kill_t0 = time.monotonic()
+            cluster.kill_pod("default", "chaos4-worker-2")
+
+            def world_recreated():
+                try:
+                    pods = {n: cluster.get_pod("default", n) for n in names}
+                except KeyError:
+                    return False
+                return all(
+                    p.status.start_time is not None
+                    and p.status.start_time > starts_before[n]
+                    for n, p in pods.items()
+                )
+
+            assert wait_for(world_recreated, timeout=90), (
+                "gang restart did not recreate all four workers")
+            mttr = time.monotonic() - kill_t0
+            print(f"[chaos4] world recreated {mttr:.2f}s after SIGKILL",
+                  flush=True)
+
+            assert wait_for(
+                lambda: job_condition(cluster, "JAXJob", "chaos4", "Succeeded"),
+                timeout=420,
+            ), cluster.get_pod_log("default", "chaos4-worker-0")
+            # Every process of the new world resumed from the checkpoint.
+            for n in names:
+                log = cluster.get_pod_log("default", n)
+                assert "resumed from step" in log, f"{n}: {log[-2000:]}"
+            assert not job_condition(cluster, "JAXJob", "chaos4", "Failed")
+            job = cluster.get_job("JAXJob", "default", "chaos4")
+            assert job["status"]["restartCounts"] == {"Worker": 1}, (
+                "one world restart, not one per pod")
+            hist = metrics._histograms["training_operator_job_restart_seconds"][
+                ("default", "JAXJob")]
+            assert hist.count >= 1, "restart MTTR missing from the histogram"
+        finally:
+            manager.stop()
+            cluster.shutdown()

@@ -23,6 +23,12 @@ class Conflict(Exception):
     """Stale resourceVersion on a full-object write (k8s 409 analog)."""
 
 
+class Gone(RuntimeError):
+    """Requested history no longer available (k8s 410 analog): an expired
+    list continue token or a watch resourceVersion older than the server's
+    watch cache. Recoverable by restarting the list/watch from scratch."""
+
+
 # Watch event types
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"

@@ -50,6 +50,7 @@ from .base import (
     SYNC,
     Cluster,
     Conflict,
+    Gone,
     NotFound,
     matches_claim_view,
 )
@@ -147,6 +148,7 @@ class KubeCluster(Cluster):
         token_file: Optional[str] = None,
         client_cert_file: Optional[str] = None,
         client_key_file: Optional[str] = None,
+        list_limit: int = 500,
     ):
         if base_url is None:
             host = os.environ.get("KUBERNETES_SERVICE_HOST")
@@ -199,6 +201,9 @@ class KubeCluster(Cluster):
                 self._ssl.load_cert_chain(client_cert_file, client_key_file)
         else:
             self._ssl = None
+        # Informer relists paginate with this page size (client-go reflector
+        # default 500); 0 = single-shot unchunked lists.
+        self._list_limit = list_limit
         self._stop = threading.Event()
         self._local = threading.local()  # per-thread keep-alive connection
         # ---- informer state: one watch loop per kind, N handlers ----
@@ -292,6 +297,9 @@ class KubeCluster(Cluster):
                 raise NotFound(f"{method} {path}: 404")
             if resp.status == 409:
                 raise Conflict(f"{method} {path}: 409 {data[:200]!r}")
+            if resp.status == 410:
+                # Expired list continue token (or rv): restartable.
+                raise Gone(f"{method} {path}: 410 {data[:200]!r}")
             if resp.status >= 400:
                 raise RuntimeError(f"{method} {path}: {resp.status} {data[:300]!r}")
             return json.loads(data) if data else {}
@@ -880,14 +888,12 @@ class KubeCluster(Cluster):
         """List, diff against the store, emit ADDED/MODIFIED/SYNC/DELETED
         deltas, replace the store. Returns the collection resourceVersion to
         stream from."""
-        query = {"labelSelector": selector} if selector else {}
-        full = f"{path}?{urllib.parse.urlencode(query)}" if query else path
-        listing = self._request("GET", full)
-        rv = listing.get("metadata", {}).get("resourceVersion", "")
+        base_query = {"labelSelector": selector} if selector else {}
+        items, rv = self._list_paginated(path, base_query)
         # Conversion happens outside the lock: a large relist must not stall
         # every cached read and event emission across the operator.
         fresh: Dict[Tuple[str, str], Tuple[str, object]] = {}
-        for item in listing.get("items", []):
+        for item in items:
             obj = convert(item)
             ns, name, obj_rv = _meta_of(obj)
             fresh[(ns, name)] = (obj_rv, obj)
@@ -910,6 +916,36 @@ class KubeCluster(Cluster):
         for event_type, obj in events:
             self._emit(kind, event_type, obj)
         return rv
+
+    def _list_paginated(self, path: str, base_query: dict):
+        """Chunked LIST: request `limit`-sized pages and follow `continue`
+        tokens (client-go reflector semantics). A 410 Gone mid-pagination
+        means the server compacted the snapshot the token referenced —
+        restart the list from scratch (bounded), exactly what a reflector
+        does. Returns (items, collection resourceVersion)."""
+        for attempt in range(4):
+            items: List[dict] = []
+            cont: Optional[str] = None
+            try:
+                while True:
+                    query = dict(base_query)
+                    if self._list_limit:
+                        query["limit"] = str(self._list_limit)
+                    if cont:
+                        query["continue"] = cont
+                    full = (f"{path}?{urllib.parse.urlencode(query)}"
+                            if query else path)
+                    listing = self._request("GET", full)
+                    items.extend(listing.get("items", []))
+                    meta = listing.get("metadata", {})
+                    cont = meta.get("continue")
+                    if not cont:
+                        return items, meta.get("resourceVersion", "")
+            except Gone:
+                if attempt == 3:
+                    raise
+                _log.debug("list %s: continue token expired, restarting", path)
+                continue
 
     def _watch_loop(self, kind: str) -> None:
         path, selector, convert = self._watch_paths(kind)

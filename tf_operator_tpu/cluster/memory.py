@@ -41,12 +41,26 @@ from .base import ADDED, DELETED, MODIFIED, Conflict, NotFound
 _log = logging.getLogger(__name__)
 
 
+class _RVCounter:
+    """Drop-in for itertools.count(1) that also remembers the last value
+    issued, so list responses can carry a true collection resourceVersion
+    (a real apiserver's list rv is the storage's current revision, not 0)."""
+
+    def __init__(self):
+        self._it = itertools.count(1)
+        self.latest = 0
+
+    def __next__(self) -> int:
+        self.latest = next(self._it)
+        return self.latest
+
+
 class InMemoryCluster(base.Cluster):
     def __init__(self, clock=time.time):
         self._lock = threading.RLock()
         self._clock = clock
         self._uid = itertools.count(1)
-        self._rv = itertools.count(1)
+        self._rv = _RVCounter()
         self._jobs: Dict[Tuple[str, str, str], dict] = {}
         self._pods: Dict[Tuple[str, str], Pod] = {}
         self._services: Dict[Tuple[str, str], Service] = {}
@@ -60,6 +74,10 @@ class InMemoryCluster(base.Cluster):
         self._pod_logs: Dict[Tuple[str, str], str] = {}
 
     # ------------------------------------------------------------------ util
+    def latest_rv(self) -> int:
+        """Current storage revision: the last resourceVersion issued."""
+        return self._rv.latest
+
     def _emit(self, kind: str, event_type: str, obj) -> None:
         """Deliver to subscribers in CAUSAL order even when a handler writes
         back: a handler that mutates state mid-dispatch (e.g. a kubelet sim
@@ -172,6 +190,10 @@ class InMemoryCluster(base.Cluster):
             job = self._jobs.pop((kind, namespace, name), None)
             if job is None:
                 raise NotFound(f"{kind} {namespace}/{name}")
+            # Deletion is a write: the DELETED event carries a fresh
+            # resourceVersion (real apiservers bump the revision), so a
+            # watch resuming from the object's last rv still sees it.
+            job["metadata"]["resourceVersion"] = str(next(self._rv))
         self._emit(kind, DELETED, job)
 
     # ------------------------------------------------------------------ pods
@@ -246,6 +268,7 @@ class InMemoryCluster(base.Cluster):
             self._pod_logs.pop((namespace, name), None)
             if pod is None:
                 raise NotFound(f"pod {namespace}/{name}")
+            pod.metadata.resource_version = str(next(self._rv))
         self._emit("pods", DELETED, pod)
 
     # -------------------------------------------------------------- services
@@ -296,6 +319,7 @@ class InMemoryCluster(base.Cluster):
             svc = self._services.pop((namespace, name), None)
             if svc is None:
                 raise NotFound(f"service {namespace}/{name}")
+            svc.metadata.resource_version = str(next(self._rv))
         self._emit("services", DELETED, svc)
 
     # ------------------------------------------------------------ pod groups

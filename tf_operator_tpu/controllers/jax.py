@@ -53,6 +53,17 @@ class JAXController(FrameworkController):
         )
         self._attach_tpu_resources(job, template, index)
 
+    def restart_peers_on_failure(self, rtype: str) -> bool:
+        """SPMD gang restart (GKE multislice / JobSet semantics): a
+        jax.distributed world cannot re-admit a single restarted process —
+        the coordinator's membership is fixed at initialize() — so a
+        retryable worker failure restarts every worker in one batched sync
+        and the world re-rendezvouses from the shared checkpoint. The
+        GPU-era reference restarts only the failed replica
+        (tfjob_controller.go:717-736), which is right for PS worlds and
+        wrong for SPMD ones."""
+        return rtype == jaxapi.REPLICA_TYPE_WORKER
+
     def stale_world_pods(self, job, replicas, pods) -> List:
         """Elastic resize: any pod stamped with a different world generation
         must be recreated — SPMD membership is global, so the whole job

@@ -262,6 +262,16 @@ class FrameworkHooks:
         framework opts in."""
         return []
 
+    def restart_peers_on_failure(self, rtype: str) -> bool:
+        """True if a retryable failure of ONE replica must restart the
+        job's pods as a whole gang. SPMD worlds need this: a lost process
+        invalidates every peer's collectives, and a partially-restarted
+        gang leaves survivors wedged on a coordinator that will never
+        re-admit the newcomer. Default keeps the reference's GPU-era
+        per-replica restart (tfjob_controller.go:717-736), which is right
+        for PS/allreduce frameworks that re-admit members."""
+        return False
+
     def gang_groups(self, job: JobObject, replicas: Dict[str, ReplicaSpec], run_policy) -> List[dict]:
         """PodGroup specs to ensure when gang scheduling is on."""
         total = sum(spec.replicas or 0 for spec in replicas.values())
@@ -604,6 +614,46 @@ class JobController:
             self._write_status_if_changed(job, old_status)
             return
 
+        # Gang restart on retryable failure (SPMD worlds, restart_peers_on_
+        # failure hook): one lost process takes the whole gang down in a
+        # single batched sync — survivors included — so every process
+        # re-runs the rendezvous and resumes from the shared checkpoint.
+        gang_failure = self._find_gang_retryable_failure(replicas, pods)
+        if gang_failure is not None:
+            rtype, failed_pod = gang_failure
+            for pod in pods:
+                if pod.status.phase != POD_SUCCEEDED:
+                    self._delete_pod(job, pod)
+            msg = (
+                f"{self.hooks.kind} {job.name} is restarting the whole gang: "
+                f"{rtype} replica {failed_pod.metadata.name} failed retryably "
+                "and the SPMD world restarts as one unit."
+            )
+            self.cluster.record_event(
+                Event(
+                    type="Warning",
+                    reason=constants.job_reason(self.hooks.kind, constants.REASON_RESTARTING),
+                    message=msg,
+                    involved_object=f"{job.kind}/{key}",
+                )
+            )
+            capi.update_job_conditions(
+                job.status,
+                capi.JOB_RESTARTING,
+                constants.job_reason(self.hooks.kind, constants.REASON_RESTARTING),
+                msg,
+                now=self.clock(),
+            )
+            job.status._restarting_this_sync = True
+            # ONE restart per gang restart: backoffLimit counts world
+            # restarts, not the gang-size multiple of them.
+            job.status.restart_counts[rtype] = (
+                job.status.restart_counts.get(rtype, 0) + 1
+            )
+            self.on_job_restarting(job, rtype)
+            self._write_status_if_changed(job, old_status)
+            return
+
         services = self.get_services_for_job(job)
         for rtype in self.hooks.replica_order(replicas):
             spec = replicas[rtype]
@@ -623,6 +673,27 @@ class JobController:
                 self.requeue(f"{job.kind}:{key}", remaining)
 
         self._write_status_if_changed(job, old_status)
+
+    def _find_gang_retryable_failure(
+        self, replicas: Dict[str, ReplicaSpec], pods: List[Pod]
+    ):
+        """(rtype, pod) of the first retryably-failed replica whose type
+        opted into gang restart (restart_peers_on_failure), else None.
+        Non-retryable failures fall through to the normal status machine."""
+        for rtype, spec in replicas.items():
+            if spec.restart_policy != capi.RESTART_POLICY_EXIT_CODE:
+                continue
+            if not self.hooks.restart_peers_on_failure(rtype):
+                continue
+            for pod in filter_pods_for_replica_type(pods, rtype):
+                if pod.status.phase != POD_FAILED:
+                    continue
+                exit_code = get_container_exit_code(
+                    pod, self.hooks.default_container_name
+                )
+                if capi.is_retryable_exit_code(exit_code):
+                    return rtype, pod
+        return None
 
     # -------------------------------------------------------------- pods
     def reconcile_pods(
