@@ -1,0 +1,83 @@
+"""Single-worker MNIST with TensorBoard summaries on a shared volume.
+
+Re-design of the reference's mnist_with_summaries (examples/tensorflow/
+mnist_with_summaries/mnist_with_summaries.py): the TF1 original existed
+to exercise every TensorBoard dashboard from a TFJob whose event files
+land on a PV. The modern form keeps that: a keras model trained with a
+custom loop that writes scalar (loss/accuracy), histogram (weights), and
+image (input digits) summaries via tf.summary to --log-dir, which the
+manifest mounts from a PVC so TensorBoard can serve it after the job.
+
+--synthetic-data skips the MNIST download for hermetic clusters/CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def load_data(synthetic: bool):
+    import numpy as np
+
+    if synthetic:
+        rng = np.random.default_rng(0)
+        x = rng.random((2048, 28, 28), dtype=np.float32)
+        y = rng.integers(0, 10, size=(2048,)).astype(np.int64)
+        return x, y
+    import tensorflow as tf
+
+    (x, y), _ = tf.keras.datasets.mnist.load_data()
+    return (x / 255.0).astype("float32"), y.astype("int64")
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=200)
+    parser.add_argument("--batch", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--log-dir", default="/train/logs")
+    parser.add_argument("--summary-every", type=int, default=10)
+    parser.add_argument("--synthetic-data", action="store_true")
+    args = parser.parse_args()
+
+    os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+    import tensorflow as tf
+
+    x, y = load_data(args.synthetic_data)
+    model = tf.keras.Sequential([
+        tf.keras.layers.Input((28, 28)),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(128, activation="relu"),
+        tf.keras.layers.Dense(10),
+    ])
+    loss_fn = tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True)
+    optimizer = tf.keras.optimizers.Adam(args.lr)
+    writer = tf.summary.create_file_writer(args.log_dir)
+
+    for step in range(args.steps):
+        lo = step * args.batch % (len(x) - args.batch)
+        xb, yb = x[lo:lo + args.batch], y[lo:lo + args.batch]
+        with tf.GradientTape() as tape:
+            logits = model(xb, training=True)
+            loss = loss_fn(yb, logits)
+        grads = tape.gradient(loss, model.trainable_variables)
+        optimizer.apply_gradients(zip(grads, model.trainable_variables))
+        if step % args.summary_every == 0 or step == args.steps - 1:
+            acc = float(tf.reduce_mean(tf.cast(
+                tf.argmax(logits, axis=-1) == yb, tf.float32)))
+            with writer.as_default(step=step):
+                tf.summary.scalar("loss", loss)
+                tf.summary.scalar("accuracy", acc)
+                for v in model.trainable_variables:
+                    tf.summary.histogram(v.name, v)
+                tf.summary.image("input", xb[:3][..., None], max_outputs=3)
+            print(f"step {step} loss {float(loss):.4f} acc {acc:.3f}",
+                  flush=True)
+    writer.flush()
+    print(f"SUMMARIES_WRITTEN {args.log_dir}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

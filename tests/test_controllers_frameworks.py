@@ -331,6 +331,32 @@ class TestJAXController:
         events = [e.reason for e in self.cluster.list_events()]
         assert "JAXJobRestarting" in events
 
+    def test_gang_restart_recreates_succeeded_coordinator(self):
+        """Recreate-ALL semantics: worker-0 (the jax.distributed
+        coordinator) exits 0 in the same window a peer is preempted; the
+        gang restart must replace the Succeeded coordinator too, or the
+        new world waits forever on a process that already exited."""
+        self.cluster.create_job(jax_manifest(accelerator="v5e-16"))
+        self.controller.run_until_idle()
+        for p in self.cluster.list_pods():
+            self.cluster.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+        self.controller.run_until_idle()
+        uids_before = {p.metadata.name: p.metadata.uid
+                       for p in self.cluster.list_pods()}
+        self.cluster.set_pod_phase("default", "llama-worker-0", POD_SUCCEEDED,
+                                   exit_code=0)
+        self.cluster.set_pod_phase("default", "llama-worker-2", POD_FAILED,
+                                   exit_code=137)
+        self.controller.run_until_idle()
+        pods = {p.metadata.name: p.metadata.uid for p in self.cluster.list_pods()}
+        assert set(pods) == set(uids_before)
+        assert all(pods[n] != uids_before[n] for n in pods), (
+            "the Succeeded coordinator must be recreated with the gang")
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds.get("Succeeded", {}).get("status") != "True"
+        assert conds.get("Failed", {}).get("status") != "True"
+
     def test_elastic_slice_resize_restarts_world(self):
         """Elastic resize (SURVEY.md §2.5 elastic row, TPU-native): scaling
         a multislice job 2 -> 1 slices deletes EVERY live pod in one batched

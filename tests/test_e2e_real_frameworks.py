@@ -328,3 +328,41 @@ class TestRealTrainAndEvaluate:
         assert int(done[0].split("count=")[1]) >= 2  # one eval per epoch
         chief_log = harness.get_pod_log("default", "tae-chief-0")
         assert "replicas_in_sync=2" in chief_log, chief_log[-2000:]
+
+
+class TestRealTFSmoke:
+    def test_chief_places_ops_on_every_task(self, harness):
+        """The tf_smoke re-design under real TensorFlow: the chief connects
+        to the whole cluster and runs a matmul pinned to EACH task's device
+        (chief/worker/ps), verifying every address in the injected
+        TF_CONFIG actually computes — placement breadth a collective ring
+        can't attribute. One replica per type because each type declares
+        its own port and tf.distribute.Server binds it on all interfaces
+        (same one-machine constraint as the MWMS test)."""
+        cmd = [sys.executable, os.path.join(
+            REPO_ROOT, "examples", "tensorflow", "tf_smoke", "tf_smoke.py")]
+
+        def replica(port=None):
+            c = {"name": "tensorflow", "image": "local", "command": cmd}
+            if port:
+                c["ports"] = [{"name": "tfjob-port", "containerPort": port}]
+            return {"replicas": 1, "template": {"spec": {"containers": [c]}}}
+
+        harness.create_job({
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "TFJob",
+            "metadata": {"name": "smoke", "namespace": "default"},
+            "spec": {"runPolicy": {"cleanPodPolicy": "Running"},
+                     "tfReplicaSpecs": {"Chief": replica(),
+                                        "Worker": replica(2223),
+                                        "PS": replica(2224)}},
+        })
+        assert wait_for(
+            lambda: job_condition(harness, "TFJob", "smoke", "Succeeded"),
+            timeout=240,
+        ), TestRealMultiWorkerMirroredStrategy._logs(harness, "smoke")
+        chief_log = harness.get_pod_log("default", "smoke-chief-0")
+        for device in ("/job:chief/task:0", "/job:worker/task:0",
+                       "/job:ps/task:0"):
+            assert f"SMOKE_OK {device}" in chief_log, chief_log[-2000:]
+        assert "SMOKE_DONE tasks=3" in chief_log

@@ -68,7 +68,11 @@ class InMemoryCluster(base.Cluster):
         self._leases: Dict[Tuple[str, str], dict] = {}
         self._events: List[Event] = []
         self._watchers: Dict[str, List[base.WatchHandler]] = {}
-        self._emit_local = threading.local()
+        # Ordered publish log (see _publish_locked/_drain_events).
+        self._emit_lock = threading.Lock()
+        self._pending_events: List[tuple] = []
+        self._draining = False
+        self._delivered_rv = 0
         # pod name -> behavior fn(pod) called on each step() while running
         self._behaviors: Dict[Tuple[str, str], Callable[[Pod], None]] = {}
         self._pod_logs: Dict[Tuple[str, str], str] = {}
@@ -78,30 +82,68 @@ class InMemoryCluster(base.Cluster):
         """Current storage revision: the last resourceVersion issued."""
         return self._rv.latest
 
-    def _emit(self, kind: str, event_type: str, obj) -> None:
-        """Deliver to subscribers in CAUSAL order even when a handler writes
-        back: a handler that mutates state mid-dispatch (e.g. a kubelet sim
-        marking a new pod Running) triggers a nested emit, and delivering
-        that nested event inline would hand later subscribers the MODIFIED
-        before the ADDED that caused it — regressing their view of the
-        object. Nested emits queue behind the in-flight event; the
-        outermost call drains in order. Handler errors log-and-continue
-        (one bad subscriber must not corrupt the stream for the rest)."""
-        queue = self._emit_local.__dict__.setdefault("queue", [])
-        queue.append((kind, event_type, obj))
-        if self._emit_local.__dict__.get("active"):
-            return
-        self._emit_local.active = True
+    def delivered_rv(self) -> int:
+        """Highest rv whose event has been dispatched to EVERY subscriber.
+        The safe watermark for watch bookmarks: a client resuming from this
+        rv cannot have an undelivered event hiding at-or-below it (the
+        publish log is rv-ordered, and the drainer advances this only
+        after an event's full dispatch)."""
+        with self._emit_lock:
+            return self._delivered_rv
+
+    @staticmethod
+    def _event_rv(obj) -> int:
+        raw = ((obj.get("metadata") or {}).get("resourceVersion")
+               if isinstance(obj, dict)
+               else obj.metadata.resource_version) or "0"
         try:
-            while queue:
-                k, e, o = queue.pop(0)
+            return int(raw)
+        except ValueError:
+            return 0
+
+    def _publish_locked(self, kind: str, event_type: str, obj) -> None:
+        """Append an event to the ordered publish log. MUST be called while
+        holding self._lock, in the SAME critical section that assigned the
+        object's resourceVersion: publication order equals rv order only
+        because assignment and publication share one lock. (Publishing
+        after releasing the lock let two writer threads interleave —
+        commit rv N, commit+publish rv N+1, publish rv N — and an
+        rv-reordered stream breaks every consumer that treats a delivered
+        rv as a resume watermark: watch-cache bookmarks, replay floors.)"""
+        with self._emit_lock:
+            self._pending_events.append((kind, event_type, obj))
+
+    def _drain_events(self) -> None:
+        """Dispatch the publish log to subscribers, in order, with NO locks
+        held around handler calls. One active drainer at a time: a write
+        landing mid-drain (another thread, or a handler writing back — the
+        kubelet sim marking a new pod Running) appends behind the in-flight
+        event and the active drainer delivers it, preserving causal AND rv
+        order for every subscriber. Handler errors log-and-continue (one
+        bad subscriber must not corrupt the stream for the rest)."""
+        with self._emit_lock:
+            if self._draining:
+                return  # the active drainer will deliver what we queued
+            self._draining = True
+        try:
+            while True:
+                with self._emit_lock:
+                    if not self._pending_events:
+                        self._draining = False
+                        return
+                    k, e, o = self._pending_events.pop(0)
                 for handler in self._watchers.get(k, []):
                     try:
                         handler(e, copy.deepcopy(o))
                     except Exception:  # noqa: BLE001
                         _log.exception("watch handler for %s failed", k)
-        finally:
-            self._emit_local.active = False
+                rv = self._event_rv(o)
+                with self._emit_lock:
+                    self._delivered_rv = max(self._delivered_rv, rv)
+        except BaseException:
+            with self._emit_lock:
+                self._draining = False
+            raise
 
     def watch(self, kind: str, handler: base.WatchHandler) -> None:
         with self._lock:
@@ -122,7 +164,8 @@ class InMemoryCluster(base.Cluster):
             meta["creationTimestamp"] = self._clock()
             self._jobs[(kind, ns, name)] = job_dict
             out = copy.deepcopy(job_dict)
-        self._emit(kind, ADDED, out)
+            self._publish_locked(kind, ADDED, copy.deepcopy(job_dict))
+        self._drain_events()
         return out
 
     def get_job(self, kind: str, namespace: str, name: str) -> dict:
@@ -171,7 +214,8 @@ class InMemoryCluster(base.Cluster):
             stored["metadata"]["resourceVersion"] = str(next(self._rv))
             self._jobs[(kind, ns, name)] = stored
             out = copy.deepcopy(stored)
-        self._emit(kind, MODIFIED, out)
+            self._publish_locked(kind, MODIFIED, copy.deepcopy(stored))
+        self._drain_events()
         return out
 
     def update_job_status(self, kind: str, namespace: str, name: str, status: dict) -> dict:
@@ -182,7 +226,8 @@ class InMemoryCluster(base.Cluster):
             job["status"] = copy.deepcopy(status)
             job["metadata"]["resourceVersion"] = str(next(self._rv))
             out = copy.deepcopy(job)
-        self._emit(kind, MODIFIED, out)
+            self._publish_locked(kind, MODIFIED, copy.deepcopy(job))
+        self._drain_events()
         return out
 
     def delete_job(self, kind: str, namespace: str, name: str) -> None:
@@ -194,7 +239,8 @@ class InMemoryCluster(base.Cluster):
             # resourceVersion (real apiservers bump the revision), so a
             # watch resuming from the object's last rv still sees it.
             job["metadata"]["resourceVersion"] = str(next(self._rv))
-        self._emit(kind, DELETED, job)
+            self._publish_locked(kind, DELETED, job)
+        self._drain_events()
 
     # ------------------------------------------------------------------ pods
     def create_pod(self, pod: Pod) -> Pod:
@@ -209,7 +255,8 @@ class InMemoryCluster(base.Cluster):
             pod.status.phase = POD_PENDING
             self._pods[key] = pod
             out = pod.deep_copy()
-        self._emit("pods", ADDED, out)
+            self._publish_locked("pods", ADDED, pod.deep_copy())
+        self._drain_events()
         return out
 
     def get_pod(self, namespace: str, name: str) -> Pod:
@@ -243,7 +290,8 @@ class InMemoryCluster(base.Cluster):
             pod.metadata.resource_version = str(next(self._rv))
             self._pods[key] = pod
             out = pod.deep_copy()
-        self._emit("pods", MODIFIED, out)
+            self._publish_locked("pods", MODIFIED, pod.deep_copy())
+        self._drain_events()
         return out
 
     def append_pod_log(self, namespace: str, name: str, text: str) -> None:
@@ -269,7 +317,8 @@ class InMemoryCluster(base.Cluster):
             if pod is None:
                 raise NotFound(f"pod {namespace}/{name}")
             pod.metadata.resource_version = str(next(self._rv))
-        self._emit("pods", DELETED, pod)
+            self._publish_locked("pods", DELETED, pod)
+        self._drain_events()
 
     # -------------------------------------------------------------- services
     def create_service(self, service: Service) -> Service:
@@ -282,7 +331,8 @@ class InMemoryCluster(base.Cluster):
             service.metadata.resource_version = str(next(self._rv))
             self._services[key] = service
             out = service.deep_copy()
-        self._emit("services", ADDED, out)
+            self._publish_locked("services", ADDED, service.deep_copy())
+        self._drain_events()
         return out
 
     def get_service(self, namespace: str, name: str) -> Service:
@@ -311,7 +361,8 @@ class InMemoryCluster(base.Cluster):
             service.metadata.resource_version = str(next(self._rv))
             self._services[key] = service
             out = service.deep_copy()
-        self._emit("services", MODIFIED, out)
+            self._publish_locked("services", MODIFIED, service.deep_copy())
+        self._drain_events()
         return out
 
     def delete_service(self, namespace: str, name: str) -> None:
@@ -320,7 +371,8 @@ class InMemoryCluster(base.Cluster):
             if svc is None:
                 raise NotFound(f"service {namespace}/{name}")
             svc.metadata.resource_version = str(next(self._rv))
-        self._emit("services", DELETED, svc)
+            self._publish_locked("services", DELETED, svc)
+        self._drain_events()
 
     # ------------------------------------------------------------ pod groups
     def create_pod_group(self, group: dict) -> dict:
@@ -372,7 +424,10 @@ class InMemoryCluster(base.Cluster):
                 raise Conflict(f"lease {key} already exists")
             meta["resourceVersion"] = str(next(self._rv))
             self._leases[key] = lease
-            return copy.deepcopy(lease)
+            out = copy.deepcopy(lease)
+            self._publish_locked("leases", ADDED, copy.deepcopy(lease))
+        self._drain_events()
+        return out
 
     def update_lease(self, lease: dict) -> dict:
         meta = lease.get("metadata", {})
@@ -390,7 +445,10 @@ class InMemoryCluster(base.Cluster):
             stored = copy.deepcopy(lease)
             stored["metadata"]["resourceVersion"] = str(next(self._rv))
             self._leases[key] = stored
-            return copy.deepcopy(stored)
+            out = copy.deepcopy(stored)
+            self._publish_locked("leases", MODIFIED, copy.deepcopy(stored))
+        self._drain_events()
+        return out
 
     # ---------------------------------------------------------------- events
     def record_event(self, event: Event) -> None:
@@ -451,9 +509,8 @@ class InMemoryCluster(base.Cluster):
                     if behavior is not None:
                         behavior(pod)
                         pod.metadata.resource_version = str(next(self._rv))
-                        updates.append(pod.deep_copy())
-        for pod in updates:
-            self._emit("pods", MODIFIED, pod)
+                        self._publish_locked("pods", MODIFIED, pod.deep_copy())
+        self._drain_events()
 
     # ------------------------------------------------- test-seeding helpers
     def set_pod_phase(
@@ -489,7 +546,8 @@ class InMemoryCluster(base.Cluster):
                 ]
             pod.metadata.resource_version = str(next(self._rv))
             out = pod.deep_copy()
-        self._emit("pods", MODIFIED, out)
+            self._publish_locked("pods", MODIFIED, pod.deep_copy())
+        self._drain_events()
 
 
 def terminate_after(steps: int, exit_code: int = 0):

@@ -220,7 +220,6 @@ class LocalProcessCluster(InMemoryCluster):
                 self._launching.add(key)
                 plans.append((key, cmd, env, container.working_dir or None, log_path))
 
-        started: List[Pod] = []
         for key, cmd, env, cwd, log_path in plans:
             fh = open(log_path, "ab")
             proc = None
@@ -248,7 +247,6 @@ class LocalProcessCluster(InMemoryCluster):
                 if error is not None:
                     fh.close()
                     self._mark_start_error_locked(pod, error)
-                    started.append(pod.deep_copy())
                     continue
                 self._procs[key] = proc
                 self._log_fhs[key] = fh
@@ -256,15 +254,15 @@ class LocalProcessCluster(InMemoryCluster):
                 pod.status.phase = POD_RUNNING
                 pod.status.start_time = self._clock()
                 pod.metadata.resource_version = str(next(self._rv))
-                started.append(pod.deep_copy())
-        for pod in started:
-            self._emit("pods", "MODIFIED", pod)
+                self._publish_locked("pods", "MODIFIED", pod.deep_copy())
+        self._drain_events()
 
     def _mark_start_error_locked(self, pod: Pod, message: str) -> None:
         pod.status.phase = POD_FAILED
         pod.status.reason = "StartError"
         pod.status.message = message
         pod.metadata.resource_version = str(next(self._rv))
+        self._publish_locked("pods", "MODIFIED", pod.deep_copy())
 
     # --------------------------------------------------------------- reaper
     def _reap_loop(self) -> None:
@@ -278,7 +276,6 @@ class LocalProcessCluster(InMemoryCluster):
                 _log.exception("process-cluster reaper pass failed")
 
     def _reap_once(self) -> None:
-        finished: List[Pod] = []
         with self._lock:
             for key, proc in list(self._procs.items()):
                 code = proc.poll()
@@ -307,9 +304,8 @@ class LocalProcessCluster(InMemoryCluster):
                     )
                 ]
                 pod.metadata.resource_version = str(next(self._rv))
-                finished.append(pod.deep_copy())
-        for pod in finished:
-            self._emit("pods", "MODIFIED", pod)
+                self._publish_locked("pods", "MODIFIED", pod.deep_copy())
+        self._drain_events()
 
     def kill_pod(self, namespace: str, name: str, sig: int = signal.SIGKILL) -> None:
         """Fault injection: signal the pod's process WITHOUT deleting the

@@ -621,9 +621,14 @@ class JobController:
         gang_failure = self._find_gang_retryable_failure(replicas, pods)
         if gang_failure is not None:
             rtype, failed_pod = gang_failure
+            # Recreate-ALL (JobSet semantics), Succeeded pods included: the
+            # restarted world initializes with the full declared membership,
+            # and a kept Succeeded coordinator (worker-0 exited 0 while a
+            # peer was preempted) would leave the new gang waiting on a
+            # process that will never rejoin. The re-run resumes from the
+            # shared checkpoint and exits cleanly again.
             for pod in pods:
-                if pod.status.phase != POD_SUCCEEDED:
-                    self._delete_pod(job, pod)
+                self._delete_pod(job, pod)
             msg = (
                 f"{self.hooks.kind} {job.name} is restarting the whole gang: "
                 f"{rtype} replica {failed_pod.metadata.name} failed retryably "

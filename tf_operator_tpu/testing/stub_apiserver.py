@@ -185,16 +185,8 @@ class StubApiServer:
         self.httpd.shutdown()
 
     # --------------------------------------------------------- watch cache
-    @staticmethod
-    def _rv_of(obj) -> int:
-        if isinstance(obj, dict):
-            raw = (obj.get("metadata") or {}).get("resourceVersion") or "0"
-        else:
-            raw = obj.metadata.resource_version or "0"
-        try:
-            return int(raw)
-        except ValueError:
-            return 0
+    # One rv parser for the whole stack — a second copy here would drift.
+    _rv_of = staticmethod(InMemoryCluster._event_rv)
 
     def _ensure_history(self, collection: str) -> None:
         """Subscribe a ring-buffer appender for `collection` (a job kind,
@@ -205,7 +197,6 @@ class StubApiServer:
             if collection in self._history:
                 return
             self._history[collection] = deque(maxlen=self.watch_history_depth)
-            self._history_start[collection] = self.mem.latest_rv()
 
         def appender(etype, obj):
             rv = self._rv_of(obj)
@@ -219,7 +210,16 @@ class StubApiServer:
                     )
                 dq.append((rv, etype, obj))
 
-        self.mem.watch(collection, appender)
+        # Subscribe and read the horizon atomically vs writers (the mem
+        # write lock): a commit landing between "horizon = latest_rv" and
+        # the subscription would be in neither the ring nor below the
+        # horizon — silently lost to resumers instead of 410'd. Under the
+        # lock, a write either finished before (horizon covers it) or
+        # lands after the appender is live (ring covers it).
+        with self.mem._lock:
+            self.mem.watch(collection, appender)
+            with self._history_lock:
+                self._history_start[collection] = self.mem.latest_rv()
 
     def compact_watch_cache(self) -> None:
         """Test hook: drop all buffered watch history and expire every
@@ -472,6 +472,11 @@ class StubApiServer:
 
     def _serve(self, handler, kind, items_fn, convert, keep, watching,
                q: dict) -> None:
+        # Start buffering on LIST, not first watch: the reflector pattern
+        # is list(rv=L) then watch(resourceVersion=L), and a history ring
+        # born after the list (global rv moved past L in between) would
+        # 410 that very first resume.
+        self._ensure_history(kind)
         if not watching:
             return self._list(handler, items_fn, q)
         return self._watch_stream(handler, kind, items_fn, convert, keep, q)
@@ -505,11 +510,15 @@ class StubApiServer:
         else:
             # First page: pin the sorted item list so every continue pages
             # the same consistent snapshot regardless of concurrent writes.
+            # rv is read BEFORE the snapshot: advertising an rv that
+            # postdates the items would let a resumed watch skip the
+            # in-between event forever; an rv slightly older than the
+            # items only costs a duplicate replay the informer dedups.
+            rv = str(self.mem.latest_rv())
             items = items_fn()
             items.sort(key=lambda o: (
                 (o.get("metadata") or {}).get("namespace", ""),
                 (o.get("metadata") or {}).get("name", "")))
-            rv = str(self.mem.latest_rv())
             offset = 0
             sid = None
             if limit and limit < len(items):
@@ -632,6 +641,17 @@ class StubApiServer:
                 wait = next_bookmark - now if bookmarks else 3600.0
                 if deadline is not None:
                     wait = min(wait, deadline - now)
+                # Watermark read BEFORE the blocking get: an event fully
+                # dispatched (and so counted by delivered_rv) before this
+                # point is already in our queue, so an Empty get proves
+                # everything at-or-below `wm` was sent on this stream —
+                # the bookmark contract. Reading the watermark after the
+                # Empty would race an event enqueued in between, putting
+                # BOOKMARK(rv) ahead of event rv on the wire and letting a
+                # resume-at-bookmark skip it. (latest_rv is never safe
+                # here: it can be ahead of an event still in the publish
+                # log.)
+                wm = self.mem.delivered_rv()
                 try:
                     etype, obj = events.get(timeout=max(wait, 0.0))
                 except queue.Empty:
@@ -641,7 +661,7 @@ class StubApiServer:
                     if bookmarks and now >= next_bookmark:
                         send({"type": "BOOKMARK", "object": {
                             "kind": kind, "metadata": {
-                                "resourceVersion": str(self.mem.latest_rv())}}})
+                                "resourceVersion": str(wm)}}})
                         next_bookmark = now + self.bookmark_interval
                     continue
                 rv = self._rv_of(obj)
