@@ -834,3 +834,73 @@ class TestGangFailureChaosFourProc:
         finally:
             manager.stop()
             cluster.shutdown()
+
+
+class TestMultisliceGangFailureChaos:
+    def test_kill_one_worker_restarts_both_slices_and_resumes(self, harness,
+                                                              tmp_path):
+        """Cross-slice blast radius: a 2-slice world is ONE megascale
+        rendezvous, so SIGKILLing a worker in slice 1 must restart the
+        workers of BOTH slices (recreate-all gang restart), re-form the
+        {'slice': 2, 'fsdp': 8} mesh, and resume from the shared
+        checkpoint to Succeeded."""
+        ckpt_dir = str(tmp_path / "ckpt")
+        train_cmd = [
+            sys.executable,
+            os.path.join(REPO_ROOT, "examples", "jax", "llama", "llama_train.py"),
+            "--model", "llama-tiny", "--steps", "80", "--batch", "16",
+            "--seq", "32", "--checkpoint-every", "10", "--log-every", "40",
+            "--checkpoint-dir", ckpt_dir,
+        ]
+        harness.create_job({
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "JAXJob",
+            "metadata": {"name": "msc", "namespace": "default"},
+            "spec": {
+                "numSlices": 2,
+                "mesh": {"slice": 2, "fsdp": 8},
+                "jaxReplicaSpecs": {"Worker": {
+                    "replicas": 4,  # 2 hosts per slice
+                    "template": {"spec": {"containers": [
+                        {"name": "jax", "image": "local", "command": train_cmd}
+                    ]}},
+                }},
+            },
+        })
+        names = [f"msc-worker-{i}" for i in range(4)]
+
+        def committed_checkpoint():
+            if not os.path.isdir(ckpt_dir):
+                return False
+            return any(e.name.isdigit() for e in os.scandir(ckpt_dir))
+
+        assert wait_for(committed_checkpoint, timeout=240), (
+            "no committed checkpoint before the kill")
+        starts_before = {
+            n: harness.get_pod("default", n).status.start_time for n in names
+        }
+        harness.kill_pod("default", "msc-worker-3")  # slice 1's second host
+
+        def world_recreated():
+            try:
+                pods = {n: harness.get_pod("default", n) for n in names}
+            except KeyError:
+                return False
+            return all(
+                p.status.start_time is not None
+                and p.status.start_time > starts_before[n]
+                for n, p in pods.items()
+            )
+
+        assert wait_for(world_recreated, timeout=90), (
+            "gang restart did not span both slices")
+        assert wait_for(
+            lambda: job_condition(harness, "JAXJob", "msc", "Succeeded"),
+            timeout=420,
+        ), harness.get_pod_log("default", "msc-worker-0")
+        for i, n in enumerate(names):
+            log = harness.get_pod_log("default", n)
+            assert "resumed from step" in log, f"{n}: {log[-2000:]}"
+            assert f"slice={i // 2}/2" in log, log
+        job = harness.get_job("JAXJob", "default", "msc")
+        assert job["status"]["restartCounts"] == {"Worker": 1}

@@ -545,7 +545,6 @@ class InMemoryCluster(base.Cluster):
                     )
                 ]
             pod.metadata.resource_version = str(next(self._rv))
-            out = pod.deep_copy()
             self._publish_locked("pods", MODIFIED, pod.deep_copy())
         self._drain_events()
 

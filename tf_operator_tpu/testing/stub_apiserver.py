@@ -193,11 +193,6 @@ class StubApiServer:
         "pods", or "services") on first use. Events before the subscription
         are unavailable — a resume below the horizon gets 410, exactly a
         real apiserver's watch-cache semantics."""
-        with self._history_lock:
-            if collection in self._history:
-                return
-            self._history[collection] = deque(maxlen=self.watch_history_depth)
-
         def appender(etype, obj):
             rv = self._rv_of(obj)
             with self._history_lock:
@@ -210,16 +205,23 @@ class StubApiServer:
                     )
                 dq.append((rv, etype, obj))
 
-        # Subscribe and read the horizon atomically vs writers (the mem
-        # write lock): a commit landing between "horizon = latest_rv" and
-        # the subscription would be in neither the ring nor below the
-        # horizon — silently lost to resumers instead of 410'd. Under the
-        # lock, a write either finished before (horizon covers it) or
-        # lands after the appender is live (ring covers it).
+        # One critical section for membership check, ring creation, horizon
+        # read, and subscription — all under the mem write lock so no event
+        # can commit in between (a commit in a gap would be in neither the
+        # ring nor below the horizon: silently lost to resumers instead of
+        # 410'd). Membership and horizon land under the SAME _history_lock
+        # hold, so a racing second caller either sees both or neither —
+        # never a ring whose horizon still reads 0. Lock order is
+        # mem._lock -> _history_lock everywhere; no path holds
+        # _history_lock while acquiring mem._lock.
         with self.mem._lock:
-            self.mem.watch(collection, appender)
             with self._history_lock:
+                if collection in self._history:
+                    return
+                self._history[collection] = deque(
+                    maxlen=self.watch_history_depth)
                 self._history_start[collection] = self.mem.latest_rv()
+            self.mem.watch(collection, appender)
 
     def compact_watch_cache(self) -> None:
         """Test hook: drop all buffered watch history and expire every
