@@ -232,21 +232,26 @@ def test_hundred_jobs_with_churn_scale_proof(capsys):
         for t in churn:
             t.join()
 
-        # Every killed worker-1 must be recreated and Running again.
-        def all_restarted():
+        # Every killed worker-1 must be recreated and Running again. One
+        # probe serves as both poll predicate and failure diagnostic so
+        # the two cannot drift apart.
+        def restart_laggards():
+            out = {}
             for i in range(40, 70):
+                name = f"s{i}-worker-1"
                 try:
-                    pod = cluster.get_pod("default", f"s{i}-worker-1")
-                except Exception:
-                    return False
-                if pod.status.phase != "Running":
-                    if pod.status.phase == "Pending":
-                        cluster.set_pod_phase(
-                            "default", pod.metadata.name, "Running")
-                    return False
-            return True
+                    phase = cluster.get_pod("default", name).status.phase
+                except Exception as exc:  # noqa: BLE001
+                    out[name] = f"missing ({exc})"
+                    continue
+                if phase == "Pending":
+                    cluster.set_pod_phase("default", name, "Running")
+                if phase != "Running":
+                    out[name] = phase
+            return out
 
-        assert wait_until(all_restarted, timeout=120), "restarts incomplete"
+        assert wait_until(lambda: not restart_laggards(), timeout=120), (
+            f"restarts incomplete: {restart_laggards()}")
 
         # Drive the survivors to completion: worker-0 exit 0.
         for i in range(0, 70):
@@ -265,12 +270,16 @@ def test_hundred_jobs_with_churn_scale_proof(capsys):
             lambda: all(conds(f"s{i}").get("Succeeded") == "True"
                         for i in range(0, 70)),
             timeout=120,
-        ), "not all survivors Succeeded"
+        ), ("not all survivors Succeeded: " + str(
+            {f"s{i}": conds(f"s{i}") for i in range(0, 70)
+             if conds(f"s{i}").get("Succeeded") != "True"}))
         assert wait_until(
             lambda: all(conds(f"s{i}").get("Failed") == "True"
                         for i in range(90, 100)),
             timeout=60,
-        ), "not all permanent failures Failed"
+        ), ("not all permanent failures Failed: " + str(
+            {f"s{i}": conds(f"s{i}") for i in range(90, 100)
+             if conds(f"s{i}").get("Failed") != "True"}))
         for i in range(70, 90):
             assert conds(f"s{i}") == {}, f"deleted job s{i} still has status"
 
