@@ -71,6 +71,13 @@ def run_evaluator(args) -> int:
     done_marker = os.path.join(args.model_dir, "DONE")
     deadline = time.monotonic() + args.evaluator_timeout
     while time.monotonic() < deadline:
+        # Read the DONE marker BEFORE listing: the chief commits the final
+        # weights before writing DONE, so a directory listing taken after
+        # the marker was observed necessarily includes the last checkpoint
+        # — "done and nothing fresh" can then never skip it. (Checking DONE
+        # after the listing races: the chief may publish final weights +
+        # DONE between the two reads.)
+        done = os.path.exists(done_marker)
         fresh = []
         if os.path.isdir(args.model_dir):
             fresh = sorted(
@@ -90,7 +97,7 @@ def run_evaluator(args) -> int:
             evaluated += 1
             print(f"EVAL file={fname} loss={loss:.4f} acc={acc:.4f}",
                   flush=True)
-        if os.path.exists(done_marker) and not fresh:
+        if done and not fresh:
             print(f"EVAL_DONE count={evaluated}", flush=True)
             return 0
         time.sleep(0.5)

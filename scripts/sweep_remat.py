@@ -1,0 +1,74 @@
+"""Remat sweep for the headline configs on the live chip (VERDICT r4 #4):
+remat off vs every checkpoint policy (models/llama._remat_policy) x batch,
+on llama-400m and llama-1b.
+
+`flops_per_token` does not count remat recompute, so any policy that saves
+more (or no-remat, if it fit) converts skipped recompute into free measured
+MFU. Round-5 result (BASELINE.md): no-remat OOMs everywhere; `dots+rope`
+won on 400m (64.4%) and `dots+rope+norms` on 1b (69.3%) — those are now
+the shipped CONFIG defaults. One JSON line per point; OOM points record an
+error entry and the sweep continues.
+
+Usage: python scripts/sweep_remat.py [--steps 20] [--only 400m|1b]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--warmup", type=int, default=3)
+    parser.add_argument("--only", default="", help="400m|1b")
+    args = parser.parse_args()
+
+    import jax
+
+    sys.path.insert(0, ".")
+    import bench
+    from tf_operator_tpu.models import llama as llama_models
+
+    devices = jax.devices()
+    mesh = jax.sharding.Mesh(devices, ("fsdp",))
+
+    # (remat, policy) points: remat=False saves all activations (max HBM,
+    # zero recompute); "nothing" rematerializes everything (min HBM, max
+    # recompute); the dots+ variants trade residency for skipped backward
+    # recompute of specific named tensors (models/llama._remat_policy).
+    variants = [("noremat", {"remat": False})] + [
+        (pol, {"remat": True, "remat_policy": pol})
+        for pol in ("dots", "nothing", "dots+act", "dots+rope",
+                    "dots+act+rope", "dots+norms", "dots+rope+norms")
+    ]
+    plans = []
+    if args.only in ("", "400m"):
+        plans += [("llama-400m", bs) for bs in (8, 16)]
+    if args.only in ("", "1b"):
+        plans += [("llama-1b", bs) for bs in (4, 8)]
+
+    for base_name, batch in plans:
+        for tag, overrides in variants:
+            name = f"{base_name}[{tag},bs={batch}]"
+            try:
+                cfg = dataclasses.replace(
+                    llama_models.CONFIGS[base_name], **overrides
+                )
+                llama_models.CONFIGS[name] = cfg
+                out = bench.bench_llama(
+                    name, batch, 2048, args.steps, args.warmup, mesh, devices
+                )
+                print(json.dumps({"config": name, **out}), flush=True)
+            except Exception as exc:  # noqa: BLE001 — OOM etc: keep sweeping
+                print(json.dumps({"config": name,
+                                  "error": f"{type(exc).__name__}: {exc}"[:200]}),
+                      flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

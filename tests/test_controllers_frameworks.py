@@ -62,7 +62,8 @@ def mxnet_manifest(name="mx"):
     }
 
 
-def jax_manifest(name="llama", accelerator="v5e-16", num_slices=1, mesh=None):
+def jax_manifest(name="llama", accelerator="v5e-16", num_slices=1, mesh=None,
+                 evaluators=0):
     spec = {
         "tpu": {"acceleratorType": accelerator, "topology": "4x4"},
         "numSlices": num_slices,
@@ -70,6 +71,11 @@ def jax_manifest(name="llama", accelerator="v5e-16", num_slices=1, mesh=None):
             "Worker": {"template": {"spec": {"containers": [container("jax")]}}}
         },
     }
+    if evaluators:
+        spec["jaxReplicaSpecs"]["Evaluator"] = {
+            "replicas": evaluators,
+            "template": {"spec": {"containers": [container("jax")]}},
+        }
     if mesh:
         spec["mesh"] = mesh
     return {
@@ -502,6 +508,245 @@ class TestJAXController:
         job = self.cluster.get_job("JAXJob", "default", "llama")
         conds = {c["type"]: c for c in job["status"]["conditions"]}
         assert conds["Failed"]["status"] == "True"
+
+    def test_evaluator_out_of_world_env_and_resources(self):
+        """Evaluators are sidecars, not SPMD world members: no coordinator/
+        world env (runtime/tpu_init.py keys jax.distributed on
+        JAX_COORDINATOR_ADDRESS presence — an evaluator joining the
+        rendezvous would deadlock the gang), no slice chip ask, and a
+        round-robin gang assignment across slices."""
+        self.cluster.create_job(jax_manifest(num_slices=2, evaluators=2))
+        self.controller.run_until_idle()
+        assert len(self.cluster.list_pods()) == 10  # 8 workers + 2 evaluators
+        ev = self.cluster.get_pod("default", "llama-evaluator-1")
+        env = {e.name: e.value for e in ev.spec.containers[0].env}
+        assert env["JAXJOB_ROLE"] == "evaluator"
+        assert env["TPU_ACCELERATOR_TYPE"] == "v5e-16"
+        for forbidden in ("JAX_COORDINATOR_ADDRESS", "JAX_PROCESS_ID",
+                          "TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES",
+                          "MEGASCALE_COORDINATOR_ADDRESS"):
+            assert forbidden not in env
+        assert "google.com/tpu" not in (
+            (ev.spec.containers[0].resources or {}).get("limits") or {}
+        )
+        # Round-robin across slice gangs (matches gang_groups' ceil-division
+        # accounting of auxiliary replica counts).
+        ev0 = self.cluster.get_pod("default", "llama-evaluator-0")
+        assert ev0.metadata.annotations["scheduling.k8s.io/group-name"] == "llama-slice-0"
+        assert ev.metadata.annotations["scheduling.k8s.io/group-name"] == "llama-slice-1"
+
+    def test_evaluator_does_not_gate_success_or_gang_restart(self):
+        """Job success is the SPMD world completing; a live evaluator must
+        not hold it open. A retryably-failed evaluator restarts alone —
+        never the worker gang."""
+        self.cluster.create_job(jax_manifest(evaluators=1))
+        self.controller.run_until_idle()
+        for p in self.cluster.list_pods():
+            self.cluster.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+        self.controller.run_until_idle()
+        worker_uids = {p.metadata.name: p.metadata.uid
+                       for p in self.cluster.list_pods()
+                       if "-worker-" in p.metadata.name}
+        # Evaluator preempted: only it restarts; the worker world is intact.
+        self.cluster.set_pod_phase("default", "llama-evaluator-0", POD_FAILED,
+                                   exit_code=137)
+        self.controller.run_until_idle()
+        after = {p.metadata.name: p.metadata.uid
+                 for p in self.cluster.list_pods() if "-worker-" in p.metadata.name}
+        assert after == worker_uids, "evaluator failure must not restart the gang"
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        assert job["status"]["restartCounts"] == {"Evaluator": 1}
+        # All workers succeed while the evaluator still runs: job Succeeded.
+        for name in worker_uids:
+            self.cluster.set_pod_phase("default", name, POD_SUCCEEDED, exit_code=0)
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds["Succeeded"]["status"] == "True"
+
+    def test_worker_gang_restart_spares_evaluator(self):
+        """The gang is the SPMD world: a worker preemption replaces every
+        worker but must NOT tear down the out-of-world evaluator — it holds
+        no rendezvous state and restarting it kills an in-flight eval."""
+        self.cluster.create_job(jax_manifest(evaluators=1))
+        self.controller.run_until_idle()
+        for p in self.cluster.list_pods():
+            self.cluster.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+        self.controller.run_until_idle()
+        uids = {p.metadata.name: p.metadata.uid for p in self.cluster.list_pods()}
+        self.cluster.set_pod_phase("default", "llama-worker-2", POD_FAILED,
+                                   exit_code=137)
+        self.controller.run_until_idle()
+        after = {p.metadata.name: p.metadata.uid for p in self.cluster.list_pods()}
+        assert set(after) == set(uids)
+        for name in after:
+            if "-worker-" in name:
+                assert after[name] != uids[name], "workers must be replaced"
+            else:
+                assert after[name] == uids[name], "evaluator must survive"
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        assert job["status"]["restartCounts"] == {"Worker": 1}
+
+    def test_evaluator_share_not_reserved_in_every_slice_gang(self):
+        """Round-robin evaluator placement means slice s's exact auxiliary
+        share is ceil((replicas - s) / num_slices): with 1 evaluator and 2
+        slices, only slice-0's PodGroup may reserve its cpu ask — a flat
+        ceil would wedge slice-1 waiting on capacity no pod of its will
+        ever claim."""
+        m = jax_manifest(num_slices=2, evaluators=1)
+        m["spec"]["jaxReplicaSpecs"]["Evaluator"]["template"]["spec"][
+            "containers"][0]["resources"] = {"requests": {"cpu": "3"}}
+        self.cluster.create_job(m)
+        self.controller.run_until_idle()
+        g0 = self.cluster.get_pod_group("default", "llama-slice-0")
+        g1 = self.cluster.get_pod_group("default", "llama-slice-1")
+        assert g0["spec"]["minMember"] == 4 and g1["spec"]["minMember"] == 4
+        assert g0["spec"]["minResources"].get("cpu") == "3"
+        assert "cpu" not in g1["spec"]["minResources"]
+
+    def test_evaluator_permanent_failure_fails_job(self):
+        self.cluster.create_job(jax_manifest(evaluators=1))
+        self.controller.run_until_idle()
+        self.cluster.set_pod_phase("default", "llama-evaluator-0", POD_FAILED,
+                                   exit_code=1)
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds["Failed"]["status"] == "True"
+        assert "Evaluator" in conds["Failed"]["message"]
+
+    def test_stuck_terminating_gang_does_not_retrigger_restart(self):
+        """ADVICE r4: once the controller's own teardown is in flight
+        (every world pod Terminating), a pod stuck in that state past the
+        expectations expiry must not re-trigger the gang restart each sync
+        — that would re-burn backoffLimit on one real failure — nor be
+        read as a permanent job failure."""
+        self.cluster.create_job(jax_manifest(accelerator="v5e-16"))
+        self.controller.run_until_idle()
+        for p in self.cluster.list_pods():
+            self.cluster.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+        self.controller.run_until_idle()
+        # Controller-initiated teardown already happened: every pod is
+        # Terminating, the trigger still shows its retryable failure.
+        self.cluster.set_pod_phase("default", "llama-worker-2", POD_FAILED,
+                                   exit_code=137)
+        for p in self.cluster.list_pods():
+            self.cluster.set_pod_deleting("default", p.metadata.name)
+        before = self.cluster.get_job("JAXJob", "default", "llama")["status"]
+        self.controller.run_until_idle()
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        assert job["status"].get("restartCounts", {}) == \
+            before.get("restartCounts", {})
+        assert len(self.cluster.list_pods()) == 4  # nothing re-deleted
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds.get("Failed", {}).get("status") != "True"
+
+    def test_externally_deleted_failed_worker_still_restarts_gang(self):
+        """A retryably-failed worker whose deletion was initiated
+        EXTERNALLY (eviction/node drain: Failed(137) with
+        deletion_timestamp already set) must still take the gang down —
+        the controller deletes its trigger last, so a Terminating trigger
+        beside LIVE peers can only mean an external delete, and leaving
+        the survivors up would hand jax.distributed a lone replacement it
+        cannot re-admit. Counted exactly once."""
+        self.cluster.create_job(jax_manifest(accelerator="v5e-16"))
+        self.controller.run_until_idle()
+        for p in self.cluster.list_pods():
+            self.cluster.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+        self.controller.run_until_idle()
+        uids = {p.metadata.name: p.metadata.uid for p in self.cluster.list_pods()}
+        self.cluster.set_pod_phase("default", "llama-worker-2", POD_FAILED,
+                                   exit_code=137)
+        self.cluster.set_pod_deleting("default", "llama-worker-2")
+        self.controller.run_until_idle()
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        assert job["status"]["restartCounts"] == {"Worker": 1}
+        # Survivors were torn down (and their indices recreated); the
+        # externally-deleted pod itself stays Terminating (test hook holds
+        # it, as a kubelet grace period would) and is never re-deleted.
+        after = {p.metadata.name: p.metadata.uid for p in self.cluster.list_pods()}
+        assert set(after) == set(uids)
+        for name in after:
+            if name == "llama-worker-2":
+                assert after[name] == uids[name]
+            else:
+                assert after[name] != uids[name], f"{name} must be replaced"
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds.get("Failed", {}).get("status") != "True"
+        assert conds.get("Restarting", {}).get("status") == "True"
+
+    def test_simultaneous_evictions_count_one_restart(self):
+        """One maintenance event evicting TWO workers (both
+        Failed+Terminating through their grace periods) is ONE world
+        restart: every world pod present at teardown completion is stamped
+        handled, so the second lingering eviction must not re-tear the
+        recreated gang or burn a second backoffLimit count."""
+        self.cluster.create_job(jax_manifest(accelerator="v5e-16"))
+        self.controller.run_until_idle()
+        for p in self.cluster.list_pods():
+            self.cluster.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+        self.controller.run_until_idle()
+        for name in ("llama-worker-1", "llama-worker-2"):
+            self.cluster.set_pod_phase("default", name, POD_FAILED, exit_code=137)
+            self.cluster.set_pod_deleting("default", name)
+        for _ in range(4):
+            self.controller.run_until_idle()
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        assert job["status"]["restartCounts"] == {"Worker": 1}
+        # Grace periods end; the full world must settle recreated, still
+        # at one counted restart.
+        self.cluster.delete_pod("default", "llama-worker-1")
+        self.cluster.delete_pod("default", "llama-worker-2")
+        self.controller.run_until_idle()
+        assert len(self.cluster.list_pods()) == 4
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        assert job["status"]["restartCounts"] == {"Worker": 1}
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds.get("Failed", {}).get("status") != "True"
+
+    def test_gang_teardown_continues_past_delete_errors(self):
+        """ADVICE r4: one failed delete must not abort the batched gang
+        teardown (piecemeal recreation yields a mixed old/new world that
+        jax.distributed cannot re-form). The trigger pod is deleted last, so
+        the next sync re-detects and finishes the job; the restart is
+        counted exactly once."""
+        self.cluster.create_job(jax_manifest(accelerator="v5e-16"))
+        self.controller.run_until_idle()
+        for p in self.cluster.list_pods():
+            self.cluster.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+        self.controller.run_until_idle()
+        uids_before = {p.metadata.name: p.metadata.uid
+                       for p in self.cluster.list_pods()}
+        self.cluster.set_pod_phase("default", "llama-worker-2", POD_FAILED,
+                                   exit_code=137)
+
+        real_delete = self.controller.engine.pod_control.delete_pod
+        fail_once = {"llama-worker-1": 1}
+
+        def flaky_delete(namespace, name, job):
+            if fail_once.get(name, 0) > 0:
+                fail_once[name] -= 1
+                raise RuntimeError("transient apiserver error")
+            return real_delete(namespace, name, job)
+
+        self.controller.engine.pod_control.delete_pod = flaky_delete
+        try:
+            self.controller.run_until_idle()
+            # The requeued sync finishes the teardown and recreates the gang.
+            self.controller.run_until_idle()
+        finally:
+            self.controller.engine.pod_control.delete_pod = real_delete
+        self.controller.run_until_idle()
+        pods = {p.metadata.name: p.metadata.uid for p in self.cluster.list_pods()}
+        assert set(pods) == set(uids_before)
+        assert all(pods[n] != uids_before[n] for n in pods), (
+            "every gang member must be replaced despite the transient error")
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        assert job["status"]["restartCounts"] == {"Worker": 1}
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds.get("Failed", {}).get("status") != "True"
 
 
 class TestRegistry:

@@ -140,6 +140,15 @@ class JobStatus:
     # The rendezvous-world hash the controller last acted on (JAXJob resize
     # — surfaced as status.worldGeneration for operators/debuggers).
     world_generation: Optional[str] = None
+    # UIDs of every world pod present when the last gang teardown
+    # completed+counted (all of them are being replaced by that restart).
+    # Externally-deleted pods (eviction: Failed + Terminating) can linger
+    # through their grace period beside the already-recreated world;
+    # without this stamp every sync would re-read each one as a fresh
+    # external deletion, tearing the new gang down again and burning one
+    # backoffLimit count per evicted pod for a single maintenance event.
+    # Replaced wholesale at each counted restart, so it stays gang-sized.
+    gang_handled_uids: List[str] = field(default_factory=list)
 
 
 # --- Condition helpers (kubeflow/common pkg/util/status.go equivalents) ---

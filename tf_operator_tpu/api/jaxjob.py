@@ -51,7 +51,14 @@ DEFAULT_PORT = 1234
 DEFAULT_RESTART_POLICY = "ExitCode"
 
 REPLICA_TYPE_WORKER = "Worker"
-CANONICAL_REPLICA_TYPES = (REPLICA_TYPE_WORKER,)
+# Out-of-world sidecar replicas (the TFJob Evaluator analog,
+# /root/reference/pkg/apis/tensorflow/v1/types.go: TFReplicaTypeEval): an
+# Evaluator is NOT a member of the jax.distributed SPMD world — it runs its
+# own single-process jax, follows the job's checkpoint stream, and neither
+# gates job success nor participates in gang restart. Evaluator pods are
+# spread round-robin across slice gangs for scheduling accounting only.
+REPLICA_TYPE_EVALUATOR = "Evaluator"
+CANONICAL_REPLICA_TYPES = (REPLICA_TYPE_WORKER, REPLICA_TYPE_EVALUATOR)
 
 # The TPU vocabulary is shared across kinds (api/tpu.py, north star: TPU
 # pod-slice provisioning on TFJob/PyTorchJob/MXJob too); re-exported here
@@ -117,10 +124,16 @@ def set_defaults(job: JAXJob) -> None:
     if job.spec.num_slices <= 0:
         job.spec.num_slices = 1
     normalize_replica_type_names(job.spec.jax_replica_specs, CANONICAL_REPLICA_TYPES)
-    for spec in job.spec.jax_replica_specs.values():
-        # Replicas default: hosts implied by the slice topology × slices,
-        # falling back to 1 (single-process) when no TPU block is given.
-        if spec.replicas is None and job.spec.tpu is not None:
+    for rtype, spec in job.spec.jax_replica_specs.items():
+        # Worker replicas default: hosts implied by the slice topology ×
+        # slices, falling back to 1 (single-process) when no TPU block is
+        # given. Out-of-world types (Evaluator) are not slice-shaped and
+        # default to 1 via set_default_replicas.
+        if (
+            rtype == REPLICA_TYPE_WORKER
+            and spec.replicas is None
+            and job.spec.tpu is not None
+        ):
             hosts = hosts_for(job.spec.tpu)
             if hosts is not None:
                 spec.replicas = hosts * job.spec.num_slices
@@ -171,6 +184,12 @@ def validate(spec: JAXJobSpec) -> None:
             raise ValidationError(
                 f"JAXReplicaType is {rtype} but must be one of {list(CANONICAL_REPLICA_TYPES)}"
             )
+    if REPLICA_TYPE_WORKER not in spec.jax_replica_specs:
+        # Evaluators are sidecars to an SPMD world; there is nothing for
+        # them to follow without one.
+        raise ValidationError(
+            "JAXJobSpec is not valid: a Worker replica spec is required"
+        )
     worker = spec.jax_replica_specs.get(REPLICA_TYPE_WORKER)
     if (
         spec.num_slices > 1

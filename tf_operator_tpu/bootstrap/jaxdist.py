@@ -98,6 +98,21 @@ def hosts_per_slice(job: JAXJob) -> int:
 
 
 def gen_env(job: JAXJob, rtype: str, index: int) -> Dict[str, str]:
+    if rtype != jaxapi.REPLICA_TYPE_WORKER:
+        # Out-of-world replicas (Evaluator): deliberately NO world vars —
+        # runtime/tpu_init.py keys jax.distributed.initialize on
+        # JAX_COORDINATOR_ADDRESS presence, and an evaluator joining the
+        # SPMD rendezvous would deadlock the worker gang. It gets the
+        # published topology (to size its own eval batch) and a role
+        # marker; checkpoint discovery is spec-level (the workload's env/
+        # volume), not a bootstrap concern.
+        env = {"JAXJOB_ROLE": rtype.lower()}
+        if job.spec.tpu is not None:
+            if job.spec.tpu.accelerator_type:
+                env[ENV_TPU_ACCELERATOR_TYPE] = job.spec.tpu.accelerator_type
+            if job.spec.tpu.topology:
+                env[ENV_TPU_TOPOLOGY] = job.spec.tpu.topology
+        return env
     worker = job.spec.jax_replica_specs.get(jaxapi.REPLICA_TYPE_WORKER)
     total = (worker.replicas or 1) if worker else 1
     port = get_port(job)

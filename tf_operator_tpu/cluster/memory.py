@@ -548,6 +548,19 @@ class InMemoryCluster(base.Cluster):
             self._publish_locked("pods", MODIFIED, pod.deep_copy())
         self._drain_events()
 
+    def set_pod_deleting(self, namespace: str, name: str) -> None:
+        """Test hook: mark a pod Terminating (deletion_timestamp set, object
+        still present) — the graceful-deletion window a real apiserver holds
+        pods in, which the instant-removal delete_pod above never shows."""
+        with self._lock:
+            pod = self._pods.get((namespace, name))
+            if pod is None:
+                raise NotFound(f"pod {namespace}/{name}")
+            pod.metadata.deletion_timestamp = self._clock()
+            pod.metadata.resource_version = str(next(self._rv))
+            self._publish_locked("pods", MODIFIED, pod.deep_copy())
+        self._drain_events()
+
 
 def terminate_after(steps: int, exit_code: int = 0):
     """Behavior factory: container runs `steps` ticks then terminates."""

@@ -51,7 +51,7 @@ class JAXController(FrameworkController):
         template.metadata.labels[constants.LABEL_WORLD_GENERATION] = (
             jaxdist.world_generation(job)
         )
-        self._attach_tpu_resources(job, template, index)
+        self._attach_tpu_resources(job, template, rtype, index)
 
     def restart_peers_on_failure(self, rtype: str) -> bool:
         """SPMD gang restart (GKE multislice / JobSet semantics): a
@@ -95,9 +95,12 @@ class JAXController(FrameworkController):
             and p.metadata.labels.get(constants.LABEL_WORLD_GENERATION) != current
         ]
 
-    def _attach_tpu_resources(self, job, template, index: int) -> None:
+    def _attach_tpu_resources(self, job, template, rtype: str, index: int) -> None:
         tpu = job.spec.tpu
-        if tpu is None:
+        if tpu is None or rtype != jaxapi.REPLICA_TYPE_WORKER:
+            # Out-of-world replicas (Evaluator) never claim slice chips: the
+            # slice is exactly worker-shaped, and an extra chip ask would
+            # make every gang reservation unschedulable.
             return
         per_slice = jaxdist.hosts_per_slice(job)
         _tpu.attach_tpu_to_template(
@@ -107,6 +110,12 @@ class JAXController(FrameworkController):
     # ---------------------------------------------------------------- gang
     def gang_group_name(self, job, rtype: str, index: int) -> str:
         per_slice = jaxdist.hosts_per_slice(job)
+        if rtype != jaxapi.REPLICA_TYPE_WORKER:
+            # Auxiliary pods spread round-robin across the slice gangs,
+            # matching gang_groups' ceil-division accounting of their
+            # replica counts.
+            num_slices = max(1, job.spec.num_slices)
+            return f"{job.name}-slice-{index % num_slices}"
         return f"{job.name}-slice-{index // per_slice}"
 
     def gang_groups(self, job, replicas: Dict[str, ReplicaSpec], run_policy) -> List[dict]:
@@ -124,37 +133,39 @@ class JAXController(FrameworkController):
         # Per-slice capacity: one slice's share of the worker topology (the
         # scheduler must be able to reserve a whole slice, not the whole
         # multislice job, for a free slice to start independently). Only the
-        # Worker type is slice-shaped (per_slice hosts each); any auxiliary
-        # type divides its own replica count across slices — counting it
-        # per_slice times per gang would inflate every reservation.
-        # (JAXJob validation currently permits only Worker; if the type set
-        # is ever widened, gang_group_name must also learn to assign
-        # auxiliary pods across slices to match this even-spread accounting.)
-        slice_replicas = {
-            rtype: dataclasses.replace(
-                spec,
-                replicas=(
-                    per_slice if rtype == jaxapi.REPLICA_TYPE_WORKER
-                    else -(-(spec.replicas or 0) // num_slices)
-                ),
-            )
-            for rtype, spec in replicas.items()
-        }
-        min_resources = (
-            dict(sp.min_resources) if sp is not None and sp.min_resources
-            else aggregate_min_resources(slice_replicas)
-        )
-        # The per-pod chip ask is injected at pod-creation time (mutate
-        # hook), so the template aggregation misses it — add the slice's
-        # chips explicitly: hosts/slice x chips/host.
-        if sp is None or not sp.min_resources:
+        # Worker type is slice-shaped (per_slice hosts each); auxiliary
+        # types (Evaluator) land round-robin across slices
+        # (gang_group_name: index % num_slices), so slice s's EXACT share
+        # is ceil((replicas - s) / num_slices) — a flat ceil for every
+        # slice would reserve auxiliary capacity in gangs that will never
+        # receive an auxiliary pod, wedging them on tight clusters.
+        def slice_min_resources(s: int) -> dict:
+            if sp is not None and sp.min_resources:
+                return dict(sp.min_resources)
+            slice_replicas = {
+                rtype: dataclasses.replace(
+                    spec,
+                    replicas=(
+                        per_slice if rtype == jaxapi.REPLICA_TYPE_WORKER
+                        else max(0, -(-((spec.replicas or 0) - s) // num_slices))
+                    ),
+                )
+                for rtype, spec in replicas.items()
+            }
+            resources = aggregate_min_resources(slice_replicas)
+            # The per-pod chip ask is injected at pod-creation time (mutate
+            # hook), so the template aggregation misses it — add the slice's
+            # chips explicitly: hosts/slice x chips/host.
             from ..api import tpu as tpuapi
 
             chips = tpuapi.per_host_chips(job.spec.tpu) if job.spec.tpu else None
             if chips:
-                min_resources.setdefault(TPU_RESOURCE, str(per_slice * chips))
+                resources.setdefault(TPU_RESOURCE, str(per_slice * chips))
+            return resources
+
         groups = []
         for s in range(num_slices):
+            min_resources = slice_min_resources(s)
             groups.append(
                 {
                     "apiVersion": "scheduling.volcano.sh/v1beta1",
@@ -194,6 +205,49 @@ class JAXController(FrameworkController):
             return
         expected = (spec.replicas or 0) - status.succeeded
 
+        # Permanent failures are checked BEFORE the success branch: when the
+        # last worker's Succeeded and an evaluator's permanent Failed land
+        # in the same sync, Failed must win — the documented contract is
+        # that an evaluator's permanent failure fails the job. Suppress only
+        # for the sync that initiated a retryable restart; a stale
+        # Restarting condition must not mask a permanent failure of the
+        # recreated pod (it would wedge the job forever). Evaluator
+        # failures count too (reference semantics: any replica type's
+        # permanent failure fails the job, tfjob_controller.go) — but
+        # evaluators never gate success below: the SPMD world completing is
+        # the job completing.
+        failed_by_type = {
+            rt: st.failed
+            for rt, st in job_status.replica_statuses.items()
+            if st.failed > 0
+        }
+        if failed_by_type and not restarting:
+            detail = ", ".join(
+                f"{n} {rt}" for rt, n in sorted(failed_by_type.items())
+            )
+            msg = (
+                f"JAXJob {job.key()} has failed because {detail} "
+                "replica(s) failed."
+            )
+            if job_status.completion_time is None:
+                job_status.completion_time = now
+            capi.update_job_conditions(
+                job_status,
+                capi.JOB_FAILED,
+                constants.job_reason(self.kind, constants.REASON_FAILED),
+                msg,
+                now=now,
+            )
+            self.cluster.record_event(
+                Event(
+                    type="Normal",
+                    reason=constants.job_reason(self.kind, constants.REASON_FAILED),
+                    message=msg,
+                    involved_object=f"{job.kind}/{job.key()}",
+                )
+            )
+            return
+
         if expected == 0:
             # SPMD: every process ran the same program to completion.
             msg = f"JAXJob {job.key()} successfully completed."
@@ -223,30 +277,4 @@ class JAXController(FrameworkController):
                 constants.job_reason(self.kind, constants.REASON_RUNNING),
                 f"JAXJob {job.key()} is running.",
                 now=now,
-            )
-
-        # Suppress Failed only for the sync that initiated a retryable
-        # restart; a stale Restarting condition must not mask a permanent
-        # failure of the recreated pod (it would wedge the job forever).
-        if status.failed > 0 and not restarting:
-            msg = (
-                f"JAXJob {job.key()} has failed because {status.failed} Worker "
-                "replica(s) failed."
-            )
-            if job_status.completion_time is None:
-                job_status.completion_time = now
-            capi.update_job_conditions(
-                job_status,
-                capi.JOB_FAILED,
-                constants.job_reason(self.kind, constants.REASON_FAILED),
-                msg,
-                now=now,
-            )
-            self.cluster.record_event(
-                Event(
-                    type="Normal",
-                    reason=constants.job_reason(self.kind, constants.REASON_FAILED),
-                    message=msg,
-                    involved_object=f"{job.kind}/{job.key()}",
-                )
             )

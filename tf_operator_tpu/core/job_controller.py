@@ -157,9 +157,14 @@ def aggregate_min_resources(replicas: Dict[str, ReplicaSpec]) -> Dict[str, str]:
             or name.startswith("hugepages-")
         )
 
+    # Exact zeros are dropped, not rendered: a type with 0 replicas in this
+    # aggregation (e.g. a slice gang that receives no auxiliary pod under
+    # round-robin spread) contributes no reservation, and a literal "0"
+    # entry only adds scheduler noise.
     return {
         name: format_quantity(v, binary=binary.get(name, memory_like(name)))
         for name, v in sorted(totals.items())
+        if v != 0
     }
 
 
@@ -618,7 +623,9 @@ class JobController:
         # failure hook): one lost process takes the whole gang down in a
         # single batched sync — survivors included — so every process
         # re-runs the rendezvous and resumes from the shared checkpoint.
-        gang_failure = self._find_gang_retryable_failure(replicas, pods)
+        gang_failure = self._find_gang_retryable_failure(
+            replicas, pods, handled_uids=set(job.status.gang_handled_uids or ())
+        )
         if gang_failure is not None:
             rtype, failed_pod = gang_failure
             # Recreate-ALL (JobSet semantics), Succeeded pods included: the
@@ -627,8 +634,57 @@ class JobController:
             # peer was preempted) would leave the new gang waiting on a
             # process that will never rejoin. The re-run resumes from the
             # shared checkpoint and exits cleanly again.
+            #
+            # Teardown order: survivors first, the triggering pod LAST and
+            # only once every survivor delete succeeded. A transient delete
+            # error therefore leaves the trigger intact as the re-fire
+            # marker — the next sync re-detects it and finishes the gang —
+            # while the restart is counted exactly once, on the pass that
+            # completes the teardown. Pods already Terminating are skipped
+            # so a retried teardown never double-deletes. Only WORLD MEMBERS
+            # (types that opted into restart_peers_on_failure) go down with
+            # the gang: out-of-world sidecars (JAXJob Evaluator) are not in
+            # the SPMD rendezvous and restart individually.
+            world_types = {
+                rt.lower() for rt in replicas
+                if self.hooks.restart_peers_on_failure(rt)
+            }
+            delete_errors = []
             for pod in pods:
-                self._delete_pod(job, pod)
+                if pod is failed_pod or pod.metadata.deletion_timestamp is not None:
+                    continue
+                if pod.metadata.labels.get(
+                    constants.LABEL_REPLICA_TYPE
+                ) not in world_types:
+                    continue
+                try:
+                    self._delete_pod(job, pod)
+                except Exception as exc:  # noqa: BLE001 — keep tearing down
+                    delete_errors.append((pod.metadata.name, exc))
+            if not delete_errors and failed_pod.metadata.deletion_timestamp is None:
+                try:
+                    self._delete_pod(job, failed_pod)
+                except Exception as exc:  # noqa: BLE001
+                    delete_errors.append((failed_pod.metadata.name, exc))
+            if delete_errors:
+                names = ", ".join(n for n, _ in delete_errors)
+                self.cluster.record_event(
+                    Event(
+                        type="Warning",
+                        reason=constants.job_reason(self.hooks.kind, constants.REASON_RESTARTING),
+                        message=(
+                            f"{self.hooks.kind} {job.name} gang teardown is "
+                            f"partial: delete failed for {names}; retrying."
+                        ),
+                        involved_object=f"{job.kind}/{key}",
+                    )
+                )
+                # Keep the status machine in "restarting" so the failed pod
+                # still being torn down is not read as a job failure.
+                job.status._restarting_this_sync = True
+                self.requeue(f"{job.kind}:{key}", 1.0)
+                self._write_status_if_changed(job, old_status)
+                return
             msg = (
                 f"{self.hooks.kind} {job.name} is restarting the whole gang: "
                 f"{rtype} replica {failed_pod.metadata.name} failed retryably "
@@ -651,7 +707,17 @@ class JobController:
             )
             job.status._restarting_this_sync = True
             # ONE restart per gang restart: backoffLimit counts world
-            # restarts, not the gang-size multiple of them.
+            # restarts, not the gang-size multiple of them. EVERY world
+            # pod present at completion is stamped handled — all are being
+            # replaced by this restart — so N pods evicted together in one
+            # maintenance event (each lingering Failed+Terminating through
+            # its grace period) count one restart, not N.
+            job.status.gang_handled_uids = [
+                p.metadata.uid
+                for p in pods
+                if p.metadata.labels.get(constants.LABEL_REPLICA_TYPE)
+                in world_types
+            ]
             job.status.restart_counts[rtype] = (
                 job.status.restart_counts.get(rtype, 0) + 1
             )
@@ -680,24 +746,54 @@ class JobController:
         self._write_status_if_changed(job, old_status)
 
     def _find_gang_retryable_failure(
-        self, replicas: Dict[str, ReplicaSpec], pods: List[Pod]
+        self, replicas: Dict[str, ReplicaSpec], pods: List[Pod],
+        handled_uids: frozenset = frozenset(),
     ):
         """(rtype, pod) of the first retryably-failed replica whose type
         opted into gang restart (restart_peers_on_failure), else None.
-        Non-retryable failures fall through to the normal status machine."""
+        Non-retryable failures fall through to the normal status machine.
+
+        A trigger already Terminating is returned ONLY while some world
+        member is still live AND its teardown was not already completed
+        (status.gang_handled_uids). The controller's own teardown deletes
+        the trigger LAST, so "terminating trigger + live peers" normally
+        means the deletion was external (eviction, node drain, kubectl
+        delete) and the gang teardown still needs to run — but once that
+        teardown is counted, the trigger can linger Terminating through
+        its grace period beside the recreated world, and re-reading it as
+        fresh would tear the new gang down every sync. Once every world
+        pod is terminating, the restart is in flight — re-firing would
+        re-burn backoffLimit on one failure."""
+        terminating_candidate = None
+        world_types_lower = set()
         for rtype, spec in replicas.items():
             if spec.restart_policy != capi.RESTART_POLICY_EXIT_CODE:
                 continue
             if not self.hooks.restart_peers_on_failure(rtype):
                 continue
+            world_types_lower.add(rtype.lower())
             for pod in filter_pods_for_replica_type(pods, rtype):
                 if pod.status.phase != POD_FAILED:
                     continue
                 exit_code = get_container_exit_code(
                     pod, self.hooks.default_container_name
                 )
-                if capi.is_retryable_exit_code(exit_code):
+                if not capi.is_retryable_exit_code(exit_code):
+                    continue
+                if pod.metadata.deletion_timestamp is None:
                     return rtype, pod
+                if (
+                    terminating_candidate is None
+                    and pod.metadata.uid not in handled_uids
+                ):
+                    terminating_candidate = (rtype, pod)
+        if terminating_candidate is not None and any(
+            p.metadata.deletion_timestamp is None
+            and p.metadata.labels.get(constants.LABEL_REPLICA_TYPE)
+            in world_types_lower
+            for p in pods
+        ):
+            return terminating_candidate
         return None
 
     # -------------------------------------------------------------- pods
@@ -743,11 +839,18 @@ class JobController:
                     )
                 )
 
-            if (
+            retryable_failure = (
                 spec.restart_policy == capi.RESTART_POLICY_EXIT_CODE
                 and pod.status.phase == POD_FAILED
                 and capi.is_retryable_exit_code(exit_code)
-            ):
+            )
+            if retryable_failure and pod.metadata.deletion_timestamp is not None:
+                # Teardown already in flight (the restart was counted when
+                # the deletion began): don't re-delete or re-count, but keep
+                # this sync in "restarting" so the status machine doesn't
+                # read the terminating pod as a job failure.
+                job_status._restarting_this_sync = True
+            elif retryable_failure:
                 # Retryable failure: delete the pod (recreated next sync) and
                 # mark the job Restarting (reference :717-736).
                 self._delete_pod(job, pod)

@@ -27,6 +27,7 @@ from typing import Any, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 
 @dataclass(frozen=True)
@@ -127,9 +128,17 @@ class LlamaConfig:
 # 61.9% — the narrow-head flash kernel wastes half of every lane register
 # and half the QK^T contraction. Param count and FLOPs are identical.
 CONFIGS = {
+    # remat_policy defaults per config are MEASURED on a single v5e (remat
+    # sweep, BASELINE.md): saving the rotated q/k ("+rope") bought ~2 MFU
+    # points everywhere it fit; "+norms" helped only where HBM headroom
+    # remained (1b bs4, moe). 7b keeps plain "dots" — its per-chip
+    # activation budget on a v5e-32 FSDP mesh is unmeasured here and the
+    # saved-rope tensors scale with seq 4096.
     "llama2-7b": LlamaConfig(),
-    "llama-1b": LlamaConfig(dim=2048, n_layers=16, n_heads=16, n_kv_heads=16, ffn_dim=5504),
-    "llama-400m": LlamaConfig(dim=1024, n_layers=24, n_heads=8, n_kv_heads=8, ffn_dim=2816),
+    "llama-1b": LlamaConfig(dim=2048, n_layers=16, n_heads=16, n_kv_heads=16,
+                            ffn_dim=5504, remat_policy="dots+rope+norms"),
+    "llama-400m": LlamaConfig(dim=1024, n_layers=24, n_heads=8, n_kv_heads=8,
+                              ffn_dim=2816, remat_policy="dots+rope"),
     "llama-125m": LlamaConfig(dim=768, n_layers=12, n_heads=6, n_kv_heads=6, ffn_dim=2048),
     "llama-tiny": LlamaConfig(
         vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=128,
@@ -142,7 +151,7 @@ CONFIGS = {
     ),
     "moe-125m": LlamaConfig(
         dim=768, n_layers=12, n_heads=6, n_kv_heads=6, ffn_dim=2048,
-        n_experts=8, experts_per_token=2,
+        n_experts=8, experts_per_token=2, remat_policy="dots+rope+norms",
     ),
     "moe-tiny": LlamaConfig(
         vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=128,
@@ -160,7 +169,12 @@ class RMSNorm(nn.Module):
         scale = self.param('scale', nn.initializers.ones, (x.shape[-1],), self.param_dtype)
         x32 = x.astype(jnp.float32)
         normed = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
-        return (normed * scale.astype(jnp.float32)).astype(x.dtype)
+        # Named for optional checkpointing ("+norms" remat variants): under
+        # "dots" the normalized stream is recomputed in the backward as the
+        # saved projections' input.
+        return checkpoint_name(
+            (normed * scale.astype(jnp.float32)).astype(x.dtype), "norm_out"
+        )
 
 
 def rope_table(head_dim: int, max_len: int, theta: float):
@@ -237,8 +251,11 @@ class Attention(nn.Module):
         v = dense(features=(cfg.n_kv_heads, cfg.head_dim), name="wv")(x)
 
         cos, sin = rope
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        # Named for optional checkpointing ("dots+rope"): under plain
+        # "dots" the rotated q/k are recomputed from the saved projections
+        # in the backward (one rope kernel replay each).
+        q = checkpoint_name(apply_rope(q, cos, sin), "rope_q")
+        k = checkpoint_name(apply_rope(k, cos, sin), "rope_k")
 
         from ..ops import attention as attn_ops
 
@@ -271,7 +288,10 @@ class MLP(nn.Module):
         # fused on v5e (same split-copy cost as the wqkv experiment).
         gate = dense(cfg.ffn_dim, name="w1")(x)
         up = dense(cfg.ffn_dim, name="w3")(x)
-        return dense(cfg.dim, name="w2")(nn.silu(gate) * up)
+        # Named for optional checkpointing (remat_policy "dots+act"): under
+        # plain "dots" the silu*up product is recomputed in the backward.
+        act = checkpoint_name(nn.silu(gate) * up, "mlp_act")
+        return dense(cfg.dim, name="w2")(act)
 
 
 class MoE(nn.Module):
@@ -387,13 +407,26 @@ def _remat_policy(cfg: LlamaConfig):
     flash-attention outputs (tagged flash_o/flash_lse in
     ops/flash_pallas.py): with q/k/v already dot-saveable, every VJP
     residual is checkpointed and the backward replay skips re-running the
-    forward kernel."""
+    forward kernel. The "dots+..." variants trade more HBM for less
+    backward recompute (remat sweep, BASELINE.md): "+act" also saves the
+    SwiGLU silu*up product, "+rope" the rotated q/k."""
+
+    def dots_plus(*names):
+        return jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names(
+                "flash_o", "flash_lse", *names
+            ),
+        )
+
     return {
         "nothing": jax.checkpoint_policies.nothing_saveable,
-        "dots": jax.checkpoint_policies.save_from_both_policies(
-            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            jax.checkpoint_policies.save_only_these_names("flash_o", "flash_lse"),
-        ),
+        "dots": dots_plus(),
+        "dots+act": dots_plus("mlp_act"),
+        "dots+rope": dots_plus("rope_q", "rope_k"),
+        "dots+act+rope": dots_plus("mlp_act", "rope_q", "rope_k"),
+        "dots+norms": dots_plus("norm_out"),
+        "dots+rope+norms": dots_plus("rope_q", "rope_k", "norm_out"),
     }[cfg.remat_policy]
 
 

@@ -239,6 +239,13 @@ class StubApiServer:
         """Test hook: 410 any continue token minted before this call."""
         with self._history_lock:
             self._continue_floor = self.mem.latest_rv()
+            # Drop the pinned snapshots too: a token minted at exactly the
+            # current rv passes the floor comparison (rv granularity cannot
+            # distinguish "minted before" from "minted after" without a
+            # write in between), but its snapshot being gone still 410s it
+            # — matching the docstring's contract for every outstanding
+            # token. New lists mint fresh snapshot ids.
+            self._list_snapshots.clear()
 
     # ------------------------------------------------------------- routing
     def _route(self, handler, method: str) -> None:
