@@ -145,13 +145,15 @@ def bench_bert(config_name, batch, seq, steps, warmup, mesh, devices):
     )
 
     def loss_fn(params, batch_ids):
-        # MLM-shaped throughput loss: the model's tied-head vocab logits
-        # against synthetic targets at every position.
-        ids, targets = batch_ids[:, :-1], batch_ids[:, 1:]
-        logits = model.apply(params, ids)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return -ll.mean()
+        # MLM-shaped throughput loss via the SHARED chunked-head path
+        # (train_step.loss_fn → head_kernel_and_bias → chunked CE): the
+        # [b, s, 30k] fp32 logits tensor never exists whole in HBM
+        # (~0.5 GB at bs 8 — materializing it plus its log_softmax was
+        # measured costing bert-base several MFU points of pure
+        # bandwidth).
+        from tf_operator_tpu.train.train_step import loss_fn as shared_loss
+
+        return shared_loss(model, params, batch_ids)
 
     step_fn, sharding = make_train_step_for(loss_fn, optimizer, mesh, state)
     state = jax.tree.map(jax.device_put, state, sharding)
@@ -524,8 +526,17 @@ def main() -> int:
             mesh, devices,
         ))
         bert_name = "bert-base" if on_tpu else "bert-tiny"
+        # bs 16 for bert on TPU (not the llama headline's bs): seq 512
+        # gives the flash kernel a small grid per sequence; the larger
+        # batch keeps the MXU fed (+0.5 MFU over bs 8, round-5 sweep).
+        # And MORE steps than the other secondaries: a bert step is ~60 ms
+        # — at 10 steps the per-dispatch latency of a remote-relay backend
+        # eats 4-6 MFU points of pure measurement artifact (41% at 10
+        # steps vs 47.5% at 40 on the same config).
+        bert_batch = 16 if on_tpu else args.batch
+        bert_steps = max(sub_steps, 40) if on_tpu else sub_steps
         secondary(bert_name, lambda: bench_bert(
-            bert_name, args.batch, min(seq, 512), sub_steps, args.warmup,
+            bert_name, bert_batch, min(seq, 512), bert_steps, args.warmup,
             mesh, devices,
         ))
         if on_tpu and n == 1 and args.model != "llama-1b":

@@ -1,0 +1,230 @@
+"""Runnable auto-tuning through the MXTune topology (MXJob jobMode=MXTune).
+
+The reference ships TVM autotuning driven by `auto-tuning.py`/`start-job.py`
+(/root/reference/examples/mxnet/tune/ — tracker process, RPC servers keyed
+by device class, a tuner searching CUDA schedules). This re-design keeps
+the exact topology and operator contract but replaces the TVM/CUDA search
+with a dependency-free toy: tuning the k-tile size of a blocked float32
+matmul — a real measurement-driven search (cache locality makes the tile
+choice genuinely matter) that runs anywhere in seconds.
+
+Roles (one script, dispatched on MX_CONFIG task.type, like start-job.py):
+
+- **tunertracker** — the rendezvous point (DMLC_PS_ROOT_URI points here).
+  Serves /healthz and waits for the tuner's POST /done {best}; then prints
+  the verdict and exits 0 — MXTune jobs complete on the TunerTracker
+  (controllers/mxnet.py _completion_key), so tracker exit 0 = job
+  Succeeded and the operator reaps the still-running servers per
+  CleanPodPolicy.
+- **tunerserver** — a measurement worker: POST /measure {"n","tile"} times
+  the blocked matmul locally and returns achieved GFLOP/s. Its
+  `tuner-server-key` annotation surfaces in MX_CONFIG.labels so a tuner
+  can address a device class, exactly as the reference keys RPC servers.
+- **tuner** — drives the search: reads the server addresses from
+  MX_CONFIG.cluster.tunerserver, waits for them, dispatches each tile
+  candidate round-robin, and reports the best config to the tracker.
+
+Run under the operator: `kubectl apply -f mxjob_tune.yaml` (image with
+this file), or locally via the process backend — the e2e
+(tests/test_e2e_process.py TestMXTuneSearch) runs this exact search
+end-to-end through live operator-launched processes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+TILE_CANDIDATES = (16, 32, 64, 128, 384)
+MATMUL_N = 384
+
+
+def mx_config() -> dict:
+    raw = os.environ.get("MX_CONFIG")
+    if not raw:
+        raise SystemExit("MX_CONFIG not set — run this under an MXJob")
+    return json.loads(raw)
+
+
+def own_entry(cfg: dict) -> tuple:
+    task = cfg.get("task", {})
+    entries = (cfg.get("cluster") or {}).get(task.get("type", ""), [])
+    entry = entries[int(task.get("index", 0))]
+    return entry["url"], int(entry["port"])
+
+
+def post_json(url: str, payload: dict, timeout: float = 30.0) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def wait_healthy(host: str, port: int, timeout: float = 60.0) -> None:
+    deadline = time.monotonic() + timeout
+    url = f"http://{host}:{port}/healthz"
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=2):
+                return
+        except Exception:  # noqa: BLE001 — booting
+            time.sleep(0.2)
+    raise SystemExit(f"peer {host}:{port} never became healthy")
+
+
+def measure_tile(n: int, tile: int, repeats: int = 3) -> float:
+    """GFLOP/s of a k-blocked matmul at this tile size (best of repeats).
+    The accumulation loop over k-tiles changes the working-set size per
+    pass — the toy analog of a TVM schedule's tiling knob."""
+    rng = np.random.default_rng(0)
+    a = rng.random((n, n), dtype=np.float32)
+    b = rng.random((n, n), dtype=np.float32)
+    best = 0.0
+    for _ in range(repeats):
+        c = np.zeros((n, n), dtype=np.float32)
+        t0 = time.perf_counter()
+        for k0 in range(0, n, tile):
+            c += a[:, k0:k0 + tile] @ b[k0:k0 + tile, :]
+        dt = time.perf_counter() - t0
+        best = max(best, 2.0 * n ** 3 / dt / 1e9)
+    # Keep the result honest: the blocked product must match the plain one.
+    if not np.allclose(c, a @ b, atol=1e-2):
+        raise SystemExit(f"blocked matmul wrong at tile={tile}")
+    return best
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "mxtune/1.0"
+
+    def log_message(self, fmt, *args):  # noqa: A003 — quiet
+        pass
+
+    def _json(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        if self.path == "/healthz":
+            return self._json(200, {"ok": True, "role": self.server.role})
+        return self._json(404, {"error": self.path})
+
+    def do_POST(self):  # noqa: N802
+        length = int(self.headers.get("Content-Length", 0))
+        payload = json.loads(self.rfile.read(length) or b"{}")
+        if self.path == "/measure" and self.server.role == "tunerserver":
+            tile = int(payload["tile"])
+            gflops = measure_tile(int(payload.get("n", MATMUL_N)), tile)
+            print(f"[server] tile={tile} -> {gflops:.2f} GFLOP/s", flush=True)
+            return self._json(200, {"tile": tile, "gflops": gflops})
+        if self.path == "/done" and self.server.role == "tunertracker":
+            # Respond BEFORE signaling completion: the main thread exits
+            # the process on `done`, and setting it first could kill this
+            # daemon handler between the event and the response write,
+            # resetting the tuner's connection.
+            self.server.best = payload
+            self._json(200, {"ok": True})
+            try:
+                self.wfile.flush()
+            except OSError:
+                pass
+            self.server.done.set()
+            return None
+        return self._json(404, {"error": self.path})
+
+
+def serve(role: str, host: str, port: int) -> ThreadingHTTPServer:
+    httpd = ThreadingHTTPServer((host, port), _Handler)
+    httpd.role = role
+    httpd.done = threading.Event()
+    httpd.best = None
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    print(f"[{role}] listening on {host}:{port}", flush=True)
+    return httpd
+
+
+def run_tracker(cfg: dict) -> int:
+    host, port = own_entry(cfg)
+    httpd = serve("tunertracker", host, port)
+    # The tracker is the job's completion key: it exits 0 only once the
+    # tuner reports the finished search.
+    httpd.done.wait()
+    best = httpd.best or {}
+    print(f"[tracker] search finished: best={best}", flush=True)
+    httpd.shutdown()
+    return 0
+
+
+def run_server(cfg: dict) -> int:
+    host, port = own_entry(cfg)
+    key = (cfg.get("labels") or {}).get("tunerserver", "")
+    httpd = serve("tunerserver", host, port)
+    print(f"[server] device-class key={key!r}", flush=True)
+    # Serve until the operator reaps this pod after job completion
+    # (CleanPodPolicy) — the reference's RPC servers behave the same way.
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        httpd.shutdown()
+    return 0
+
+
+def run_tuner(cfg: dict) -> int:
+    cluster = cfg.get("cluster") or {}
+    servers = [(e["url"], int(e["port"])) for e in cluster.get("tunerserver", [])]
+    tracker = cluster["tunertracker"][0]
+    if not servers:
+        raise SystemExit("no tunerserver replicas in MX_CONFIG")
+    for host, port in servers + [(tracker["url"], int(tracker["port"]))]:
+        wait_healthy(host, port)
+
+    results = []
+    for i, tile in enumerate(TILE_CANDIDATES):
+        host, port = servers[i % len(servers)]  # round-robin device class
+        out = post_json(f"http://{host}:{port}/measure",
+                        {"n": MATMUL_N, "tile": tile})
+        print(f"[tuner] server={host}:{port} tile={tile} "
+              f"-> {out['gflops']:.2f} GFLOP/s", flush=True)
+        results.append(out)
+    best = max(results, key=lambda r: r["gflops"])
+    print(f"[tuner] BEST tile={best['tile']} gflops={best['gflops']:.2f} "
+          f"({len(results)} candidates over {len(servers)} servers)",
+          flush=True)
+    post_json(f"http://{tracker['url']}:{tracker['port']}/done", best)
+    print("[tuner] done", flush=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--role", default="",
+                        help="override MX_CONFIG task.type (local debugging)")
+    args = parser.parse_args(argv)
+    cfg = mx_config()
+    role = args.role or cfg.get("task", {}).get("type", "")
+    if role == "tunertracker":
+        return run_tracker(cfg)
+    if role == "tunerserver":
+        return run_server(cfg)
+    if role == "tuner":
+        return run_tuner(cfg)
+    raise SystemExit(f"unknown MXTune role {role!r}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

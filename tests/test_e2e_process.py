@@ -336,6 +336,183 @@ class TestJAXJobElasticResize:
         )
 
 
+    def test_scale_down_live_world_restarts_and_resumes(self, harness, tmp_path):
+        """VERDICT r4 #5a: elastic scale-DOWN with live training processes.
+        An 8-process world training llama-tiny is patched to 4 workers: the
+        operator deletes ALL stale-generation pods in one batched sync
+        (world-generation restart), boots a consistent 4-process world, and
+        the workload resumes from the shared orbax checkpoint rather than
+        step 0."""
+        ckpt_dir = str(tmp_path / "ckpt")
+        train_cmd = [
+            sys.executable,
+            os.path.join(REPO_ROOT, "examples", "jax", "llama", "llama_train.py"),
+            # 150 steps, not more: the federated CPU mesh pays gloo TCP
+            # collectives every step (~0.4 steps/s in the 4-proc world
+            # under CI load) — 400 steps blew the Succeeded window.
+            "--model", "llama-tiny", "--steps", "150", "--batch", "32",
+            "--seq", "32", "--checkpoint-every", "10", "--log-every", "50",
+            "--checkpoint-dir", ckpt_dir,
+        ]
+        harness.create_job({
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "JAXJob",
+            "metadata": {"name": "eld", "namespace": "default"},
+            "spec": {
+                "elastic": {"minSlices": 1},
+                "jaxReplicaSpecs": {"Worker": {
+                    "replicas": 8,
+                    "template": {"spec": {"containers": [
+                        {"name": "jax", "image": "local", "command": train_cmd}
+                    ]}},
+                }},
+            },
+        })
+
+        def committed_checkpoint():
+            return os.path.isdir(ckpt_dir) and any(
+                e.name.isdigit() for e in os.scandir(ckpt_dir))
+
+        assert wait_for(committed_checkpoint, timeout=300), (
+            "8-proc world never committed a checkpoint")
+        old_gens = {p.metadata.labels["world-generation"]
+                    for p in harness.list_pods("default")}
+
+        from tf_operator_tpu.sdk.client import JobClient
+
+        JobClient(harness, kind="JAXJob").patch(
+            "eld", {"spec": {"jaxReplicaSpecs": {"Worker": {"replicas": 4}}}}
+        )
+
+        def shrunk_world_running():
+            pods = harness.list_pods("default")
+            return (len(pods) == 4
+                    and all(p.status.phase == "Running" for p in pods)
+                    and all(p.metadata.labels["world-generation"] not in old_gens
+                            for p in pods))
+
+        assert wait_for(shrunk_world_running, timeout=90), (
+            [(p.metadata.name, p.status.phase)
+             for p in harness.list_pods("default")])
+        assert wait_for(
+            lambda: job_condition(harness, "JAXJob", "eld", "Succeeded"),
+            timeout=600,
+        ), harness.get_pod_log("default", "eld-worker-0")[-3000:]
+        for i in range(4):
+            log = harness.get_pod_log("default", f"eld-worker-{i}")
+            assert f"process {i}/4 devices=16" in log, f"{i}: {log[-2000:]}"
+            assert "resumed from step" in log, f"{i}: {log[-2000:]}"
+        assert not job_condition(harness, "JAXJob", "eld", "Failed")
+
+
+class TestSuspendResumeLiveProcesses:
+    def test_suspend_kills_processes_resume_restores_from_checkpoint(
+        self, harness, tmp_path
+    ):
+        """VERDICT r4 #5b: suspend/resume against LIVE processes (the
+        memory-backend tests never executed this path). Suspending a
+        running JAXJob kills every worker process and releases the gang
+        group; resuming boots a fresh world that restores from the orbax
+        checkpoint instead of step 0."""
+        ckpt_dir = str(tmp_path / "ckpt")
+        train_cmd = [
+            sys.executable,
+            os.path.join(REPO_ROOT, "examples", "jax", "llama", "llama_train.py"),
+            "--model", "llama-tiny", "--steps", "400", "--batch", "8",
+            "--seq", "32", "--checkpoint-every", "15", "--log-every", "100",
+            "--checkpoint-dir", ckpt_dir,
+        ]
+        harness.create_job({
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "JAXJob",
+            "metadata": {"name": "sus", "namespace": "default"},
+            "spec": {"jaxReplicaSpecs": {"Worker": {
+                "replicas": 2,
+                "template": {"spec": {"containers": [
+                    {"name": "jax", "image": "local", "command": train_cmd}
+                ]}},
+            }}},
+        })
+
+        def committed_checkpoint():
+            return os.path.isdir(ckpt_dir) and any(
+                e.name.isdigit() for e in os.scandir(ckpt_dir))
+
+        assert wait_for(committed_checkpoint, timeout=240), (
+            "no committed checkpoint before suspend")
+
+        from tf_operator_tpu.sdk.client import JobClient
+
+        client = JobClient(harness, kind="JAXJob")
+        client.suspend("sus")
+        assert wait_for(
+            lambda: not harness.list_pods("default"), timeout=60
+        ), "suspend must tear down every live process"
+        assert wait_for(
+            lambda: job_condition(harness, "JAXJob", "sus", "Suspended"),
+            timeout=30,
+        )
+        # The slice is genuinely released: no processes remain.
+        assert harness.list_pods("default") == []
+
+        client.resume("sus")
+        assert wait_for(
+            lambda: job_condition(harness, "JAXJob", "sus", "Succeeded"),
+            timeout=600,
+        ), harness.get_pod_log("default", "sus-worker-0")[-3000:]
+        for i in range(2):
+            log = harness.get_pod_log("default", f"sus-worker-{i}")
+            assert "resumed from step" in log, f"{i}: {log[-2000:]}"
+        assert not job_condition(harness, "JAXJob", "sus", "Failed")
+
+
+class TestTFDynamicWorkerLive:
+    def test_add_worker_joins_without_world_restart(self, harness):
+        """VERDICT r4 #5c: TF EnableDynamicWorker live (reference
+        tensorflow.go:62-83 — sparse TF_CONFIG so membership can change
+        without restarting the world). Adding a worker to a RUNNING job
+        must boot only the new member: the existing workers' processes
+        keep their pids/start times, and every member sees the sparse
+        config (itself + the PS list, never the full worker map that
+        would have pinned the old world size)."""
+        manifest = tfjob_manifest("dyn", workers=2)
+        manifest["spec"]["enableDynamicWorker"] = True
+        harness.create_job(manifest)
+        assert wait_for(lambda: len(harness.list_pods("default")) == 2)
+        for i in range(2):
+            http_get_json(worker_addr(harness, "dyn", i), "/healthz")
+        starts = {i: harness.get_pod("default", f"dyn-worker-{i}").status.start_time
+                  for i in range(2)}
+
+        from tf_operator_tpu.sdk.client import JobClient
+
+        JobClient(harness, kind="TFJob").patch(
+            "dyn", {"spec": {"tfReplicaSpecs": {"Worker": {"replicas": 3}}}}
+        )
+
+        def third_up():
+            try:
+                return http_get_json(
+                    worker_addr(harness, "dyn", 2), "/healthz", timeout=2
+                ) is not None
+            except AssertionError:
+                return False
+
+        assert wait_for(third_up, timeout=60), "worker-2 never came up"
+        # The original members were NOT restarted: same processes.
+        for i in range(2):
+            pod = harness.get_pod("default", f"dyn-worker-{i}")
+            assert pod.status.start_time == starts[i], (
+                f"worker-{i} was restarted by the scale-up")
+        # Sparse config on the new member: itself only, no full worker map
+        # (under EnableDynamicWorker /runconfig's cluster_spec IS the
+        # sparse form — testing/test_server.py).
+        cfg = http_get_json(worker_addr(harness, "dyn", 2), "/runconfig")
+        assert cfg["task_type"] == "worker" and cfg["task_id"] == 2, cfg
+        assert list(cfg["cluster_spec"].get("worker", {}).keys()) == ["2"], cfg
+        assert not job_condition(harness, "TFJob", "dyn", "Restarting")
+
+
 class TestSDKFaultInjection:
     def test_terminate_replica_completes_job(self, harness):
         """The SDK's terminate_replica drives the controllable test-server's
@@ -747,6 +924,58 @@ class TestMXTuneTopology:
         assert tcfg["labels"]["tunerserver"] == "1080ti"
 
 
+class TestMXTuneSearch:
+    """The runnable auto-tuning example (VERDICT r4 #7 — the reference
+    ships executable auto-tuning.py/start-job.py, not just topology YAML):
+    the operator boots the full MXTune topology as live processes running
+    examples/mxnet/tune/auto_tuning.py, the tuner measures a toy tiling
+    space on the servers and reports the winner to the tracker, whose
+    exit 0 completes the job (MXTune completion key)."""
+
+    def test_search_runs_to_completion(self, mx_harness):
+        tune_cmd = [
+            sys.executable,
+            os.path.join(REPO_ROOT, "examples", "mxnet", "tune", "auto_tuning.py"),
+        ]
+
+        def replica(n, key=None):
+            spec = {
+                "replicas": n,
+                "restartPolicy": "Never",
+                "template": {"spec": {"containers": [
+                    {"name": "mxnet", "image": "local", "command": tune_cmd}
+                ]}},
+            }
+            if key:
+                spec["template"]["metadata"] = {
+                    "annotations": {"tuner-server-key": key}
+                }
+            return spec
+
+        mx_harness.create_job({
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "MXJob",
+            "metadata": {"name": "ts", "namespace": "default"},
+            "spec": {
+                "jobMode": "MXTune",
+                "mxReplicaSpecs": {
+                    "TunerTracker": replica(1),
+                    "TunerServer": replica(2, key="cpu-avx2"),
+                    "Tuner": replica(1),
+                },
+            },
+        })
+        assert wait_for(
+            lambda: job_condition(mx_harness, "MXJob", "ts", "Succeeded"),
+            timeout=180,
+        ), mx_harness.get_pod_log("default", "ts-tunertracker-0")[-2000:]
+        tuner_log = mx_harness.get_pod_log("default", "ts-tuner-0")
+        assert "BEST tile=" in tuner_log, tuner_log[-2000:]
+        assert "over 2 servers" in tuner_log, tuner_log[-2000:]
+        tracker_log = mx_harness.get_pod_log("default", "ts-tunertracker-0")
+        assert "search finished: best=" in tracker_log, tracker_log[-2000:]
+
+
 class TestGangFailureChaosFourProc:
     def test_kill_one_of_four_restarts_world_and_resumes(self, tmp_path):
         """VERDICT r3 next-round #6: 4-process JAXJob gang chaos. SIGKILL
@@ -831,6 +1060,94 @@ class TestGangFailureChaosFourProc:
             hist = metrics._histograms["training_operator_job_restart_seconds"][
                 ("default", "JAXJob")]
             assert hist.count >= 1, "restart MTTR missing from the histogram"
+        finally:
+            manager.stop()
+            cluster.shutdown()
+
+
+class TestGangFailureChaosEightProc:
+    def test_kill_one_of_eight_restarts_world_and_resumes(self, tmp_path):
+        """VERDICT r4 #3: gang chaos at the v5e-32 world's HOST extent —
+        8 live processes (the 8 TPU VM hosts of a v5e-32), one SIGKILLed
+        mid-training. The whole-gang restart must replace all EIGHT in one
+        batched sync, re-form the 32-device federated mesh, resume from
+        the shared orbax checkpoint, and count exactly one world restart."""
+        metrics = Metrics()
+        cluster = LocalProcessCluster(child_env=CHILD_ENV)
+        manager = OperatorManager(
+            cluster,
+            OperatorOptions(enabled_schemes=["JAXJob"], health_port=0,
+                            metrics_port=0, resync_period=0.2),
+            metrics=metrics,
+        )
+        manager.start()
+        ckpt_dir = str(tmp_path / "ckpt")
+        train_cmd = [
+            sys.executable,
+            os.path.join(REPO_ROOT, "examples", "jax", "llama", "llama_train.py"),
+            "--model", "llama-tiny", "--steps", "60", "--batch", "32",
+            "--seq", "32", "--checkpoint-every", "10", "--log-every", "30",
+            "--checkpoint-dir", ckpt_dir,
+        ]
+        try:
+            cluster.create_job({
+                "apiVersion": "kubeflow.org/v1",
+                "kind": "JAXJob",
+                "metadata": {"name": "chaos8", "namespace": "default"},
+                "spec": {"jaxReplicaSpecs": {"Worker": {
+                    "replicas": 8,
+                    "template": {"spec": {"containers": [
+                        {"name": "jax", "image": "local", "command": train_cmd}
+                    ]}},
+                }}},
+            })
+            names = [f"chaos8-worker-{i}" for i in range(8)]
+
+            def committed_checkpoint():
+                if not os.path.isdir(ckpt_dir):
+                    return False
+                return any(e.name.isdigit() for e in os.scandir(ckpt_dir))
+
+            assert wait_for(committed_checkpoint, timeout=300), (
+                "no committed checkpoint before the kill")
+            starts_before = {
+                n: cluster.get_pod("default", n).status.start_time for n in names
+            }
+            kill_t0 = time.monotonic()
+            cluster.kill_pod("default", "chaos8-worker-5")
+
+            def world_recreated():
+                try:
+                    pods = {n: cluster.get_pod("default", n) for n in names}
+                except KeyError:
+                    return False
+                return all(
+                    p.status.start_time is not None
+                    and p.status.start_time > starts_before[n]
+                    for n, p in pods.items()
+                )
+
+            assert wait_for(world_recreated, timeout=120), (
+                "gang restart did not recreate all eight workers")
+            mttr = time.monotonic() - kill_t0
+            print(f"[chaos8] world recreated {mttr:.2f}s after SIGKILL",
+                  flush=True)
+
+            assert wait_for(
+                lambda: job_condition(cluster, "JAXJob", "chaos8", "Succeeded"),
+                timeout=600,
+            ), cluster.get_pod_log("default", "chaos8-worker-0")[-3000:]
+            for n in names:
+                log = cluster.get_pod_log("default", n)
+                assert "resumed from step" in log, f"{n}: {log[-2000:]}"
+                assert "devices=32" in log, f"{n}: {log[-2000:]}"
+            assert not job_condition(cluster, "JAXJob", "chaos8", "Failed")
+            job = cluster.get_job("JAXJob", "default", "chaos8")
+            assert job["status"]["restartCounts"] == {"Worker": 1}, (
+                "one world restart, not one per pod")
+            hist = metrics._histograms["training_operator_job_restart_seconds"][
+                ("default", "JAXJob")]
+            assert hist.count >= 1
         finally:
             manager.stop()
             cluster.shutdown()

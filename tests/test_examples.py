@@ -149,6 +149,59 @@ class TestJaxScriptsRun:
         ok.close()
 
 
+class TestMXTuneExampleDirect:
+    """The auto-tuning workload itself (examples/mxnet/tune/auto_tuning.py)
+    without the operator: hand-built MX_CONFIG, four local processes, toy
+    tile search to a BEST verdict. The operator-driven run of the same
+    script is tests/test_e2e_process.py::TestMXTuneSearch."""
+
+    def test_toy_search_finds_best_tile(self, tmp_path):
+        import json
+        import subprocess
+
+        script = os.path.join(EXAMPLES, "mxnet", "tune", "auto_tuning.py")
+        # Below Linux's ephemeral range (32768+): a concurrent CI step's
+        # client sockets can never grab these as source ports.
+        base = 24390
+
+        def cfg(rtype, index):
+            cluster = {
+                "tunertracker": [{"url": "127.0.0.1", "port": base}],
+                "tunerserver": [
+                    {"url": "127.0.0.1", "port": base + 1},
+                    {"url": "127.0.0.1", "port": base + 2},
+                ],
+                "tuner": [{"url": "127.0.0.1", "port": base + 3}],
+            }
+            return json.dumps({
+                "cluster": cluster,
+                "task": {"type": rtype, "index": index},
+                "labels": {"tunerserver": "cpu-avx2"},
+            })
+
+        def spawn(rtype, index):
+            env = {**os.environ, "MX_CONFIG": cfg(rtype, index)}
+            return subprocess.Popen(
+                [sys.executable, script], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            )
+
+        tracker = spawn("tunertracker", 0)
+        servers = [spawn("tunerserver", i) for i in range(2)]
+        tuner = spawn("tuner", 0)
+        try:
+            out, _ = tuner.communicate(timeout=120)
+            assert tuner.returncode == 0, out
+            assert "BEST tile=" in out and "[tuner] done" in out, out
+            tout, _ = tracker.communicate(timeout=30)
+            assert tracker.returncode == 0, tout
+            assert "search finished: best=" in tout, tout
+        finally:
+            for proc in [tracker, tuner, *servers]:
+                if proc.poll() is None:
+                    proc.kill()
+
+
 class TestPytorchExampleE2E:
     """The c10d contract proven live: a PyTorchJob (1 master + 2 workers)
     runs the DDP example as real processes; gloo rendezvous rides the

@@ -1,0 +1,219 @@
+"""Control-plane soak (VERDICT r4 #6): sustained churn with residency.
+
+The reference ran for months in 30Mi (manifests deployment limits); this
+repo's scale proof (tests/test_concurrency_stress.py) asserted latency but
+never memory. Here the full HTTP stack — TWO operator replicas through
+real KubeCluster clients (JSON + sockets every hop) against the stub
+apiserver — cycles jobs continuously for ~10 minutes (>=500 jobs total,
+each created, run through churn to Succeeded, then deleted), with:
+
+- **RSS plateau**: sampled after every wave (gc first); the last third of
+  the run must not sit above the middle third by more than a small
+  allowance — the watch-cache rings, informer stores, expectations cache
+  and UID-keyed metrics must all shed deleted jobs.
+- **Reconcile p90** within 2x the 100-job scale-proof baseline (61 ms
+  memory-backend; HTTP adds socket hops — bound at 0.25 s).
+- **Leader failover mid-soak loses zero jobs**: the leader is stopped
+  cold halfway; the standby must finish that wave and all later waves —
+  every job still reaches Succeeded before its deletion.
+
+Duration/volume tunable for dev runs: TF_OPERATOR_SOAK_SECONDS (600),
+TF_OPERATOR_SOAK_MIN_JOBS (500).
+"""
+
+import gc
+import math
+import os
+import threading
+import time
+
+import pytest
+
+from tf_operator_tpu.cli import OperatorManager, OperatorOptions
+from tf_operator_tpu.cluster.kube import KubeCluster
+from tf_operator_tpu.metrics import Metrics
+from tf_operator_tpu.testing.stub_apiserver import StubApiServer
+
+SOAK_SECONDS = float(os.environ.get("TF_OPERATOR_SOAK_SECONDS", "600"))
+MIN_JOBS = int(os.environ.get("TF_OPERATOR_SOAK_MIN_JOBS", "500"))
+WAVE = 25  # jobs per wave
+
+
+def tfjob(name, workers=2):
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "TFJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "tfReplicaSpecs": {
+                "Worker": {
+                    "replicas": workers,
+                    "restartPolicy": "ExitCode",
+                    "template": {
+                        "spec": {"containers": [{"name": "tensorflow", "image": "i"}]}
+                    },
+                }
+            }
+        },
+    }
+
+
+def wait_until(predicate, timeout=120.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def rss_mib() -> float:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024.0
+    raise RuntimeError("no VmRSS")
+
+
+@pytest.fixture
+def stub():
+    server = StubApiServer()
+    yield server
+    server.shutdown()
+
+
+def test_ten_minute_churn_soak_rss_plateau_and_failover(stub, capsys):
+    opts = OperatorOptions(
+        enabled_schemes=["TFJob"], leader_elect=True, lease_duration=1.0,
+        threadiness=4, resync_period=0.5, health_port=0, metrics_port=0,
+    )
+    kube1 = KubeCluster(base_url=stub.url, token="t")
+    kube2 = KubeCluster(base_url=stub.url, token="t")
+    metrics1, metrics2 = Metrics(), Metrics()
+    m1 = OperatorManager(kube1, opts, metrics=metrics1, identity="soak-1")
+    m2 = OperatorManager(kube2, opts, metrics=metrics2, identity="soak-2")
+    submit = stub.mem  # the test's own CRUD path, independent of leaders
+
+    m1.start()
+    m2.start()
+    assert wait_until(lambda: m1.is_leader or m2.is_leader, timeout=15)
+
+    total = 0
+    wave_no = 0
+    rss_samples = []
+    failed_over = False
+    t_start = time.monotonic()
+    deadline = t_start + SOAK_SECONDS
+
+    def conds(name):
+        try:
+            job = submit.get_job("TFJob", "default", name)
+        except Exception:  # noqa: BLE001
+            return {}
+        return {c["type"]: c["status"]
+                for c in (job.get("status") or {}).get("conditions") or []}
+
+    try:
+        while time.monotonic() < deadline or total < MIN_JOBS:
+            names = [f"w{wave_no}-{i}" for i in range(WAVE)]
+            for n in names:
+                submit.create_job(tfjob(n))
+            assert wait_until(
+                lambda: len(submit.list_pods("default")) == 2 * WAVE
+            ), (f"wave {wave_no}: pods stuck at "
+                f"{len(submit.list_pods('default'))}")
+            for pod in submit.list_pods("default"):
+                submit.set_pod_phase("default", pod.metadata.name, "Running")
+
+            # Churn: every 5th job loses worker-1 retryably (exit 130) and
+            # the operator must replace it before the wave can drain.
+            for n in names[::5]:
+                submit.set_pod_phase("default", f"{n}-worker-1", "Failed",
+                                     exit_code=130,
+                                     container_name="tensorflow")
+
+            # Halfway: kill the leader cold. The standby finishes this
+            # wave and every later one — zero lost jobs.
+            nonlocal_now = time.monotonic()
+            if not failed_over and nonlocal_now - t_start > SOAK_SECONDS / 2:
+                leader, standby = (m1, m2) if m1.is_leader else (m2, m1)
+                leader.stop()
+                assert wait_until(lambda: standby.is_leader, timeout=10), (
+                    "standby never took over mid-soak")
+                failed_over = True
+
+            def drain_laggards():
+                stuck = {}
+                for n in names[::5]:
+                    pname = f"{n}-worker-1"
+                    try:
+                        phase = submit.get_pod("default", pname).status.phase
+                    except Exception as exc:  # noqa: BLE001
+                        stuck[pname] = f"missing ({exc})"
+                        continue
+                    if phase == "Pending":
+                        submit.set_pod_phase("default", pname, "Running")
+                    elif phase == "Failed":
+                        stuck[pname] = "Failed (not yet replaced)"
+                return stuck
+
+            assert wait_until(lambda: not drain_laggards(), timeout=120), (
+                f"wave {wave_no} restarts stuck: {drain_laggards()}")
+            for n in names:
+                submit.set_pod_phase("default", f"{n}-worker-0", "Succeeded",
+                                     exit_code=0, container_name="tensorflow")
+            assert wait_until(
+                lambda: all(conds(n).get("Succeeded") == "True" for n in names),
+                timeout=120,
+            ), (f"wave {wave_no} lost jobs: "
+                + str({n: conds(n) for n in names
+                       if conds(n).get("Succeeded") != "True"}))
+            for n in names:
+                submit.delete_job("TFJob", "default", n)
+            assert wait_until(
+                lambda: not submit.list_pods("default"), timeout=60
+            ), "wave pods not cleaned up"
+
+            total += WAVE
+            wave_no += 1
+            gc.collect()
+            rss_samples.append(rss_mib())
+
+        elapsed = time.monotonic() - t_start
+        assert failed_over, "soak ended before the mid-run leader failover"
+        assert total >= MIN_JOBS
+
+        # --- RSS plateau: last third vs middle third.
+        k = len(rss_samples)
+        mid = rss_samples[k // 3: 2 * k // 3]
+        last = rss_samples[2 * k // 3:]
+        med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+        allowance = med(mid) * 0.15 + 20.0  # MiB: heap jitter, not a leak
+        with capsys.disabled():
+            print(f"\n[soak] {total} jobs / {wave_no} waves in {elapsed:.0f}s; "
+                  f"rss first={rss_samples[0]:.0f} mid-med={med(mid):.0f} "
+                  f"last-med={med(last):.0f} max={max(rss_samples):.0f} MiB")
+        assert med(last) <= med(mid) + allowance, (
+            f"RSS grows monotonically: mid {med(mid):.0f} -> last "
+            f"{med(last):.0f} MiB (samples {['%.0f' % r for r in rss_samples]})")
+
+        # --- Reconcile p90 (both replicas' histograms pooled) within 2x
+        # the scale-proof class: HTTP hops bound it at 0.25 s.
+        samples = []
+        for m in (metrics1, metrics2):
+            samples += m.histogram_values(
+                "training_operator_reconcile_duration_seconds", "default",
+                "TFJob")
+        assert samples, "no reconcile samples"
+        xs = sorted(samples)
+        p50 = xs[max(0, math.ceil(0.5 * len(xs)) - 1)]
+        p90 = xs[max(0, math.ceil(0.9 * len(xs)) - 1)]
+        with capsys.disabled():
+            print(f"[soak] reconcile p50={p50*1000:.1f}ms p90={p90*1000:.1f}ms "
+                  f"samples={len(xs)}")
+        assert p90 < 0.25, f"soak reconcile p90 {p90:.3f}s"
+    finally:
+        m1.stop()
+        m2.stop()
+        kube1.shutdown()
+        kube2.shutdown()

@@ -240,7 +240,59 @@ class InMemoryCluster(base.Cluster):
             # watch resuming from the object's last rv still sees it.
             job["metadata"]["resourceVersion"] = str(next(self._rv))
             self._publish_locked(kind, DELETED, job)
+            # Cascading GC, like a real apiserver's garbage collector: the
+            # operator stamps ownerReferences on everything it creates and
+            # relies on the cluster to reap them when the owner goes —
+            # without this, every deleted job leaked its terminal pods
+            # (the soak tier caught it as monotonic residency). The sweep
+            # reaps anything whose CONTROLLER owner uid matches no live
+            # job — not just this job's uid — so an orphan slipping past
+            # one cascade (a concurrent reconcile that read the job before
+            # its deletion can create a pod after this snapshot) is
+            # collected by the next deletion, mirroring the real GC's
+            # eventual reaping. Objects without a controller ref are never
+            # touched.
+            live_uids = {
+                (j.get("metadata") or {}).get("uid")
+                for j in self._jobs.values()
+            }
+
+            def dangling(refs) -> bool:
+                ctrl = [r for r in refs if getattr(r, "controller", False)
+                        and r.uid]
+                return bool(ctrl) and all(r.uid not in live_uids for r in ctrl)
+
+            owned_pods = [
+                k for k, p in self._pods.items()
+                if dangling(p.metadata.owner_references)
+            ]
+            owned_services = [
+                k for k, s in self._services.items()
+                if dangling(s.metadata.owner_references)
+            ]
+            owned_groups = [
+                k for k, g in self._pod_groups.items()
+                if (refs := (g.get("metadata") or {}).get("ownerReferences"))
+                and all(r.get("uid") not in live_uids
+                        for r in refs if r.get("controller"))
+                and any(r.get("controller") for r in refs)
+            ]
         self._drain_events()
+        for ns, pname in owned_pods:
+            try:
+                self.delete_pod(ns, pname)
+            except NotFound:
+                pass
+        for ns, sname in owned_services:
+            try:
+                self.delete_service(ns, sname)
+            except NotFound:
+                pass
+        for ns, gname in owned_groups:
+            try:
+                self.delete_pod_group(ns, gname)
+            except NotFound:
+                pass
 
     # ------------------------------------------------------------------ pods
     def create_pod(self, pod: Pod) -> Pod:

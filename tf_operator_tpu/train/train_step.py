@@ -97,12 +97,14 @@ CE_CHUNK = int(os.environ.get("TF_OPERATOR_CE_CHUNK", "512"))
 
 
 def chunked_cross_entropy(hidden, kernel, targets, chunk: int = CE_CHUNK,
-                          ignore_id: int = -1):
+                          ignore_id: int = -1, bias=None):
     """Next-token CE where the lm head is applied per sequence chunk under
     `lax.map`: the [b, s, vocab] fp32 logits tensor never exists whole in
     HBM (~3 GB at b=8/s=2k/32k vocab), only [b, chunk, vocab] at a time.
     The backward recomputes each chunk's logits from the (small) hidden —
-    one extra head matmul total, bought for gigabytes of peak memory."""
+    one extra head matmul total, bought for gigabytes of peak memory.
+    `bias` (fp32 [vocab], optional) supports tied-embedding heads that
+    carry one (BERT's MLM head); Llama-family heads pass none."""
     b, s, d = hidden.shape
     pad = (-s) % chunk
     if pad:
@@ -119,7 +121,10 @@ def chunked_cross_entropy(hidden, kernel, targets, chunk: int = CE_CHUNK,
     @jax.checkpoint
     def per_chunk(args):
         h, t = args
-        return _masked_nll(h @ kernel, t, ignore_id)
+        logits = h @ kernel
+        if bias is not None:
+            logits = logits + bias
+        return _masked_nll(logits, t, ignore_id)
 
     sums, counts = jax.lax.map(per_chunk, (hc, tc))
     return sums.sum() / jnp.maximum(counts.sum(), 1.0)
@@ -139,8 +144,15 @@ def loss_fn(model, params, tokens):
         hidden, mutated = model.apply(
             params, tokens[:, :-1], mutable=["losses"], return_hidden=True
         )
-        kernel = params["params"]["output"]["kernel"].astype(hidden.dtype)
-        loss = chunked_cross_entropy(hidden, kernel, tokens[:, 1:])
+        if hasattr(model, "head_kernel_and_bias"):
+            # Tied-embedding heads (Bert): the model knows where its head
+            # lives and whether it carries a bias.
+            kernel, bias = model.head_kernel_and_bias(params)
+            kernel = kernel.astype(hidden.dtype)
+        else:
+            kernel = params["params"]["output"]["kernel"].astype(hidden.dtype)
+            bias = None
+        loss = chunked_cross_entropy(hidden, kernel, tokens[:, 1:], bias=bias)
     else:
         logits, mutated = model.apply(params, tokens[:, :-1], mutable=["losses"])
         loss = cross_entropy_loss(logits, tokens[:, 1:])
