@@ -351,7 +351,7 @@ class TestJAXJobElasticResize:
             # collectives every step (~0.4 steps/s in the 4-proc world
             # under CI load) — 400 steps blew the Succeeded window.
             "--model", "llama-tiny", "--steps", "150", "--batch", "32",
-            "--seq", "32", "--checkpoint-every", "10", "--log-every", "50",
+            "--seq", "32", "--checkpoint-every", "5", "--log-every", "50",
             "--checkpoint-dir", ckpt_dir,
         ]
         harness.create_job({
@@ -373,7 +373,10 @@ class TestJAXJobElasticResize:
             return os.path.isdir(ckpt_dir) and any(
                 e.name.isdigit() for e in os.scandir(ckpt_dir))
 
-        assert wait_for(committed_checkpoint, timeout=300), (
+        # 600 s, not 300: under the CI DAG's 4-way parallelism, EIGHT
+        # llama-tiny processes compile concurrently with other tiers and
+        # the first committed checkpoint can take most of that.
+        assert wait_for(committed_checkpoint, timeout=600), (
             "8-proc world never committed a checkpoint")
         old_gens = {p.metadata.labels["world-generation"]
                     for p in harness.list_pods("default")}
@@ -391,7 +394,7 @@ class TestJAXJobElasticResize:
                     and all(p.metadata.labels["world-generation"] not in old_gens
                             for p in pods))
 
-        assert wait_for(shrunk_world_running, timeout=90), (
+        assert wait_for(shrunk_world_running, timeout=180), (
             [(p.metadata.name, p.status.phase)
              for p in harness.list_pods("default")])
         assert wait_for(
@@ -1108,7 +1111,7 @@ class TestGangFailureChaosEightProc:
                     return False
                 return any(e.name.isdigit() for e in os.scandir(ckpt_dir))
 
-            assert wait_for(committed_checkpoint, timeout=300), (
+            assert wait_for(committed_checkpoint, timeout=600), (
                 "no committed checkpoint before the kill")
             starts_before = {
                 n: cluster.get_pod("default", n).status.start_time for n in names
