@@ -212,7 +212,7 @@ class TestRealTPUJAXJobThroughOperator:
         # averages polluted by the first compile (~30 s through the
         # remote-compile tunnel) and by orbax saves streaming the full
         # state off-chip (~20 s each here), so they sit far below
-        # bench.py's 44.6k steady-state — but a CPU at seq 2048 trains
+        # bench.py's 45.2k steady-state — but a CPU at seq 2048 trains
         # llama-400m at <100 tokens/sec, so 1,000+ still proves the chip
         # (measured run: min-window 1.8k, best-window 11.4k).
         rates = [float(m.replace(",", ""))

@@ -330,30 +330,39 @@ class MoE(nn.Module):
         gate = gate / jnp.maximum(gate.sum(-1, keepdims=True), 1e-9)
 
         # Capacity assignment rank-major (all rank-0 choices win slots before
-        # any rank-1 choice), accumulating the [b, s, e, cap] dispatch and
-        # combine tensors one routing rank at a time — never materializing
-        # the k-times-larger [b, s, k, e, cap] intermediate. k is a static
-        # config constant, so the Python loop unrolls into one XLA graph.
-        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # [b, s, k, e]
-        dispatch = jnp.zeros((b, s, e, cap), jnp.float32)
-        combine = jnp.zeros((b, s, e, cap), jnp.float32)
-        taken = jnp.zeros((b, 1, e), jnp.float32)  # slots already claimed
+        # any rank-1 choice), accumulating the [b, s, e, cap] combine tensor
+        # one routing rank at a time — never materializing the k-times-larger
+        # [b, s, k, e, cap] intermediate. k is a static config constant, so
+        # the Python loop unrolls into one XLA graph. Slot arithmetic runs in
+        # int32 (a bf16 cumsum is only integer-exact to 256 — s is 2048) but
+        # every [b, s, e, cap]-shaped tensor is built directly in model
+        # dtype: at moe-125m these are ~170 MB EACH, and the fp32 originals
+        # plus their per-rank slot intermediates were the layer's largest
+        # HBM stream. The dispatch mask is derived from combine (> 0) rather
+        # than accumulated as a second chain — GShard's trick, halving the
+        # construction traffic; a gate underflowing to 0 in bf16 just drops
+        # that token to the residual path.
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.int32)  # [b, s, k, e]
+        combine = jnp.zeros((b, s, e, cap), cfg.dtype)
+        taken = jnp.zeros((b, 1, e), jnp.int32)  # slots already claimed
         for j in range(k):
             oh = onehot[:, :, j, :]  # [b, s, e]
             pos = jnp.cumsum(oh, axis=1) - oh + taken  # slot index per token
-            keep = (pos < cap).astype(jnp.float32) * oh
-            slot = jax.nn.one_hot(jnp.minimum(pos, cap - 1).astype(jnp.int32),
-                                  cap, dtype=jnp.float32)  # [b, s, e, cap]
-            dispatch = dispatch + keep[..., None] * slot
-            combine = combine + (keep * gate[:, :, j, None])[..., None] * slot
+            keep = ((pos < cap) & (oh > 0)).astype(cfg.dtype)
+            slot = jax.nn.one_hot(jnp.minimum(pos, cap - 1), cap,
+                                  dtype=cfg.dtype)  # [b, s, e, cap]
+            combine = combine + (
+                keep * gate[:, :, j, None].astype(cfg.dtype)
+            )[..., None] * slot
             taken = taken + oh.sum(axis=1, keepdims=True)
+        dispatch = (combine > 0).astype(cfg.dtype)
 
         # Dispatch: tokens -> per-expert slots. The constraint reshards the
         # expert dim onto ep (all-to-all); batch stays on the other data axes.
         # dispatch is a 0/1 mask (exactly representable in bf16), so the
         # largest routing contraction runs at full MXU rate in model dtype.
         expert_in = jnp.einsum(
-            "bsec,bsd->ebcd", dispatch.astype(cfg.dtype), x.astype(cfg.dtype)
+            "bsec,bsd->ebcd", dispatch, x.astype(cfg.dtype)
         )
         expert_in = constrain(expert_in, "ep", ("slice", "dp", "fsdp"), None, None)
 
@@ -366,12 +375,21 @@ class MoE(nn.Module):
         out = jnp.einsum("ebcf,efd->ebcd", nn.silu(gate_h) * up_h, w2.astype(cfg.dtype))
         out = constrain(out, "ep", ("slice", "dp", "fsdp"), None, None)
 
-        # Combine: weighted return all-to-all back to token layout.
-        y = jnp.einsum("bsec,ebcd->bsd", combine, out.astype(jnp.float32))
+        # Combine: weighted return all-to-all back to token layout. bf16
+        # operands / fp32 accumulation: a genuinely fp32 einsum here runs
+        # the MXU at a fraction of its bf16 rate, and the routing
+        # contraction (e*cap per output element) is the same magnitude as
+        # the dispatch one. The gate weights are O(1) softmax probs — a
+        # bf16 combine loses ~0.4% relative on them, standard for MoE
+        # training; the router itself stays fp32 above.
+        y = jnp.einsum(
+            "bsec,ebcd->bsd", combine, out,
+            preferred_element_type=jnp.float32,
+        )
 
         # Switch load-balance loss: e * Σ_i f_i·P_i (f = dispatch fraction,
         # P = mean router prob); minimized at uniform routing.
-        f_frac = onehot.sum(axis=2).mean(axis=(0, 1)) / k
+        f_frac = onehot.astype(jnp.float32).sum(axis=2).mean(axis=(0, 1)) / k
         p_mean = probs.mean(axis=(0, 1))
         aux = e * jnp.sum(f_frac * p_mean) * cfg.router_aux_weight
         self.sow("losses", "moe_aux", aux)
