@@ -394,6 +394,47 @@ class TestMoE:
         params = llama.init_params(model, jax.random.PRNGKey(0), batch=1, seq=8)
         assert set(params.keys()) == {"params"}
 
+    def test_gather_impl_matches_einsum(self):
+        """Differential oracle: the slot-indexed ("gather") routing must
+        produce the same logits AND gradients as the GShard one-hot
+        einsums from identical params — two independent formulations of
+        the same capacity assignment. (The einsum form ships: measured
+        faster on the MXU; see LlamaConfig.moe_impl.)"""
+        from tf_operator_tpu.parallel.mesh import current_mesh
+
+        # Guard against vacuity: under a scoped mesh with ep > 1 the
+        # gather model would silently fall back to einsum and this test
+        # would compare einsum against itself.
+        mesh = current_mesh()
+        assert mesh is None or int(mesh.shape.get("ep", 1)) == 1, (
+            "oracle must run without an ep axis or it tests nothing")
+        cfg_e = dataclasses.replace(llama.CONFIGS["moe-tiny"], max_seq_len=64)
+        cfg_g = dataclasses.replace(cfg_e, moe_impl="gather")
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (4, 64), 0, cfg_e.vocab_size)
+        m_e, m_g = llama.Llama(cfg_e), llama.Llama(cfg_g)
+        params = m_e.init(jax.random.PRNGKey(0), tokens)
+        out_e = m_e.apply(params, tokens).astype(jnp.float32)
+        out_g = m_g.apply(params, tokens).astype(jnp.float32)
+        # Tolerances are bf16-accumulation-sized (the two formulations
+        # fuse differently, so roundings drift ~1e-2 over the stack); a
+        # routing bug — wrong expert, wrong slot, dropped-token leak —
+        # shows up as O(1) divergence.
+        assert float(jnp.max(jnp.abs(out_e - out_g))) < 0.1
+
+        def loss_of(m):
+            def f(p):
+                return jnp.mean(m.apply(p, tokens).astype(jnp.float32) ** 2)
+            return f
+
+        g_e = jax.grad(loss_of(m_e))(params)
+        g_g = jax.grad(loss_of(m_g))(params)
+        for a, b in zip(jax.tree.leaves(g_e), jax.tree.leaves(g_g)):
+            # atol floors the comparison for near-zero-gradient leaves
+            # (bf16 noise dominates any relative measure there).
+            tol = 1e-5 + 0.1 * float(jnp.max(jnp.abs(a)))
+            assert float(jnp.max(jnp.abs(a - b))) < tol
+
     def test_aux_loss_sown_per_layer(self):
         config = llama.CONFIGS["moe-tiny"]
         model = llama.Llama(config)

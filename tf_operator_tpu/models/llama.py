@@ -60,6 +60,19 @@ class LlamaConfig:
     experts_per_token: int = 2
     capacity_factor: float = 1.25
     router_aux_weight: float = 0.01
+    # Token routing implementation. "einsum": GShard dense one-hot
+    # dispatch/combine matmuls — SPMD-clean (the expert-dim constrain
+    # lowers to the MoE all-to-all when `ep` is in the mesh) and the
+    # measured v5e winner despite paying ~e·cap·d uncounted MACs per
+    # token each way (BASELINE.md: the MXU burns through the one-hots
+    # faster than the memory system serves row-granular indexing).
+    # "gather": slot-indexed gathers/scatters moving the same data as
+    # bandwidth — measured SLOWER on the chip (32.1% vs 39.3% MFU at
+    # moe-125m) and kept as the independent differential-testing oracle
+    # for the routing algebra (tests/test_workload_tier.py TestMoE);
+    # indices must stay shard-local, so meshes with an `ep` axis fall
+    # back to einsum.
+    moe_impl: str = "einsum"
     # Microbatches per pipeline round when the mesh has a pp axis
     # (0 = one per stage). More microbatches shrink the GPipe bubble
     # ((pp-1)/(M+pp-1)) at the cost of smaller per-stage matmuls.
@@ -329,63 +342,131 @@ class MoE(nn.Module):
         gate, idx = jax.lax.top_k(probs, k)  # [b, s, k]
         gate = gate / jnp.maximum(gate.sum(-1, keepdims=True), 1e-9)
 
-        # Capacity assignment rank-major (all rank-0 choices win slots before
-        # any rank-1 choice), accumulating the [b, s, e, cap] combine tensor
-        # one routing rank at a time — never materializing the k-times-larger
-        # [b, s, k, e, cap] intermediate. k is a static config constant, so
-        # the Python loop unrolls into one XLA graph. Slot arithmetic runs in
-        # int32 (a bf16 cumsum is only integer-exact to 256 — s is 2048) but
-        # every [b, s, e, cap]-shaped tensor is built directly in model
-        # dtype: at moe-125m these are ~170 MB EACH, and the fp32 originals
-        # plus their per-rank slot intermediates were the layer's largest
-        # HBM stream. The dispatch mask is derived from combine (> 0) rather
-        # than accumulated as a second chain — GShard's trick, halving the
-        # construction traffic; a gate underflowing to 0 in bf16 just drops
-        # that token to the residual path.
+        # Capacity assignment rank-major (all rank-0 choices win slots
+        # before any rank-1 choice), one routing rank at a time — never
+        # materializing the k-times-larger [b, s, k, e, cap] intermediate.
+        # k is a static config constant, so the Python loop unrolls into
+        # one XLA graph. Slot arithmetic runs in int32 (a bf16 cumsum is
+        # only integer-exact to 256 — s is 2048). Shared by both routing
+        # implementations: per (token, rank) the chosen expert's slot
+        # index and whether it won one.
         onehot = jax.nn.one_hot(idx, e, dtype=jnp.int32)  # [b, s, k, e]
-        combine = jnp.zeros((b, s, e, cap), cfg.dtype)
         taken = jnp.zeros((b, 1, e), jnp.int32)  # slots already claimed
+        pos_ranks, keep_ranks = [], []
         for j in range(k):
             oh = onehot[:, :, j, :]  # [b, s, e]
             pos = jnp.cumsum(oh, axis=1) - oh + taken  # slot index per token
-            keep = ((pos < cap) & (oh > 0)).astype(cfg.dtype)
-            slot = jax.nn.one_hot(jnp.minimum(pos, cap - 1), cap,
-                                  dtype=cfg.dtype)  # [b, s, e, cap]
-            combine = combine + (
-                keep * gate[:, :, j, None].astype(cfg.dtype)
-            )[..., None] * slot
+            keep = (pos < cap) & (oh > 0)
+            pos_ranks.append(pos)
+            keep_ranks.append(keep)
             taken = taken + oh.sum(axis=1, keepdims=True)
-        dispatch = (combine > 0).astype(cfg.dtype)
 
-        # Dispatch: tokens -> per-expert slots. The constraint reshards the
-        # expert dim onto ep (all-to-all); batch stays on the other data axes.
-        # dispatch is a 0/1 mask (exactly representable in bf16), so the
-        # largest routing contraction runs at full MXU rate in model dtype.
-        expert_in = jnp.einsum(
-            "bsec,bsd->ebcd", dispatch, x.astype(cfg.dtype)
-        )
-        expert_in = constrain(expert_in, "ep", ("slice", "dp", "fsdp"), None, None)
+        from ..parallel.mesh import current_mesh
+
+        mesh = current_mesh()
+        ep = int(mesh.shape.get("ep", 1)) if mesh is not None else 1
+        use_gather = cfg.moe_impl == "gather" and ep == 1
 
         init = nn.initializers.normal(0.02)
         w1 = self.param("experts_w1", init, (e, d, cfg.ffn_dim), cfg.param_dtype)
         w3 = self.param("experts_w3", init, (e, d, cfg.ffn_dim), cfg.param_dtype)
         w2 = self.param("experts_w2", init, (e, cfg.ffn_dim, d), cfg.param_dtype)
-        gate_h = jnp.einsum("ebcd,edf->ebcf", expert_in, w1.astype(cfg.dtype))
-        up_h = jnp.einsum("ebcd,edf->ebcf", expert_in, w3.astype(cfg.dtype))
-        out = jnp.einsum("ebcf,efd->ebcd", nn.silu(gate_h) * up_h, w2.astype(cfg.dtype))
-        out = constrain(out, "ep", ("slice", "dp", "fsdp"), None, None)
 
-        # Combine: weighted return all-to-all back to token layout. bf16
-        # operands / fp32 accumulation: a genuinely fp32 einsum here runs
-        # the MXU at a fraction of its bf16 rate, and the routing
-        # contraction (e*cap per output element) is the same magnitude as
-        # the dispatch one. The gate weights are O(1) softmax probs — a
-        # bf16 combine loses ~0.4% relative on them, standard for MoE
-        # training; the router itself stays fp32 above.
-        y = jnp.einsum(
-            "bsec,ebcd->bsd", combine, out,
-            preferred_element_type=jnp.float32,
-        )
+        def expert_ffn(expert_in):  # [e, b, c, d] -> [e, b, c, d]
+            gate_h = jnp.einsum("ebcd,edf->ebcf", expert_in, w1.astype(cfg.dtype))
+            up_h = jnp.einsum("ebcd,edf->ebcf", expert_in, w3.astype(cfg.dtype))
+            return jnp.einsum(
+                "ebcf,efd->ebcd", nn.silu(gate_h) * up_h, w2.astype(cfg.dtype)
+            )
+
+        if use_gather:
+            # Slot-indexed routing (see moe_impl docstring: measured
+            # slower than the einsums on TPU; kept as the differential
+            # oracle for the routing algebra). Flat slot id per (token,
+            # rank): the chosen expert's slot, or the overflow bucket
+            # e*cap when the token lost the capacity race.
+            pos_c = jnp.stack([
+                jnp.take_along_axis(p, idx[:, :, j, None], axis=2)[..., 0]
+                for j, p in enumerate(pos_ranks)
+            ], axis=-1)  # [b, s, k]
+            keep_c = jnp.stack([
+                jnp.take_along_axis(kp, idx[:, :, j, None], axis=2)[..., 0]
+                for j, kp in enumerate(keep_ranks)
+            ], axis=-1)  # [b, s, k] bool
+            fslot = jnp.where(keep_c, idx * cap + pos_c, e * cap)  # [b, s, k]
+
+            def route_row(xb, fslot_b):
+                # xb [s, d]; fslot_b [s, k] -> [e*cap, d] (unfilled rows 0)
+                flat = fslot_b.reshape(-1)
+                token_of_slot = jnp.zeros((e * cap + 1,), jnp.int32).at[
+                    flat].set(jnp.repeat(jnp.arange(s, dtype=jnp.int32), k),
+                              mode="drop")
+                valid = jnp.zeros((e * cap + 1,), cfg.dtype).at[flat].set(
+                    1.0, mode="drop")
+                gathered = jnp.take(xb, token_of_slot[:-1], axis=0)
+                return gathered * valid[:-1, None]
+
+            expert_in_b = jax.vmap(route_row)(
+                x.astype(cfg.dtype), fslot
+            )  # [b, e*cap, d]
+            expert_in = expert_in_b.reshape(b, e, cap, d).transpose(1, 0, 2, 3)
+
+            out = expert_ffn(expert_in)  # [e, b, c, d]
+
+            # Combine: gather each (token, rank)'s slot output and weight
+            # by its gate; the overflow row is zeros so dropped tokens
+            # contribute nothing (residual passes them through).
+            out_flat = jnp.concatenate([
+                out.transpose(1, 0, 2, 3).reshape(b, e * cap, d),
+                jnp.zeros((b, 1, d), out.dtype),
+            ], axis=1)  # [b, e*cap+1, d]
+
+            def combine_row(out_b, fslot_b, gate_b):
+                contrib = jnp.take(out_b, fslot_b.reshape(-1), axis=0)
+                contrib = contrib.reshape(s, k, d).astype(jnp.float32)
+                return (contrib * gate_b[..., None]).sum(axis=1)
+
+            y = jax.vmap(combine_row)(out_flat, fslot, gate)
+        else:
+            # GShard dense-algebra routing: every [b, s, e, cap]-shaped
+            # tensor is built directly in model dtype (at moe-125m these
+            # are ~170 MB EACH in fp32), and the dispatch mask is derived
+            # from combine (> 0) rather than accumulated as a second
+            # chain — halving the construction traffic; a gate
+            # underflowing to 0 in bf16 just drops that token to the
+            # residual path.
+            combine = jnp.zeros((b, s, e, cap), cfg.dtype)
+            for j in range(k):
+                keep = keep_ranks[j].astype(cfg.dtype)
+                slot = jax.nn.one_hot(jnp.minimum(pos_ranks[j], cap - 1), cap,
+                                      dtype=cfg.dtype)  # [b, s, e, cap]
+                combine = combine + (
+                    keep * gate[:, :, j, None].astype(cfg.dtype)
+                )[..., None] * slot
+            dispatch = (combine > 0).astype(cfg.dtype)
+
+            # Dispatch: tokens -> per-expert slots. The constraint reshards
+            # the expert dim onto ep (all-to-all); batch stays on the other
+            # data axes. dispatch is a 0/1 mask (exactly representable in
+            # bf16), so the largest routing contraction runs at full MXU
+            # rate in model dtype.
+            expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, x.astype(cfg.dtype))
+            expert_in = constrain(
+                expert_in, "ep", ("slice", "dp", "fsdp"), None, None
+            )
+            out = expert_ffn(expert_in)
+            out = constrain(out, "ep", ("slice", "dp", "fsdp"), None, None)
+
+            # Combine: weighted return all-to-all back to token layout.
+            # bf16 operands / fp32 accumulation: a genuinely fp32 einsum
+            # here runs the MXU at a fraction of its bf16 rate. The gate
+            # weights are O(1) softmax probs — a bf16 combine loses ~0.4%
+            # relative on them, standard for MoE training; the router
+            # itself stays fp32 above.
+            y = jnp.einsum(
+                "bsec,ebcd->bsd", combine, out,
+                preferred_element_type=jnp.float32,
+            )
 
         # Switch load-balance loss: e * Σ_i f_i·P_i (f = dispatch fraction,
         # P = mean router prob); minimized at uniform routing.
