@@ -4,7 +4,7 @@
 IMG ?= tf-operator-tpu:latest
 PY ?= python
 
-.PHONY: all test unit e2e manifests run docker-build deploy bench dryrun
+.PHONY: all test unit e2e chaos manifests run docker-build deploy bench dryrun
 
 all: test
 
@@ -19,6 +19,9 @@ unit:            ## fast tier only
 
 e2e:             ## process-backed e2e tier
 	$(PY) -m pytest tests/test_e2e_process.py -q
+
+chaos:           ## seeded fault-injection tier incl. the randomized sweep
+	$(PY) -m pytest tests/test_chaos.py tests/test_disruption.py -q
 
 manifests:       ## regenerate CRDs + operator deployment from the API dataclasses
 	$(PY) -m tf_operator_tpu.manifests --out manifests

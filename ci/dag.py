@@ -237,6 +237,21 @@ def default_dag() -> List[Step]:
         # aggressive resync; retried because timing-sensitive by nature.
         Step("concurrency-stress", pytest + ["tests/test_concurrency_stress.py"],
              deps=["operator-integration"], retries=2),
+        # Seeded chaos tier (docs/design/disruption_handling.md): the
+        # controllers under deterministic fault schedules — write
+        # conflicts/errors, watch drops, slice-host preemptions — with
+        # FIXED seeds so a red run replays locally from the seed alone.
+        # The long randomized sweep stays behind `-m slow` (tier-1 speed);
+        # retried like the other timing-sensitive tiers (the rate-limited
+        # retry waits are wall-clock-coupled under parallel CI load).
+        Step("chaos-seeded",
+             pytest + ["tests/test_chaos.py", "tests/test_disruption.py",
+                       "-m", "not slow"],
+             deps=["operator-integration"], retries=2),
+        # The full randomized sweep, serialized after the fixed seeds.
+        Step("chaos-sweep",
+             pytest + ["tests/test_chaos.py", "-m", "slow"],
+             deps=["chaos-seeded"], retries=2),
         # Residency under sustained churn (VERDICT r4 #6): ~10 min of
         # create/churn/succeed/delete waves over the HTTP backend with two
         # leader-elected replicas; asserts the RSS plateau, reconcile p90,

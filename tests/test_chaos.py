@@ -1,0 +1,380 @@
+"""Seeded chaos tier: the JAX/TF controllers driven to convergence under
+deterministic fault schedules (cluster/chaos.py), asserting the invariants
+every robustness claim in this repo rests on:
+
+- the job reaches Succeeded, or Failed with the CORRECT cause;
+- no orphaned pods/services/pod-groups once the job is gone;
+- expectations never wedge past their timeout (and the timeout is counted);
+- backoffLimit is never burned by infrastructure disruptions;
+- the same seed reproduces the same fault schedule byte-for-byte.
+
+Tier-1 runs the fixed-seed cases below; the randomized multi-seed sweep is
+`-m slow` (ci/dag.py runs the fixed seeds with retries like the other
+timing-sensitive tiers).
+"""
+
+import time
+
+import pytest
+
+from tf_operator_tpu.api.k8s import POD_FAILED, POD_PENDING, POD_RUNNING
+from tf_operator_tpu.cluster.chaos import (
+    ChaosCluster,
+    ChaosSpec,
+    ScheduledPreemption,
+)
+from tf_operator_tpu.cluster.memory import InMemoryCluster
+from tf_operator_tpu.controllers.jax import JAXController
+from tf_operator_tpu.controllers.tensorflow import TFController
+from tf_operator_tpu.core import expectations as expmod
+from tf_operator_tpu.metrics import Metrics
+
+
+def container(name):
+    return {"name": name, "image": "test:1"}
+
+
+def jax_manifest(name="llama", workers=4, run_policy=None):
+    spec = {
+        "jaxReplicaSpecs": {
+            "Worker": {
+                "replicas": workers,
+                "template": {"spec": {"containers": [container("jax")]}},
+            }
+        },
+    }
+    if run_policy:
+        spec["runPolicy"] = run_policy
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "JAXJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": spec,
+    }
+
+
+def tfjob_manifest(name="tj", workers=2):
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "TFJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "tfReplicaSpecs": {
+                "Worker": {
+                    "replicas": workers,
+                    "restartPolicy": "ExitCode",
+                    "template": {
+                        "spec": {"containers": [container("tensorflow")]}
+                    },
+                }
+            }
+        },
+    }
+
+
+def conds_of(cluster, kind, name):
+    job = cluster.get_job(kind, "default", name)
+    return {c["type"]: c for c in (job.get("status") or {}).get("conditions") or []}
+
+
+def pump(controller, inner, kind, name, done, rounds=400, drive=None):
+    """Synchronous chaos driver: drain the queue, let the sim kubelet act,
+    re-enqueue (the resync analog — chaos drops/errors mean watch delivery
+    alone cannot be relied on), until `done()` or the round budget ends.
+    Deterministic given deterministic `drive`."""
+    for _ in range(rounds):
+        controller.run_until_idle()
+        if done():
+            return True
+        if drive is not None:
+            drive()
+        controller.queue.add(f"{kind}:default/{name}")
+        # Let rate-limited retries (injected write errors) come due.
+        time.sleep(0.002)
+    controller.run_until_idle()
+    return done()
+
+
+def assert_no_orphans(inner, controller, kind, name):
+    """Terminal hygiene: once the job object is deleted, nothing it owned
+    may remain — pods, services, or pod groups."""
+    try:
+        inner.delete_job(kind, "default", name)
+    except KeyError:
+        pass
+    controller.run_until_idle()
+    assert inner.list_pods("default") == [], "orphaned pods"
+    assert inner.list_services("default") == [], "orphaned services"
+    assert inner.list_pod_groups("default") == [], "orphaned pod groups"
+
+
+def run_slice_preemption(seed):
+    """One seeded run of the acceptance scenario: conflicts + watch drops
+    active throughout; an entire simulated slice host's pods preempted
+    mid-training; the job must gang-restart budget-free and complete.
+    Returns everything the assertions (and the determinism check) need."""
+    inner = InMemoryCluster()
+    chaos = ChaosCluster(inner, ChaosSpec(
+        seed=seed,
+        conflict_rate=0.05,
+        drop_watch_rate=0.05,
+        drop_watch_kinds=("JAXJob",),  # job events; the resync pump recovers
+    ))
+    metrics = Metrics()
+    controller = JAXController(chaos, metrics=metrics)
+    # backoffLimit 0: ANY application-classified restart would fail the job
+    # instantly — the strongest possible proof the preemption recovery
+    # never touches that budget.
+    inner.create_job(jax_manifest(run_policy={"backoffLimit": 0}))
+
+    state = {"preempted": False, "finished": False}
+
+    def drive():
+        pods = inner.list_pods("default")
+        pending = [p for p in pods if p.status.phase == POD_PENDING]
+        running = [p for p in pods if p.status.phase == POD_RUNNING]
+        for p in pending:
+            inner.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+        if not state["preempted"] and len(running) == 4:
+            # Mid-training: the whole simulated slice host goes away in
+            # one batch (maintenance event), via the seeded proxy.
+            chaos.preempt_pods(
+                namespace="default",
+                labels={"job-name": "llama", "replica-type": "worker"},
+                reason="Preempted",
+            )
+            state["preempted"] = True
+        elif state["preempted"] and len(running) == 4:
+            # The recreated world ran to its final step: clean exit.
+            for p in running:
+                inner.set_pod_phase(
+                    "default", p.metadata.name, "Succeeded", exit_code=0,
+                )
+            state["finished"] = True
+
+    converged = pump(
+        controller, inner, "JAXJob", "llama",
+        done=lambda: state["finished"]
+        and conds_of(inner, "JAXJob", "llama").get("Succeeded", {}).get("status")
+        == "True",
+        drive=drive,
+    )
+    job = inner.get_job("JAXJob", "default", "llama")
+    events = [e.reason for e in inner.list_events()]
+    return {
+        "converged": converged,
+        "fault_log": list(chaos.fault_log),
+        "status": job.get("status") or {},
+        "events": events,
+        "by_cause": metrics.labeled_counter_value(
+            "training_operator_jobs_restarted_by_cause_total",
+            "default", "JAXJob", "InfrastructureDisruption",
+        ),
+        "inner": inner,
+        "controller": controller,
+    }
+
+
+class TestSeededSlicePreemption:
+    def test_preempted_slice_host_recovers_budget_free(self):
+        """The acceptance scenario (ISSUE 1): an entire simulated slice
+        host preempted mid-training gang-restarts the job WITHOUT
+        consuming backoffLimit, and the cause lands in conditions, events,
+        and metrics."""
+        out = run_slice_preemption(seed=42)
+        assert out["converged"], (out["status"], out["fault_log"][-10:])
+        status = out["status"]
+        conds = {c["type"]: c for c in status["conditions"]}
+        assert conds["Succeeded"]["status"] == "True"
+        # Budget-free: the whole-slice preemption drew ONLY the disruption
+        # ledger; with backoffLimit 0, any leak would have failed the job.
+        assert status["disruptionCounts"] == {"Worker": 1}
+        assert "restartCounts" not in status
+        # Cause surfaced in events and metrics (the Restarting condition
+        # carried it mid-incident — asserted in test_disruption.py — and
+        # is dropped once Running returns, per the status-machine
+        # invariants).
+        assert "JAXJobDisruptionRestarting" in out["events"]
+        assert out["by_cause"] == 1
+        # The schedule recorded the batch kill of the full slice host.
+        preempts = [f for f in out["fault_log"] if f.startswith("preempt:")]
+        assert len(preempts) == 4
+        # Terminal hygiene: nothing owned survives the job.
+        assert_no_orphans(out["inner"], out["controller"], "JAXJob", "llama")
+
+    def test_same_seed_reproduces_fault_schedule_byte_for_byte(self):
+        a = run_slice_preemption(seed=1234)
+        b = run_slice_preemption(seed=1234)
+        assert a["converged"] and b["converged"]
+        assert a["fault_log"] == b["fault_log"]
+        assert a["fault_log"], "the seeded run must have injected faults"
+
+    def test_different_seed_different_schedule(self):
+        a = run_slice_preemption(seed=1)
+        b = run_slice_preemption(seed=2)
+        # Same operation sequence, different seed: the injected fault
+        # positions must differ (rates are low but nonzero, so schedules
+        # diverging is the overwhelmingly likely signature; identical logs
+        # would mean the seed is ignored).
+        faults_a = [f for f in a["fault_log"] if not f.startswith("preempt:")]
+        faults_b = [f for f in b["fault_log"] if not f.startswith("preempt:")]
+        assert faults_a != faults_b
+
+
+class TestScheduledPreemption:
+    def test_write_clock_preemption_fires_once_and_recovers(self):
+        """A preemption planted in the plan itself (after N writes — here
+        mid-creation, the nastiest window: the gang is still coming up)
+        fires exactly once; the controller still converges the job."""
+        inner = InMemoryCluster()
+        chaos = ChaosCluster(inner, ChaosSpec(
+            seed=7,
+            preemptions=(
+                ScheduledPreemption(
+                    after_writes=6,
+                    namespace="default",
+                    labels={"job-name": "llama", "replica-type": "worker"},
+                ),
+            ),
+        ))
+        controller = JAXController(chaos)
+        inner.create_job(jax_manifest(run_policy={"backoffLimit": 0}))
+
+        def drive():
+            for p in inner.list_pods("default"):
+                if p.status.phase == POD_PENDING:
+                    inner.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+
+        def all_running():
+            pods = inner.list_pods("default")
+            return len(pods) == 4 and all(
+                p.status.phase == POD_RUNNING for p in pods
+            )
+
+        assert pump(controller, inner, "JAXJob", "llama", all_running, drive=drive)
+        preempts = [f for f in chaos.fault_log if f.startswith("preempt:")]
+        assert preempts, "the scheduled preemption never fired"
+        conds = conds_of(inner, "JAXJob", "llama")
+        assert conds.get("Failed", {}).get("status") != "True"
+        job = inner.get_job("JAXJob", "default", "llama")
+        assert "restartCounts" not in job["status"]
+
+
+class TestWriteFaultConvergence:
+    def test_conflicts_errors_latency_converge_clean(self):
+        """A TFJob lifecycle under injected write conflicts, transient
+        server errors, and latency: the rate-limited queue absorbs every
+        fault, the job completes, slots stay unique, and nothing leaks."""
+        inner = InMemoryCluster()
+        chaos = ChaosCluster(inner, ChaosSpec(
+            seed=99,
+            conflict_rate=0.10,
+            error_rate=0.10,
+            latency_rate=0.2,
+            latency_seconds=0.001,
+        ))
+        controller = TFController(chaos)
+        inner.create_job(tfjob_manifest(workers=2))
+
+        def drive():
+            pods = inner.list_pods("default")
+            for p in pods:
+                if p.status.phase == POD_PENDING:
+                    inner.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+            if len(pods) == 2 and all(
+                p.status.phase == POD_RUNNING for p in pods
+            ):
+                inner.set_pod_phase(
+                    "default", "tj-worker-0", "Succeeded", exit_code=0,
+                )
+
+        assert pump(
+            controller, inner, "TFJob", "tj",
+            done=lambda: conds_of(inner, "TFJob", "tj").get("Succeeded", {}).get(
+                "status"
+            ) == "True",
+            drive=drive,
+        ), (conds_of(inner, "TFJob", "tj"), chaos.fault_log[-10:])
+        # Chaos actually bit: injected faults are on the record.
+        assert any(":error" in f or ":conflict" in f for f in chaos.fault_log)
+        # Slot uniqueness survived the retries (no expectation-race dupes).
+        pods = inner.list_pods("default")
+        slots = {
+            (p.metadata.labels["job-name"], p.metadata.labels["replica-index"])
+            for p in pods
+        }
+        assert len(slots) == len(pods)
+        assert_no_orphans(inner, controller, "TFJob", "tj")
+
+
+class TestWatchDropRecovery:
+    def test_dropped_pod_events_surface_timeouts_not_wedges(self, monkeypatch):
+        """Dropped pod watch events starve the expectations cache; with
+        the (shortened) expiry the job must SELF-HEAL — and the incident
+        must be visible in the timeout counter instead of silent."""
+        monkeypatch.setattr(expmod, "EXPECTATION_TIMEOUT_SECONDS", 0.05)
+        inner = InMemoryCluster()
+        chaos = ChaosCluster(inner, ChaosSpec(
+            seed=3,
+            drop_watch_rate=0.5,
+            drop_watch_kinds=("pods",),
+        ))
+        metrics = Metrics()
+        controller = TFController(chaos, metrics=metrics)
+        inner.create_job(tfjob_manifest(workers=3))
+
+        def drive():
+            for p in inner.list_pods("default"):
+                if p.status.phase == POD_PENDING:
+                    inner.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+            pods = inner.list_pods("default")
+            if len(pods) == 3 and all(
+                p.status.phase == POD_RUNNING for p in pods
+            ):
+                inner.set_pod_phase(
+                    "default", "tj-worker-0", "Succeeded", exit_code=0,
+                )
+
+        assert pump(
+            controller, inner, "TFJob", "tj",
+            done=lambda: conds_of(inner, "TFJob", "tj").get("Succeeded", {}).get(
+                "status"
+            ) == "True",
+            drive=drive,
+        ), conds_of(inner, "TFJob", "tj")
+        dropped = [f for f in chaos.fault_log if ":drop:" in f]
+        assert dropped, "seed 3 must drop pod events for this test to bite"
+        # Dropped ADDED events starved expectations -> counted timeouts.
+        assert metrics.labeled_counter_value(
+            "training_operator_expectation_timeouts_total",
+            "default", "TFJob", "pods",
+        ) >= 1
+        assert any(
+            e.reason == "ExpectationTimeout" for e in inner.list_events()
+        )
+
+
+@pytest.mark.slow
+class TestRandomizedSweep:
+    """Long randomized sweep (chaos CI keeps tier-1 on the fixed seeds
+    above; this runs under `-m slow`): many seeds, mixed fault classes,
+    same invariants every time."""
+
+    @pytest.mark.parametrize("seed", range(20))
+    def test_invariants_hold_across_seeds(self, seed):
+        out = run_slice_preemption(seed=1000 + seed)
+        assert out["converged"], (seed, out["status"], out["fault_log"][-10:])
+        status = out["status"]
+        conds = {c["type"]: c for c in status["conditions"]}
+        assert conds["Succeeded"]["status"] == "True"
+        assert "restartCounts" not in status, (
+            "disruption leaked into backoffLimit accounting")
+        # The disruption ledger normally shows the one restart; an injected
+        # Conflict on the post-teardown status write can lose the increment
+        # (the same exposure restartCounts has always had) — under-counting
+        # is the conservative direction for a budget, so the invariant is
+        # "never MORE than the physical restarts, never on backoffLimit".
+        assert status.get("disruptionCounts", {}).get("Worker", 0) <= 1
+        assert_no_orphans(
+            out["inner"], out["controller"], "JAXJob", "llama"
+        )

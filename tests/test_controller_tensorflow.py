@@ -305,7 +305,9 @@ class TestRestartPolicies:
         # restart recorded, and the job still live.
         assert "Failed" not in conds
         assert any(p.metadata.name == "test-tfjob-worker-1" for p in cluster.list_pods())
-        assert any(e.reason == "TFJobRestarting" for e in cluster.list_events())
+        # SIGKILL beside healthy peers classifies as a disruption (budget-
+        # free preemption recovery), so the event reason carries the cause.
+        assert any(e.reason == "TFJobDisruptionRestarting" for e in cluster.list_events())
         cluster.set_pod_phase("default", "test-tfjob-worker-1", POD_RUNNING)
         controller.run_until_idle()
         job = cluster.get_job("TFJob", "default", "test-tfjob")
@@ -582,8 +584,10 @@ class TestStatusEdgeMatrix:
 
     def test_backoff_limit_zero_fails_on_first_retryable_exit(self, env):
         """backoffLimit: 0 leaves no restart budget: even a retryable exit
-        code (137) must fail the job instead of restarting
-        (reference status.go:88-92 backoff accounting)."""
+        code (130 = SIGINT, application-class) must fail the job instead of
+        restarting (reference status.go:88-92 backoff accounting).
+        SIGKILL-class codes (137/143) are exercised separately — they draw
+        from the disruption budget, not backoffLimit."""
         cluster, controller = env
         cluster.create_job(tfjob_manifest(
             worker=1, restart_policy="ExitCode", backoff_limit=0,
@@ -591,7 +595,7 @@ class TestStatusEdgeMatrix:
         controller.run_until_idle()
         cluster.set_pod_phase(
             "default", "test-tfjob-worker-0", POD_FAILED,
-            exit_code=137, restart_count=1,
+            exit_code=130, restart_count=1,
         )
         controller.run_until_idle()
         job = cluster.get_job("TFJob", "default", "test-tfjob")
@@ -647,7 +651,7 @@ class TestStatusEdgeMatrix:
         manifest = tfjob_manifest(worker=1, restart_policy="ExitCode", backoff_limit=3)
         job = create_and_sync(cluster, controller, manifest)
         for _ in range(2):  # consume most of the budget (3rd restart would fail)
-            cluster.set_pod_phase("default", "test-tfjob-worker-0", POD_FAILED, exit_code=137)
+            cluster.set_pod_phase("default", "test-tfjob-worker-0", POD_FAILED, exit_code=130)
             controller.run_until_idle()
         job = cluster.get_job("TFJob", "default", "test-tfjob")
         assert sum(job["status"].get("restartCounts", {}).values()) == 2
@@ -662,7 +666,7 @@ class TestStatusEdgeMatrix:
         job = cluster.get_job("TFJob", "default", "test-tfjob")
         assert job["status"].get("restartCounts", {}) in ({}, None)
         # A retryable failure after resume restarts instead of failing.
-        cluster.set_pod_phase("default", "test-tfjob-worker-0", POD_FAILED, exit_code=137)
+        cluster.set_pod_phase("default", "test-tfjob-worker-0", POD_FAILED, exit_code=130)
         controller.run_until_idle()
         job = cluster.get_job("TFJob", "default", "test-tfjob")
         conds = {c["type"]: c for c in job["status"]["conditions"]}

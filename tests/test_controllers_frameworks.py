@@ -307,7 +307,8 @@ class TestJAXController:
         assert "Failed" not in conds
         assert any(p.metadata.name == "llama-worker-2" for p in self.cluster.list_pods())
         events = {e.reason for e in self.cluster.list_events()}
-        assert "JAXJobRestarting" in events
+        # SIGKILL on a healthy gang = preemption: cause-labeled reason.
+        assert "JAXJobDisruptionRestarting" in events
 
     def test_retryable_failure_restarts_whole_gang(self):
         """SPMD gang restart: ONE preempted worker (exit 137) takes all
@@ -333,9 +334,11 @@ class TestJAXController:
         job = self.cluster.get_job("JAXJob", "default", "llama")
         conds = {c["type"]: c for c in job["status"]["conditions"]}
         assert "Failed" not in conds or conds["Failed"]["status"] != "True"
-        assert job["status"]["restartCounts"] == {"Worker": 1}
+        assert job["status"]["disruptionCounts"] == {"Worker": 1}
+        assert "restartCounts" not in job["status"], (
+            "a preemption must not burn backoffLimit")
         events = [e.reason for e in self.cluster.list_events()]
-        assert "JAXJobRestarting" in events
+        assert "JAXJobDisruptionRestarting" in events
 
     def test_gang_restart_recreates_succeeded_coordinator(self):
         """Recreate-ALL semantics: worker-0 (the jax.distributed
@@ -555,7 +558,7 @@ class TestJAXController:
                  for p in self.cluster.list_pods() if "-worker-" in p.metadata.name}
         assert after == worker_uids, "evaluator failure must not restart the gang"
         job = self.cluster.get_job("JAXJob", "default", "llama")
-        assert job["status"]["restartCounts"] == {"Evaluator": 1}
+        assert job["status"]["disruptionCounts"] == {"Evaluator": 1}
         # All workers succeed while the evaluator still runs: job Succeeded.
         for name in worker_uids:
             self.cluster.set_pod_phase("default", name, POD_SUCCEEDED, exit_code=0)
@@ -585,7 +588,7 @@ class TestJAXController:
             else:
                 assert after[name] == uids[name], "evaluator must survive"
         job = self.cluster.get_job("JAXJob", "default", "llama")
-        assert job["status"]["restartCounts"] == {"Worker": 1}
+        assert job["status"]["disruptionCounts"] == {"Worker": 1}
 
     def test_evaluator_share_not_reserved_in_every_slice_gang(self):
         """Round-robin evaluator placement means slice s's exact auxiliary
@@ -662,7 +665,7 @@ class TestJAXController:
         self.controller.run_until_idle()
         self.controller.run_until_idle()
         job = self.cluster.get_job("JAXJob", "default", "llama")
-        assert job["status"]["restartCounts"] == {"Worker": 1}
+        assert job["status"]["disruptionCounts"] == {"Worker": 1}
         # Survivors were torn down (and their indices recreated); the
         # externally-deleted pod itself stays Terminating (test hook holds
         # it, as a kubelet grace period would) and is never re-deleted.
@@ -694,7 +697,7 @@ class TestJAXController:
         for _ in range(4):
             self.controller.run_until_idle()
         job = self.cluster.get_job("JAXJob", "default", "llama")
-        assert job["status"]["restartCounts"] == {"Worker": 1}
+        assert job["status"]["disruptionCounts"] == {"Worker": 1}
         # Grace periods end; the full world must settle recreated, still
         # at one counted restart.
         self.cluster.delete_pod("default", "llama-worker-1")
@@ -702,7 +705,7 @@ class TestJAXController:
         self.controller.run_until_idle()
         assert len(self.cluster.list_pods()) == 4
         job = self.cluster.get_job("JAXJob", "default", "llama")
-        assert job["status"]["restartCounts"] == {"Worker": 1}
+        assert job["status"]["disruptionCounts"] == {"Worker": 1}
         conds = {c["type"]: c for c in job["status"]["conditions"]}
         assert conds.get("Failed", {}).get("status") != "True"
 
@@ -744,7 +747,7 @@ class TestJAXController:
         assert all(pods[n] != uids_before[n] for n in pods), (
             "every gang member must be replaced despite the transient error")
         job = self.cluster.get_job("JAXJob", "default", "llama")
-        assert job["status"]["restartCounts"] == {"Worker": 1}
+        assert job["status"]["disruptionCounts"] == {"Worker": 1}
         conds = {c["type"]: c for c in job["status"]["conditions"]}
         assert conds.get("Failed", {}).get("status") != "True"
 

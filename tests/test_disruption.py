@@ -1,0 +1,582 @@
+"""Disruption-aware gang recovery: failure-cause classification
+(DisruptionTarget / Evicted / SIGKILL-class exits vs application crashes),
+the budget split (backoffLimit vs maxDisruptionRetries), jittered
+exponential restart backoff, terminating-trigger edges of the gang
+restart-cause machine, expectation-timeout observability, and best-effort
+event recording. Design: docs/design/disruption_handling.md.
+"""
+
+import time
+
+import pytest
+
+from tf_operator_tpu.api import common as capi
+from tf_operator_tpu.api.k8s import (
+    POD_FAILED,
+    POD_PENDING,
+    POD_RUNNING,
+    Pod,
+    PodCondition,
+)
+from tf_operator_tpu.cluster.chaos import ChaosCluster, ChaosSpec
+from tf_operator_tpu.cluster.memory import InMemoryCluster
+from tf_operator_tpu.controllers.jax import JAXController
+from tf_operator_tpu.controllers.tensorflow import TFController
+from tf_operator_tpu.core import expectations as expmod
+from tf_operator_tpu.core.expectations import ControllerExpectations
+from tf_operator_tpu.core.job_controller import disruption_backoff_seconds
+from tf_operator_tpu.metrics import Metrics
+
+
+def container(name):
+    return {"name": name, "image": "test:1"}
+
+
+def jax_manifest(name="llama", workers=4, run_policy=None):
+    spec = {
+        "jaxReplicaSpecs": {
+            "Worker": {
+                "replicas": workers,
+                "template": {"spec": {"containers": [container("jax")]}},
+            }
+        },
+    }
+    if run_policy:
+        spec["runPolicy"] = run_policy
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "JAXJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": spec,
+    }
+
+
+def tfjob_manifest(name="tj", workers=1, run_policy=None):
+    spec = {
+        "tfReplicaSpecs": {
+            "Worker": {
+                "replicas": workers,
+                "restartPolicy": "ExitCode",
+                "template": {"spec": {"containers": [container("tensorflow")]}},
+            }
+        },
+    }
+    if run_policy:
+        spec["runPolicy"] = run_policy
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "TFJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": spec,
+    }
+
+
+def conds_of(cluster, kind, name):
+    job = cluster.get_job(kind, "default", name)
+    return {c["type"]: c for c in (job.get("status") or {}).get("conditions") or []}
+
+
+class TestClassification:
+    def test_sigkill_class_codes(self):
+        assert capi.is_sigkill_class_exit_code(137)
+        assert capi.is_sigkill_class_exit_code(143)
+        assert not capi.is_sigkill_class_exit_code(130)  # SIGINT: app-class
+        assert not capi.is_sigkill_class_exit_code(139)  # SIGSEGV: a crash
+        assert not capi.is_sigkill_class_exit_code(1)
+
+    def test_disruption_target_condition_wins(self):
+        pod = Pod()
+        pod.status.conditions.append(
+            PodCondition(type="DisruptionTarget", status="True", reason="PreemptionByScheduler")
+        )
+        assert capi.pod_disruption_signal(pod) == "PreemptionByScheduler"
+        # Even a permanent-looking exit code defers to the explicit marker.
+        assert capi.classify_pod_failure(pod, 130) == capi.RESTART_CAUSE_DISRUPTION
+
+    def test_status_reason_evicted(self):
+        pod = Pod()
+        pod.status.reason = "Evicted"
+        assert capi.pod_disruption_signal(pod) == "Evicted"
+
+    def test_oom_killed_container_is_application_failure(self):
+        """cgroup OOMKill: exit 137, terminated reason 'OOMKilled' — the
+        workload blew ITS OWN memory limit. Must draw backoffLimit, or a
+        leaking trainer crash-loops budget-free forever."""
+        from tf_operator_tpu.api.k8s import (
+            ContainerState,
+            ContainerStateTerminated,
+            ContainerStatus,
+        )
+
+        pod = Pod()
+        pod.status.container_statuses = [
+            ContainerStatus(
+                name="jax",
+                state=ContainerState(
+                    terminated=ContainerStateTerminated(
+                        exit_code=137, reason="OOMKilled"
+                    )
+                ),
+            )
+        ]
+        assert (
+            capi.classify_pod_failure(pod, 137, peers_healthy=True)
+            == capi.RESTART_CAUSE_APPLICATION
+        )
+        # An explicit DisruptionTarget still wins (the eviction API OOM-
+        # scoring a NODE-pressure kill stamps the condition).
+        pod.status.conditions.append(
+            PodCondition(type="DisruptionTarget", status="True", reason="TerminationByKubelet")
+        )
+        assert (
+            capi.classify_pod_failure(pod, 137) == capi.RESTART_CAUSE_DISRUPTION
+        )
+
+    def test_bare_sigkill_needs_healthy_peers(self):
+        pod = Pod()
+        assert (
+            capi.classify_pod_failure(pod, 137, peers_healthy=True)
+            == capi.RESTART_CAUSE_DISRUPTION
+        )
+        assert (
+            capi.classify_pod_failure(pod, 137, peers_healthy=False)
+            == capi.RESTART_CAUSE_APPLICATION
+        )
+        # Self-inflicted retryable crashes stay application-class.
+        assert (
+            capi.classify_pod_failure(pod, 139, peers_healthy=True)
+            == capi.RESTART_CAUSE_APPLICATION
+        )
+
+
+class TestDisruptionBudget:
+    def setup_method(self):
+        self.cluster = InMemoryCluster()
+        self.metrics = Metrics()
+        self.controller = JAXController(self.cluster, metrics=self.metrics)
+
+    def start(self, manifest):
+        self.cluster.create_job(manifest)
+        self.controller.run_until_idle()
+        for p in self.cluster.list_pods():
+            self.cluster.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+        self.controller.run_until_idle()
+
+    def test_evicted_gang_restart_draws_disruption_budget(self):
+        """A DisruptionTarget-marked kill gang-restarts the job on the
+        disruption ledger: backoffLimit untouched, cause in the condition
+        reason, the event stream, and the by-cause metric."""
+        self.start(jax_manifest(run_policy={"backoffLimit": 1}))
+        self.cluster.set_pod_phase(
+            "default", "llama-worker-2", POD_FAILED,
+            exit_code=137, disruption_target="PreemptionByScheduler",
+        )
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        assert job["status"]["disruptionCounts"] == {"Worker": 1}
+        assert "restartCounts" not in job["status"]
+        conds = conds_of(self.cluster, "JAXJob", "llama")
+        assert conds["Restarting"]["reason"] == "JAXJobDisruptionRestarting"
+        assert any(
+            e.reason == "JAXJobDisruptionRestarting"
+            for e in self.cluster.list_events()
+        )
+        assert self.metrics.labeled_counter_value(
+            "training_operator_jobs_restarted_by_cause_total",
+            "default", "JAXJob", capi.RESTART_CAUSE_DISRUPTION,
+        ) == 1
+        # The whole gang was replaced and the job is alive.
+        assert len(self.cluster.list_pods()) == 4
+        assert conds.get("Failed", {}).get("status") != "True"
+
+    def test_disruptions_never_burn_backoff_limit(self):
+        """backoffLimit 1 + two preemptions: still alive. Then ONE
+        application-class retryable failure consumes the backoff budget
+        and the job fails with BackoffLimitExceeded — proving the two
+        ledgers are disjoint."""
+        self.start(jax_manifest(run_policy={"backoffLimit": 1}))
+        for round_ in range(2):
+            for p in self.cluster.list_pods():
+                self.cluster.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+            self.controller.run_until_idle()
+            self.cluster.set_pod_phase(
+                "default", "llama-worker-1", POD_FAILED,
+                exit_code=137, disruption_target="Preempted",
+            )
+            self.controller.run_until_idle()
+            job = self.cluster.get_job("JAXJob", "default", "llama")
+            assert job["status"]["disruptionCounts"] == {"Worker": round_ + 1}
+            conds = conds_of(self.cluster, "JAXJob", "llama")
+            assert conds.get("Failed", {}).get("status") != "True"
+        # Reach Running so the disruption backoff streak closes.
+        for p in self.cluster.list_pods():
+            self.cluster.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+        self.controller.run_until_idle()
+        # Application failure (SIGINT): draws backoffLimit.
+        self.cluster.set_pod_phase(
+            "default", "llama-worker-1", POD_FAILED, exit_code=130,
+        )
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        assert job["status"]["restartCounts"] == {"Worker": 1}
+        self.controller.queue.add("JAXJob:default/llama")
+        self.controller.run_until_idle()
+        conds = conds_of(self.cluster, "JAXJob", "llama")
+        assert conds["Failed"]["status"] == "True"
+        assert conds["Failed"]["reason"] == "BackoffLimitExceeded"
+
+    def test_max_disruption_retries_bounds_preemption_loop(self):
+        self.start(jax_manifest(run_policy={"maxDisruptionRetries": 1}))
+        self.cluster.set_pod_phase(
+            "default", "llama-worker-0", POD_FAILED,
+            exit_code=137, disruption_target="Preempted",
+        )
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        assert job["status"]["disruptionCounts"] == {"Worker": 1}
+        # The budget gate runs at the next sync's run-policy check.
+        self.controller.queue.add("JAXJob:default/llama")
+        self.controller.run_until_idle()
+        conds = conds_of(self.cluster, "JAXJob", "llama")
+        assert conds["Failed"]["status"] == "True"
+        assert conds["Failed"]["reason"] == "DisruptionBudgetExceeded"
+
+    def test_evicted_pod_without_exit_code(self):
+        """Eviction often leaves no containerStatuses at all (the kubelet
+        reaped the pod before the container reported): the status.reason
+        marker alone must classify it."""
+        self.start(jax_manifest())
+        self.cluster.set_pod_phase(
+            "default", "llama-worker-3", POD_FAILED, reason="Evicted",
+        )
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        assert job["status"]["disruptionCounts"] == {"Worker": 1}
+        assert "restartCounts" not in job["status"]
+
+    def test_oom_kill_loop_exhausts_backoff_limit(self):
+        """Engine-level: a gang whose worker keeps OOM-killing itself must
+        burn backoffLimit (restartCounts) and eventually fail — never the
+        disruption ledger."""
+        self.start(jax_manifest(run_policy={"backoffLimit": 1}))
+        self.cluster.set_pod_phase(
+            "default", "llama-worker-1", POD_FAILED,
+            exit_code=137, container_reason="OOMKilled",
+        )
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        assert job["status"].get("restartCounts") == {"Worker": 1}
+        assert "disruptionCounts" not in job["status"]
+        self.controller.queue.add("JAXJob:default/llama")
+        self.controller.run_until_idle()
+        conds = conds_of(self.cluster, "JAXJob", "llama")
+        assert conds["Failed"]["reason"] == "BackoffLimitExceeded"
+
+    def test_sigkill_amid_permanent_peer_failure_is_application(self):
+        """137 beside a peer that failed with a permanent code is NOT read
+        as preemption: the gang is not otherwise healthy, so the restart
+        draws backoffLimit (and the permanent failure will fail the job
+        on the recreated world if it recurs)."""
+        self.start(jax_manifest())
+        self.cluster.set_pod_phase(
+            "default", "llama-worker-0", POD_FAILED, exit_code=1,
+        )
+        self.cluster.set_pod_phase(
+            "default", "llama-worker-2", POD_FAILED, exit_code=137,
+        )
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        assert job["status"].get("restartCounts") == {"Worker": 1}
+        assert "disruptionCounts" not in job["status"]
+
+
+class TestDisruptionBackoff:
+    def test_first_disruption_is_immediate(self):
+        assert disruption_backoff_seconds("uid-1", 0) == 0.0
+        assert disruption_backoff_seconds("uid-1", 1) == 0.0
+
+    def test_deterministic_jittered_exponential(self):
+        d2 = disruption_backoff_seconds("uid-1", 2)
+        d3 = disruption_backoff_seconds("uid-1", 3)
+        d4 = disruption_backoff_seconds("uid-1", 4)
+        # Deterministic: same inputs, same delay.
+        assert d2 == disruption_backoff_seconds("uid-1", 2)
+        # Jitter keeps each step within [0.5, 1.0) x the nominal value.
+        assert 0.5 <= d2 < 1.0
+        assert 1.0 <= d3 < 2.0
+        assert 2.0 <= d4 < 4.0
+        # Different jobs land at different points in the window.
+        assert d2 != disruption_backoff_seconds("uid-2", 2)
+
+    def test_cap(self):
+        assert disruption_backoff_seconds("u", 60) <= 300.0
+
+    def test_engine_defers_recreation_and_resets_streak_on_running(self):
+        """Second consecutive disruption opens a backoff window: pods are
+        NOT recreated until the engine clock passes it. Reaching Running
+        closes the streak so the NEXT preemption restarts immediately."""
+        now = [1000.0]
+        cluster = InMemoryCluster(clock=lambda: now[0])
+        controller = JAXController(cluster, clock=lambda: now[0])
+        cluster.create_job(jax_manifest(workers=2))
+        controller.run_until_idle()
+
+        def preempt_all():
+            for p in cluster.list_pods():
+                cluster.set_pod_phase(
+                    "default", p.metadata.name, POD_FAILED,
+                    exit_code=137, disruption_target="Preempted",
+                )
+
+        for p in cluster.list_pods():
+            cluster.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+        controller.run_until_idle()
+        # Disruption 1: streak 1 -> immediate recreation.
+        preempt_all()
+        controller.run_until_idle()
+        pods = cluster.list_pods()
+        assert len(pods) == 2 and all(p.status.phase == POD_PENDING for p in pods)
+        # Disruption 2 before ever reaching Running: streak 2 -> deferred.
+        preempt_all()
+        controller.run_until_idle()
+        job = cluster.get_job("JAXJob", "default", "llama")
+        until = job["status"].get("restartBackoffUntil")
+        assert until is not None and until > now[0]
+        assert cluster.list_pods() == [], "recreation must wait out the window"
+        # Window passes: recreation proceeds and the marker clears.
+        now[0] = until + 0.01
+        controller.queue.add("JAXJob:default/llama")
+        controller.run_until_idle()
+        assert len(cluster.list_pods()) == 2
+        job = cluster.get_job("JAXJob", "default", "llama")
+        assert job["status"].get("restartBackoffUntil") is None
+        assert job["status"]["disruptionStreak"] == 2
+        # Running resets the streak (but never the budget ledger).
+        for p in cluster.list_pods():
+            cluster.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+        controller.run_until_idle()
+        job = cluster.get_job("JAXJob", "default", "llama")
+        assert job["status"]["disruptionStreak"] == 0
+        assert job["status"]["disruptionCounts"] == {"Worker": 2}
+
+
+class TestTerminatingTriggerEdges:
+    """Satellite coverage for the gang restart-cause machine's terminating
+    triggers (the Failed-trigger edges live in
+    test_controllers_frameworks.py::TestJAXController)."""
+
+    def setup_method(self):
+        self.cluster = InMemoryCluster()
+        self.controller = JAXController(self.cluster)
+
+    def start(self, workers=4):
+        self.cluster.create_job(jax_manifest(workers=workers))
+        self.controller.run_until_idle()
+        for p in self.cluster.list_pods():
+            self.cluster.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+        self.controller.run_until_idle()
+
+    def test_node_drain_of_running_pod_fires_teardown_exactly_once(self):
+        """A RUNNING world pod externally deleted (node drain: Terminating
+        with no failure recorded) beside live peers is a disruption: the
+        gang tears down once, the drained pod's uid lands in
+        gang_handled_uids, and repeated syncs while it lingers through its
+        grace period never re-fire or double-count."""
+        self.start()
+        uids = {p.metadata.name: p.metadata.uid for p in self.cluster.list_pods()}
+        self.cluster.set_pod_deleting("default", "llama-worker-1")
+        self.controller.run_until_idle()
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        assert job["status"]["disruptionCounts"] == {"Worker": 1}
+        assert "restartCounts" not in job["status"]
+        assert uids["llama-worker-1"] in job["status"]["gangHandledUids"]
+        # Survivors replaced; the drained pod still Terminating untouched.
+        after = {p.metadata.name: p.metadata.uid for p in self.cluster.list_pods()}
+        assert after["llama-worker-1"] == uids["llama-worker-1"]
+        for name in after:
+            if name != "llama-worker-1":
+                assert after[name] != uids[name], f"{name} must be replaced"
+        # Grace period ends; the world settles at exactly one counted
+        # disruption and a full recreated gang.
+        self.cluster.delete_pod("default", "llama-worker-1")
+        self.controller.run_until_idle()
+        assert len(self.cluster.list_pods()) == 4
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        assert job["status"]["disruptionCounts"] == {"Worker": 1}
+        assert conds_of(self.cluster, "JAXJob", "llama").get(
+            "Failed", {}
+        ).get("status") != "True"
+
+    def test_externally_deleted_failed_trigger_counts_once_across_syncs(self):
+        """The Failed+Terminating trigger (eviction) fires the teardown on
+        the first sync and is stamped handled: every later sync while it
+        lingers must be a no-op for the budget."""
+        self.start()
+        self.cluster.set_pod_phase(
+            "default", "llama-worker-2", POD_FAILED, exit_code=137,
+            disruption_target="Evicted",
+        )
+        self.cluster.set_pod_deleting("default", "llama-worker-2")
+        for _ in range(4):
+            self.controller.run_until_idle()
+            self.controller.queue.add("JAXJob:default/llama")
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        assert job["status"]["disruptionCounts"] == {"Worker": 1}
+
+    def test_in_flight_restart_does_not_refire(self):
+        """Once every world pod is terminating (the teardown in flight),
+        the trigger must not re-fire: the budget sees one restart, and no
+        pod is re-deleted."""
+        self.start()
+        self.cluster.set_pod_phase(
+            "default", "llama-worker-2", POD_FAILED, exit_code=137,
+        )
+        for p in self.cluster.list_pods():
+            self.cluster.set_pod_deleting("default", p.metadata.name)
+        before = self.cluster.get_job("JAXJob", "default", "llama")["status"]
+        self.controller.run_until_idle()
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        assert job["status"].get("disruptionCounts") == before.get("disruptionCounts")
+        assert job["status"].get("restartCounts", {}) == before.get("restartCounts", {})
+        assert len(self.cluster.list_pods()) == 4  # nothing re-deleted
+        assert conds_of(self.cluster, "JAXJob", "llama").get(
+            "Failed", {}
+        ).get("status") != "True"
+
+    def test_resize_during_trigger_grace_period_does_not_recount(self):
+        """A counted trigger lingering Failed+Terminating through its grace
+        period must STAY handled across a spec resize: the stale-world
+        stamp merges with (not replaces) gang_handled_uids, or the resize
+        would un-handle the trigger and re-fire a second gang teardown —
+        double-charging one incident."""
+        self.start()
+        self.cluster.set_pod_phase(
+            "default", "llama-worker-2", POD_FAILED, exit_code=137,
+            disruption_target="Evicted",
+        )
+        self.cluster.set_pod_deleting("default", "llama-worker-2")
+        self.controller.run_until_idle()  # teardown counted once
+        self.controller.run_until_idle()  # world recreated
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        assert job["status"]["disruptionCounts"] == {"Worker": 1}
+        # Resize while the trigger still lingers Terminating: the
+        # stale-world restart fires for the new generation.
+        job["spec"]["jaxReplicaSpecs"]["Worker"]["replicas"] = 8
+        self.cluster.update_job(job)
+        for _ in range(4):
+            self.controller.run_until_idle()
+            self.controller.queue.add("JAXJob:default/llama")
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        assert job["status"]["disruptionCounts"] == {"Worker": 1}, (
+            "resize mid-grace-period must not re-count the handled trigger")
+        assert conds_of(self.cluster, "JAXJob", "llama").get(
+            "Failed", {}
+        ).get("status") != "True"
+
+    def test_scale_down_deletion_is_not_a_disruption(self):
+        """The engine's own out-of-range deletion (scale-down) leaves a
+        Running+Terminating pod at an index >= replicas: the drained-pod
+        trigger must ignore it — a resize is not a preemption."""
+        self.start(workers=4)
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        job["spec"]["jaxReplicaSpecs"]["Worker"]["replicas"] = 2
+        self.cluster.update_job(job)
+        # Several syncs: world restart (spec change) then steady state.
+        for _ in range(4):
+            self.controller.run_until_idle()
+            self.controller.queue.add("JAXJob:default/llama")
+        self.controller.run_until_idle()
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        assert "disruptionCounts" not in job["status"], (
+            "a scale-down must never draw the disruption budget")
+
+
+class TestExpectationTimeouts:
+    def test_timeout_fires_callback_once(self):
+        now = [0.0]
+        fired = []
+        exp = ControllerExpectations(
+            clock=lambda: now[0],
+            on_timeout=lambda *args: fired.append(args),
+        )
+        exp.expect_creations("default/j", "pods", 2)
+        assert not exp.satisfied("default/j", "pods")
+        assert fired == []
+        now[0] = expmod.EXPECTATION_TIMEOUT_SECONDS + 1
+        assert exp.satisfied("default/j", "pods")  # expired -> self-heal
+        assert exp.satisfied("default/j", "pods")
+        assert fired == [("default/j", "pods", 2, 0)]  # exactly once
+
+    def test_fulfilled_expectation_never_counts(self):
+        now = [0.0]
+        fired = []
+        exp = ControllerExpectations(
+            clock=lambda: now[0],
+            on_timeout=lambda *args: fired.append(args),
+        )
+        exp.expect_creations("default/j", "pods", 1)
+        exp.creation_observed("default/j", "pods")
+        now[0] = expmod.EXPECTATION_TIMEOUT_SECONDS + 1
+        assert exp.satisfied("default/j", "pods")
+        assert fired == []
+
+    def test_controller_surfaces_timeout_as_metric_and_event(self, monkeypatch):
+        """A lost dependent watch event wedges the job until expiry; the
+        expiry must land in the timeouts counter and a Warning event."""
+        monkeypatch.setattr(expmod, "EXPECTATION_TIMEOUT_SECONDS", 0.01)
+        cluster = InMemoryCluster()
+        metrics = Metrics()
+        controller = TFController(cluster, metrics=metrics)
+        # Simulate the lost event: an expectation nothing will observe.
+        controller.expectations.expect_creations("default/tj", "pods", 1)
+        cluster.create_job(tfjob_manifest())
+        time.sleep(0.02)
+        controller.run_until_idle()
+        assert metrics.labeled_counter_value(
+            "training_operator_expectation_timeouts_total",
+            "default", "TFJob", "pods",
+        ) == 1
+        assert any(
+            e.reason == "ExpectationTimeout" and e.type == "Warning"
+            for e in cluster.list_events()
+        )
+        # The job self-healed: its pod exists despite the stale window.
+        assert len(cluster.list_pods("default")) == 1
+
+
+class TestBestEffortEvents:
+    def test_event_recorder_failure_never_aborts_reconcile(self):
+        """Chaos-backed regression for the swallow-and-log helper: with
+        record_event failing on EVERY call, the reconcile must still
+        create pods, drive the lifecycle, and complete the job."""
+        spec = ChaosSpec(
+            seed=7,
+            error_rate=1.0,
+            # Fault ONLY the event recorder: every other write is exempt.
+            exempt_methods=tuple(
+                m for m in (
+                    "create_job", "update_job", "update_job_status",
+                    "delete_job", "create_pod", "update_pod", "delete_pod",
+                    "create_service", "update_service", "delete_service",
+                    "create_pod_group", "delete_pod_group",
+                )
+            ),
+        )
+        inner = InMemoryCluster()
+        cluster = ChaosCluster(inner, spec)
+        controller = TFController(cluster)
+        inner.create_job(tfjob_manifest())
+        controller.run_until_idle()
+        assert len(inner.list_pods("default")) == 1, (
+            "a failing event recorder must not block pod creation")
+        inner.set_pod_phase(
+            "default", "tj-worker-0", "Succeeded", exit_code=0,
+        )
+        controller.run_until_idle()
+        assert conds_of(inner, "TFJob", "tj")["Succeeded"]["status"] == "True"
+        # The chaos proxy did fire on record_event calls.
+        assert any("record_event" in entry for entry in cluster.fault_log)

@@ -1058,8 +1058,14 @@ class TestGangFailureChaosFourProc:
                 assert "resumed from step" in log, f"{n}: {log[-2000:]}"
             assert not job_condition(cluster, "JAXJob", "chaos4", "Failed")
             job = cluster.get_job("JAXJob", "default", "chaos4")
-            assert job["status"]["restartCounts"] == {"Worker": 1}, (
-                "one world restart, not one per pod")
+            # SIGKILL = disruption ledger (budget-free); a peer racing to a
+            # nonzero app-class exit before the sync can shift the cause,
+            # so the durable assertion is ONE world restart total.
+            counts = job["status"]
+            total = (sum(counts.get("restartCounts", {}).values())
+                     + sum(counts.get("disruptionCounts", {}).values()))
+            assert total == 1, (
+                f"one world restart, not one per pod: {counts}")
             hist = metrics._histograms["training_operator_job_restart_seconds"][
                 ("default", "JAXJob")]
             assert hist.count >= 1, "restart MTTR missing from the histogram"
@@ -1146,8 +1152,14 @@ class TestGangFailureChaosEightProc:
                 assert "devices=32" in log, f"{n}: {log[-2000:]}"
             assert not job_condition(cluster, "JAXJob", "chaos8", "Failed")
             job = cluster.get_job("JAXJob", "default", "chaos8")
-            assert job["status"]["restartCounts"] == {"Worker": 1}, (
-                "one world restart, not one per pod")
+            # SIGKILL = disruption ledger (budget-free); a peer racing to a
+            # nonzero app-class exit before the sync can shift the cause,
+            # so the durable assertion is ONE world restart total.
+            counts = job["status"]
+            total = (sum(counts.get("restartCounts", {}).values())
+                     + sum(counts.get("disruptionCounts", {}).values()))
+            assert total == 1, (
+                f"one world restart, not one per pod: {counts}")
             hist = metrics._histograms["training_operator_job_restart_seconds"][
                 ("default", "JAXJob")]
             assert hist.count >= 1
@@ -1223,4 +1235,7 @@ class TestMultisliceGangFailureChaos:
             assert "resumed from step" in log, f"{n}: {log[-2000:]}"
             assert f"slice={i // 2}/2" in log, log
         job = harness.get_job("JAXJob", "default", "msc")
-        assert job["status"]["restartCounts"] == {"Worker": 1}
+        counts = job["status"]
+        total = (sum(counts.get("restartCounts", {}).values())
+                 + sum(counts.get("disruptionCounts", {}).values()))
+        assert total == 1, counts

@@ -12,7 +12,14 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from .k8s import ObjectMeta, PodTemplateSpec, from_dict, to_dict
+from .k8s import (
+    POD_CONDITION_DISRUPTION_TARGET,
+    ObjectMeta,
+    Pod,
+    PodTemplateSpec,
+    from_dict,
+    to_dict,
+)
 
 # --- Replica types are plain strings; frameworks define their own constants.
 ReplicaType = str
@@ -66,6 +73,84 @@ def is_retryable_exit_code(exit_code: int) -> bool:
     return exit_code >= 128
 
 
+# --- Restart-cause taxonomy (docs/design/disruption_handling.md) ---------
+#
+# Every operator-initiated restart is classified as one of two causes, and
+# each cause draws from its own budget: application failures consume
+# RunPolicy.backoffLimit (as they always have), infrastructure disruptions
+# consume RunPolicy.maxDisruptionRetries (default unlimited). On TPU fleets
+# preemption/maintenance is the dominant failure mode; letting it burn the
+# application budget turns routine capacity churn into dead jobs.
+RESTART_CAUSE_APPLICATION = "ApplicationFailure"
+RESTART_CAUSE_DISRUPTION = "InfrastructureDisruption"
+# A deliberate spec change (elastic resize / world-generation rollout):
+# not a failure at all — consumes neither budget, but still labels the
+# restarted-by-cause metric so dashboards see why a world churned.
+RESTART_CAUSE_SPEC_CHANGE = "SpecChange"
+
+# Signal-kill exit codes: the process was terminated from OUTSIDE
+# (137 = 128+SIGKILL: preemption/OOM-score eviction; 143 = 128+SIGTERM:
+# node drain, graceful preemption). Other 128+ codes (134 SIGABRT,
+# 139 SIGSEGV) are the process crashing on its own and stay
+# application-classified even though they are retryable.
+SIGKILL_CLASS_EXIT_CODES = (137, 143)
+
+# PodStatus.reason values the kubelet/eviction machinery writes when the
+# infrastructure (not the workload) killed the pod.
+DISRUPTION_POD_REASONS = ("Preempted", "Evicted", "NodeShutdown", "Terminated")
+
+
+def is_sigkill_class_exit_code(exit_code: int) -> bool:
+    return exit_code in SIGKILL_CLASS_EXIT_CODES
+
+
+def pod_disruption_signal(pod: Pod) -> Optional[str]:
+    """The pod's explicit infrastructure-disruption marker, if any: the
+    DisruptionTarget condition (k8s >=1.26 stamps it on preemption, node
+    drain, taint eviction) or a disruption-class PodStatus.reason
+    (Preempted/Evicted/NodeShutdown). Returns the reason string for
+    events/metrics, or None when the pod carries no explicit marker."""
+    for cond in pod.status.conditions:
+        if (
+            cond.type == POD_CONDITION_DISRUPTION_TARGET
+            and cond.status == CONDITION_TRUE
+        ):
+            return cond.reason or POD_CONDITION_DISRUPTION_TARGET
+    if pod.status.reason in DISRUPTION_POD_REASONS:
+        return pod.status.reason
+    return None
+
+
+def classify_pod_failure(pod: Pod, exit_code: int, peers_healthy: bool = True) -> str:
+    """Restart-cause classification for a retryably-failed pod:
+
+    - an explicit marker (DisruptionTarget condition, Preempted/Evicted
+      status reason) is always a disruption — the cluster told us so;
+    - a container the kubelet reports as OOMKilled is the workload
+      exceeding ITS OWN memory limit: exit code 137, but an application
+      failure — without this check a leaking trainer would crash-loop
+      budget-free forever instead of exhausting backoffLimit;
+    - a SIGKILL-class exit (137/143) with no marker is a disruption only on
+      an otherwise-healthy gang (`peers_healthy`): a lone host silently
+      killed under healthy peers is preemption in practice, while the same
+      code beside peers dying of application errors is the workload
+      taking itself down;
+    - everything else (1-127 permanent, 128+ self-inflicted crashes) is an
+      application failure, exactly as before this taxonomy existed.
+    """
+    if pod_disruption_signal(pod) is not None:
+        return RESTART_CAUSE_DISRUPTION
+    for status in pod.status.container_statuses:
+        if (
+            status.state.terminated is not None
+            and status.state.terminated.reason == "OOMKilled"
+        ):
+            return RESTART_CAUSE_APPLICATION
+    if is_sigkill_class_exit_code(exit_code) and peers_healthy:
+        return RESTART_CAUSE_DISRUPTION
+    return RESTART_CAUSE_APPLICATION
+
+
 @dataclass
 class SchedulingPolicy:
     """Gang-scheduling knobs (commonv1.SchedulingPolicy, visible in the
@@ -85,6 +170,12 @@ class RunPolicy:
     ttl_seconds_after_finished: Optional[int] = None
     active_deadline_seconds: Optional[int] = None
     backoff_limit: Optional[int] = None
+    # Separate budget for infrastructure-disruption restarts (preemption,
+    # eviction, node drain): None = unlimited — the Gavel/Podracer stance
+    # that preemption-and-resume is a normal, budget-free operation the
+    # substrate absorbs. Set a bound to fail jobs stuck in a preemption
+    # loop (e.g. a reservation that keeps getting reclaimed).
+    max_disruption_retries: Optional[int] = None
     scheduling_policy: Optional[SchedulingPolicy] = None
     # Suspend (training-operator v1.7 RunPolicy.suspend): true tears down
     # every pod (and gang groups — on TPU this releases the whole slice)
@@ -130,10 +221,24 @@ class JobStatus:
 
     conditions: List[JobCondition] = field(default_factory=list)
     replica_statuses: Dict[str, ReplicaStatus] = field(default_factory=dict)
-    # Operator-initiated restarts per replica type (policy ExitCode deletes
-    # + recreates pods, so kubelet restartCounts never see them; backoffLimit
-    # must still count them — persisted here across pod generations).
+    # Operator-initiated APPLICATION-failure restarts per replica type
+    # (policy ExitCode deletes + recreates pods, so kubelet restartCounts
+    # never see them; backoffLimit must still count them — persisted here
+    # across pod generations).
     restart_counts: Dict[str, int] = field(default_factory=dict)
+    # Operator-initiated INFRASTRUCTURE-disruption restarts per replica
+    # type (preemption/eviction/drain). Deliberately a separate ledger:
+    # these never count toward backoffLimit — they draw from
+    # RunPolicy.maxDisruptionRetries instead.
+    disruption_counts: Dict[str, int] = field(default_factory=dict)
+    # Consecutive disruption restarts since the job last reached Running:
+    # drives the jittered exponential restart backoff (first disruption
+    # restarts immediately; a preemption loop backs off). Reset on Running.
+    disruption_streak: int = 0
+    # Absolute clock time before which the engine defers pod recreation
+    # (the restart-backoff window after a disruption). Cleared when it
+    # elapses and on suspend/resume.
+    restart_backoff_until: Optional[float] = None
     start_time: Optional[float] = None
     completion_time: Optional[float] = None
     last_reconcile_time: Optional[float] = None

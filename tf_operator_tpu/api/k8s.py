@@ -319,10 +319,28 @@ POD_SUCCEEDED = "Succeeded"
 POD_FAILED = "Failed"
 POD_UNKNOWN = "Unknown"
 
+# Pod condition types the operator consumes. DisruptionTarget is the
+# k8s >=1.26 marker the kubelet/scheduler/eviction-API stamp on a pod about
+# to be terminated for infrastructure reasons (preemption, node drain,
+# taint eviction) — the authoritative "this was not the workload's fault"
+# signal the disruption classifier keys on.
+POD_CONDITION_DISRUPTION_TARGET = "DisruptionTarget"
+
+
+@dataclass
+class PodCondition:
+    """One entry in PodStatus.conditions (core/v1 PodCondition subset)."""
+
+    type: str = ""
+    status: str = "True"
+    reason: str = ""
+    message: str = ""
+
 
 @dataclass
 class PodStatus:
     phase: str = POD_PENDING
+    conditions: List[PodCondition] = field(default_factory=list)
     container_statuses: List[ContainerStatus] = field(default_factory=list)
     start_time: Optional[float] = None
     reason: str = ""

@@ -9,6 +9,14 @@ Kubernetes API server for production deployments.
 """
 
 from .base import Cluster, NotFound
+from .chaos import ChaosCluster, ChaosSpec, ScheduledPreemption
 from .memory import InMemoryCluster
 
-__all__ = ["Cluster", "NotFound", "InMemoryCluster"]
+__all__ = [
+    "ChaosCluster",
+    "ChaosSpec",
+    "Cluster",
+    "InMemoryCluster",
+    "NotFound",
+    "ScheduledPreemption",
+]

@@ -29,6 +29,13 @@ class Gone(RuntimeError):
     watch cache. Recoverable by restarting the list/watch from scratch."""
 
 
+class ServerError(RuntimeError):
+    """Transient apiserver failure (k8s 5xx analog): the request may or
+    may not have taken effect; safe to retry through the rate-limited
+    queue. Raised by HTTP backends on 5xx and injected by the chaos proxy
+    (cluster/chaos.py)."""
+
+
 # Watch event types
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"

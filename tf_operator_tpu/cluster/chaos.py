@@ -1,0 +1,266 @@
+"""Seeded fault-injection cluster proxy.
+
+Same proxy idiom as `cluster/throttled.py`: wraps any `Cluster` and
+delegates everything, but — driven by a deterministic seeded plan —
+injects the apiserver's unhappy paths between the controller and the
+backend:
+
+- write `Conflict`s (stale-resourceVersion 409s),
+- transient `ServerError`s (5xx),
+- added write latency,
+- watch-stream event drops (the informer's lost-event failure mode),
+- node-scoped batch pod kills that simulate TPU slice-host preemption
+  (every matching pod flips to Failed/137 with a `DisruptionTarget`
+  condition in one batch, the way a reclaimed host takes all its pods
+  at once).
+
+Determinism is the point: every decision is a pure function of
+(seed, method, per-method call index), via SHA-256 — no `random` state,
+no wall clock — so the SAME seed over the SAME operation sequence yields
+the SAME fault schedule byte-for-byte (`fault_log`). That is what lets a
+chaos-tier failure be replayed locally from nothing but its seed.
+
+Faults are injected on WRITES only (plus watch delivery): reads are
+retried freely by the sync loop, so read-side faults would make the
+per-method call counts — and with them the schedule — depend on sync
+timing rather than on the controller's actual actions.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ..api.k8s import (
+    POD_CONDITION_DISRUPTION_TARGET,
+    POD_FAILED,
+    ContainerState,
+    ContainerStateTerminated,
+    ContainerStatus,
+    PodCondition,
+)
+from .base import Cluster, Conflict, ServerError
+
+# Writes eligible for fault injection — the same surface ThrottledCluster
+# throttles (every apiserver mutation the engine performs).
+_WRITE_METHODS = (
+    "create_job",
+    "update_job",
+    "update_job_status",
+    "delete_job",
+    "create_pod",
+    "update_pod",
+    "delete_pod",
+    "create_service",
+    "update_service",
+    "delete_service",
+    "record_event",
+    "create_pod_group",
+    "delete_pod_group",
+)
+
+# Conflict only makes sense where the apiserver would 409: optimistic-
+# concurrency writes and name-collision creates.
+_CONFLICT_METHODS = tuple(
+    m for m in _WRITE_METHODS if m.startswith(("update_", "create_"))
+)
+
+
+@dataclass
+class ScheduledPreemption:
+    """A slice-host preemption planted in the schedule: after the proxy
+    has seen `after_writes` total write calls, every pod matching
+    (namespace, labels) is batch-killed. Fires at most once."""
+
+    after_writes: int
+    namespace: Optional[str] = None
+    labels: Optional[Dict[str, str]] = None
+    reason: str = "Preempted"
+    exit_code: int = 137
+
+
+@dataclass
+class ChaosSpec:
+    """The seeded plan. Rates are probabilities in [0, 1] evaluated per
+    call from the deterministic hash stream."""
+
+    seed: int = 0
+    conflict_rate: float = 0.0
+    error_rate: float = 0.0
+    latency_rate: float = 0.0
+    latency_seconds: float = 0.0
+    drop_watch_rate: float = 0.0
+    # Kinds whose watch events may be dropped; empty tuple = all kinds.
+    drop_watch_kinds: Tuple[str, ...] = ()
+    preemptions: Tuple[ScheduledPreemption, ...] = ()
+    # Methods exempt from error/conflict injection (latency still
+    # applies). Default: none — every write, record_event included, is
+    # faultable; the engine's best-effort event recording is itself a
+    # property the chaos tier regression-tests (by exempting everything
+    # EXCEPT record_event and asserting reconciles survive).
+    exempt_methods: Tuple[str, ...] = ()
+
+
+class ChaosCluster:
+    """Delegates everything to `inner`; write methods run the fault plan
+    first. `fault_log` records every injected fault in order — the
+    byte-for-byte reproducibility artifact."""
+
+    def __init__(self, inner: Cluster, spec: ChaosSpec):
+        self._inner = inner
+        self.spec = spec
+        self.fault_log: List[str] = []
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._writes_seen = 0
+        self._preempted = [False] * len(spec.preemptions)
+
+    # ------------------------------------------------------------- plan
+    def _next_index(self, stream: str) -> int:
+        with self._lock:
+            n = self._counters.get(stream, 0)
+            self._counters[stream] = n + 1
+            return n
+
+    def _fraction(self, stream: str, index: int, salt: str) -> float:
+        """Deterministic uniform [0, 1): SHA-256 of (seed, stream, call
+        index, fault kind). Independent per salt so e.g. the latency and
+        conflict decisions of one call don't correlate."""
+        digest = hashlib.sha256(
+            f"{self.spec.seed}:{stream}:{index}:{salt}".encode()
+        ).digest()
+        return int.from_bytes(digest[:8], "big") / 2**64
+
+    def _log(self, entry: str) -> None:
+        with self._lock:
+            self.fault_log.append(entry)
+
+    def _inject(self, method: str) -> None:
+        """Run the fault plan for one write call; raises the injected
+        fault, sleeps the injected latency, or returns clean."""
+        index = self._next_index(method)
+        spec = self.spec
+        if spec.latency_rate > 0 and spec.latency_seconds > 0:
+            if self._fraction(method, index, "latency") < spec.latency_rate:
+                self._log(f"{method}#{index}:latency")
+                time.sleep(spec.latency_seconds)
+        if method in spec.exempt_methods:
+            return
+        if spec.error_rate > 0 and self._fraction(method, index, "error") < spec.error_rate:
+            self._log(f"{method}#{index}:error")
+            raise ServerError(f"chaos: injected transient error on {method}")
+        if (
+            method in _CONFLICT_METHODS
+            and spec.conflict_rate > 0
+            and self._fraction(method, index, "conflict") < spec.conflict_rate
+        ):
+            self._log(f"{method}#{index}:conflict")
+            raise Conflict(f"chaos: injected conflict on {method}")
+
+    def _note_write(self) -> None:
+        """Advance the write clock and fire any scheduled preemption it
+        crossed. Fired OUTSIDE the inner call, after it returns, so the
+        preemption lands between operations like a real node event."""
+        with self._lock:
+            self._writes_seen += 1
+            due = [
+                i for i, p in enumerate(self.spec.preemptions)
+                if not self._preempted[i] and self._writes_seen >= p.after_writes
+            ]
+            for i in due:
+                self._preempted[i] = True
+        for i in due:
+            p = self.spec.preemptions[i]
+            self.preempt_pods(
+                namespace=p.namespace, labels=p.labels,
+                reason=p.reason, exit_code=p.exit_code,
+            )
+
+    # ------------------------------------------------------------ proxy
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name in _WRITE_METHODS and callable(attr):
+            def chaotic(*args, _method=name, _attr=attr, **kwargs):
+                self._inject(_method)
+                out = _attr(*args, **kwargs)
+                self._note_write()
+                return out
+
+            return chaotic
+        return attr
+
+    def watch(self, kind: str, handler) -> None:
+        """Register the handler behind a seeded drop filter: a dropped
+        delivery is the lost-watch-event failure mode informers suffer on
+        reconnects — the expectations machinery (fallback requeue, 5-min
+        expiry + timeout metric) is what must absorb it."""
+        spec = self.spec
+        if spec.drop_watch_rate <= 0 or (
+            spec.drop_watch_kinds and kind not in spec.drop_watch_kinds
+        ):
+            self._inner.watch(kind, handler)
+            return
+        registration = self._next_index(f"watch-reg:{kind}")
+        stream = f"watch:{kind}:{registration}"
+
+        def dropping(event_type, obj) -> None:
+            index = self._next_index(stream)
+            if self._fraction(stream, index, "drop") < spec.drop_watch_rate:
+                self._log(f"{stream}#{index}:drop:{event_type}")
+                return
+            handler(event_type, obj)
+
+        self._inner.watch(kind, dropping)
+
+    # ------------------------------------------------------- preemption
+    def preempt_pods(
+        self,
+        namespace: Optional[str] = None,
+        labels: Optional[Dict[str, str]] = None,
+        reason: str = "Preempted",
+        exit_code: int = 137,
+    ) -> int:
+        """Node-scoped batch kill: every matching pod gets a
+        DisruptionTarget condition + disruption status reason and flips to
+        Failed with a SIGKILL-class exit code, in one batch — a simulated
+        TPU slice-host preemption/maintenance event. Goes through the
+        public get/update surface so it works against ANY backend, and
+        bypasses the fault plan (the infrastructure doing the preempting
+        is not subject to it). Returns the number of pods killed."""
+        killed = 0
+        for pod in self._inner.list_pods(namespace=namespace, labels=labels):
+            if pod.metadata.deletion_timestamp is not None:
+                continue
+            if pod.status.phase == POD_FAILED:
+                continue
+            pod.status.phase = POD_FAILED
+            pod.status.reason = reason
+            pod.status.conditions.append(
+                PodCondition(
+                    type=POD_CONDITION_DISRUPTION_TARGET,
+                    status="True",
+                    reason=reason,
+                    message="chaos: simulated slice-host preemption",
+                )
+            )
+            cname = pod.spec.containers[0].name if pod.spec.containers else ""
+            pod.status.container_statuses = [
+                ContainerStatus(
+                    name=cname,
+                    state=ContainerState(
+                        terminated=ContainerStateTerminated(
+                            exit_code=exit_code, reason=reason
+                        )
+                    ),
+                )
+            ]
+            self._inner.update_pod(pod)
+            self._log(
+                f"preempt:{pod.metadata.namespace}/{pod.metadata.name}"
+                f":{reason}:{exit_code}"
+            )
+            killed += 1
+        return killed

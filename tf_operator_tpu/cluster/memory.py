@@ -573,9 +573,17 @@ class InMemoryCluster(base.Cluster):
         exit_code: Optional[int] = None,
         container_name: str = "",
         restart_count: int = 0,
+        reason: str = "",
+        disruption_target: Optional[str] = None,
+        container_reason: str = "",
     ) -> None:
         """Directly set a pod's phase (and terminated exit code), as the
-        reference's testutil.SetPodsStatuses seeds informer indexers."""
+        reference's testutil.SetPodsStatuses seeds informer indexers.
+        `reason` seeds PodStatus.reason (kubelet "Evicted"/"Preempted"
+        style); `disruption_target` appends a DisruptionTarget condition
+        with that reason — the k8s >=1.26 infrastructure-kill marker."""
+        from ..api.k8s import POD_CONDITION_DISRUPTION_TARGET, PodCondition
+
         with self._lock:
             pod = self._pods.get((namespace, name))
             if pod is None:
@@ -583,6 +591,16 @@ class InMemoryCluster(base.Cluster):
             pod.status.phase = phase
             if phase == POD_RUNNING and pod.status.start_time is None:
                 pod.status.start_time = self._clock()
+            if reason:
+                pod.status.reason = reason
+            if disruption_target is not None:
+                pod.status.conditions.append(
+                    PodCondition(
+                        type=POD_CONDITION_DISRUPTION_TARGET,
+                        status="True",
+                        reason=disruption_target,
+                    )
+                )
             if exit_code is not None:
                 cname = container_name or (pod.spec.containers[0].name if pod.spec.containers else "")
                 pod.status.container_statuses = [
@@ -591,7 +609,9 @@ class InMemoryCluster(base.Cluster):
                         restart_count=restart_count,
                         state=ContainerState(
                             terminated=ContainerStateTerminated(
-                                exit_code=exit_code, finished_at=self._clock()
+                                exit_code=exit_code,
+                                reason=container_reason,
+                                finished_at=self._clock(),
                             )
                         ),
                     )

@@ -16,6 +16,7 @@ from ..api import common as capi
 from ..api.common import JobStatus, ReplicaSpec
 from ..api.k8s import Event
 from ..core import constants
+from ..core.control import record_event_best_effort
 
 
 def update_master_based_status(
@@ -62,7 +63,8 @@ def update_master_based_status(
                     msg,
                     now=now,
                 )
-                controller.cluster.record_event(
+                record_event_best_effort(
+                    controller.cluster,
                     Event(
                         type="Normal",
                         reason=constants.job_reason(kind, constants.REASON_SUCCEEDED),
@@ -91,7 +93,8 @@ def update_master_based_status(
                 msg,
                 now=now,
             )
-            controller.cluster.record_event(
+            record_event_best_effort(
+                controller.cluster,
                 Event(
                     type="Normal",
                     reason=constants.job_reason(kind, constants.REASON_FAILED),

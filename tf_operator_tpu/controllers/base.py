@@ -17,7 +17,12 @@ from ..api.defaulting import ValidationError
 from ..api.k8s import Event
 from ..cluster.base import ADDED, DELETED, Cluster, NotFound
 from ..core import constants
-from ..core.control import RealPodControl, RealServiceControl, TokenBucket
+from ..core.control import (
+    RealPodControl,
+    RealServiceControl,
+    TokenBucket,
+    record_event_best_effort,
+)
 from ..core.expectations import ControllerExpectations
 from ..core.job_controller import EngineOptions, FrameworkHooks, JobController
 from ..core.workqueue import WorkQueue
@@ -101,7 +106,9 @@ class FrameworkController(FrameworkHooks):
 
             metrics = METRICS
         self.metrics = metrics
-        self.expectations = ControllerExpectations()
+        self.expectations = ControllerExpectations(
+            on_timeout=self._on_expectation_timeout
+        )
         # key -> uid of the last job seen at that key, so the sync-path
         # NotFound cleanup can prune UID-keyed terminal-metrics entries even
         # when the DELETED watch event was missed. Bounded by live jobs:
@@ -202,8 +209,31 @@ class FrameworkController(FrameworkHooks):
         if uid:
             self.metrics.forget_terminal(self.kind, uid)
 
-    def _record_restart(self, job: JobObject, rtype: str) -> None:
+    def _record_restart(self, job: JobObject, rtype: str, cause: str) -> None:
         self.metrics.restarted_inc(job.namespace, self.kind)
+        self.metrics.restarted_by_cause_inc(job.namespace, self.kind, cause)
+
+    def _on_expectation_timeout(self, key: str, kind: str, adds: int, dels: int) -> None:
+        """An expectation expired unfulfilled: the watch event we were
+        waiting for never arrived and the job sat wedged for the full
+        window before self-healing. Counted + evented so chaos tiers (and
+        production dashboards) can see dropped-watch incidents instead of
+        inferring them from latency."""
+        namespace = key.partition("/")[0]
+        self.metrics.expectation_timeout_inc(namespace, self.kind, kind)
+        record_event_best_effort(
+            self.cluster,
+            Event(
+                type="Warning",
+                reason=constants.REASON_EXPECTATION_TIMEOUT,
+                message=(
+                    f"expectation for {kind} expired unfulfilled "
+                    f"(outstanding creates={adds} deletes={dels}); a watch "
+                    "event was lost — proceeding on a possibly-stale view"
+                ),
+                involved_object=f"{self.kind}/{key}",
+            ),
+        )
 
     # ------------------------------------------------------------ validate
     def parse_job(self, job_dict: dict) -> JobObject:
@@ -316,7 +346,8 @@ class FrameworkController(FrameworkHooks):
             )
         except NotFound:
             pass
-        self.cluster.record_event(
+        record_event_best_effort(
+            self.cluster,
             Event(
                 type="Warning",
                 reason=constants.job_reason(self.kind, constants.REASON_FAILED),

@@ -23,6 +23,7 @@ from ..api.common import JobStatus, ReplicaSpec
 from ..api.k8s import Event
 from ..bootstrap import jaxdist
 from ..core import constants
+from ..core.control import record_event_best_effort
 from . import register
 from .base import FrameworkController
 
@@ -238,7 +239,8 @@ class JAXController(FrameworkController):
                 msg,
                 now=now,
             )
-            self.cluster.record_event(
+            record_event_best_effort(
+                self.cluster,
                 Event(
                     type="Normal",
                     reason=constants.job_reason(self.kind, constants.REASON_FAILED),
@@ -260,7 +262,8 @@ class JAXController(FrameworkController):
                 msg,
                 now=now,
             )
-            self.cluster.record_event(
+            record_event_best_effort(
+                self.cluster,
                 Event(
                     type="Normal",
                     reason=constants.job_reason(self.kind, constants.REASON_SUCCEEDED),

@@ -17,6 +17,7 @@ from ..api.common import JobStatus, ReplicaSpec
 from ..api.k8s import POD_SUCCEEDED, Event
 from ..bootstrap import tf_config
 from ..core import constants
+from ..core.control import record_event_best_effort
 from ..core.job_controller import (
     filter_pods_for_replica_type,
     get_container_exit_code,
@@ -171,7 +172,8 @@ class TFController(FrameworkController):
                         msg,
                         now=now,
                     )
-                    self.cluster.record_event(
+                    record_event_best_effort(
+                        self.cluster,
                         Event(
                             type="Normal",
                             reason=constants.job_reason(self.kind, constants.REASON_FAILED),
@@ -191,7 +193,8 @@ class TFController(FrameworkController):
             msg,
             now=now,
         )
-        self.cluster.record_event(
+        record_event_best_effort(
+            self.cluster,
             Event(
                 type="Normal",
                 reason=constants.job_reason(self.kind, constants.REASON_SUCCEEDED),

@@ -41,6 +41,14 @@ REASON_SUCCESSFUL_DELETE_SERVICE = "SuccessfulDeleteService"
 REASON_EXITED_WITH_CODE = "ExitedWithCode"
 REASON_JOB_DEADLINE_EXCEEDED = "DeadlineExceeded"
 REASON_JOB_BACKOFF_EXCEEDED = "BackoffLimitExceeded"
+# Disruption budget exhausted (RunPolicy.maxDisruptionRetries): distinct
+# from BackoffLimitExceeded so dashboards can tell "crash-looped" from
+# "preempted more times than the job allows".
+REASON_JOB_DISRUPTION_EXCEEDED = "DisruptionBudgetExceeded"
+# A 5-minute-stale expectation expired (core/expectations.py): the watch
+# event the controller was waiting for never arrived. The job self-heals,
+# but silently-self-healing wedges are exactly what chaos tiers must see.
+REASON_EXPECTATION_TIMEOUT = "ExpectationTimeout"
 
 # Condition reasons; the reference builds "<Kind>Created" etc. per framework
 # (e.g. tfJobCreatedReason). job_reason(kind, suffix) reproduces that.
@@ -53,11 +61,26 @@ def job_reason(kind: str, suffix: str) -> str:
 REASON_CREATED = "Created"
 REASON_RUNNING = "Running"
 REASON_RESTARTING = "Restarting"
+# Restarting with cause InfrastructureDisruption: preemption/eviction/
+# drain recovery. Same Restarting condition TYPE (the status machine's
+# mutual-exclusion invariants apply unchanged); the reason carries the
+# cause so conditions/events distinguish "recovering from preemption"
+# from "retrying a crash".
+REASON_DISRUPTION_RESTARTING = "DisruptionRestarting"
 REASON_SUCCEEDED = "Succeeded"
 REASON_FAILED = "Failed"
 REASON_SUSPENDED = "Suspended"
 REASON_RESUMED = "Resumed"
 REASON_QUEUED = "GangQueued"
+
+# Disruption restart backoff (jittered exponential, engine
+# `_disruption_backoff_seconds`): the FIRST disruption restarts
+# immediately (a preempted slice should re-queue for capacity at once);
+# consecutive disruptions without reaching Running back off
+# BASE * 2^(streak-2), capped — a reclaim loop must not hammer the
+# scheduler with gang-sized pod churn every sync.
+DISRUPTION_BACKOFF_BASE_SECONDS = 1.0
+DISRUPTION_BACKOFF_MAX_SECONDS = 300.0
 
 # Exit code sentinel when the framework container has not terminated
 # (reference tfjob_controller.go:707 "magic number").

@@ -8,6 +8,7 @@ tests at controller_test.go:63-64).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import List, Optional
@@ -17,9 +18,31 @@ from ..api.k8s import Event, Pod, Service, new_owner_reference
 from ..cluster.base import Cluster
 from . import constants
 
+_log = logging.getLogger(__name__)
+
 
 def owner_ref_for(job: JobObject):
     return new_owner_reference(job.api_version, job.kind, job.name, job.metadata.uid)
+
+
+def record_event_best_effort(cluster: Cluster, event: Event) -> None:
+    """Record an event, swallowing (and logging) any failure.
+
+    Events are observability, never control flow: a recorder failure — a
+    throttled or flapping apiserver, an injected chaos fault — must not
+    abort the reconcile that produced it. The reference gets this for free
+    from client-go's EventRecorder (an async broadcaster that drops on
+    error); a direct synchronous call here would turn event loss into job
+    loss. Every controller/engine event goes through this one helper so no
+    call site can reintroduce the coupling.
+    """
+    try:
+        cluster.record_event(event)
+    except Exception as exc:  # noqa: BLE001 — by design: log and move on
+        _log.warning(
+            "dropping event %s/%s for %s: %s",
+            event.type, event.reason, event.involved_object, exc,
+        )
 
 
 class TokenBucket:
@@ -82,7 +105,8 @@ class RealPodControl(PodControl):
         pod.metadata.namespace = namespace
         pod.metadata.owner_references.append(owner_ref_for(job))
         self.cluster.create_pod(pod)
-        self.cluster.record_event(
+        record_event_best_effort(
+            self.cluster,
             Event(
                 type="Normal",
                 reason=constants.REASON_SUCCESSFUL_CREATE_POD,
@@ -93,7 +117,8 @@ class RealPodControl(PodControl):
 
     def delete_pod(self, namespace: str, name: str, job: JobObject) -> None:
         self.cluster.delete_pod(namespace, name)
-        self.cluster.record_event(
+        record_event_best_effort(
+            self.cluster,
             Event(
                 type="Normal",
                 reason=constants.REASON_SUCCESSFUL_DELETE_POD,
@@ -111,7 +136,8 @@ class RealServiceControl(ServiceControl):
         service.metadata.namespace = namespace
         service.metadata.owner_references.append(owner_ref_for(job))
         self.cluster.create_service(service)
-        self.cluster.record_event(
+        record_event_best_effort(
+            self.cluster,
             Event(
                 type="Normal",
                 reason=constants.REASON_SUCCESSFUL_CREATE_SERVICE,
@@ -122,7 +148,8 @@ class RealServiceControl(ServiceControl):
 
     def delete_service(self, namespace: str, name: str, job: JobObject) -> None:
         self.cluster.delete_service(namespace, name)
-        self.cluster.record_event(
+        record_event_best_effort(
+            self.cluster,
             Event(
                 type="Normal",
                 reason=constants.REASON_SUCCESSFUL_DELETE_SERVICE,

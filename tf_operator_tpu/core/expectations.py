@@ -12,22 +12,31 @@ k8s.io/kubernetes/pkg/controller.ControllerExpectations.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
+
+_log = logging.getLogger(__name__)
 
 # Expectations are forgotten after this long, so a crashed watch channel can
 # never wedge a job forever (same 5-minute timeout as upstream).
 EXPECTATION_TIMEOUT_SECONDS = 5 * 60.0
 
+# (key, kind, outstanding adds, outstanding dels) — fired once per
+# expectation that expires unfulfilled, so wedged-then-self-healed jobs are
+# observable (metric + warning event at the controller) instead of silent.
+TimeoutHandler = Callable[[str, str, int, int], None]
+
 
 class _Expectation:
-    __slots__ = ("adds", "dels", "timestamp")
+    __slots__ = ("adds", "dels", "timestamp", "timed_out")
 
     def __init__(self, adds: int, dels: int, now: float):
         self.adds = adds
         self.dels = dels
         self.timestamp = now
+        self.timed_out = False
 
     def fulfilled(self) -> bool:
         return self.adds <= 0 and self.dels <= 0
@@ -43,10 +52,11 @@ class ControllerExpectations:
     store serves both caches (the reference keys them as "<key>/pods").
     """
 
-    def __init__(self, clock=time.monotonic):
+    def __init__(self, clock=time.monotonic, on_timeout: Optional[TimeoutHandler] = None):
         self._lock = threading.Lock()
         self._store: Dict[Tuple[str, str], _Expectation] = {}
         self._clock = clock
+        self._on_timeout = on_timeout
 
     def expect_creations(self, key: str, kind: str, count: int) -> None:
         """Raise the outstanding-creation count by `count`. Accumulates on an
@@ -59,15 +69,19 @@ class ControllerExpectations:
         self._accumulate(key, kind, dels=count)
 
     def _accumulate(self, key: str, kind: str, adds: int = 0, dels: int = 0) -> None:
+        fire = None
         with self._lock:
             now = self._clock()
             exp = self._store.get((key, kind))
             if exp is None or exp.fulfilled() or exp.expired(now):
+                fire = self._note_timeout_locked(key, kind, exp, now)
                 self._store[(key, kind)] = _Expectation(max(adds, 0), max(dels, 0), now)
-                return
-            exp.adds = max(exp.adds, 0) + adds
-            exp.dels = max(exp.dels, 0) + dels
-            exp.timestamp = now
+            else:
+                exp.adds = max(exp.adds, 0) + adds
+                exp.dels = max(exp.dels, 0) + dels
+                exp.timestamp = now
+        if fire is not None:
+            self._fire_timeout(*fire)
 
     def creation_observed(self, key: str, kind: str) -> None:
         self._lower(key, kind, add_delta=-1)
@@ -86,13 +100,47 @@ class ControllerExpectations:
     def satisfied(self, key: str, kind: str) -> bool:
         """True when it is safe to re-list and act: no expectation recorded,
         expectation fulfilled, or expectation expired."""
+        fire = None
         with self._lock:
             exp = self._store.get((key, kind))
             if exp is None:
                 return True
             if exp.fulfilled():
                 return True
-            return exp.expired(self._clock())
+            now = self._clock()
+            if not exp.expired(now):
+                return False
+            fire = self._note_timeout_locked(key, kind, exp, now)
+        if fire is not None:
+            self._fire_timeout(*fire)
+        return True
+
+    def _note_timeout_locked(self, key: str, kind: str, exp, now: float):
+        """Mark an expired-unfulfilled expectation as timed out exactly
+        once; returns the callback args to fire outside the lock (the
+        handler writes metrics/events and must not reenter under it)."""
+        if (
+            exp is None
+            or exp.fulfilled()
+            or exp.timed_out
+            or not exp.expired(now)
+        ):
+            return None
+        exp.timed_out = True
+        return (key, kind, max(exp.adds, 0), max(exp.dels, 0))
+
+    def _fire_timeout(self, key: str, kind: str, adds: int, dels: int) -> None:
+        _log.warning(
+            "expectation for %s/%s expired unfulfilled (adds=%d dels=%d): "
+            "the watch event never arrived; proceeding on a possibly-stale view",
+            key, kind, adds, dels,
+        )
+        if self._on_timeout is None:
+            return
+        try:
+            self._on_timeout(key, kind, adds, dels)
+        except Exception:  # noqa: BLE001 — observability must not wedge syncs
+            _log.exception("expectation-timeout handler failed for %s/%s", key, kind)
 
     def delete_expectations(self, key: str, kind: str) -> None:
         with self._lock:

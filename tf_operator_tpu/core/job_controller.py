@@ -14,11 +14,12 @@ per-framework ReconcilePods override into one engine with policy hooks
 from __future__ import annotations
 
 import copy
+import hashlib
 import threading
 import time
 from fractions import Fraction
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..api import common as capi
 from ..api.common import JobObject, JobStatus, ReplicaSpec
@@ -36,8 +37,33 @@ from ..api.k8s import (
 )
 from ..cluster.base import Cluster
 from . import constants
-from .control import PodControl, ServiceControl
+from .control import PodControl, ServiceControl, record_event_best_effort
 from .expectations import ControllerExpectations
+
+
+def disruption_backoff_seconds(
+    uid: str,
+    streak: int,
+    base: float = constants.DISRUPTION_BACKOFF_BASE_SECONDS,
+    cap: float = constants.DISRUPTION_BACKOFF_MAX_SECONDS,
+) -> float:
+    """Jittered exponential restart backoff for consecutive disruptions.
+
+    streak 1 (first disruption since the job last ran) restarts
+    immediately — a preempted slice should re-queue for capacity at once.
+    From streak 2 on: base * 2^(streak-2), capped, scaled by a jitter
+    factor in [0.5, 1.0) derived from a hash of (uid, streak). The jitter
+    is deterministic per (job incarnation, streak) so a seeded chaos run
+    replays the same schedule byte-for-byte, while distinct jobs preempted
+    by one maintenance event never thundering-herd the scheduler in
+    lockstep.
+    """
+    if streak <= 1:
+        return 0.0
+    delay = min(cap, base * (2 ** (streak - 2)))
+    digest = hashlib.sha256(f"{uid}:{streak}".encode()).digest()
+    fraction = int.from_bytes(digest[:8], "big") / 2**64
+    return delay * (0.5 + 0.5 * fraction)
 
 
 def gen_general_name(job_name: str, rtype: str, index) -> str:
@@ -336,7 +362,7 @@ class JobController:
         options: Optional[EngineOptions] = None,
         requeue: Optional[Callable[[str, float], None]] = None,
         clock=time.time,
-        on_job_restarting: Optional[Callable[[JobObject, str], None]] = None,
+        on_job_restarting: Optional[Callable[[JobObject, str, str], None]] = None,
     ):
         self.hooks = hooks
         self.cluster = cluster
@@ -346,7 +372,9 @@ class JobController:
         self.options = options or EngineOptions()
         self.requeue = requeue or (lambda key, after: None)
         self.clock = clock
-        self.on_job_restarting = on_job_restarting or (lambda job, rtype: None)
+        # (job, rtype, cause) — cause is a RESTART_CAUSE_* constant so the
+        # controller's metrics can label restarts by what actually happened.
+        self.on_job_restarting = on_job_restarting or (lambda job, rtype, cause: None)
         # (job key, uid) -> last-declared gang-group names: gates the stale
         # sweep's uncached LIST to declared-set changes (and once per
         # operator lifetime per job, since this cache is in-memory).
@@ -534,8 +562,13 @@ class JobController:
             # counters reset with the recreated pods (reference behavior),
             # so the durable ExitCode counter must reset alongside or
             # pre-suspension restarts would eat the resumed job's
-            # backoffLimit.
+            # backoffLimit. The disruption ledger and backoff window reset
+            # with it — suspension released the slice, so the preemption
+            # streak the old incarnation accumulated is history.
             job.status.restart_counts = {}
+            job.status.disruption_counts = {}
+            job.status.disruption_streak = 0
+            job.status.restart_backoff_until = None
             capi.update_job_conditions(
                 job.status,
                 capi.JOB_CREATED,
@@ -543,7 +576,8 @@ class JobController:
                 f"{self.hooks.kind} {job.name} is resumed.",
                 now=self.clock(),
             )
-            self.cluster.record_event(
+            record_event_best_effort(
+                self.cluster,
                 Event(
                     type="Normal",
                     reason=constants.job_reason(self.hooks.kind, constants.REASON_RESUMED),
@@ -561,6 +595,13 @@ class JobController:
         elif self._past_backoff_limit(job, run_policy, replicas, pods):
             failure_reason = constants.REASON_JOB_BACKOFF_EXCEEDED
             failure_message = f"{self.hooks.kind} {job.name} has failed because it has reached the specified backoff limit"
+        elif self._past_disruption_limit(job, run_policy):
+            failure_reason = constants.REASON_JOB_DISRUPTION_EXCEEDED
+            failure_message = (
+                f"{self.hooks.kind} {job.name} has failed because it was "
+                "disrupted (preempted/evicted) more times than "
+                "maxDisruptionRetries allows"
+            )
 
         if failure_reason is not None:
             # Honor CleanPodPolicy even on the failure path (the reference's
@@ -572,7 +613,8 @@ class JobController:
             capi.update_job_conditions(
                 job.status, capi.JOB_FAILED, failure_reason, failure_message, now=self.clock()
             )
-            self.cluster.record_event(
+            record_event_best_effort(
+                self.cluster,
                 Event(
                     type="Normal",
                     reason=failure_reason,
@@ -595,11 +637,25 @@ class JobController:
         if stale:
             for pod in stale:
                 self._delete_pod(job, pod)
+            # Stamp the deleted set as handled: these controller-initiated
+            # deletions must not be re-read next sync as external (node
+            # drain) disruptions by the gang trigger below. MERGED with the
+            # still-present previously-handled uids, not replacing them — a
+            # resize mid-grace-period must not un-handle a counted trigger
+            # still lingering Terminating (re-reading it would tear the new
+            # gang down twice for one incident). Pruned to pods that still
+            # exist so the stamp stays gang-sized.
+            present = {p.metadata.uid for p in pods}
+            job.status.gang_handled_uids = sorted(
+                (set(job.status.gang_handled_uids or ()) & present)
+                | {p.metadata.uid for p in stale}
+            )
             msg = (
                 f"{self.hooks.kind} {job.name} is restarting to apply a new "
                 f"replica topology ({len(stale)} stale pod(s))."
             )
-            self.cluster.record_event(
+            record_event_best_effort(
+                self.cluster,
                 Event(
                     type="Normal",
                     reason=constants.job_reason(self.hooks.kind, constants.REASON_RESTARTING),
@@ -615,7 +671,7 @@ class JobController:
                 now=self.clock(),
             )
             job.status._restarting_this_sync = True
-            self.on_job_restarting(job, "")
+            self.on_job_restarting(job, "", capi.RESTART_CAUSE_SPEC_CHANGE)
             self._write_status_if_changed(job, old_status)
             return
 
@@ -627,7 +683,7 @@ class JobController:
             replicas, pods, handled_uids=set(job.status.gang_handled_uids or ())
         )
         if gang_failure is not None:
-            rtype, failed_pod = gang_failure
+            rtype, failed_pod, cause = gang_failure
             # Recreate-ALL (JobSet semantics), Succeeded pods included: the
             # restarted world initializes with the full declared membership,
             # and a kept Succeeded coordinator (worker-0 exited 0 while a
@@ -668,7 +724,8 @@ class JobController:
                     delete_errors.append((failed_pod.metadata.name, exc))
             if delete_errors:
                 names = ", ".join(n for n, _ in delete_errors)
-                self.cluster.record_event(
+                record_event_best_effort(
+                    self.cluster,
                     Event(
                         type="Warning",
                         reason=constants.job_reason(self.hooks.kind, constants.REASON_RESTARTING),
@@ -685,15 +742,26 @@ class JobController:
                 self.requeue(f"{job.kind}:{key}", 1.0)
                 self._write_status_if_changed(job, old_status)
                 return
+            disrupted = cause == capi.RESTART_CAUSE_DISRUPTION
+            reason = constants.job_reason(
+                self.hooks.kind,
+                constants.REASON_DISRUPTION_RESTARTING if disrupted
+                else constants.REASON_RESTARTING,
+            )
+            detail = (
+                "was disrupted (preempted/evicted/drained)" if disrupted
+                else "failed retryably"
+            )
             msg = (
                 f"{self.hooks.kind} {job.name} is restarting the whole gang: "
-                f"{rtype} replica {failed_pod.metadata.name} failed retryably "
+                f"{rtype} replica {failed_pod.metadata.name} {detail} "
                 "and the SPMD world restarts as one unit."
             )
-            self.cluster.record_event(
+            record_event_best_effort(
+                self.cluster,
                 Event(
                     type="Warning",
-                    reason=constants.job_reason(self.hooks.kind, constants.REASON_RESTARTING),
+                    reason=reason,
                     message=msg,
                     involved_object=f"{job.kind}/{key}",
                 )
@@ -701,7 +769,7 @@ class JobController:
             capi.update_job_conditions(
                 job.status,
                 capi.JOB_RESTARTING,
-                constants.job_reason(self.hooks.kind, constants.REASON_RESTARTING),
+                reason,
                 msg,
                 now=self.clock(),
             )
@@ -718,12 +786,25 @@ class JobController:
                 if p.metadata.labels.get(constants.LABEL_REPLICA_TYPE)
                 in world_types
             ]
-            job.status.restart_counts[rtype] = (
-                job.status.restart_counts.get(rtype, 0) + 1
-            )
-            self.on_job_restarting(job, rtype)
+            self._count_restart(job, rtype, cause)
+            self.on_job_restarting(job, rtype, cause)
             self._write_status_if_changed(job, old_status)
             return
+
+        # Disruption restart backoff: after consecutive disruptions the job
+        # waits out a jittered exponential window before recreating pods —
+        # a reclaim loop must not hammer the scheduler with gang-sized pod
+        # churn every sync. The job stays in Restarting (not Failed) for
+        # the whole window; a requeue lands exactly when it closes.
+        backoff_until = job.status.restart_backoff_until
+        if backoff_until is not None:
+            now = self.clock()
+            if now < backoff_until:
+                job.status._restarting_this_sync = True
+                self.requeue(f"{job.kind}:{key}", backoff_until - now)
+                self._write_status_if_changed(job, old_status)
+                return
+            job.status.restart_backoff_until = None
 
         services = self.get_services_for_job(job)
         for rtype in self.hooks.replica_order(replicas):
@@ -732,6 +813,12 @@ class JobController:
             self.reconcile_services(job, services, rtype, spec)
 
         self.hooks.update_job_status(job, replicas, job.status, pods)
+
+        # The job came (back) up: close the disruption streak so the next
+        # preemption restarts immediately instead of inheriting this
+        # incident's backoff position (client-go crash-loop reset analog).
+        if job.status.disruption_streak and capi.is_running(job.status):
+            job.status.disruption_streak = 0
 
         # ActiveDeadline resync scheduling (reference :373-383).
         if (
@@ -748,53 +835,126 @@ class JobController:
     def _find_gang_retryable_failure(
         self, replicas: Dict[str, ReplicaSpec], pods: List[Pod],
         handled_uids: frozenset = frozenset(),
-    ):
-        """(rtype, pod) of the first retryably-failed replica whose type
-        opted into gang restart (restart_peers_on_failure), else None.
-        Non-retryable failures fall through to the normal status machine.
+    ) -> Optional[Tuple[str, Pod, str]]:
+        """The gang restart-cause machine: (rtype, pod, cause) of the first
+        replica whose loss requires a whole-gang restart — cause is a
+        RESTART_CAUSE_* constant deciding which budget the restart draws
+        from — else None. Triggers, in precedence order:
 
-        A trigger already Terminating is returned ONLY while some world
-        member is still live AND its teardown was not already completed
-        (status.gang_handled_uids). The controller's own teardown deletes
-        the trigger LAST, so "terminating trigger + live peers" normally
-        means the deletion was external (eviction, node drain, kubectl
-        delete) and the gang teardown still needs to run — but once that
-        teardown is counted, the trigger can linger Terminating through
-        its grace period beside the recreated world, and re-reading it as
-        fresh would tear the new gang down every sync. Once every world
-        pod is terminating, the restart is in flight — re-firing would
-        re-burn backoffLimit on one failure."""
-        terminating_candidate = None
+        1. A retryably-failed pod NOT yet terminating (fresh failure).
+           Cause: classify_pod_failure — explicit disruption markers
+           (DisruptionTarget condition, Preempted/Evicted reason) or a
+           SIGKILL-class exit on an otherwise-healthy gang are
+           InfrastructureDisruption; other retryable exits are
+           ApplicationFailure, exactly as before. Non-retryable failures
+           fall through to the normal status machine.
+        2. A retryably-failed pod already Terminating, returned ONLY while
+           some world member is still live AND its teardown was not already
+           counted (status.gang_handled_uids). The controller's own
+           teardown deletes the trigger LAST, so "terminating trigger +
+           live peers" normally means the deletion was external (eviction,
+           node drain, kubectl delete) — but once that teardown is
+           counted, the trigger can linger Terminating through its grace
+           period beside the recreated world, and re-reading it as fresh
+           would tear the new gang down every sync. Once every world pod
+           is terminating, the restart is in flight — re-firing would
+           re-burn a budget on one failure.
+        3. A RUNNING/PENDING in-range world pod externally deleted
+           (deletion_timestamp set before it ever failed): node drain. The
+           controller's own paths that delete live world pods (gang
+           teardown, stale-world resize, suspension, scale-down) either
+           stamp handled_uids, take all world pods down together (no live
+           peer remains), or delete only out-of-range indices — so a live
+           in-range Terminating pod beside live peers can only be an
+           external actor, and leaving the survivors up would hand the
+           SPMD world a lone replacement it cannot re-admit. Always an
+           InfrastructureDisruption.
+        """
+        terminating_candidate: Optional[Tuple[str, Pod, str]] = None
+        drained_candidate: Optional[Tuple[str, Pod, str]] = None
         world_types_lower = set()
+        # "Otherwise-healthy gang": no world pod failed with a PERMANENT
+        # exit code — a lone SIGKILL under healthy peers reads as
+        # preemption; the same code amid application crashes does not.
+        peers_healthy = True
+        for rtype, spec in replicas.items():
+            if not self.hooks.restart_peers_on_failure(rtype):
+                continue
+            for pod in filter_pods_for_replica_type(pods, rtype):
+                if pod.status.phase != POD_FAILED:
+                    continue
+                code = get_container_exit_code(pod, self.hooks.default_container_name)
+                if code != constants.EXIT_CODE_UNSET and not capi.is_retryable_exit_code(code):
+                    peers_healthy = False
         for rtype, spec in replicas.items():
             if spec.restart_policy != capi.RESTART_POLICY_EXIT_CODE:
                 continue
             if not self.hooks.restart_peers_on_failure(rtype):
                 continue
             world_types_lower.add(rtype.lower())
+            num_replicas = spec.replicas or 0
             for pod in filter_pods_for_replica_type(pods, rtype):
                 if pod.status.phase != POD_FAILED:
+                    if (
+                        drained_candidate is None
+                        and pod.status.phase in (POD_RUNNING, POD_PENDING)
+                        and pod.metadata.deletion_timestamp is not None
+                        and pod.metadata.uid not in handled_uids
+                        and self._replica_index(pod) < num_replicas
+                    ):
+                        drained_candidate = (
+                            rtype, pod, capi.RESTART_CAUSE_DISRUPTION
+                        )
                     continue
                 exit_code = get_container_exit_code(
                     pod, self.hooks.default_container_name
                 )
                 if not capi.is_retryable_exit_code(exit_code):
                     continue
+                cause = capi.classify_pod_failure(
+                    pod, exit_code, peers_healthy=peers_healthy
+                )
                 if pod.metadata.deletion_timestamp is None:
-                    return rtype, pod
+                    return rtype, pod, cause
                 if (
                     terminating_candidate is None
                     and pod.metadata.uid not in handled_uids
                 ):
-                    terminating_candidate = (rtype, pod)
-        if terminating_candidate is not None and any(
+                    terminating_candidate = (rtype, pod, cause)
+        candidate = terminating_candidate or drained_candidate
+        if candidate is not None and any(
             p.metadata.deletion_timestamp is None
             and p.metadata.labels.get(constants.LABEL_REPLICA_TYPE)
             in world_types_lower
             for p in pods
         ):
-            return terminating_candidate
+            return candidate
         return None
+
+    @staticmethod
+    def _replica_index(pod: Pod) -> int:
+        try:
+            return int(pod.metadata.labels.get(constants.LABEL_REPLICA_INDEX, ""))
+        except ValueError:
+            return -1
+
+    def _count_restart(self, job: JobObject, rtype: str, cause: str) -> None:
+        """Charge one restart to the budget its cause draws from, and open
+        the disruption-backoff window when a disruption streak builds."""
+        if cause == capi.RESTART_CAUSE_DISRUPTION:
+            job.status.disruption_counts[rtype] = (
+                job.status.disruption_counts.get(rtype, 0) + 1
+            )
+            job.status.disruption_streak += 1
+            delay = disruption_backoff_seconds(
+                job.metadata.uid, job.status.disruption_streak
+            )
+            if delay > 0:
+                job.status.restart_backoff_until = self.clock() + delay
+        else:
+            job.status.restart_counts[rtype] = (
+                job.status.restart_counts.get(rtype, 0) + 1
+            )
 
     # -------------------------------------------------------------- pods
     def reconcile_pods(
@@ -830,7 +990,8 @@ class JobController:
 
             exit_code = get_container_exit_code(pod, self.hooks.default_container_name)
             if exit_code != constants.EXIT_CODE_UNSET:
-                self.cluster.record_event(
+                record_event_best_effort(
+                    self.cluster,
                     Event(
                         type="Normal",
                         reason=constants.REASON_EXITED_WITH_CODE,
@@ -852,13 +1013,40 @@ class JobController:
                 job_status._restarting_this_sync = True
             elif retryable_failure:
                 # Retryable failure: delete the pod (recreated next sync) and
-                # mark the job Restarting (reference :717-736).
+                # mark the job Restarting (reference :717-736). Same cause
+                # classification as the gang path: a preempted/evicted pod
+                # restarts on the disruption budget, a crashing one on
+                # backoffLimit. peers_healthy: no OTHER pod of the job
+                # failed permanently this sync.
+                peers_healthy = not any(
+                    p is not pod
+                    and p.status.phase == POD_FAILED
+                    and (c := get_container_exit_code(
+                        p, self.hooks.default_container_name
+                    )) != constants.EXIT_CODE_UNSET
+                    and not capi.is_retryable_exit_code(c)
+                    for p in pods
+                )
+                cause = capi.classify_pod_failure(
+                    pod, exit_code, peers_healthy=peers_healthy
+                )
+                disrupted = cause == capi.RESTART_CAUSE_DISRUPTION
+                reason = constants.job_reason(
+                    self.hooks.kind,
+                    constants.REASON_DISRUPTION_RESTARTING if disrupted
+                    else constants.REASON_RESTARTING,
+                )
+                detail = "was disrupted" if disrupted else "failed"
                 self._delete_pod(job, pod)
-                msg = f"{self.hooks.kind} {job.name} is restarting because {rtype} replica(s) failed."
-                self.cluster.record_event(
+                msg = (
+                    f"{self.hooks.kind} {job.name} is restarting because "
+                    f"{rtype} replica(s) {detail}."
+                )
+                record_event_best_effort(
+                    self.cluster,
                     Event(
                         type="Warning",
-                        reason=constants.job_reason(self.hooks.kind, constants.REASON_RESTARTING),
+                        reason=reason,
                         message=msg,
                         involved_object=f"{job.kind}/{job.key()}",
                     )
@@ -866,18 +1054,17 @@ class JobController:
                 capi.update_job_conditions(
                     job_status,
                     capi.JOB_RESTARTING,
-                    constants.job_reason(self.hooks.kind, constants.REASON_RESTARTING),
+                    reason,
                     msg,
                     now=self.clock(),
                 )
                 job_status._restarting_this_sync = True
                 # Durable restart accounting: the deleted pod's kubelet
-                # counter dies with it, but backoffLimit must see the
-                # restart (checked at the next sync's run-policy gate).
-                job_status.restart_counts[rtype] = (
-                    job_status.restart_counts.get(rtype, 0) + 1
-                )
-                self.on_job_restarting(job, rtype)
+                # counter dies with it, but the budget its cause draws
+                # from must see the restart (checked at the next sync's
+                # run-policy gate).
+                self._count_restart(job, rtype, cause)
+                self.on_job_restarting(job, rtype, cause)
 
             update_job_replica_statuses(job_status, rtype, pod)
 
@@ -1041,6 +1228,19 @@ class JobController:
             return restarts > 0
         return restarts >= run_policy.backoff_limit
 
+    def _past_disruption_limit(self, job: JobObject, run_policy) -> bool:
+        """The disruption budget (RunPolicy.maxDisruptionRetries) mirrors
+        backoffLimit's accounting over the disruption ledger. None —
+        the default — is unlimited: preemption-and-resume is a normal,
+        budget-free operation on TPU fleets."""
+        limit = run_policy.max_disruption_retries
+        if limit is None:
+            return False
+        disruptions = sum(job.status.disruption_counts.values())
+        if limit == 0:
+            return disruptions > 0
+        return disruptions >= limit
+
     # ----------------------------------------------------------- suspension
     def _suspend_job(
         self, job: JobObject, pods: List[Pod], replicas: Dict[str, ReplicaSpec], run_policy
@@ -1063,9 +1263,22 @@ class JobController:
         # `active` counts would report live workers on a released slice.
         for rtype in replicas:
             job.status.replica_statuses[rtype] = capi.ReplicaStatus()
+        deleted_uids = []
         for pod in pods:
             if pod.metadata.deletion_timestamp is None:
                 self._delete_pod(job, pod)
+                deleted_uids.append(pod.metadata.uid)
+        if deleted_uids:
+            # Suspension teardown is controller-initiated: stamp it so a
+            # quick resume doesn't misread the still-terminating pods as a
+            # node-drain disruption of the fresh world. Merged (pruned to
+            # present pods), same as the stale-world stamp: replacing would
+            # un-handle a counted trigger still in its grace period.
+            present = {p.metadata.uid for p in pods}
+            job.status.gang_handled_uids = sorted(
+                (set(job.status.gang_handled_uids or ()) & present)
+                | set(deleted_uids)
+            )
         for svc in self.get_services_for_job(job):
             self.service_control.delete_service(svc.metadata.namespace, svc.metadata.name, job)
         if self.options.enable_gang_scheduling:
@@ -1079,7 +1292,8 @@ class JobController:
                 msg,
                 now=self.clock(),
             )
-            self.cluster.record_event(
+            record_event_best_effort(
+                self.cluster,
                 Event(
                     type="Normal",
                     reason=constants.job_reason(self.hooks.kind, constants.REASON_SUSPENDED),

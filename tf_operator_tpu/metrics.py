@@ -55,6 +55,23 @@ class Metrics:
         ("training_operator_jobs_failed_total", "The number of failed jobs"),
         ("training_operator_jobs_restarted_total", "The number of restarted jobs"),
     )
+    # Counters with label sets beyond (job_namespace, framework): name ->
+    # (label names, help). Values live in _labeled_counters keyed by the
+    # label-value tuple, in label-name order.
+    _LABELED_COUNTERS = {
+        "training_operator_jobs_restarted_by_cause_total": (
+            ("job_namespace", "framework", "cause"),
+            "Operator-initiated job restarts by restart cause "
+            "(ApplicationFailure consumes backoffLimit; "
+            "InfrastructureDisruption consumes maxDisruptionRetries)",
+        ),
+        "training_operator_expectation_timeouts_total": (
+            ("job_namespace", "framework", "kind"),
+            "Expectations that expired unfulfilled (a dependent watch event "
+            "never arrived); the job self-healed but was wedged for the "
+            "full expectation window",
+        ),
+    }
     _HISTOGRAM_BUCKETS = (0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600)
     # Reconciles are ms-scale; startup/restart are seconds-scale.
     _BUCKETS_BY_NAME = {
@@ -67,6 +84,9 @@ class Metrics:
         self._lock = threading.Lock()
         self._counters: Dict[str, Dict[Tuple[str, str], int]] = {
             name: defaultdict(int) for name, _ in self._COUNTERS
+        }
+        self._labeled_counters: Dict[str, Dict[Tuple[str, ...], int]] = {
+            name: defaultdict(int) for name in self._LABELED_COUNTERS
         }
         self._terminal_seen: Set[Tuple[str, str, str]] = set()
 
@@ -100,6 +120,29 @@ class Metrics:
 
     def restarted_inc(self, namespace: str, framework: str) -> None:
         self._inc("training_operator_jobs_restarted_total", namespace, framework)
+
+    def _inc_labeled(self, name: str, *label_values: str) -> None:
+        with self._lock:
+            self._labeled_counters[name][tuple(label_values)] += 1
+
+    def labeled_counter_value(self, name: str, *label_values: str) -> int:
+        with self._lock:
+            return self._labeled_counters[name][tuple(label_values)]
+
+    def restarted_by_cause_inc(self, namespace: str, framework: str, cause: str) -> None:
+        """Restart-cause breakdown (ApplicationFailure vs
+        InfrastructureDisruption) beside the legacy cause-blind
+        jobs_restarted_total, which keeps its reference-parity meaning."""
+        self._inc_labeled(
+            "training_operator_jobs_restarted_by_cause_total",
+            namespace, framework, cause,
+        )
+
+    def expectation_timeout_inc(self, namespace: str, framework: str, kind: str) -> None:
+        self._inc_labeled(
+            "training_operator_expectation_timeouts_total",
+            namespace, framework, kind,
+        )
 
     def successful_inc_once(self, namespace: str, framework: str, job_key: str) -> None:
         """`job_key` should be the job UID (unique per incarnation): a
@@ -164,6 +207,14 @@ class Metrics:
                 lines.append(f"# TYPE {name} counter")
                 for (ns, fw), value in sorted(self._counters[name].items()):
                     lines.append(f'{name}{{job_namespace="{ns}",framework="{fw}"}} {value}')
+            for name, (label_names, help_text) in self._LABELED_COUNTERS.items():
+                lines.append(f"# HELP {name} {help_text}")
+                lines.append(f"# TYPE {name} counter")
+                for values, count in sorted(self._labeled_counters[name].items()):
+                    label = ",".join(
+                        f'{ln}="{lv}"' for ln, lv in zip(label_names, values)
+                    )
+                    lines.append(f"{name}{{{label}}} {count}")
             for name, series in self._histograms.items():
                 lines.append(f"# HELP {name} {name.replace('_', ' ')}")
                 lines.append(f"# TYPE {name} histogram")
