@@ -224,3 +224,53 @@ class TestSerialization:
         assert isinstance(job, jaxjob.JAXJob)
         with pytest.raises(Exception):
             parse_job({"kind": "Nope"})
+
+
+class TestLivenessDeadlineDefaults:
+    """Both gang-liveness deadlines default to UNSET (off) on every kind:
+    existing jobs that never heartbeat must never become stall-restartable
+    by defaulting alone."""
+
+    def test_tfjob_defaults_leave_deadlines_unset(self):
+        job = make_tfjob()
+        tfjob.set_defaults(job)
+        assert job.spec.run_policy.progress_deadline_seconds is None
+        assert job.spec.run_policy.rendezvous_deadline_seconds is None
+
+    def test_parse_without_run_policy_leaves_deadlines_unset(self):
+        job = tfjob.TFJob.parse({
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "TFJob",
+            "metadata": {"name": "t", "namespace": "default"},
+            "spec": {"tfReplicaSpecs": {"Worker": {
+                "replicas": 1,
+                "template": {"spec": {"containers": [
+                    {"name": "tensorflow", "image": "img"}]}},
+            }}},
+        })
+        tfjob.set_defaults(job)
+        assert job.spec.run_policy.progress_deadline_seconds is None
+        assert job.spec.run_policy.rendezvous_deadline_seconds is None
+
+    def test_parse_round_trips_declared_deadlines(self):
+        job = jaxjob.JAXJob.parse({
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "JAXJob",
+            "metadata": {"name": "j", "namespace": "default"},
+            "spec": {
+                "runPolicy": {"progressDeadlineSeconds": 120,
+                              "rendezvousDeadlineSeconds": 240},
+                "jaxReplicaSpecs": {"Worker": {
+                    "replicas": 2,
+                    "template": {"spec": {"containers": [
+                        {"name": "jax", "image": "img"}]}},
+                }},
+            },
+        })
+        jaxjob.set_defaults(job)
+        rp = job.spec.run_policy
+        assert rp.progress_deadline_seconds == 120
+        assert rp.rendezvous_deadline_seconds == 240
+        out = job.to_dict()
+        assert out["spec"]["runPolicy"]["progressDeadlineSeconds"] == 120
+        assert out["spec"]["runPolicy"]["rendezvousDeadlineSeconds"] == 240

@@ -181,3 +181,59 @@ class TestJAXJobValidation:
         assert not common.is_retryable_exit_code(127)
         assert common.is_retryable_exit_code(128)
         assert common.is_retryable_exit_code(137)
+
+
+class TestRunPolicyLivenessValidation:
+    """Gang-liveness deadline admission rules (docs/design/failure_modes.md
+    §8): positive ints only, rendezvous requires progress, both default
+    unset so heartbeat-less jobs can never stall-restart."""
+
+    def _spec(self, **rp):
+        return tfjob.TFJobSpec(
+            run_policy=common.RunPolicy(**rp),
+            tf_replica_specs={tfjob.REPLICA_TYPE_WORKER: replica("tensorflow")},
+        )
+
+    def test_valid_deadlines(self):
+        tfjob.validate(self._spec(progress_deadline_seconds=300))
+        tfjob.validate(
+            self._spec(progress_deadline_seconds=300,
+                       rendezvous_deadline_seconds=600)
+        )
+
+    @pytest.mark.parametrize("bad", [0, -1, 1.5, "soon", True])
+    def test_progress_deadline_must_be_positive_int(self, bad):
+        with pytest.raises(ValidationError, match="progressDeadlineSeconds"):
+            tfjob.validate(self._spec(progress_deadline_seconds=bad))
+
+    @pytest.mark.parametrize("bad", [0, -30, "fast", False])
+    def test_rendezvous_deadline_must_be_positive_int(self, bad):
+        with pytest.raises(ValidationError, match="rendezvousDeadlineSeconds"):
+            tfjob.validate(
+                self._spec(progress_deadline_seconds=60,
+                           rendezvous_deadline_seconds=bad)
+            )
+
+    def test_rendezvous_requires_progress_opt_in(self):
+        with pytest.raises(ValidationError, match="requires runPolicy.progressDeadlineSeconds"):
+            tfjob.validate(self._spec(rendezvous_deadline_seconds=60))
+
+    def test_every_kind_validates_run_policy(self):
+        rp = common.RunPolicy(rendezvous_deadline_seconds=60)
+        cases = [
+            (pytorchjob, pytorchjob.PyTorchJobSpec(
+                run_policy=rp,
+                pytorch_replica_specs={"Master": replica("pytorch")})),
+            (mxjob, mxjob.MXJobSpec(
+                run_policy=rp,
+                mx_replica_specs={"Worker": replica("mxnet")})),
+            (xgboostjob, xgboostjob.XGBoostJobSpec(
+                run_policy=rp,
+                xgb_replica_specs={"Master": replica("xgboost")})),
+            (jaxjob, jaxjob.JAXJobSpec(
+                run_policy=rp,
+                jax_replica_specs={"Worker": replica("jax")})),
+        ]
+        for module, spec in cases:
+            with pytest.raises(ValidationError, match="rendezvousDeadlineSeconds"):
+                module.validate(spec)

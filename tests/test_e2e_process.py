@@ -11,6 +11,7 @@ state machine.
 
 import json
 import os
+import signal
 import sys
 import time
 import urllib.request
@@ -741,6 +742,90 @@ class TestMultisliceTraining:
             # Workers 0,1 are slice 0; workers 2,3 are slice 1.
             assert f"slice={i // 2}/2" in log, log
             assert "[llama] done" in log, log
+
+
+class TestProgressStallLiveProcesses:
+    def test_sigstop_wedged_worker_restarts_with_progress_stall(self, harness):
+        """The gang-liveness e2e (ISSUE 2 acceptance): SIGSTOP one worker
+        of a live 2-process rendezvous workload mid-training-loop. The
+        process stays alive under a live kubelet-analog (phase Running,
+        poll() None) — the exact silent wedge activeDeadlineSeconds cannot
+        distinguish from progress. Its heartbeat file freezes with it, the
+        bridge stops renewing its Lease, and within
+        progressDeadlineSeconds the operator must gang-restart with
+        reason ProgressStall; the recreated world then runs to Succeeded
+        on the stall ledger alone."""
+        cmd = RENDEZVOUS_CMD + ["--progress-steps", "120",
+                                "--step-seconds", "0.25"]
+        harness.create_job({
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "JAXJob",
+            "metadata": {"name": "stl", "namespace": "default"},
+            "spec": {
+                "runPolicy": {"progressDeadlineSeconds": 5,
+                              "rendezvousDeadlineSeconds": 180},
+                "jaxReplicaSpecs": {"Worker": {
+                    "replicas": 2,
+                    "template": {"spec": {"containers": [
+                        {"name": "jax", "image": "local", "command": cmd}
+                    ]}},
+                }},
+            },
+        })
+
+        def beating():
+            try:
+                harness.get_lease("default", "stl-worker-0-hb")
+                harness.get_lease("default", "stl-worker-1-hb")
+                return True
+            except KeyError:
+                return False
+
+        # Both workers rendezvoused and proved liveness through the
+        # file->Lease bridge before we wedge one.
+        assert wait_for(beating, timeout=180), "heartbeats never appeared"
+        starts = {i: harness.get_pod("default", f"stl-worker-{i}").status.start_time
+                  for i in range(2)}
+        harness.kill_pod("default", "stl-worker-1", sig=signal.SIGSTOP)
+        # Still Running as far as any phase-based check can tell.
+        assert harness.get_pod("default", "stl-worker-1").status.phase == "Running"
+
+        assert wait_for(
+            lambda: any(
+                e.reason == "JAXJobProgressStallRestarting"
+                for e in harness.list_events("JAXJob/default/stl")
+            ),
+            timeout=90,
+        ), "stall never detected"
+
+        def world_recreated():
+            try:
+                pods = {i: harness.get_pod("default", f"stl-worker-{i}")
+                        for i in range(2)}
+            except KeyError:
+                return False
+            return all(
+                p.status.start_time is not None
+                and p.status.start_time > starts[i]
+                for i, p in pods.items()
+            )
+
+        assert wait_for(world_recreated, timeout=90), (
+            "stall restart did not recreate the whole gang")
+        assert wait_for(
+            lambda: job_condition(harness, "JAXJob", "stl", "Succeeded"),
+            timeout=300,
+        ), harness.get_pod_log("default", "stl-worker-0")[-3000:]
+        job = harness.get_job("JAXJob", "default", "stl")
+        status = job["status"]
+        assert status.get("stallCounts") == {"Worker": 1}, status
+        # Ledger disjointness end to end: neither backoffLimit accounting
+        # nor the disruption budget saw the wedge.
+        assert "restartCounts" not in status, status
+        assert "disruptionCounts" not in status, status
+        assert not job_condition(harness, "JAXJob", "stl", "Failed")
+        log1 = harness.get_pod_log("default", "stl-worker-1")
+        assert "progress loop done" in log1, log1[-2000:]
 
 
 class TestJAXJobRendezvous:

@@ -1,0 +1,438 @@
+"""Gang liveness, unit tier: the in-container heartbeat publisher (Lease
+renewals through the Cluster seam + the process-tier file bridge) and the
+engine's stall detector (progress/rendezvous deadlines, skew-safe
+observation clocks, deadline resync scheduling, ledger disjointness, env
+injection, lease GC). Design: docs/design/failure_modes.md §8.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tf_operator_tpu.api import common as capi
+from tf_operator_tpu.bootstrap import heartbeat as hb_bootstrap
+from tf_operator_tpu.cluster.base import NotFound
+from tf_operator_tpu.cluster.memory import InMemoryCluster
+from tf_operator_tpu.controllers.jax import JAXController
+from tf_operator_tpu.core.constants import (
+    ANNOTATION_HEARTBEAT_STEP,
+    heartbeat_lease_name,
+)
+from tf_operator_tpu.metrics import Metrics
+from tf_operator_tpu.runtime import heartbeat as hb
+
+
+def container(name):
+    return {"name": name, "image": "test:1"}
+
+
+def jax_manifest(name="llama", workers=2, run_policy=None):
+    spec = {
+        "jaxReplicaSpecs": {
+            "Worker": {
+                "replicas": workers,
+                "template": {"spec": {"containers": [container("jax")]}},
+            }
+        },
+    }
+    if run_policy:
+        spec["runPolicy"] = run_policy
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "JAXJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": spec,
+    }
+
+
+def conds_of(cluster, kind, name):
+    job = cluster.get_job(kind, "default", name)
+    return {c["type"]: c for c in (job.get("status") or {}).get("conditions") or []}
+
+
+class TestPublishHeartbeat:
+    def test_create_then_renew(self):
+        cluster = InMemoryCluster()
+        now = [100.0]
+        assert hb.publish_heartbeat(
+            cluster, "default", "p-0-hb", "p-0", step=1, clock=lambda: now[0]
+        )
+        lease = cluster.get_lease("default", "p-0-hb")
+        assert lease["spec"]["holderIdentity"] == "p-0"
+        assert lease["metadata"]["annotations"][ANNOTATION_HEARTBEAT_STEP] == "1"
+        first_renew = lease["spec"]["renewTime"]
+        now[0] += 30
+        assert hb.publish_heartbeat(
+            cluster, "default", "p-0-hb", "p-0", step=2, clock=lambda: now[0]
+        )
+        lease = cluster.get_lease("default", "p-0-hb")
+        assert lease["spec"]["renewTime"] != first_renew
+        assert lease["metadata"]["annotations"][ANNOTATION_HEARTBEAT_STEP] == "2"
+
+    def test_conflict_loses_round_without_raising(self):
+        """A concurrent writer bumping the rv between GET and PUT must cost
+        one beat, never crash the publisher (leaderelection idiom)."""
+        cluster = InMemoryCluster()
+        assert hb.publish_heartbeat(cluster, "default", "p-0-hb", "p-0")
+        original_get = cluster.get_lease
+
+        def racing_get(ns, name):
+            lease = original_get(ns, name)
+            cluster.update_lease(original_get(ns, name))  # rv bump
+            return lease  # stale rv
+
+        cluster.get_lease = racing_get
+        assert not hb.publish_heartbeat(cluster, "default", "p-0-hb", "p-0")
+
+    def test_transient_error_skips_beat(self):
+        cluster = InMemoryCluster()
+
+        def boom(*a, **k):
+            raise RuntimeError("apiserver 500")
+
+        cluster.get_lease = boom
+        assert not hb.publish_heartbeat(cluster, "default", "p-0-hb", "p-0")
+
+    def test_file_bridge_round_trip(self, tmp_path):
+        path = str(tmp_path / "beat.hb")
+        assert hb.read_heartbeat_file(path) is None  # absent
+        hb.write_heartbeat_file(path, seq=3, step=17)
+        beat = hb.read_heartbeat_file(path)
+        assert beat["seq"] == 3 and beat["step"] == 17
+        with open(path, "w") as fh:
+            fh.write("{torn")
+        assert hb.read_heartbeat_file(path) is None  # torn write tolerated
+
+
+class TestHeartbeatPublisher:
+    def test_beats_and_record_progress(self):
+        beats = []
+        done = threading.Event()
+
+        def sink(seq, step):
+            beats.append((seq, step))
+            if len(beats) >= 2:
+                done.set()
+
+        pub = hb.HeartbeatPublisher(sink, interval=10.0).start()
+        try:
+            # First beat fires immediately; record_progress wakes the loop
+            # long before the 10s interval.
+            pub.record_progress(step=7)
+            assert done.wait(5.0), beats
+            assert beats[0][0] == 1
+            assert any(step == 7 for _, step in beats)
+        finally:
+            pub.stop()
+
+    def test_sink_failure_never_escapes(self):
+        def sink(seq, step):
+            raise RuntimeError("boom")
+
+        pub = hb.HeartbeatPublisher(sink, interval=10.0)
+        pub.beat_once()  # must not raise
+
+    def test_start_from_env_no_env_is_noop(self):
+        assert hb.start_from_env(env={}) is None
+
+    def test_start_from_env_file_sink(self, tmp_path):
+        path = str(tmp_path / "p.hb")
+        env = {
+            hb_bootstrap.ENV_HEARTBEAT_LEASE: "p-0-hb",
+            hb_bootstrap.ENV_HEARTBEAT_NAMESPACE: "default",
+            hb_bootstrap.ENV_HEARTBEAT_INTERVAL: "0.05",
+            hb_bootstrap.ENV_HEARTBEAT_FILE: path,
+        }
+        try:
+            pub = hb.start_from_env(env=env)
+            assert pub is not None
+            assert hb.start_from_env(env=env) is pub  # idempotent
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                beat = hb.read_heartbeat_file(path)
+                if beat and beat["seq"] >= 2:
+                    break
+                time.sleep(0.02)
+            assert hb.read_heartbeat_file(path)["seq"] >= 2
+        finally:
+            hb.stop()
+
+    def test_start_from_env_cluster_sink(self):
+        cluster = InMemoryCluster()
+        env = {
+            hb_bootstrap.ENV_HEARTBEAT_LEASE: "w-1-hb",
+            hb_bootstrap.ENV_HEARTBEAT_NAMESPACE: "ns1",
+            hb_bootstrap.ENV_HEARTBEAT_INTERVAL: "0.05",
+        }
+        try:
+            pub = hb.start_from_env(cluster=cluster, env=env)
+            assert pub is not None
+            deadline = time.monotonic() + 5.0
+            lease = None
+            while time.monotonic() < deadline:
+                try:
+                    lease = cluster.get_lease("ns1", "w-1-hb")
+                    break
+                except NotFound:
+                    time.sleep(0.02)
+            assert lease is not None and lease["spec"]["holderIdentity"]
+        finally:
+            hb.stop()
+
+
+class Harness:
+    """Fake-clock engine harness (the TestDisruptionBudget idiom)."""
+
+    def __init__(self, run_policy=None, workers=2):
+        self.now = [1000.0]
+        self.cluster = InMemoryCluster(clock=lambda: self.now[0])
+        self.metrics = Metrics()
+        # The workqueue shares the fake clock so AddAfter deadline resyncs
+        # come due when the test advances time, not wall time.
+        from tf_operator_tpu.core.workqueue import WorkQueue
+
+        self.controller = JAXController(
+            self.cluster, queue=WorkQueue(clock=lambda: self.now[0]),
+            metrics=self.metrics, clock=lambda: self.now[0]
+        )
+        self.cluster.create_job(jax_manifest(run_policy=run_policy, workers=workers))
+        self.controller.run_until_idle()
+        for p in self.cluster.list_pods():
+            self.cluster.set_pod_phase("default", p.metadata.name, "Running")
+        self.controller.run_until_idle()
+
+    def beat(self, *names, step=None):
+        for name in names:
+            assert hb.publish_heartbeat(
+                self.cluster, "default", heartbeat_lease_name(name), name,
+                step=step, clock=lambda: self.now[0],
+            )
+
+    def sync(self):
+        self.controller.queue.add("JAXJob:default/llama")
+        self.controller.run_until_idle()
+
+    def status(self):
+        return self.cluster.get_job("JAXJob", "default", "llama")["status"]
+
+
+class TestEngineStallDetection:
+    def test_deadlines_unset_means_no_liveness_machinery(self):
+        h = Harness(run_policy=None)
+        # No heartbeat env injected...
+        for p in h.cluster.list_pods():
+            env = {e.name for e in p.spec.containers[0].env}
+            assert hb_bootstrap.ENV_HEARTBEAT_LEASE not in env
+        # ...and heartbeat-less months of wall clock never stall the job.
+        for _ in range(5):
+            h.now[0] += 86400 * 30
+            h.sync()
+        assert "stallCounts" not in h.status()
+        assert conds_of(h.cluster, "JAXJob", "llama").get(
+            "Restarting", {}).get("status") != "True"
+
+    def test_heartbeat_env_injected_when_opted_in(self):
+        h = Harness(run_policy={"progressDeadlineSeconds": 40})
+        for p in h.cluster.list_pods():
+            env = {e.name: e.value for e in p.spec.containers[0].env}
+            assert env[hb_bootstrap.ENV_HEARTBEAT_LEASE] == (
+                f"{p.metadata.name}-hb")
+            assert env[hb_bootstrap.ENV_HEARTBEAT_NAMESPACE] == "default"
+            assert float(env[hb_bootstrap.ENV_HEARTBEAT_INTERVAL]) == 10.0
+
+    def test_heartbeat_less_job_with_progress_deadline_never_stalls(self):
+        """progressDeadlineSeconds alone measures staleness of OBSERVED
+        renewals: a job that never heartbeats (a TF job without the
+        runtime wired) has nothing to go stale and must never restart."""
+        h = Harness(run_policy={"progressDeadlineSeconds": 30})
+        for _ in range(10):
+            h.now[0] += 3600
+            h.sync()
+        assert "stallCounts" not in h.status()
+
+    def test_progress_stall_detected_and_gang_restarted(self):
+        h = Harness(run_policy={"progressDeadlineSeconds": 30})
+        h.beat("llama-worker-0", "llama-worker-1")
+        h.sync()
+        uids_before = {p.metadata.name: p.metadata.uid
+                       for p in h.cluster.list_pods()}
+        # worker-0 keeps renewing; worker-1 freezes silently.
+        for _ in range(3):
+            h.now[0] += 15
+            h.beat("llama-worker-0")
+            h.sync()
+        status = h.status()
+        assert status["stallCounts"] == {"Worker": 1}
+        assert "restartCounts" not in status
+        assert "disruptionCounts" not in status
+        conds = conds_of(h.cluster, "JAXJob", "llama")
+        # The condition may already have advanced past Restarting (the
+        # recreated pods re-enqueue syncs); the event stream is durable.
+        assert any(
+            e.reason == "JAXJobProgressStallRestarting" and e.type == "Warning"
+            for e in h.cluster.list_events()
+        )
+        assert h.metrics.labeled_counter_value(
+            "training_operator_jobs_restarted_by_cause_total",
+            "default", "JAXJob", capi.RESTART_CAUSE_STALL,
+        ) == 1
+        # Whole-gang restart: the healthy worker-0 was replaced too.
+        h.sync()
+        after = {p.metadata.name: p.metadata.uid for p in h.cluster.list_pods()}
+        assert len(after) == 2
+        for name, uid in after.items():
+            assert uid != uids_before[name], f"{name} must be replaced"
+        assert conds.get("Failed", {}).get("status") != "True"
+
+    def test_detection_within_deadline_via_scheduled_resync(self):
+        """A stopped heartbeat generates no watch event: the engine must
+        wake ITSELF via AddAfter. With no external re-enqueue at all, the
+        delayed item lands and the stall is detected once the clock
+        crosses the deadline."""
+        h = Harness(run_policy={"progressDeadlineSeconds": 30})
+        h.beat("llama-worker-0", "llama-worker-1")
+        h.sync()
+        assert h.controller.queue.depth()["delayed"] >= 1, (
+            "liveness check must schedule its own deadline resync")
+        h.now[0] += 31  # cross the deadline; the delayed item is now due
+        h.controller.run_until_idle()
+        assert h.status().get("stallCounts") == {"Worker": 2} or (
+            h.status().get("stallCounts") == {"Worker": 1}
+        )
+
+    def test_rendezvous_deadline_catches_never_heartbeat(self):
+        h = Harness(run_policy={
+            "progressDeadlineSeconds": 30, "rendezvousDeadlineSeconds": 50,
+        })
+        # worker-0 rendezvoused; worker-1 never produces a first beat.
+        h.beat("llama-worker-0")
+        h.sync()
+        h.now[0] += 40
+        h.beat("llama-worker-0")
+        h.sync()
+        assert "stallCounts" not in h.status()  # inside the bound
+        h.now[0] += 15  # 55s since gang-up > 50
+        h.beat("llama-worker-0")
+        h.sync()
+        status = h.status()
+        assert status["stallCounts"] == {"Worker": 1}
+        assert any(
+            "rendezvousDeadlineSeconds" in e.message
+            for e in h.cluster.list_events()
+            if e.reason == "JAXJobProgressStallRestarting"
+        )
+
+    def test_skew_safety_remote_timestamps_ignored(self):
+        """A worker with a wildly skewed clock (renewTime an hour in the
+        past) must NOT read as stalled: staleness is measured from when
+        the controller OBSERVES each renewal change, never by comparing
+        the remote timestamp to local now."""
+        h = Harness(run_policy={"progressDeadlineSeconds": 30})
+        skewed = lambda: h.now[0] - 3600  # noqa: E731
+        for _ in range(6):
+            for name in ("llama-worker-0", "llama-worker-1"):
+                hb.publish_heartbeat(
+                    h.cluster, "default", heartbeat_lease_name(name), name,
+                    clock=skewed,
+                )
+            h.sync()
+            h.now[0] += 15
+        assert "stallCounts" not in h.status()
+
+    def test_heartbeat_age_gauge_exported_and_cleared(self):
+        h = Harness(run_policy={"progressDeadlineSeconds": 300})
+        h.beat("llama-worker-0", "llama-worker-1")
+        h.sync()
+        h.now[0] += 42
+        h.sync()
+        age = h.metrics.heartbeat_age_value("default", "JAXJob", "llama")
+        assert age == pytest.approx(42, abs=1e-6)
+        assert 'training_operator_heartbeat_age_seconds{job_namespace="default"' \
+            in h.metrics.render()
+        # Deleting the job clears the series (no unbounded growth).
+        h.cluster.delete_job("JAXJob", "default", "llama")
+        h.controller.run_until_idle()
+        assert h.metrics.heartbeat_age_value("default", "JAXJob", "llama") is None
+
+    def test_terminal_job_gcs_heartbeat_leases(self):
+        h = Harness(run_policy={"progressDeadlineSeconds": 30,
+                                "cleanPodPolicy": "All"})
+        h.beat("llama-worker-0", "llama-worker-1")
+        h.sync()
+        assert h.cluster.get_lease("default", "llama-worker-0-hb")
+        # Every worker exits 0 -> SPMD completion -> job Succeeded.
+        for name in ("llama-worker-0", "llama-worker-1"):
+            h.cluster.set_pod_phase("default", name, "Succeeded", exit_code=0)
+        h.sync()
+        assert conds_of(h.cluster, "JAXJob", "llama")["Succeeded"]["status"] == "True"
+        h.sync()
+        for name in ("llama-worker-0-hb", "llama-worker-1-hb"):
+            with pytest.raises(NotFound):
+                h.cluster.get_lease("default", name)
+
+    def test_recreated_pod_not_credited_with_predecessor_lease(self):
+        """A recreated pod inherits its predecessor's (frozen) Lease.
+        Crediting that as the new pod's first heartbeat would start the
+        staleness clock at a renewal this process never made — and
+        stall-loop every restart before the new world can rendezvous (the
+        e2e tier caught exactly this). The first read baselines; only an
+        observed CHANGE proves liveness."""
+        h = Harness(run_policy={"progressDeadlineSeconds": 30})
+        h.beat("llama-worker-0", "llama-worker-1")
+        h.sync()
+        # The world is replaced (stale leases survive the pods).
+        for p in h.cluster.list_pods():
+            h.cluster.delete_pod("default", p.metadata.name)
+        h.sync()
+        for p in h.cluster.list_pods():
+            h.cluster.set_pod_phase("default", p.metadata.name, "Running")
+        h.sync()
+        # Far past the progress deadline with NO new beats: the stale
+        # predecessor leases must not read as this incarnation's renewals.
+        for _ in range(4):
+            h.now[0] += 20
+            h.sync()
+        assert "stallCounts" not in h.status()
+        # A real beat re-arms staleness; silence after it stalls normally.
+        h.beat("llama-worker-0", "llama-worker-1")
+        h.sync()
+        h.now[0] += 31
+        h.sync()
+        assert h.status().get("stallCounts") == {"Worker": 1}
+
+    def test_resume_resets_stall_ledger_with_the_others(self):
+        """Suspend/resume opens a fresh lifecycle window: the stall ledger
+        resets alongside restartCounts/disruptionCounts (the three ledgers
+        stay symmetric)."""
+        h = Harness(run_policy={"progressDeadlineSeconds": 30})
+        h.beat("llama-worker-0", "llama-worker-1")
+        h.sync()
+        h.now[0] += 31  # both stale -> stall restart counted
+        h.sync()
+        assert h.status()["stallCounts"] == {"Worker": 1}
+        job = h.cluster.get_job("JAXJob", "default", "llama")
+        job["spec"]["runPolicy"]["suspend"] = True
+        h.cluster.update_job(job)
+        h.sync()
+        job = h.cluster.get_job("JAXJob", "default", "llama")
+        job["spec"]["runPolicy"]["suspend"] = False
+        h.cluster.update_job(job)
+        h.sync()
+        assert "stallCounts" not in h.status()
+
+    def test_terminating_pods_are_not_liveness_judged(self):
+        """A pod mid-deletion stopped heartbeating by design; judging it
+        would double-fire every teardown."""
+        h = Harness(run_policy={"progressDeadlineSeconds": 30})
+        h.beat("llama-worker-0", "llama-worker-1")
+        h.sync()
+        h.cluster.set_pod_deleting("default", "llama-worker-1")
+        before = h.status().get("stallCounts")
+        h.now[0] += 100
+        h.beat("llama-worker-0")
+        h.sync()
+        # worker-1 (terminating) ignored; worker-0 is fresh: no stall...
+        assert h.status().get("stallCounts") == before
+        # ...and the drained-pod DISRUPTION trigger owns that pod instead.
+        assert h.status().get("disruptionCounts") == {"Worker": 1}

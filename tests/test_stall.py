@@ -1,0 +1,357 @@
+"""Gang-liveness chaos tier: seeded hang injection (cluster/chaos.py
+ScheduledHang / freeze_heartbeats) driving the stall detector to its
+acceptance criteria:
+
+- a frozen slice-host heartbeat drives the job to Restarting with reason
+  ProgressStall within progressDeadlineSeconds (+ one resync tick),
+  deterministically — the fault log is byte-reproducible from the seed;
+- the gang restarts and converges back to Running and on to Succeeded;
+- the SAME schedule with deadlines unset never observes a stall restart;
+- stall restarts land in their own ledger: backoffLimit and the
+  disruption budget stay untouched (cause-labeled counters disjoint);
+- a leader-election failover during an in-flight stall-triggered gang
+  restart must not re-fire the teardown or double-count the restart
+  (extends the PR-1 terminating-trigger regression suite).
+
+Fixed seeds run in tier-1/CI; the randomized stall sweep is `-m slow`.
+"""
+
+import pytest
+
+from tf_operator_tpu.api import common as capi
+from tf_operator_tpu.cluster.chaos import ChaosCluster, ChaosSpec, ScheduledHang
+from tf_operator_tpu.cluster.memory import InMemoryCluster
+from tf_operator_tpu.controllers.jax import JAXController
+from tf_operator_tpu.core.constants import heartbeat_lease_name
+from tf_operator_tpu.core.workqueue import WorkQueue
+from tf_operator_tpu.metrics import Metrics
+from tf_operator_tpu.runtime.heartbeat import publish_heartbeat
+
+
+def container(name):
+    return {"name": name, "image": "test:1"}
+
+
+def jax_manifest(name="llama", workers=4, run_policy=None):
+    spec = {
+        "jaxReplicaSpecs": {
+            "Worker": {
+                "replicas": workers,
+                "template": {"spec": {"containers": [container("jax")]}},
+            }
+        },
+    }
+    if run_policy:
+        spec["runPolicy"] = run_policy
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "JAXJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": spec,
+    }
+
+
+def conds_of(cluster, kind, name):
+    job = cluster.get_job(kind, "default", name)
+    return {c["type"]: c for c in (job.get("status") or {}).get("conditions") or []}
+
+
+def stall_events(inner):
+    return [
+        e for e in inner.list_events()
+        if e.reason == "JAXJobProgressStallRestarting"
+        and "restarting" in e.message
+    ]
+
+
+class StallDriver:
+    """Synchronous seeded scenario: fake clock, chaos-proxied cluster, a
+    heartbeat driver standing in for the workers' renewal threads. Every
+    step is deterministic given (seed, schedule), which is what makes the
+    fault log byte-reproducible."""
+
+    TICK = 5.0
+
+    def __init__(self, seed, run_policy=None, workers=4, hangs=()):
+        self.now = [1000.0]
+        clock = lambda: self.now[0]  # noqa: E731
+        self.inner = InMemoryCluster(clock=clock)
+        self.chaos = ChaosCluster(self.inner, ChaosSpec(
+            seed=seed,
+            conflict_rate=0.05,  # stall detection must hold under 409 noise
+            hangs=tuple(hangs),
+        ))
+        self.metrics = Metrics()
+        self.controller = JAXController(
+            self.chaos, queue=WorkQueue(clock=clock),
+            metrics=self.metrics, clock=clock,
+        )
+        self.inner.create_job(jax_manifest(workers=workers,
+                                           run_policy=run_policy))
+        self.sync()
+        self.run_all()
+        self.sync()
+
+    def run_all(self):
+        for p in self.inner.list_pods("default"):
+            if p.status.phase == "Pending":
+                self.inner.set_pod_phase("default", p.metadata.name, "Running")
+
+    def beat_all(self):
+        """One renewal round for every Running pod — through the chaos
+        proxy, so frozen workers' beats are dropped (and logged)."""
+        for p in self.inner.list_pods("default"):
+            if (p.status.phase == "Running"
+                    and p.metadata.deletion_timestamp is None):
+                publish_heartbeat(
+                    self.chaos, "default",
+                    heartbeat_lease_name(p.metadata.name),
+                    p.metadata.name, clock=lambda: self.now[0],
+                )
+
+    def sync(self):
+        self.controller.queue.add("JAXJob:default/llama")
+        self.controller.run_until_idle()
+
+    def tick(self):
+        self.now[0] += self.TICK
+        self.beat_all()
+        self.sync()
+
+    def status(self):
+        return self.inner.get_job("JAXJob", "default", "llama")["status"]
+
+
+def run_progress_stall_scenario(seed, with_deadlines=True, max_rounds=30):
+    """The acceptance scenario: healthy gang, then one worker's heartbeats
+    freeze mid-training. Returns (driver, detected_after_seconds | None)."""
+    rp = {"progressDeadlineSeconds": 30} if with_deadlines else None
+    d = StallDriver(seed, run_policy=rp)
+    d.beat_all()
+    d.sync()
+    d.chaos.freeze_heartbeats(name_contains="llama-worker-2")
+    frozen_at = d.now[0]
+    detected = None
+    for _ in range(max_rounds):
+        d.tick()
+        if stall_events(d.inner):
+            detected = d.now[0] - frozen_at
+            break
+    return d, detected
+
+
+class TestSeededProgressStall:
+    def test_stall_detected_within_deadline_and_converges(self):
+        d, detected = run_progress_stall_scenario(seed=11)
+        # Detected within progressDeadlineSeconds + one driver tick.
+        assert detected is not None, "stall never detected"
+        assert detected <= 30 + StallDriver.TICK + 1e-6
+        status = d.status()
+        assert status["stallCounts"] == {"Worker": 1}
+        # Ledger disjointness: neither backoffLimit accounting nor the
+        # disruption budget saw this incident.
+        assert "restartCounts" not in status
+        assert "disruptionCounts" not in status
+        assert d.metrics.labeled_counter_value(
+            "training_operator_jobs_restarted_by_cause_total",
+            "default", "JAXJob", capi.RESTART_CAUSE_STALL,
+        ) == 1
+        assert d.metrics.labeled_counter_value(
+            "training_operator_jobs_restarted_by_cause_total",
+            "default", "JAXJob", capi.RESTART_CAUSE_APPLICATION,
+        ) == 0
+        assert d.metrics.labeled_counter_value(
+            "training_operator_jobs_restarted_by_cause_total",
+            "default", "JAXJob", capi.RESTART_CAUSE_DISRUPTION,
+        ) == 0
+        # The hang is visible in the fault log (the replay artifact).
+        assert any(entry.startswith("hang:") for entry in d.chaos.fault_log)
+
+        # Convergence: thaw, let the recreated gang come up and beat —
+        # the job returns to Running with no further stall restarts, then
+        # completes.
+        d.chaos.thaw_heartbeats()
+        for _ in range(6):
+            d.run_all()
+            d.tick()
+        assert d.status()["stallCounts"] == {"Worker": 1}
+        conds = conds_of(d.inner, "JAXJob", "llama")
+        assert conds.get("Running", {}).get("status") == "True"
+        for p in d.inner.list_pods("default"):
+            d.inner.set_pod_phase("default", p.metadata.name, "Succeeded",
+                                  exit_code=0)
+        d.sync()
+        conds = conds_of(d.inner, "JAXJob", "llama")
+        assert conds["Succeeded"]["status"] == "True"
+        assert conds.get("Failed", {}).get("status") != "True"
+
+    def test_same_seed_reproduces_fault_log_byte_for_byte(self):
+        d1, _ = run_progress_stall_scenario(seed=23)
+        d2, _ = run_progress_stall_scenario(seed=23)
+        assert d1.chaos.fault_log == d2.chaos.fault_log
+        assert d1.status().get("stallCounts") == d2.status().get("stallCounts")
+
+    def test_deadlines_unset_never_flags_heartbeat_less_stall(self):
+        """The same frozen-worker schedule with deadlines unset: the job
+        must never stall-restart — heartbeat-less jobs (and jobs that
+        didn't opt in) are out of scope by construction."""
+        d, detected = run_progress_stall_scenario(seed=11, with_deadlines=False)
+        assert detected is None
+        status = d.status()
+        assert "stallCounts" not in status
+        assert "restartCounts" not in status
+        assert "disruptionCounts" not in status
+        assert stall_events(d.inner) == []
+        assert conds_of(d.inner, "JAXJob", "llama").get(
+            "Running", {}).get("status") == "True"
+
+    def test_scheduled_frozen_rendezvous_hits_rendezvous_deadline(self):
+        """ScheduledHang(after_writes=0) = frozen-rendezvous mode: the
+        chosen worker never lands a FIRST heartbeat, which only
+        rendezvousDeadlineSeconds can catch."""
+        d = StallDriver(
+            seed=7,
+            run_policy={"progressDeadlineSeconds": 60,
+                        "rendezvousDeadlineSeconds": 20},
+            hangs=[ScheduledHang(after_writes=0,
+                                 name_contains="llama-worker-3")],
+        )
+        gang_up = d.now[0]
+        d.beat_all()
+        d.sync()
+        detected = None
+        for _ in range(20):
+            d.tick()
+            if stall_events(d.inner):
+                detected = d.now[0] - gang_up
+                break
+        assert detected is not None
+        assert detected <= 20 + 2 * StallDriver.TICK + 1e-6
+        assert any(
+            "rendezvousDeadlineSeconds" in e.message
+            for e in stall_events(d.inner)
+        )
+        assert d.status()["stallCounts"] == {"Worker": 1}
+        # The dropped first beats are in the fault log.
+        assert any(
+            "llama-worker-3-hb:drop" in entry for entry in d.chaos.fault_log
+        )
+
+
+class GracefulDeleteCluster:
+    """Proxy that turns pod deletion into the graceful-deletion window a
+    real apiserver holds pods in (deletionTimestamp set, object present):
+    the in-flight-teardown state the failover regression needs."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def delete_pod(self, namespace, name):
+        self._inner.set_pod_deleting(namespace, name)
+
+
+class TestLeaderFailoverDuringStallRestart:
+    def test_new_leader_does_not_refire_or_double_count(self):
+        """Leader A detects the stall and fires the gang teardown; every
+        world pod lingers Terminating through its grace period. Leader B
+        (fresh in-memory caches — heartbeat observations and expectations
+        are deliberately not shared) takes over mid-flight: it must not
+        re-fire the teardown, must not charge a second stall, and must
+        not misread the controller-initiated deletions as a node-drain
+        disruption. Extends the PR-1 terminating-trigger suite to the
+        stall trigger."""
+        now = [1000.0]
+        clock = lambda: now[0]  # noqa: E731
+        inner = InMemoryCluster(clock=clock)
+        graceful = GracefulDeleteCluster(inner)
+        metrics_a, metrics_b = Metrics(), Metrics()
+        a = JAXController(graceful, queue=WorkQueue(clock=clock),
+                          metrics=metrics_a, clock=clock)
+        inner.create_job(jax_manifest(
+            run_policy={"progressDeadlineSeconds": 30}))
+        a.queue.add("JAXJob:default/llama")
+        a.run_until_idle()
+        for p in inner.list_pods("default"):
+            inner.set_pod_phase("default", p.metadata.name, "Running")
+        a.run_until_idle()
+
+        def beat(names):
+            for name in names:
+                publish_heartbeat(inner, "default",
+                                  heartbeat_lease_name(name), name,
+                                  clock=clock)
+
+        workers = [p.metadata.name for p in inner.list_pods("default")]
+        beat(workers)
+        a.queue.add("JAXJob:default/llama")
+        a.run_until_idle()
+        # worker-1 wedges; A crosses the deadline and fires the teardown.
+        now[0] += 31
+        beat([w for w in workers if w != "llama-worker-1"])
+        a.queue.add("JAXJob:default/llama")
+        a.run_until_idle()
+        status = inner.get_job("JAXJob", "default", "llama")["status"]
+        assert status["stallCounts"] == {"Worker": 1}
+        terminating = [p for p in inner.list_pods("default")
+                       if p.metadata.deletion_timestamp is not None]
+        assert len(terminating) == 4, "teardown must be in flight"
+        assert len(stall_events(inner)) == 1
+
+        # Failover: B is a brand-new controller over the same cluster.
+        b = JAXController(graceful, queue=WorkQueue(clock=clock),
+                          metrics=metrics_b, clock=clock)
+        for _ in range(4):
+            now[0] += 10
+            beat([w for w in workers if w != "llama-worker-1"])
+            b.queue.add("JAXJob:default/llama")
+            b.run_until_idle()
+        status = inner.get_job("JAXJob", "default", "llama")["status"]
+        assert status["stallCounts"] == {"Worker": 1}, "double-counted"
+        assert "disruptionCounts" not in status, (
+            "controller-initiated teardown misread as node drain")
+        assert "restartCounts" not in status
+        assert len(stall_events(inner)) == 1, "teardown re-fired"
+        assert metrics_b.labeled_counter_value(
+            "training_operator_jobs_restarted_by_cause_total",
+            "default", "JAXJob", capi.RESTART_CAUSE_STALL,
+        ) == 0
+
+        # Grace periods end; B recreates the world and it converges.
+        for p in list(inner.list_pods("default")):
+            inner.delete_pod("default", p.metadata.name)
+        b.queue.add("JAXJob:default/llama")
+        b.run_until_idle()
+        pods = inner.list_pods("default")
+        assert len(pods) == 4
+        for p in pods:
+            inner.set_pod_phase("default", p.metadata.name, "Running")
+        beat([p.metadata.name for p in pods])
+        now[0] += 5
+        b.queue.add("JAXJob:default/llama")
+        b.run_until_idle()
+        status = inner.get_job("JAXJob", "default", "llama")["status"]
+        assert status["stallCounts"] == {"Worker": 1}
+        assert conds_of(inner, "JAXJob", "llama").get(
+            "Running", {}).get("status") == "True"
+
+
+@pytest.mark.slow
+class TestRandomizedStallSweep:
+    """Multi-seed sweep of the acceptance scenario (tier: chaos-sweep).
+    Each seed gets a different deterministic conflict schedule; the
+    invariants must hold for all of them, and every seed's fault log must
+    replay byte-for-byte."""
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_invariants_hold_across_seeds(self, seed):
+        d, detected = run_progress_stall_scenario(seed=seed)
+        assert detected is not None and detected <= 30 + StallDriver.TICK
+        status = d.status()
+        assert status["stallCounts"] == {"Worker": 1}
+        assert "restartCounts" not in status
+        assert "disruptionCounts" not in status
+        d2, _ = run_progress_stall_scenario(seed=seed)
+        assert d2.chaos.fault_log == d.chaos.fault_log

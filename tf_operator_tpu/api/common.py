@@ -87,6 +87,15 @@ RESTART_CAUSE_DISRUPTION = "InfrastructureDisruption"
 # not a failure at all — consumes neither budget, but still labels the
 # restarted-by-cause metric so dashboards see why a world churned.
 RESTART_CAUSE_SPEC_CHANGE = "SpecChange"
+# A gang-liveness verdict (docs/design/failure_modes.md §8): every pod
+# reported Running but a replica's heartbeat went stale past
+# RunPolicy.progressDeadlineSeconds (or never arrived within
+# rendezvousDeadlineSeconds). Neither an application exit nor an
+# infrastructure kill — its restarts land in the separate
+# status.stallCounts ledger so the cause-labeled counters stay disjoint
+# (a wedged collective must not burn backoffLimit, and a dead ICI link
+# must not look like a preemption streak).
+RESTART_CAUSE_STALL = "ProgressStall"
 
 # Signal-kill exit codes: the process was terminated from OUTSIDE
 # (137 = 128+SIGKILL: preemption/OOM-score eviction; 143 = 128+SIGTERM:
@@ -176,6 +185,25 @@ class RunPolicy:
     # substrate absorbs. Set a bound to fail jobs stuck in a preemption
     # loop (e.g. a reservation that keeps getting reclaimed).
     max_disruption_retries: Optional[int] = None
+    # Gang-liveness deadlines (both opt-in, default off — a job that never
+    # heartbeats can never stall-restart):
+    #
+    # progressDeadlineSeconds: once a replica has produced its FIRST
+    # heartbeat, the operator restarts the gang with cause ProgressStall
+    # if that replica's renewals go stale for this long — measured on the
+    # operator's local clock from the moment a renewal is OBSERVED (the
+    # leader-election skew rule; never remote timestamp vs. local now).
+    # This is what lets the control plane tell "slow" from "stuck":
+    # activeDeadlineSeconds kills healthy long jobs, this only fires when
+    # a live-looking worker stopped proving liveness.
+    progress_deadline_seconds: Optional[int] = None
+    # rendezvousDeadlineSeconds: bound on reaching the first heartbeat
+    # after gang-up (pod observed Running). Catches the worker wedged in
+    # rendezvous forever — which progressDeadlineSeconds alone never
+    # flags, because staleness is only measured once a first heartbeat
+    # exists. Requires progressDeadlineSeconds to be set (validation):
+    # a job must opt into the heartbeat protocol as a whole.
+    rendezvous_deadline_seconds: Optional[int] = None
     scheduling_policy: Optional[SchedulingPolicy] = None
     # Suspend (training-operator v1.7 RunPolicy.suspend): true tears down
     # every pod (and gang groups — on TPU this releases the whole slice)
@@ -231,6 +259,13 @@ class JobStatus:
     # these never count toward backoffLimit — they draw from
     # RunPolicy.maxDisruptionRetries instead.
     disruption_counts: Dict[str, int] = field(default_factory=dict)
+    # Operator-initiated PROGRESS-STALL restarts per replica type (gang
+    # liveness: heartbeats went stale past progressDeadlineSeconds, or
+    # never arrived within rendezvousDeadlineSeconds). A third disjoint
+    # ledger: stalls draw neither backoffLimit nor maxDisruptionRetries —
+    # each stall restart is rate-limited by its own deadline window, and
+    # activeDeadlineSeconds remains the hard wall-clock bound.
+    stall_counts: Dict[str, int] = field(default_factory=dict)
     # Consecutive disruption restarts since the job last reached Running:
     # drives the jittered exponential restart backoff (first disruption
     # restarts immediately; a preemption loop backs off). Reset on Running.

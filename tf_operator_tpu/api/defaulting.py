@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from typing import Dict, Iterable
 
-from .common import ReplicaSpec, ReplicaType
+from .common import ReplicaSpec, ReplicaType, RunPolicy
 from .k8s import ContainerPort, PodSpec
 
 
@@ -52,6 +52,43 @@ def normalize_replica_type_names(
             if t != typ and t.lower() == typ.lower():
                 specs[typ] = specs.pop(t)
                 break
+
+
+def _positive_int(value) -> bool:
+    # bool is an int subclass; `progressDeadlineSeconds: true` must not
+    # slip through as 1.
+    return isinstance(value, int) and not isinstance(value, bool) and value > 0
+
+
+def validate_run_policy(run_policy: RunPolicy, kind: str) -> None:
+    """Admission validation of the gang-liveness deadlines (the rest of
+    RunPolicy predates this check and keeps its permissive parsing).
+
+    Both deadlines default to unset (off): existing TF/PyTorch/MX/XGBoost
+    jobs that never heartbeat can never stall-restart. Opt-in semantics:
+    `rendezvousDeadlineSeconds` requires `progressDeadlineSeconds` — the
+    rendezvous bound is meaningless for a job that has not opted into the
+    heartbeat protocol, and accepting it alone would arm a deadline no
+    heartbeat can ever satisfy."""
+    pdl = run_policy.progress_deadline_seconds
+    rdl = run_policy.rendezvous_deadline_seconds
+    if pdl is not None and not _positive_int(pdl):
+        raise ValidationError(
+            f"{kind}Spec is not valid: runPolicy.progressDeadlineSeconds "
+            f"must be a positive integer, got {pdl!r}"
+        )
+    if rdl is not None:
+        if not _positive_int(rdl):
+            raise ValidationError(
+                f"{kind}Spec is not valid: runPolicy.rendezvousDeadlineSeconds "
+                f"must be a positive integer, got {rdl!r}"
+            )
+        if pdl is None:
+            raise ValidationError(
+                f"{kind}Spec is not valid: runPolicy.rendezvousDeadlineSeconds "
+                "requires runPolicy.progressDeadlineSeconds (the job must opt "
+                "into heartbeat liveness as a whole)"
+            )
 
 
 def validate_replica_specs(

@@ -35,6 +35,7 @@ from .defaulting import (
     set_default_port,
     set_default_replicas,
     validate_replica_specs,
+    validate_run_policy,
 )
 
 KIND = "JAXJob"
@@ -160,6 +161,7 @@ def set_defaults(job: JAXJob) -> None:
 
 
 def validate(spec: JAXJobSpec) -> None:
+    validate_run_policy(spec.run_policy, KIND)
     validate_replica_specs(spec.jax_replica_specs, DEFAULT_CONTAINER_NAME, KIND)
     if spec.elastic is not None:
         el = spec.elastic

@@ -21,6 +21,7 @@ from .defaulting import (
     normalize_replica_type_names,
     set_default_port,
     set_default_replicas,
+    validate_run_policy,
 )
 from .tpu import (
     TPUSpec,
@@ -113,6 +114,7 @@ def set_defaults(job: MXJob) -> None:
 def validate(spec: MXJobSpec) -> None:
     """reference pkg/apis/mxnet/validation/validation.go — containers and
     images present, container named `mxnet`, at most one Scheduler."""
+    validate_run_policy(spec.run_policy, KIND)
     if not spec.mx_replica_specs:
         raise ValidationError("MXJobSpec is not valid")
     found_scheduler = 0

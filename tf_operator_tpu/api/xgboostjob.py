@@ -23,6 +23,7 @@ from .defaulting import (
     set_default_port,
     set_default_replicas,
     validate_replica_specs,
+    validate_run_policy,
 )
 
 # Constants (reference pkg/apis/xgboost/v1/constants.go:20-27)
@@ -77,6 +78,7 @@ def validate(spec: XGBoostJobSpec) -> None:
     """reference pkg/apis/xgboost/validation/validation.go — valid replica
     types, images set, container named `xgboost`, exactly one Master with
     replicas == 1."""
+    validate_run_policy(spec.run_policy, KIND)
     if not spec.xgb_replica_specs:
         raise ValidationError("XGBoostJobSpec is not valid")
     for rtype in spec.xgb_replica_specs:

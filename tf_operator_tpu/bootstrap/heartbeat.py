@@ -1,0 +1,53 @@
+"""Gang-liveness heartbeat env — the operator→container contract.
+
+No reference counterpart: the reference operator's only liveness signal is
+wall-clock ``activeDeadlineSeconds`` (job.go:174-190), which cannot tell a
+slow job from a wedged one. When a job opts in (``runPolicy.
+progressDeadlineSeconds``), the engine injects these variables into every
+replica pod and ``runtime/heartbeat.py`` consumes them inside the
+container:
+
+- TPU_HEARTBEAT_LEASE              name of this pod's heartbeat Lease
+                                   ("<pod>-hb") — renewed through the same
+                                   coordination.k8s.io seam leader election
+                                   uses.
+- TPU_HEARTBEAT_NAMESPACE          namespace the Lease lives in (the job's).
+- TPU_HEARTBEAT_INTERVAL_SECONDS   renewal cadence (progressDeadline /
+                                   HEARTBEAT_INTERVAL_FRACTION, min 1s).
+- TPU_HEARTBEAT_FILE               file-bridge override: when set (the
+                                   process e2e tier; a kubelet-analog
+                                   translates file beats into Lease
+                                   renewals), the runtime writes beats to
+                                   this path instead of an apiserver.
+
+Absent env means no heartbeat thread at all, so the same training script
+runs unmodified on a dev box — the degrade-to-local rule every bootstrap
+contract in this package follows.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ..core.constants import HEARTBEAT_INTERVAL_FRACTION, heartbeat_lease_name
+
+ENV_HEARTBEAT_LEASE = "TPU_HEARTBEAT_LEASE"
+ENV_HEARTBEAT_NAMESPACE = "TPU_HEARTBEAT_NAMESPACE"
+ENV_HEARTBEAT_INTERVAL = "TPU_HEARTBEAT_INTERVAL_SECONDS"
+ENV_HEARTBEAT_FILE = "TPU_HEARTBEAT_FILE"
+
+
+def heartbeat_interval_seconds(progress_deadline_seconds: int) -> float:
+    return max(1.0, progress_deadline_seconds / HEARTBEAT_INTERVAL_FRACTION)
+
+
+def gen_env(pod_name: str, namespace: str,
+            progress_deadline_seconds: int) -> Dict[str, str]:
+    """The heartbeat env block for one replica pod."""
+    return {
+        ENV_HEARTBEAT_LEASE: heartbeat_lease_name(pod_name),
+        ENV_HEARTBEAT_NAMESPACE: namespace,
+        ENV_HEARTBEAT_INTERVAL: str(
+            heartbeat_interval_seconds(progress_deadline_seconds)
+        ),
+    }

@@ -208,6 +208,12 @@ class Cluster:
         two replicas racing for the lock safe."""
         raise NotImplementedError
 
+    def delete_lease(self, namespace: str, name: str) -> None:
+        """Delete a Lease (heartbeat GC at job termination). NotFound if
+        absent. Backends that predate this method inherit the
+        NotImplementedError default; callers treat it as best-effort."""
+        raise NotImplementedError
+
     # ---- events ----
     def record_event(self, event: Event) -> None:
         raise NotImplementedError

@@ -12,7 +12,12 @@ backend:
 - node-scoped batch pod kills that simulate TPU slice-host preemption
   (every matching pod flips to Failed/137 with a `DisruptionTarget`
   condition in one batch, the way a reclaimed host takes all its pods
-  at once).
+  at once),
+- seeded hang injection (`ScheduledHang` / `freeze_heartbeats`): heartbeat
+  Lease writes for chosen workers are silently dropped, so a pod looks
+  Running while its liveness proof stops — the silent-wedge failure mode
+  the gang-liveness deadlines exist to catch (frozen-rendezvous mode is
+  `after_writes=0`: the first heartbeat never lands).
 
 Determinism is the point: every decision is a pure function of
 (seed, method, per-method call index), via SHA-256 — no `random` state,
@@ -42,6 +47,7 @@ from ..api.k8s import (
     ContainerStatus,
     PodCondition,
 )
+from ..core.constants import HEARTBEAT_LEASE_SUFFIX
 from .base import Cluster, Conflict, ServerError
 
 # Writes eligible for fault injection — the same surface ThrottledCluster
@@ -60,6 +66,12 @@ _WRITE_METHODS = (
     "record_event",
     "create_pod_group",
     "delete_pod_group",
+    # Lease writes (heartbeats, leader election) are faultable like every
+    # other write — but handled by explicit methods below (hang check +
+    # inject, NO _note_write: lease traffic must not advance the write
+    # clock, or heartbeat cadence would shift PR-1 preemption schedules).
+    "create_lease",
+    "update_lease",
 )
 
 # Conflict only makes sense where the apiserver would 409: optimistic-
@@ -83,6 +95,26 @@ class ScheduledPreemption:
 
 
 @dataclass
+class ScheduledHang:
+    """A silent-wedge injection planted in the schedule: while active,
+    heartbeat Lease writes (create_lease/update_lease) whose lease name
+    matches are DROPPED — the worker looks Running while its liveness
+    proof stops, exactly the failure mode progressDeadlineSeconds exists
+    to catch. `after_writes=0` is frozen-rendezvous mode (the worker
+    never lands a first heartbeat); a positive value freezes a previously
+    healthy worker mid-training. `until_writes` bounds the hang so a
+    converge-after-restart scenario stays schedulable. Lease writes do
+    not advance the write clock (PR-1 schedules stay byte-identical)."""
+
+    after_writes: int = 0
+    until_writes: Optional[int] = None
+    namespace: Optional[str] = None
+    # Substring of the lease name ("<pod>-hb"), e.g. "worker-2" to wedge
+    # one worker or "job-worker" to wedge a whole slice-host's pods.
+    name_contains: str = ""
+
+
+@dataclass
 class ChaosSpec:
     """The seeded plan. Rates are probabilities in [0, 1] evaluated per
     call from the deterministic hash stream."""
@@ -96,6 +128,7 @@ class ChaosSpec:
     # Kinds whose watch events may be dropped; empty tuple = all kinds.
     drop_watch_kinds: Tuple[str, ...] = ()
     preemptions: Tuple[ScheduledPreemption, ...] = ()
+    hangs: Tuple[ScheduledHang, ...] = ()
     # Methods exempt from error/conflict injection (latency still
     # applies). Default: none — every write, record_event included, is
     # faultable; the engine's best-effort event recording is itself a
@@ -117,6 +150,9 @@ class ChaosCluster:
         self._counters: Dict[str, int] = {}
         self._writes_seen = 0
         self._preempted = [False] * len(spec.preemptions)
+        # Direct-lever hangs (freeze_heartbeats) appended at test-chosen
+        # points, beside the write-clock-scheduled spec.hangs.
+        self._manual_hangs: List[ScheduledHang] = []
 
     # ------------------------------------------------------------- plan
     def _next_index(self, stream: str) -> int:
@@ -214,6 +250,65 @@ class ChaosCluster:
             handler(event_type, obj)
 
         self._inner.watch(kind, dropping)
+
+    # ------------------------------------------------------------- hangs
+    def freeze_heartbeats(self, name_contains: str = "",
+                          namespace: Optional[str] = None) -> None:
+        """Direct hang lever (the preempt_pods analog): from now on, drop
+        heartbeat-lease writes whose name matches — the worker wedges
+        silently. Deterministic given a deterministic call point."""
+        with self._lock:
+            self._manual_hangs.append(ScheduledHang(
+                after_writes=0, namespace=namespace,
+                name_contains=name_contains,
+            ))
+        self._log(f"hang:freeze:{namespace or '*'}:{name_contains}")
+
+    def thaw_heartbeats(self) -> None:
+        """Release every manual hang (scheduled ones obey until_writes)."""
+        with self._lock:
+            self._manual_hangs.clear()
+        self._log("hang:thaw")
+
+    def _hang_matches(self, namespace: str, name: str) -> bool:
+        # Hangs target HEARTBEAT leases only (the documented contract): a
+        # bare freeze_heartbeats() must wedge workers, never swallow the
+        # operator's own leader-election Lease renewals — that would fake
+        # a leadership loss and misattribute the resulting failover.
+        if not name.endswith(HEARTBEAT_LEASE_SUFFIX):
+            return False
+        with self._lock:
+            writes = self._writes_seen
+            hangs = list(self.spec.hangs) + self._manual_hangs
+        for h in hangs:
+            if writes < h.after_writes:
+                continue
+            if h.until_writes is not None and writes >= h.until_writes:
+                continue
+            if h.namespace is not None and h.namespace != namespace:
+                continue
+            if h.name_contains and h.name_contains not in name:
+                continue
+            return True
+        return False
+
+    def create_lease(self, lease: dict) -> dict:
+        meta = lease.get("metadata") or {}
+        ns, name = meta.get("namespace", "default"), meta.get("name", "")
+        if self._hang_matches(ns, name):
+            self._log(f"hang:{ns}/{name}:drop-create")
+            return lease  # swallowed: the beat never reaches the cluster
+        self._inject("create_lease")
+        return self._inner.create_lease(lease)
+
+    def update_lease(self, lease: dict) -> dict:
+        meta = lease.get("metadata") or {}
+        ns, name = meta.get("namespace", "default"), meta.get("name", "")
+        if self._hang_matches(ns, name):
+            self._log(f"hang:{ns}/{name}:drop-renew")
+            return lease
+        self._inject("update_lease")
+        return self._inner.update_lease(lease)
 
     # ------------------------------------------------------- preemption
     def preempt_pods(

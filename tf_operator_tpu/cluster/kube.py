@@ -690,6 +690,9 @@ class KubeCluster(Cluster):
             lease,
         )
 
+    def delete_lease(self, namespace: str, name: str) -> None:
+        self._request("DELETE", self._lease_path(namespace, name))
+
     # --------------------------------------------------------------- events
     def record_event(self, event: Event) -> None:
         kind, _, key = event.involved_object.partition("/")

@@ -502,6 +502,15 @@ class InMemoryCluster(base.Cluster):
         self._drain_events()
         return out
 
+    def delete_lease(self, namespace: str, name: str) -> None:
+        with self._lock:
+            lease = self._leases.pop((namespace, name), None)
+            if lease is None:
+                raise NotFound(f"lease {namespace}/{name}")
+            lease["metadata"]["resourceVersion"] = str(next(self._rv))
+            self._publish_locked("leases", DELETED, lease)
+        self._drain_events()
+
     # ---------------------------------------------------------------- events
     def record_event(self, event: Event) -> None:
         with self._lock:

@@ -45,6 +45,8 @@ from ..api.k8s import (
     ContainerStatus,
     Pod,
 )
+from ..bootstrap import heartbeat as hb_bootstrap
+from ..runtime import heartbeat as hb_runtime
 from .base import NotFound
 from .memory import InMemoryCluster
 
@@ -100,6 +102,13 @@ class LocalProcessCluster(InMemoryCluster):
         self._log_paths: Dict[Tuple[str, str], str] = {}
         self._attempts: Dict[Tuple[str, str], int] = {}
         self._ip_map: Dict[Tuple[str, str], str] = {}
+        # Heartbeat file bridge (gang liveness): pod key -> (file path,
+        # lease name, lease namespace, last seq seen). The reaper reads
+        # each live pod's beat file and replays fresh beats as Lease
+        # renewals through the Cluster seam — this process is the
+        # kubelet-analog, so the operator sees the identical protocol it
+        # sees on a real cluster.
+        self._hb_bridge: Dict[Tuple[str, str], list] = {}
         self._stopped = threading.Event()
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
         self._reaper.start()
@@ -217,6 +226,20 @@ class LocalProcessCluster(InMemoryCluster):
                 log_path = os.path.join(
                     self._log_dir, f"{key[0]}__{key[1]}.{attempt}.log"
                 )
+                if env.get(hb_bootstrap.ENV_HEARTBEAT_LEASE):
+                    # Heartbeat-enabled pod: point the child at a beat
+                    # file (real apiserver auth doesn't exist here) and
+                    # arm the reaper's file->Lease bridge for it.
+                    hb_path = os.path.join(
+                        self._log_dir, f"{key[0]}__{key[1]}.{attempt}.hb"
+                    )
+                    env[hb_bootstrap.ENV_HEARTBEAT_FILE] = hb_path
+                    self._hb_bridge[key] = [
+                        hb_path,
+                        env[hb_bootstrap.ENV_HEARTBEAT_LEASE],
+                        env.get(hb_bootstrap.ENV_HEARTBEAT_NAMESPACE, key[0]),
+                        None,
+                    ]
                 self._launching.add(key)
                 plans.append((key, cmd, env, container.working_dir or None, log_path))
 
@@ -270,6 +293,7 @@ class LocalProcessCluster(InMemoryCluster):
             try:
                 self._schedule_pass()
                 self._reap_once()
+                self._bridge_heartbeats()
             except Exception:
                 if self._stopped.is_set():  # teardown race: expected
                     return
@@ -307,6 +331,28 @@ class LocalProcessCluster(InMemoryCluster):
                 self._publish_locked("pods", "MODIFIED", pod.deep_copy())
         self._drain_events()
 
+    def _bridge_heartbeats(self) -> None:
+        """Replay fresh file beats as Lease renewals (the kubelet-analog
+        half of the heartbeat contract). Only pods with a LIVE process are
+        bridged: a SIGSTOPped child stops writing and therefore stops
+        renewing — precisely the silent wedge the operator must detect."""
+        with self._lock:
+            entries = [
+                (key, state) for key, state in self._hb_bridge.items()
+                if key in self._procs
+            ]
+        for key, state in entries:
+            path, lease_name, lease_ns, last_seq = state
+            beat = hb_runtime.read_heartbeat_file(path)
+            if beat is None or beat.get("seq") == last_seq:
+                continue
+            state[3] = beat.get("seq")
+            step = beat.get("step")
+            hb_runtime.publish_heartbeat(
+                self, lease_ns, lease_name, identity=key[1],
+                step=int(step) if isinstance(step, (int, float)) else None,
+            )
+
     def kill_pod(self, namespace: str, name: str, sig: int = signal.SIGKILL) -> None:
         """Fault injection: signal the pod's process WITHOUT deleting the
         pod object — the reaper then observes the death exactly as a kubelet
@@ -328,6 +374,7 @@ class LocalProcessCluster(InMemoryCluster):
             # NotFound contract: a deleted pod has no log (a same-name
             # recreation gets a fresh attempt file at launch).
             self._log_paths.pop(key, None)
+            self._hb_bridge.pop(key, None)
         if proc is not None:
             _kill_tree(proc)
         if fh is not None:

@@ -97,7 +97,10 @@ class FrameworkController(FrameworkHooks):
 
             cluster = ThrottledCluster(cluster, limiter)
         self.cluster = cluster
-        self.queue = queue or WorkQueue()
+        # `queue or WorkQueue()` would DROP an injected queue: WorkQueue
+        # defines __len__, so an empty (= freshly constructed) queue is
+        # falsy and a caller's fake-clock queue was silently replaced.
+        self.queue = WorkQueue() if queue is None else queue
         # Namespace scoping (legacy --namespace, options.go:36): empty = all.
         self.namespace = namespace
         self.clock = clock
@@ -124,6 +127,7 @@ class FrameworkController(FrameworkHooks):
             requeue=lambda key, after: self.queue.add_after(key, after),
             clock=clock,
             on_job_restarting=self._record_restart,
+            on_heartbeat_age=self._record_heartbeat_age,
         )
         self._watch()
 
@@ -204,6 +208,8 @@ class FrameworkController(FrameworkHooks):
         self.expectations.delete_expectations(key, "pods")
         self.expectations.delete_expectations(key, "services")
         self.engine.forget_job(key)
+        namespace, _, name = key.partition("/")
+        self.metrics.clear_heartbeat_age(namespace, self.kind, name)
         uid = uid or self._known_uids.get(key, "")
         self._known_uids.pop(key, None)
         if uid:
@@ -212,6 +218,9 @@ class FrameworkController(FrameworkHooks):
     def _record_restart(self, job: JobObject, rtype: str, cause: str) -> None:
         self.metrics.restarted_inc(job.namespace, self.kind)
         self.metrics.restarted_by_cause_inc(job.namespace, self.kind, cause)
+
+    def _record_heartbeat_age(self, job: JobObject, age: float) -> None:
+        self.metrics.set_heartbeat_age(job.namespace, self.kind, job.name, age)
 
     def _on_expectation_timeout(self, key: str, kind: str, adds: int, dels: int) -> None:
         """An expectation expired unfulfilled: the watch event we were

@@ -67,6 +67,12 @@ REASON_RESTARTING = "Restarting"
 # cause so conditions/events distinguish "recovering from preemption"
 # from "retrying a crash".
 REASON_DISRUPTION_RESTARTING = "DisruptionRestarting"
+# Restarting with cause ProgressStall: every pod reported Running but a
+# replica's heartbeat went stale past progressDeadlineSeconds (or the
+# first heartbeat never arrived within rendezvousDeadlineSeconds). Same
+# Restarting condition TYPE; the reason carries the liveness verdict so
+# "wedged collective" is distinguishable from both crash and preemption.
+REASON_STALL_RESTARTING = "ProgressStallRestarting"
 REASON_SUCCEEDED = "Succeeded"
 REASON_FAILED = "Failed"
 REASON_SUSPENDED = "Suspended"
@@ -81,6 +87,23 @@ REASON_QUEUED = "GangQueued"
 # scheduler with gang-sized pod churn every sync.
 DISRUPTION_BACKOFF_BASE_SECONDS = 1.0
 DISRUPTION_BACKOFF_MAX_SECONDS = 300.0
+
+# Gang liveness (docs/design/failure_modes.md §8): each worker renews a
+# per-pod heartbeat Lease named "<pod>-hb"; a lease annotation carries the
+# training step the workload last reported via record_progress(). The
+# controller measures staleness on ITS clock from the moment a renewal is
+# observed — the leaderelection skew rule — never remote-vs-local time.
+HEARTBEAT_LEASE_SUFFIX = "-hb"
+ANNOTATION_HEARTBEAT_STEP = "tpu.kubeflow.org/progress-step"
+# Renewal cadence injected into heartbeat-enabled pods: a quarter of the
+# progress deadline, floored — several renewals must fit inside one
+# deadline window or scheduling jitter alone could trip it.
+HEARTBEAT_INTERVAL_FRACTION = 4
+
+
+def heartbeat_lease_name(pod_name: str) -> str:
+    return f"{pod_name}{HEARTBEAT_LEASE_SUFFIX}"
+
 
 # Exit code sentinel when the framework container has not terminated
 # (reference tfjob_controller.go:707 "magic number").

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import copy
 import hashlib
+import logging
 import threading
 import time
 from fractions import Fraction
@@ -40,6 +41,8 @@ from . import constants
 from .control import PodControl, ServiceControl, record_event_best_effort
 from .expectations import ControllerExpectations
 
+log = logging.getLogger(__name__)
+
 
 def disruption_backoff_seconds(
     uid: str,
@@ -64,6 +67,21 @@ def disruption_backoff_seconds(
     digest = hashlib.sha256(f"{uid}:{streak}".encode()).digest()
     fraction = int.from_bytes(digest[:8], "big") / 2**64
     return delay * (0.5 + 0.5 * fraction)
+
+
+@dataclass
+class _HeartbeatState:
+    """Per-pod liveness bookkeeping, all on the CONTROLLER's clock (the
+    leaderelection skew rule: staleness is measured from the moment a
+    renewal is *observed* locally, never remote timestamp vs. local now —
+    a worker with a skewed clock must not read as stalled, and a skewed
+    operator must not excuse a dead one)."""
+
+    running_since: float  # local time we first saw this pod Running
+    raw: Optional[str] = None  # last-seen (holder, renewTime) fingerprint
+    observed_at: float = 0.0  # local time `raw` last changed
+    seen: bool = False  # a renewal has been observed to HAPPEN
+    baselined: bool = False  # first lease read recorded (content ignored)
 
 
 def gen_general_name(job_name: str, rtype: str, index) -> str:
@@ -363,6 +381,7 @@ class JobController:
         requeue: Optional[Callable[[str, float], None]] = None,
         clock=time.time,
         on_job_restarting: Optional[Callable[[JobObject, str, str], None]] = None,
+        on_heartbeat_age: Optional[Callable[[JobObject, float], None]] = None,
     ):
         self.hooks = hooks
         self.cluster = cluster
@@ -375,6 +394,24 @@ class JobController:
         # (job, rtype, cause) — cause is a RESTART_CAUSE_* constant so the
         # controller's metrics can label restarts by what actually happened.
         self.on_job_restarting = on_job_restarting or (lambda job, rtype, cause: None)
+        # (job, worst staleness seconds) — fires on every liveness check of
+        # a deadline-opted-in job; the controller exports it as the
+        # heartbeat_age_seconds gauge.
+        self.on_heartbeat_age = on_heartbeat_age or (lambda job, age: None)
+        # (job key, uid) -> {pod uid: _HeartbeatState}: the liveness
+        # observation cache. In-memory by design — an operator restart (or
+        # leader failover) restarts every staleness clock from its own
+        # first observation, which is the safe direction: a new leader can
+        # only be LATE declaring a stall, never declare one spuriously
+        # from state it did not observe. Guarded like _gang_declared.
+        self._hb_obs: Dict[tuple, Dict[str, _HeartbeatState]] = {}
+        self._hb_lock = threading.Lock()
+        # (job key, uid) whose heartbeat leases were already GC'd at
+        # terminal: _handle_terminal_job runs on EVERY resync of a
+        # finished job, and re-issuing N NotFound lease DELETEs each time
+        # would burn the QPS budget (the _suspend_job 'settled' rule).
+        # In-memory: an operator restart redoes the GC exactly once.
+        self._hb_gc_done: set = set()
         # (job key, uid) -> last-declared gang-group names: gates the stale
         # sweep's uncached LIST to declared-set changes (and once per
         # operator lifetime per job, since this cache is in-memory).
@@ -391,6 +428,11 @@ class JobController:
         with self._gang_declared_lock:
             for cache_key in [k for k in self._gang_declared if k[0] == key]:
                 self._gang_declared.pop(cache_key, None)
+        with self._hb_lock:
+            for cache_key in [k for k in self._hb_obs if k[0] == key]:
+                self._hb_obs.pop(cache_key, None)
+            for cache_key in [k for k in self._hb_gc_done if k[0] == key]:
+                self._hb_gc_done.discard(cache_key)
 
     # ------------------------------------------------------------- listing
     def get_pods_for_job(self, job: JobObject) -> List[Pod]:
@@ -567,6 +609,7 @@ class JobController:
             # streak the old incarnation accumulated is history.
             job.status.restart_counts = {}
             job.status.disruption_counts = {}
+            job.status.stall_counts = {}
             job.status.disruption_streak = 0
             job.status.restart_backoff_until = None
             capi.update_job_conditions(
@@ -705,23 +748,15 @@ class JobController:
                 rt.lower() for rt in replicas
                 if self.hooks.restart_peers_on_failure(rt)
             }
-            delete_errors = []
-            for pod in pods:
-                if pod is failed_pod or pod.metadata.deletion_timestamp is not None:
-                    continue
-                if pod.metadata.labels.get(
-                    constants.LABEL_REPLICA_TYPE
-                ) not in world_types:
-                    continue
-                try:
-                    self._delete_pod(job, pod)
-                except Exception as exc:  # noqa: BLE001 — keep tearing down
-                    delete_errors.append((pod.metadata.name, exc))
-            if not delete_errors and failed_pod.metadata.deletion_timestamp is None:
-                try:
-                    self._delete_pod(job, failed_pod)
-                except Exception as exc:  # noqa: BLE001
-                    delete_errors.append((failed_pod.metadata.name, exc))
+            delete_errors = self._teardown_gang_pods(
+                job,
+                [
+                    p for p in pods
+                    if p.metadata.labels.get(constants.LABEL_REPLICA_TYPE)
+                    in world_types
+                ],
+                failed_pod,
+            )
             if delete_errors:
                 names = ", ".join(n for n, _ in delete_errors)
                 record_event_best_effort(
@@ -805,6 +840,19 @@ class JobController:
                 self._write_status_if_changed(job, old_status)
                 return
             job.status.restart_backoff_until = None
+
+        # Gang liveness (opt-in, runPolicy.progressDeadlineSeconds): a
+        # replica whose heartbeat renewals went stale — or that never
+        # produced a first heartbeat within rendezvousDeadlineSeconds of
+        # gang-up — is wedged behind a Running phase the kubelet will
+        # never change. Drive the same gang-restart machine the failure
+        # paths use, with its own cause + ledger.
+        stall = self._check_liveness(job, replicas, run_policy, pods)
+        if stall is not None:
+            # The stall branch owns its status writes: the count must be
+            # DURABLE before any pod dies (see _restart_stalled_gang).
+            self._restart_stalled_gang(job, replicas, pods, stall, old_status)
+            return
 
         services = self.get_services_for_job(job)
         for rtype in self.hooks.replica_order(replicas):
@@ -931,6 +979,31 @@ class JobController:
             return candidate
         return None
 
+    def _teardown_gang_pods(
+        self, job: JobObject, targets: List[Pod], trigger: Pod
+    ) -> List[tuple]:
+        """The shared gang-teardown ordering rule, single-sourced for the
+        failure and stall restart paths: survivors first, the TRIGGER pod
+        last and only once every survivor delete succeeded — a partial
+        teardown therefore always leaves the re-detectable trigger intact
+        for the retry sync. Pods already Terminating are skipped so a
+        retried teardown never double-deletes. Returns (name, exc) pairs
+        for deletes that failed; the caller decides how to surface them."""
+        delete_errors: List[tuple] = []
+        for pod in targets:
+            if pod is trigger or pod.metadata.deletion_timestamp is not None:
+                continue
+            try:
+                self._delete_pod(job, pod)
+            except Exception as exc:  # noqa: BLE001 — keep tearing down
+                delete_errors.append((pod.metadata.name, exc))
+        if not delete_errors and trigger.metadata.deletion_timestamp is None:
+            try:
+                self._delete_pod(job, trigger)
+            except Exception as exc:  # noqa: BLE001
+                delete_errors.append((trigger.metadata.name, exc))
+        return delete_errors
+
     @staticmethod
     def _replica_index(pod: Pod) -> int:
         try:
@@ -938,9 +1011,293 @@ class JobController:
         except ValueError:
             return -1
 
+    # -------------------------------------------------------- gang liveness
+    def _check_liveness(
+        self, job: JobObject, replicas: Dict[str, ReplicaSpec], run_policy,
+        pods: List[Pod],
+    ) -> Optional[Tuple[str, Pod, str]]:
+        """The stall detector: (rtype, pod, message) of the first replica
+        past its liveness deadline, else None. Entirely opt-in — without
+        progressDeadlineSeconds this is one None-check per sync and a job
+        that never heartbeats can never stall-restart.
+
+        Two deadlines, both measured on the LOCAL clock from observation
+        events (the leaderelection skew rule):
+
+        - progress: once a pod's FIRST renewal has been observed, the time
+          since the last observed renewal-change may not exceed the
+          deadline. A heartbeat-less job under a progress deadline alone
+          is therefore never flagged (`seen` never latches).
+        - rendezvous: the time from first observing the pod Running to its
+          first observed heartbeat may not exceed the deadline — the bound
+          that catches a gang frozen in rendezvous forever.
+
+        Side effects: reports the worst observed staleness through
+        on_heartbeat_age, and schedules an AddAfter resync for the
+        earliest upcoming deadline (a heartbeat that STOPS generates no
+        watch event — exactly like the ActiveDeadline resync, the check
+        must wake itself).
+
+        Cost note: one uncached get_lease per in-range Running pod per
+        sync of an opted-in job. Accepted for now — the observation MUST
+        be frequent for the skew rule to time renewals accurately; a
+        lease informer (watch "leases" like every other resource) is the
+        future path if large opted-in gangs make this the dominant sync
+        cost."""
+        from ..cluster.base import NotFound
+
+        pdl = run_policy.progress_deadline_seconds
+        if pdl is None:
+            return None
+        rdl = run_policy.rendezvous_deadline_seconds
+        now = self.clock()
+        cache_key = (job.key(), job.metadata.uid)
+        stalled: Optional[Tuple[str, Pod, str]] = None
+        worst_age = 0.0
+        next_check: Optional[float] = None
+
+        def sooner(remaining: float) -> None:
+            nonlocal next_check
+            next_check = remaining if next_check is None else min(next_check, remaining)
+
+        # Lock scope: only the map of per-job dicts. The per-job dict and
+        # its states are touched exclusively by this job's syncs, which
+        # the workqueue serializes — so the lease reads (blocking I/O on
+        # a real apiserver) run unlocked. A concurrent forget_job at worst
+        # orphans the dict we hold; its updates die with the deleted job.
+        with self._hb_lock:
+            obs = self._hb_obs.setdefault(cache_key, {})
+        present = set()
+        for rtype, spec in replicas.items():
+            num_replicas = spec.replicas or 0
+            for pod in filter_pods_for_replica_type(pods, rtype):
+                if pod.status.phase != POD_RUNNING:
+                    continue
+                if pod.metadata.deletion_timestamp is not None:
+                    continue  # already being replaced; not ours to judge
+                if self._replica_index(pod) >= num_replicas:
+                    continue  # out-of-range: scale-down will delete it
+                present.add(pod.metadata.uid)
+                state = obs.get(pod.metadata.uid)
+                if state is None:
+                    state = obs[pod.metadata.uid] = _HeartbeatState(
+                        running_since=now
+                    )
+                lease_name = constants.heartbeat_lease_name(
+                    pod.metadata.name
+                )
+                try:
+                    lease = self.cluster.get_lease(job.namespace, lease_name)
+                except NotFound:
+                    lease = None
+                except Exception:
+                    # Transient read failure: a liveness verdict may
+                    # never ride on an apiserver blip — skip this pod's
+                    # verdict this round, but SCHEDULE the re-read: the
+                    # wake chain is self-sustaining, and a blip landing
+                    # on a scheduled wake would otherwise cancel it
+                    # permanently (no watch event ever re-arms it). The
+                    # log is the only signal that distinguishes a
+                    # PERSISTENT failure here (e.g. missing lease RBAC =
+                    # stall protection silently off) from a healthy job.
+                    log.warning(
+                        "liveness lease read failed for %s/%s (stall "
+                        "detection degraded until it succeeds)",
+                        job.namespace, lease_name, exc_info=True,
+                    )
+                    sooner(min(pdl, 5.0))
+                    continue
+                raw = None
+                if lease is not None:
+                    lease_spec = lease.get("spec") or {}
+                    raw = (
+                        f"{lease_spec.get('holderIdentity')}"
+                        f"@{lease_spec.get('renewTime')}"
+                    )
+                if not state.baselined:
+                    # First read for this pod incarnation: record the
+                    # lease content as a BASELINE without crediting it
+                    # as a heartbeat. A recreated pod inherits its
+                    # predecessor's (frozen) lease — counting that as
+                    # "first heartbeat seen" would start the staleness
+                    # clock at a renewal this process never made and
+                    # stall-loop every restart before rendezvous.
+                    # Liveness is proven only by a renewal observed to
+                    # HAPPEN: a change from the baseline.
+                    state.baselined = True
+                    state.raw = raw
+                elif raw is not None and raw != state.raw:
+                    # Renewal observed: restart the staleness clock at
+                    # the moment WE saw it change.
+                    state.raw = raw
+                    state.observed_at = now
+                    state.seen = True
+                if state.seen:
+                    age = now - state.observed_at
+                    worst_age = max(worst_age, age)
+                    if age >= pdl:
+                        stalled = stalled or (rtype, pod, (
+                            f"replica {pod.metadata.name} last "
+                            f"heartbeat {age:.0f}s ago "
+                            f"(progressDeadlineSeconds={pdl})"
+                        ))
+                    else:
+                        sooner(pdl - age)
+                elif rdl is not None:
+                    waited = now - state.running_since
+                    worst_age = max(worst_age, waited)
+                    if waited >= rdl:
+                        stalled = stalled or (rtype, pod, (
+                            f"replica {pod.metadata.name} produced no "
+                            f"heartbeat {waited:.0f}s after gang-up "
+                            f"(rendezvousDeadlineSeconds={rdl})"
+                        ))
+                    else:
+                        sooner(rdl - waited)
+                else:
+                    # Baselined but unseen, rendezvous deadline unset:
+                    # nothing to enforce YET — but keep the wake chain
+                    # alive. The controller never watches leases, so
+                    # without a scheduled re-read the first renewal
+                    # after gang-up may never be observed and staleness
+                    # would silently never arm (an opted-in job with
+                    # zero stall protection). The gauge still reports the
+                    # wait (documented semantics): an opted-in job whose
+                    # heartbeats never arrive should show a growing age,
+                    # not a reassuring 0.
+                    worst_age = max(worst_age, now - state.running_since)
+                    sooner(pdl)
+        # Prune pods that vanished (restart, scale-down, terminating):
+        # a recreated pod gets a fresh state under its new uid, so the
+        # rendezvous clock restarts with the new incarnation.
+        for uid in [u for u in obs if u not in present]:
+            obs.pop(uid)
+        self.on_heartbeat_age(job, worst_age)
+        if stalled is None and next_check is not None:
+            # Wake just past the earliest deadline (the +0.1 keeps a
+            # same-instant wake from re-reading "age == deadline - 0").
+            self.requeue(f"{job.kind}:{job.key()}", next_check + 0.1)
+        return stalled
+
+    def _restart_stalled_gang(
+        self, job: JobObject, replicas: Dict[str, ReplicaSpec],
+        pods: List[Pod], stall: Tuple[str, Pod, str],
+        old_status: JobStatus,
+    ) -> None:
+        """Tear the gang down for a liveness verdict (cause ProgressStall).
+        SPMD worlds (restart_peers_on_failure types) go down as one unit —
+        a wedged collective holds every peer hostage, and a lone
+        replacement could never rejoin; kinds without world semantics
+        restart only the stalled replica.
+
+        Count-before-teardown protocol, the inverse of the gang-failure
+        path's delete-trigger-last: a failed pod is durable evidence a
+        retried sync can re-detect, but a stalled pod's evidence is the
+        pod ITSELF — the teardown destroys it. So the count, condition,
+        event, and handled-uid stamp are written to status FIRST; only
+        once that write landed do pods die. A conflicted status write
+        aborts the sync with nothing deleted (the stall re-detects
+        identically on retry), and the handled-uid stamp makes the
+        post-write retry skip re-counting: exactly-once accounting under
+        write faults, which the seeded chaos tier asserts."""
+        rtype, stalled_pod, why = stall
+        key = job.key()
+        world_types = {
+            rt.lower() for rt in replicas
+            if self.hooks.restart_peers_on_failure(rt)
+        }
+        if world_types and stalled_pod.metadata.labels.get(
+            constants.LABEL_REPLICA_TYPE
+        ) in world_types:
+            targets = [
+                p for p in pods
+                if p.metadata.labels.get(constants.LABEL_REPLICA_TYPE)
+                in world_types
+            ]
+        else:
+            targets = [stalled_pod]
+        reason = constants.job_reason(
+            self.hooks.kind, constants.REASON_STALL_RESTARTING
+        )
+        handled = set(job.status.gang_handled_uids or ())
+        job.status._restarting_this_sync = True
+        if stalled_pod.metadata.uid not in handled:
+            # Phase 1 — make the verdict durable before any pod dies. The
+            # stamp covers EVERY target: controller-initiated deletions
+            # must not be re-read by the drained-pod trigger as a node
+            # drain (that would double-charge the incident to the
+            # disruption ledger — the counters must stay disjoint).
+            present = {p.metadata.uid for p in pods}
+            job.status.gang_handled_uids = sorted(
+                (handled & present) | {p.metadata.uid for p in targets}
+            )
+            msg = (
+                f"{self.hooks.kind} {job.name} is restarting "
+                f"{'the whole gang' if len(targets) > 1 else 'a stalled replica'}"
+                f": {why}."
+            )
+            capi.update_job_conditions(
+                job.status, capi.JOB_RESTARTING, reason, msg, now=self.clock()
+            )
+            self._count_restart(job, rtype, capi.RESTART_CAUSE_STALL)
+            try:
+                self._write_status_if_changed(job, old_status)
+            except Exception:  # noqa: BLE001 — conflict/transient write error
+                # Nothing was deleted: the stall re-detects byte-identically
+                # on the retry, so aborting here keeps counting exact.
+                self.requeue(f"{job.kind}:{key}", 1.0)
+                return
+            # Event + metric only once the count is durable: a conflicted
+            # write retries the whole phase, and firing these first would
+            # duplicate them per retry (and let observers see a stall the
+            # ledger doesn't have yet).
+            record_event_best_effort(
+                self.cluster,
+                Event(
+                    type="Warning",
+                    reason=reason,
+                    message=msg,
+                    involved_object=f"{job.kind}/{key}",
+                ),
+            )
+            self.on_job_restarting(job, rtype, capi.RESTART_CAUSE_STALL)
+            old_status = copy.deepcopy(job.status)
+        # Phase 2 — the teardown, retried (without re-counting: the
+        # handled-uid stamp gates phase 1) until every target is down.
+        # Trigger-last matters here too: the stalled pod is the only
+        # member a retried sync can re-DETECT, so it must outlive any
+        # partial teardown or the leftover healthy pods would never be
+        # re-judged and the world would restart mixed.
+        delete_errors = self._teardown_gang_pods(job, targets, stalled_pod)
+        if delete_errors:
+            names = ", ".join(n for n, _ in delete_errors)
+            record_event_best_effort(
+                self.cluster,
+                Event(
+                    type="Warning",
+                    reason=reason,
+                    message=(
+                        f"{self.hooks.kind} {job.name} stall teardown is "
+                        f"partial: delete failed for {names}; retrying."
+                    ),
+                    involved_object=f"{job.kind}/{key}",
+                ),
+            )
+            self.requeue(f"{job.kind}:{key}", 1.0)
+        self._write_status_if_changed(job, old_status)
+
     def _count_restart(self, job: JobObject, rtype: str, cause: str) -> None:
         """Charge one restart to the budget its cause draws from, and open
         the disruption-backoff window when a disruption streak builds."""
+        if cause == capi.RESTART_CAUSE_STALL:
+            # The stall ledger is deliberately budget-free: each restart
+            # is rate-limited by its own deadline window, and
+            # activeDeadlineSeconds stays the hard bound. Disjoint from
+            # both other ledgers by construction.
+            job.status.stall_counts[rtype] = (
+                job.status.stall_counts.get(rtype, 0) + 1
+            )
+            return
         if cause == capi.RESTART_CAUSE_DISRUPTION:
             job.status.disruption_counts[rtype] = (
                 job.status.disruption_counts.get(rtype, 0) + 1
@@ -984,8 +1341,19 @@ class JobController:
 
             pod = pod_slice[0]
             if index >= num_replicas:
-                # Out-of-range (scale-down): delete.
+                # Out-of-range (scale-down): delete, with the pod's
+                # heartbeat lease — the terminal/suspend GC iterates only
+                # the CURRENT spec's indices, so a scaled-down replica's
+                # lease would otherwise be orphaned forever.
                 self._delete_pod(job, pod)
+                if job.run_policy().progress_deadline_seconds is not None:
+                    try:
+                        self.cluster.delete_lease(
+                            job.namespace,
+                            constants.heartbeat_lease_name(pod.metadata.name),
+                        )
+                    except Exception:  # noqa: BLE001 — best-effort GC
+                        pass
                 continue
 
             exit_code = get_container_exit_code(pod, self.hooks.default_container_name)
@@ -1091,6 +1459,24 @@ class JobController:
 
         # Framework rendezvous env (TF_CONFIG etc.).
         self.hooks.set_cluster_spec(job, template, rtype, index)
+
+        # Gang-liveness heartbeat env (opt-in via progressDeadlineSeconds):
+        # tells runtime/heartbeat.py which Lease this pod renews. Injected
+        # after the framework env so the contract is uniform across kinds.
+        run_policy = job.run_policy()
+        if run_policy.progress_deadline_seconds is not None:
+            from ..bootstrap import heartbeat as hb_bootstrap
+
+            hb_env = hb_bootstrap.gen_env(
+                template.metadata.name, job.namespace,
+                run_policy.progress_deadline_seconds,
+            )
+            for container in template.spec.containers:
+                if container.name != self.hooks.default_container_name:
+                    continue
+                for name, value in hb_env.items():
+                    if container.get_env(name) is None:
+                        container.set_env(name, value)
 
         # Restart policy mapping: ExitCode is operator-managed, so the pod
         # itself must never self-restart (reference pod.go:321-328).
@@ -1281,6 +1667,7 @@ class JobController:
             )
         for svc in self.get_services_for_job(job):
             self.service_control.delete_service(svc.metadata.namespace, svc.metadata.name, job)
+        self._delete_heartbeat_leases(job, replicas, run_policy)
         if self.options.enable_gang_scheduling:
             self._delete_gang_groups(job, replicas, run_policy)
         if already is None or already.status != capi.CONDITION_TRUE:
@@ -1308,6 +1695,18 @@ class JobController:
     ) -> None:
         """CleanPodPolicy + TTL GC once the job reached Succeeded/Failed."""
         self._delete_pods_and_services(job, pods, run_policy)
+        if run_policy.progress_deadline_seconds is not None:
+            gc_key = (job.key(), job.metadata.uid)
+            with self._hb_lock:
+                first_terminal_sync = gc_key not in self._hb_gc_done
+                self._hb_gc_done.add(gc_key)
+            if first_terminal_sync:
+                self._delete_heartbeat_leases(job, replicas, run_policy)
+                # A job that went terminal while stale must not keep
+                # exporting its last (above-threshold) heartbeat age —
+                # the staleness alert would page forever for a job that
+                # is already Succeeded/Failed.
+                self.on_heartbeat_age(job, 0.0)
 
         ttl = run_policy.ttl_seconds_after_finished
         if ttl is not None:
@@ -1327,6 +1726,25 @@ class JobController:
 
         if self.options.enable_gang_scheduling:
             self._delete_gang_groups(job, replicas, run_policy)
+
+    def _delete_heartbeat_leases(
+        self, job: JobObject, replicas: Dict[str, ReplicaSpec], run_policy
+    ) -> None:
+        """GC the per-pod heartbeat Leases of a finished/suspended job.
+        Best-effort by design: a lease is tiny, same-name pod recreations
+        overwrite it, and a terminal job must never wedge on GC — so every
+        failure (including a backend predating delete_lease) is swallowed."""
+        if run_policy.progress_deadline_seconds is None:
+            return
+        for rtype, spec in replicas.items():
+            for index in range(spec.replicas or 0):
+                name = constants.heartbeat_lease_name(
+                    gen_general_name(job.name, rtype, index)
+                )
+                try:
+                    self.cluster.delete_lease(job.namespace, name)
+                except Exception:  # noqa: BLE001 — best-effort GC
+                    pass
 
     def _delete_gang_groups(self, job: JobObject, replicas: Dict[str, ReplicaSpec], run_policy) -> None:
         """Tear down the gang units (terminal cleanup and suspension).

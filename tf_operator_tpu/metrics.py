@@ -14,7 +14,7 @@ from __future__ import annotations
 import bisect
 import threading
 from collections import defaultdict, deque
-from typing import Dict, List, Set, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 
 class _Histogram:
@@ -72,6 +72,17 @@ class Metrics:
             "full expectation window",
         ),
     }
+    # Gauges with label sets: name -> (label names, help). Values live in
+    # _labeled_gauges keyed by the label-value tuple, in label-name order.
+    _LABELED_GAUGES = {
+        "training_operator_heartbeat_age_seconds": (
+            ("job_namespace", "framework", "job_name"),
+            "Seconds since the operator last observed a heartbeat renewal "
+            "from the job's slowest replica (gang liveness; only exported "
+            "for jobs with runPolicy.progressDeadlineSeconds set). Crossing "
+            "the deadline drives a ProgressStall gang restart",
+        ),
+    }
     _HISTOGRAM_BUCKETS = (0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600)
     # Reconciles are ms-scale; startup/restart are seconds-scale.
     _BUCKETS_BY_NAME = {
@@ -89,6 +100,9 @@ class Metrics:
             name: defaultdict(int) for name in self._LABELED_COUNTERS
         }
         self._terminal_seen: Set[Tuple[str, str, str]] = set()
+        self._labeled_gauges: Dict[str, Dict[Tuple[str, ...], float]] = {
+            name: {} for name in self._LABELED_GAUGES
+        }
 
         def series(name: str):
             bounds = self._BUCKETS_BY_NAME.get(name, self._HISTOGRAM_BUCKETS)
@@ -143,6 +157,31 @@ class Metrics:
             "training_operator_expectation_timeouts_total",
             namespace, framework, kind,
         )
+
+    def set_heartbeat_age(self, namespace: str, framework: str,
+                          job_name: str, seconds: float) -> None:
+        """Worst observed heartbeat staleness of one liveness-enabled job
+        (updated on every liveness check)."""
+        with self._lock:
+            self._labeled_gauges["training_operator_heartbeat_age_seconds"][
+                (namespace, framework, job_name)
+            ] = seconds
+
+    def heartbeat_age_value(self, namespace: str, framework: str,
+                            job_name: str) -> Optional[float]:
+        with self._lock:
+            return self._labeled_gauges[
+                "training_operator_heartbeat_age_seconds"
+            ].get((namespace, framework, job_name))
+
+    def clear_heartbeat_age(self, namespace: str, framework: str,
+                            job_name: str) -> None:
+        """Drop a deleted job's series so churn doesn't grow the gauge map
+        (same leak class as the terminal-dedup set)."""
+        with self._lock:
+            self._labeled_gauges["training_operator_heartbeat_age_seconds"].pop(
+                (namespace, framework, job_name), None
+            )
 
     def successful_inc_once(self, namespace: str, framework: str, job_key: str) -> None:
         """`job_key` should be the job UID (unique per incarnation): a
@@ -225,6 +264,14 @@ class Metrics:
                     lines.append(f'{name}_bucket{{{label},le="+Inf"}} {hist.count}')
                     lines.append(f"{name}_sum{{{label}}} {hist.total}")
                     lines.append(f"{name}_count{{{label}}} {hist.count}")
+            for name, (label_names, help_text) in self._LABELED_GAUGES.items():
+                lines.append(f"# HELP {name} {help_text}")
+                lines.append(f"# TYPE {name} gauge")
+                for values, gauge in sorted(self._labeled_gauges[name].items()):
+                    label = ",".join(
+                        f'{ln}="{lv}"' for ln, lv in zip(label_names, values)
+                    )
+                    lines.append(f"{name}{{{label}}} {gauge:g}")
             for name, value in sorted(self._gauges.items()):
                 lines.append(f"# HELP {name} {name.replace('_', ' ')}")
                 lines.append(f"# TYPE {name} gauge")

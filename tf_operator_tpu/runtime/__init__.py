@@ -6,6 +6,14 @@ done once here instead of in every training script — injected env →
 ``jax.distributed.initialize`` → device mesh.
 """
 
+from .heartbeat import record_progress
 from .tpu_init import Topology, global_mesh, initialize, topology_from_env, tpu_init
 
-__all__ = ["Topology", "global_mesh", "initialize", "topology_from_env", "tpu_init"]
+__all__ = [
+    "Topology",
+    "global_mesh",
+    "initialize",
+    "record_progress",
+    "topology_from_env",
+    "tpu_init",
+]

@@ -1,0 +1,257 @@
+"""In-container gang-liveness heartbeat (the worker half of stall detection).
+
+The dominant unhandled failure on TPU pod-slices is the replica that wedges
+*silently*: every pod reports Running while a collective is deadlocked, an
+ICI link is dead under a live kubelet, or the gang never leaves rendezvous.
+The kubelet cannot see any of that — only the process can prove its own
+liveness. This module is that proof: a daemon thread started from
+``tpu_init()`` renews a per-pod heartbeat Lease, and training loops may
+additionally call :func:`record_progress` so the control plane (and
+debuggers reading the Lease) see the last completed step.
+
+The renewal runs through the same ``Cluster`` seam leader election uses
+(``core/leaderelection.py``): full-object optimistic-concurrency writes on a
+``coordination.k8s.io/v1`` Lease, so the identical protocol works against
+KubeCluster (a real apiserver or the HTTP stub), the in-memory cluster, and
+— via the ``TPU_HEARTBEAT_FILE`` bridge the process cluster's kubelet-analog
+translates — live subprocesses in the e2e tier. A Conflict means a
+concurrent writer touched OUR lease (nothing else should); the round is
+simply dropped and the next tick re-reads.
+
+Everything degrades to a no-op when the env is absent: a dev-box run starts
+no thread, exactly like the rest of the bootstrap contracts.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..bootstrap.heartbeat import (
+    ENV_HEARTBEAT_FILE,
+    ENV_HEARTBEAT_INTERVAL,
+    ENV_HEARTBEAT_LEASE,
+    ENV_HEARTBEAT_NAMESPACE,
+)
+from ..core.constants import ANNOTATION_HEARTBEAT_STEP
+
+log = logging.getLogger(__name__)
+
+
+# ------------------------------------------------------------- publication
+def publish_heartbeat(cluster, namespace: str, name: str, identity: str,
+                      step: Optional[int] = None, clock=time.time) -> bool:
+    """One heartbeat renewal through the Cluster seam. True iff the beat
+    landed; False on a lost optimistic-concurrency round (retry next tick).
+
+    Same idiom as ClusterLeaseLock.try_acquire: GET (NotFound -> create),
+    mutate the read object carrying its resourceVersion, full-object PUT —
+    a concurrent writer's bump turns ours into a Conflict. Transient API
+    errors also just skip the beat: the operator's staleness clock is
+    generous (several intervals per deadline) precisely so one blip never
+    reads as a stall.
+    """
+    from ..cluster.base import Conflict, NotFound
+    from ..core.leaderelection import _format_microtime
+
+    now = clock()
+    try:
+        lease = cluster.get_lease(namespace, name)
+    except NotFound:
+        lease = {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"namespace": namespace, "name": name},
+            "spec": {
+                "holderIdentity": identity,
+                "acquireTime": _format_microtime(now),
+                "renewTime": _format_microtime(now),
+                "leaseDurationSeconds": 0,
+            },
+        }
+        if step is not None:
+            lease["metadata"]["annotations"] = {
+                ANNOTATION_HEARTBEAT_STEP: str(step)
+            }
+        try:
+            cluster.create_lease(lease)
+            return True
+        except Conflict:
+            return False  # racing first beat; the winner's renewal stands
+        except Exception:
+            log.debug("heartbeat create failed", exc_info=True)
+            return False
+    except Exception:
+        log.debug("heartbeat read failed", exc_info=True)
+        return False
+
+    spec = lease.setdefault("spec", {})
+    spec["holderIdentity"] = identity
+    spec["renewTime"] = _format_microtime(now)
+    if step is not None:
+        meta = lease.setdefault("metadata", {})
+        annotations = meta.get("annotations") or {}
+        annotations[ANNOTATION_HEARTBEAT_STEP] = str(step)
+        meta["annotations"] = annotations
+    try:
+        cluster.update_lease(lease)
+        return True
+    except Conflict:
+        return False
+    except Exception:
+        log.debug("heartbeat renew failed", exc_info=True)
+        return False
+
+
+def write_heartbeat_file(path: str, seq: int, step: Optional[int]) -> None:
+    """The file half of the process-tier bridge: one JSON object, replaced
+    wholesale each beat (write-to-temp + rename so the reader never sees a
+    torn write). ``seq`` strictly increases so the bridge can tell a fresh
+    beat from a re-read."""
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as fh:
+        json.dump({"seq": seq, "step": step, "ts": time.time()}, fh)
+    os.replace(tmp, path)
+
+
+def read_heartbeat_file(path: str) -> Optional[dict]:
+    """Reader half (LocalProcessCluster's kubelet-analog). None when the
+    file is absent or torn — never raises into the reaper loop."""
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return data if isinstance(data, dict) and "seq" in data else None
+
+
+# --------------------------------------------------------------- publisher
+class HeartbeatPublisher:
+    """Daemon renewal loop around one sink. ``record_progress`` updates the
+    step AND wakes the loop so a long sleep never delays the proof of the
+    step that just completed."""
+
+    def __init__(self, sink: Callable[[int, Optional[int]], None],
+                 interval: float):
+        self._sink = sink
+        self.interval = max(0.05, float(interval))
+        self._step: Optional[int] = None
+        self._seq = 0
+        self._wake = threading.Event()
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "HeartbeatPublisher":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="tpu-heartbeat", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def record_progress(self, step: Optional[int] = None) -> None:
+        if step is not None:
+            self._step = int(step)
+        self._wake.set()
+
+    def beat_once(self) -> None:
+        """One synchronous beat (also the loop body): never raises — a
+        broken sink must not take the training process down with it."""
+        self._seq += 1
+        try:
+            self._sink(self._seq, self._step)
+        except Exception:  # noqa: BLE001 — liveness must never kill training
+            log.debug("heartbeat sink failed", exc_info=True)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._wake.set()
+
+    def _run(self) -> None:
+        while not self._stopped.is_set():
+            self.beat_once()
+            self._wake.wait(self.interval)
+            self._wake.clear()
+
+
+# ------------------------------------------------------------- module API
+_active: Optional[HeartbeatPublisher] = None
+_lock = threading.Lock()
+
+
+def start_from_env(cluster=None,
+                   env: Optional[Dict[str, str]] = None) -> Optional[HeartbeatPublisher]:
+    """Start (once) the heartbeat thread the injected env describes.
+
+    Sink resolution, most-specific first:
+    - ``TPU_HEARTBEAT_FILE`` -> file bridge (process e2e tier);
+    - explicit ``cluster`` -> direct Lease renewals through that seam
+      (unit tests; embedded runtimes);
+    - in-cluster (``KUBERNETES_SERVICE_HOST``) -> a KubeCluster against the
+      real apiserver, service-account auth;
+    - anything else -> no-op (dev box).
+
+    Returns the active publisher, or None when the env opts out. Idempotent:
+    repeated calls (tpu_init() then an explicit initialize()) share one
+    thread.
+    """
+    global _active
+    env = os.environ if env is None else env
+    lease = env.get(ENV_HEARTBEAT_LEASE)
+    if not lease:
+        return None
+    with _lock:
+        if _active is not None:
+            return _active
+        namespace = env.get(ENV_HEARTBEAT_NAMESPACE, "default")
+        try:
+            interval = float(env.get(ENV_HEARTBEAT_INTERVAL, "5"))
+        except ValueError:
+            interval = 5.0
+        identity = env.get("HOSTNAME") or lease
+        file_path = env.get(ENV_HEARTBEAT_FILE)
+        if file_path:
+            def sink(seq: int, step: Optional[int],
+                     _path=file_path) -> None:
+                write_heartbeat_file(_path, seq, step)
+        else:
+            if cluster is None and "KUBERNETES_SERVICE_HOST" in env:
+                try:
+                    from ..cluster.kube import KubeCluster
+
+                    cluster = KubeCluster(namespace=namespace)
+                except Exception:  # no creds/unreachable: stay silent
+                    log.debug("in-cluster heartbeat setup failed",
+                              exc_info=True)
+                    return None
+            if cluster is None:
+                return None
+
+            def sink(seq: int, step: Optional[int], _c=cluster,
+                     _ns=namespace, _name=lease, _id=identity) -> None:
+                publish_heartbeat(_c, _ns, _name, _id, step=step)
+
+        _active = HeartbeatPublisher(sink, interval).start()
+        return _active
+
+
+def record_progress(step: Optional[int] = None) -> None:
+    """Training-loop API: prove liveness now (and record the step). A
+    no-op when no publisher is active, so workloads can call it
+    unconditionally — the same script runs with and without the operator."""
+    publisher = _active
+    if publisher is not None:
+        publisher.record_progress(step)
+
+
+def stop() -> None:
+    """Tear down the active publisher (tests; graceful shutdown)."""
+    global _active
+    with _lock:
+        if _active is not None:
+            _active.stop()
+            _active = None

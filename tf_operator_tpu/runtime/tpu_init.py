@@ -124,6 +124,14 @@ def initialize(
     """
     global _initialized
     _force_declared_platform()
+    # Gang liveness: start renewing this pod's heartbeat BEFORE the
+    # rendezvous blocks — a worker wedged inside
+    # jax.distributed.initialize must still prove the process is alive
+    # (rendezvousDeadlineSeconds measures first-heartbeat, not first
+    # step). No-op without the operator-injected heartbeat env.
+    from . import heartbeat as _heartbeat
+
+    _heartbeat.start_from_env()
     topo = topology or topology_from_env()
     # Local mode must NOT latch: a pre-env probe call (import-time init, a
     # notebook) would otherwise make the later real rendezvous a silent no-op.
@@ -131,6 +139,18 @@ def initialize(
         return topo
 
     import jax
+
+    # CPU dev/e2e federation: multi-process computations on the CPU
+    # backend need the gloo collectives implementation selected BEFORE
+    # backend init, or every cross-process collective dies with
+    # "Multiprocess computations aren't implemented on the CPU backend"
+    # (jax 0.4.x; newer versions default to gloo and drop the knob —
+    # hence best-effort).
+    if (os.environ.get("JAX_PLATFORMS") or "").startswith("cpu"):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
 
     kwargs = dict(
         coordinator_address=topo.coordinator_address,

@@ -5,6 +5,12 @@ is to ours: a container program that consumes ONLY the operator-injected
 env, rendezvouses through `tpu_init`, and proves the collective fabric by
 psum-ing each process's contribution across every device. Exit code 0 only
 if the global sum matches the expected closed form.
+
+``--progress-steps N`` appends a liveness-exercising training loop: N
+steps, each running the same psum collective (so a wedged peer stalls the
+whole gang, exactly like a real SPMD step) and reporting progress via
+``record_progress`` — the workload half of the gang-liveness contract the
+ProgressStall e2e regression SIGSTOPs mid-loop.
 """
 
 from __future__ import annotations
@@ -12,7 +18,13 @@ from __future__ import annotations
 import sys
 
 
-def main() -> int:
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--progress-steps", type=int, default=0)
+    parser.add_argument("--step-seconds", type=float, default=0.25)
+    args = parser.parse_args(argv)
     import jax
     import jax.numpy as jnp
 
@@ -41,6 +53,11 @@ def main() -> int:
 
     from jax.sharding import PartitionSpec as P
 
+    try:  # jax >= 0.5 exposes it at top level; 0.4.x under experimental
+        shard_map = jax.shard_map
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map
+
     def contribute():
         total = jnp.float32(1.0)
         for name in axis_names:
@@ -48,7 +65,7 @@ def main() -> int:
         return total
 
     summed = jax.jit(
-        jax.shard_map(contribute, mesh=mesh, in_specs=(), out_specs=P())
+        shard_map(contribute, mesh=mesh, in_specs=(), out_specs=P())
     )()
     got = float(jnp.asarray(summed.addressable_data(0)))
     want = float(n_global)
@@ -56,6 +73,26 @@ def main() -> int:
     if got != want:
         print("[rendezvous] FAIL: collective mismatch", flush=True)
         return 4
+
+    if args.progress_steps > 0:
+        import time
+
+        from tf_operator_tpu.runtime.heartbeat import record_progress
+
+        step_fn = jax.jit(
+            shard_map(contribute, mesh=mesh, in_specs=(), out_specs=P())
+        )
+        for step in range(args.progress_steps):
+            # A real collective per step: a SIGSTOPped peer blocks every
+            # process here (its heartbeat thread freezes with it), while
+            # healthy peers keep renewing from their own threads — the
+            # asymmetry the stall detector keys on.
+            jax.block_until_ready(step_fn())
+            record_progress(step=step)
+            time.sleep(args.step_seconds)
+        print(f"[rendezvous] progress loop done ({args.progress_steps} steps)",
+              flush=True)
+
     print("[rendezvous] OK", flush=True)
     return 0
 

@@ -445,6 +445,9 @@ class StubApiServer:
             return handler._json(201, self.mem.create_lease(handler._body()))
         if method == "PUT":
             return handler._json(200, self.mem.update_lease(handler._body()))
+        if method == "DELETE":
+            self.mem.delete_lease(ns, name)
+            return handler._json(200, {})
         raise KeyError(method)
 
     # -------------------------------------------------------------- watches
