@@ -251,11 +251,23 @@ def default_dag() -> List[Step]:
              pytest + ["tests/test_chaos.py", "tests/test_disruption.py",
                        "tests/test_stall.py", "-m", "not slow"],
              deps=["operator-integration"], retries=2),
+        # Crash tier (docs/design/crash_consistency.md): the controller
+        # itself dies at seeded CrashPoints (before/after-write variants)
+        # and a cold-started replacement must converge every job with the
+        # structural invariants (testing/invariants.py) green and all
+        # three restart ledgers exactly-once; plus the stuck-terminating
+        # force-delete escalation end-to-end. Fixed seeds here,
+        # byte-reproducible from the seed alone; the randomized crash
+        # sweep rides chaos-sweep below.
+        Step("crash-seeded",
+             pytest + ["tests/test_crash_failover.py",
+                       "tests/test_stuck_terminating.py", "-m", "not slow"],
+             deps=["operator-integration"], retries=2),
         # The full randomized sweeps, serialized after the fixed seeds.
         Step("chaos-sweep",
              pytest + ["tests/test_chaos.py", "tests/test_stall.py",
-                       "-m", "slow"],
-             deps=["chaos-seeded"], retries=2),
+                       "tests/test_crash_failover.py", "-m", "slow"],
+             deps=["chaos-seeded", "crash-seeded"], retries=2),
         # Residency under sustained churn (VERDICT r4 #6): ~10 min of
         # create/churn/succeed/delete waves over the HTTP backend with two
         # leader-elected replicas; asserts the RSS plateau, reconcile p90,

@@ -28,6 +28,7 @@ from tf_operator_tpu.controllers.jax import JAXController
 from tf_operator_tpu.controllers.tensorflow import TFController
 from tf_operator_tpu.core import expectations as expmod
 from tf_operator_tpu.metrics import Metrics
+from tf_operator_tpu.testing.invariants import assert_invariants
 
 
 def container(name):
@@ -199,6 +200,17 @@ class TestSeededSlicePreemption:
         # The schedule recorded the batch kill of the full slice host.
         preempts = [f for f in out["fault_log"] if f.startswith("preempt:")]
         assert len(preempts) == 4
+        # Structural invariants (the crash tier's checker, run here too):
+        # well-formed conditions, no orphans/duplicate slots, exact
+        # exactly-once ledgers.
+        assert_invariants(
+            out["inner"], kinds=("JAXJob",),
+            expect_ledgers={
+                "disruptionCounts": {"Worker": 1},
+                "restartCounts": {},
+                "stallCounts": {},
+            },
+        )
         # Terminal hygiene: nothing owned survives the job.
         assert_no_orphans(out["inner"], out["controller"], "JAXJob", "llama")
 
@@ -304,6 +316,7 @@ class TestWriteFaultConvergence:
             for p in pods
         }
         assert len(slots) == len(pods)
+        assert_invariants(inner, kinds=("TFJob",))
         assert_no_orphans(inner, controller, "TFJob", "tj")
 
 
@@ -369,12 +382,19 @@ class TestRandomizedSweep:
         assert conds["Succeeded"]["status"] == "True"
         assert "restartCounts" not in status, (
             "disruption leaked into backoffLimit accounting")
-        # The disruption ledger normally shows the one restart; an injected
-        # Conflict on the post-teardown status write can lose the increment
-        # (the same exposure restartCounts has always had) — under-counting
-        # is the conservative direction for a budget, so the invariant is
-        # "never MORE than the physical restarts, never on backoffLimit".
-        assert status.get("disruptionCounts", {}).get("Worker", 0) <= 1
+        # Exactly one: the count-before-teardown protocol (ISSUE 3) closed
+        # the old loss window — a Conflict on the counting write now aborts
+        # the sync with nothing deleted, and the retry re-detects the
+        # intact trigger, so the increment can neither be lost nor doubled.
+        assert status.get("disruptionCounts", {}).get("Worker", 0) == 1
+        assert_invariants(
+            out["inner"], kinds=("JAXJob",),
+            expect_ledgers={
+                "disruptionCounts": {"Worker": 1},
+                "restartCounts": {},
+                "stallCounts": {},
+            },
+        )
         assert_no_orphans(
             out["inner"], out["controller"], "JAXJob", "llama"
         )

@@ -10,16 +10,21 @@ cmd/tf-operator.v1/app/server.go:168-196; RV-dedup predicates:
 pkg/common/util/reconciler.go:80-123).
 """
 
+import dataclasses
 import time
 
 import pytest
 
 from tf_operator_tpu.cli import OperatorManager, OperatorOptions
 from tf_operator_tpu.cluster.base import ADDED, MODIFIED, SYNC, Conflict
+from tf_operator_tpu.cluster.chaos import ChaosCluster, ChaosSpec, CrashPoint
 from tf_operator_tpu.cluster.kube import KubeCluster
 from tf_operator_tpu.cluster.memory import InMemoryCluster
 from tf_operator_tpu.core.leaderelection import ClusterLeaseLock
+from tf_operator_tpu.core.workqueue import WorkQueue
 from tf_operator_tpu.metrics import Metrics
+from tf_operator_tpu.testing.failover import FailoverDriver
+from tf_operator_tpu.testing.invariants import assert_invariants
 from tf_operator_tpu.testing.stub_apiserver import StubApiServer
 
 
@@ -266,6 +271,115 @@ class TestTwoReplicaElection:
             assert lock.holder is None
         finally:
             kube.shutdown()
+
+
+class TestLeaderFailoverMidGangRestart:
+    """ISSUE 3 regression: the old leader crashes BETWEEN the counted
+    status write and the teardown of a gang restart (the after-write
+    CrashPoint on the phase-1 status write). The new leader — fresh
+    in-memory everything, cold-start resync, nothing but persisted
+    status — must finish the teardown without double-counting ANY of the
+    three ledgers, with every world pod lingering Terminating through
+    its grace period across the handoff (the graceful-deletion hold)."""
+
+    def test_new_leader_finishes_teardown_exactly_once(self):
+        from tf_operator_tpu.api.k8s import POD_FAILED, POD_PENDING, POD_RUNNING
+        from tf_operator_tpu.controllers.jax import JAXController
+
+        def jaxjob(workers=4):
+            return {
+                "apiVersion": "kubeflow.org/v1",
+                "kind": "JAXJob",
+                "metadata": {"name": "llama", "namespace": "default"},
+                "spec": {
+                    "jaxReplicaSpecs": {
+                        "Worker": {
+                            "replicas": workers,
+                            "template": {"spec": {"containers": [
+                                {"name": "jax", "image": "test:1"}]}},
+                        }
+                    },
+                    "runPolicy": {"backoffLimit": 0},
+                },
+            }
+
+        inner = InMemoryCluster()
+        chaos = ChaosCluster(inner, ChaosSpec(seed=17))
+        driver = FailoverDriver(
+            chaos,
+            lambda cluster: JAXController(
+                cluster, queue=WorkQueue(), metrics=Metrics()
+            ),
+            kinds=("JAXJob",),
+        )
+        inner.create_job(jaxjob())
+        driver.run_until_idle()
+        for p in inner.list_pods("default"):
+            if p.status.phase == POD_PENDING:
+                inner.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+        driver.run_until_idle()
+
+        # All deletes wedge in their grace window (real-apiserver
+        # semantics), worker-2 is preempted, and the old leader dies the
+        # instant its counted status write lands — before any teardown.
+        inner.hold_pod_termination()
+        inner.set_pod_phase(
+            "default", "llama-worker-2", POD_FAILED, exit_code=137,
+            disruption_target="Preempted",
+        )
+        idx = chaos.next_call_index("update_job_status")
+        chaos.spec = dataclasses.replace(chaos.spec, crash_points=(
+            CrashPoint("update_job_status", idx, before_write=False),
+        ))
+        driver.controller.queue.add("JAXJob:default/llama")
+        driver.run_until_idle()
+        assert len(driver.crashes) == 1, driver.crashes
+        status = inner.get_job("JAXJob", "default", "llama")["status"]
+        assert status["disruptionCounts"] == {"Worker": 1}, (
+            "the counted write landed before the crash")
+
+        # The NEW leader (already booted by the driver) finished the
+        # teardown: every world pod is Terminating, and repeated syncs
+        # while they linger must not re-count or re-fire.
+        for _ in range(4):
+            driver.controller.queue.add("JAXJob:default/llama")
+            driver.run_until_idle()
+        pods = inner.list_pods("default")
+        assert len(pods) == 4
+        assert all(p.metadata.deletion_timestamp is not None for p in pods), (
+            "new leader must finish the gang teardown")
+        status = inner.get_job("JAXJob", "default", "llama")["status"]
+        assert status["disruptionCounts"] == {"Worker": 1}, "double-counted"
+        assert "restartCounts" not in status
+        assert "stallCounts" not in status
+        restart_events = [
+            e for e in inner.list_events()
+            if e.reason == "JAXJobDisruptionRestarting"
+            and "restarting the whole gang" in e.message
+        ]
+        assert len(restart_events) <= 1, "teardown re-fired across failover"
+
+        # Grace periods end (kubelet acks): the world recreates and
+        # converges, still exactly one counted restart.
+        inner.release_pod_terminations()
+        driver.controller.queue.add("JAXJob:default/llama")
+        driver.run_until_idle()
+        for p in inner.list_pods("default"):
+            if p.status.phase == POD_PENDING:
+                inner.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+        driver.controller.queue.add("JAXJob:default/llama")
+        driver.run_until_idle()
+        pods = inner.list_pods("default")
+        assert len(pods) == 4
+        assert all(p.metadata.deletion_timestamp is None for p in pods)
+        assert_invariants(
+            inner, kinds=("JAXJob",),
+            expect_ledgers={
+                "disruptionCounts": {"Worker": 1},
+                "restartCounts": {},
+                "stallCounts": {},
+            },
+        )
 
 
 class TestInformerWatchSemantics:

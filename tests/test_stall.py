@@ -26,6 +26,7 @@ from tf_operator_tpu.core.constants import heartbeat_lease_name
 from tf_operator_tpu.core.workqueue import WorkQueue
 from tf_operator_tpu.metrics import Metrics
 from tf_operator_tpu.runtime.heartbeat import publish_heartbeat
+from tf_operator_tpu.testing.invariants import assert_invariants
 
 
 def container(name):
@@ -184,6 +185,16 @@ class TestSeededProgressStall:
         conds = conds_of(d.inner, "JAXJob", "llama")
         assert conds["Succeeded"]["status"] == "True"
         assert conds.get("Failed", {}).get("status") != "True"
+        # Structural invariants (the crash tier's checker): exactly-once
+        # stall ledger, untouched siblings, well-formed conditions.
+        assert_invariants(
+            d.inner, kinds=("JAXJob",),
+            expect_ledgers={
+                "stallCounts": {"Worker": 1},
+                "restartCounts": {},
+                "disruptionCounts": {},
+            },
+        )
 
     def test_same_seed_reproduces_fault_log_byte_for_byte(self):
         d1, _ = run_progress_stall_scenario(seed=23)
@@ -353,5 +364,6 @@ class TestRandomizedStallSweep:
         assert status["stallCounts"] == {"Worker": 1}
         assert "restartCounts" not in status
         assert "disruptionCounts" not in status
+        assert_invariants(d.inner, kinds=("JAXJob",))
         d2, _ = run_progress_stall_scenario(seed=seed)
         assert d2.chaos.fault_log == d.chaos.fault_log

@@ -1,0 +1,325 @@
+"""Stuck-terminating force-delete escalation (ISSUE 3 tentpole b).
+
+The dominant dead-host failure mode: a pod wedged Terminating on a
+reclaimed TPU host (kubelet dead, graceful deletion never acked) blocks
+gang recovery forever — the lingering object occupies its replica index.
+The opt-in `runPolicy.forceDeleteAfterSeconds` escalates such a pod to a
+grace-period-0 force delete, with a Warning event and a cause-labeled
+metric. Acceptance (ISSUE 3):
+
+- a chaos `stuck_terminating` pod blocks a gang restart until the bound
+  elapses, then the force delete (event + metric recorded) unblocks
+  recovery;
+- with the field unset, no escalation EVER fires;
+- the force path exists across the cluster seam (memory here; REST wire
+  form against the stub apiserver below; validation + CRD schema).
+"""
+
+import pytest
+
+from tf_operator_tpu.api.defaulting import ValidationError
+from tf_operator_tpu.api.k8s import ObjectMeta, Pod, POD_FAILED, POD_PENDING, POD_RUNNING
+from tf_operator_tpu.cluster.base import NotFound
+from tf_operator_tpu.cluster.chaos import (
+    ChaosCluster,
+    ChaosSpec,
+    ScheduledStuckTermination,
+)
+from tf_operator_tpu.cluster.memory import InMemoryCluster
+from tf_operator_tpu.controllers.jax import JAXController
+from tf_operator_tpu.core.workqueue import WorkQueue
+from tf_operator_tpu.metrics import Metrics
+from tf_operator_tpu.testing.invariants import assert_invariants
+from tf_operator_tpu.testing.stub_apiserver import StubApiServer
+
+
+def container(name):
+    return {"name": name, "image": "test:1"}
+
+
+def jax_manifest(name="llama", workers=4, run_policy=None):
+    spec = {
+        "jaxReplicaSpecs": {
+            "Worker": {
+                "replicas": workers,
+                "template": {"spec": {"containers": [container("jax")]}},
+            }
+        },
+    }
+    if run_policy:
+        spec["runPolicy"] = run_policy
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "JAXJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": spec,
+    }
+
+
+class StuckDriver:
+    """Fake-clock scenario: gang up, wedge one pod's graceful deletion
+    (chaos stuck_terminating), fail a peer to trigger the gang restart,
+    then watch the escalation clock."""
+
+    def __init__(self, run_policy=None, seed=0):
+        self.now = [1000.0]
+        clock = lambda: self.now[0]  # noqa: E731
+        self.inner = InMemoryCluster(clock=clock)
+        self.chaos = ChaosCluster(self.inner, ChaosSpec(seed=seed))
+        self.metrics = Metrics()
+        self.controller = JAXController(
+            self.chaos, queue=WorkQueue(clock=clock),
+            metrics=self.metrics, clock=clock,
+        )
+        self.inner.create_job(jax_manifest(run_policy=run_policy))
+        self.sync()
+        for p in self.inner.list_pods("default"):
+            if p.status.phase == POD_PENDING:
+                self.inner.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+        self.sync()
+
+    def sync(self):
+        self.controller.queue.add("JAXJob:default/llama")
+        self.controller.run_until_idle()
+
+    def advance(self, seconds):
+        self.now[0] += seconds
+        self.sync()
+
+    def wedge_and_fail(self, stuck="llama-worker-1", failed="llama-worker-2"):
+        """The acceptance sequence: worker-1's host dies (deletes wedge),
+        worker-2 is preempted — the gang teardown then leaves worker-1
+        stuck Terminating."""
+        self.chaos.stick_terminating(name_contains=stuck)
+        self.inner.set_pod_phase(
+            "default", failed, POD_FAILED, exit_code=137,
+            disruption_target="Preempted",
+        )
+        self.sync()
+        self.sync()
+
+    def pods(self):
+        return {p.metadata.name: p for p in self.inner.list_pods("default")}
+
+    def force_events(self):
+        return [e for e in self.inner.list_events()
+                if e.reason == "ForceDeletePod"]
+
+    def force_metric(self):
+        return self.metrics.labeled_counter_value(
+            "training_operator_force_deletes_total",
+            "default", "JAXJob", "StuckTerminating",
+        )
+
+
+GRACE = InMemoryCluster.DEFAULT_GRACE_PERIOD_SECONDS  # 30.0
+
+
+class TestForceDeleteEscalation:
+    def test_stuck_pod_blocks_then_force_delete_unblocks(self):
+        """End-to-end acceptance: the stuck pod blocks its index through
+        grace + forceDeleteAfterSeconds, then the escalation fires once
+        (event + metric) and the gang recreates and recovers."""
+        d = StuckDriver(run_policy={"forceDeleteAfterSeconds": 60,
+                                    "backoffLimit": 0})
+        d.wedge_and_fail()
+        pods = d.pods()
+        stuck = pods["llama-worker-1"]
+        assert stuck.metadata.deletion_timestamp is not None, (
+            "the wedged pod must be Terminating")
+        stuck_uid = stuck.metadata.uid
+        # Blocked: inside the window the index is occupied by the corpse —
+        # no replacement pod can exist, and no escalation fires.
+        d.advance(GRACE + 30)  # 60s in: grace elapsed, bound not yet
+        pods = d.pods()
+        assert pods["llama-worker-1"].metadata.uid == stuck_uid, (
+            "escalation fired inside the window")
+        assert d.force_events() == []
+        assert d.force_metric() == 0
+
+        # The deadline passes: deletionTimestamp + grace + 60 < now.
+        d.advance(45)  # 105s after deletion began: 30 + 60 exceeded
+        d.sync()
+        assert len(d.force_events()) == 1, "escalation must fire exactly once"
+        assert d.force_metric() == 1
+        assert "force-deleted" in d.force_events()[0].message
+        # Unblocked: the index recreates with a fresh pod; the gang
+        # converges back to Running with only the one disruption counted.
+        for _ in range(4):
+            for p in d.inner.list_pods("default"):
+                if p.status.phase == POD_PENDING:
+                    d.inner.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+            d.advance(1)
+        pods = d.pods()
+        assert len(pods) == 4
+        assert pods["llama-worker-1"].metadata.uid != stuck_uid
+        assert pods["llama-worker-1"].metadata.deletion_timestamp is None
+        status = d.inner.get_job("JAXJob", "default", "llama")["status"]
+        assert status["disruptionCounts"] == {"Worker": 1}
+        assert "restartCounts" not in status
+        conds = {c["type"]: c for c in status["conditions"]}
+        assert conds.get("Running", {}).get("status") == "True"
+        assert conds.get("Failed", {}).get("status") != "True"
+        assert_invariants(d.inner, kinds=("JAXJob",))
+        # The injection is on the byte-reproducible record.
+        assert any(
+            f.startswith("stuck-terminating:") for f in d.chaos.fault_log
+        )
+
+    def test_field_unset_never_escalates(self):
+        """The k8s-safe default: without forceDeleteAfterSeconds the
+        operator NEVER force-deletes — the pod may still be running on a
+        partitioned node. The stuck pod stays, however long we wait."""
+        d = StuckDriver(run_policy=None)
+        d.wedge_and_fail()
+        stuck_uid = d.pods()["llama-worker-1"].metadata.uid
+        for _ in range(6):
+            d.advance(10_000)
+        pods = d.pods()
+        assert pods["llama-worker-1"].metadata.uid == stuck_uid
+        assert pods["llama-worker-1"].metadata.deletion_timestamp is not None
+        assert d.force_events() == []
+        assert d.force_metric() == 0
+
+    def test_escalation_waits_out_full_grace_plus_bound(self):
+        """From the delete REQUEST the operator waits grace + bound: k8s
+        stamps deletionTimestamp as the expected-GONE time (request +
+        grace), and the deadline is deletionTimestamp + bound — so a pod
+        mid-legitimate-graceful-shutdown always gets its whole granted
+        window before the operator concludes the kubelet is dead, and the
+        grace period is never double-counted on top of it."""
+        d = StuckDriver(run_policy={"forceDeleteAfterSeconds": 10})
+        d.wedge_and_fail()
+        d.advance(GRACE + 5)  # bound alone elapsed; grace+bound has not
+        assert d.force_events() == []
+        d.advance(6)
+        d.sync()
+        assert len(d.force_events()) == 1
+
+    def test_scheduled_stuck_termination_is_seeded_and_logged(self):
+        """The write-clock-scheduled injection variant (the
+        ScheduledPreemption analog) registers the hold deterministically
+        and lands in the fault log."""
+        now = [0.0]
+        inner = InMemoryCluster(clock=lambda: now[0])
+        chaos = ChaosCluster(inner, ChaosSpec(
+            seed=3,
+            stuck_terminations=(
+                ScheduledStuckTermination(after_writes=2, name_contains="w"),
+            ),
+        ))
+        controller = JAXController(chaos, queue=WorkQueue(clock=lambda: now[0]),
+                                   metrics=Metrics(), clock=lambda: now[0])
+        inner.create_job(jax_manifest(workers=2))
+        controller.queue.add("JAXJob:default/llama")
+        controller.run_until_idle()
+        assert any(
+            f.startswith("stuck-terminating:") for f in chaos.fault_log
+        ), chaos.fault_log
+        # The hold is live: a graceful delete wedges instead of removing.
+        name = inner.list_pods("default")[0].metadata.name
+        chaos.delete_pod("default", name)
+        assert inner.get_pod("default", name).metadata.deletion_timestamp \
+            is not None
+
+    def test_unstick_releases_held_deletions(self):
+        """unstick_terminating = the kubelet coming back: held deletions
+        complete without the force path."""
+        d = StuckDriver(run_policy=None)
+        d.wedge_and_fail()
+        assert d.pods()["llama-worker-1"].metadata.deletion_timestamp is not None
+        d.chaos.unstick_terminating()
+        with pytest.raises(NotFound):
+            d.inner.get_pod("default", "llama-worker-1")
+
+
+class TestForceDeleteSeam:
+    def test_memory_force_bypasses_hold(self):
+        inner = InMemoryCluster()
+        inner.create_pod(Pod(metadata=ObjectMeta(name="p", namespace="default")))
+        inner.hold_pod_termination(name_contains="p")
+        inner.delete_pod("default", "p")
+        pod = inner.get_pod("default", "p")  # held, not removed
+        assert pod.metadata.deletion_timestamp is not None
+        assert pod.metadata.deletion_grace_period_seconds == GRACE
+        inner.delete_pod("default", "p", force=True)
+        with pytest.raises(NotFound):
+            inner.get_pod("default", "p")
+
+    def test_kube_force_sends_grace_period_zero(self):
+        """The REST wire form end-to-end: KubeCluster emits
+        ?gracePeriodSeconds=0 and the stub apiserver maps it onto the
+        backend's force path, removing a held pod."""
+        from tf_operator_tpu.cluster.kube import KubeCluster
+
+        stub = StubApiServer()
+        kube = KubeCluster(base_url=stub.url, token="t")
+        try:
+            stub.mem.create_pod(Pod(metadata=ObjectMeta(
+                name="p", namespace="default")))
+            stub.mem.hold_pod_termination(name_contains="p")
+            kube.delete_pod("default", "p")  # graceful: wedges
+            assert stub.mem.get_pod(
+                "default", "p").metadata.deletion_timestamp is not None
+            kube.delete_pod("default", "p", force=True)
+            with pytest.raises(NotFound):
+                stub.mem.get_pod("default", "p")
+            # The wire form was the DeleteOptions query param, not a body.
+            assert any(
+                m == "DELETE" and q.get("gracePeriodSeconds") == "0"
+                for m, _p, q in stub.requests
+            )
+        finally:
+            kube.shutdown()
+            stub.shutdown()
+
+
+class TestValidationAndSchema:
+    @pytest.mark.parametrize("bad", [0, -5])
+    def test_force_delete_after_seconds_validated(self, bad):
+        from tf_operator_tpu.api import KINDS
+
+        manifest = jax_manifest(run_policy={"forceDeleteAfterSeconds": bad})
+        cls, set_defaults, validate = KINDS["JAXJob"]
+        job = cls.parse(manifest)
+        set_defaults(job)
+        with pytest.raises(ValidationError, match="forceDeleteAfterSeconds"):
+            validate(job.spec)
+
+    @pytest.mark.parametrize("garbage", [True, "soon", 1.5])
+    def test_type_garbage_rejected_at_parse(self, garbage):
+        """Non-integer values never even reach the validator: the typed
+        conversion layer rejects them (ValueError -> parse_job's
+        ValidationError boundary in the controller)."""
+        from tf_operator_tpu.api import KINDS
+
+        cls, _, _ = KINDS["JAXJob"]
+        with pytest.raises(ValueError):
+            cls.parse(jax_manifest(run_policy={"forceDeleteAfterSeconds": garbage}))
+
+    def test_valid_value_accepted_and_defaulted_unset(self):
+        from tf_operator_tpu.api import KINDS
+
+        cls, set_defaults, validate = KINDS["JAXJob"]
+        job = cls.parse(jax_manifest(run_policy={"forceDeleteAfterSeconds": 300}))
+        set_defaults(job)
+        validate(job.spec)
+        assert job.run_policy().force_delete_after_seconds == 300
+        bare = cls.parse(jax_manifest())
+        set_defaults(bare)
+        validate(bare.spec)
+        assert bare.run_policy().force_delete_after_seconds is None
+
+    def test_crd_schema_carries_the_field(self):
+        """CRDs are generated from the dataclasses; the new runPolicy knob
+        must be present (and integer-typed) in every kind's schema."""
+        from tf_operator_tpu.manifests.gen import _KIND_MODULES, generate_crd
+
+        for module in _KIND_MODULES:
+            crd = generate_crd(module)
+            spec_schema = crd["spec"]["versions"][0]["schema"][
+                "openAPIV3Schema"]["properties"]["spec"]
+            run_policy = spec_schema["properties"]["runPolicy"]["properties"]
+            assert run_policy["forceDeleteAfterSeconds"] == {
+                "type": "integer"
+            }, module.KIND

@@ -204,6 +204,18 @@ class RunPolicy:
     # exists. Requires progressDeadlineSeconds to be set (validation):
     # a job must opt into the heartbeat protocol as a whole.
     rendezvous_deadline_seconds: Optional[int] = None
+    # forceDeleteAfterSeconds (opt-in, default unset = never): how long a
+    # pod may linger Terminating PAST its granted grace period
+    # (deletionTimestamp + deletionGracePeriodSeconds) before the operator
+    # escalates to a grace-period-0 force delete. The dead-host failure
+    # mode (docs/design/failure_modes.md §9): a kubelet on a reclaimed TPU
+    # host never acks termination, the pod object never goes away, and the
+    # gang can never recreate that index — recovery blocked forever.
+    # Unset keeps the k8s-safe default (never force-delete: the container
+    # may still be running on a partitioned node); set it on fleets where
+    # "node gone" is routine (TPU reclaims) and a stuck object costs a
+    # whole slice's worth of idle accelerators.
+    force_delete_after_seconds: Optional[int] = None
     scheduling_policy: Optional[SchedulingPolicy] = None
     # Suspend (training-operator v1.7 RunPolicy.suspend): true tears down
     # every pod (and gang groups — on TPU this releases the whole slice)

@@ -89,6 +89,15 @@ def validate_run_policy(run_policy: RunPolicy, kind: str) -> None:
                 "requires runPolicy.progressDeadlineSeconds (the job must opt "
                 "into heartbeat liveness as a whole)"
             )
+    fda = run_policy.force_delete_after_seconds
+    if fda is not None and not _positive_int(fda):
+        # Same opt-in discipline as the liveness deadlines: unset = the
+        # operator never force-deletes (k8s-safe default); set = a bound
+        # on how long a stuck-Terminating pod may block gang recovery.
+        raise ValidationError(
+            f"{kind}Spec is not valid: runPolicy.forceDeleteAfterSeconds "
+            f"must be a positive integer, got {fda!r}"
+        )
 
 
 def validate_replica_specs(

@@ -184,7 +184,14 @@ class ObjectMeta:
     uid: str = ""
     resource_version: str = ""
     creation_timestamp: Optional[float] = None
+    # k8s semantics: deletionTimestamp is the time the graceful window
+    # EXPIRES (delete-request time + grace), i.e. when the object is
+    # expected GONE — not when the delete was requested. The
+    # stuck-terminating escalation measures its patience from this point.
     deletion_timestamp: Optional[float] = None
+    # Graceful-deletion window the apiserver granted (DeleteOptions
+    # gracePeriodSeconds); informational beside deletion_timestamp.
+    deletion_grace_period_seconds: Optional[float] = None
     owner_references: List[OwnerReference] = field(default_factory=list)
 
     def controller_ref(self) -> Optional[OwnerReference]:

@@ -158,7 +158,16 @@ class Cluster:
                 return
             time.sleep(poll_interval)
 
-    def delete_pod(self, namespace: str, name: str) -> None:
+    def delete_pod(self, namespace: str, name: str, force: bool = False) -> None:
+        """Delete a pod. ``force`` requests grace-period-0 semantics (the
+        ``kubectl delete --force --grace-period=0`` analog): the apiserver
+        removes the object immediately instead of waiting for the kubelet
+        to confirm termination. The escalation path for pods wedged
+        Terminating on a dead host (docs/design/failure_modes.md §9) —
+        a kubelet that will never ack holds the graceful window open
+        forever, and the object's continued existence blocks gang
+        recovery. Backends that predate the flag ignore it (their deletes
+        were always immediate)."""
         raise NotImplementedError
 
     # ---- services ----

@@ -80,6 +80,22 @@ _CONFLICT_METHODS = tuple(
     m for m in _WRITE_METHODS if m.startswith(("update_", "create_"))
 )
 
+# Lease writes are issued by WORKLOAD heartbeat threads and the leader
+# elector, not by the reconcile loop — a "controller crash" planted there
+# would kill the wrong process. Rate-based crash decisions skip them
+# (explicit CrashPoints may still target them deliberately).
+_CRASH_EXEMPT_METHODS = ("create_lease", "update_lease")
+
+
+class SimulatedCrash(BaseException):
+    """A planted controller crash (chaos CrashPoint): the process dies at
+    this exact write. BaseException ON PURPOSE — the controller's blanket
+    `except Exception` recovery paths (process_next, best-effort event
+    recording, teardown continue-past-errors) must NOT absorb it, exactly
+    as none of them would survive a real SIGKILL. Only the failover
+    harness (testing/failover.py) catches it, discards the controller
+    instance wholesale, and cold-starts a fresh one."""
+
 
 @dataclass
 class ScheduledPreemption:
@@ -115,6 +131,42 @@ class ScheduledHang:
 
 
 @dataclass
+class CrashPoint:
+    """An explicit controller crash planted in the schedule: the
+    `call_index`-th call of `method` (per-method 0-based counter, the same
+    clock every other fault uses) raises SimulatedCrash. Two variants,
+    both of which a crash-consistent controller must survive:
+
+    - before_write=True: the crash lands BEFORE the write reaches the
+      backend — the write dies with the process (the controller decided
+      but never acted);
+    - before_write=False: the write LANDED, then the process died before
+      observing the response — "did my write land?" is unanswerable to
+      the next incarnation except through a fresh read.
+
+    Deterministic by construction: per-method call indices are a pure
+    function of the operation sequence, so a fixed (seed, crash_points)
+    replays the identical crash byte-for-byte."""
+
+    method: str
+    call_index: int
+    before_write: bool = True
+
+
+@dataclass
+class ScheduledStuckTermination:
+    """A dead-kubelet event planted in the schedule: after the proxy has
+    seen `after_writes` total writes, graceful deletes of matching pods
+    wedge Terminating (the memory backend's hold lever) until force
+    deleted. Fires at most once; requires a backend with
+    hold_pod_termination (the in-memory simulator)."""
+
+    after_writes: int
+    namespace: Optional[str] = None
+    name_contains: str = ""
+
+
+@dataclass
 class ChaosSpec:
     """The seeded plan. Rates are probabilities in [0, 1] evaluated per
     call from the deterministic hash stream."""
@@ -129,6 +181,18 @@ class ChaosSpec:
     drop_watch_kinds: Tuple[str, ...] = ()
     preemptions: Tuple[ScheduledPreemption, ...] = ()
     hangs: Tuple[ScheduledHang, ...] = ()
+    # Controller-crash plan: hash-driven crashes at `crash_rate` per
+    # eligible write (variant — before/after the write lands — drawn from
+    # the same hash stream), bounded by `max_crashes` so a failover run
+    # can converge; `crash_points` plants explicit (method, call-index)
+    # crashes for targeted crash-window tests. Lease writes are exempt
+    # from the rate (they belong to workload threads, not the controller).
+    crash_rate: float = 0.0
+    crash_methods: Tuple[str, ...] = ()  # empty = every faultable write
+    max_crashes: int = 8
+    crash_points: Tuple[CrashPoint, ...] = ()
+    # Dead-kubelet plan: write-clock-scheduled stuck-terminating holds.
+    stuck_terminations: Tuple[ScheduledStuckTermination, ...] = ()
     # Methods exempt from error/conflict injection (latency still
     # applies). Default: none — every write, record_event included, is
     # faultable; the engine's best-effort event recording is itself a
@@ -150,11 +214,22 @@ class ChaosCluster:
         self._counters: Dict[str, int] = {}
         self._writes_seen = 0
         self._preempted = [False] * len(spec.preemptions)
+        self._stuck_fired = [False] * len(spec.stuck_terminations)
+        self._crashes_fired = 0
         # Direct-lever hangs (freeze_heartbeats) appended at test-chosen
         # points, beside the write-clock-scheduled spec.hangs.
         self._manual_hangs: List[ScheduledHang] = []
 
     # ------------------------------------------------------------- plan
+    def next_call_index(self, method: str) -> int:
+        """The per-method call index the NEXT call of `method` will draw —
+        lets a test plant a CrashPoint at 'the controller's next status
+        write' at a chosen scenario moment without hand-counting the whole
+        schedule. Deterministic: the counters are a pure function of the
+        operation sequence so far."""
+        with self._lock:
+            return self._counters.get(method, 0)
+
     def _next_index(self, stream: str) -> int:
         with self._lock:
             n = self._counters.get(stream, 0)
@@ -174,9 +249,39 @@ class ChaosCluster:
         with self._lock:
             self.fault_log.append(entry)
 
-    def _inject(self, method: str) -> None:
+    def _crash_decision(self, method: str, index: int) -> Optional[str]:
+        """Crash verdict for one write call: None, "before", or "after".
+        Explicit CrashPoints always fire; rate-based crashes draw from the
+        hash stream, bounded by max_crashes so a failover scenario can
+        converge once the schedule's budget is spent."""
+        spec = self.spec
+        for cp in spec.crash_points:
+            if cp.method == method and cp.call_index == index:
+                with self._lock:
+                    self._crashes_fired += 1
+                return "before" if cp.before_write else "after"
+        if spec.crash_rate <= 0 or method in _CRASH_EXEMPT_METHODS:
+            return None
+        if spec.crash_methods and method not in spec.crash_methods:
+            return None
+        with self._lock:
+            if self._crashes_fired >= spec.max_crashes:
+                return None
+        if self._fraction(method, index, "crash") >= spec.crash_rate:
+            return None
+        with self._lock:
+            self._crashes_fired += 1
+        return (
+            "before"
+            if self._fraction(method, index, "crash-variant") < 0.5
+            else "after"
+        )
+
+    def _inject(self, method: str) -> Optional[int]:
         """Run the fault plan for one write call; raises the injected
-        fault, sleeps the injected latency, or returns clean."""
+        fault, sleeps the injected latency, or returns clean. Returns the
+        call index when an AFTER-write crash is due (the caller raises it
+        once the inner write has landed), else None."""
         index = self._next_index(method)
         spec = self.spec
         if spec.latency_rate > 0 and spec.latency_seconds > 0:
@@ -184,7 +289,11 @@ class ChaosCluster:
                 self._log(f"{method}#{index}:latency")
                 time.sleep(spec.latency_seconds)
         if method in spec.exempt_methods:
-            return
+            return None
+        # Error/conflict injection decided BEFORE the crash decision: a
+        # call that draws an injected fault never arms a crash, so the
+        # crash budget is never silently consumed by a write that raised
+        # without the SimulatedCrash ever firing.
         if spec.error_rate > 0 and self._fraction(method, index, "error") < spec.error_rate:
             self._log(f"{method}#{index}:error")
             raise ServerError(f"chaos: injected transient error on {method}")
@@ -195,11 +304,19 @@ class ChaosCluster:
         ):
             self._log(f"{method}#{index}:conflict")
             raise Conflict(f"chaos: injected conflict on {method}")
+        crash = self._crash_decision(method, index)
+        if crash == "before":
+            self._log(f"{method}#{index}:crash-before")
+            raise SimulatedCrash(
+                f"chaos: controller crash before {method}#{index}"
+            )
+        return index if crash == "after" else None
 
     def _note_write(self) -> None:
-        """Advance the write clock and fire any scheduled preemption it
-        crossed. Fired OUTSIDE the inner call, after it returns, so the
-        preemption lands between operations like a real node event."""
+        """Advance the write clock and fire any scheduled preemption or
+        stuck-termination hold it crossed. Fired OUTSIDE the inner call,
+        after it returns, so the event lands between operations like a
+        real node event."""
         with self._lock:
             self._writes_seen += 1
             due = [
@@ -208,11 +325,22 @@ class ChaosCluster:
             ]
             for i in due:
                 self._preempted[i] = True
+            stuck_due = [
+                i for i, s in enumerate(self.spec.stuck_terminations)
+                if not self._stuck_fired[i] and self._writes_seen >= s.after_writes
+            ]
+            for i in stuck_due:
+                self._stuck_fired[i] = True
         for i in due:
             p = self.spec.preemptions[i]
             self.preempt_pods(
                 namespace=p.namespace, labels=p.labels,
                 reason=p.reason, exit_code=p.exit_code,
+            )
+        for i in stuck_due:
+            s = self.spec.stuck_terminations[i]
+            self.stick_terminating(
+                name_contains=s.name_contains, namespace=s.namespace,
             )
 
     # ------------------------------------------------------------ proxy
@@ -220,9 +348,26 @@ class ChaosCluster:
         attr = getattr(self._inner, name)
         if name in _WRITE_METHODS and callable(attr):
             def chaotic(*args, _method=name, _attr=attr, **kwargs):
-                self._inject(_method)
-                out = _attr(*args, **kwargs)
+                crash_after = self._inject(_method)
+                try:
+                    out = _attr(*args, **kwargs)
+                except BaseException:
+                    if crash_after is not None:
+                        # The write itself raised: the armed after-write
+                        # crash never fires (there is no "after the write
+                        # landed"), so give its budget back — the schedule
+                        # must not silently thin out.
+                        with self._lock:
+                            self._crashes_fired -= 1
+                    raise
                 self._note_write()
+                if crash_after is not None:
+                    # After-write variant: the write is durable in the
+                    # backend; the process dies before seeing the response.
+                    self._log(f"{_method}#{crash_after}:crash-after")
+                    raise SimulatedCrash(
+                        f"chaos: controller crash after {_method}#{crash_after}"
+                    )
                 return out
 
             return chaotic
@@ -269,6 +414,32 @@ class ChaosCluster:
         with self._lock:
             self._manual_hangs.clear()
         self._log("hang:thaw")
+
+    # -------------------------------------------------- stuck terminating
+    def stick_terminating(self, name_contains: str = "",
+                          namespace: Optional[str] = None) -> None:
+        """Direct dead-kubelet lever (the preempt_pods analog): from now
+        on, graceful deletes of matching pods wedge Terminating —
+        deletionTimestamp set, object held — until force-deleted. Goes
+        through the inner backend's hold_pod_termination (the in-memory
+        simulator's graceful-deletion window); backends without one
+        cannot host this injection."""
+        hold = getattr(self._inner, "hold_pod_termination", None)
+        if hold is None:
+            raise TypeError(
+                "chaos stuck_terminating needs a backend with "
+                "hold_pod_termination (the in-memory simulator)"
+            )
+        hold(name_contains=name_contains, namespace=namespace)
+        self._log(f"stuck-terminating:{namespace or '*'}:{name_contains}")
+
+    def unstick_terminating(self) -> None:
+        """Release every termination hold (the kubelet coming back): held
+        deletions complete, pods go away."""
+        release = getattr(self._inner, "release_pod_terminations", None)
+        if release is not None:
+            release()
+        self._log("stuck-terminating:release")
 
     def _hang_matches(self, namespace: str, name: str) -> bool:
         # Hangs target HEARTBEAT leases only (the documented contract): a

@@ -581,8 +581,15 @@ class KubeCluster(Cluster):
             done.set()
             conn.close()
 
-    def delete_pod(self, namespace: str, name: str) -> None:
-        self._request("DELETE", self._core_path("pods", namespace, name))
+    def delete_pod(self, namespace: str, name: str, force: bool = False) -> None:
+        path = self._core_path("pods", namespace, name)
+        if force:
+            # Grace-period-0 delete (DeleteOptions as query params, the
+            # `kubectl delete --force --grace-period=0` wire form): the
+            # apiserver drops the object immediately instead of waiting
+            # for a kubelet that may be dead to ack termination.
+            path += "?gracePeriodSeconds=0"
+        self._request("DELETE", path)
 
     # ------------------------------------------------------------- services
     def create_service(self, service: Service) -> Service:

@@ -76,6 +76,12 @@ class InMemoryCluster(base.Cluster):
         # pod name -> behavior fn(pod) called on each step() while running
         self._behaviors: Dict[Tuple[str, str], Callable[[Pod], None]] = {}
         self._pod_logs: Dict[Tuple[str, str], str] = {}
+        # Graceful-deletion holds (the dead-kubelet simulation): matching
+        # pods get deletionTimestamp set by delete_pod but stay present —
+        # stuck Terminating — until force-deleted or released. Each entry
+        # is (namespace-or-None, name substring).
+        self._termination_holds: List[Tuple[Optional[str], str]] = []
+        self._held_deletions: set = set()  # (ns, name) with a delete pending
 
     # ------------------------------------------------------------------ util
     def latest_rv(self) -> int:
@@ -361,15 +367,68 @@ class InMemoryCluster(base.Cluster):
                 raise NotFound(f"pod {namespace}/{name}")
             return self._pod_logs.get((namespace, name), "")
 
-    def delete_pod(self, namespace: str, name: str) -> None:
+    # Grace the apiserver grants a held (stuck-Terminating) pod — the k8s
+    # default terminationGracePeriodSeconds. Folded into the pod's
+    # deletionTimestamp (expected-gone time), matching real apiservers.
+    DEFAULT_GRACE_PERIOD_SECONDS = 30.0
+
+    def _hold_matches_locked(self, namespace: str, name: str) -> bool:
+        return any(
+            (ns is None or ns == namespace) and (not frag or frag in name)
+            for ns, frag in self._termination_holds
+        )
+
+    def hold_pod_termination(self, name_contains: str = "",
+                             namespace: Optional[str] = None) -> None:
+        """Chaos/test lever — the dead-kubelet simulation: from now on a
+        graceful delete of a matching pod sets deletionTimestamp (+ the
+        default grace) and HOLDS the object, exactly as a real apiserver
+        keeps a pod whose kubelet never acks termination. Only
+        delete_pod(..., force=True) — grace-period-0 — removes it."""
         with self._lock:
-            pod = self._pods.pop((namespace, name), None)
-            self._behaviors.pop((namespace, name), None)
-            self._pod_logs.pop((namespace, name), None)
+            self._termination_holds.append((namespace, name_contains))
+
+    def release_pod_terminations(self) -> None:
+        """Drop every hold and finish the deletions they blocked (the
+        kubelet coming back and acking), so tests can model recovery
+        without the force path."""
+        with self._lock:
+            self._termination_holds.clear()
+            held = list(self._held_deletions)
+        for ns, name in held:
+            try:
+                self.delete_pod(ns, name)
+            except NotFound:
+                pass
+
+    def delete_pod(self, namespace: str, name: str, force: bool = False) -> None:
+        with self._lock:
+            pod = self._pods.get((namespace, name))
             if pod is None:
                 raise NotFound(f"pod {namespace}/{name}")
-            pod.metadata.resource_version = str(next(self._rv))
-            self._publish_locked("pods", DELETED, pod)
+            if not force and self._hold_matches_locked(namespace, name):
+                # Graceful window held open indefinitely: mark Terminating
+                # (idempotently) and keep the object. The MODIFIED event is
+                # what informers see for a real graceful delete.
+                self._held_deletions.add((namespace, name))
+                if pod.metadata.deletion_timestamp is None:
+                    # k8s semantics: deletionTimestamp = request time +
+                    # grace — the instant the object is expected GONE.
+                    pod.metadata.deletion_timestamp = (
+                        self._clock() + self.DEFAULT_GRACE_PERIOD_SECONDS
+                    )
+                    pod.metadata.deletion_grace_period_seconds = (
+                        self.DEFAULT_GRACE_PERIOD_SECONDS
+                    )
+                    pod.metadata.resource_version = str(next(self._rv))
+                    self._publish_locked("pods", MODIFIED, pod.deep_copy())
+            else:
+                self._pods.pop((namespace, name), None)
+                self._behaviors.pop((namespace, name), None)
+                self._pod_logs.pop((namespace, name), None)
+                self._held_deletions.discard((namespace, name))
+                pod.metadata.resource_version = str(next(self._rv))
+                self._publish_locked("pods", DELETED, pod)
         self._drain_events()
 
     # -------------------------------------------------------------- services

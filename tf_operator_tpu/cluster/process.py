@@ -366,7 +366,7 @@ class LocalProcessCluster(InMemoryCluster):
         proc.send_signal(sig)
 
     # ------------------------------------------------------------- deletion
-    def delete_pod(self, namespace: str, name: str) -> None:
+    def delete_pod(self, namespace: str, name: str, force: bool = False) -> None:
         key = (namespace, name)
         with self._lock:
             proc = self._procs.pop(key, None)
@@ -376,10 +376,15 @@ class LocalProcessCluster(InMemoryCluster):
             self._log_paths.pop(key, None)
             self._hb_bridge.pop(key, None)
         if proc is not None:
-            _kill_tree(proc)
+            if force:
+                # Grace-period-0: no SIGTERM courtesy window — straight
+                # SIGKILL, like a kubelet executing a force delete.
+                _kill_tree(proc, grace=False)
+            else:
+                _kill_tree(proc)
         if fh is not None:
             fh.close()
-        super().delete_pod(namespace, name)
+        super().delete_pod(namespace, name, force=force)
 
     def get_pod_log(self, namespace: str, name: str) -> str:
         key = (namespace, name)
@@ -459,16 +464,27 @@ class LocalProcessCluster(InMemoryCluster):
         self._reaper.join(timeout=2.0)
 
 
-def _kill_tree(proc: subprocess.Popen) -> None:
+def _kill_tree(proc: subprocess.Popen, grace: bool = True) -> None:
+    """SIGTERM-then-SIGKILL (grace=True, the kubelet's normal teardown) or
+    straight SIGKILL (grace=False, a force delete). Either way the SIGKILL
+    is followed by a bounded reap so the Popen doesn't linger as a zombie
+    (the proc was already popped from the cluster's tables, so no reaper
+    thread will ever wait() it)."""
+    if grace:
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+        try:
+            proc.wait(timeout=2.0)
+            return
+        except subprocess.TimeoutExpired:
+            pass
     try:
-        os.killpg(proc.pid, signal.SIGTERM)
+        os.killpg(proc.pid, signal.SIGKILL)
     except (ProcessLookupError, PermissionError, OSError):
         pass
     try:
         proc.wait(timeout=2.0)
     except subprocess.TimeoutExpired:
-        try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError, OSError):
-            pass
-        proc.wait(timeout=2.0)
+        pass  # D-state straggler: nothing more a SIGKILL sender can do

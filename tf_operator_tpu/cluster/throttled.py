@@ -21,6 +21,9 @@ _WRITE_METHODS = (
     "delete_job",
     "create_pod",
     "update_pod",
+    # delete_pod's kwargs (force=True grace-period-0 escalation) pass
+    # through untouched — a force delete pays the same budget token as
+    # any other write.
     "delete_pod",
     "create_service",
     "update_service",

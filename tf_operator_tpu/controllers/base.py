@@ -15,7 +15,7 @@ from ..api import KINDS
 from ..api.common import JobObject
 from ..api.defaulting import ValidationError
 from ..api.k8s import Event
-from ..cluster.base import ADDED, DELETED, Cluster, NotFound
+from ..cluster.base import ADDED, DELETED, Cluster, Conflict, NotFound
 from ..core import constants
 from ..core.control import (
     RealPodControl,
@@ -128,6 +128,7 @@ class FrameworkController(FrameworkHooks):
             clock=clock,
             on_job_restarting=self._record_restart,
             on_heartbeat_age=self._record_heartbeat_age,
+            on_force_delete=self._record_force_delete,
         )
         self._watch()
 
@@ -222,6 +223,9 @@ class FrameworkController(FrameworkHooks):
     def _record_heartbeat_age(self, job: JobObject, age: float) -> None:
         self.metrics.set_heartbeat_age(job.namespace, self.kind, job.name, age)
 
+    def _record_force_delete(self, job: JobObject, cause: str) -> None:
+        self.metrics.force_delete_inc(job.namespace, self.kind, cause)
+
     def _on_expectation_timeout(self, key: str, kind: str, adds: int, dels: int) -> None:
         """An expectation expired unfulfilled: the watch event we were
         waiting for never arrived and the job sat wedged for the full
@@ -306,7 +310,12 @@ class FrameworkController(FrameworkHooks):
         ):
             # Cache not settled. A watch event normally re-enqueues; also
             # schedule a fallback resync so a dropped event cannot wedge the
-            # job past the expectation expiry window.
+            # job past the expectation expiry window. The stuck-terminating
+            # escalation must still run HERE: the wedged pod is exactly
+            # what keeps the deletion expectation unfulfilled, so an
+            # escalation only inside reconcile_job (which this gate blocks)
+            # could first fire after the 5-minute expectation expiry.
+            self.engine.escalate_stuck_terminating(job)
             self.queue.add_after(f"{self.kind}:{key}", 30.0)
             return
 
@@ -353,7 +362,13 @@ class FrameworkController(FrameworkHooks):
             self.cluster.update_job_status(
                 self.kind, meta.get("namespace", "default"), meta.get("name", ""), new_status
             )
-        except NotFound:
+        except (NotFound, Conflict):
+            # NotFound: the job vanished — nothing to mark. Conflict (a
+            # concurrent status writer, or chaos-injected 409): letting it
+            # escape to the blanket process_next handler hot-requeued the
+            # invalid job forever — the spec cannot become valid by
+            # retrying faster. The next sync (watch/resync) re-runs
+            # validation and retries the write.
             pass
         record_event_best_effort(
             self.cluster,
@@ -418,7 +433,17 @@ class FrameworkController(FrameworkHooks):
             namespace, _, name = key.partition("/")
             self.sync(namespace, name)
             self.queue.forget(item)
-        except Exception:
+        except Exception as err:
+            # The requeue itself stays (the rate-limited queue is the
+            # recovery mechanism), but the failure must be VISIBLE: a
+            # counter chaos tiers and dashboards can watch for
+            # error-requeue storms, plus a log line naming the exception —
+            # previously this swallowed every sync failure silently.
+            self.metrics.sync_error_inc(self.kind, type(err).__name__)
+            _log.warning(
+                "sync of %s failed (%s: %s); rate-limited requeue",
+                item, type(err).__name__, err, exc_info=True,
+            )
             self.queue.add_rate_limited(item)
         finally:
             self.queue.done(item)

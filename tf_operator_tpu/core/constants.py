@@ -49,6 +49,15 @@ REASON_JOB_DISRUPTION_EXCEEDED = "DisruptionBudgetExceeded"
 # event the controller was waiting for never arrived. The job self-heals,
 # but silently-self-healing wedges are exactly what chaos tiers must see.
 REASON_EXPECTATION_TIMEOUT = "ExpectationTimeout"
+# Stuck-terminating escalation (runPolicy.forceDeleteAfterSeconds): a pod
+# lingered Terminating past deletionTimestamp + grace + the opt-in bound —
+# dead kubelet on a reclaimed host — and the operator force-deleted it
+# (grace-period-0) to unblock gang recovery. Always a Warning: a force
+# delete abandons a node that may still be running the container.
+REASON_FORCE_DELETE_POD = "ForceDeletePod"
+# Cause label for the force-delete metric (the only cause today; the label
+# exists so future escalation triggers stay distinguishable).
+FORCE_DELETE_CAUSE_STUCK_TERMINATING = "StuckTerminating"
 
 # Condition reasons; the reference builds "<Kind>Created" etc. per framework
 # (e.g. tfJobCreatedReason). job_reason(kind, suffix) reproduces that.

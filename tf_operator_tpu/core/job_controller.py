@@ -382,6 +382,7 @@ class JobController:
         clock=time.time,
         on_job_restarting: Optional[Callable[[JobObject, str, str], None]] = None,
         on_heartbeat_age: Optional[Callable[[JobObject, float], None]] = None,
+        on_force_delete: Optional[Callable[[JobObject, str], None]] = None,
     ):
         self.hooks = hooks
         self.cluster = cluster
@@ -398,6 +399,10 @@ class JobController:
         # a deadline-opted-in job; the controller exports it as the
         # heartbeat_age_seconds gauge.
         self.on_heartbeat_age = on_heartbeat_age or (lambda job, age: None)
+        # (job, cause) — fires once per grace-period-0 escalation of a
+        # stuck-Terminating pod; the controller exports it as the
+        # cause-labeled force_deletes_total counter.
+        self.on_force_delete = on_force_delete or (lambda job, cause: None)
         # (job key, uid) -> {pod uid: _HeartbeatState}: the liveness
         # observation cache. In-memory by design — an operator restart (or
         # leader failover) restarts every staleness clock from its own
@@ -412,6 +417,13 @@ class JobController:
         # would burn the QPS budget (the _suspend_job 'settled' rule).
         # In-memory: an operator restart redoes the GC exactly once.
         self._hb_gc_done: set = set()
+        # (job key, job uid, pod uid) already force-deleted (stuck-
+        # terminating escalation): gates the event/metric/delete to once
+        # per pod per operator incarnation — a force delete accepted but
+        # leaving the object behind (foreign finalizer) must not re-fire
+        # every sync. In-memory: a restart re-escalates exactly once.
+        # Guarded by _hb_lock; pruned via forget_job.
+        self._force_deleted: set = set()
         # (job key, uid) -> last-declared gang-group names: gates the stale
         # sweep's uncached LIST to declared-set changes (and once per
         # operator lifetime per job, since this cache is in-memory).
@@ -433,6 +445,8 @@ class JobController:
                 self._hb_obs.pop(cache_key, None)
             for cache_key in [k for k in self._hb_gc_done if k[0] == key]:
                 self._hb_gc_done.discard(cache_key)
+            for cache_key in [k for k in self._force_deleted if k[0] == key]:
+                self._force_deleted.discard(cache_key)
 
     # ------------------------------------------------------------- listing
     def get_pods_for_job(self, job: JobObject) -> List[Pod]:
@@ -564,8 +578,20 @@ class JobController:
         # exclusive), and the failed>0 check then marks the job Failed —
         # killing a job that was merely recovering from preemption.
         job.status._restarting_this_sync = False
+        # Per-replica restart deletes deferred to AFTER the end-of-sync
+        # status write (count-before-delete: reconcile_pods counts the
+        # restart and stamps the pod handled, but the pod — the only
+        # re-detectable evidence — dies only once that count is durable).
+        # Transient, like _restarting_this_sync.
+        job.status._deferred_deletes = []
 
         pods = self.get_pods_for_job(job)
+
+        # Stuck-terminating escalation on the hot path reuses this claimed
+        # pod list (zero extra LIST per sync); the expectations-gated path
+        # runs it pre-gate in controllers/base.py with its own list. No-op
+        # unless runPolicy.forceDeleteAfterSeconds is set.
+        self.escalate_stuck_terminating(job, pods=pods)
 
         # Seed Created condition (reference sets it in onOwnerCreateFunc,
         # tfjob_controller.go:839-856; converging here keeps any path safe).
@@ -676,35 +702,31 @@ class JobController:
         # stale pods in this one sync — a gang restarts together, and batched
         # deletion keeps restart MTTR one informer round-trip instead of one
         # per pod — then recreate on the next sync once deletions land.
+        #
+        # Stamp-BEFORE-delete (crash consistency): the handled-uid stamp
+        # marks these deletions as controller-initiated so the gang trigger
+        # below never re-reads them as external node drains. A crash
+        # between the deletes landing and the stamp landing would leave
+        # Terminating in-range pods beside live peers — the drained-pod
+        # trigger's exact signature — and charge the resize to the
+        # disruption ledger. So the stamp + condition are made durable
+        # FIRST; only then do pods die. A failed/crashed write deletes
+        # nothing (the stale pods re-detect identically); a crash after
+        # the write resumes the deletes without re-eventing. The stamp is
+        # MERGED with still-present previously-handled uids (a resize
+        # mid-grace-period must not un-handle a counted trigger) and
+        # pruned to present pods so it stays gang-sized.
         stale = self.hooks.stale_world_pods(job, replicas, pods)
         if stale:
-            for pod in stale:
-                self._delete_pod(job, pod)
-            # Stamp the deleted set as handled: these controller-initiated
-            # deletions must not be re-read next sync as external (node
-            # drain) disruptions by the gang trigger below. MERGED with the
-            # still-present previously-handled uids, not replacing them — a
-            # resize mid-grace-period must not un-handle a counted trigger
-            # still lingering Terminating (re-reading it would tear the new
-            # gang down twice for one incident). Pruned to pods that still
-            # exist so the stamp stays gang-sized.
             present = {p.metadata.uid for p in pods}
+            already = set(job.status.gang_handled_uids or ())
+            fresh = any(p.metadata.uid not in already for p in stale)
             job.status.gang_handled_uids = sorted(
-                (set(job.status.gang_handled_uids or ()) & present)
-                | {p.metadata.uid for p in stale}
+                (already & present) | {p.metadata.uid for p in stale}
             )
             msg = (
                 f"{self.hooks.kind} {job.name} is restarting to apply a new "
                 f"replica topology ({len(stale)} stale pod(s))."
-            )
-            record_event_best_effort(
-                self.cluster,
-                Event(
-                    type="Normal",
-                    reason=constants.job_reason(self.hooks.kind, constants.REASON_RESTARTING),
-                    message=msg,
-                    involved_object=f"{job.kind}/{key}",
-                )
             )
             capi.update_job_conditions(
                 job.status,
@@ -714,8 +736,25 @@ class JobController:
                 now=self.clock(),
             )
             job.status._restarting_this_sync = True
-            self.on_job_restarting(job, "", capi.RESTART_CAUSE_SPEC_CHANGE)
-            self._write_status_if_changed(job, old_status)
+            try:
+                self._write_status_if_changed(job, old_status)
+            except Exception:  # noqa: BLE001 — conflict/transient write error
+                self.requeue(f"{job.kind}:{key}", 1.0)
+                return
+            if fresh:
+                record_event_best_effort(
+                    self.cluster,
+                    Event(
+                        type="Normal",
+                        reason=constants.job_reason(self.hooks.kind, constants.REASON_RESTARTING),
+                        message=msg,
+                        involved_object=f"{job.kind}/{key}",
+                    )
+                )
+                self.on_job_restarting(job, "", capi.RESTART_CAUSE_SPEC_CHANGE)
+            for pod in stale:
+                if pod.metadata.deletion_timestamp is None:
+                    self._delete_pod(job, pod)
             return
 
         # Gang restart on retryable failure (SPMD worlds, restart_peers_on_
@@ -732,51 +771,27 @@ class JobController:
             # and a kept Succeeded coordinator (worker-0 exited 0 while a
             # peer was preempted) would leave the new gang waiting on a
             # process that will never rejoin. The re-run resumes from the
-            # shared checkpoint and exits cleanly again.
-            #
-            # Teardown order: survivors first, the triggering pod LAST and
-            # only once every survivor delete succeeded. A transient delete
-            # error therefore leaves the trigger intact as the re-fire
-            # marker — the next sync re-detects it and finishes the gang —
-            # while the restart is counted exactly once, on the pass that
-            # completes the teardown. Pods already Terminating are skipped
-            # so a retried teardown never double-deletes. Only WORLD MEMBERS
+            # shared checkpoint and exits cleanly again. Only WORLD MEMBERS
             # (types that opted into restart_peers_on_failure) go down with
             # the gang: out-of-world sidecars (JAXJob Evaluator) are not in
             # the SPMD rendezvous and restart individually.
+            #
+            # ONE restart per gang restart: backoffLimit counts world
+            # restarts, not the gang-size multiple of them — every world
+            # pod present is stamped handled (all are being replaced), so
+            # N pods evicted together in one maintenance event count one
+            # restart, not N. Count/stamp/teardown ordering — including
+            # every crash window between them — lives in
+            # _restart_gang_counted (count-before-teardown protocol).
             world_types = {
                 rt.lower() for rt in replicas
                 if self.hooks.restart_peers_on_failure(rt)
             }
-            delete_errors = self._teardown_gang_pods(
-                job,
-                [
-                    p for p in pods
-                    if p.metadata.labels.get(constants.LABEL_REPLICA_TYPE)
-                    in world_types
-                ],
-                failed_pod,
-            )
-            if delete_errors:
-                names = ", ".join(n for n, _ in delete_errors)
-                record_event_best_effort(
-                    self.cluster,
-                    Event(
-                        type="Warning",
-                        reason=constants.job_reason(self.hooks.kind, constants.REASON_RESTARTING),
-                        message=(
-                            f"{self.hooks.kind} {job.name} gang teardown is "
-                            f"partial: delete failed for {names}; retrying."
-                        ),
-                        involved_object=f"{job.kind}/{key}",
-                    )
-                )
-                # Keep the status machine in "restarting" so the failed pod
-                # still being torn down is not read as a job failure.
-                job.status._restarting_this_sync = True
-                self.requeue(f"{job.kind}:{key}", 1.0)
-                self._write_status_if_changed(job, old_status)
-                return
+            targets = [
+                p for p in pods
+                if p.metadata.labels.get(constants.LABEL_REPLICA_TYPE)
+                in world_types
+            ]
             disrupted = cause == capi.RESTART_CAUSE_DISRUPTION
             reason = constants.job_reason(
                 self.hooks.kind,
@@ -792,38 +807,10 @@ class JobController:
                 f"{rtype} replica {failed_pod.metadata.name} {detail} "
                 "and the SPMD world restarts as one unit."
             )
-            record_event_best_effort(
-                self.cluster,
-                Event(
-                    type="Warning",
-                    reason=reason,
-                    message=msg,
-                    involved_object=f"{job.kind}/{key}",
-                )
+            self._restart_gang_counted(
+                job, pods, targets, failed_pod, rtype, cause, reason, msg,
+                old_status,
             )
-            capi.update_job_conditions(
-                job.status,
-                capi.JOB_RESTARTING,
-                reason,
-                msg,
-                now=self.clock(),
-            )
-            job.status._restarting_this_sync = True
-            # ONE restart per gang restart: backoffLimit counts world
-            # restarts, not the gang-size multiple of them. EVERY world
-            # pod present at completion is stamped handled — all are being
-            # replaced by this restart — so N pods evicted together in one
-            # maintenance event (each lingering Failed+Terminating through
-            # its grace period) count one restart, not N.
-            job.status.gang_handled_uids = [
-                p.metadata.uid
-                for p in pods
-                if p.metadata.labels.get(constants.LABEL_REPLICA_TYPE)
-                in world_types
-            ]
-            self._count_restart(job, rtype, cause)
-            self.on_job_restarting(job, rtype, cause)
-            self._write_status_if_changed(job, old_status)
             return
 
         # Disruption restart backoff: after consecutive disruptions the job
@@ -878,7 +865,47 @@ class JobController:
             if remaining > 0:
                 self.requeue(f"{job.kind}:{key}", remaining)
 
+        # Order is the per-replica crash-consistency protocol: the status
+        # write makes the restart counts durable; only then do the counted
+        # pods die. A write failure propagates (rate-limited retry) with
+        # nothing deleted.
         self._write_status_if_changed(job, old_status)
+        self._flush_deferred_deletes(job)
+
+    def _flush_deferred_deletes(self, job: JobObject) -> None:
+        """Phase 2 of the per-replica restart protocol (reconcile_pods):
+        execute the deletes whose counts the status write just made
+        durable, firing each fresh restart's event + metric now that the
+        ledger the observer would check agrees. A delete failure requeues;
+        the handled-uid stamp skips the re-count on retry. A crash
+        anywhere in here leaves a counted, stamped, still-Failed pod the
+        next controller incarnation finishes off without re-charging."""
+        items = getattr(job.status, "_deferred_deletes", None) or []
+        errors = False
+        for item in items:
+            pod = item["pod"]
+            if item.get("fresh"):
+                record_event_best_effort(
+                    self.cluster,
+                    Event(
+                        type="Warning",
+                        reason=item["reason"],
+                        message=item["msg"],
+                        involved_object=f"{job.kind}/{job.key()}",
+                    ),
+                )
+                self.on_job_restarting(job, item["rtype"], item["cause"])
+            try:
+                self._delete_pod(job, pod)
+            except Exception:  # noqa: BLE001 — keep deleting the rest
+                log.warning(
+                    "deferred restart delete of %s/%s failed; retrying",
+                    pod.metadata.namespace, pod.metadata.name, exc_info=True,
+                )
+                errors = True
+        job.status._deferred_deletes = []
+        if errors:
+            self.requeue(f"{job.kind}:{job.key()}", 1.0)
 
     def _find_gang_retryable_failure(
         self, replicas: Dict[str, ReplicaSpec], pods: List[Pod],
@@ -895,7 +922,12 @@ class JobController:
            SIGKILL-class exit on an otherwise-healthy gang are
            InfrastructureDisruption; other retryable exits are
            ApplicationFailure, exactly as before. Non-retryable failures
-           fall through to the normal status machine.
+           fall through to the normal status machine. A fresh failure
+           whose uid is ALREADY in status.gang_handled_uids is a crash
+           leftover (the count-before-teardown write landed, the process
+           died before any delete) — still a trigger, so the teardown
+           resumes, but _restart_gang_counted sees the stamp and never
+           re-counts it.
         2. A retryably-failed pod already Terminating, returned ONLY while
            some world member is still live AND its teardown was not already
            counted (status.gang_handled_uids). The controller's own
@@ -920,6 +952,7 @@ class JobController:
         """
         terminating_candidate: Optional[Tuple[str, Pod, str]] = None
         drained_candidate: Optional[Tuple[str, Pod, str]] = None
+        handled_candidate: Optional[Tuple[str, Pod, str]] = None
         world_types_lower = set()
         # "Otherwise-healthy gang": no world pod failed with a PERMANENT
         # exit code — a lone SIGKILL under healthy peers reads as
@@ -963,13 +996,18 @@ class JobController:
                     pod, exit_code, peers_healthy=peers_healthy
                 )
                 if pod.metadata.deletion_timestamp is None:
-                    return rtype, pod, cause
-                if (
+                    if pod.metadata.uid not in handled_uids:
+                        return rtype, pod, cause
+                    # Counted but never deleted (crash between the phase-1
+                    # status write and the teardown): resume, don't refire.
+                    if handled_candidate is None:
+                        handled_candidate = (rtype, pod, cause)
+                elif (
                     terminating_candidate is None
                     and pod.metadata.uid not in handled_uids
                 ):
                     terminating_candidate = (rtype, pod, cause)
-        candidate = terminating_candidate or drained_candidate
+        candidate = handled_candidate or terminating_candidate or drained_candidate
         if candidate is not None and any(
             p.metadata.deletion_timestamp is None
             and p.metadata.labels.get(constants.LABEL_REPLICA_TYPE)
@@ -1188,20 +1226,11 @@ class JobController:
         SPMD worlds (restart_peers_on_failure types) go down as one unit —
         a wedged collective holds every peer hostage, and a lone
         replacement could never rejoin; kinds without world semantics
-        restart only the stalled replica.
-
-        Count-before-teardown protocol, the inverse of the gang-failure
-        path's delete-trigger-last: a failed pod is durable evidence a
-        retried sync can re-detect, but a stalled pod's evidence is the
-        pod ITSELF — the teardown destroys it. So the count, condition,
-        event, and handled-uid stamp are written to status FIRST; only
-        once that write landed do pods die. A conflicted status write
-        aborts the sync with nothing deleted (the stall re-detects
-        identically on retry), and the handled-uid stamp makes the
-        post-write retry skip re-counting: exactly-once accounting under
-        write faults, which the seeded chaos tier asserts."""
+        restart only the stalled replica. Count/teardown ordering is the
+        shared count-before-teardown protocol (_restart_gang_counted) —
+        this path pioneered it, because a stalled pod's evidence is the
+        pod ITSELF and the teardown destroys it."""
         rtype, stalled_pod, why = stall
-        key = job.key()
         world_types = {
             rt.lower() for rt in replicas
             if self.hooks.restart_peers_on_failure(rt)
@@ -1219,38 +1248,62 @@ class JobController:
         reason = constants.job_reason(
             self.hooks.kind, constants.REASON_STALL_RESTARTING
         )
+        msg = (
+            f"{self.hooks.kind} {job.name} is restarting "
+            f"{'the whole gang' if len(targets) > 1 else 'a stalled replica'}"
+            f": {why}."
+        )
+        self._restart_gang_counted(
+            job, pods, targets, stalled_pod, rtype, capi.RESTART_CAUSE_STALL,
+            reason, msg, old_status,
+        )
+
+    def _restart_gang_counted(
+        self, job: JobObject, pods: List[Pod], targets: List[Pod],
+        trigger: Pod, rtype: str, cause: str, reason: str, msg: str,
+        old_status: JobStatus,
+    ) -> None:
+        """The count-before-teardown protocol, single-sourced for the
+        gang-failure and stall restart paths. (The failure path used to
+        count at teardown COMPLETION; its crash window — trigger deleted,
+        process dies before the counted status write — destroyed the only
+        re-detectable evidence and lost the restart from every ledger.
+        The crash tier, tests/test_crash_failover.py, holds the line.)
+
+        Phase 1 — make the verdict durable before any pod dies. The
+        handled-uid stamp covers EVERY target: controller-initiated
+        deletions must not be re-read by the drained-pod trigger as a
+        node drain (that would double-charge the incident to the
+        disruption ledger — the counters must stay disjoint). A failed
+        status write aborts the sync with nothing deleted (the trigger
+        re-detects identically on retry); event + metric fire only once
+        the count is durable, so a retried phase never duplicates them.
+
+        Phase 2 — the teardown, retried (without re-counting: the stamp
+        gates phase 1) until every target is down. Trigger-last matters:
+        the trigger is the only member a retried sync — or a freshly
+        failed-over controller — can re-DETECT, so it must outlive any
+        partial teardown or the leftover healthy pods would never be
+        re-judged and the world would restart mixed."""
+        key = job.key()
         handled = set(job.status.gang_handled_uids or ())
         job.status._restarting_this_sync = True
-        if stalled_pod.metadata.uid not in handled:
-            # Phase 1 — make the verdict durable before any pod dies. The
-            # stamp covers EVERY target: controller-initiated deletions
-            # must not be re-read by the drained-pod trigger as a node
-            # drain (that would double-charge the incident to the
-            # disruption ledger — the counters must stay disjoint).
+        if trigger.metadata.uid not in handled:
             present = {p.metadata.uid for p in pods}
             job.status.gang_handled_uids = sorted(
                 (handled & present) | {p.metadata.uid for p in targets}
             )
-            msg = (
-                f"{self.hooks.kind} {job.name} is restarting "
-                f"{'the whole gang' if len(targets) > 1 else 'a stalled replica'}"
-                f": {why}."
-            )
             capi.update_job_conditions(
                 job.status, capi.JOB_RESTARTING, reason, msg, now=self.clock()
             )
-            self._count_restart(job, rtype, capi.RESTART_CAUSE_STALL)
+            self._count_restart(job, rtype, cause)
             try:
                 self._write_status_if_changed(job, old_status)
             except Exception:  # noqa: BLE001 — conflict/transient write error
-                # Nothing was deleted: the stall re-detects byte-identically
+                # Nothing was deleted: the trigger re-detects identically
                 # on the retry, so aborting here keeps counting exact.
                 self.requeue(f"{job.kind}:{key}", 1.0)
                 return
-            # Event + metric only once the count is durable: a conflicted
-            # write retries the whole phase, and firing these first would
-            # duplicate them per retry (and let observers see a stall the
-            # ledger doesn't have yet).
             record_event_best_effort(
                 self.cluster,
                 Event(
@@ -1260,15 +1313,9 @@ class JobController:
                     involved_object=f"{job.kind}/{key}",
                 ),
             )
-            self.on_job_restarting(job, rtype, capi.RESTART_CAUSE_STALL)
+            self.on_job_restarting(job, rtype, cause)
             old_status = copy.deepcopy(job.status)
-        # Phase 2 — the teardown, retried (without re-counting: the
-        # handled-uid stamp gates phase 1) until every target is down.
-        # Trigger-last matters here too: the stalled pod is the only
-        # member a retried sync can re-DETECT, so it must outlive any
-        # partial teardown or the leftover healthy pods would never be
-        # re-judged and the world would restart mixed.
-        delete_errors = self._teardown_gang_pods(job, targets, stalled_pod)
+        delete_errors = self._teardown_gang_pods(job, targets, trigger)
         if delete_errors:
             names = ", ".join(n for n, _ in delete_errors)
             record_event_best_effort(
@@ -1277,7 +1324,7 @@ class JobController:
                     type="Warning",
                     reason=reason,
                     message=(
-                        f"{self.hooks.kind} {job.name} stall teardown is "
+                        f"{self.hooks.kind} {job.name} gang teardown is "
                         f"partial: delete failed for {names}; retrying."
                     ),
                     involved_object=f"{job.kind}/{key}",
@@ -1313,6 +1360,111 @@ class JobController:
                 job.status.restart_counts.get(rtype, 0) + 1
             )
 
+    # ----------------------------------------- stuck-terminating escalation
+    def escalate_stuck_terminating(
+        self, job: JobObject, pods: Optional[List[Pod]] = None
+    ) -> None:
+        """Opt-in (runPolicy.forceDeleteAfterSeconds) dead-host recovery:
+        a pod still Terminating past deletionTimestamp (k8s semantics: the
+        time the graceful window EXPIRES — request time + grace) plus the
+        opt-in bound is force-deleted (grace-period-0) with a Warning
+        event and a cause-labeled metric — the kubelet that should have
+        finished the deletion is assumed dead (reclaimed TPU host), and
+        the lingering object is what blocks gang recreation of that index
+        forever. With the field unset this is one None-check per sync and
+        the operator NEVER force-deletes.
+
+        Call sites: reconcile_job passes its already-fetched claimed pod
+        list (the hot path pays no extra LIST); the expectations-gated
+        path (controllers/base.py sync) calls with pods=None — the stuck
+        pod is exactly what keeps the deletion expectation unfulfilled,
+        so an escalation only behind the gate could first fire after the
+        5-minute expectation expiry. The pods=None path lists and then
+        keeps ONLY pods whose controllerRef is this job (never act on a
+        label-colliding pod another controller owns).
+
+        Each pod uid is escalated at most once per operator incarnation
+        (self._force_deleted): a force delete that is accepted but leaves
+        the object behind (a foreign finalizer) must not re-fire the
+        event/metric every sync. A stuck pod generates no further watch
+        events, so the wake is self-scheduled: pods inside their window
+        get an AddAfter resync at the earliest upcoming deadline (the
+        ActiveDeadline idiom). Write failures are per-pod best-effort —
+        the requeue retries, and a force delete that did land unblocks
+        the job via its DELETED event (which also satisfies the original
+        deletion expectation; no new expectation is recorded here)."""
+        from ..cluster.base import NotFound
+
+        fdas = job.run_policy().force_delete_after_seconds
+        if fdas is None:
+            return
+        now = self.clock()
+        next_wake: Optional[float] = None
+        retry = False
+        if pods is None:
+            pods = [
+                p for p in self.cluster.list_pods(
+                    namespace=job.namespace,
+                    labels=job_selector(job),
+                    owner_uid=job.metadata.uid,
+                )
+                if (ref := p.metadata.controller_ref()) is not None
+                and ref.uid == job.metadata.uid
+            ]
+        for pod in pods:
+            ts = pod.metadata.deletion_timestamp
+            if ts is None:
+                continue
+            deadline = ts + fdas
+            if now < deadline:
+                remaining = deadline - now
+                next_wake = (
+                    remaining if next_wake is None
+                    else min(next_wake, remaining)
+                )
+                continue
+            dedup_key = (job.key(), job.metadata.uid, pod.metadata.uid)
+            with self._hb_lock:
+                if dedup_key in self._force_deleted:
+                    continue  # already escalated this incarnation
+            name = pod.metadata.name
+            try:
+                self.cluster.delete_pod(pod.metadata.namespace, name, force=True)
+            except NotFound:
+                continue  # won the race with the kubelet after all
+            except Exception:  # noqa: BLE001 — transient write failure
+                log.warning(
+                    "force delete of stuck-terminating pod %s/%s failed; "
+                    "retrying", pod.metadata.namespace, name, exc_info=True,
+                )
+                retry = True
+                continue
+            with self._hb_lock:
+                self._force_deleted.add(dedup_key)
+            msg = (
+                f"Pod {name} was stuck Terminating {now - ts:.0f}s past "
+                f"its granted grace period (forceDeleteAfterSeconds "
+                f"{fdas}s exceeded; node/kubelet presumed dead) — "
+                "force-deleted with grace period 0 to unblock gang "
+                "recovery."
+            )
+            record_event_best_effort(
+                self.cluster,
+                Event(
+                    type="Warning",
+                    reason=constants.REASON_FORCE_DELETE_POD,
+                    message=msg,
+                    involved_object=f"{job.kind}/{job.key()}",
+                ),
+            )
+            self.on_force_delete(
+                job, constants.FORCE_DELETE_CAUSE_STUCK_TERMINATING
+            )
+        if retry:
+            self.requeue(f"{job.kind}:{job.key()}", 1.0)
+        elif next_wake is not None:
+            self.requeue(f"{job.kind}:{job.key()}", next_wake + 0.1)
+
     # -------------------------------------------------------------- pods
     def reconcile_pods(
         self,
@@ -1325,6 +1477,8 @@ class JobController:
     ) -> None:
         """Reference ReconcilePods with the TF exit-code override folded in
         (tfjob_controller.go:646-742)."""
+        if not hasattr(job_status, "_deferred_deletes"):
+            job_status._deferred_deletes = []  # direct callers (tests)
         typed_pods = filter_pods_for_replica_type(pods, rtype)
         num_replicas = spec.replicas or 0
         job_status.replica_statuses[rtype] = capi.ReplicaStatus()
@@ -1379,13 +1533,28 @@ class JobController:
                 # this sync in "restarting" so the status machine doesn't
                 # read the terminating pod as a job failure.
                 job_status._restarting_this_sync = True
+            elif retryable_failure and pod.metadata.uid in (
+                job_status.gang_handled_uids or ()
+            ):
+                # Crash leftover: the restart was counted (the phase-1
+                # status write landed) but the process died before the
+                # delete. Finish the delete without re-charging any budget.
+                job_status._restarting_this_sync = True
+                job_status._deferred_deletes.append(
+                    {"pod": pod, "fresh": False}
+                )
             elif retryable_failure:
-                # Retryable failure: delete the pod (recreated next sync) and
-                # mark the job Restarting (reference :717-736). Same cause
-                # classification as the gang path: a preempted/evicted pod
-                # restarts on the disruption budget, a crashing one on
-                # backoffLimit. peers_healthy: no OTHER pod of the job
-                # failed permanently this sync.
+                # Retryable failure: count the restart and mark the job
+                # Restarting (reference :717-736), then delete the pod —
+                # but only AFTER the end-of-sync status write makes the
+                # count durable (count-before-delete: the failed pod is
+                # the only evidence a retried or failed-over sync can
+                # re-detect, and deleting it first opened a crash window
+                # that silently lost the restart from the budget). Same
+                # cause classification as the gang path: a preempted/
+                # evicted pod restarts on the disruption budget, a
+                # crashing one on backoffLimit. peers_healthy: no OTHER
+                # pod of the job failed permanently this sync.
                 peers_healthy = not any(
                     p is not pod
                     and p.status.phase == POD_FAILED
@@ -1405,19 +1574,9 @@ class JobController:
                     else constants.REASON_RESTARTING,
                 )
                 detail = "was disrupted" if disrupted else "failed"
-                self._delete_pod(job, pod)
                 msg = (
                     f"{self.hooks.kind} {job.name} is restarting because "
                     f"{rtype} replica(s) {detail}."
-                )
-                record_event_best_effort(
-                    self.cluster,
-                    Event(
-                        type="Warning",
-                        reason=reason,
-                        message=msg,
-                        involved_object=f"{job.kind}/{job.key()}",
-                    )
                 )
                 capi.update_job_conditions(
                     job_status,
@@ -1427,12 +1586,21 @@ class JobController:
                     now=self.clock(),
                 )
                 job_status._restarting_this_sync = True
-                # Durable restart accounting: the deleted pod's kubelet
-                # counter dies with it, but the budget its cause draws
-                # from must see the restart (checked at the next sync's
-                # run-policy gate).
+                # Handled stamp + durable restart accounting: the deleted
+                # pod's kubelet counter dies with it, but the budget its
+                # cause draws from must see the restart (checked at the
+                # next sync's run-policy gate). Stamp merged and pruned to
+                # present pods, like every other handled-uid writer.
+                present = {p.metadata.uid for p in pods}
+                job_status.gang_handled_uids = sorted(
+                    (set(job_status.gang_handled_uids or ()) & present)
+                    | {pod.metadata.uid}
+                )
                 self._count_restart(job, rtype, cause)
-                self.on_job_restarting(job, rtype, cause)
+                job_status._deferred_deletes.append({
+                    "pod": pod, "fresh": True, "rtype": rtype,
+                    "cause": cause, "reason": reason, "msg": msg,
+                })
 
             update_job_replica_statuses(job_status, rtype, pod)
 

@@ -71,6 +71,20 @@ class Metrics:
             "never arrived); the job self-healed but was wedged for the "
             "full expectation window",
         ),
+        "training_operator_force_deletes_total": (
+            ("job_namespace", "framework", "cause"),
+            "Pods the operator force-deleted (grace-period-0) after they "
+            "lingered Terminating past runPolicy.forceDeleteAfterSeconds "
+            "(cause StuckTerminating = dead kubelet/reclaimed host). Each "
+            "one means a node stopped acking and a gang was blocked",
+        ),
+        "training_operator_sync_errors_total": (
+            ("framework", "exception"),
+            "Reconcile syncs that raised and were rate-limit-requeued "
+            "(controllers/base.py process_next). A sustained rate here is "
+            "an error-requeue storm: jobs burning backoff delays instead "
+            "of converging",
+        ),
     }
     # Gauges with label sets: name -> (label names, help). Values live in
     # _labeled_gauges keyed by the label-value tuple, in label-name order.
@@ -156,6 +170,20 @@ class Metrics:
         self._inc_labeled(
             "training_operator_expectation_timeouts_total",
             namespace, framework, kind,
+        )
+
+    def force_delete_inc(self, namespace: str, framework: str, cause: str) -> None:
+        """One grace-period-0 escalation of a stuck-Terminating pod."""
+        self._inc_labeled(
+            "training_operator_force_deletes_total",
+            namespace, framework, cause,
+        )
+
+    def sync_error_inc(self, framework: str, exception: str) -> None:
+        """One sync that raised out of the reconcile and was requeued
+        rate-limited — the signal that was previously swallowed silently."""
+        self._inc_labeled(
+            "training_operator_sync_errors_total", framework, exception,
         )
 
     def set_heartbeat_age(self, namespace: str, framework: str,

@@ -1,0 +1,129 @@
+"""Crash-point failover harness.
+
+Drives a framework controller against a (usually chaos-proxied) cluster
+and, whenever a planted `SimulatedCrash` escapes a sync, simulates a full
+controller-process death + leader failover:
+
+- the controller instance is discarded WHOLESALE — expectations, the
+  gang-sweep cache, heartbeat observations, `_known_uids`, the workqueue:
+  every piece of in-memory state dies with the process, exactly as it
+  would with the pod;
+- its watch registrations are severed (a dead process receives no
+  events) via a generation-gated cluster proxy, since in-memory backends
+  have no unsubscribe;
+- a FRESH controller is constructed over the same cluster backend and
+  cold-start resynced — the `cli.py resync_once` path: LIST every job of
+  every enabled kind and enqueue it, which is all a real replacement
+  leader has (persisted status; none of its predecessor's memory).
+
+The chaos proxy (and its per-method call counters) lives on the CLUSTER
+side of the crash, so the fault schedule keeps advancing across
+failovers: a fixed seed replays the identical crash/fault schedule
+byte-for-byte, run to run — the property the crash tier asserts.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+from ..cluster.base import Cluster
+from ..cluster.chaos import SimulatedCrash
+
+
+class _GenerationGate:
+    """Cluster proxy handed to ONE controller incarnation: everything
+    delegates to the shared backend, but watch handlers registered
+    through it are dropped once the incarnation is superseded — the
+    in-memory backends have no unsubscribe, and a discarded controller
+    must not keep reacting to events (updating its dead expectations,
+    enqueuing into its dead queue) like a process that never died."""
+
+    def __init__(self, inner: Cluster, driver: "FailoverDriver", generation: int):
+        self._inner = inner
+        self._driver = driver
+        self._generation = generation
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def watch(self, kind, handler):
+        def gated(event_type, obj):
+            if self._driver.generation != self._generation:
+                return  # this incarnation is dead; it receives nothing
+            handler(event_type, obj)
+
+        self._inner.watch(kind, gated)
+
+
+class FailoverDriver:
+    """Runs `controller_factory(cluster)` to convergence, failing over on
+    every SimulatedCrash. `controller_factory` must build a COMPLETE
+    controller (its own queue, metrics, expectations) from nothing but a
+    cluster — any state smuggled past it would survive the "crash" and
+    invalidate the whole exercise."""
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        controller_factory: Callable[[Cluster], object],
+        kinds: Sequence[str] = ("JAXJob",),
+        namespace: Optional[str] = None,
+        max_failovers: int = 100,
+    ):
+        self._cluster = cluster
+        self._factory = controller_factory
+        self.kinds = tuple(kinds)
+        self.namespace = namespace
+        self.max_failovers = max_failovers
+        self.generation = 0
+        self.crashes: List[str] = []  # one entry per failover, in order
+        self.controller = None
+        self._boot()
+
+    # ------------------------------------------------------------ lifecycle
+    def _boot(self) -> None:
+        """Construct a fresh controller incarnation over the shared
+        backend and cold-start resync it (the cli.py resync_once path)."""
+        self.generation += 1
+        gate = _GenerationGate(self._cluster, self, self.generation)
+        self.controller = self._factory(gate)
+        self.resync()
+
+    def fail_over(self, crash: BaseException) -> None:
+        """Record the crash and replace the controller. Public so tests
+        can also force a failover at a chosen point (leader handoff
+        without a crash)."""
+        self.crashes.append(str(crash))
+        if len(self.crashes) > self.max_failovers:
+            raise AssertionError(
+                f"failover budget exceeded ({self.max_failovers}): the "
+                "crash schedule never lets the controller converge"
+            ) from crash
+        self._boot()
+
+    def resync(self) -> None:
+        """Cold-start enqueue from a LIST — everything a fresh leader has."""
+        for kind in self.kinds:
+            for job in self._cluster.list_jobs(kind, self.namespace):
+                meta = job.get("metadata", {}) or {}
+                self.controller._enqueue(
+                    meta.get("namespace", "default"), meta.get("name", "")
+                )
+
+    # ------------------------------------------------------------- driving
+    def step(self) -> bool:
+        """One process_next, converting a SimulatedCrash into a failover.
+        Returns whether an item was processed (or a failover happened)."""
+        try:
+            return self.controller.process_next(timeout=0.01)
+        except SimulatedCrash as crash:
+            self.fail_over(crash)
+            return True
+
+    def run_until_idle(self, max_iterations: int = 10_000) -> None:
+        """Drain to convergence across however many failovers the
+        schedule inflicts (the crash-surviving run_until_idle)."""
+        for _ in range(max_iterations):
+            if self.controller.queue.empty_and_idle():
+                return
+            self.step()

@@ -1,0 +1,216 @@
+"""Reusable structural invariants for chaos/crash/stall tiers.
+
+Everything a crash-consistent control plane must leave true of the
+CLUSTER — checkable from persisted state alone, with no knowledge of the
+schedule that battered it:
+
+- exactly-once ledgers: the three restart ledgers (`restartCounts` /
+  `disruptionCounts` / `stallCounts`) are non-negative, and when the test
+  knows the physical incident count it can pin them exactly
+  (`expect_ledgers`) — "disjoint and never doubled across a failover" is
+  asserted by passing the per-cause expectation;
+- no orphans: every pod/service carrying a controller ownerRef points at
+  a LIVE job uid (a crashed teardown must not strand dependents);
+- no duplicate indices: at most one non-terminating pod (and one
+  service) per (job, replica-type, index) slot — the expectations race's
+  signature corpse — and, for a live unsuspended job, no non-terminating
+  pod at an index beyond the declared replica count;
+- well-formed conditions: at most one entry per type, legal status
+  values, and the mutual-exclusion pairs (Succeeded/Failed,
+  Running/Restarting) never both True.
+
+`check_job_invariants` returns violations as strings (so a tier can
+aggregate); `assert_invariants` raises with the full list. The chaos and
+stall tiers run these after every scenario, the crash tier after every
+failover-and-converge.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from ..core import constants
+
+# Condition pairs that may never be simultaneously True.
+_EXCLUSIVE = (("Succeeded", "Failed"), ("Running", "Restarting"))
+
+_LEDGERS = ("restartCounts", "disruptionCounts", "stallCounts")
+
+
+def _conditions(status: dict) -> List[dict]:
+    return list((status or {}).get("conditions") or [])
+
+
+def check_condition_invariants(job: dict) -> List[str]:
+    name = (job.get("metadata") or {}).get("name", "?")
+    violations: List[str] = []
+    conds = _conditions(job.get("status") or {})
+    seen: Dict[str, dict] = {}
+    for c in conds:
+        ctype = c.get("type")
+        if not ctype:
+            violations.append(f"{name}: condition with empty type: {c}")
+            continue
+        if ctype in seen:
+            violations.append(f"{name}: duplicate condition type {ctype}")
+        seen[ctype] = c
+        if c.get("status") not in ("True", "False"):
+            violations.append(
+                f"{name}: condition {ctype} has malformed status "
+                f"{c.get('status')!r}"
+            )
+    for a, b in _EXCLUSIVE:
+        if (
+            seen.get(a, {}).get("status") == "True"
+            and seen.get(b, {}).get("status") == "True"
+        ):
+            violations.append(f"{name}: conditions {a} and {b} both True")
+    return violations
+
+
+def check_ledger_invariants(
+    job: dict, expect_ledgers: Optional[Dict[str, Dict[str, int]]] = None
+) -> List[str]:
+    """Structural ledger checks, plus exact-count pinning when the caller
+    knows the physical incident tally. `expect_ledgers` maps ledger name
+    -> expected per-replica-type dict; a named ledger must match EXACTLY
+    (pass {} to assert it stayed untouched — the disjointness half)."""
+    name = (job.get("metadata") or {}).get("name", "?")
+    status = job.get("status") or {}
+    violations: List[str] = []
+    for ledger in _LEDGERS:
+        counts = status.get(ledger) or {}
+        for rtype, value in counts.items():
+            if not isinstance(value, int) or value < 0:
+                violations.append(
+                    f"{name}: {ledger}[{rtype}] malformed: {value!r}"
+                )
+    if expect_ledgers:
+        for ledger, expected in expect_ledgers.items():
+            actual = status.get(ledger) or {}
+            if actual != expected:
+                violations.append(
+                    f"{name}: {ledger} == {actual!r}, expected {expected!r} "
+                    "(a crash/failover doubled or lost a count)"
+                )
+    return violations
+
+
+def _slot(obj) -> Optional[tuple]:
+    labels = obj.metadata.labels
+    jn = labels.get(constants.LABEL_JOB_NAME)
+    rt = labels.get(constants.LABEL_REPLICA_TYPE)
+    idx = labels.get(constants.LABEL_REPLICA_INDEX)
+    if not jn or rt is None or idx is None:
+        return None
+    return (obj.metadata.namespace, jn, rt, idx)
+
+
+def check_dependents_invariants(
+    cluster, jobs: Sequence[dict], namespace: Optional[str] = None
+) -> List[str]:
+    """Orphan + duplicate-slot checks over the live pods/services against
+    the given job set (pass every kind's jobs — an ownerRef match against
+    ANY live job counts)."""
+    violations: List[str] = []
+    live_uids = {
+        (j.get("metadata") or {}).get("uid") for j in jobs
+    } - {None, ""}
+    by_job = {
+        (
+            (j.get("metadata") or {}).get("namespace", "default"),
+            (j.get("metadata") or {}).get("name", ""),
+        ): j
+        for j in jobs
+    }
+
+    def scan(objs, what: str) -> None:
+        slots: Dict[tuple, int] = {}
+        for obj in objs:
+            ref = obj.metadata.controller_ref()
+            if ref is not None and ref.uid and ref.uid not in live_uids:
+                violations.append(
+                    f"orphan {what} {obj.metadata.namespace}/"
+                    f"{obj.metadata.name}: controller uid {ref.uid} matches "
+                    "no live job"
+                )
+            if obj.metadata.deletion_timestamp is not None:
+                continue  # a terminating object vacates its slot
+            slot = _slot(obj)
+            if slot is None:
+                continue
+            slots[slot] = slots.get(slot, 0) + 1
+            if slots[slot] > 1:
+                violations.append(
+                    f"duplicate {what} for slot {slot} (expectations race "
+                    "corpse: two live objects share one replica index)"
+                )
+        if what != "pod":
+            return
+        # Out-of-range live pods against the declared replica counts.
+        for (ns, jname, rt, idx), _count in slots.items():
+            job = by_job.get((ns, jname))
+            if job is None:
+                continue
+            spec = job.get("spec") or {}
+            replica_specs = next(
+                (v for k, v in spec.items() if k.endswith("ReplicaSpecs")),
+                {},
+            ) or {}
+            declared = next(
+                (
+                    v.get("replicas", 1)
+                    for k, v in replica_specs.items()
+                    if k.lower() == rt.lower()
+                ),
+                None,
+            )
+            try:
+                index = int(idx)
+            except ValueError:
+                violations.append(
+                    f"pod slot {(ns, jname, rt, idx)}: non-integer index"
+                )
+                continue
+            if declared is not None and index >= int(declared or 0):
+                violations.append(
+                    f"live pod at out-of-range index {index} "
+                    f"(declared {declared}) for {ns}/{jname}/{rt}"
+                )
+
+    scan(cluster.list_pods(namespace=namespace), "pod")
+    scan(cluster.list_services(namespace=namespace), "service")
+    return violations
+
+
+def check_job_invariants(
+    cluster,
+    kinds: Sequence[str],
+    namespace: Optional[str] = None,
+    expect_ledgers: Optional[Dict[str, Dict[str, int]]] = None,
+) -> List[str]:
+    """Run every invariant over all jobs of `kinds` (plus their
+    dependents) and return the violations."""
+    jobs: List[dict] = []
+    for kind in kinds:
+        jobs.extend(cluster.list_jobs(kind, namespace))
+    violations: List[str] = []
+    for job in jobs:
+        violations.extend(check_condition_invariants(job))
+        violations.extend(check_ledger_invariants(job, expect_ledgers))
+    violations.extend(
+        check_dependents_invariants(cluster, jobs, namespace=namespace)
+    )
+    return violations
+
+
+def assert_invariants(
+    cluster,
+    kinds: Sequence[str],
+    namespace: Optional[str] = None,
+    expect_ledgers: Optional[Dict[str, Dict[str, int]]] = None,
+) -> None:
+    violations = check_job_invariants(
+        cluster, kinds, namespace=namespace, expect_ledgers=expect_ledgers
+    )
+    assert not violations, "invariant violations:\n  " + "\n  ".join(violations)

@@ -344,7 +344,10 @@ class StubApiServer:
                 pod = from_dict(Pod, handler._body())
                 return handler._json(200, to_dict(self.mem.update_pod(pod)))
             if method == "DELETE":
-                self.mem.delete_pod(ns, name)
+                # DeleteOptions-as-query-params: gracePeriodSeconds=0 is
+                # the force-delete wire form KubeCluster emits.
+                force = q.get("gracePeriodSeconds", [None])[0] == "0"
+                self.mem.delete_pod(ns, name, force=force)
                 return handler._json(200, {})
         if resource == "services":
             if method == "GET" and name:
