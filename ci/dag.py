@@ -237,6 +237,25 @@ def default_dag() -> List[Step]:
         # aggressive resync; retried because timing-sensitive by nature.
         Step("concurrency-stress", pytest + ["tests/test_concurrency_stress.py"],
              deps=["operator-integration"], retries=2),
+        # Slow-start fan-out tier (docs/design/control_plane_performance.md):
+        # batch semantics, FIFO bucket fairness, the service-deletion
+        # expectation protocol, and — the hard constraint — chaos/crash
+        # determinism with fan-out enabled (the chaos seam serializes via
+        # supports_concurrent_writes, so fault schedules stay keyed on
+        # (method, call-index) byte-for-byte).
+        Step("fanout", pytest + ["tests/test_fanout.py"],
+             deps=["operator-integration"], retries=2),
+        # Control-plane scale smoke (scripts/measure_control_plane.py
+        # --mode scale): 32-replica gang bring-up, slow-start fan-out vs
+        # the serial baseline at the same qps/burst. Fails if parallel
+        # stops beating serial or the startup-p50 speedup (the
+        # load-normalized run-over-run gate) regresses >2x
+        # (build/scale_smoke_last.json); retried like the other
+        # timing-sensitive tiers.
+        Step("scale-smoke",
+             [PY, "scripts/measure_control_plane.py", "--mode", "scale",
+              "--smoke"],
+             deps=["operator-integration"], retries=3),
         # Seeded chaos tier (docs/design/disruption_handling.md): the
         # controllers under deterministic fault schedules — write
         # conflicts/errors, watch drops, slice-host preemptions — with

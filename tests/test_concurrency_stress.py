@@ -10,6 +10,7 @@ import time
 
 from tf_operator_tpu.cli import OperatorManager, OperatorOptions
 from tf_operator_tpu.cluster.memory import InMemoryCluster
+from tf_operator_tpu.cluster.throttled import LatencyCluster
 from tf_operator_tpu.metrics import Metrics
 
 
@@ -167,6 +168,52 @@ def test_counters_exact_under_concurrency():
         assert metrics.counter_value(
             "training_operator_jobs_created_total", "default", "TFJob"
         ) == 15
+    finally:
+        manager.stop()
+
+
+def test_large_gang_parallel_fanout_beats_serial_lower_bound():
+    """1 job x 64 workers under 3 worker threads on a latency-charged
+    cluster (5ms per write — the apiserver round trip the in-memory
+    backend doesn't charge): the slow-start fan-out must bring the gang
+    up well under the serial lower bound of 128 sequential writes
+    (64 pods + 64 services), with no duplicate pods — the expectations
+    dance must stay exact when creates land concurrently."""
+    latency = 0.005
+    mem = InMemoryCluster()
+    cluster = LatencyCluster(mem, latency)
+    manager = OperatorManager(
+        cluster,
+        OperatorOptions(enabled_schemes=["TFJob"], threadiness=3,
+                        resync_period=5.0, health_port=0, metrics_port=0),
+        metrics=Metrics(),
+    )
+    manager.start()
+    try:
+        t0 = time.monotonic()
+        mem.create_job(tfjob("big", workers=64))
+        assert wait_until(
+            lambda: len(mem.list_pods("default")) == 64, timeout=60,
+            interval=0.01,
+        ), f"pods: {len(mem.list_pods('default'))}"
+        elapsed = time.monotonic() - t0
+
+        pods = mem.list_pods("default")
+        names = [p.metadata.name for p in pods]
+        assert len(names) == len(set(names)) == 64, "duplicate/lost pods"
+        slots = {p.metadata.labels["replica-index"] for p in pods}
+        assert len(slots) == 64, "replica slot collision under fan-out"
+
+        # Serial lower bound: every replica costs at least a pod create
+        # and a service create, 128 round trips of `latency` each if
+        # issued one at a time. The fan-out overlaps them (waves ~=
+        # 2*log2(64)), so even with scheduling noise it must land well
+        # under the bound; 70% leaves margin for slow CI.
+        serial_bound = 128 * latency
+        assert elapsed < 0.7 * serial_bound, (
+            f"gang bring-up {elapsed:.3f}s did not beat the serial lower "
+            f"bound {serial_bound:.3f}s — fan-out is not parallel"
+        )
     finally:
         manager.stop()
 

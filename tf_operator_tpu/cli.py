@@ -58,6 +58,12 @@ class OperatorOptions:
     # a remote apiserver; in-process default is unlimited).
     qps: float = 0.0
     burst: int = 0
+    # Slow-start parallel replica fan-out (upstream slowStartBatch). On by
+    # default; chaos/process cluster seams serialize themselves via the
+    # supports_concurrent_writes capability regardless. Disabling is the
+    # serial-baseline lever for the scale benchmark.
+    parallel_fanout: bool = True
+    fanout_max_parallelism: int = 16
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -96,6 +102,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         help="Client write QPS limit (0 = unlimited; reference default 5).")
     parser.add_argument("--burst", type=int, default=0,
                         help="Client write burst (reference default 10).")
+    parser.add_argument("--disable-parallel-fanout", action="store_true",
+                        help="Serialize replica create/delete fan-out (the "
+                        "serial baseline; default is slow-start parallel batches).")
+    parser.add_argument("--fanout-max-parallelism", type=int, default=16,
+                        help="Max in-flight writes of one slow-start fan-out batch.")
     parser.add_argument("--kube", action="store_true",
                         help="Reconcile a real cluster via the kube-apiserver "
                         "(in-cluster service-account auth, or --kube-url/--kube-token).")
@@ -129,6 +140,8 @@ def options_from_args(args: argparse.Namespace) -> OperatorOptions:
         json_log_format=args.json_log_format,
         qps=args.qps,
         burst=args.burst,
+        parallel_fanout=not args.disable_parallel_fanout,
+        fanout_max_parallelism=args.fanout_max_parallelism,
     )
 
 
@@ -278,6 +291,8 @@ class OperatorManager:
             gang_scheduler_name=self.options.gang_scheduler_name,
             qps=self.options.qps,
             burst=self.options.burst,
+            parallel_fanout=self.options.parallel_fanout,
+            fanout_max_parallelism=self.options.fanout_max_parallelism,
         )
         from .core.control import TokenBucket
 
@@ -444,6 +459,14 @@ class OperatorManager:
                 server.server_close()
         for thread in self._threads:
             thread.join(timeout=timeout)
+        # After the workers have quiesced: release each controller's
+        # fan-out pool (lazily rebuilt on a start() cycle) so repeated
+        # manager lifecycles — the scale benchmark builds one per
+        # measurement — don't accumulate idle thread pools.
+        for controller in self.controllers.values():
+            close = getattr(controller, "close", None)
+            if close is not None:
+                close()
         self._started = False
 
     def run_forever(self) -> None:

@@ -68,6 +68,18 @@ def matches_claim_view(obj, labels, owner_uid) -> bool:
 class Cluster:
     """Abstract cluster backend."""
 
+    # Capability flag for the engine's slow-start fan-out (core/control.py
+    # slow_start_batch): True means write methods tolerate concurrent
+    # callers AND nothing downstream keys behavior on per-method call
+    # ORDER, so the engine may issue a batch's writes in parallel. False
+    # (the conservative default) serializes every batch in work-list
+    # order — required by the chaos proxy, whose fault schedule is a pure
+    # function of (method, per-method call index) and must stay
+    # byte-reproducible, and by backends that are not thread-safe.
+    # Proxies that delegate via __getattr__ (throttled, failover gate)
+    # inherit the inner backend's verdict automatically.
+    supports_concurrent_writes: bool = False
+
     # ---- jobs (CR objects, stored as dicts keyed by kind) ----
     def create_job(self, job_dict: dict) -> dict:
         raise NotImplementedError

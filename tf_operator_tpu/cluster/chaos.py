@@ -206,6 +206,15 @@ class ChaosCluster:
     first. `fault_log` records every injected fault in order — the
     byte-for-byte reproducibility artifact."""
 
+    # The fault schedule is a pure function of (method, per-method call
+    # index): concurrent writers would make those indices — and with them
+    # the entire schedule — depend on thread scheduling. Declaring the
+    # seam serial makes the engine's slow-start fan-out degrade to
+    # strictly-ordered sequential writes, which is exactly what keeps a
+    # seeded chaos run byte-reproducible with fan-out enabled
+    # (docs/design/control_plane_performance.md).
+    supports_concurrent_writes = False
+
     def __init__(self, inner: Cluster, spec: ChaosSpec):
         self._inner = inner
         self.spec = spec

@@ -136,6 +136,11 @@ class _NoDelayHTTPSConnection(http.client.HTTPSConnection):
 
 
 class KubeCluster(Cluster):
+    # Each thread holds its own keep-alive connection (self._local) and a
+    # real apiserver is built for concurrent clients — the whole point of
+    # the parallel fan-out is overlapping these round trips.
+    supports_concurrent_writes = True
+
     def __init__(
         self,
         base_url: Optional[str] = None,

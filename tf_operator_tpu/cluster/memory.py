@@ -56,6 +56,11 @@ class _RVCounter:
 
 
 class InMemoryCluster(base.Cluster):
+    # Every mutation runs under one RLock and the event drainer is
+    # designed for concurrent writers (_publish_locked/_drain_events), so
+    # the engine's parallel fan-out is safe here.
+    supports_concurrent_writes = True
+
     def __init__(self, clock=time.time):
         self._lock = threading.RLock()
         self._clock = clock

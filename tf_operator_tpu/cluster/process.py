@@ -84,6 +84,12 @@ _IP_BASE = (127, 0, 10, 1)
 
 
 class LocalProcessCluster(InMemoryCluster):
+    # Pod creates fork real subprocesses and juggle per-pod log file
+    # handles outside the store lock; keep the engine's fan-out
+    # sequential here (the e2e tier's determinism also leans on stable
+    # launch order for the loopback-alias IP assignment).
+    supports_concurrent_writes = False
+
     def __init__(
         self,
         clock=time.time,

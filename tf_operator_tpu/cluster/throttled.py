@@ -11,6 +11,8 @@ is cache-backed in both worlds).
 
 from __future__ import annotations
 
+import time
+
 from ..core.control import TokenBucket
 from .base import Cluster
 
@@ -35,7 +37,11 @@ _WRITE_METHODS = (
 
 
 class ThrottledCluster:
-    """Delegates everything to `inner`; write methods pay the bucket."""
+    """Delegates everything to `inner`; write methods pay the bucket.
+    `supports_concurrent_writes` passes through untouched (__getattr__
+    reaches the inner backend's verdict): throttling changes WHEN a write
+    may go, never whether concurrent callers are safe — the bucket itself
+    is FIFO-fair under contention."""
 
     def __init__(self, inner: Cluster, limiter: TokenBucket):
         self._inner = inner
@@ -51,4 +57,36 @@ class ThrottledCluster:
                 return attr(*args, **kwargs)
 
             return throttled
+        return attr
+
+
+class LatencyCluster:
+    """Per-write latency proxy: every write sleeps `latency_seconds`
+    before delegating — a dependency-free stand-in for the apiserver
+    round trip the in-memory backend doesn't charge. This is what makes
+    serial-vs-parallel fan-out measurable on `InMemoryCluster` (the
+    scale benchmark and the concurrency-stress large-gang test): with
+    free writes, 32 sequential creates and 6 slow-start waves cost the
+    same; with a round trip, parallelism overlaps it.
+
+    Sleeps happen OUTSIDE any lock and the proxy keeps no mutable state,
+    so it is exactly as concurrency-safe as its inner backend."""
+
+    def __init__(self, inner: Cluster, latency_seconds: float):
+        self._inner = inner
+        self._latency = latency_seconds
+        self.supports_concurrent_writes = getattr(
+            inner, "supports_concurrent_writes", False
+        )
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name in _WRITE_METHODS and callable(attr):
+            latency = self._latency
+
+            def delayed(*args, **kwargs):
+                time.sleep(latency)
+                return attr(*args, **kwargs)
+
+            return delayed
         return attr

@@ -129,7 +129,14 @@ class FrameworkController(FrameworkHooks):
             on_job_restarting=self._record_restart,
             on_heartbeat_age=self._record_heartbeat_age,
             on_force_delete=self._record_force_delete,
+            on_fanout_batch=self._record_fanout_batch,
+            on_fanout_abort=self._record_fanout_abort,
         )
+        # Queue-wait observer (enqueue -> worker pop), fed straight into
+        # the queue_wait histogram; injected custom queues without the
+        # hook simply go unobserved.
+        if hasattr(self.queue, "on_wait"):
+            self.queue.on_wait = self._observe_queue_wait
         self._watch()
 
     # ---------------------------------------------------------------- glue
@@ -145,6 +152,11 @@ class FrameworkController(FrameworkHooks):
         if self.namespace and namespace != self.namespace:
             return
         self.queue.add(f"{self.kind}:{namespace}/{name}")
+        # Depth sampled on ADD as well as on pop (_observe_queue_wait):
+        # when every worker is wedged in a long sync, pops stop — exactly
+        # the moment a growing backlog must not freeze the gauge at its
+        # last popped value.
+        self._sample_queue_depth()
 
     def _on_job_event(self, event_type: str, job_dict: dict) -> None:
         meta = job_dict.get("metadata", {})
@@ -225,6 +237,27 @@ class FrameworkController(FrameworkHooks):
 
     def _record_force_delete(self, job: JobObject, cause: str) -> None:
         self.metrics.force_delete_inc(job.namespace, self.kind, cause)
+
+    def close(self) -> None:
+        """Release the engine's process-lifetime resources (fan-out
+        pool). Called by OperatorManager.stop(); long-lived standalone
+        controllers in tests may skip it (threads die with the process)."""
+        self.engine.close()
+
+    def _record_fanout_batch(self, resource: str, size: int) -> None:
+        self.metrics.fanout_batch_inc(self.kind, resource)
+
+    def _record_fanout_abort(self, resource: str) -> None:
+        self.metrics.fanout_abort_inc(self.kind, resource)
+
+    def _observe_queue_wait(self, item: str, seconds: float) -> None:
+        self.metrics.observe_queue_wait(self.kind, seconds)
+        self._sample_queue_depth()
+
+    def _sample_queue_depth(self) -> None:
+        self.metrics.set_workqueue_depth(
+            self.kind, self.queue.depth()["queued"]
+        )
 
     def _on_expectation_timeout(self, key: str, kind: str, adds: int, dels: int) -> None:
         """An expectation expired unfulfilled: the watch event we were

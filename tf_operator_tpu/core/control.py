@@ -11,7 +11,9 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import List, Optional
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List, Optional, Tuple
 
 from ..api.common import JobObject
 from ..api.k8s import Event, Pod, Service, new_owner_reference
@@ -19,6 +21,107 @@ from ..cluster.base import Cluster
 from . import constants
 
 _log = logging.getLogger(__name__)
+
+# Upper bound on in-flight writes of one slow-start fan-out: batches double
+# 1 -> 2 -> 4 -> ... and saturate here, so a 128-replica gang never opens
+# 128 concurrent apiserver connections from one sync.
+SLOW_START_MAX_PARALLELISM = 16
+
+
+def slow_start_batch(
+    count: int,
+    fn: Callable[[int], None],
+    *,
+    parallel: bool = True,
+    initial_batch_size: int = 1,
+    max_parallelism: int = SLOW_START_MAX_PARALLELISM,
+    on_batch: Optional[Callable[[int], None]] = None,
+    pool: Optional[ThreadPoolExecutor] = None,
+) -> Tuple[int, Optional[Exception]]:
+    """Issue ``fn(0) .. fn(count-1)`` in slow-start batches — the upstream
+    controller-manager ``slowStartBatch`` idiom (kube-controller-manager
+    pkg/controller/replicaset): batch sizes double from
+    ``initial_batch_size`` (1 -> 2 -> 4 -> ...), each batch runs
+    concurrently on a bounded pool, and the FIRST batch containing an
+    error aborts the remainder. A broken pod template therefore costs one
+    apiserver call, not N; a healthy template reaches full parallelism
+    within log2(N) waves.
+
+    Returns ``(successes, first_error)`` — successes is the exact number
+    of ``fn`` calls that returned cleanly (the caller rolls back
+    expectations for the ``count - successes`` never-confirmed writes).
+
+    ``parallel=False`` degrades to a strictly-ordered sequential loop that
+    stops at the first error: the determinism fallback for cluster seams
+    whose fault schedules key on ``(method, per-method call index)``
+    (the chaos proxy) or that are not thread-safe (the process tier) —
+    call order then equals work-list order, byte-for-byte reproducible.
+
+    ``on_batch`` (optional) fires once per wave with the wave size, before
+    the wave runs — the instrumentation hook for batch-size counters.
+
+    ``pool`` (optional) is a caller-owned long-lived executor. Passing one
+    keeps worker threads — and with them per-thread keep-alive apiserver
+    connections (KubeCluster's ``self._local``) — warm across fan-outs;
+    without it a throwaway pool is built per call. A shared pool is never
+    shut down here.
+    """
+    if count <= 0:
+        return 0, None
+    # A one-write batch gains nothing from a pool; skip the executor
+    # machinery (single failed-replica recreates hit this every sync).
+    if not parallel or max_parallelism <= 1 or count == 1:
+        if on_batch is not None:
+            on_batch(count)
+        for i in range(count):
+            try:
+                fn(i)
+            except Exception as exc:  # noqa: BLE001 — reported, not hidden
+                return i, exc
+        return count, None
+
+    own_pool = pool is None
+    if own_pool:
+        pool = ThreadPoolExecutor(max_workers=max_parallelism)
+    successes = 0
+    index = 0
+    batch = max(1, initial_batch_size)
+    try:
+        while index < count:
+            size = min(batch, count - index, max_parallelism)
+            if on_batch is not None:
+                on_batch(size)
+            futures = []
+            submit_error: Optional[Exception] = None
+            for j in range(size):
+                try:
+                    futures.append(pool.submit(fn, index + j))
+                except Exception as exc:  # noqa: BLE001 — pool shut under us
+                    # A failed submit (a shared pool closed by a racing
+                    # controller shutdown) is the wave's error, NOT an
+                    # escape from the (successes, first_error) contract:
+                    # the already-submitted part of the wave still runs
+                    # and must be counted, or the caller's expectation
+                    # rollback would roll back writes that landed.
+                    submit_error = exc
+                    break
+            first_error: Optional[Exception] = None
+            for future in futures:
+                exc = future.exception()
+                if exc is None:
+                    successes += 1
+                elif first_error is None:
+                    first_error = exc  # keep the earliest-indexed error
+            if first_error is None:
+                first_error = submit_error
+            if first_error is not None:
+                return successes, first_error
+            index += size
+            batch *= 2
+        return successes, None
+    finally:
+        if own_pool:
+            pool.shutdown(wait=True)
 
 
 def owner_ref_for(job: JobObject):
@@ -48,7 +151,15 @@ def record_event_best_effort(cluster: Cluster, event: Event) -> None:
 class TokenBucket:
     """Client-side write throttling — the reference's --qps/--burst client
     rate limits (options.go:73-83, defaults QPS 5 / burst 10 against the
-    apiserver). qps <= 0 disables (unlimited)."""
+    apiserver). qps <= 0 disables (unlimited).
+
+    FIFO-fair under contention: waiters are served in arrival order via a
+    queue of per-waiter events, and each released token wakes exactly the
+    next waiter in line — no thundering-herd re-race on every refill.
+    Parallel fan-out (slow_start_batch) makes N threads contending for
+    this one budget the common case; the old spin-under-one-lock acquire
+    let an unlucky thread starve arbitrarily long behind later arrivals.
+    """
 
     def __init__(self, qps: float = 0.0, burst: int = 0, clock=time.monotonic):
         self.qps = qps
@@ -62,23 +173,63 @@ class TokenBucket:
         self._last = clock()
         self._clock = clock
         self._lock = threading.Lock()
+        # FIFO ticket line: each waiting thread parks on its own Event;
+        # only the head of the line polls the refill clock.
+        self._waiters: deque = deque()
+
+    def _refill_locked(self) -> None:
+        now = self._clock()
+        self._tokens = min(
+            float(self.burst), self._tokens + (now - self._last) * self.qps
+        )
+        self._last = now
 
     def acquire(self) -> None:
-        """Block until a token is available (no-op when disabled)."""
+        """Block until a token is available (no-op when disabled). Tokens
+        are granted strictly in arrival order."""
         if self.qps <= 0:
             return
-        while True:
+        me = threading.Event()
+        with self._lock:
+            self._refill_locked()
+            if not self._waiters and self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return  # uncontended fast path
+            self._waiters.append(me)
+            if self._waiters[0] is me:
+                me.set()  # head of the line: poll for refill below
+        try:
+            while True:
+                # Non-head waiters sleep here until the departing head
+                # hands them the baton (one targeted set(), no broadcast).
+                me.wait(0.05)
+                with self._lock:
+                    if self._waiters[0] is not me:
+                        continue
+                    self._refill_locked()
+                    if self._tokens >= 1.0:
+                        self._tokens -= 1.0
+                        self._waiters.popleft()
+                        if self._waiters:
+                            self._waiters[0].set()
+                        return
+                    wait = (1.0 - self._tokens) / self.qps
+                # Head-only refill poll, bounded so injected test clocks
+                # that jump forward are observed promptly.
+                time.sleep(min(wait, 0.05))
+        except BaseException:
+            # A thread unwinding mid-wait (KeyboardInterrupt, injected
+            # timeout) must not leave its dead Event in the line: once it
+            # reached the head, every later acquire would spin on it
+            # forever. Dequeue and hand the baton on.
             with self._lock:
-                now = self._clock()
-                self._tokens = min(
-                    float(self.burst), self._tokens + (now - self._last) * self.qps
-                )
-                self._last = now
-                if self._tokens >= 1.0:
-                    self._tokens -= 1.0
-                    return
-                wait = (1.0 - self._tokens) / self.qps
-            time.sleep(min(wait, 0.1))
+                try:
+                    self._waiters.remove(me)
+                except ValueError:
+                    pass
+                if self._waiters:
+                    self._waiters[0].set()
+            raise
 
 
 class PodControl:

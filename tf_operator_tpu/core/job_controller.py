@@ -38,7 +38,12 @@ from ..api.k8s import (
 )
 from ..cluster.base import Cluster
 from . import constants
-from .control import PodControl, ServiceControl, record_event_best_effort
+from .control import (
+    PodControl,
+    ServiceControl,
+    record_event_best_effort,
+    slow_start_batch,
+)
 from .expectations import ControllerExpectations
 
 log = logging.getLogger(__name__)
@@ -365,6 +370,14 @@ class EngineOptions:
     # Client-side write throttling (reference --qps/--burst; 0 = unlimited).
     qps: float = 0.0
     burst: int = 0
+    # Slow-start parallel fan-out for replica create/delete batches
+    # (upstream slowStartBatch). Effective parallelism is ANDed with the
+    # cluster seam's supports_concurrent_writes capability: a seam that
+    # keys fault schedules on call order (chaos) or is not thread-safe
+    # (process tier) serializes regardless of this flag, so turning it
+    # off is only needed to measure the serial baseline.
+    parallel_fanout: bool = True
+    fanout_max_parallelism: int = 16
 
 
 class JobController:
@@ -383,6 +396,8 @@ class JobController:
         on_job_restarting: Optional[Callable[[JobObject, str, str], None]] = None,
         on_heartbeat_age: Optional[Callable[[JobObject, float], None]] = None,
         on_force_delete: Optional[Callable[[JobObject, str], None]] = None,
+        on_fanout_batch: Optional[Callable[[str, int], None]] = None,
+        on_fanout_abort: Optional[Callable[[str], None]] = None,
     ):
         self.hooks = hooks
         self.cluster = cluster
@@ -403,6 +418,11 @@ class JobController:
         # stuck-Terminating pod; the controller exports it as the
         # cause-labeled force_deletes_total counter.
         self.on_force_delete = on_force_delete or (lambda job, cause: None)
+        # (resource, wave size) once per slow-start wave issued, and
+        # (resource,) once per fan-out aborted by a write error — the
+        # controller exports them as the fanout batch/abort counters.
+        self.on_fanout_batch = on_fanout_batch or (lambda resource, size: None)
+        self.on_fanout_abort = on_fanout_abort or (lambda resource: None)
         # (job key, uid) -> {pod uid: _HeartbeatState}: the liveness
         # observation cache. In-memory by design — an operator restart (or
         # leader failover) restarts every staleness clock from its own
@@ -424,6 +444,12 @@ class JobController:
         # every sync. In-memory: a restart re-escalates exactly once.
         # Guarded by _hb_lock; pruned via forget_job.
         self._force_deleted: set = set()
+        # Long-lived fan-out executor, built lazily on the first parallel
+        # batch: reusing threads keeps KubeCluster's per-thread keep-alive
+        # connections warm across fan-outs instead of renegotiating TLS
+        # every wave. Never used on seams that serialize (chaos/process).
+        self._fanout_pool = None
+        self._fanout_pool_lock = threading.Lock()
         # (job key, uid) -> last-declared gang-group names: gates the stale
         # sweep's uncached LIST to declared-set changes (and once per
         # operator lifetime per job, since this cache is in-memory).
@@ -433,6 +459,18 @@ class JobController:
         # thread delivering DELETED — unsynchronized iteration would race.
         self._gang_declared: Dict[tuple, set] = {}
         self._gang_declared_lock = threading.Lock()
+
+    def close(self) -> None:
+        """Release process-lifetime resources (the fan-out thread pool).
+        Safe to call repeatedly; the pool is lazily recreated if the
+        engine is driven again (OperatorManager supports stop->start
+        cycles). In-flight batch submits racing a close see a
+        RuntimeError from the shut pool, which rides the normal batch
+        error path (rollback + rate-limited requeue)."""
+        with self._fanout_pool_lock:
+            pool, self._fanout_pool = self._fanout_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
 
     def forget_job(self, key: str) -> None:
         """Drop per-job in-memory bookkeeping after the job is gone
@@ -1025,16 +1063,28 @@ class JobController:
         last and only once every survivor delete succeeded — a partial
         teardown therefore always leaves the re-detectable trigger intact
         for the retry sync. Pods already Terminating are skipped so a
-        retried teardown never double-deletes. Returns (name, exc) pairs
-        for deletes that failed; the caller decides how to surface them."""
+        retried teardown never double-deletes. Survivor deletions fan out
+        through slow_start_batch (gang teardown is half of restart MTTR),
+        but unlike the CREATE batches a failed delete does NOT abort the
+        wave's successors: errors are recorded per pod and the rest keep
+        going — one survivor whose delete persistently fails (webhook
+        denial, a wedged node) must not block the pods behind it from
+        ever being deleted, or the gang restart could stall forever on
+        zero progress per retry. Returns (name, exc) pairs for deletes
+        that failed; the caller decides how to surface them."""
+        victims = [
+            pod for pod in targets
+            if pod is not trigger and pod.metadata.deletion_timestamp is None
+        ]
         delete_errors: List[tuple] = []
-        for pod in targets:
-            if pod is trigger or pod.metadata.deletion_timestamp is not None:
-                continue
+
+        def delete_one(i: int) -> None:
             try:
-                self._delete_pod(job, pod)
-            except Exception as exc:  # noqa: BLE001 — keep tearing down
-                delete_errors.append((pod.metadata.name, exc))
+                self._delete_pod(job, victims[i])
+            except Exception as exc:  # noqa: BLE001 — recorded, not aborting
+                delete_errors.append((victims[i].metadata.name, exc))
+
+        self._batch_write("pods", len(victims), delete_one)
         if not delete_errors and trigger.metadata.deletion_timestamp is None:
             try:
                 self._delete_pod(job, trigger)
@@ -1465,6 +1515,77 @@ class JobController:
         elif next_wake is not None:
             self.requeue(f"{job.kind}:{job.key()}", next_wake + 0.1)
 
+    # ----------------------------------------------------- batched fan-out
+    def _batch_write(self, resource: str, count: int, fn) -> tuple:
+        """Issue `count` cluster writes through slow_start_batch, parallel
+        only when BOTH the options allow it and the cluster seam declares
+        itself safe for concurrent callers (supports_concurrent_writes).
+        The serial fallback preserves work-list call order exactly, which
+        is what keeps chaos fault schedules — keyed on (method, per-method
+        call index) — byte-reproducible with fan-out enabled. Returns
+        (successes, first_error)."""
+        parallel = self.options.parallel_fanout and bool(
+            getattr(self.cluster, "supports_concurrent_writes", False)
+        )
+        pool = None
+        if parallel and count > 1 and self.options.fanout_max_parallelism > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with self._fanout_pool_lock:
+                if self._fanout_pool is None:
+                    self._fanout_pool = ThreadPoolExecutor(
+                        max_workers=max(1, self.options.fanout_max_parallelism),
+                        thread_name_prefix="fanout",
+                    )
+                pool = self._fanout_pool
+        successes, err = slow_start_batch(
+            count,
+            fn,
+            parallel=parallel,
+            max_parallelism=max(1, self.options.fanout_max_parallelism),
+            on_batch=lambda size: self.on_fanout_batch(resource, size),
+            pool=pool,
+        )
+        if err is not None:
+            self.on_fanout_abort(resource)
+        return successes, err
+
+    def _create_pods_batch(
+        self,
+        job: JobObject,
+        rtype: str,
+        indices: List[int],
+        spec: ReplicaSpec,
+        replicas: Dict[str, ReplicaSpec],
+    ) -> None:
+        """Create every missing pod of one replica type in one slow-start
+        fan-out. Expectations for the WHOLE batch are raised up front —
+        the sync gate must block until every issued create's watch event
+        lands, not just the last one's — and on a write error exactly the
+        failed remainder (count - successes) is rolled back, the
+        generalization of the reference createNewPod's per-pod rollback
+        (tfjob_controller.go:828-833). The first error then propagates to the rate-limited
+        queue with the already-created pods left standing (their events
+        fulfill their share of the expectation; the retry sync re-lists
+        and creates only what is still missing)."""
+        key = job.key()
+        pods = [
+            self._build_pod(
+                job, rtype, index, spec,
+                self.hooks.is_master_role(replicas, rtype, index), replicas,
+            )
+            for index in indices
+        ]
+        self.expectations.expect_creations(key, "pods", len(pods))
+        successes, err = self._batch_write(
+            "pods", len(pods),
+            lambda i: self.pod_control.create_pod(job.namespace, pods[i], job),
+        )
+        if err is not None:
+            for _ in range(len(pods) - successes):
+                self.expectations.creation_observed(key, "pods")
+            raise err
+
     # -------------------------------------------------------------- pods
     def reconcile_pods(
         self,
@@ -1484,13 +1605,18 @@ class JobController:
         job_status.replica_statuses[rtype] = capi.ReplicaStatus()
 
         slices = get_pod_slices(typed_pods, num_replicas)
+        # Missing in-range slots are COLLECTED here and created in one
+        # slow-start fan-out after the scan: a 32-host gang pays log2(32)
+        # batched waves instead of 32 sequential apiserver round trips
+        # before its first rendezvous (docs/design/
+        # control_plane_performance.md).
+        to_create: List[int] = []
         for index, pod_slice in enumerate(slices):
             if len(pod_slice) > 1:
                 continue  # duplicate pods for an index: wait for cache to settle
             if not pod_slice:
                 if index < num_replicas:
-                    master_role = self.hooks.is_master_role(replicas, rtype, index)
-                    self.create_new_pod(job, rtype, index, spec, master_role, replicas)
+                    to_create.append(index)
                 continue
 
             pod = pod_slice[0]
@@ -1604,7 +1730,10 @@ class JobController:
 
             update_job_replica_statuses(job_status, rtype, pod)
 
-    def create_new_pod(
+        if to_create:
+            self._create_pods_batch(job, rtype, to_create, spec, replicas)
+
+    def _build_pod(
         self,
         job: JobObject,
         rtype: str,
@@ -1612,11 +1741,11 @@ class JobController:
         spec: ReplicaSpec,
         master_role: bool,
         replicas: Dict[str, ReplicaSpec],
-    ) -> None:
-        """Reference createNewPod (tfjob_controller.go:746-836)."""
-        key = job.key()
-        self.expectations.expect_creations(key, "pods", 1)
-
+    ) -> Pod:
+        """Render one replica's Pod from the template: labels, rendezvous
+        env, restart-policy mapping, gang annotations. Pure build — no
+        cluster writes, no expectations — so the batch path can construct
+        the whole work list deterministically before any write is issued."""
         template = copy.deepcopy(spec.template)
         labels = replica_labels(job, rtype, index)
         if master_role:
@@ -1660,14 +1789,7 @@ class JobController:
             template.metadata.annotations[constants.ANNOTATION_GANG_TASK_SPEC] = rtype.lower()
             template.spec.scheduler_name = self.options.gang_scheduler_name
 
-        pod = Pod(metadata=template.metadata, spec=template.spec)
-        try:
-            self.pod_control.create_pod(job.namespace, pod, job)
-        except Exception:
-            # Roll the expectation back so the job is not stuck waiting for a
-            # create event that will never come (reference :828-833).
-            self.expectations.creation_observed(key, "pods")
-            raise
+        return Pod(metadata=template.metadata, spec=template.spec)
 
     def _delete_pod(self, job: JobObject, pod: Pod) -> None:
         key = job.key()
@@ -1678,22 +1800,53 @@ class JobController:
             self.expectations.deletion_observed(key, "pods")
             raise
 
+    def _delete_service(self, job: JobObject, svc: Service) -> None:
+        """Delete one service under the SAME expectation protocol as
+        _delete_pod. Service deletions used to bypass expect_deletions
+        entirely, so a slow service delete could never gate the next sync
+        the way pod deletes do — a relist racing the deletion re-saw the
+        dying service and skipped recreating its index, then double-created
+        after the DELETED event landed. One protocol for both dependents
+        closes the asymmetry (the controller's service watch observes the
+        deletion exactly like the pod watch does)."""
+        key = job.key()
+        self.expectations.expect_deletions(key, "services", 1)
+        try:
+            self.service_control.delete_service(
+                svc.metadata.namespace, svc.metadata.name, job
+            )
+        except Exception:
+            self.expectations.deletion_observed(key, "services")
+            raise
+
     def _delete_pods_and_services(self, job: JobObject, pods: List[Pod], run_policy) -> None:
         """Apply CleanPodPolicy: None keeps everything; Running deletes only
         live (running/pending) pods; All deletes all. Services go with any
-        pod cleanup (kubeflow/common deletePodsAndServices semantics)."""
+        pod cleanup (kubeflow/common deletePodsAndServices semantics).
+        Both teardowns fan out through slow_start_batch — gang teardown is
+        the other half of restart MTTR — with the first delete error
+        aborting the remainder and propagating to the rate-limited queue
+        (already-deleted objects don't re-delete on the retry)."""
         policy = run_policy.clean_pod_policy or capi.CLEAN_POD_POLICY_NONE
         if policy == capi.CLEAN_POD_POLICY_NONE:
             return
-        for pod in pods:
-            if policy == capi.CLEAN_POD_POLICY_RUNNING and pod.status.phase not in (
-                POD_RUNNING,
-                POD_PENDING,
-            ):
-                continue
-            self._delete_pod(job, pod)
-        for svc in self.get_services_for_job(job):
-            self.service_control.delete_service(svc.metadata.namespace, svc.metadata.name, job)
+        doomed = [
+            pod for pod in pods
+            if policy != capi.CLEAN_POD_POLICY_RUNNING
+            or pod.status.phase in (POD_RUNNING, POD_PENDING)
+        ]
+        _, err = self._batch_write(
+            "pods", len(doomed), lambda i: self._delete_pod(job, doomed[i])
+        )
+        if err is not None:
+            raise err
+        services = self.get_services_for_job(job)
+        _, err = self._batch_write(
+            "services", len(services),
+            lambda i: self._delete_service(job, services[i]),
+        )
+        if err is not None:
+            raise err
 
     # ----------------------------------------------------------- services
     def reconcile_services(
@@ -1714,34 +1867,56 @@ class JobController:
             except ValueError:
                 continue
 
-        port = self._port_from_spec(spec)
-        for index in range(num_replicas):
-            if index in by_index:
-                continue
-            labels = replica_labels(job, rtype, index)
-            service = Service(
-                metadata=copy.deepcopy(spec.template.metadata),
-                spec=ServiceSpec(
-                    cluster_ip="None",
-                    selector=labels,
-                    ports=[ServicePort(name=self.hooks.default_port_name, port=port)],
+        # Missing indices fan out through the same slow-start batch path
+        # as pods: whole-batch expectations up front, exact rollback of
+        # the failed remainder, first error to the rate-limited queue.
+        missing = [i for i in range(num_replicas) if i not in by_index]
+        if missing:
+            services = [
+                self._build_service(job, rtype, index, spec)
+                for index in missing
+            ]
+            key = job.key()
+            self.expectations.expect_creations(key, "services", len(services))
+            successes, err = self._batch_write(
+                "services", len(services),
+                lambda i: self.service_control.create_service(
+                    job.namespace, services[i], job
                 ),
             )
-            service.metadata.name = gen_general_name(job.name, rtype, index)
-            service.metadata.namespace = job.namespace
-            service.metadata.labels = dict(service.metadata.labels)
-            service.metadata.labels.update(labels)
-            key = job.key()
-            self.expectations.expect_creations(key, "services", 1)
-            try:
-                self.service_control.create_service(job.namespace, service, job)
-            except Exception:
-                self.expectations.creation_observed(key, "services")
-                raise
+            if err is not None:
+                for _ in range(len(services) - successes):
+                    self.expectations.creation_observed(key, "services")
+                raise err
 
-        for index, svc in by_index.items():
+        for index, svc in sorted(by_index.items()):
             if index >= num_replicas:
-                self.service_control.delete_service(svc.metadata.namespace, svc.metadata.name, job)
+                self._delete_service(job, svc)
+
+    def _build_service(
+        self, job: JobObject, rtype: str, index: int, spec: ReplicaSpec
+    ) -> Service:
+        """Render one replica's headless Service (pure build, no writes —
+        the service analog of _build_pod)."""
+        labels = replica_labels(job, rtype, index)
+        service = Service(
+            metadata=copy.deepcopy(spec.template.metadata),
+            spec=ServiceSpec(
+                cluster_ip="None",
+                selector=labels,
+                ports=[
+                    ServicePort(
+                        name=self.hooks.default_port_name,
+                        port=self._port_from_spec(spec),
+                    )
+                ],
+            ),
+        )
+        service.metadata.name = gen_general_name(job.name, rtype, index)
+        service.metadata.namespace = job.namespace
+        service.metadata.labels = dict(service.metadata.labels)
+        service.metadata.labels.update(labels)
+        return service
 
     def _port_from_spec(self, spec: ReplicaSpec) -> int:
         for container in spec.template.spec.containers:
@@ -1834,7 +2009,7 @@ class JobController:
                 | set(deleted_uids)
             )
         for svc in self.get_services_for_job(job):
-            self.service_control.delete_service(svc.metadata.namespace, svc.metadata.name, job)
+            self._delete_service(job, svc)
         self._delete_heartbeat_leases(job, replicas, run_policy)
         if self.options.enable_gang_scheduling:
             self._delete_gang_groups(job, replicas, run_policy)

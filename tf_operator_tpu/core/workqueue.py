@@ -13,7 +13,7 @@ from __future__ import annotations
 import heapq
 import threading
 import time
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 
 class WorkQueue:
@@ -31,6 +31,15 @@ class WorkQueue:
         self._seq = 0
         self._failures: Dict[str, int] = {}
         self._shutdown = False
+        # item -> clock() at the moment it entered the immediate queue;
+        # drained in get() to measure queue wait (client-go's
+        # workqueue_queue_duration_seconds analog). Delayed items start
+        # their wait when they come DUE, not when scheduled — an
+        # ActiveDeadline resync parked for an hour is not "waiting".
+        self._added_at: Dict[str, float] = {}
+        # Observer hook (set by the controller): fn(item, wait_seconds)
+        # called after each successful get(), outside the queue lock.
+        self.on_wait: Optional[Callable[[str, float], None]] = None
 
     def add(self, item: str) -> None:
         with self._cond:
@@ -40,6 +49,7 @@ class WorkQueue:
                 self._dirty.add(item)
                 return
             self._queued.add(item)
+            self._added_at[item] = self._clock()
             self._queue.append(item)
             self._cond.notify()
 
@@ -80,6 +90,7 @@ class WorkQueue:
             _, _, item = heapq.heappop(self._delayed)
             if item not in self._queued and item not in self._processing:
                 self._queued.add(item)
+                self._added_at[item] = now
                 self._queue.append(item)
             elif item in self._processing:
                 self._dirty.add(item)
@@ -89,8 +100,10 @@ class WorkQueue:
         """Pop the next item, blocking up to timeout. Returns None on timeout
         or shutdown. The caller MUST call done(item) afterwards."""
         deadline = None if timeout is None else self._clock() + timeout
+        item = None
+        waited = 0.0
         with self._cond:
-            while True:
+            while item is None:
                 if self._shutdown:
                     return None
                 next_delay = self._drain_delayed_locked()
@@ -98,7 +111,9 @@ class WorkQueue:
                     item = self._queue.pop(0)
                     self._queued.discard(item)
                     self._processing.add(item)
-                    return item
+                    now = self._clock()
+                    waited = now - self._added_at.pop(item, now)
+                    break
                 wait = next_delay
                 if deadline is not None:
                     remaining = deadline - self._clock()
@@ -106,6 +121,15 @@ class WorkQueue:
                         return None
                     wait = remaining if wait is None else min(wait, remaining)
                 self._cond.wait(wait if wait is not None else 1.0)
+        observer = self.on_wait
+        if observer is not None:
+            try:
+                # Outside the lock: the observer writes metrics (its own
+                # lock) and must never wedge or reenter the queue.
+                observer(item, max(0.0, waited))
+            except Exception:  # noqa: BLE001 — observability never blocks work
+                pass
+        return item
 
     def done(self, item: str) -> None:
         with self._cond:
@@ -114,6 +138,7 @@ class WorkQueue:
                 self._dirty.discard(item)
                 if item not in self._queued:
                     self._queued.add(item)
+                    self._added_at[item] = self._clock()
                     self._queue.append(item)
                     self._cond.notify()
 

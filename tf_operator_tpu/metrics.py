@@ -85,6 +85,23 @@ class Metrics:
             "an error-requeue storm: jobs burning backoff delays instead "
             "of converging",
         ),
+        "training_operator_fanout_batches_total": (
+            ("framework", "resource"),
+            "Slow-start fan-out waves issued (core/control.py "
+            "slow_start_batch; resource = pods|services). Parallel "
+            "batches double 1->2->4->..., so ~log2(gang size) waves per "
+            "fan-out; a serialized fan-out (chaos seam or "
+            "--disable-parallel-fanout) counts exactly one wave per "
+            "fan-out regardless of gang size",
+        ),
+        "training_operator_fanout_batch_aborts_total": (
+            ("framework", "resource"),
+            "Fan-outs aborted by a write error before completing (first-"
+            "error abort: a broken pod template costs one apiserver call, "
+            "not gang-size of them). Each abort rolled back the "
+            "unobserved remainder of its expectation batch and requeued "
+            "rate-limited",
+        ),
     }
     # Gauges with label sets: name -> (label names, help). Values live in
     # _labeled_gauges keyed by the label-value tuple, in label-name order.
@@ -96,12 +113,24 @@ class Metrics:
             "for jobs with runPolicy.progressDeadlineSeconds set). Crossing "
             "the deadline drives a ProgressStall gang restart",
         ),
+        "training_operator_workqueue_depth": (
+            ("framework",),
+            "Items waiting in the controller's immediate workqueue "
+            "(client-go workqueue_depth analog; sampled on every worker "
+            "get). Sustained depth means the workers cannot keep up with "
+            "the event rate — scale --threadiness or raise --qps",
+        ),
     }
     _HISTOGRAM_BUCKETS = (0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600)
     # Reconciles are ms-scale; startup/restart are seconds-scale.
     _BUCKETS_BY_NAME = {
         "training_operator_reconcile_duration_seconds": (
             0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 5,
+        ),
+        # Queue waits are ms-scale when healthy and explode toward the
+        # resync period when the workers fall behind.
+        "training_operator_queue_wait_seconds": (
+            0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30, 60,
         ),
     }
 
@@ -130,6 +159,11 @@ class Metrics:
                 # Per-sync latency (the reference logs "Finished syncing
                 # tfjob %q (%v)", controller.go:306; here a histogram).
                 "training_operator_reconcile_duration_seconds",
+                # Enqueue -> worker-pop wait (client-go
+                # workqueue_queue_duration_seconds analog). No namespace
+                # dimension (a queue serves every namespace): series are
+                # keyed ("", framework).
+                "training_operator_queue_wait_seconds",
             )
         }
         # Unlabeled gauges: leader flag etc. (legacy tf_operator_is_leader,
@@ -185,6 +219,37 @@ class Metrics:
         self._inc_labeled(
             "training_operator_sync_errors_total", framework, exception,
         )
+
+    def fanout_batch_inc(self, framework: str, resource: str) -> None:
+        """One slow-start fan-out wave issued (resource = pods|services)."""
+        self._inc_labeled(
+            "training_operator_fanout_batches_total", framework, resource,
+        )
+
+    def fanout_abort_inc(self, framework: str, resource: str) -> None:
+        """One fan-out aborted on its first write error."""
+        self._inc_labeled(
+            "training_operator_fanout_batch_aborts_total", framework, resource,
+        )
+
+    def set_workqueue_depth(self, framework: str, depth: int) -> None:
+        with self._lock:
+            self._labeled_gauges["training_operator_workqueue_depth"][
+                (framework,)
+            ] = float(depth)
+
+    def workqueue_depth_value(self, framework: str) -> Optional[float]:
+        with self._lock:
+            return self._labeled_gauges["training_operator_workqueue_depth"].get(
+                (framework,)
+            )
+
+    def observe_queue_wait(self, framework: str, seconds: float) -> None:
+        """One item's enqueue -> worker-pop wait."""
+        with self._lock:
+            self._histograms["training_operator_queue_wait_seconds"][
+                ("", framework)
+            ].observe(seconds)
 
     def set_heartbeat_age(self, namespace: str, framework: str,
                           job_name: str, seconds: float) -> None:
@@ -252,6 +317,30 @@ class Metrics:
         exposition path uses the streaming aggregates."""
         with self._lock:
             return list(self._histograms[name][(namespace, framework)].recent)
+
+    def histogram_quantile(self, name: str, namespace: str, framework: str,
+                           q: float) -> Optional[float]:
+        """Nearest-bucket upper-bound quantile from the STREAMING bucket
+        counts — unlike histogram_values, not biased by the bounded
+        recent-window (a long run's early observations stay counted).
+        Returns None with no observations; a quantile landing in the
+        +Inf bucket reports the largest recent raw value as a best-effort
+        cap."""
+        import math
+
+        with self._lock:
+            hist = self._histograms[name].get((namespace, framework))
+            if hist is None or hist.count == 0:
+                return None
+            rank = max(1, math.ceil(q * hist.count))
+            running = 0
+            for bound, count in zip(hist.bounds, hist.counts):
+                running += count
+                if running >= rank:
+                    return float(bound)
+            return float(max(hist.recent)) if hist.recent else float(
+                hist.bounds[-1]
+            )
 
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
