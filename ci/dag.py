@@ -237,6 +237,15 @@ def default_dag() -> List[Step]:
         # aggressive resync; retried because timing-sensitive by nature.
         Step("concurrency-stress", pytest + ["tests/test_concurrency_stress.py"],
              deps=["operator-integration"], retries=2),
+        # Sync-worker-pool tier (concurrent reconciliation,
+        # docs/design/control_plane_performance.md): many jobs × N workers
+        # on a latency-charged cluster through the shared invariant
+        # checker, workers quiescing on leadership loss, the busy-worker
+        # gauge, and — the determinism half — the chaos seam pinning the
+        # pool to 1 with byte-equal same-seed fault logs.
+        Step("multiworker-stress", pytest + ["tests/test_multiworker_stress.py",
+                                             "tests/test_workqueue.py"],
+             deps=["operator-integration"], retries=2),
         # Slow-start fan-out tier (docs/design/control_plane_performance.md):
         # batch semantics, FIFO bucket fairness, the service-deletion
         # expectation protocol, and — the hard constraint — chaos/crash
@@ -250,8 +259,10 @@ def default_dag() -> List[Step]:
         # the serial baseline at the same qps/burst. Fails if parallel
         # stops beating serial or the startup-p50 speedup (the
         # load-normalized run-over-run gate) regresses >2x
-        # (build/scale_smoke_last.json); retried like the other
-        # timing-sensitive tiers.
+        # (build/scale_smoke_last.json); also gates concurrent
+        # reconciliation — a 4-worker pool must beat 1 worker on p50
+        # queue wait and makespan on a queue-wait-bound 24-job load.
+        # Retried like the other timing-sensitive tiers.
         Step("scale-smoke",
              [PY, "scripts/measure_control_plane.py", "--mode", "scale",
               "--smoke"],

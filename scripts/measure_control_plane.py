@@ -17,11 +17,14 @@ gang sizes (8/32/128 replicas at 1 job) and job counts (1/20/100 jobs of
 the serial baseline (--disable-parallel-fanout lever) at the same
 qps/burst. A per-write latency proxy (cluster/throttled.py LatencyCluster)
 stands in for the apiserver round trip — with free in-memory writes,
-serial and parallel are indistinguishable. `--smoke` runs only the
-32-replica gang (CI tier: fails if parallel doesn't beat serial, or if
-the startup-p50 speedup — the load-normalized run-over-run gate —
-regressed >2x against the previous run stored in
-build/scale_smoke_last.json).
+serial and parallel are indistinguishable. `--workers 1,2,4,8` sweeps
+the same grid over sync-worker pool sizes instead (fan-out always on):
+the 100-job combos are queue-wait-bound, so p50 queue wait and makespan
+must fall near-linearly with the pool. `--smoke` runs the 32-replica
+gang (CI tier: fails if parallel doesn't beat serial, or if the
+startup-p50 speedup — the load-normalized run-over-run gate — regressed
+>2x against the previous run stored in build/scale_smoke_last.json)
+plus the multi-vs-single worker gate on a queue-wait-bound 24-job load.
 
 Both modes print one JSON object as the LAST line (the bench.py
 contract), so the trajectory is comparable across PRs.
@@ -210,10 +213,12 @@ SMOKE_SPEEDUP_CAP = 5.0
 
 
 def _measure_gang_bringup(gang, jobs, parallel, qps, burst, latency,
-                          threadiness=4, timeout=120.0):
-    """One bring-up measurement: `jobs` TFJobs of `gang` workers against
-    a latency-charged InMemoryCluster; returns per-job startup seconds
-    (create -> every replica Running) and the run's queue-wait p50."""
+                          workers=4, timeout=120.0):
+    """One bring-up measurement: `jobs` TFJobs of `gang` replicas against
+    a latency-charged InMemoryCluster; returns (per-job startup seconds
+    (create -> every replica Running), the run's queue-wait p50, and the
+    makespan: first create -> last job fully Running). `workers` is the
+    sync-worker pool size (--workers / MaxConcurrentReconciles)."""
     import threading
 
     from tf_operator_tpu.cluster.memory import InMemoryCluster
@@ -253,15 +258,17 @@ def _measure_gang_bringup(gang, jobs, parallel, qps, burst, latency,
         LatencyCluster(mem, latency),
         OperatorOptions(
             enabled_schemes=["TFJob"], health_port=0, metrics_port=0,
-            threadiness=threadiness, resync_period=5.0,
+            threadiness=workers, resync_period=5.0,
             qps=qps, burst=burst, parallel_fanout=parallel,
         ),
         metrics=metrics,
     )
     manager.start()
     startups = []
+    makespan = 0.0
     try:
         created = []
+        t_sweep = time.monotonic()
         for i in range(jobs):
             name = f"g{i}"
             created.append((name, time.monotonic()))
@@ -278,6 +285,8 @@ def _measure_gang_bringup(gang, jobs, parallel, qps, burst, latency,
             for name in [n for n, _ in created if n in pending]:
                 if running.get(name, 0) >= gang:
                     startups.append(now - pending.pop(name))
+            if not pending:
+                makespan = now - t_sweep
             # Coarse poll: list_pods deep-copies every pod, and a tight
             # poll loop's GIL churn would bleed into the measurement.
             time.sleep(0.01)
@@ -285,7 +294,7 @@ def _measure_gang_bringup(gang, jobs, parallel, qps, burst, latency,
             raise SystemExit(
                 f"scale: {len(pending)} job(s) of {gang} replicas never "
                 f"came up within {timeout}s (fanout="
-                f"{'parallel' if parallel else 'serial'})"
+                f"{'parallel' if parallel else 'serial'}, workers={workers})"
             )
         # Streaming bucket quantile, NOT histogram_values: the raw-sample
         # window holds only the last 256 observations, which at 100 jobs
@@ -297,7 +306,75 @@ def _measure_gang_bringup(gang, jobs, parallel, qps, burst, latency,
         stop_kubelet.set()
         manager.stop()
         kubelet.join(timeout=5)
-    return startups, (wait_p50 or 0.0)
+    return startups, (wait_p50 or 0.0), makespan
+
+
+def _measure_workers_leg(gang, jobs, workers, qps, burst, latency):
+    """One leg of the sync-worker sweep: fan-out parallel (the default),
+    only the pool size varies. The timeout scales with the job count —
+    the whole point of the 1-worker leg is that it serializes ~jobs
+    syncs end to end (the representative 100-job leg runs ~115s on the
+    authoring machine), so the default 120s bound would abort the sweep
+    on any slightly slower box."""
+    startups, wait_p50, makespan = _measure_gang_bringup(
+        gang, jobs, True, qps, burst, latency, workers=workers,
+        timeout=max(120.0, 3.0 * jobs))
+    return {
+        "workers": workers,
+        "startup_p50_s": round(_pct(startups, 0.5), 4),
+        "startup_p90_s": round(_pct(startups, 0.9), 4),
+        "queue_wait_p50_s": round(wait_p50, 4),
+        "makespan_s": round(makespan, 4),
+    }
+
+
+def workers_main(workers_list, qps=0.0, burst=0, latency=0.01) -> int:
+    """The sync-worker-pool sweep (--mode scale --workers 1,2,4,8): the
+    existing gang/job grid, fan-out ON everywhere, only --workers varies.
+    PR 4 showed the 100-job combos queue-wait-bound — one worker
+    serializes every job behind one reconcile at a time — so p50 queue
+    wait and makespan must fall near-linearly with the pool until
+    token-bucket qps (or write fan-out overlap) saturates."""
+    combos = [(8, 1), (32, 1), (128, 1), (8, 20), (8, 100)]
+    results = []
+    for gang, jobs in combos:
+        row = {"gang": gang, "jobs": jobs, "by_workers": []}
+        for workers in workers_list:
+            leg = _measure_workers_leg(gang, jobs, workers, qps, burst, latency)
+            row["by_workers"].append(leg)
+        base = next(
+            (l for l in row["by_workers"] if l["workers"] == 1),
+            row["by_workers"][0],
+        )
+        best = min(row["by_workers"], key=lambda l: l["makespan_s"])
+        row["makespan_speedup_best"] = round(
+            base["makespan_s"] / max(best["makespan_s"], 1e-9), 2)
+        row["queue_wait_reduction_best"] = round(
+            base["queue_wait_p50_s"]
+            / max(min(l["queue_wait_p50_s"] for l in row["by_workers"]), 1e-9),
+            2,
+        )
+        results.append(row)
+    print(json.dumps({
+        "mode": "scale-workers",
+        "backend": "memory+latency",
+        "latency_s": latency,
+        "qps": qps,
+        "burst": burst,
+        "workers": list(workers_list),
+        "combos": results,
+    }))
+    return 0
+
+
+# Smoke-tier worker gate: a deliberately queue-wait-bound load (many small
+# jobs — the PR 4 scale sweep's 100-job regime scaled down for CI time)
+# where a multi-worker pool must beat one worker on p50 queue wait AND
+# makespan, or concurrent reconciliation has silently stopped working
+# (e.g. a capability flag regression pinning every pool to 1).
+SMOKE_WORKER_GANG = 8
+SMOKE_WORKER_JOBS = 24
+SMOKE_WORKER_POOL = 4
 
 
 def scale_main(smoke=False, qps=0.0, burst=0, latency=0.01) -> int:
@@ -314,7 +391,7 @@ def scale_main(smoke=False, qps=0.0, burst=0, latency=0.01) -> int:
             trials = 3 if smoke or jobs == 1 else 1
             samples, waits = [], []
             for _ in range(trials):
-                startups, wait_p50 = _measure_gang_bringup(
+                startups, wait_p50, _makespan = _measure_gang_bringup(
                     gang, jobs, parallel, qps, burst, latency)
                 samples.extend(startups)
                 waits.append(wait_p50)
@@ -341,7 +418,9 @@ def scale_main(smoke=False, qps=0.0, burst=0, latency=0.01) -> int:
     rc = 0
     if smoke:
         row = results[0]
-        out["regression"] = None
+        # Every failed gate is recorded — a red run with two independent
+        # regressions must surface both, not whichever wrote last.
+        regressions = []
         # Loose run-over-run gate on the 32-replica gang's startup p50,
         # in its load-normalized form: both modes run in the same
         # process under the same co-load, so the parallel/serial ratio
@@ -356,17 +435,44 @@ def scale_main(smoke=False, qps=0.0, burst=0, latency=0.01) -> int:
             except Exception:  # noqa: BLE001 — corrupt baseline: rewrite it
                 prev = None
             if prev and row["speedup_p50"] < prev / 2.0:
-                out["regression"] = (
+                regressions.append(
                     f"startup p50 speedup {row['speedup_p50']}x regressed "
                     f">2x vs previous run ({prev}x)"
                 )
-                rc = 1
         if row["speedup_p50"] < 1.0:
-            out["regression"] = (
+            regressions.append(
                 f"parallel fan-out slower than serial "
                 f"(speedup {row['speedup_p50']}x)"
             )
-            rc = 1
+        # Concurrent-reconciliation gate: on the queue-wait-bound load the
+        # worker pool must visibly beat one worker. Makespan is the
+        # primary discriminator (continuous, 10% margin; both legs share
+        # the process so co-load cancels, like the speedup gate above).
+        # Queue-wait p50s are streaming-BUCKET upper bounds with 2-3x
+        # spacing, so the pool regression check tolerates a same-bucket
+        # tie — only strictly WORSE fails; demanding a strict win there
+        # would go red whenever throttling compresses both legs into one
+        # bucket with no code change at all.
+        single = _measure_workers_leg(
+            SMOKE_WORKER_GANG, SMOKE_WORKER_JOBS, 1, qps, burst, latency)
+        multi = _measure_workers_leg(
+            SMOKE_WORKER_GANG, SMOKE_WORKER_JOBS, SMOKE_WORKER_POOL,
+            qps, burst, latency)
+        out["workers_gate"] = {"single": single, "multi": multi}
+        if multi["queue_wait_p50_s"] > single["queue_wait_p50_s"]:
+            regressions.append(
+                f"{SMOKE_WORKER_POOL} sync workers WORSE than 1 on p50 "
+                f"queue wait ({multi['queue_wait_p50_s']}s vs "
+                f"{single['queue_wait_p50_s']}s)"
+            )
+        if multi["makespan_s"] >= 0.9 * single["makespan_s"]:
+            regressions.append(
+                f"{SMOKE_WORKER_POOL} sync workers did not beat 1 on "
+                f"makespan ({multi['makespan_s']}s vs "
+                f"{single['makespan_s']}s)"
+            )
+        out["regression"] = "; ".join(regressions) or None
+        rc = 1 if regressions else 0
         if rc == 0:
             os.makedirs(os.path.dirname(SMOKE_BASELINE_PATH), exist_ok=True)
             with open(SMOKE_BASELINE_PATH, "w") as f:
@@ -388,13 +494,31 @@ if __name__ == "__main__":
     parser.add_argument("--mode", choices=("latency", "scale"),
                         default="latency")
     parser.add_argument("--smoke", action="store_true",
-                        help="scale mode: fast 32-replica-gang CI check")
+                        help="scale mode: fast CI check (32-replica-gang "
+                        "fan-out gate + the multi-vs-single sync-worker "
+                        "gate on a queue-wait-bound load)")
+    parser.add_argument("--workers", default="",
+                        help="scale mode: comma-separated sync-worker pool "
+                        "sizes (e.g. 1,2,4,8) — sweeps the gang/job grid "
+                        "over --workers instead of parallel-vs-serial")
     parser.add_argument("--qps", type=float, default=0.0)
     parser.add_argument("--burst", type=int, default=0)
     parser.add_argument("--write-latency", type=float, default=0.01,
                         help="scale mode: injected per-write apiserver "
                         "round-trip stand-in (seconds)")
     args = parser.parse_args()
+    if args.smoke and args.workers:
+        # Silently routing to the sweep would drop every CI gate.
+        parser.error("--smoke and --workers are mutually exclusive: the "
+                     "smoke tier has its own fixed worker gate")
+    if args.workers and args.mode != "scale":
+        # Dropping the flag would hand back a plausible-looking JSON
+        # object for the wrong experiment.
+        parser.error("--workers requires --mode scale")
+    if args.mode == "scale" and args.workers:
+        sys.exit(workers_main(
+            [int(w) for w in args.workers.split(",") if w.strip()],
+            qps=args.qps, burst=args.burst, latency=args.write_latency))
     if args.mode == "scale":
         sys.exit(scale_main(smoke=args.smoke, qps=args.qps,
                             burst=args.burst, latency=args.write_latency))

@@ -321,3 +321,104 @@ def test_packaging_console_script_resolves():
     assert isinstance(getattr(importlib.import_module(pkg), name), str)
     # The native dataloader source must travel with the wheel.
     assert "*.cc" in data["tool"]["setuptools"]["package-data"]["tf_operator_tpu.native"]
+
+
+class TestSyncWorkerPool:
+    """--workers (MaxConcurrentReconciles): flag plumbing, capability
+    gating, and the periodic-resync jitter that keeps a pool-sized herd
+    from landing on the queue at the same instant every period."""
+
+    def test_workers_flag_and_threadiness_alias(self):
+        opts = options_from_args(build_arg_parser().parse_args(["--workers", "6"]))
+        assert opts.threadiness == 6
+        # Deprecated alias still parses to the same field.
+        opts = options_from_args(build_arg_parser().parse_args(["--threadiness", "2"]))
+        assert opts.threadiness == 2
+        # Concurrent by default (one worker serialized the namespace).
+        assert options_from_args(build_arg_parser().parse_args([])).threadiness > 1
+
+    def test_pool_sized_by_capability(self):
+        from tf_operator_tpu.cluster.process import LocalProcessCluster
+
+        mgr = OperatorManager(
+            InMemoryCluster(),
+            OperatorOptions(enabled_schemes=["JAXJob"], threadiness=5,
+                            health_port=0, metrics_port=0),
+            metrics=Metrics(),
+        )
+        assert mgr.sync_workers == {"JAXJob": 5}
+        proc = LocalProcessCluster()
+        try:
+            mgr = OperatorManager(
+                proc,
+                OperatorOptions(enabled_schemes=["JAXJob"], threadiness=5,
+                                health_port=0, metrics_port=0),
+                metrics=Metrics(),
+            )
+            # The process seam cannot take concurrent syncs: pinned to 1.
+            assert mgr.sync_workers == {"JAXJob": 1}
+        finally:
+            proc.shutdown()
+
+    def test_start_spawns_one_thread_per_worker(self):
+        import threading as _threading
+
+        mgr = OperatorManager(
+            InMemoryCluster(),
+            OperatorOptions(enabled_schemes=["JAXJob"], threadiness=3,
+                            health_port=0, metrics_port=0, resync_period=60),
+            metrics=Metrics(),
+        )
+        mgr.start()
+        try:
+            names = [t.name for t in _threading.enumerate()]
+            assert sum(1 for n in names if n.startswith("sync-JAXJob-")) == 3
+        finally:
+            mgr.stop()
+
+    def test_resync_jitter_spreads_the_herd(self):
+        """Periodic resyncs must not enqueue every live job at the same
+        instant: with a jitter window each key lands at its own
+        deterministic delay (no `random` — a replay spreads identically)."""
+        from tf_operator_tpu.cli import resync_jitter_seconds
+        from tf_operator_tpu.core.workqueue import WorkQueue
+
+        cluster = InMemoryCluster()
+        mgr = OperatorManager(
+            cluster,
+            OperatorOptions(enabled_schemes=["JAXJob"], health_port=0,
+                            metrics_port=0),
+            metrics=Metrics(),
+        )
+        for i in range(12):
+            cluster.create_job(jaxjob_manifest(name=f"j{i}"))
+
+        class Now:
+            value = 0.0
+        queue = WorkQueue(clock=lambda: Now.value)
+        mgr.controllers["JAXJob"].queue = queue
+
+        mgr.resync_once(jitter_window=10.0)
+        depth = queue.depth()
+        # Spread: the herd sits in the delayed heap, not the immediate
+        # queue (a key hashing to ~0 delay may legitimately be immediate).
+        assert depth["delayed"] >= 10, depth
+        delays = sorted(when for when, _, _ in queue._delayed)
+        assert len(set(delays)) >= 10, "jitter must differ per key"
+        assert all(0.0 <= d < 10.0 for d in delays)
+        # Deterministic: the same keys spread to the same delays.
+        expected = sorted(
+            resync_jitter_seconds(f"JAXJob:default/j{i}", 10.0)
+            for i in range(12)
+            if resync_jitter_seconds(f"JAXJob:default/j{i}", 10.0) > 0
+        )
+        assert delays == expected
+
+        # The cold-start path (window 0) stays immediate: convergence on
+        # boot must not wait out a jitter.
+        queue2 = WorkQueue(clock=lambda: Now.value)
+        mgr.controllers["JAXJob"].queue = queue2
+        mgr.resync_once()
+        assert queue2.depth() == {
+            "queued": 12, "processing": 0, "delayed": 0, "failing": 0,
+        }

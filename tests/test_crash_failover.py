@@ -469,7 +469,7 @@ class TestSyncErrorVisibility:
         controller.queue.add("TFJob:default/x")
         assert controller.process_next(timeout=0.1)
         assert metrics.labeled_counter_value(
-            "training_operator_sync_errors_total", "TFJob", "RuntimeError",
+            "training_operator_sync_errors_total", "default", "TFJob", "RuntimeError",
         ) == 1
         # The recovery mechanism is unchanged: the item is requeued
         # rate-limited, not dropped.
@@ -494,7 +494,7 @@ class TestSyncErrorVisibility:
         # Swallowed cleanly: no sync errors counted, nothing stuck in the
         # rate-limited failure set.
         assert metrics.labeled_counter_value(
-            "training_operator_sync_errors_total", "JAXJob", "Conflict",
+            "training_operator_sync_errors_total", "default", "JAXJob", "Conflict",
         ) == 0
         assert controller.queue.depth()["failing"] == 0
         # And once the conflicts stop (chaos over), the Failed condition

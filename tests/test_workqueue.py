@@ -1,0 +1,101 @@
+"""WorkQueue unit coverage for the deque-backed immediate queue.
+
+The immediate queue used to be a plain list popped at index 0 — O(n) per
+pop, paid by every worker of the sync pool on every get once backlogs
+grow. The deque swap must not change any visible semantics: strict FIFO
+order, while-queued dedup, the dirty re-queue for items enqueued while
+processing, and delayed items joining at their due time.
+"""
+
+from tf_operator_tpu.core.workqueue import WorkQueue
+
+
+class FakeClock:
+    def __init__(self, now=0.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+
+def drain(q, limit=100):
+    out = []
+    for _ in range(limit):
+        item = q.get(timeout=0)
+        if item is None:
+            break
+        out.append(item)
+        q.done(item)
+    return out
+
+
+class TestFifoOrder:
+    def test_adds_pop_in_fifo_order(self):
+        q = WorkQueue(clock=FakeClock())
+        for item in ("a", "b", "c", "d", "e"):
+            q.add(item)
+        assert drain(q) == ["a", "b", "c", "d", "e"]
+
+    def test_dedup_keeps_first_position(self):
+        """Re-adding a queued item neither duplicates it nor moves it to
+        the back (client-go set-queue semantics)."""
+        q = WorkQueue(clock=FakeClock())
+        q.add("a")
+        q.add("b")
+        q.add("a")  # dedup: "a" stays at the head
+        q.add("c")
+        assert drain(q) == ["a", "b", "c"]
+
+    def test_dirty_requeue_preserves_order_behind_existing(self):
+        """An item re-added while processing goes dirty and re-queues on
+        done() — behind items that were already waiting."""
+        q = WorkQueue(clock=FakeClock())
+        q.add("a")
+        q.add("b")
+        item = q.get(timeout=0)
+        assert item == "a"
+        q.add("a")  # processing -> dirty, not queued
+        assert len(q) == 1  # only "b" waits
+        q.done("a")  # dirty "a" re-queues behind "b"
+        assert drain(q) == ["b", "a"]
+
+    def test_delayed_items_join_at_due_time_in_due_order(self):
+        clock = FakeClock()
+        q = WorkQueue(clock=clock)
+        q.add_after("late", 10.0)
+        q.add_after("early", 5.0)
+        q.add("now")
+        assert q.get(timeout=0) == "now"
+        q.done("now")
+        assert q.get(timeout=0) is None  # nothing due yet
+        clock.now = 6.0
+        assert q.get(timeout=0) == "early"
+        q.done("early")
+        clock.now = 11.0
+        assert q.get(timeout=0) == "late"
+        q.done("late")
+
+    def test_interleaved_adds_and_pops_stay_fifo(self):
+        q = WorkQueue(clock=FakeClock())
+        q.add("a")
+        q.add("b")
+        assert q.get(timeout=0) == "a"
+        q.add("c")
+        q.done("a")
+        assert q.get(timeout=0) == "b"
+        q.done("b")
+        q.add("d")
+        assert q.get(timeout=0) == "c"
+        q.done("c")
+        assert q.get(timeout=0) == "d"
+        q.done("d")
+
+    def test_depth_and_len_track_the_deque(self):
+        q = WorkQueue(clock=FakeClock())
+        for item in ("a", "b", "c"):
+            q.add(item)
+        assert len(q) == 3
+        assert q.depth()["queued"] == 3
+        assert q.get(timeout=0) == "a"
+        assert len(q) == 2
+        assert q.depth()["processing"] == 1

@@ -14,6 +14,7 @@ Run: ``python -m tf_operator_tpu --enable-scheme JAXJob --namespace train``.
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import logging
 import os
@@ -31,6 +32,23 @@ from .metrics import METRICS, Metrics
 
 log = logging.getLogger("tf_operator_tpu.operator")
 
+# Periodic resync jitter window is half the resync period, capped: with a
+# multi-hour production resync the herd is already rare, and a >10s spread
+# would visibly delay the dropped-watch-event safety net.
+RESYNC_JITTER_CAP = 10.0
+
+
+def resync_jitter_seconds(item: str, window: float) -> float:
+    """Deterministic per-key delay in [0, window) for periodic resync
+    enqueues: a hash of the queue item, not `random`, so two runs (and a
+    seeded replay harness) spread the same jobs identically. Keys are
+    stable across rounds, which is what matters — the herd is the
+    same-instant alignment WITHIN a round, not correlation across rounds."""
+    if window <= 0:
+        return 0.0
+    digest = hashlib.sha256(item.encode()).digest()
+    return window * (int.from_bytes(digest[:8], "big") / 2**64)
+
 
 # ------------------------------------------------------------------ options
 
@@ -42,7 +60,14 @@ class OperatorOptions:
 
     enabled_schemes: List[str] = field(default_factory=list)  # empty = all
     namespace: str = ""  # empty = all namespaces
-    threadiness: int = 1
+    # Sync workers per controller (--workers; client-go
+    # MaxConcurrentReconciles, the legacy server's --threadiness). The
+    # default is concurrent: one worker per kind serialized every job in
+    # the namespace behind one reconcile at a time, and the scale
+    # benchmark showed queue wait — not write latency — dominating at 100
+    # jobs. Fault-injection seams (chaos/process) force 1 regardless via
+    # supports_concurrent_syncs, so determinism tiers never see a pool.
+    threadiness: int = 4
     resync_period: float = 30.0
     bind_address: str = "0.0.0.0"  # kubelet probes reach the pod IP, not loopback
     metrics_port: int = 8443
@@ -84,7 +109,15 @@ def build_arg_parser() -> argparse.ArgumentParser:
         default=os.environ.get("KUBEFLOW_NAMESPACE", ""),
         help="Restrict to one namespace (default: $KUBEFLOW_NAMESPACE, else all).",
     )
-    parser.add_argument("--threadiness", type=int, default=1, help="Worker threads per controller.")
+    parser.add_argument(
+        "--workers", "--threadiness", dest="threadiness", type=int, default=4,
+        help="Sync workers per controller (MaxConcurrentReconciles): N "
+        "threads pull from the controller's workqueue, reconciling "
+        "different jobs concurrently while the queue's dirty/processing "
+        "sets keep each job serialized. Backends that cannot tolerate "
+        "concurrent syncs (chaos/process test seams) force 1. "
+        "--threadiness is the deprecated alias.",
+    )
     parser.add_argument("--resync-period", type=float, default=30.0, help="Full relist/resync seconds.")
     parser.add_argument("--bind-address", default="0.0.0.0", help="Address metrics/health servers bind.")
     parser.add_argument("--metrics-port", type=int, default=8443, help="Prometheus /metrics port (0 = off).")
@@ -293,6 +326,7 @@ class OperatorManager:
             burst=self.options.burst,
             parallel_fanout=self.options.parallel_fanout,
             fanout_max_parallelism=self.options.fanout_max_parallelism,
+            sync_workers=self.options.threadiness,
         )
         from .core.control import TokenBucket
 
@@ -306,6 +340,19 @@ class OperatorManager:
                 namespace=self.options.namespace,
                 limiter=shared_limiter,
             )
+        # Effective pool size per kind: the requested --workers ANDed with
+        # the cluster seam's supports_concurrent_syncs capability
+        # (resolve_sync_workers) — the chaos/crash/process determinism
+        # tiers run with the pool "enabled" but forced serial, exactly
+        # like parallel_fanout vs supports_concurrent_writes. Resolved
+        # against each controller's own (possibly throttle-wrapped)
+        # cluster so proxy seams inherit the inner verdict.
+        from .core.job_controller import resolve_sync_workers
+
+        self.sync_workers: Dict[str, int] = {
+            kind: resolve_sync_workers(c.engine.options, c.cluster)
+            for kind, c in self.controllers.items()
+        }
         self._set_leader_gauge()
 
     # ------------------------------------------------------------- status
@@ -339,6 +386,7 @@ class OperatorManager:
             "queues": {
                 kind: c.queue.depth() for kind, c in self.controllers.items()
             },
+            "sync_workers": dict(self.sync_workers),
             "threads": threads,
         }
 
@@ -369,29 +417,48 @@ class OperatorManager:
 
     def _worker_loop(self, kind: str) -> None:
         controller = self.controllers[kind]
+        # The gate re-checks leadership AFTER the blocking queue pop: a
+        # worker parked in get() across a leadership flip must hand its
+        # item back, not sync it (see process_next). Each of the N pool
+        # workers carries the same gate — quiescing is per-worker, not
+        # per-pool.
+        gate = lambda: self._is_leader  # noqa: E731
         while not self._stop.is_set():
             if not self._is_leader:
                 self._stop.wait(0.05)
                 continue
-            controller.process_next(timeout=0.1)
+            controller.process_next(timeout=0.1, gate=gate)
 
     def _resync_loop(self) -> None:
         """Periodic full relist: re-enqueue every job of every enabled kind
         (reference resync period, options.go:24). Also the safety net for
-        dropped watch events."""
+        dropped watch events. Periodic rounds spread their enqueues with
+        deterministic per-key jitter: every live job landing in the queue
+        at the same instant each period created a queue-depth/token-bucket
+        spike exactly `resync_period` apart — a herd the worker pool then
+        burned down in a burst instead of a steady trickle."""
+        window = min(self.options.resync_period * 0.5, RESYNC_JITTER_CAP)
         while not self._stop.is_set():
             self._stop.wait(self.options.resync_period)
             if self._stop.is_set():
                 return
-            self.resync_once()
+            self.resync_once(jitter_window=window)
 
-    def resync_once(self) -> None:
+    def resync_once(self, jitter_window: float = 0.0) -> None:
+        """Relist-and-enqueue every job. jitter_window=0 (the default, and
+        the cold-start call in start()) enqueues immediately; periodic
+        rounds pass a window and each key is delayed by its deterministic
+        hash fraction of it (clock-injected through the WorkQueue — no
+        `random`, so a seeded harness replays the identical schedule)."""
         namespace = self.options.namespace or None
         for kind, controller in self.controllers.items():
             for job in self.cluster.list_jobs(kind, namespace):
                 meta = job.get("metadata", {})
-                controller._enqueue(
-                    meta.get("namespace", "default"), meta.get("name", "")
+                ns = meta.get("namespace", "default")
+                name = meta.get("name", "")
+                controller._enqueue_after(
+                    ns, name,
+                    resync_jitter_seconds(f"{kind}:{ns}/{name}", jitter_window),
                 )
 
     # --------------------------------------------------------- http server
@@ -440,8 +507,11 @@ class OperatorManager:
             thread.start()
             self._threads.append(thread)
         for kind in self.controllers:
-            for _ in range(max(1, self.options.threadiness)):
-                thread = threading.Thread(target=self._worker_loop, args=(kind,), daemon=True)
+            for i in range(self.sync_workers[kind]):
+                thread = threading.Thread(
+                    target=self._worker_loop, args=(kind,), daemon=True,
+                    name=f"sync-{kind}-{i}",
+                )
                 thread.start()
                 self._threads.append(thread)
         thread = threading.Thread(target=self._resync_loop, daemon=True)

@@ -80,6 +80,19 @@ class Cluster:
     # inherit the inner backend's verdict automatically.
     supports_concurrent_writes: bool = False
 
+    # Whether the backend tolerates N sync WORKERS reconciling different
+    # jobs at once (the controller's MaxConcurrentReconciles pool). The
+    # workqueue already guarantees one key is never synced by two workers
+    # simultaneously, so this flag is about the backend only: False (the
+    # conservative default) pins the pool to one worker — required by the
+    # chaos seam (its fault schedule is keyed on per-method call order,
+    # which interleaved syncs of DIFFERENT jobs would scramble) and by
+    # backends whose writes are not thread-safe. Distinct from
+    # supports_concurrent_writes (parallelism WITHIN one sync's fan-out);
+    # the two are gated independently but every seam today answers both
+    # the same way. Proxies inherit via __getattr__, like the write flag.
+    supports_concurrent_syncs: bool = False
+
     # ---- jobs (CR objects, stored as dicts keyed by kind) ----
     def create_job(self, job_dict: dict) -> dict:
         raise NotImplementedError

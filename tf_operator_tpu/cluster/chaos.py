@@ -212,8 +212,12 @@ class ChaosCluster:
     # seam serial makes the engine's slow-start fan-out degrade to
     # strictly-ordered sequential writes, which is exactly what keeps a
     # seeded chaos run byte-reproducible with fan-out enabled
-    # (docs/design/control_plane_performance.md).
+    # (docs/design/control_plane_performance.md). The same argument pins
+    # the sync-worker pool to one worker: interleaved syncs of different
+    # jobs would scramble per-method call indices just as thoroughly as
+    # parallel writes within one sync.
     supports_concurrent_writes = False
+    supports_concurrent_syncs = False
 
     def __init__(self, inner: Cluster, spec: ChaosSpec):
         self._inner = inner

@@ -138,8 +138,10 @@ class _NoDelayHTTPSConnection(http.client.HTTPSConnection):
 class KubeCluster(Cluster):
     # Each thread holds its own keep-alive connection (self._local) and a
     # real apiserver is built for concurrent clients — the whole point of
-    # the parallel fan-out is overlapping these round trips.
+    # the parallel fan-out (and of the sync-worker pool) is overlapping
+    # these round trips.
     supports_concurrent_writes = True
+    supports_concurrent_syncs = True
 
     def __init__(
         self,

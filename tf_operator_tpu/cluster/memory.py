@@ -58,8 +58,10 @@ class _RVCounter:
 class InMemoryCluster(base.Cluster):
     # Every mutation runs under one RLock and the event drainer is
     # designed for concurrent writers (_publish_locked/_drain_events), so
-    # the engine's parallel fan-out is safe here.
+    # the engine's parallel fan-out is safe here — and so are N sync
+    # workers reconciling different jobs concurrently.
     supports_concurrent_writes = True
+    supports_concurrent_syncs = True
 
     def __init__(self, clock=time.time):
         self._lock = threading.RLock()

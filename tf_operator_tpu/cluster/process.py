@@ -87,8 +87,12 @@ class LocalProcessCluster(InMemoryCluster):
     # Pod creates fork real subprocesses and juggle per-pod log file
     # handles outside the store lock; keep the engine's fan-out
     # sequential here (the e2e tier's determinism also leans on stable
-    # launch order for the loopback-alias IP assignment).
+    # launch order for the loopback-alias IP assignment). Same verdict
+    # for the sync-worker pool: it must override the InMemoryCluster
+    # base's True, or the e2e tier would launch subprocesses from
+    # concurrent syncs.
     supports_concurrent_writes = False
+    supports_concurrent_syncs = False
 
     def __init__(
         self,

@@ -78,6 +78,9 @@ class LatencyCluster:
         self.supports_concurrent_writes = getattr(
             inner, "supports_concurrent_writes", False
         )
+        self.supports_concurrent_syncs = getattr(
+            inner, "supports_concurrent_syncs", False
+        )
 
     def __getattr__(self, name):
         attr = getattr(self._inner, name)

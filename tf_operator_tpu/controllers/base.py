@@ -8,6 +8,7 @@ common.JobController and implementing ControllerInterface
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Dict, Optional
 
@@ -115,8 +116,12 @@ class FrameworkController(FrameworkHooks):
         # key -> uid of the last job seen at that key, so the sync-path
         # NotFound cleanup can prune UID-keyed terminal-metrics entries even
         # when the DELETED watch event was missed. Bounded by live jobs:
-        # pruned in _forget.
+        # pruned in _forget. Lock: _note_uid's read-compare-write runs on
+        # every sync WORKER (plus the watch thread via _on_job_event); an
+        # unsynchronized interleave across two keys could lose a
+        # forget_terminal prune. The lock never wraps cluster I/O.
         self._known_uids: Dict[str, str] = {}
+        self._uid_lock = threading.Lock()
         self.engine = JobController(
             hooks=self,
             cluster=self.cluster,
@@ -157,6 +162,16 @@ class FrameworkController(FrameworkHooks):
         # the moment a growing backlog must not freeze the gauge at its
         # last popped value.
         self._sample_queue_depth()
+
+    def _enqueue_after(self, namespace: str, name: str, delay: float) -> None:
+        """Scoped enqueue with a delay (the periodic resync's jitter path);
+        delay<=0 degrades to the immediate _enqueue."""
+        if delay <= 0:
+            self._enqueue(namespace, name)
+            return
+        if self.namespace and namespace != self.namespace:
+            return
+        self.queue.add_after(f"{self.kind}:{namespace}/{name}", delay)
 
     def _on_job_event(self, event_type: str, job_dict: dict) -> None:
         meta = job_dict.get("metadata", {})
@@ -208,10 +223,11 @@ class FrameworkController(FrameworkHooks):
         means the old job was deleted and the name reused — prune the old
         uid's terminal-metrics entries now, since the NotFound sync that
         would have done it can no longer learn the old uid."""
-        old = self._known_uids.get(key)
+        with self._uid_lock:
+            old = self._known_uids.get(key)
+            self._known_uids[key] = uid
         if old and old != uid:
             self.metrics.forget_terminal(self.kind, old)
-        self._known_uids[key] = uid
 
     def _forget(self, key: str, uid: str = "") -> None:
         """Drop every piece of per-job in-memory bookkeeping (expectations,
@@ -223,8 +239,9 @@ class FrameworkController(FrameworkHooks):
         self.engine.forget_job(key)
         namespace, _, name = key.partition("/")
         self.metrics.clear_heartbeat_age(namespace, self.kind, name)
-        uid = uid or self._known_uids.get(key, "")
-        self._known_uids.pop(key, None)
+        with self._uid_lock:
+            uid = uid or self._known_uids.get(key, "")
+            self._known_uids.pop(key, None)
         if uid:
             self.metrics.forget_terminal(self.kind, uid)
 
@@ -453,12 +470,29 @@ class FrameworkController(FrameworkHooks):
             self.metrics.failed_inc_once(job.namespace, self.kind, job.metadata.uid)
 
     # ------------------------------------------------------------ run loop
-    def process_next(self, timeout: float = 0.1) -> bool:
+    def process_next(self, timeout: float = 0.1, gate=None) -> bool:
         """Drain one item; the reference's processNextWorkItem
-        (controller.go:230-286)."""
+        (controller.go:230-286). Safe for N concurrent workers: the
+        queue's processing/dirty sets guarantee no two workers ever hold
+        the same item, so per-job state stays single-threaded while
+        different jobs sync in parallel.
+
+        `gate` (e.g. the manager's leadership flag) is re-checked AFTER
+        the pop: a worker blocked in queue.get() when leadership flips
+        would otherwise sync an item popped seconds into its standby —
+        the checked-then-blocked race that lets a demoted operator write
+        beside the new leader. A gated-out item is handed back unsynced."""
         item = self.queue.get(timeout=timeout)
         if item is None:
             return False
+        if gate is not None and not gate():
+            self.queue.done(item)
+            self.queue.add(item)
+            return False
+        # Busy-worker gauge (client-go workqueue "busy workers" parity):
+        # bracketed around the sync so saturation — every worker inside a
+        # reconcile while the queue grows — is directly observable.
+        self.metrics.busy_workers_inc(self.kind)
         try:
             kind, _, key = item.partition(":")
             if kind != self.kind:
@@ -471,14 +505,18 @@ class FrameworkController(FrameworkHooks):
             # recovery mechanism), but the failure must be VISIBLE: a
             # counter chaos tiers and dashboards can watch for
             # error-requeue storms, plus a log line naming the exception —
-            # previously this swallowed every sync failure silently.
-            self.metrics.sync_error_inc(self.kind, type(err).__name__)
+            # previously this swallowed every sync failure silently. The
+            # namespace label keeps a storm attributable when N workers
+            # surface interleaved failures from different tenants.
+            namespace = item.partition(":")[2].partition("/")[0]
+            self.metrics.sync_error_inc(namespace, self.kind, type(err).__name__)
             _log.warning(
                 "sync of %s failed (%s: %s); rate-limited requeue",
                 item, type(err).__name__, err, exc_info=True,
             )
             self.queue.add_rate_limited(item)
         finally:
+            self.metrics.busy_workers_dec(self.kind)
             self.queue.done(item)
         return True
 

@@ -378,6 +378,28 @@ class EngineOptions:
     # off is only needed to measure the serial baseline.
     parallel_fanout: bool = True
     fanout_max_parallelism: int = 16
+    # Sync-worker pool size (client-go MaxConcurrentReconciles): N threads
+    # per controller pulling from the one WorkQueue, whose dirty/processing
+    # sets already guarantee a key is never handed to two workers at once
+    # — cross-JOB concurrency with per-job serialization. Like
+    # parallel_fanout, the requested count is ANDed with the cluster
+    # seam's capability (supports_concurrent_syncs) by
+    # resolve_sync_workers: the chaos/crash/process fault tiers pin the
+    # pool to 1 so every seeded schedule stays byte-reproducible.
+    sync_workers: int = 4
+
+
+def resolve_sync_workers(options: EngineOptions, cluster) -> int:
+    """Effective sync-worker count for one controller over one cluster
+    seam: the requested EngineOptions.sync_workers, forced to 1 when the
+    seam does not declare supports_concurrent_syncs. Single-sourced so
+    the operator manager, benchmarks, and regression tests cannot drift
+    on the gating rule (the mirror of _batch_write's AND with
+    supports_concurrent_writes)."""
+    requested = max(1, int(getattr(options, "sync_workers", 1) or 1))
+    if requested > 1 and not getattr(cluster, "supports_concurrent_syncs", False):
+        return 1
+    return requested
 
 
 class JobController:

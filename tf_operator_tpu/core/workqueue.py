@@ -13,7 +13,8 @@ from __future__ import annotations
 import heapq
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Set, Tuple
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Set, Tuple
 
 
 class WorkQueue:
@@ -23,7 +24,10 @@ class WorkQueue:
     def __init__(self, clock=time.monotonic):
         self._clock = clock
         self._cond = threading.Condition()
-        self._queue: List[str] = []
+        # deque, not list: get() pops the head, and list.pop(0) is O(n) —
+        # at 100 queued jobs every pop shifted the whole backlog, a cost
+        # paid once per sync by every worker of the pool.
+        self._queue: Deque[str] = deque()
         self._queued: Set[str] = set()
         self._processing: Set[str] = set()
         self._dirty: Set[str] = set()
@@ -108,7 +112,7 @@ class WorkQueue:
                     return None
                 next_delay = self._drain_delayed_locked()
                 if self._queue:
-                    item = self._queue.pop(0)
+                    item = self._queue.popleft()
                     self._queued.discard(item)
                     self._processing.add(item)
                     now = self._clock()

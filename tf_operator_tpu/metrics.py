@@ -79,11 +79,13 @@ class Metrics:
             "one means a node stopped acking and a gang was blocked",
         ),
         "training_operator_sync_errors_total": (
-            ("framework", "exception"),
+            ("job_namespace", "framework", "exception"),
             "Reconcile syncs that raised and were rate-limit-requeued "
             "(controllers/base.py process_next). A sustained rate here is "
             "an error-requeue storm: jobs burning backoff delays instead "
-            "of converging",
+            "of converging. Namespace-labeled so a storm surfaced by "
+            "interleaved concurrent workers stays attributable to the "
+            "tenant causing it",
         ),
         "training_operator_fanout_batches_total": (
             ("framework", "resource"),
@@ -118,7 +120,15 @@ class Metrics:
             "Items waiting in the controller's immediate workqueue "
             "(client-go workqueue_depth analog; sampled on every worker "
             "get). Sustained depth means the workers cannot keep up with "
-            "the event rate — scale --threadiness or raise --qps",
+            "the event rate — scale --workers or raise --qps",
+        ),
+        "training_operator_busy_workers": (
+            ("framework",),
+            "Sync workers currently inside a reconcile (client-go "
+            "busy_workers parity). Pinned at the --workers pool size "
+            "while workqueue_depth grows = the pool is saturated; "
+            "persistently 0 with depth growing = workers wedged or "
+            "quiesced (lost leadership)",
         ),
     }
     _HISTOGRAM_BUCKETS = (0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600)
@@ -213,12 +223,28 @@ class Metrics:
             namespace, framework, cause,
         )
 
-    def sync_error_inc(self, framework: str, exception: str) -> None:
+    def sync_error_inc(self, namespace: str, framework: str, exception: str) -> None:
         """One sync that raised out of the reconcile and was requeued
         rate-limited — the signal that was previously swallowed silently."""
         self._inc_labeled(
-            "training_operator_sync_errors_total", framework, exception,
+            "training_operator_sync_errors_total", namespace, framework, exception,
         )
+
+    def busy_workers_inc(self, framework: str) -> None:
+        with self._lock:
+            gauges = self._labeled_gauges["training_operator_busy_workers"]
+            gauges[(framework,)] = gauges.get((framework,), 0.0) + 1.0
+
+    def busy_workers_dec(self, framework: str) -> None:
+        with self._lock:
+            gauges = self._labeled_gauges["training_operator_busy_workers"]
+            gauges[(framework,)] = max(0.0, gauges.get((framework,), 0.0) - 1.0)
+
+    def busy_workers_value(self, framework: str) -> float:
+        with self._lock:
+            return self._labeled_gauges["training_operator_busy_workers"].get(
+                (framework,), 0.0
+            )
 
     def fanout_batch_inc(self, framework: str, resource: str) -> None:
         """One slow-start fan-out wave issued (resource = pods|services)."""

@@ -20,6 +20,13 @@ The chaos proxy (and its per-method call counters) lives on the CLUSTER
 side of the crash, so the fault schedule keeps advancing across
 failovers: a fixed seed replays the identical crash/fault schedule
 byte-for-byte, run to run — the property the crash tier asserts.
+
+Sync concurrency: the driver steps `process_next` from the test thread,
+so it is a one-worker pool by construction no matter what
+`EngineOptions.sync_workers` requests — the same serial verdict the
+chaos seam's `supports_concurrent_syncs=False` forces on a
+manager-hosted pool (`resolve_sync_workers`). Crash schedules therefore
+stay byte-reproducible with the worker pool feature enabled.
 """
 
 from __future__ import annotations
