@@ -267,6 +267,15 @@ def default_dag() -> List[Step]:
              [PY, "scripts/measure_control_plane.py", "--mode", "scale",
               "--smoke"],
              deps=["operator-integration"], retries=3),
+        # Tracing tier (docs/design/tracing.md): deterministic-ID span
+        # timelines + apiserver request accounting — Tracer semantics,
+        # the accounting proxy's 1:1 pass-through, the /tracez and
+        # /readyz handlers, and the acceptance property: a seeded chaos
+        # run on fake clocks replays BOTH fault log and span sequence
+        # byte-identically. The crash/chaos tiers below dump their trace
+        # export into build/ on any invariant failure (post-mortem).
+        Step("tracing", pytest + ["tests/test_tracing.py"],
+             deps=["operator-integration"], retries=2),
         # Seeded chaos tier (docs/design/disruption_handling.md): the
         # controllers under deterministic fault schedules — write
         # conflicts/errors, watch drops, slice-host preemptions — with

@@ -43,6 +43,7 @@ sys.path.insert(0, REPO)
 
 from tf_operator_tpu.cli import OperatorManager, OperatorOptions  # noqa: E402
 from tf_operator_tpu.cluster.process import LocalProcessCluster  # noqa: E402
+from tf_operator_tpu.core.tracing import Tracer  # noqa: E402
 from tf_operator_tpu.metrics import Metrics  # noqa: E402
 
 CHILD_ENV = {"PYTHONPATH": REPO}
@@ -216,9 +217,12 @@ def _measure_gang_bringup(gang, jobs, parallel, qps, burst, latency,
                           workers=4, timeout=120.0):
     """One bring-up measurement: `jobs` TFJobs of `gang` replicas against
     a latency-charged InMemoryCluster; returns (per-job startup seconds
-    (create -> every replica Running), the run's queue-wait p50, and the
-    makespan: first create -> last job fully Running). `workers` is the
-    sync-worker pool size (--workers / MaxConcurrentReconciles)."""
+    (create -> every replica Running), the run's queue-wait p50, the
+    makespan: first create -> last job fully Running, and writes per
+    converged job: tracer-attributed apiserver writes / jobs — the
+    apiserver-load baseline the watch-cache/status-coalescing work must
+    drive down). `workers` is the sync-worker pool size (--workers /
+    MaxConcurrentReconciles)."""
     import threading
 
     from tf_operator_tpu.cluster.memory import InMemoryCluster
@@ -254,6 +258,7 @@ def _measure_gang_bringup(gang, jobs, parallel, qps, burst, latency,
     kubelet = threading.Thread(target=kubelet_pump, daemon=True)
     kubelet.start()
     metrics = Metrics()
+    tracer = Tracer()
     manager = OperatorManager(
         LatencyCluster(mem, latency),
         OperatorOptions(
@@ -262,6 +267,7 @@ def _measure_gang_bringup(gang, jobs, parallel, qps, burst, latency,
             qps=qps, burst=burst, parallel_fanout=parallel,
         ),
         metrics=metrics,
+        tracer=tracer,
     )
     manager.start()
     startups = []
@@ -306,7 +312,12 @@ def _measure_gang_bringup(gang, jobs, parallel, qps, burst, latency,
         stop_kubelet.set()
         manager.stop()
         kubelet.join(timeout=5)
-    return startups, (wait_p50 or 0.0), makespan
+    # Writes per CONVERGED job, from the tracer's per-job attribution
+    # (cluster/accounting.py): every job in the sweep converged (the
+    # pending gate above), so total attributed writes / jobs is the
+    # apiserver write cost one job's bring-up charges the control plane.
+    writes_per_job = round(tracer.total_writes() / max(jobs, 1), 2)
+    return startups, (wait_p50 or 0.0), makespan, writes_per_job
 
 
 def _measure_workers_leg(gang, jobs, workers, qps, burst, latency):
@@ -316,7 +327,7 @@ def _measure_workers_leg(gang, jobs, workers, qps, burst, latency):
     syncs end to end (the representative 100-job leg runs ~115s on the
     authoring machine), so the default 120s bound would abort the sweep
     on any slightly slower box."""
-    startups, wait_p50, makespan = _measure_gang_bringup(
+    startups, wait_p50, makespan, writes_per_job = _measure_gang_bringup(
         gang, jobs, True, qps, burst, latency, workers=workers,
         timeout=max(120.0, 3.0 * jobs))
     return {
@@ -325,6 +336,7 @@ def _measure_workers_leg(gang, jobs, workers, qps, burst, latency):
         "startup_p90_s": round(_pct(startups, 0.9), 4),
         "queue_wait_p50_s": round(wait_p50, 4),
         "makespan_s": round(makespan, 4),
+        "writes_per_converged_job": writes_per_job,
     }
 
 
@@ -389,17 +401,24 @@ def scale_main(smoke=False, qps=0.0, burst=0, latency=0.01) -> int:
         row = {"gang": gang, "jobs": jobs}
         for parallel in (True, False):
             trials = 3 if smoke or jobs == 1 else 1
-            samples, waits = [], []
+            samples, waits, writes = [], [], []
             for _ in range(trials):
-                startups, wait_p50, _makespan = _measure_gang_bringup(
+                startups, wait_p50, _makespan, wpj = _measure_gang_bringup(
                     gang, jobs, parallel, qps, burst, latency)
                 samples.extend(startups)
                 waits.append(wait_p50)
+                writes.append(wpj)
             key = "parallel" if parallel else "serial"
             row[f"startup_p50_s_{key}"] = round(_pct(samples, 0.5), 4)
             row[f"startup_p90_s_{key}"] = round(_pct(samples, 0.9), 4)
             # Median of the per-trial streaming p50s.
             row[f"queue_wait_p50_s_{key}"] = round(_pct(waits, 0.5), 4)
+            # The writes-per-converged-job column (median across trials):
+            # fan-out mode must NOT move it — parallelism reorders writes,
+            # it may not add any — so both columns double as a cheap
+            # write-amplification cross-check.
+            row[f"writes_per_converged_job_{key}"] = round(
+                _pct(writes, 0.5), 2)
         row["speedup_p50"] = round(
             row["startup_p50_s_serial"]
             / max(row["startup_p50_s_parallel"], 1e-9), 2,
@@ -471,6 +490,12 @@ def scale_main(smoke=False, qps=0.0, burst=0, latency=0.01) -> int:
                 f"makespan ({multi['makespan_s']}s vs "
                 f"{single['makespan_s']}s)"
             )
+        # Writes-per-converged-job: REPORT-ONLY (the gate belongs to the
+        # status-write-coalescing PR this number baselines) — surfaced as
+        # its own top-level key and recorded run-over-run so the next PR
+        # has yesterday's number to beat.
+        out["writes_per_converged_job"] = row[
+            "writes_per_converged_job_parallel"]
         out["regression"] = "; ".join(regressions) or None
         rc = 1 if regressions else 0
         if rc == 0:
@@ -479,6 +504,8 @@ def scale_main(smoke=False, qps=0.0, burst=0, latency=0.01) -> int:
                 json.dump({
                     "speedup_p50": min(row["speedup_p50"], SMOKE_SPEEDUP_CAP),
                     "startup_p50_s_parallel": row["startup_p50_s_parallel"],
+                    "writes_per_converged_job": out[
+                        "writes_per_converged_job"],
                 }, f)
     print(json.dumps(out))
     return rc

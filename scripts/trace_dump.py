@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Pretty-print job-lifecycle traces (core/tracing.py exports).
+
+Input is the JSON the operator serves at /tracez (also what
+testing/invariants.py dump_trace writes into build/ on a failed fault
+tier): `{"traces": [...]}`. Renders one causally-ordered timeline per
+trace — span tree indented by parentage, offsets relative to the trace's
+first span, per-job apiserver request/write attribution up top — the
+"what did the operator do to job X, in what order, and what did it cost"
+view the aggregate histograms cannot give.
+
+Usage:
+    python scripts/trace_dump.py build/trace_crash_sweep_seed42.json
+    python scripts/trace_dump.py http://localhost:8443/tracez --job llama
+    curl -s host:8443/tracez | python scripts/trace_dump.py -
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def _fmt_attrs(attrs: dict) -> str:
+    if not attrs:
+        return ""
+    parts = []
+    for key, value in sorted(attrs.items()):
+        if isinstance(value, float):
+            parts.append(f"{key}={value:.3f}")
+        else:
+            parts.append(f"{key}={value}")
+    return " " + " ".join(parts)
+
+
+def _span_depths(spans: List[dict]) -> dict:
+    """span id -> indent depth (ring-buffer trimming may have dropped an
+    ancestor; a missing parent just roots the subtree)."""
+    by_id = {s["id"]: s for s in spans}
+    depths: dict = {}
+
+    def depth(span_id) -> int:
+        if span_id in depths:
+            return depths[span_id]
+        span = by_id.get(span_id)
+        parent = span.get("parent") if span else None
+        d = 0 if parent is None or parent not in by_id else depth(parent) + 1
+        depths[span_id] = d
+        return d
+
+    for s in spans:
+        depth(s["id"])
+    return depths
+
+
+def format_trace(trace: dict) -> str:
+    lines = [
+        f"{trace.get('trace_id', '?')} {trace.get('kind', '?')} "
+        f"{trace.get('namespace', '?')}/{trace.get('job', '?')} "
+        f"uid={trace.get('uid', '') or '-'} writes={trace.get('writes', 0)}"
+    ]
+    requests = trace.get("requests") or []
+    if requests:
+        lines.append("  requests: " + " | ".join(
+            f"{r['verb']} {r['resource']} {r['code']} x{r['count']}"
+            for r in requests
+        ))
+    spans = sorted(trace.get("spans") or [], key=lambda s: s["id"])
+    depths = _span_depths(spans)
+    t0 = min((s["start"] for s in spans if s.get("start") is not None),
+             default=0.0)
+    for span in spans:
+        start = span.get("start")
+        end = span.get("end")
+        offset = f"+{start - t0:8.3f}s" if start is not None else " " * 10
+        if start is not None and end is not None:
+            dur = f"{(end - start) * 1000:9.1f}ms"
+        else:
+            dur = "  open    "
+        indent = "  " * depths.get(span["id"], 0)
+        lines.append(
+            f"  [{offset} {dur}] {indent}{span.get('name', '?')}"
+            f"{_fmt_attrs(span.get('attrs') or {})}"
+        )
+        for event in span.get("events") or []:
+            lines.append(
+                f"  [{' ' * 10} {' ' * 11}] {indent}  * "
+                f"{event.get('name', '?')}{_fmt_attrs(event.get('attrs') or {})}"
+            )
+    return "\n".join(lines)
+
+
+def format_export(export: dict, namespace: Optional[str] = None,
+                  job: Optional[str] = None,
+                  limit: Optional[int] = None) -> str:
+    traces = export.get("traces") or []
+    if namespace:
+        traces = [t for t in traces if t.get("namespace") == namespace]
+    if job:
+        traces = [t for t in traces if t.get("job") == job]
+    if limit is not None and limit >= 0:
+        # -limit slicing alone would turn limit=0 into "everything".
+        traces = traces[-limit:] if limit > 0 else []
+    if not traces:
+        return "(no traces)"
+    return "\n\n".join(format_trace(t) for t in traces)
+
+
+def load(source: str) -> dict:
+    if source == "-":
+        return json.load(sys.stdin)
+    if source.startswith(("http://", "https://")):
+        import urllib.request
+
+        with urllib.request.urlopen(source) as resp:
+            return json.loads(resp.read().decode())
+    with open(source) as f:
+        return json.load(f)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Render /tracez exports as per-job span timelines.")
+    parser.add_argument("source",
+                        help="trace JSON file, /tracez URL, or - for stdin")
+    parser.add_argument("--namespace", default=None)
+    parser.add_argument("--job", default=None)
+    parser.add_argument("--limit", type=int, default=None,
+                        help="newest N traces only")
+    args = parser.parse_args(argv)
+    print(format_export(load(args.source), namespace=args.namespace,
+                        job=args.job, limit=args.limit))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

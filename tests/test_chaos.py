@@ -27,6 +27,7 @@ from tf_operator_tpu.cluster.memory import InMemoryCluster
 from tf_operator_tpu.controllers.jax import JAXController
 from tf_operator_tpu.controllers.tensorflow import TFController
 from tf_operator_tpu.core import expectations as expmod
+from tf_operator_tpu.core.tracing import Tracer
 from tf_operator_tpu.metrics import Metrics
 from tf_operator_tpu.testing.invariants import assert_invariants
 
@@ -122,7 +123,11 @@ def run_slice_preemption(seed):
         drop_watch_kinds=("JAXJob",),  # job events; the resync pump recovers
     ))
     metrics = Metrics()
-    controller = JAXController(chaos, metrics=metrics)
+    # Per-run tracer: assert_invariants(tracer=...) audits the gang
+    # restart's count-before-teardown span ordering and dumps the
+    # trace export into build/ on any violation (post-mortem).
+    tracer = Tracer()
+    controller = JAXController(chaos, metrics=metrics, tracer=tracer)
     # backoffLimit 0: ANY application-classified restart would fail the job
     # instantly — the strongest possible proof the preemption recovery
     # never touches that budget.
@@ -173,6 +178,7 @@ def run_slice_preemption(seed):
         ),
         "inner": inner,
         "controller": controller,
+        "tracer": tracer,
     }
 
 
@@ -210,6 +216,8 @@ class TestSeededSlicePreemption:
                 "restartCounts": {},
                 "stallCounts": {},
             },
+            tracer=out["tracer"],
+            label="chaos_slice_preemption",
         )
         # Terminal hygiene: nothing owned survives the job.
         assert_no_orphans(out["inner"], out["controller"], "JAXJob", "llama")
@@ -394,6 +402,8 @@ class TestRandomizedSweep:
                 "restartCounts": {},
                 "stallCounts": {},
             },
+            tracer=out["tracer"],
+            label="chaos_slice_preemption",
         )
         assert_no_orphans(
             out["inner"], out["controller"], "JAXJob", "llama"

@@ -40,6 +40,7 @@ from tf_operator_tpu.cluster.chaos import (
 from tf_operator_tpu.cluster.memory import InMemoryCluster
 from tf_operator_tpu.controllers.jax import JAXController
 from tf_operator_tpu.controllers.tensorflow import TFController
+from tf_operator_tpu.core.tracing import Tracer
 from tf_operator_tpu.core.workqueue import WorkQueue
 from tf_operator_tpu.metrics import Metrics
 from tf_operator_tpu.testing.failover import FailoverDriver
@@ -95,11 +96,19 @@ def conds_of(cluster, kind, name):
 
 def jax_driver(chaos):
     """FailoverDriver over the chaos proxy: each incarnation is a complete
-    JAXController built from nothing but the cluster."""
+    JAXController built from nothing but the cluster. ONE tracer spans
+    every incarnation (the trace is the post-mortem timeline across
+    failovers); assert_invariants(tracer=driver.tracer) then audits the
+    count-before-teardown span ordering and dumps the trace into build/
+    on any violation."""
+    tracer = Tracer()
     return FailoverDriver(
         chaos,
-        lambda cluster: JAXController(cluster, queue=WorkQueue(), metrics=Metrics()),
+        lambda cluster: JAXController(
+            cluster, queue=WorkQueue(), metrics=Metrics(), tracer=tracer
+        ),
         kinds=("JAXJob",),
+        tracer=tracer,
     )
 
 
@@ -180,7 +189,18 @@ class TestTargetedCrashWindows:
                 "restartCounts": {},
                 "stallCounts": {},
             },
+            tracer=driver.tracer,
+            label=f"crash_counted_write_{before_write}",
         )
+        # The trace must actually witness the protocol (assert_invariants
+        # above already ran the span-order audit): at least one COUNTED
+        # gang-restart span recorded the phase-1 write, so the audit is
+        # structurally green — not green-by-absence.
+        counted = [
+            s for t in driver.tracer.export() for s in t["spans"]
+            if s["name"] == "gang.restart" and s["attrs"].get("counted")
+        ]
+        assert counted, "no counted gang.restart span in the trace"
 
     @pytest.mark.parametrize("before_write", [True, False])
     def test_crash_mid_teardown_exactly_once(self, before_write):
@@ -206,7 +226,8 @@ class TestTargetedCrashWindows:
         assert "restartCounts" not in status
         pods = {p.metadata.name for p in inner.list_pods("default")}
         assert len(pods) == 4
-        assert_invariants(inner, kinds=("JAXJob",))
+        assert_invariants(inner, kinds=("JAXJob",), tracer=driver.tracer,
+                          label=f"crash_mid_teardown_{before_write}")
 
     @pytest.mark.parametrize("before_write", [True, False])
     def test_per_replica_restart_crash_window(self, before_write):
@@ -353,6 +374,7 @@ def run_seeded_crash_sweep(seed, crash_rate=0.04, rounds=400):
         "fault_log": list(chaos.fault_log),
         "status": inner.get_job("JAXJob", "default", "llama").get("status") or {},
         "inner": inner,
+        "tracer": driver.tracer,
     }
 
 
@@ -374,6 +396,8 @@ class TestSeededCrashSweep:
                 "restartCounts": {},
                 "stallCounts": {},
             },
+            tracer=out["tracer"],
+            label="crash_sweep_seed42",
         )
 
     def test_same_seed_replays_identical_crash_schedule(self):
@@ -452,7 +476,8 @@ class TestResizeCrashWindow:
         status = inner.get_job("JAXJob", "default", "llama")["status"]
         assert "disruptionCounts" not in status
         assert "restartCounts" not in status
-        assert_invariants(inner, kinds=("JAXJob",))
+        assert_invariants(inner, kinds=("JAXJob",), tracer=driver.tracer,
+                          label="resize_crash")
 
 
 class TestSyncErrorVisibility:
@@ -519,6 +544,7 @@ class TestRandomizedCrashSweep:
         status = out["status"]
         assert status["disruptionCounts"] == {"Worker": 1}, (seed, status)
         assert "restartCounts" not in status
-        assert_invariants(out["inner"], kinds=("JAXJob",))
+        assert_invariants(out["inner"], kinds=("JAXJob",),
+                          tracer=out["tracer"], label=f"crash_sweep_{seed}")
         again = run_seeded_crash_sweep(seed=2000 + seed)
         assert again["fault_log"] == out["fault_log"], seed

@@ -151,3 +151,116 @@ def test_step_profiler_noop_without_env(monkeypatch):
     monkeypatch.delenv(profiling.ENV_PROFILE_DIR, raising=False)
     for step in range(5):
         profiling.step_profiler(step)  # must not import jax or raise
+
+
+# ------------------------------------------------- exposition + cleanup
+
+
+def test_render_escapes_label_values():
+    """Prometheus text-format escaping (satellite): an exception label
+    carrying backslash/quote/newline — all legal in a Python exception
+    message, and sync_errors_total interpolates them — used to invalidate
+    the whole exposition page."""
+    metrics = Metrics()
+    metrics.sync_error_inc("ns", "TFJob", 'Boom"quote\\slash\nline')
+    body = metrics.render()
+    line = next(
+        l for l in body.splitlines()
+        if l.startswith("training_operator_sync_errors_total{")
+    )
+    assert '\\"quote' in line, "double quote must be escaped"
+    assert "\\\\slash" in line, "backslash must be escaped"
+    assert "\\nline" in line, "newline must be escaped to the 2-char form"
+    assert "\n" not in line  # splitlines already proves it, but explicitly:
+    # Round-trip: the escaped value decodes back to the original.
+    import re
+
+    match = re.search(r'exception="((?:[^"\\]|\\.)*)"', line)
+    assert match
+    decoded = (
+        match.group(1)
+        .replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+    )
+    assert decoded == 'Boom"quote\\slash\nline'
+
+
+def test_render_escapes_namespace_in_plain_counters():
+    metrics = Metrics()
+    metrics.created_inc('we"ird\\ns', "TFJob")
+    body = metrics.render()
+    assert 'job_namespace="we\\"ird\\\\ns"' in body
+
+
+def test_histogram_quantile_inf_bucket_fallback():
+    """A quantile landing in the +Inf bucket (satellite): every
+    observation above the top bound must report the largest recent raw
+    value as a best-effort cap, not None and not a finite bucket edge."""
+    metrics = Metrics()
+    # Top startup bucket is 600s; push everything beyond it.
+    for seconds in (700.0, 900.0, 800.0):
+        metrics.observe_startup("ns", "TFJob", seconds)
+    q = metrics.histogram_quantile(
+        "training_operator_job_startup_seconds", "ns", "TFJob", 0.5)
+    assert q == 900.0
+    # Mixed: rank 1 of {0.9 (le-1 bucket), 700, 900} -> the in-range
+    # path still answers with a bucket upper bound, not the raw cap.
+    metrics2 = Metrics()
+    for seconds in (0.9, 700.0, 900.0):
+        metrics2.observe_startup("ns", "TFJob", seconds)
+    assert metrics2.histogram_quantile(
+        "training_operator_job_startup_seconds", "ns", "TFJob", 0.3) == 1.0
+    # No observations at all: None, not a crash.
+    assert metrics2.histogram_quantile(
+        "training_operator_job_startup_seconds", "other", "TFJob", 0.5) is None
+
+
+def test_heartbeat_age_series_cleared_on_job_deletion():
+    """The gauge-leak class (satellite): a deleted job's heartbeat-age
+    series must leave the exposition page, or churn grows the gauge map
+    (and the staleness alert pages for a ghost) forever."""
+    metrics = Metrics()
+    metrics.set_heartbeat_age("default", "JAXJob", "lat", 12.5)
+    assert metrics.heartbeat_age_value("default", "JAXJob", "lat") == 12.5
+    assert "training_operator_heartbeat_age_seconds{" in metrics.render()
+    metrics.clear_heartbeat_age("default", "JAXJob", "lat")
+    assert metrics.heartbeat_age_value("default", "JAXJob", "lat") is None
+    assert 'job_name="lat"' not in metrics.render()
+    # Clearing an unknown series is a no-op, not a KeyError.
+    metrics.clear_heartbeat_age("default", "JAXJob", "ghost")
+
+
+def test_forget_terminal_prunes_dedup_and_controller_forgets_on_delete():
+    """forget_terminal (satellite): the UID-keyed terminal dedup must be
+    prunable — and a recreated job with a fresh UID counts again — plus
+    the controller end-to-end: a DELETED watch event clears both the
+    dedup entry and the heartbeat gauge via _forget."""
+    metrics = Metrics()
+    metrics.successful_inc_once("ns", "TFJob", "uid-1")
+    metrics.successful_inc_once("ns", "TFJob", "uid-1")  # deduped
+    assert metrics.counter_value(
+        "training_operator_jobs_successful_total", "ns", "TFJob") == 1
+    metrics.forget_terminal("TFJob", "uid-1")
+    metrics.successful_inc_once("ns", "TFJob", "uid-1")
+    assert metrics.counter_value(
+        "training_operator_jobs_successful_total", "ns", "TFJob") == 2
+
+    # Controller path: DELETED event -> _forget -> both series pruned.
+    from tf_operator_tpu.core.workqueue import WorkQueue
+
+    mem = InMemoryCluster()
+    cmetrics = Metrics()
+    controller = JAXController(mem, queue=WorkQueue(), metrics=cmetrics)
+    mem.create_job(jaxjob(name="lat"))
+    job = mem.get_job("JAXJob", "default", "lat")
+    uid = job["metadata"]["uid"]
+    controller._note_uid("default/lat", uid)
+    cmetrics.set_heartbeat_age("default", "JAXJob", "lat", 30.0)
+    cmetrics.failed_inc_once("default", "JAXJob", uid)
+    mem.delete_job("JAXJob", "default", "lat")
+    assert cmetrics.heartbeat_age_value("default", "JAXJob", "lat") is None, (
+        "DELETED event must clear the heartbeat-age series")
+    # Dedup entry pruned: the same UID counts again (name reuse with the
+    # SAME uid cannot happen on a real apiserver; this asserts the prune).
+    cmetrics.failed_inc_once("default", "JAXJob", uid)
+    assert cmetrics.counter_value(
+        "training_operator_jobs_failed_total", "default", "JAXJob") == 2

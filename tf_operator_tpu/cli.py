@@ -76,6 +76,10 @@ class OperatorOptions:
     lease_duration: float = 15.0
     lease_name: str = "tf-operator-tpu-lock"
     enable_debugz: bool = False  # /debugz exposes thread stacks: opt-in only
+    # /tracez exposes per-job timelines (pod names, restart causes, the
+    # full apiserver call sequence) on the 0.0.0.0 metrics port — same
+    # exposure class as /debugz, same opt-in rule.
+    enable_tracez: bool = False
     enable_gang_scheduling: bool = False
     gang_scheduler_name: str = "volcano"
     json_log_format: bool = False
@@ -128,9 +132,19 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         help="Name of the coordination.k8s.io Lease used for election.")
     parser.add_argument("--enable-debugz", action="store_true",
                         help="Expose /debugz (thread stacks, queue depths) on the metrics port.")
+    parser.add_argument("--enable-tracez", action="store_true",
+                        help="Expose /tracez (per-job lifecycle span timelines, "
+                        "core/tracing.py) on the metrics port; pretty-print "
+                        "with scripts/trace_dump.py.")
     parser.add_argument("--enable-gang-scheduling", action="store_true")
     parser.add_argument("--gang-scheduler-name", default="volcano")
-    parser.add_argument("--json-log-format", action="store_true")
+    parser.add_argument("--json-log-format", action="store_true",
+                        help="Deprecated alias for --log-format json.")
+    parser.add_argument("--log-format", choices=("text", "json"), default="text",
+                        help="json: one JSON object per log record, stamped "
+                        "with the active job key and trace/span ids "
+                        "(core/tracing.py) when the record is emitted "
+                        "inside a reconcile.")
     parser.add_argument("--qps", type=float, default=0.0,
                         help="Client write QPS limit (0 = unlimited; reference default 5).")
     parser.add_argument("--burst", type=int, default=0,
@@ -168,9 +182,10 @@ def options_from_args(args: argparse.Namespace) -> OperatorOptions:
         lease_duration=args.lease_duration,
         lease_name=args.lease_name,
         enable_debugz=args.enable_debugz,
+        enable_tracez=args.enable_tracez,
         enable_gang_scheduling=args.enable_gang_scheduling,
         gang_scheduler_name=args.gang_scheduler_name,
-        json_log_format=args.json_log_format,
+        json_log_format=args.json_log_format or args.log_format == "json",
         qps=args.qps,
         burst=args.burst,
         parallel_fanout=not args.disable_parallel_fanout,
@@ -250,14 +265,49 @@ class _HealthHandler(_BaseHandler):
 
 
 class _MetricsHandler(_BaseHandler):
-    """Prometheus /metrics + /debugz on --metrics-port. /debugz is the
-    analog of the reference's pprof-on-monitoring-port (blank import in
-    cmd/tf-operator.v1/main.go:21): live thread stacks and per-controller
-    workqueue depths for diagnosing a stuck operator."""
+    """Prometheus /metrics + /debugz + /tracez on --metrics-port. /debugz
+    is the analog of the reference's pprof-on-monitoring-port (blank
+    import in cmd/tf-operator.v1/main.go:21): live thread stacks and
+    per-controller workqueue depths for diagnosing a stuck operator.
+    /tracez (opt-in, --enable-tracez — same exposure rule as /debugz)
+    serves the recent job-lifecycle traces (core/tracing.py) as JSON —
+    ?namespace= and ?job= filter, ?limit=N keeps the newest N;
+    pretty-print with scripts/trace_dump.py."""
 
     def do_GET(self):  # noqa: N802 (stdlib API)
         if self.path.startswith("/metrics"):
             self._respond(200, self.manager.metrics.render(), "text/plain; version=0.0.4")
+        elif self.path.startswith("/tracez"):
+            # Same exposure class as /debugz (the port binds 0.0.0.0 for
+            # Prometheus): per-job timelines carry pod names, restart
+            # causes, and the apiserver call sequence — opt-in only.
+            if not self.manager.options.enable_tracez:
+                self._respond(404, "tracez disabled (--enable-tracez)")
+                return
+            from urllib.parse import parse_qs, urlparse
+
+            query = parse_qs(urlparse(self.path).query)
+
+            def first(name):
+                values = query.get(name)
+                return values[0] if values else None
+
+            try:
+                limit = int(first("limit")) if first("limit") else None
+            except ValueError:
+                limit = -1
+            if limit is not None and limit < 0:
+                self._respond(400, "limit must be a non-negative integer")
+                return
+            self._respond(
+                200,
+                self.manager.tracer.export_json(
+                    namespace=first("namespace") or None,
+                    job=first("job") or None,
+                    limit=limit,
+                ),
+                "application/json",
+            )
         elif self.path.startswith("/debugz"):
             # Thread stacks leak file paths and internal state; the port
             # binds 0.0.0.0 for Prometheus, so diagnostics are opt-in
@@ -288,10 +338,18 @@ class OperatorManager:
         metrics: Optional[Metrics] = None,
         lease: Optional[LeaseLock] = None,
         identity: Optional[str] = None,
+        tracer=None,
     ):
         self.cluster = cluster
         self.options = options or OperatorOptions()
         self.metrics = metrics if metrics is not None else METRICS
+        if tracer is None:
+            # Process-wide default like METRICS; benches/tests that need
+            # isolation inject their own Tracer.
+            from .core.tracing import TRACER
+
+            tracer = TRACER
+        self.tracer = tracer
         if lease is None:
             # Production default: the election is arbitrated by the cluster
             # (coordination.k8s.io Lease), so two operator PROCESSES cannot
@@ -339,6 +397,7 @@ class OperatorManager:
                 metrics=self.metrics,
                 namespace=self.options.namespace,
                 limiter=shared_limiter,
+                tracer=self.tracer,
             )
         # Effective pool size per kind: the requested --workers ANDed with
         # the cluster seam's supports_concurrent_syncs capability
@@ -553,22 +612,35 @@ class OperatorManager:
 # -------------------------------------------------------------------- main
 
 
+def json_log_formatter(tracer=None) -> logging.Formatter:
+    """The --log-format json formatter: one JSON object per record,
+    stamped with {job, trace_id, span_id} when the EMITTING thread is
+    inside a traced reconcile (core/tracing.py current_log_context) —
+    `grep trace-000042` then reconstructs one job's interleaved log
+    lines from an N-worker pool."""
+    if tracer is None:
+        from .core.tracing import TRACER as tracer  # noqa: N811
+
+    class JsonFormatter(logging.Formatter):
+        def format(self, record):
+            entry = {
+                "level": record.levelname.lower(),
+                "time": self.formatTime(record),
+                "logger": record.name,
+                "msg": record.getMessage(),
+            }
+            entry.update(tracer.current_log_context())
+            if record.exc_info and record.exc_info[0] is not None:
+                entry["exception"] = record.exc_info[0].__name__
+            return json.dumps(entry)
+
+    return JsonFormatter()
+
+
 def _setup_logging(json_format: bool) -> None:
     if json_format:
-
-        class JsonFormatter(logging.Formatter):
-            def format(self, record):
-                return json.dumps(
-                    {
-                        "level": record.levelname.lower(),
-                        "time": self.formatTime(record),
-                        "logger": record.name,
-                        "msg": record.getMessage(),
-                    }
-                )
-
         handler = logging.StreamHandler()
-        handler.setFormatter(JsonFormatter())
+        handler.setFormatter(json_log_formatter())
         logging.basicConfig(level=logging.INFO, handlers=[handler], force=True)
     else:
         logging.basicConfig(

@@ -1,0 +1,134 @@
+"""Apiserver request-accounting cluster proxy.
+
+Sits directly over the backend (inside the throttle, inside any chaos
+seam's view of the world from the controller's side) and records every
+cluster call twice:
+
+- `training_operator_apiserver_requests_total{verb,resource,code}` in the
+  metrics registry — the aggregate apiserver-load number the ROADMAP's
+  watch-cache/status-coalescing item needs a baseline for;
+- `Tracer.record_request` — per-JOB attribution: a request issued while a
+  job's sync span is active on this thread is charged to that job's
+  trace, and write verbs additionally become `api.<verb>` child spans
+  (which is what makes span-order invariants like count-before-teardown
+  checkable from the trace alone).
+
+Determinism contract (the same one ThrottledCluster honors): the proxy
+forwards every call 1:1 — no extra cluster calls, no reordering, no
+sleeps — so a chaos seam underneath sees the identical (method, call
+index) sequence with accounting on or off, and every seeded fault tier
+replays byte-identically. Recording happens entirely in process memory.
+
+`supports_concurrent_writes` / `supports_concurrent_syncs` pass through
+untouched via __getattr__, like every other proxy seam.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Tuple
+
+from .base import Conflict, Gone, NotFound, ServerError
+
+# Cluster method -> (verb, resource). Methods absent here (watch,
+# stream_pod_log, capability flags, chaos control knobs) pass through
+# unaccounted — they are not apiserver request/response calls.
+METHOD_VERBS = {
+    "create_job": ("create", "jobs"),
+    "get_job": ("get", "jobs"),
+    "get_job_uncached": ("get", "jobs"),
+    "list_jobs": ("list", "jobs"),
+    "update_job": ("update", "jobs"),
+    # Status writes get their own resource label: they are the coalescing
+    # target (today every sync may write status) and must be separable
+    # from spec updates in both the counter and the per-job attribution.
+    "update_job_status": ("update", "status"),
+    "delete_job": ("delete", "jobs"),
+    "create_pod": ("create", "pods"),
+    "get_pod": ("get", "pods"),
+    "list_pods": ("list", "pods"),
+    "update_pod": ("update", "pods"),
+    "delete_pod": ("delete", "pods"),
+    "get_pod_log": ("get", "pods/log"),
+    "create_service": ("create", "services"),
+    "get_service": ("get", "services"),
+    "list_services": ("list", "services"),
+    "update_service": ("update", "services"),
+    "delete_service": ("delete", "services"),
+    "create_pod_group": ("create", "podgroups"),
+    "get_pod_group": ("get", "podgroups"),
+    "list_pod_groups": ("list", "podgroups"),
+    "delete_pod_group": ("delete", "podgroups"),
+    "get_lease": ("get", "leases"),
+    "create_lease": ("create", "leases"),
+    "update_lease": ("update", "leases"),
+    "delete_lease": ("delete", "leases"),
+    "record_event": ("create", "events"),
+    "list_events": ("list", "events"),
+}
+
+
+def code_of(exc: Optional[BaseException]) -> str:
+    """Outcome label: HTTP-analog codes for the typed cluster errors,
+    the exception class name for anything else, "200" for success.
+    Pure function of the exception type — deterministic under seeded
+    fault injection."""
+    if exc is None:
+        return "200"
+    if isinstance(exc, NotFound):
+        return "404"
+    if isinstance(exc, Conflict):
+        return "409"
+    if isinstance(exc, Gone):
+        return "410"
+    if isinstance(exc, ServerError):
+        return "500"
+    return type(exc).__name__
+
+
+class AccountingCluster:
+    """Delegates everything to `inner`; request/response methods are
+    counted + attributed on the way through. Exceptions — including
+    BaseException-derived SimulatedCrash, whose planted call must still
+    appear in the timeline it kills — are recorded and re-raised
+    unchanged."""
+
+    def __init__(self, inner, metrics=None, tracer=None, clock=time.monotonic):
+        self._inner = inner
+        self._metrics = metrics
+        self._tracer = tracer
+        self._clock = clock
+
+    def _record(self, verb: str, resource: str, code: str,
+                duration: float) -> None:
+        if self._metrics is not None:
+            self._metrics.apiserver_request_inc(verb, resource, code)
+        if self._tracer is not None:
+            self._tracer.record_request(verb, resource, code, duration)
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        vr: Optional[Tuple[str, str]] = METHOD_VERBS.get(name)
+        if vr is None or not callable(attr):
+            # Pass-through attrs (capability flags, fault_log, chaos
+            # knobs) are NOT memoized: some are live state.
+            return attr
+        verb, resource = vr
+        record, clock = self._record, self._clock
+
+        def accounted(*args, **kwargs):
+            t0 = clock()
+            try:
+                result = attr(*args, **kwargs)
+            except BaseException as exc:
+                record(verb, resource, code_of(exc), clock() - t0)
+                raise
+            record(verb, resource, "200", clock() - t0)
+            return result
+
+        # Memoize the wrapper on the instance: __getattr__ only fires on
+        # a miss, so every later access is a plain attribute hit — this
+        # sits on the hottest path in the process (every apiserver call
+        # of every controller), and the inner method binding is stable.
+        self.__dict__[name] = accounted
+        return accounted

@@ -85,8 +85,28 @@ class FrameworkController(FrameworkHooks):
         metrics=None,
         namespace: str = "",
         limiter: Optional[TokenBucket] = None,
+        tracer=None,
     ):
         opts = options or EngineOptions()
+        if metrics is None:
+            from ..metrics import METRICS
+
+            metrics = METRICS
+        self.metrics = metrics
+        if tracer is None:
+            from ..core.tracing import TRACER
+
+            tracer = TRACER
+        self.tracer = tracer
+        # Request accounting sits directly over the backend (inside the
+        # throttle: a throttled write is still exactly one apiserver
+        # request) — every cluster call the controller or engine issues is
+        # counted into apiserver_requests_total and attributed to the
+        # active job trace. Pure 1:1 pass-through, so fault seams
+        # underneath see an unchanged call sequence.
+        from ..cluster.accounting import AccountingCluster
+
+        cluster = AccountingCluster(cluster, metrics=metrics, tracer=tracer)
         # ONE client budget per operator process, enforced at the cluster
         # boundary so EVERY write (pods, services, events, status) pays it
         # — reference rest-client semantics. The manager passes a shared
@@ -105,11 +125,11 @@ class FrameworkController(FrameworkHooks):
         # Namespace scoping (legacy --namespace, options.go:36): empty = all.
         self.namespace = namespace
         self.clock = clock
-        if metrics is None:
-            from ..metrics import METRICS
-
-            metrics = METRICS
-        self.metrics = metrics
+        # Last observed queue wait of THIS worker thread (item, seconds):
+        # stashed by the on_wait hook at pop time, consumed by sync() to
+        # record the trace's queue.wait span and parent the sync span to
+        # it. Thread-local — each pool worker pops its own items.
+        self._wait_tls = threading.local()
         self.expectations = ControllerExpectations(
             on_timeout=self._on_expectation_timeout
         )
@@ -136,6 +156,7 @@ class FrameworkController(FrameworkHooks):
             on_force_delete=self._record_force_delete,
             on_fanout_batch=self._record_fanout_batch,
             on_fanout_abort=self._record_fanout_abort,
+            tracer=tracer,
         )
         # Queue-wait observer (enqueue -> worker pop), fed straight into
         # the queue_wait histogram; injected custom queues without the
@@ -269,6 +290,10 @@ class FrameworkController(FrameworkHooks):
 
     def _observe_queue_wait(self, item: str, seconds: float) -> None:
         self.metrics.observe_queue_wait(self.kind, seconds)
+        # Stash for the sync about to run on this same thread: the trace's
+        # queue.wait span needs the job UID, which is only known once
+        # sync() reads the job back.
+        self._wait_tls.last = (item, seconds)
         self._sample_queue_depth()
 
     def _sample_queue_depth(self) -> None:
@@ -344,6 +369,24 @@ class FrameworkController(FrameworkHooks):
         if uid:
             self._note_uid(f"{namespace}/{name}", uid)
 
+        # Trace context: one sync span per reconcile, rooted in the job
+        # incarnation's trace and parented to the measured workqueue wait
+        # (recorded after the fact — the wait is only known at pop time,
+        # the uid only now). Everything the engine does below, cluster
+        # writes included (cluster/accounting.py), nests under this span.
+        job_trace_key = (self.kind, namespace, name, uid or "")
+        wait = getattr(self._wait_tls, "last", None)
+        self._wait_tls.last = None
+        wait_span = None
+        if wait is not None and wait[0] == f"{self.kind}:{namespace}/{name}":
+            wait_span = self.tracer.record_span(
+                "queue.wait", job=job_trace_key, duration=wait[1],
+            )
+        with self.tracer.span("sync", job=job_trace_key, parent=wait_span):
+            self._sync_traced(namespace, name, job_dict, uid)
+
+    def _sync_traced(self, namespace: str, name: str, job_dict: dict,
+                     uid) -> None:
         try:
             job = self.parse_job(job_dict)
             self.validate_job(job)
@@ -365,6 +408,7 @@ class FrameworkController(FrameworkHooks):
             # what keeps the deletion expectation unfulfilled, so an
             # escalation only inside reconcile_job (which this gate blocks)
             # could first fire after the 5-minute expectation expiry.
+            self.tracer.event("expectations.pending")
             self.engine.escalate_stuck_terminating(job)
             self.queue.add_after(f"{self.kind}:{key}", 30.0)
             return

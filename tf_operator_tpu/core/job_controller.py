@@ -420,6 +420,7 @@ class JobController:
         on_force_delete: Optional[Callable[[JobObject, str], None]] = None,
         on_fanout_batch: Optional[Callable[[str, int], None]] = None,
         on_fanout_abort: Optional[Callable[[str], None]] = None,
+        tracer=None,
     ):
         self.hooks = hooks
         self.cluster = cluster
@@ -472,6 +473,16 @@ class JobController:
         # every wave. Never used on seams that serialize (chaos/process).
         self._fanout_pool = None
         self._fanout_pool_lock = threading.Lock()
+        # Lifecycle tracer (core/tracing.py): spans for gang restarts,
+        # liveness checks, force-delete escalations and fan-out waves nest
+        # under the controller's sync span. Defaults to the shared
+        # disabled instance so engines driven directly (tests, benches)
+        # pay one attribute load per call and record nothing.
+        if tracer is None:
+            from .tracing import NOOP_TRACER
+
+            tracer = NOOP_TRACER
+        self.tracer = tracer
         # (job key, uid) -> last-declared gang-group names: gates the stale
         # sweep's uncached LIST to declared-set changes (and once per
         # operator lifetime per job, since this cache is in-memory).
@@ -894,7 +905,15 @@ class JobController:
         # gang-up — is wedged behind a Running phase the kubelet will
         # never change. Drive the same gang-restart machine the failure
         # paths use, with its own cause + ledger.
-        stall = self._check_liveness(job, replicas, run_policy, pods)
+        if run_policy.progress_deadline_seconds is None:
+            stall = None
+        else:
+            # Traced only for opted-in jobs (a span per sync of every job
+            # would be noise): the lease reads inside are attributed by
+            # accounting; the verdict rides as an attr.
+            with self.tracer.span("liveness.check") as live_span:
+                stall = self._check_liveness(job, replicas, run_policy, pods)
+                live_span.set(stalled=stall is not None)
         if stall is not None:
             # The stall branch owns its status writes: the count must be
             # DURABLE before any pod dies (see _restart_stalled_gang).
@@ -1359,8 +1378,32 @@ class JobController:
         re-judged and the world would restart mixed."""
         key = job.key()
         handled = set(job.status.gang_handled_uids or ())
+        # `counted` = phase 1 runs in THIS span (a False span is a resume
+        # after the count already landed). Computed ONCE and passed down:
+        # the span attr and the phase-1 gate must be the same predicate,
+        # because check_span_invariants' counted-exemption audits exactly
+        # what the attr claims. The trace's api.* child spans make the
+        # protocol auditable after the fact: invariants.py asserts the
+        # counted status write precedes every teardown delete in span
+        # order.
+        counted = trigger.metadata.uid not in handled
+        with self.tracer.span("gang.restart", attrs={
+            "cause": cause, "rtype": rtype,
+            "trigger": trigger.metadata.name, "targets": len(targets),
+            "counted": counted,
+        }):
+            self._restart_gang_counted_traced(
+                job, pods, targets, trigger, rtype, cause, reason, msg,
+                old_status, key, handled, counted,
+            )
+
+    def _restart_gang_counted_traced(
+        self, job: JobObject, pods: List[Pod], targets: List[Pod],
+        trigger: Pod, rtype: str, cause: str, reason: str, msg: str,
+        old_status: JobStatus, key: str, handled: set, counted: bool,
+    ) -> None:
         job.status._restarting_this_sync = True
-        if trigger.metadata.uid not in handled:
+        if counted:
             present = {p.metadata.uid for p in pods}
             job.status.gang_handled_uids = sorted(
                 (handled & present) | {p.metadata.uid for p in targets}
@@ -1501,7 +1544,16 @@ class JobController:
                     continue  # already escalated this incarnation
             name = pod.metadata.name
             try:
-                self.cluster.delete_pod(pod.metadata.namespace, name, force=True)
+                # The escalation span wraps only the grace-period-0 write,
+                # so its api.delete child (and any error) reads directly
+                # off the timeline; cause mirrors the metric label.
+                with self.tracer.span("force_delete", attrs={
+                    "pod": name,
+                    "cause": constants.FORCE_DELETE_CAUSE_STUCK_TERMINATING,
+                }):
+                    self.cluster.delete_pod(
+                        pod.metadata.namespace, name, force=True
+                    )
             except NotFound:
                 continue  # won the race with the kubelet after all
             except Exception:  # noqa: BLE001 — transient write failure
@@ -1549,6 +1601,21 @@ class JobController:
         parallel = self.options.parallel_fanout and bool(
             getattr(self.cluster, "supports_concurrent_writes", False)
         )
+        # Parallel fan-out runs `fn` on pool threads whose thread-local
+        # trace stack is empty — propagate this sync's context explicitly
+        # so every write stays attributed to the job (accounting's
+        # record_request reads the ACTIVE thread's context). Serial
+        # fan-out runs on this thread and needs nothing. Span ids of
+        # parallel writes land in completion order (wall-clock), which is
+        # fine: the deterministic fault tiers all serialize (their seams
+        # report supports_concurrent_writes=False).
+        ctx = self.tracer.current()
+        if parallel and ctx is not None:
+            inner_fn, tracer = fn, self.tracer
+
+            def fn(i, _inner=inner_fn, _ctx=ctx):
+                return tracer.call_in_context(_ctx, _inner, i)
+
         pool = None
         if parallel and count > 1 and self.options.fanout_max_parallelism > 1:
             from concurrent.futures import ThreadPoolExecutor
@@ -1565,12 +1632,19 @@ class JobController:
             fn,
             parallel=parallel,
             max_parallelism=max(1, self.options.fanout_max_parallelism),
-            on_batch=lambda size: self.on_fanout_batch(resource, size),
+            on_batch=lambda size: self._record_fanout_wave(resource, size),
             pool=pool,
         )
         if err is not None:
             self.on_fanout_abort(resource)
         return successes, err
+
+    def _record_fanout_wave(self, resource: str, size: int) -> None:
+        """One slow-start wave issued: counter + a point event on the
+        active span (on_batch fires on the coordinating sync thread, so
+        the event lands in the right trace)."""
+        self.on_fanout_batch(resource, size)
+        self.tracer.event("fanout.wave", resource=resource, size=size)
 
     def _create_pods_batch(
         self,

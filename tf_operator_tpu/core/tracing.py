@@ -1,0 +1,426 @@
+"""Job-lifecycle tracing: per-job span timelines for the operator.
+
+The reference's only per-sync observability is a log line ("Finished
+syncing tfjob %q (%v)", controller.go:306). Histograms and counters say
+how MUCH the operator did; this module answers "what did the operator do
+to job X, in what order, and how many apiserver calls did it cost" — the
+causally-ordered control-action timeline TF-Replicator (arXiv:1902.00465)
+argues is the debugging primitive for rendezvous-heavy systems.
+
+Design rules (docs/design/tracing.md):
+
+- One trace per JOB INCARNATION, keyed (kind, namespace, name, uid): a
+  deleted-and-recreated job starts a fresh trace, exactly like the
+  UID-keyed terminal-metrics dedup.
+- Spans are recorded into a bounded per-trace ring buffer and the trace
+  map itself is a bounded LRU — a long-lived operator with job churn
+  holds a fixed memory ceiling, like every other per-job cache here.
+- DETERMINISTIC IDs: trace ids are a per-tracer creation counter, span
+  ids a per-trace counter — no wall clock, no randomness. The seeded
+  chaos/crash/failover tiers replay byte-identical fault logs with
+  tracing on, and the span SEQUENCE (names/parents/non-timing attrs)
+  replays identically too (`span_sequence`). Wall-clock timestamps exist
+  only as start/end fields, excluded from determinism comparisons.
+- Tracing NEVER touches the cluster: no writes, no reads, no sleeps —
+  it cannot perturb a chaos schedule keyed on (method, call index).
+- Thread model: the active span stack is thread-local (the workqueue
+  serializes each job onto one worker). Parallel fan-out propagates the
+  parent context onto pool threads explicitly (`call_in_context`), so
+  per-job request attribution survives concurrent writes.
+
+Request accounting (cluster/accounting.py) feeds `record_request`: every
+apiserver call made while a job's span is active is attributed to that
+job's trace, and write calls additionally become `api.<verb>` child
+spans — which is what makes span-order invariants like "the counted
+status write precedes the gang teardown's deletions" checkable from the
+trace alone (testing/invariants.py check_span_invariants).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import OrderedDict, deque
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+WRITE_VERBS = frozenset({"create", "update", "delete"})
+
+
+class Span:
+    """One timed operation inside a trace. `span_id` is the per-trace
+    deterministic sequence number (also the causal order key: ids are
+    assigned in call order, so `a.span_id < b.span_id` means a was
+    recorded before b)."""
+
+    __slots__ = ("span_id", "parent_id", "name", "attrs", "events",
+                 "start", "end")
+
+    def __init__(self, span_id: int, parent_id: Optional[int], name: str,
+                 attrs: Optional[dict], start: float):
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.attrs = dict(attrs) if attrs else {}
+        self.events: List[tuple] = []
+        self.start = start
+        self.end: Optional[float] = None
+
+    def set(self, **attrs) -> None:
+        """Copy-on-write: the attrs mapping is REPLACED, never mutated —
+        an exporter on another thread (a /tracez scrape mid-sync) reads
+        the reference it snapshotted without 'dict changed size during
+        iteration' ever being possible."""
+        self.attrs = {**self.attrs, **attrs}
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.span_id,
+            "parent": self.parent_id,
+            "name": self.name,
+            "start": self.start,
+            "end": self.end,
+            "attrs": dict(self.attrs),
+            "events": [
+                {"name": n, "attrs": dict(a)} for n, a in self.events
+            ],
+        }
+
+
+class _NullSpan:
+    """No-op stand-in when tracing is disabled or no trace is active."""
+
+    span_id = None
+    parent_id = None
+    attrs: dict = {}
+
+    def set(self, **attrs) -> None:
+        pass
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _Trace:
+    __slots__ = ("trace_id", "kind", "namespace", "name", "uid", "spans",
+                 "span_seq", "requests", "writes", "created_seq")
+
+    def __init__(self, trace_id: str, kind: str, namespace: str, name: str,
+                 uid: str, max_spans: int, created_seq: int):
+        self.trace_id = trace_id
+        self.kind = kind
+        self.namespace = namespace
+        self.name = name
+        self.uid = uid
+        self.spans: deque = deque(maxlen=max_spans)
+        self.span_seq = 0
+        # (verb, resource, code) -> count; bounded by the method table.
+        self.requests: Dict[Tuple[str, str, str], int] = {}
+        self.writes = 0
+        self.created_seq = created_seq
+
+
+class Tracer:
+    """Dependency-free in-process tracer. A process-wide default lives at
+    module level (`TRACER`, the METRICS idiom); harnesses and benchmarks
+    construct their own for isolation."""
+
+    def __init__(self, max_traces: int = 256, max_spans: int = 512,
+                 clock=time.time, enabled: bool = True):
+        self.enabled = enabled
+        self.max_traces = max_traces
+        self.max_spans = max_spans
+        self.clock = clock
+        self._lock = threading.Lock()
+        # (kind, namespace, name, uid) -> _Trace, in creation order; LRU
+        # eviction drops the OLDEST trace when the map is full.
+        self._traces: "OrderedDict[tuple, _Trace]" = OrderedDict()
+        self._trace_seq = 0
+        self._tls = threading.local()
+
+    # ------------------------------------------------------------ context
+    def _stack(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def current(self) -> Optional[tuple]:
+        """The active (trace, span) context of THIS thread, or None —
+        capture it before handing work to a pool thread and re-install
+        there with `attach`/`call_in_context`."""
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    @contextmanager
+    def attach(self, ctx):
+        """Install a captured (trace, span) context on this thread."""
+        if ctx is None:
+            yield
+            return
+        stack = self._stack()
+        stack.append(ctx)
+        try:
+            yield
+        finally:
+            stack.pop()
+
+    def call_in_context(self, ctx, fn, *args, **kwargs):
+        with self.attach(ctx):
+            return fn(*args, **kwargs)
+
+    def current_log_context(self) -> dict:
+        """{job, trace_id, span_id} of the active context (empty when
+        none) — the structured-logging stamp (`--log-format json`)."""
+        ctx = self.current()
+        if ctx is None:
+            return {}
+        trace, span = ctx
+        return {
+            "job": f"{trace.namespace}/{trace.name}",
+            "trace_id": trace.trace_id,
+            "span_id": span.span_id,
+        }
+
+    # ------------------------------------------------------------- traces
+    def _trace_for_locked(self, kind: str, namespace: str, name: str,
+                          uid: str) -> _Trace:
+        key = (kind, namespace, name, uid)
+        trace = self._traces.get(key)
+        if trace is not None:
+            # True LRU, not FIFO: a hit refreshes recency, so the
+            # busiest (oldest-created) job's live trace is never the one
+            # evicted while idle newer traces survive. Recency order is
+            # a pure function of the operation sequence — deterministic
+            # under seeded replay.
+            self._traces.move_to_end(key)
+        else:
+            self._trace_seq += 1
+            trace = _Trace(
+                f"trace-{self._trace_seq:06d}", kind, namespace, name, uid,
+                self.max_spans, self._trace_seq,
+            )
+            self._traces[key] = trace
+            while len(self._traces) > self.max_traces:
+                self._traces.popitem(last=False)
+        return trace
+
+    def _touch_locked(self, trace: _Trace) -> None:
+        """Refresh (or restore) `trace`'s slot in the LRU map. Threads
+        hold direct _Trace references on their context stacks for the
+        whole sync, so a long sync racing heavy job churn can have its
+        trace evicted mid-flight — without this, every later span and
+        write attribution of that sync would land on a detached object
+        and vanish from export()/writes_by_job(). Touch order is a pure
+        function of the operation sequence — deterministic under replay."""
+        key = (trace.kind, trace.namespace, trace.name, trace.uid)
+        existing = self._traces.get(key)
+        if existing is trace:
+            self._traces.move_to_end(key)
+            return
+        # Evicted (or clobbered by a fresh same-key root after eviction):
+        # the object the live sync is recording into wins the slot.
+        self._traces[key] = trace
+        self._traces.move_to_end(key)
+        while len(self._traces) > self.max_traces:
+            self._traces.popitem(last=False)
+
+    # -------------------------------------------------------------- spans
+    @contextmanager
+    def span(self, name: str, job: Optional[tuple] = None,
+             parent: Optional[int] = None, attrs: Optional[dict] = None):
+        """Record one span. `job` = (kind, namespace, name, uid) roots the
+        span in that job's trace; without it the span nests under the
+        thread's current context (and is silently dropped when there is
+        none — engine helpers called outside a sync never crash on
+        tracing). `parent` overrides the parent span id (the
+        workqueue-wait linkage)."""
+        if not self.enabled:
+            yield NULL_SPAN
+            return
+        stack = self._stack()
+        if job is None and not stack:
+            yield NULL_SPAN
+            return
+        # One critical section for lookup + touch + append: this lock is
+        # the hottest in the process (every span AND every accounted
+        # request), so no double round-trips.
+        with self._lock:
+            if job is not None:
+                trace = self._trace_for_locked(*job)
+            else:
+                trace = stack[-1][0]
+                self._touch_locked(trace)
+            if parent is None and stack and stack[-1][0] is trace:
+                parent = stack[-1][1].span_id
+            trace.span_seq += 1
+            span = Span(trace.span_seq, parent, name, attrs, self.clock())
+            trace.spans.append(span)
+        stack.append((trace, span))
+        try:
+            yield span
+        except BaseException as exc:
+            if "error" not in span.attrs:
+                span.set(error=type(exc).__name__)
+            raise
+        finally:
+            span.end = self.clock()
+            stack.pop()
+
+    def record_span(self, name: str, job: Optional[tuple] = None,
+                    duration: float = 0.0,
+                    attrs: Optional[dict] = None) -> Optional[int]:
+        """Record an already-finished span (e.g. the measured workqueue
+        wait, known only after the fact). Returns its span id so a
+        follow-on span can parent to it."""
+        if not self.enabled:
+            return None
+        ctx = None
+        if job is None:
+            ctx = self.current()
+            if ctx is None:
+                return None
+        with self._lock:
+            if job is not None:
+                trace = self._trace_for_locked(*job)
+            else:
+                trace = ctx[0]
+                self._touch_locked(trace)
+            trace.span_seq += 1
+            end = self.clock()
+            span = Span(trace.span_seq, None, name, attrs,
+                        end - max(0.0, duration))
+            span.end = end
+            trace.spans.append(span)
+            return span.span_id
+
+    def event(self, name: str, **attrs) -> None:
+        """Append a point-in-time event to the active span (no-op without
+        one) — cheaper than a span for things like fan-out waves."""
+        ctx = self.current()
+        if ctx is not None:
+            ctx[1].events.append((name, attrs))
+
+    # ----------------------------------------------------------- requests
+    def record_request(self, verb: str, resource: str, code: str,
+                       duration: float = 0.0) -> None:
+        """One apiserver request completed under the active job context:
+        counted into the trace's per-job attribution, and — for writes —
+        recorded as an `api.<verb>` child span of the active span."""
+        ctx = self.current()
+        if ctx is None or not self.enabled:
+            return
+        trace, parent = ctx
+        with self._lock:
+            self._touch_locked(trace)
+            key = (verb, resource, code)
+            trace.requests[key] = trace.requests.get(key, 0) + 1
+            if verb not in WRITE_VERBS:
+                return
+            trace.writes += 1
+            trace.span_seq += 1
+            end = self.clock()
+            span = Span(
+                trace.span_seq, parent.span_id, f"api.{verb}",
+                {"resource": resource, "code": code}, end - max(0.0, duration),
+            )
+            span.end = end
+            trace.spans.append(span)
+
+    # ------------------------------------------------------------- export
+    def export(self, namespace: Optional[str] = None,
+               job: Optional[str] = None,
+               limit: Optional[int] = None) -> List[dict]:
+        """JSON-able snapshot of recent traces (newest last), filterable
+        by namespace and job name — the /tracez payload. Only a SHALLOW
+        snapshot (deque->list, request-table copy) happens under the
+        tracer lock — the same lock every hot-path span()/record_request
+        must take — so a /tracez scrape of max_traces full ring buffers
+        never stalls controller workers for the full serialization.
+        Building the dicts outside the lock is safe: spans are
+        append-only, attrs are copy-on-write (Span.set replaces the
+        mapping), and a mid-scrape live sync at worst contributes a span
+        whose `end` is still None."""
+        snapshot = []
+        with self._lock:
+            for trace in self._traces.values():
+                if namespace and trace.namespace != namespace:
+                    continue
+                if job and trace.name != job:
+                    continue
+                snapshot.append((trace, list(trace.spans),
+                                 dict(trace.requests), trace.writes))
+        if limit is not None and limit >= 0:
+            # Applied BEFORE serialization (newest-last is already the
+            # map order), so ?limit=1 over a full map costs O(1) traces,
+            # not a full export. -limit slicing alone would turn limit=0
+            # into "everything".
+            snapshot = snapshot[-limit:] if limit > 0 else []
+        out = []
+        for trace, spans, requests, writes in snapshot:
+            out.append({
+                "trace_id": trace.trace_id,
+                "kind": trace.kind,
+                "namespace": trace.namespace,
+                "job": trace.name,
+                "uid": trace.uid,
+                "writes": writes,
+                "requests": [
+                    {"verb": v, "resource": r, "code": c, "count": n}
+                    for (v, r, c), n in sorted(requests.items())
+                ],
+                "spans": [s.to_dict() for s in spans],
+            })
+        return out
+
+    def export_json(self, **kwargs) -> str:
+        return json.dumps({"traces": self.export(**kwargs)}, indent=2)
+
+    def span_sequence(self, namespace: Optional[str] = None,
+                      job: Optional[str] = None) -> List[tuple]:
+        """The determinism artifact: every span's (trace_id, span_id,
+        parent, name, attrs, events) with float-valued attrs dropped —
+        floats are wall-clock-derived (durations, ages), everything else
+        (causes, resources, codes, counts) is a pure function of the
+        operation sequence. Two same-seed runs must compare equal."""
+        def clean(attrs: dict) -> tuple:
+            return tuple(sorted(
+                (k, v) for k, v in attrs.items()
+                if not isinstance(v, float)
+            ))
+
+        out = []
+        for trace in self.export(namespace=namespace, job=job):
+            for span in trace["spans"]:
+                out.append((
+                    trace["trace_id"], span["id"], span["parent"],
+                    span["name"], clean(span["attrs"]),
+                    tuple((e["name"], clean(e["attrs"]))
+                          for e in span["events"]),
+                ))
+        return out
+
+    # --------------------------------------------------------- accounting
+    def writes_by_job(self) -> Dict[str, int]:
+        """job 'kind/namespace/name' -> attributed apiserver writes
+        (latest incarnation wins on a reused name; the kind is part of
+        the key so a TFJob and a JAXJob sharing a name never collide)."""
+        with self._lock:
+            return {
+                f"{t.kind}/{t.namespace}/{t.name}": t.writes
+                for t in self._traces.values()
+            }
+
+    def total_writes(self) -> int:
+        with self._lock:
+            return sum(t.writes for t in self._traces.values())
+
+
+# Process-wide default, like metrics.METRICS. Tests and benchmarks that
+# need isolation construct their own Tracer.
+TRACER = Tracer()
+
+# Shared disabled instance for components constructed without a tracer
+# (the engine's default): every call is a cheap no-op.
+NOOP_TRACER = Tracer(enabled=False)

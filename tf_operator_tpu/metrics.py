@@ -47,6 +47,20 @@ class _Histogram:
         return out
 
 
+def escape_label_value(value) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote, and newline must be escaped or the exposition line is invalid
+    (label values here include exception strings — sync_errors_total's
+    `exception` label, accounting's `code` — which can legally contain
+    any of the three)."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 class Metrics:
     _COUNTERS = (
         ("training_operator_jobs_created_total", "The number of created jobs"),
@@ -103,6 +117,16 @@ class Metrics:
             "not gang-size of them). Each abort rolled back the "
             "unobserved remainder of its expectation batch and requeued "
             "rate-limited",
+        ),
+        "training_operator_apiserver_requests_total": (
+            ("verb", "resource", "code"),
+            "Apiserver requests issued through the cluster seam "
+            "(cluster/accounting.py), labeled by verb (get/list/create/"
+            "update/delete), resource (pods/services/jobs/status/events/"
+            "leases/podgroups), and outcome code (200, 404, 409, 410, "
+            "500, or the exception class for anything else). The write "
+            "verbs are the apiserver-load number the watch-cache/"
+            "status-coalescing work must drive down",
         ),
     }
     # Gauges with label sets: name -> (label names, help). Values live in
@@ -228,6 +252,12 @@ class Metrics:
         rate-limited — the signal that was previously swallowed silently."""
         self._inc_labeled(
             "training_operator_sync_errors_total", namespace, framework, exception,
+        )
+
+    def apiserver_request_inc(self, verb: str, resource: str, code: str) -> None:
+        """One apiserver request completed (any verb, any outcome)."""
+        self._inc_labeled(
+            "training_operator_apiserver_requests_total", verb, resource, code,
         )
 
     def busy_workers_inc(self, framework: str) -> None:
@@ -381,27 +411,31 @@ class Metrics:
             return self._counters[name][(namespace, framework)]
 
     def render(self) -> str:
-        """Prometheus text exposition format."""
+        """Prometheus text exposition format. EVERY label value goes
+        through escape_label_value: exception names, namespaces, and
+        outcome codes are caller-controlled strings, and an unescaped
+        `"` or `\\` in one series used to invalidate the whole page."""
+        esc = escape_label_value
         lines: List[str] = []
         with self._lock:
             for name, help_text in self._COUNTERS:
                 lines.append(f"# HELP {name} {help_text}")
                 lines.append(f"# TYPE {name} counter")
                 for (ns, fw), value in sorted(self._counters[name].items()):
-                    lines.append(f'{name}{{job_namespace="{ns}",framework="{fw}"}} {value}')
+                    lines.append(f'{name}{{job_namespace="{esc(ns)}",framework="{esc(fw)}"}} {value}')
             for name, (label_names, help_text) in self._LABELED_COUNTERS.items():
                 lines.append(f"# HELP {name} {help_text}")
                 lines.append(f"# TYPE {name} counter")
                 for values, count in sorted(self._labeled_counters[name].items()):
                     label = ",".join(
-                        f'{ln}="{lv}"' for ln, lv in zip(label_names, values)
+                        f'{ln}="{esc(lv)}"' for ln, lv in zip(label_names, values)
                     )
                     lines.append(f"{name}{{{label}}} {count}")
             for name, series in self._histograms.items():
                 lines.append(f"# HELP {name} {name.replace('_', ' ')}")
                 lines.append(f"# TYPE {name} histogram")
                 for (ns, fw), hist in sorted(series.items()):
-                    label = f'job_namespace="{ns}",framework="{fw}"'
+                    label = f'job_namespace="{esc(ns)}",framework="{esc(fw)}"'
                     for bound, cum in zip(hist.bounds, hist.cumulative()):
                         lines.append(f'{name}_bucket{{{label},le="{bound}"}} {cum}')
                     lines.append(f'{name}_bucket{{{label},le="+Inf"}} {hist.count}')
@@ -412,7 +446,7 @@ class Metrics:
                 lines.append(f"# TYPE {name} gauge")
                 for values, gauge in sorted(self._labeled_gauges[name].items()):
                     label = ",".join(
-                        f'{ln}="{lv}"' for ln, lv in zip(label_names, values)
+                        f'{ln}="{esc(lv)}"' for ln, lv in zip(label_names, values)
                     )
                     lines.append(f"{name}{{{label}}} {gauge:g}")
             for name, value in sorted(self._gauges.items()):

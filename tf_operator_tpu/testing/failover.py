@@ -76,12 +76,19 @@ class FailoverDriver:
         kinds: Sequence[str] = ("JAXJob",),
         namespace: Optional[str] = None,
         max_failovers: int = 100,
+        tracer=None,
     ):
         self._cluster = cluster
         self._factory = controller_factory
         self.kinds = tuple(kinds)
         self.namespace = namespace
         self.max_failovers = max_failovers
+        # Optional core/tracing.py Tracer shared by every controller
+        # incarnation (the factory must wire it in): the trace OUTLIVES
+        # each simulated crash, so a post-mortem reads one causal
+        # timeline across failovers. On a budget-exceeded failure the
+        # export is dumped into build/ and referenced from the assertion.
+        self.tracer = tracer
         self.generation = 0
         self.crashes: List[str] = []  # one entry per failover, in order
         self.controller = None
@@ -102,10 +109,17 @@ class FailoverDriver:
         without a crash)."""
         self.crashes.append(str(crash))
         if len(self.crashes) > self.max_failovers:
-            raise AssertionError(
+            message = (
                 f"failover budget exceeded ({self.max_failovers}): the "
                 "crash schedule never lets the controller converge"
-            ) from crash
+            )
+            if self.tracer is not None:
+                from .invariants import dump_trace
+
+                path = dump_trace(self.tracer, "failover_budget_exceeded")
+                if path:
+                    message += f"; trace dump: {path}"
+            raise AssertionError(message) from crash
         self._boot()
 
     def resync(self) -> None:

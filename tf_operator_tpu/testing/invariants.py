@@ -17,16 +17,26 @@ schedule that battered it:
   pod at an index beyond the declared replica count;
 - well-formed conditions: at most one entry per type, legal status
   values, and the mutual-exclusion pairs (Succeeded/Failed,
-  Running/Restarting) never both True.
+  Running/Restarting) never both True;
+- span ordering (`check_span_invariants`, over a core/tracing.py export):
+  inside every COUNTED gang-restart span, the successful status write
+  that made the count durable precedes every teardown pod delete in span
+  order — the count-before-teardown protocol, audited from the trace
+  alone. Resume spans (counted=False: the write landed in a previous
+  sync/incarnation) carry no ordering obligation.
 
 `check_job_invariants` returns violations as strings (so a tier can
 aggregate); `assert_invariants` raises with the full list. The chaos and
 stall tiers run these after every scenario, the crash tier after every
-failover-and-converge.
+failover-and-converge. Passing `tracer=` folds the span invariants in
+AND, on any violation, dumps the full trace export into build/ for
+post-mortem (`dump_trace`).
 """
 
 from __future__ import annotations
 
+import os
+import re
 from typing import Dict, List, Optional, Sequence
 
 from ..core import constants
@@ -183,6 +193,75 @@ def check_dependents_invariants(
     return violations
 
 
+def check_span_invariants(traces: Sequence[dict]) -> List[str]:
+    """Span-order invariants over a `Tracer.export()` payload. The one
+    hard rule today: a counted gang restart's successful status write
+    (`api.update` child, resource=status, code=200) precedes every
+    teardown pod delete (`api.delete` child, resource=pods) in span-id
+    order — span ids are assigned at record time under one lock, so id
+    order IS causal order. A counted span with deletes but no successful
+    write is the lost-count crash window the protocol exists to close."""
+    violations: List[str] = []
+    for trace in traces:
+        spans = list(trace.get("spans") or [])
+        by_parent: Dict[Optional[int], List[dict]] = {}
+        for span in spans:
+            by_parent.setdefault(span.get("parent"), []).append(span)
+        for span in spans:
+            if span.get("name") != "gang.restart":
+                continue
+            attrs = span.get("attrs") or {}
+            children = by_parent.get(span.get("id"), [])
+            status_writes = [
+                c["id"] for c in children
+                if c.get("name") == "api.update"
+                and (c.get("attrs") or {}).get("resource") == "status"
+                and (c.get("attrs") or {}).get("code") == "200"
+            ]
+            deletes = [
+                c["id"] for c in children
+                if c.get("name") == "api.delete"
+                and (c.get("attrs") or {}).get("resource") == "pods"
+            ]
+            if not attrs.get("counted") or not deletes:
+                # Resume span (count already durable), or phase 1 aborted
+                # before anything died — nothing to order.
+                continue
+            where = f"{trace.get('trace_id')}: gang.restart span {span.get('id')}"
+            if not status_writes:
+                violations.append(
+                    f"{where} deleted {len(deletes)} pod(s) with no "
+                    "successful counted status write in the span (count-"
+                    "before-teardown violated: a crash here loses the count)"
+                )
+            elif min(deletes) < min(status_writes):
+                violations.append(
+                    f"{where}: teardown delete (span {min(deletes)}) "
+                    f"precedes the counted status write (span "
+                    f"{min(status_writes)})"
+                )
+    return violations
+
+
+def dump_trace(tracer, label: str) -> Optional[str]:
+    """Write the tracer's full export into build/ (override the directory
+    with TRACE_DUMP_DIR) for post-mortem; returns the path, or None
+    without a tracer / on any write failure — a dump must never mask the
+    assertion it decorates."""
+    if tracer is None:
+        return None
+    try:
+        directory = os.environ.get("TRACE_DUMP_DIR", "build")
+        os.makedirs(directory, exist_ok=True)
+        slug = re.sub(r"[^A-Za-z0-9_.-]+", "-", label).strip("-") or "trace"
+        path = os.path.join(directory, f"trace_{slug}.json")
+        with open(path, "w") as f:
+            f.write(tracer.export_json())
+        return path
+    except Exception:  # noqa: BLE001 — best-effort post-mortem artifact
+        return None
+
+
 def check_job_invariants(
     cluster,
     kinds: Sequence[str],
@@ -209,8 +288,18 @@ def assert_invariants(
     kinds: Sequence[str],
     namespace: Optional[str] = None,
     expect_ledgers: Optional[Dict[str, Dict[str, int]]] = None,
+    tracer=None,
+    label: str = "invariants",
 ) -> None:
     violations = check_job_invariants(
         cluster, kinds, namespace=namespace, expect_ledgers=expect_ledgers
     )
-    assert not violations, "invariant violations:\n  " + "\n  ".join(violations)
+    if tracer is not None:
+        violations.extend(check_span_invariants(tracer.export()))
+    if not violations:
+        return
+    message = "invariant violations:\n  " + "\n  ".join(violations)
+    path = dump_trace(tracer, label)
+    if path:
+        message += f"\n  trace dump: {path}"
+    raise AssertionError(message)
