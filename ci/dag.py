@@ -261,7 +261,15 @@ def default_dag() -> List[Step]:
         # load-normalized run-over-run gate) regresses >2x
         # (build/scale_smoke_last.json); also gates concurrent
         # reconciliation — a 4-worker pool must beat 1 worker on p50
-        # queue wait and makespan on a queue-wait-bound 24-job load.
+        # queue wait and makespan on a queue-wait-bound 24-job load —
+        # and, since the write-coalescing PR, apiserver WRITE PRESSURE:
+        # writes-per-converged-job must stay under 65% of the PR 6
+        # ≈129 baseline (measured ≈68 coalesced; the 64-create
+        # structural floor bounds total reduction), the coalescible
+        # events+status share must stay ≥3x under its ≈66 baseline
+        # (measured ≈4), parallel and serial write costs must agree
+        # (no fan-out write amplification), and the writes column may
+        # not regress >10% run-over-run.
         # Retried like the other timing-sensitive tiers.
         Step("scale-smoke",
              [PY, "scripts/measure_control_plane.py", "--mode", "scale",

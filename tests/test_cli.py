@@ -279,8 +279,15 @@ def test_qps_flag_reaches_engine():
     ctrl = next(iter(mgr.controllers.values()))
     from tf_operator_tpu.cluster.throttled import ThrottledCluster
 
-    assert isinstance(ctrl.cluster, ThrottledCluster)
-    assert ctrl.cluster._limiter.qps == 5.0
+    # The watch cache is the outermost proxy (a cache hit must skip the
+    # throttle entirely); the throttled boundary sits directly beneath.
+    from tf_operator_tpu.cluster.watchcache import WatchCacheCluster
+
+    cluster = ctrl.cluster
+    if isinstance(cluster, WatchCacheCluster):
+        cluster = cluster._inner
+    assert isinstance(cluster, ThrottledCluster)
+    assert cluster._limiter.qps == 5.0
     # The SAME throttled boundary serves engine, pod and service control,
     # so events and status writes pay the budget too.
     assert ctrl.engine.cluster is ctrl.cluster

@@ -728,11 +728,11 @@ class TestJAXController:
         real_delete = self.controller.engine.pod_control.delete_pod
         fail_once = {"llama-worker-1": 1}
 
-        def flaky_delete(namespace, name, job):
+        def flaky_delete(namespace, name, job, **kwargs):
             if fail_once.get(name, 0) > 0:
                 fail_once[name] -= 1
                 raise RuntimeError("transient apiserver error")
-            return real_delete(namespace, name, job)
+            return real_delete(namespace, name, job, **kwargs)
 
         self.controller.engine.pod_control.delete_pod = flaky_delete
         try:

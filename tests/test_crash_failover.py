@@ -548,3 +548,81 @@ class TestRandomizedCrashSweep:
                           tracer=out["tracer"], label=f"crash_sweep_{seed}")
         again = run_seeded_crash_sweep(seed=2000 + seed)
         assert again["fault_log"] == out["fault_log"], seed
+
+
+class TestCoalescingCrashWindows:
+    """The counted-write crash windows with write coalescing ENABLED over
+    the chaos seam (instance-level supports_write_coalescing opt-in —
+    the class default stays False so every other seeded tier keeps its
+    byte-identical schedule). Counted writes flow through
+    patch_job_status but must remain synchronous, durable before any
+    teardown delete, and exactly-once across a failover: the coalescing
+    buffer may never widen a crash window the PR 3 protocol closed. The
+    span-order audit runs with the patch verb standing in for the legacy
+    update (testing/invariants.py accepts either)."""
+
+    def _coalescing_chaos(self, seed):
+        inner = InMemoryCluster()
+        chaos = ChaosCluster(inner, ChaosSpec(seed=seed))
+        chaos.supports_write_coalescing = True
+        return inner, chaos
+
+    @pytest.mark.parametrize("before_write", [True, False])
+    def test_crash_around_counted_patch_exactly_once(self, before_write):
+        """The headline window, coalescing-on: the gang restart's phase-1
+        counted status PATCH. Before-write: the count died with the
+        process — the new leader re-detects and counts once. After-write
+        (the crash lands between the counted write and the teardown):
+        the new leader resumes off the handled-uid stamp, never
+        re-counting."""
+        inner, chaos = self._coalescing_chaos(seed=5)
+        driver = jax_driver(chaos)
+        inner.create_job(jax_manifest(run_policy={"backoffLimit": 0}))
+        gang_up(driver, inner)
+
+        inner.set_pod_phase(
+            "default", "llama-worker-2", POD_FAILED, exit_code=137,
+            disruption_target="Preempted",
+        )
+        plant_crash(chaos, "patch_job_status", before_write)
+        driver.controller.queue.add("JAXJob:default/llama")
+        for _ in range(6):
+            driver.run_until_idle()
+            for p in inner.list_pods("default"):
+                if p.status.phase == POD_PENDING:
+                    inner.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+            driver.controller.queue.add("JAXJob:default/llama")
+        driver.run_until_idle()
+
+        assert len(driver.crashes) == 1, driver.crashes
+        variant = "crash-before" if before_write else "crash-after"
+        assert any(
+            variant in f and "patch_job_status" in f for f in chaos.fault_log
+        ), chaos.fault_log
+        status = inner.get_job("JAXJob", "default", "llama")["status"]
+        assert status["disruptionCounts"] == {"Worker": 1}, status
+        assert "restartCounts" not in status
+        assert len(inner.list_pods("default")) == 4
+        assert_invariants(
+            inner, kinds=("JAXJob",),
+            expect_ledgers={
+                "disruptionCounts": {"Worker": 1},
+                "restartCounts": {},
+                "stallCounts": {},
+            },
+            tracer=driver.tracer,
+            label=f"coalescing_crash_counted_patch_{before_write}",
+        )
+        # Structurally green, not green-by-absence: the trace holds a
+        # counted gang.restart whose api.patch children fed the audit.
+        counted = [
+            s for t in driver.tracer.export() for s in t["spans"]
+            if s["name"] == "gang.restart" and s["attrs"].get("counted")
+        ]
+        assert counted, "no counted gang.restart span in the trace"
+        patch_children = [
+            s for t in driver.tracer.export() for s in t["spans"]
+            if s["name"] == "api.patch"
+            and s["attrs"].get("resource") == "status"
+        ]
+        assert patch_children, "counted writes must ride the patch verb"

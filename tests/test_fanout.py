@@ -212,11 +212,11 @@ class TestBatchExpectations:
         fails = {"after": 3}
         real_create = engine.pod_control.create_pod
 
-        def flaky_create(namespace, pod, job):
+        def flaky_create(namespace, pod, job, **kwargs):
             if fails["after"] <= 0:
                 raise RuntimeError("chaos template")
             fails["after"] -= 1
-            return real_create(namespace, pod, job)
+            return real_create(namespace, pod, job, **kwargs)
 
         engine.pod_control.create_pod = flaky_create
         # Serialize so exactly 3 creates land before the failure (the
@@ -275,7 +275,7 @@ class TestBatchExpectations:
         controller.run_until_idle()
         engine = controller.engine
 
-        def failing_delete(namespace, name, job):
+        def failing_delete(namespace, name, job, **kwargs):
             raise RuntimeError("injected delete failure")
 
         engine.service_control.delete_service = failing_delete

@@ -1,0 +1,418 @@
+"""Write-pressure collapse tier: status-write coalescing, the
+patch_job_status verb, batched create/delete events, claim no-op write
+dedup, and the shared watch cache (docs/design/
+control_plane_performance.md "Write coalescing").
+
+What this tier holds:
+
+- patch_job_status semantics across the seam: single-request status
+  apply on the in-memory backend (replace, rv bump, MODIFIED event, no
+  Conflict surface), the `patch` verb label through accounting, and the
+  api.patch child span feeding the span-order invariant.
+- The coalescing writer: pure replica-count churn inside the per-job
+  rate window is buffered (status_writes_coalesced_total) and carried by
+  a scheduled flush (status_write_flush_latency_seconds); condition
+  transitions, ledgers and stamps flush synchronously and in order.
+- The mandatory bypass: counted writes (gang restart ledgers) and
+  terminal conditions are never deferred — a Succeeded job's terminal
+  status lands exactly once even with a dirty buffer pending.
+- Capability gating: resolve_write_coalescing pins the whole path off
+  over chaos/process seams, byte-preserving every seeded schedule.
+- Event aggregation: a gang-sized create/delete fan-out records ONE
+  SuccessfulCreate*/Delete* event, not gang-size of them.
+- Claim-protocol no-op dedup: a release whose live object already
+  dropped our controllerRef, and an adoption Conflict whose live object
+  already carries it, issue no UPDATE.
+- The shared watch cache: a manager-hosted controller converges a job
+  with ZERO accounted list/get reads (all served from the delta-fed
+  store), stays coherent across deletes, and exposes rv bookmarks.
+"""
+
+import time
+
+from tf_operator_tpu.api.k8s import POD_RUNNING, POD_SUCCEEDED
+from tf_operator_tpu.cli import OperatorManager, OperatorOptions
+from tf_operator_tpu.cluster.accounting import AccountingCluster
+from tf_operator_tpu.cluster.base import NotFound
+from tf_operator_tpu.cluster.chaos import ChaosCluster, ChaosSpec
+from tf_operator_tpu.cluster.memory import InMemoryCluster
+from tf_operator_tpu.cluster.process import LocalProcessCluster
+from tf_operator_tpu.controllers.tensorflow import TFController
+from tf_operator_tpu.core.job_controller import (
+    EngineOptions,
+    resolve_write_coalescing,
+)
+from tf_operator_tpu.core.tracing import Tracer
+from tf_operator_tpu.core.workqueue import WorkQueue
+from tf_operator_tpu.metrics import Metrics
+
+REQS = "training_operator_apiserver_requests_total"
+COALESCED = "training_operator_status_writes_coalesced_total"
+FLUSH_HIST = "training_operator_status_write_flush_latency_seconds"
+
+
+def container(name):
+    return {"name": name, "image": "test:1"}
+
+
+def tf_manifest(name="tj", workers=2):
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "TFJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "tfReplicaSpecs": {
+                "Worker": {
+                    "replicas": workers,
+                    "restartPolicy": "ExitCode",
+                    "template": {
+                        "spec": {"containers": [container("tensorflow")]}
+                    },
+                }
+            }
+        },
+    }
+
+
+def conds_of(cluster, name, kind="TFJob"):
+    job = cluster.get_job(kind, "default", name)
+    return {
+        c["type"]: c for c in (job.get("status") or {}).get("conditions") or []
+    }
+
+
+def patches(metrics):
+    return metrics.labeled_counter_value(REQS, "patch", "status", "200")
+
+
+# ---------------------------------------------------------------- the verb
+
+
+class TestPatchJobStatusVerb:
+    def test_memory_patch_replaces_status_and_publishes(self):
+        mem = InMemoryCluster()
+        mem.create_job(tf_manifest("tj"))
+        seen = []
+        mem.watch("TFJob", lambda et, obj: seen.append(et))
+        rv0 = int(mem.get_job("TFJob", "default", "tj")["metadata"]["resourceVersion"])
+        out = mem.patch_job_status(
+            "TFJob", "default", "tj", {"startTime": 1.0})
+        assert out["status"] == {"startTime": 1.0}
+        assert int(out["metadata"]["resourceVersion"]) > rv0
+        assert seen == ["MODIFIED"]
+        # Full-replace semantics: a later patch omitting startTime clears it.
+        out = mem.patch_job_status("TFJob", "default", "tj", {"conditions": []})
+        assert "startTime" not in out["status"]
+        try:
+            mem.patch_job_status("TFJob", "default", "missing", {})
+        except NotFound:
+            pass
+        else:
+            raise AssertionError("patching a missing job must raise NotFound")
+
+    def test_accounting_labels_patch_and_emits_api_patch_span(self):
+        mem = InMemoryCluster()
+        mem.create_job(tf_manifest("tj"))
+        metrics, tracer = Metrics(), Tracer()
+        acct = AccountingCluster(mem, metrics=metrics, tracer=tracer)
+        job_key = ("TFJob", "default", "tj", "uid-1")
+        with tracer.span("sync", job=job_key):
+            acct.patch_job_status("TFJob", "default", "tj", {"conditions": []})
+        assert metrics.labeled_counter_value(REQS, "patch", "status", "200") == 1
+        assert tracer.total_writes() == 1
+        assert tracer.total_writes_by_resource() == {"status": 1}
+        spans = tracer.export(job="tj")[0]["spans"]
+        assert any(
+            s["name"] == "api.patch" and s["attrs"]["resource"] == "status"
+            for s in spans
+        )
+
+
+# ------------------------------------------------------------- the resolver
+
+
+class TestCapabilityGating:
+    def test_resolver_pins_off_over_fault_seams(self):
+        opts = EngineOptions()
+        assert resolve_write_coalescing(opts, InMemoryCluster())
+        chaos = ChaosCluster(InMemoryCluster(), ChaosSpec(seed=1))
+        assert not resolve_write_coalescing(opts, chaos)
+        proc = LocalProcessCluster()
+        try:
+            assert not resolve_write_coalescing(opts, proc)
+        finally:
+            proc.shutdown()
+        assert not resolve_write_coalescing(
+            EngineOptions(write_coalescing=False), InMemoryCluster())
+        # Instance-level opt-in (the crash-window regressions' lever).
+        chaos.supports_write_coalescing = True
+        assert resolve_write_coalescing(opts, chaos)
+
+    def test_legacy_seam_keeps_update_verb(self):
+        """Over a coalescing-incapable seam the engine's status writes
+        stay full-object update_job_status — the byte-identity half of
+        the capability contract."""
+        mem = InMemoryCluster()
+        chaos = ChaosCluster(mem, ChaosSpec(seed=1))
+        metrics = Metrics()
+        controller = TFController(chaos, queue=WorkQueue(), metrics=metrics)
+        mem.create_job(tf_manifest("tj"))
+        controller.run_until_idle()
+        assert metrics.labeled_counter_value(REQS, "update", "status", "200") >= 1
+        assert patches(metrics) == 0
+
+
+# ------------------------------------------------------- coalescing writer
+
+
+class TestCoalescingWriter:
+    def _controller(self, mem, metrics, interval):
+        return TFController(
+            mem, queue=WorkQueue(), metrics=metrics,
+            options=EngineOptions(status_flush_interval=interval),
+        )
+
+    def test_replica_churn_defers_then_flushes(self):
+        mem = InMemoryCluster()
+        metrics = Metrics()
+        controller = self._controller(mem, metrics, interval=0.5)
+        mem.create_job(tf_manifest("tj", workers=3))
+        controller.run_until_idle()
+        mem.set_pod_phase("default", "tj-worker-0", POD_RUNNING)
+        controller.run_until_idle()  # Running condition: immediate flush
+        running_patches = patches(metrics)
+        assert running_patches >= 1
+        mem.set_pod_phase("default", "tj-worker-1", POD_RUNNING)
+        controller.run_until_idle()
+        # Pure replicaStatuses churn inside the window: buffered, not
+        # written — the cluster copy stays one count behind.
+        assert metrics.labeled_counter_value(COALESCED, "default", "TFJob") >= 1
+        assert patches(metrics) == running_patches
+        stored = mem.get_job("TFJob", "default", "tj")["status"]
+        assert stored["replicaStatuses"]["Worker"]["active"] == 1
+        # The scheduled flush comes due and carries the churn.
+        time.sleep(0.8)
+        controller.run_until_idle()
+        assert patches(metrics) > running_patches
+        stored = mem.get_job("TFJob", "default", "tj")["status"]
+        assert stored["replicaStatuses"]["Worker"]["active"] == 2
+        assert metrics.histogram_values(FLUSH_HIST, "default", "TFJob"), (
+            "the flush must observe its dirty-buffer age")
+
+    def test_steady_state_writes_nothing(self):
+        mem = InMemoryCluster()
+        metrics = Metrics()
+        controller = self._controller(mem, metrics, interval=0.2)
+        mem.create_job(tf_manifest("tj", workers=2))
+        controller.run_until_idle()
+        for p in mem.list_pods("default"):
+            mem.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+        controller.run_until_idle()
+        time.sleep(0.3)
+        controller.run_until_idle()
+        settled = patches(metrics)
+        for _ in range(5):
+            controller.queue.add("TFJob:default/tj")
+            controller.run_until_idle()
+        assert patches(metrics) == settled, (
+            "steady-state resyncs must not write status at all")
+
+    def test_terminal_flush_lands_exactly_once_with_dirty_buffer(self):
+        """The lost-terminal-status failure mode: churn is sitting in the
+        buffer (rate window held open by a huge interval) when the job
+        reaches Succeeded — the terminal condition is counted, bypasses
+        the window, carries the buffered churn, and never writes again."""
+        mem = InMemoryCluster()
+        metrics = Metrics()
+        controller = self._controller(mem, metrics, interval=60.0)
+        mem.create_job(tf_manifest("tj", workers=2))
+        controller.run_until_idle()
+        mem.set_pod_phase("default", "tj-worker-0", POD_RUNNING)
+        controller.run_until_idle()
+        mem.set_pod_phase("default", "tj-worker-1", POD_RUNNING)
+        controller.run_until_idle()
+        assert metrics.labeled_counter_value(COALESCED, "default", "TFJob") >= 1
+        engine = controller.engine
+        assert engine._status_dirty_since, "churn must be sitting dirty"
+
+        mem.set_pod_phase("default", "tj-worker-0", POD_SUCCEEDED,
+                          exit_code=0)
+        controller.run_until_idle()
+        assert conds_of(mem, "tj").get("Succeeded", {}).get("status") == "True"
+        terminal_patches = patches(metrics)
+        with engine._status_lock:
+            assert not engine._status_dirty_since, (
+                "the terminal flush must clear the buffer")
+        # Exactly once: terminal resyncs see an unchanged status.
+        for _ in range(4):
+            controller.queue.add("TFJob:default/tj")
+            controller.run_until_idle()
+        assert patches(metrics) == terminal_patches
+        # Forgetting the job drops the writer's per-job state.
+        mem.delete_job("TFJob", "default", "tj")
+        with engine._status_lock:
+            assert not engine._status_last_flush
+            assert not engine._status_dirty_since
+
+
+# --------------------------------------------------------- event batching
+
+
+class TestEventAggregation:
+    def test_batched_creates_record_one_event_per_resource(self):
+        mem = InMemoryCluster()
+        controller = TFController(mem, queue=WorkQueue(), metrics=Metrics())
+        mem.create_job(tf_manifest("tj", workers=8))
+        controller.run_until_idle()
+        assert len(mem.list_pods("default")) == 8
+        pod_events = [
+            e for e in mem.list_events() if e.reason == "SuccessfulCreatePod"
+        ]
+        svc_events = [
+            e for e in mem.list_events()
+            if e.reason == "SuccessfulCreateService"
+        ]
+        assert len(pod_events) == 1 and "8" in pod_events[0].message
+        assert len(svc_events) == 1 and "8" in svc_events[0].message
+
+    def test_legacy_lever_keeps_per_object_events(self):
+        mem = InMemoryCluster()
+        controller = TFController(
+            mem, queue=WorkQueue(), metrics=Metrics(),
+            options=EngineOptions(write_coalescing=False),
+        )
+        mem.create_job(tf_manifest("tj", workers=8))
+        controller.run_until_idle()
+        pod_events = [
+            e for e in mem.list_events() if e.reason == "SuccessfulCreatePod"
+        ]
+        assert len(pod_events) == 8
+
+
+# --------------------------------------------------------- claim no-op dedup
+
+
+class TestClaimNoOpDedup:
+    def test_release_skips_update_when_live_already_released(self):
+        mem = InMemoryCluster()
+        controller = TFController(mem, queue=WorkQueue(), metrics=Metrics())
+        mem.create_job(tf_manifest("tj", workers=1))
+        controller.run_until_idle()
+        job = controller.parse_job(mem.get_job("TFJob", "default", "tj"))
+        stale = mem.get_pod("default", "tj-worker-0")  # carries our ref
+        assert stale.metadata.controller_ref() is not None
+        # The release already landed on the live object (response lost).
+        live = mem.get_pod("default", "tj-worker-0")
+        live.metadata.owner_references = []
+        mem.update_pod(live)
+        rv_before = mem.get_pod("default", "tj-worker-0").metadata.resource_version
+        controller.engine._release_object(
+            job, stale, mem.get_pod, mem.update_pod)
+        assert mem.get_pod(
+            "default", "tj-worker-0").metadata.resource_version == rv_before, (
+            "a no-op release must not issue an UPDATE")
+
+    def test_adoption_conflict_keeps_already_adopted_live_object(self):
+        mem = InMemoryCluster()
+        controller = TFController(mem, queue=WorkQueue(), metrics=Metrics())
+        mem.create_job(tf_manifest("tj", workers=1))
+        controller.run_until_idle()  # pod exists, adopted, labels match
+        job = controller.parse_job(mem.get_job("TFJob", "default", "tj"))
+        live = mem.get_pod("default", "tj-worker-0")
+        # Stale orphan view: no controllerRef — the adopt UPDATE it
+        # drives Conflicts (simulating the apiserver's stale-rv 409; the
+        # memory backend's update_pod is last-write-wins, so the 409 is
+        # injected), and the fallback must keep the (already ours) live
+        # object without another write.
+        stale = mem.get_pod("default", "tj-worker-0")
+        stale.metadata.owner_references = []
+        rv_before = live.metadata.resource_version
+
+        from tf_operator_tpu.cluster.base import Conflict
+
+        def conflicting_update(pod):
+            raise Conflict("stale resourceVersion")
+
+        out = controller.engine._claim_objects(
+            job, [stale], mem.get_pod, conflicting_update)
+        assert [p.metadata.name for p in out] == ["tj-worker-0"]
+        assert out[0].metadata.controller_ref().uid == job.metadata.uid
+        assert mem.get_pod(
+            "default", "tj-worker-0").metadata.resource_version == rv_before
+
+
+# ------------------------------------------------------- shared watch cache
+
+
+class TestSharedWatchCache:
+    def _manager(self, mem, metrics):
+        return OperatorManager(
+            mem,
+            OperatorOptions(enabled_schemes=["TFJob"], health_port=0,
+                            metrics_port=0),
+            metrics=metrics,
+        )
+
+    def test_converges_with_zero_accounted_reads(self):
+        mem = InMemoryCluster()
+        metrics = Metrics()
+        manager = self._manager(mem, metrics)
+        controller = manager.controllers["TFJob"]
+        mem.create_job(tf_manifest("tj", workers=2))
+        controller.run_until_idle()
+        for p in mem.list_pods("default"):
+            mem.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+        controller.run_until_idle()
+        assert conds_of(mem, "tj").get("Running", {}).get("status") == "True"
+        # Every hot-path read was served from the delta-fed store: the
+        # accounting proxy saw no LIST/GET at all (the cache's priming
+        # LIST goes straight to the backend, outside the counted chain).
+        for verb, resource in (("list", "pods"), ("list", "services"),
+                               ("get", "jobs"), ("get", "pods")):
+            assert metrics.labeled_counter_value(
+                REQS, verb, resource, "200") == 0, (verb, resource)
+        assert manager.watch_cache.bookmark("pods") > 0
+
+    def test_cache_coherent_across_deletes_and_recreates(self):
+        mem = InMemoryCluster()
+        metrics = Metrics()
+        manager = self._manager(mem, metrics)
+        controller = manager.controllers["TFJob"]
+        mem.create_job(tf_manifest("tj", workers=2))
+        controller.run_until_idle()
+        for p in mem.list_pods("default"):
+            mem.set_pod_phase("default", p.metadata.name, POD_RUNNING)
+        controller.run_until_idle()
+        # An external delete reaches the cache via its DELETED delta; the
+        # next sync sees the hole off the cache and recreates the index.
+        mem.delete_pod("default", "tj-worker-1")
+        controller.run_until_idle()
+        names = {p.metadata.name for p in mem.list_pods("default")}
+        assert names == {"tj-worker-0", "tj-worker-1"}
+        # Job deletion propagates through the job-kind store too.
+        mem.delete_job("TFJob", "default", "tj")
+        controller.run_until_idle()
+        try:
+            manager.watch_cache.get_object("TFJob", "default", "tj")
+        except NotFound:
+            pass
+        else:
+            raise AssertionError("deleted job must leave the cache")
+
+    def test_scoped_cache_drops_out_of_scope_deltas(self):
+        """A namespace-scoped cache must not accumulate other tenants'
+        churn: out-of-scope deltas are dropped at the handler, and
+        out-of-scope reads fall through to the inner chain."""
+        from tf_operator_tpu.api.k8s import ObjectMeta, Pod
+        from tf_operator_tpu.cluster.watchcache import SharedWatchCache
+
+        mem = InMemoryCluster()
+        cache = SharedWatchCache(mem, namespace="ns1")
+        mem.create_pod(Pod(metadata=ObjectMeta(name="mine", namespace="ns1")))
+        mem.create_pod(Pod(metadata=ObjectMeta(name="theirs", namespace="ns2")))
+        assert [p.metadata.name for p in cache.list_objects(
+            "pods", namespace="ns1")] == ["mine"]
+        with cache._lock:
+            stored = {k for k in cache._stores["pods"]}
+        assert stored == {("ns1", "mine")}, stored
+        assert not cache.covers("ns2")

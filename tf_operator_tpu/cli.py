@@ -93,6 +93,15 @@ class OperatorOptions:
     # serial-baseline lever for the scale benchmark.
     parallel_fanout: bool = True
     fanout_max_parallelism: int = 16
+    # Apiserver write-pressure collapse (status-write coalescing +
+    # batched create/delete events + the patch_job_status verb). On by
+    # default; chaos/process seams pin it off via the
+    # supports_write_coalescing capability regardless. Disabling is the
+    # legacy-write-path lever for the scale benchmark.
+    write_coalescing: bool = True
+    # Per-job floor between coalesced status flushes: churn inside the
+    # window is buffered and carried by a scheduled flush.
+    status_flush_interval: float = 1.0
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -154,6 +163,15 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "serial baseline; default is slow-start parallel batches).")
     parser.add_argument("--fanout-max-parallelism", type=int, default=16,
                         help="Max in-flight writes of one slow-start fan-out batch.")
+    parser.add_argument("--disable-write-coalescing", action="store_true",
+                        help="Disable status-write coalescing and batched "
+                        "create/delete events (the legacy one-update-per-"
+                        "sync write path; default is coalesced single-"
+                        "request status patches on capable backends).")
+    parser.add_argument("--status-flush-interval", type=float, default=1.0,
+                        help="Per-job floor (seconds) between coalesced "
+                        "status flushes; replica-count churn inside the "
+                        "window is buffered and flushed on its close.")
     parser.add_argument("--kube", action="store_true",
                         help="Reconcile a real cluster via the kube-apiserver "
                         "(in-cluster service-account auth, or --kube-url/--kube-token).")
@@ -190,6 +208,8 @@ def options_from_args(args: argparse.Namespace) -> OperatorOptions:
         burst=args.burst,
         parallel_fanout=not args.disable_parallel_fanout,
         fanout_max_parallelism=args.fanout_max_parallelism,
+        write_coalescing=not args.disable_write_coalescing,
+        status_flush_interval=args.status_flush_interval,
     )
 
 
@@ -385,10 +405,26 @@ class OperatorManager:
             parallel_fanout=self.options.parallel_fanout,
             fanout_max_parallelism=self.options.fanout_max_parallelism,
             sync_workers=self.options.threadiness,
+            write_coalescing=self.options.write_coalescing,
+            status_flush_interval=self.options.status_flush_interval,
         )
         from .core.control import TokenBucket
 
         shared_limiter = TokenBucket(self.options.qps, self.options.burst)
+        # ONE shared watch cache for every framework controller when the
+        # backend's delivery contract allows it (cluster/watchcache.py):
+        # constructed BEFORE any controller so its handlers run first in
+        # each kind's dispatch order — the store must reflect an event by
+        # the time a controller's expectation observes it. KubeCluster
+        # declines (its reflector already is the cache); chaos/process
+        # decline for determinism.
+        self.watch_cache = None
+        if getattr(cluster, "supports_watch_cache", False):
+            from .cluster.watchcache import SharedWatchCache
+
+            self.watch_cache = SharedWatchCache(
+                cluster, namespace=self.options.namespace or None
+            )
         self.controllers: Dict[str, object] = {}
         for kind in enabled_kinds(self.options.enabled_schemes):
             self.controllers[kind] = SUPPORTED_CONTROLLERS[kind](
@@ -398,6 +434,7 @@ class OperatorManager:
                 namespace=self.options.namespace,
                 limiter=shared_limiter,
                 tracer=self.tracer,
+                watch_cache=self.watch_cache,
             )
         # Effective pool size per kind: the requested --workers ANDed with
         # the cluster seam's supports_concurrent_syncs capability

@@ -43,6 +43,10 @@ METHOD_VERBS = {
     # target (today every sync may write status) and must be separable
     # from spec updates in both the counter and the per-job attribution.
     "update_job_status": ("update", "status"),
+    # The coalescing writer's single-request status apply: its own verb
+    # label so dashboards can watch the update->patch migration (and the
+    # coalesced flush rate) directly off apiserver_requests_total.
+    "patch_job_status": ("patch", "status"),
     "delete_job": ("delete", "jobs"),
     "create_pod": ("create", "pods"),
     "get_pod": ("get", "pods"),

@@ -93,6 +93,26 @@ class Cluster:
     # the same way. Proxies inherit via __getattr__, like the write flag.
     supports_concurrent_syncs: bool = False
 
+    # Whether the backend supports the coalesced status-write path
+    # (patch_job_status + rate-limited flush + batched create/delete
+    # events). False (the conservative default) keeps the engine on the
+    # legacy one-synchronous-update_job_status-per-sync path with
+    # per-replica events — required by the chaos/crash seams, whose fault
+    # schedules are keyed on (method, per-method call index) and must
+    # replay byte-identically, and by the process tier for the same
+    # reason. Proxies inherit via __getattr__, like the other two flags.
+    supports_write_coalescing: bool = False
+
+    # Whether list/get reads may be served from a delta-fed shared watch
+    # cache (cluster/watchcache.py) instead of hitting the backend per
+    # sync. True only for backends whose watch delivery is ordered and
+    # lossless (the in-memory simulator). KubeCluster keeps False: its
+    # reflector already serves lists from an informer store, and a second
+    # cache layer would double-buffer staleness. Chaos keeps False — its
+    # watch-drop injection would poison a delta-fed cache permanently
+    # (a real informer heals via relist; the proxy cache has no resync).
+    supports_watch_cache: bool = False
+
     # ---- jobs (CR objects, stored as dicts keyed by kind) ----
     def create_job(self, job_dict: dict) -> dict:
         raise NotImplementedError
@@ -115,6 +135,19 @@ class Cluster:
 
     def update_job_status(self, kind: str, namespace: str, name: str, status: dict) -> dict:
         raise NotImplementedError
+
+    def patch_job_status(self, kind: str, namespace: str, name: str, status: dict) -> dict:
+        """Apply `status` to the job's status subresource in ONE request —
+        the server-side-apply/merge-patch idiom the coalescing writer
+        uses. `status` is the ENTIRE intended status (not a partial
+        delta): fields it omits must clear on the server, exactly like
+        update_job_status's replace semantics, but without the
+        read-modify-write round trip or resourceVersion Conflict surface.
+        Backends that predate the verb inherit this fallback (two
+        requests, same end state), so the writer never needs a
+        capability check of its own — supports_write_coalescing already
+        gates whether the coalesced path runs at all."""
+        return self.update_job_status(kind, namespace, name, status)
 
     def delete_job(self, kind: str, namespace: str, name: str) -> None:
         raise NotImplementedError

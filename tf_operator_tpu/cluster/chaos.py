@@ -56,6 +56,12 @@ _WRITE_METHODS = (
     "create_job",
     "update_job",
     "update_job_status",
+    # The coalescing writer's verb: faultable like every other write so a
+    # test that OPTS a chaos seam into coalescing (instance-level
+    # supports_write_coalescing=True, the crash-window regressions) can
+    # plant CrashPoints on the counted patch. Not conflict-eligible —
+    # a merge patch carries no resourceVersion to go stale.
+    "patch_job_status",
     "delete_job",
     "create_pod",
     "update_pod",
@@ -218,6 +224,16 @@ class ChaosCluster:
     # parallel writes within one sync.
     supports_concurrent_writes = False
     supports_concurrent_syncs = False
+    # Coalescing would change WHICH status writes are issued (deferred
+    # churn never reaches the backend) and event batching would change
+    # record_event counts — both scramble the write clock every
+    # after_writes-scheduled fault keys on. Pinned off so every seeded
+    # tier replays byte-identically; crash-window regressions that need
+    # coalescing ON over a chaos seam opt in per instance. The watch
+    # cache is pinned off because drop_watch_rate would poison a
+    # delta-fed store permanently (no relist heals the proxy cache).
+    supports_write_coalescing = False
+    supports_watch_cache = False
 
     def __init__(self, inner: Cluster, spec: ChaosSpec):
         self._inner = inner

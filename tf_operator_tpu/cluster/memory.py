@@ -62,6 +62,12 @@ class InMemoryCluster(base.Cluster):
     # workers reconciling different jobs concurrently.
     supports_concurrent_writes = True
     supports_concurrent_syncs = True
+    # Status writes may be coalesced/patched and reads served from the
+    # shared watch cache: the simulator's watch delivery is rv-ordered
+    # and lossless (_publish_locked/_drain_events), which is exactly the
+    # contract the delta-fed cache needs.
+    supports_write_coalescing = True
+    supports_watch_cache = True
 
     def __init__(self, clock=time.time):
         self._lock = threading.RLock()
@@ -232,6 +238,23 @@ class InMemoryCluster(base.Cluster):
         return out
 
     def update_job_status(self, kind: str, namespace: str, name: str, status: dict) -> dict:
+        with self._lock:
+            job = self._jobs.get((kind, namespace, name))
+            if job is None:
+                raise NotFound(f"{kind} {namespace}/{name}")
+            job["status"] = copy.deepcopy(status)
+            job["metadata"]["resourceVersion"] = str(next(self._rv))
+            out = copy.deepcopy(job)
+            self._publish_locked(kind, MODIFIED, copy.deepcopy(job))
+        self._drain_events()
+        return out
+
+    def patch_job_status(self, kind: str, namespace: str, name: str, status: dict) -> dict:
+        """Single-request status-subresource apply (the coalescing
+        writer's verb): same end state as update_job_status — the payload
+        is the entire intended status, replacing what is stored — but
+        modeled as a PATCH: no resourceVersion precondition, so it can
+        never Conflict on a stale read (apply-with-force semantics)."""
         with self._lock:
             job = self._jobs.get((kind, namespace, name))
             if job is None:

@@ -93,6 +93,12 @@ class LocalProcessCluster(InMemoryCluster):
     # concurrent syncs.
     supports_concurrent_writes = False
     supports_concurrent_syncs = False
+    # Must override the InMemoryCluster base's True: the e2e tier's
+    # assertions read job status straight off the store between steps
+    # (coalesced deferral would make those reads racy), and its launch
+    # ordering leans on the strictly-serial write sequence.
+    supports_write_coalescing = False
+    supports_watch_cache = False
 
     def __init__(
         self,

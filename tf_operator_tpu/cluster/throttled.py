@@ -20,6 +20,9 @@ _WRITE_METHODS = (
     "create_job",
     "update_job",
     "update_job_status",
+    # The coalesced single-request status apply pays the same budget
+    # token as the two-request read-modify-write it replaces.
+    "patch_job_status",
     "delete_job",
     "create_pod",
     "update_pod",

@@ -1,0 +1,280 @@
+"""Shared, delta-fed watch cache: the informer-store seam for backends
+without their own reflector.
+
+The reference serves every hot-path read from client-go informer caches;
+KubeCluster reproduces that with its reflector + store. The in-memory
+backend (the scale benchmark's fabric, most test tiers, and the chaos
+substrate) had no equivalent: every sync paid a fresh LIST for pods and
+services and a GET for the job — pressure the accounting proxy shows
+scaling linearly with sync count. This module closes that gap:
+
+- `SharedWatchCache` subscribes to the backend's watch streams ONCE
+  (pods, services, plus each job kind a controller registers) and
+  maintains a store per resource, fed purely by deltas, with a
+  resourceVersion bookmark per resource (the highest rv applied — the
+  resume watermark a reconnecting reflector would use).
+- `WatchCacheCluster` is the per-controller proxy: list_pods /
+  list_services / get_pod / get_service / get_job are served from the
+  shared store (deep-copied, claim-view filtered); every write — and
+  every read the cache does not model, get_job_uncached above all —
+  passes through to the inner chain untouched.
+
+Shared by design: one manager's N framework controllers fan their syncs
+over ONE store, so the backend sees one initial LIST per resource per
+process instead of one per controller per sync.
+
+Ordering contract: the cache registers its watch handlers BEFORE any
+controller registers its own (the manager builds the cache first; the
+per-kind registration happens inside FrameworkController.__init__ before
+_watch()), and backends dispatch handlers in registration order — so by
+the time an expectation is observed or a sync is enqueued for an event,
+the store already reflects it. That is what lets the expectations gate
+keep its exact meaning over cache-served lists.
+
+Priming uses the reflector's watch-before-list trick: handlers are live
+before the initial LIST, the merge keeps whichever copy carries the
+higher resourceVersion, and deletions observed mid-prime leave
+tombstones so the LIST snapshot can never resurrect an object the
+deltas already removed.
+
+Capability-gated via `Cluster.supports_watch_cache`: only backends whose
+watch delivery is ordered and lossless opt in (the in-memory simulator).
+The chaos seam pins it off — its seeded watch-drop injection would
+poison a delta-fed store permanently — which also keeps every seeded
+fault tier's read sequence byte-identical to the pre-cache engine.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from . import base
+from .base import ADDED, DELETED, MODIFIED, NotFound, SYNC
+
+_UPSERTS = (ADDED, MODIFIED, SYNC)
+
+
+def _meta(obj) -> Tuple[str, str, int]:
+    """(namespace, name, rv) of a typed object or a job dict."""
+    if isinstance(obj, dict):
+        meta = obj.get("metadata") or {}
+        ns = meta.get("namespace", "default")
+        name = meta.get("name", "")
+        raw = meta.get("resourceVersion") or "0"
+    else:
+        ns = obj.metadata.namespace
+        name = obj.metadata.name
+        raw = obj.metadata.resource_version or "0"
+    try:
+        rv = int(raw)
+    except ValueError:
+        rv = 0
+    return ns, name, rv
+
+
+def _copy(obj):
+    return obj.deep_copy() if hasattr(obj, "deep_copy") else copy.deepcopy(obj)
+
+
+class SharedWatchCache:
+    """Delta-fed store over one backend, shared by every controller of a
+    process. Construct it ONCE, before any controller registers watches
+    of its own (the manager does; see the module docstring's ordering
+    contract)."""
+
+    def __init__(self, backend, namespace: Optional[str] = None):
+        self.backend = backend
+        # Cache scope (None = every namespace): the LIST that primes a
+        # resource uses it, and reads outside the scope fall through.
+        self.namespace = namespace or None
+        self._lock = threading.Lock()
+        self._stores: Dict[str, Dict[Tuple[str, str], object]] = {}
+        self._bookmarks: Dict[str, int] = {}
+        self._primed: set = set()
+        # (resource, ns, name) -> rv of a DELETED delta observed before
+        # that resource finished priming: the merge must not resurrect.
+        self._tombstones: Dict[Tuple[str, str, str], int] = {}
+        self._registered: set = set()
+        for resource in ("pods", "services"):
+            self._register(resource)
+
+    # -------------------------------------------------------------- feeds
+    def _register(self, resource: str) -> None:
+        with self._lock:
+            if resource in self._registered:
+                return
+            self._registered.add(resource)
+            self._stores.setdefault(resource, {})
+        self.backend.watch(resource, self._handler(resource))
+
+    def register_kind(self, kind: str) -> None:
+        """Subscribe + prime the store for one job kind's CR objects
+        (idempotent; each FrameworkController registers its own kind)."""
+        self._register(kind)
+        self._prime(kind, lambda: self.backend.list_jobs(kind, self.namespace))
+
+    def _handler(self, resource: str):
+        def on_event(event_type: str, obj) -> None:
+            ns, name, rv = _meta(obj)
+            if self.namespace is not None and ns != self.namespace:
+                # Out-of-scope delta: covers() guarantees it could never
+                # be served, so storing it would only grow the store with
+                # other tenants' churn, unbounded.
+                return
+            with self._lock:
+                store = self._stores[resource]
+                if event_type == DELETED:
+                    store.pop((ns, name), None)
+                    if resource not in self._primed:
+                        self._tombstones[(resource, ns, name)] = rv
+                elif event_type in _UPSERTS:
+                    current = store.get((ns, name))
+                    if current is None or _meta(current)[2] <= rv:
+                        store[(ns, name)] = obj
+                self._bookmarks[resource] = max(
+                    self._bookmarks.get(resource, 0), rv
+                )
+
+        return on_event
+
+    def _prime(self, resource: str, lister) -> None:
+        """Initial LIST, merged under the watch-before-list rule: deltas
+        already flowing win on rv, tombstoned deletions never resurrect."""
+        with self._lock:
+            if resource in self._primed:
+                return
+        listed = lister()
+        with self._lock:
+            if resource in self._primed:
+                return
+            store = self._stores[resource]
+            for obj in listed:
+                ns, name, rv = _meta(obj)
+                if self._tombstones.get((resource, ns, name), -1) >= rv:
+                    continue
+                current = store.get((ns, name))
+                if current is None or _meta(current)[2] < rv:
+                    store[(ns, name)] = obj
+                self._bookmarks[resource] = max(
+                    self._bookmarks.get(resource, 0), rv
+                )
+            self._primed.add(resource)
+            self._tombstones = {
+                k: v for k, v in self._tombstones.items() if k[0] != resource
+            }
+
+    def ensure_primed(self, resource: str) -> None:
+        if resource == "pods":
+            self._prime(resource, lambda: self.backend.list_pods(
+                namespace=self.namespace))
+        elif resource == "services":
+            self._prime(resource, lambda: self.backend.list_services(
+                namespace=self.namespace))
+        else:
+            self._prime(resource, lambda: self.backend.list_jobs(
+                resource, self.namespace))
+
+    # -------------------------------------------------------------- reads
+    def bookmark(self, resource: str) -> int:
+        """Highest resourceVersion applied to `resource`'s store — the
+        watermark a resuming watch would start from."""
+        with self._lock:
+            return self._bookmarks.get(resource, 0)
+
+    def primed(self, resource: str) -> bool:
+        with self._lock:
+            return resource in self._primed
+
+    def covers(self, namespace: Optional[str]) -> bool:
+        """Whether a read scoped to `namespace` can be served from this
+        cache's scope (an all-namespace cache covers everything; a scoped
+        cache only its own namespace)."""
+        return self.namespace is None or (
+            namespace is not None and namespace == self.namespace
+        )
+
+    def list_objects(self, resource: str, namespace=None, labels=None,
+                     owner_uid=None) -> list:
+        self.ensure_primed(resource)
+        with self._lock:
+            snapshot = list(self._stores[resource].values())
+        out = []
+        for obj in snapshot:
+            ns, _, _ = _meta(obj)
+            if namespace is not None and ns != namespace:
+                continue
+            if not isinstance(obj, dict) and not base.matches_claim_view(
+                obj, labels, owner_uid
+            ):
+                continue
+            out.append(_copy(obj))
+        return out
+
+    def get_object(self, resource: str, namespace: str, name: str):
+        self.ensure_primed(resource)
+        with self._lock:
+            obj = self._stores[resource].get((namespace, name))
+        if obj is None:
+            raise NotFound(f"{resource} {namespace}/{name}")
+        return _copy(obj)
+
+
+class WatchCacheCluster:
+    """Per-controller proxy serving the hot-path reads from a
+    SharedWatchCache; everything else — writes, watches, uncached reads —
+    delegates to `inner` (the controller's accounted/throttled chain), so
+    a cache hit costs zero apiserver requests, exactly like an informer
+    read in the reference."""
+
+    def __init__(self, inner, cache: SharedWatchCache, kind: str):
+        self._inner = inner
+        self._cache = cache
+        self._kind = kind
+        cache.register_kind(kind)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    # ------------------------------------------------------------- reads
+    def list_pods(self, namespace=None, labels=None, owner_uid=None):
+        if not self._cache.covers(namespace):
+            return self._inner.list_pods(
+                namespace=namespace, labels=labels, owner_uid=owner_uid)
+        return self._cache.list_objects(
+            "pods", namespace=namespace, labels=labels, owner_uid=owner_uid)
+
+    def list_services(self, namespace=None, labels=None, owner_uid=None):
+        if not self._cache.covers(namespace):
+            return self._inner.list_services(
+                namespace=namespace, labels=labels, owner_uid=owner_uid)
+        return self._cache.list_objects(
+            "services", namespace=namespace, labels=labels,
+            owner_uid=owner_uid)
+
+    def get_pod(self, namespace: str, name: str):
+        if not self._cache.covers(namespace):
+            return self._inner.get_pod(namespace, name)
+        return self._cache.get_object("pods", namespace, name)
+
+    def get_service(self, namespace: str, name: str):
+        if not self._cache.covers(namespace):
+            return self._inner.get_service(namespace, name)
+        return self._cache.get_object("services", namespace, name)
+
+    def get_job(self, kind: str, namespace: str, name: str) -> dict:
+        # Only the proxy's own kind is cached (each controller registers
+        # exactly its kind); a cross-kind read (SDK helpers) delegates.
+        if kind != self._kind or not self._cache.covers(namespace):
+            return self._inner.get_job(kind, namespace, name)
+        return self._cache.get_object(kind, namespace, name)
+
+    def list_jobs(self, kind: str, namespace=None):
+        if kind != self._kind or not self._cache.covers(namespace):
+            return self._inner.list_jobs(kind, namespace)
+        return self._cache.list_objects(kind, namespace=namespace)
+
+    # get_job_uncached deliberately NOT overridden: the adoption UID
+    # recheck depends on bypassing every cache layer (__getattr__ hands
+    # it straight to the inner chain).

@@ -86,6 +86,7 @@ class FrameworkController(FrameworkHooks):
         namespace: str = "",
         limiter: Optional[TokenBucket] = None,
         tracer=None,
+        watch_cache=None,
     ):
         opts = options or EngineOptions()
         if metrics is None:
@@ -117,6 +118,20 @@ class FrameworkController(FrameworkHooks):
             from ..cluster.throttled import ThrottledCluster
 
             cluster = ThrottledCluster(cluster, limiter)
+        # Shared watch cache (cluster/watchcache.py), outermost on
+        # purpose: a cache-served list/get never reaches the accounting
+        # or throttle layers — zero apiserver requests, exactly like an
+        # informer read. The manager passes one SharedWatchCache so all
+        # framework controllers fan over a single store; standalone
+        # construction (tests, benches driving one controller directly)
+        # stays uncached unless the caller passes one — the backend's
+        # supports_watch_cache capability gates it either way.
+        if watch_cache is not None and getattr(
+            watch_cache.backend, "supports_watch_cache", False
+        ):
+            from ..cluster.watchcache import WatchCacheCluster
+
+            cluster = WatchCacheCluster(cluster, watch_cache, self.kind)
         self.cluster = cluster
         # `queue or WorkQueue()` would DROP an injected queue: WorkQueue
         # defines __len__, so an empty (= freshly constructed) queue is
@@ -156,6 +171,8 @@ class FrameworkController(FrameworkHooks):
             on_force_delete=self._record_force_delete,
             on_fanout_batch=self._record_fanout_batch,
             on_fanout_abort=self._record_fanout_abort,
+            on_status_coalesced=self._record_status_coalesced,
+            on_status_flush=self._record_status_flush,
             tracer=tracer,
         )
         # Queue-wait observer (enqueue -> worker pop), fed straight into
@@ -287,6 +304,12 @@ class FrameworkController(FrameworkHooks):
 
     def _record_fanout_abort(self, resource: str) -> None:
         self.metrics.fanout_abort_inc(self.kind, resource)
+
+    def _record_status_coalesced(self, job: JobObject) -> None:
+        self.metrics.status_coalesced_inc(job.namespace, self.kind)
+
+    def _record_status_flush(self, job: JobObject, age: float) -> None:
+        self.metrics.observe_status_flush_latency(job.namespace, self.kind, age)
 
     def _observe_queue_wait(self, item: str, seconds: float) -> None:
         self.metrics.observe_queue_wait(self.kind, seconds)

@@ -233,18 +233,28 @@ class TokenBucket:
 
 
 class PodControl:
-    def create_pod(self, namespace: str, pod: Pod, job: JobObject) -> None:
+    """``quiet=True`` suppresses the per-object SuccessfulCreate/Delete
+    event — the engine's batched fan-out paths pass it under write
+    coalescing and record ONE aggregated event per batch instead of
+    gang-size of them (the client-go EventAggregator idea, applied at
+    the batch boundary where the aggregate is already known)."""
+
+    def create_pod(self, namespace: str, pod: Pod, job: JobObject,
+                   quiet: bool = False) -> None:
         raise NotImplementedError
 
-    def delete_pod(self, namespace: str, name: str, job: JobObject) -> None:
+    def delete_pod(self, namespace: str, name: str, job: JobObject,
+                   quiet: bool = False) -> None:
         raise NotImplementedError
 
 
 class ServiceControl:
-    def create_service(self, namespace: str, service: Service, job: JobObject) -> None:
+    def create_service(self, namespace: str, service: Service, job: JobObject,
+                       quiet: bool = False) -> None:
         raise NotImplementedError
 
-    def delete_service(self, namespace: str, name: str, job: JobObject) -> None:
+    def delete_service(self, namespace: str, name: str, job: JobObject,
+                       quiet: bool = False) -> None:
         raise NotImplementedError
 
 
@@ -252,10 +262,13 @@ class RealPodControl(PodControl):
     def __init__(self, cluster: Cluster):
         self.cluster = cluster
 
-    def create_pod(self, namespace: str, pod: Pod, job: JobObject) -> None:
+    def create_pod(self, namespace: str, pod: Pod, job: JobObject,
+                   quiet: bool = False) -> None:
         pod.metadata.namespace = namespace
         pod.metadata.owner_references.append(owner_ref_for(job))
         self.cluster.create_pod(pod)
+        if quiet:
+            return
         record_event_best_effort(
             self.cluster,
             Event(
@@ -266,8 +279,11 @@ class RealPodControl(PodControl):
             )
         )
 
-    def delete_pod(self, namespace: str, name: str, job: JobObject) -> None:
+    def delete_pod(self, namespace: str, name: str, job: JobObject,
+                   quiet: bool = False) -> None:
         self.cluster.delete_pod(namespace, name)
+        if quiet:
+            return
         record_event_best_effort(
             self.cluster,
             Event(
@@ -283,10 +299,13 @@ class RealServiceControl(ServiceControl):
     def __init__(self, cluster: Cluster):
         self.cluster = cluster
 
-    def create_service(self, namespace: str, service: Service, job: JobObject) -> None:
+    def create_service(self, namespace: str, service: Service, job: JobObject,
+                       quiet: bool = False) -> None:
         service.metadata.namespace = namespace
         service.metadata.owner_references.append(owner_ref_for(job))
         self.cluster.create_service(service)
+        if quiet:
+            return
         record_event_best_effort(
             self.cluster,
             Event(
@@ -297,8 +316,11 @@ class RealServiceControl(ServiceControl):
             )
         )
 
-    def delete_service(self, namespace: str, name: str, job: JobObject) -> None:
+    def delete_service(self, namespace: str, name: str, job: JobObject,
+                       quiet: bool = False) -> None:
         self.cluster.delete_service(namespace, name)
+        if quiet:
+            return
         record_event_best_effort(
             self.cluster,
             Event(
@@ -319,14 +341,16 @@ class FakePodControl(PodControl):
         self.pods_deleted: List[str] = []
         self.create_error: Optional[Exception] = None
 
-    def create_pod(self, namespace: str, pod: Pod, job: JobObject) -> None:
+    def create_pod(self, namespace: str, pod: Pod, job: JobObject,
+                   quiet: bool = False) -> None:
         if self.create_error is not None:
             raise self.create_error
         pod.metadata.namespace = namespace
         pod.metadata.owner_references.append(owner_ref_for(job))
         self.pods_created.append(pod)
 
-    def delete_pod(self, namespace: str, name: str, job: JobObject) -> None:
+    def delete_pod(self, namespace: str, name: str, job: JobObject,
+                   quiet: bool = False) -> None:
         self.pods_deleted.append(f"{namespace}/{name}")
 
 
@@ -335,10 +359,12 @@ class FakeServiceControl(ServiceControl):
         self.services_created: List[Service] = []
         self.services_deleted: List[str] = []
 
-    def create_service(self, namespace: str, service: Service, job: JobObject) -> None:
+    def create_service(self, namespace: str, service: Service, job: JobObject,
+                       quiet: bool = False) -> None:
         service.metadata.namespace = namespace
         service.metadata.owner_references.append(owner_ref_for(job))
         self.services_created.append(service)
 
-    def delete_service(self, namespace: str, name: str, job: JobObject) -> None:
+    def delete_service(self, namespace: str, name: str, job: JobObject,
+                       quiet: bool = False) -> None:
         self.services_deleted.append(f"{namespace}/{name}")

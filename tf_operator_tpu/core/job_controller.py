@@ -387,6 +387,31 @@ class EngineOptions:
     # resolve_sync_workers: the chaos/crash/process fault tiers pin the
     # pool to 1 so every seeded schedule stays byte-reproducible.
     sync_workers: int = 4
+    # Write coalescing (apiserver write-pressure collapse): status writes
+    # go out as single-request patches (patch_job_status), pure
+    # replica-count churn is buffered per job behind a rate-limited flush
+    # (status_flush_interval), and batched create/delete fan-outs record
+    # ONE aggregated event instead of gang-size of them. ANDed with the
+    # cluster seam's supports_write_coalescing by
+    # resolve_write_coalescing — the chaos/crash/process fault tiers pin
+    # it off so their (method, call-index)-keyed schedules replay
+    # byte-identically. Counted writes (restart ledgers, handled-uid
+    # stamps, terminal/suspension conditions) are NEVER deferred: the
+    # count-before-teardown protocol needs them durable, synchronous and
+    # in order regardless of this flag.
+    write_coalescing: bool = True
+    status_flush_interval: float = 1.0
+
+
+def resolve_write_coalescing(options: EngineOptions, cluster) -> bool:
+    """Effective write-coalescing verdict for one engine over one cluster
+    seam: the requested EngineOptions.write_coalescing ANDed with the
+    seam's supports_write_coalescing capability. Single-sourced like
+    resolve_sync_workers so the engine, the operator manager, and the
+    regression tests cannot drift on the gating rule."""
+    return bool(getattr(options, "write_coalescing", False)) and bool(
+        getattr(cluster, "supports_write_coalescing", False)
+    )
 
 
 def resolve_sync_workers(options: EngineOptions, cluster) -> int:
@@ -420,6 +445,8 @@ class JobController:
         on_force_delete: Optional[Callable[[JobObject, str], None]] = None,
         on_fanout_batch: Optional[Callable[[str, int], None]] = None,
         on_fanout_abort: Optional[Callable[[str], None]] = None,
+        on_status_coalesced: Optional[Callable[[JobObject], None]] = None,
+        on_status_flush: Optional[Callable[[JobObject, float], None]] = None,
         tracer=None,
     ):
         self.hooks = hooks
@@ -446,6 +473,23 @@ class JobController:
         # controller exports them as the fanout batch/abort counters.
         self.on_fanout_batch = on_fanout_batch or (lambda resource, size: None)
         self.on_fanout_abort = on_fanout_abort or (lambda resource: None)
+        # (job,) once per status write absorbed by the coalescing buffer,
+        # and (job, dirty age seconds) once per flush of a previously
+        # dirty buffer — exported as status_writes_coalesced_total and
+        # the status_write_flush_latency_seconds histogram.
+        self.on_status_coalesced = on_status_coalesced or (lambda job: None)
+        self.on_status_flush = on_status_flush or (lambda job, age: None)
+        # Write coalescing, resolved once against the seam's capability
+        # (the chaos/crash/process tiers pin it off; see EngineOptions).
+        self._coalescing = resolve_write_coalescing(self.options, cluster)
+        # (job key, uid) -> clock() of the last status flush that reached
+        # the apiserver, and -> clock() when the oldest still-unflushed
+        # coalesced churn was deferred. Guarded by _status_lock (writes
+        # happen on sync workers; forget_job prunes from the watch
+        # thread). Pruned via forget_job, like every per-job cache here.
+        self._status_last_flush: Dict[tuple, float] = {}
+        self._status_dirty_since: Dict[tuple, float] = {}
+        self._status_lock = threading.Lock()
         # (job key, uid) -> {pod uid: _HeartbeatState}: the liveness
         # observation cache. In-memory by design — an operator restart (or
         # leader failover) restarts every staleness clock from its own
@@ -518,6 +562,11 @@ class JobController:
                 self._hb_gc_done.discard(cache_key)
             for cache_key in [k for k in self._force_deleted if k[0] == key]:
                 self._force_deleted.discard(cache_key)
+        with self._status_lock:
+            for cache_key in [k for k in self._status_last_flush if k[0] == key]:
+                self._status_last_flush.pop(cache_key, None)
+            for cache_key in [k for k in self._status_dirty_since if k[0] == key]:
+                self._status_dirty_since.pop(cache_key, None)
 
     # ------------------------------------------------------------- listing
     def get_pods_for_job(self, job: JobObject) -> List[Pod]:
@@ -569,7 +618,14 @@ class JobController:
 
         Adoption/release write failures are narrowed to NotFound/Conflict
         (the object moved under us — skip this sync, the watch re-enqueues);
-        real API errors propagate to the rate-limited queue."""
+        real API errors propagate to the rate-limited queue.
+
+        No-op write dedup: a release whose live re-read shows our ref
+        already gone, and an adoption Conflict whose live object already
+        carries our controllerRef + labels, skip the UPDATE entirely —
+        the desired state is already true and re-writing it is pure
+        apiserver write pressure (each skip shows up as one fewer
+        update in the accounting counters)."""
         from ..cluster.base import Conflict, NotFound
         from .control import owner_ref_for
 
@@ -609,7 +665,30 @@ class JobController:
             obj.metadata.owner_references.append(owner_ref_for(job))
             try:
                 obj = update(obj)
-            except (NotFound, Conflict):
+            except NotFound:
+                continue
+            except Conflict:
+                # The object moved under us. If the LIVE object already
+                # carries our controllerRef with matching labels (a prior
+                # adoption landed but its response was lost, or another
+                # worker won the race to the same verdict), the desired
+                # state is already true — keep it without burning another
+                # UPDATE on a no-op re-adopt next sync. One extra GET,
+                # paid only on the conflict path.
+                try:
+                    live = get_live(obj.metadata.namespace, obj.metadata.name)
+                except NotFound:
+                    continue
+                live_ref = live.metadata.controller_ref()
+                if (
+                    live_ref is not None
+                    and live_ref.uid == job.metadata.uid
+                    and all(
+                        live.metadata.labels.get(k) == v
+                        for k, v in selector.items()
+                    )
+                ):
+                    out.append(live)
                 continue
             out.append(obj)
         return out
@@ -626,9 +705,17 @@ class JobController:
             return
         if live.metadata.uid != obj.metadata.uid:
             return
-        live.metadata.owner_references = [
+        kept = [
             r for r in live.metadata.owner_references if r.uid != job.metadata.uid
         ]
+        if len(kept) == len(live.metadata.owner_references):
+            # The live object already carries no ref of ours (the release
+            # landed in an earlier sync whose response was lost, or the
+            # listing was cache-stale): writing back an unchanged object
+            # would be a pure no-op UPDATE — skip it. Each skip is one
+            # apiserver write saved, visible in the accounting counters.
+            return
+        live.metadata.owner_references = kept
         try:
             update(live)
         except (NotFound, Conflict):
@@ -1118,19 +1205,35 @@ class JobController:
             if pod is not trigger and pod.metadata.deletion_timestamp is None
         ]
         delete_errors: List[tuple] = []
+        # Event aggregation (write coalescing): one SuccessfulDeletePod
+        # event for the whole teardown instead of one per member — the
+        # Restarting Warning the caller records already names the
+        # incident; gang-size delete-event writes are pure pressure.
+        quiet = self._coalescing and len(targets) > 1
 
         def delete_one(i: int) -> None:
             try:
-                self._delete_pod(job, victims[i])
+                self._delete_pod(job, victims[i], quiet=quiet)
             except Exception as exc:  # noqa: BLE001 — recorded, not aborting
                 delete_errors.append((victims[i].metadata.name, exc))
 
         self._batch_write("pods", len(victims), delete_one)
+        # list.append is atomic under the GIL, so the error count is safe
+        # to read after the batch even though delete_one ran on pool
+        # threads; the deleted tally derives from it.
+        deleted = len(victims) - len(delete_errors)
         if not delete_errors and trigger.metadata.deletion_timestamp is None:
             try:
-                self._delete_pod(job, trigger)
+                self._delete_pod(job, trigger, quiet=quiet)
+                deleted += 1
             except Exception as exc:  # noqa: BLE001
                 delete_errors.append((trigger.metadata.name, exc))
+        if quiet and deleted:
+            self._record_batch_event(
+                job, constants.REASON_SUCCESSFUL_DELETE_POD,
+                f"Deleted {deleted} pod(s) (gang teardown, "
+                f"trigger {trigger.metadata.name})",
+            )
         return delete_errors
 
     @staticmethod
@@ -1639,6 +1742,32 @@ class JobController:
             self.on_fanout_abort(resource)
         return successes, err
 
+    def _record_batch_event(self, job: JobObject, reason: str,
+                            message: str) -> None:
+        """One aggregated Normal event for a whole create/delete batch —
+        the write-coalescing replacement for gang-size per-object events
+        (single-sourced so the five batch paths cannot drift)."""
+        record_event_best_effort(
+            self.cluster,
+            Event(
+                type="Normal",
+                reason=reason,
+                message=message,
+                involved_object=f"{job.kind}/{job.key()}",
+            ),
+        )
+
+    @staticmethod
+    def _batch_range(names: List[str], successes: int, total: int) -> str:
+        """Human suffix for an aggregated batch event: the name range is
+        only claimed when the WHOLE batch landed — under parallel
+        fan-out a partial batch's successes are not a prefix of the work
+        list, so naming `names[successes-1]` would cite an object that
+        may never have been created."""
+        if successes == total and names:
+            return f" ({names[0]} .. {names[-1]})" if len(names) > 1 else f" ({names[0]})"
+        return ""
+
     def _record_fanout_wave(self, resource: str, size: int) -> None:
         """One slow-start wave issued: counter + a point event on the
         active span (on_batch fires on the coordinating sync thread, so
@@ -1673,10 +1802,25 @@ class JobController:
             for index in indices
         ]
         self.expectations.expect_creations(key, "pods", len(pods))
+        # Event aggregation (write coalescing): a multi-pod fan-out
+        # records ONE SuccessfulCreatePod event for the whole batch
+        # instead of gang-size of them — at 32 replicas the per-create
+        # event stream alone used to cost as many apiserver writes as
+        # the pods themselves. Single creates keep the per-pod event
+        # (no pressure to collapse, and the message stays precise).
+        quiet = self._coalescing and len(pods) > 1
         successes, err = self._batch_write(
             "pods", len(pods),
-            lambda i: self.pod_control.create_pod(job.namespace, pods[i], job),
+            lambda i: self.pod_control.create_pod(
+                job.namespace, pods[i], job, quiet=quiet
+            ),
         )
+        if quiet and successes:
+            self._record_batch_event(
+                job, constants.REASON_SUCCESSFUL_CREATE_POD,
+                f"Created {successes} {rtype} pod(s)" + self._batch_range(
+                    [p.metadata.name for p in pods], successes, len(pods)),
+            )
         if err is not None:
             for _ in range(len(pods) - successes):
                 self.expectations.creation_observed(key, "pods")
@@ -1698,6 +1842,11 @@ class JobController:
             job_status._deferred_deletes = []  # direct callers (tests)
         typed_pods = filter_pods_for_replica_type(pods, rtype)
         num_replicas = spec.replicas or 0
+        # Rebuilt fresh for every type the SPEC declares — never pruned
+        # key-by-key. KubeCluster.patch_job_status relies on this: its
+        # merge-patch cannot clear an individual sub-key of a kept map,
+        # only whole top-level fields (see its docstring before adding
+        # any path that removes single replicaStatuses entries).
         job_status.replica_statuses[rtype] = capi.ReplicaStatus()
 
         slices = get_pod_slices(typed_pods, num_replicas)
@@ -1887,16 +2036,19 @@ class JobController:
 
         return Pod(metadata=template.metadata, spec=template.spec)
 
-    def _delete_pod(self, job: JobObject, pod: Pod) -> None:
+    def _delete_pod(self, job: JobObject, pod: Pod, quiet: bool = False) -> None:
         key = job.key()
         self.expectations.expect_deletions(key, "pods", 1)
         try:
-            self.pod_control.delete_pod(pod.metadata.namespace, pod.metadata.name, job)
+            self.pod_control.delete_pod(
+                pod.metadata.namespace, pod.metadata.name, job, quiet=quiet
+            )
         except Exception:
             self.expectations.deletion_observed(key, "pods")
             raise
 
-    def _delete_service(self, job: JobObject, svc: Service) -> None:
+    def _delete_service(self, job: JobObject, svc: Service,
+                        quiet: bool = False) -> None:
         """Delete one service under the SAME expectation protocol as
         _delete_pod. Service deletions used to bypass expect_deletions
         entirely, so a slow service delete could never gate the next sync
@@ -1909,7 +2061,7 @@ class JobController:
         self.expectations.expect_deletions(key, "services", 1)
         try:
             self.service_control.delete_service(
-                svc.metadata.namespace, svc.metadata.name, job
+                svc.metadata.namespace, svc.metadata.name, job, quiet=quiet
             )
         except Exception:
             self.expectations.deletion_observed(key, "services")
@@ -1931,16 +2083,32 @@ class JobController:
             if policy != capi.CLEAN_POD_POLICY_RUNNING
             or pod.status.phase in (POD_RUNNING, POD_PENDING)
         ]
-        _, err = self._batch_write(
-            "pods", len(doomed), lambda i: self._delete_pod(job, doomed[i])
+        # Aggregated teardown events under write coalescing (the
+        # _create_pods_batch rule, mirrored): one event per cleanup
+        # batch, not one per object.
+        quiet_pods = self._coalescing and len(doomed) > 1
+        successes, err = self._batch_write(
+            "pods", len(doomed),
+            lambda i: self._delete_pod(job, doomed[i], quiet=quiet_pods),
         )
+        if quiet_pods and successes:
+            self._record_batch_event(
+                job, constants.REASON_SUCCESSFUL_DELETE_POD,
+                f"Deleted {successes} pod(s) (cleanup policy {policy})",
+            )
         if err is not None:
             raise err
         services = self.get_services_for_job(job)
-        _, err = self._batch_write(
+        quiet_svcs = self._coalescing and len(services) > 1
+        successes, err = self._batch_write(
             "services", len(services),
-            lambda i: self._delete_service(job, services[i]),
+            lambda i: self._delete_service(job, services[i], quiet=quiet_svcs),
         )
+        if quiet_svcs and successes:
+            self._record_batch_event(
+                job, constants.REASON_SUCCESSFUL_DELETE_SERVICE,
+                f"Deleted {successes} service(s) (cleanup policy {policy})",
+            )
         if err is not None:
             raise err
 
@@ -1974,12 +2142,24 @@ class JobController:
             ]
             key = job.key()
             self.expectations.expect_creations(key, "services", len(services))
+            # One aggregated SuccessfulCreateService event per multi-
+            # service fan-out (the _create_pods_batch event-aggregation
+            # rule, identically applied).
+            quiet = self._coalescing and len(services) > 1
             successes, err = self._batch_write(
                 "services", len(services),
                 lambda i: self.service_control.create_service(
-                    job.namespace, services[i], job
+                    job.namespace, services[i], job, quiet=quiet
                 ),
             )
+            if quiet and successes:
+                self._record_batch_event(
+                    job, constants.REASON_SUCCESSFUL_CREATE_SERVICE,
+                    f"Created {successes} {rtype} service(s)"
+                    + self._batch_range(
+                        [s.metadata.name for s in services],
+                        successes, len(services)),
+                )
             if err is not None:
                 for _ in range(len(services) - successes):
                     self.expectations.creation_observed(key, "services")
@@ -2289,11 +2469,103 @@ class JobController:
             )
 
     # -------------------------------------------------------------- status
+    # Status keys whose change may be COALESCED: pure bring-up/teardown
+    # churn (per-type active/succeeded/failed counters flapping pod by
+    # pod) plus the write timestamp itself. EVERYTHING else — conditions,
+    # the three restart ledgers, the gang handled-uid stamp, start/
+    # completion times, backoff windows — flushes synchronously: those
+    # fields are the count-before-teardown protocol's durable evidence
+    # and the API contract consumers watch, and a deferred write there
+    # would open exactly the crash windows PR 3 closed. Camel-cased (the
+    # to_dict wire names) because the delta is computed on serialized
+    # snapshots.
+    _COALESCIBLE_STATUS_KEYS = frozenset({
+        "replicaStatuses", "lastReconcileTime",
+    })
+
     def _write_status_if_changed(self, job: JobObject, old_status: JobStatus) -> None:
-        if to_dict(job.status) == to_dict(old_status):
+        """Persist job.status iff it differs from what the cluster holds.
+
+        Legacy path (write_coalescing off — chaos/crash/process seams and
+        the --disable-write-coalescing lever): one synchronous full-object
+        update_job_status per changed sync, byte-identical to the
+        pre-coalescing engine.
+
+        Coalesced path (resolve_write_coalescing True): writes go out as
+        single-request status patches (patch_job_status), and a delta
+        confined to _COALESCIBLE_STATUS_KEYS inside the per-job rate
+        window (options.status_flush_interval since the last flush) is
+        BUFFERED instead of written: the cluster copy stays intentionally
+        stale, a requeue is scheduled for the window's close, and the
+        flush sync re-derives the status from scratch — so the buffer is
+        the knowledge that the stored copy is behind, never a second
+        source of truth, and a crash loses nothing but churn the next
+        sync recomputes. Any non-coalescible delta (conditions, ledgers,
+        stamps — the counted writes' superset) flushes immediately and in
+        order, carrying every previously deferred change with it.
+
+        Propagate write failures either way: the caller's rate-limited
+        queue must retry, or a terminal condition computed here is lost
+        forever (a finished job emits no further events to trigger
+        another sync)."""
+        old_d = to_dict(old_status)
+        new_d = to_dict(job.status)
+        key = (job.key(), job.metadata.uid)
+        if new_d == old_d:
+            # The stored copy IS current: deferred churn (if any) either
+            # flushed with an intervening write or reverted — drop the
+            # dirty marker, or a much later flush would report its age
+            # as a bogus multi-hour flush latency.
+            with self._status_lock:
+                self._status_dirty_since.pop(key, None)
             return
+        if self._coalescing:
+            changed = {
+                k for k in set(old_d) | set(new_d)
+                if old_d.get(k) != new_d.get(k)
+            }
+            if changed <= {"lastReconcileTime"}:
+                # Write-timestamp-only churn is a no-op, never a write —
+                # and nothing meaningful is pending (same stale-marker
+                # rule as the equal case above).
+                with self._status_lock:
+                    self._status_dirty_since.pop(key, None)
+                return
+            if changed <= self._COALESCIBLE_STATUS_KEYS:
+                now = self.clock()
+                with self._status_lock:
+                    last = self._status_last_flush.get(key)
+                    defer = (
+                        last is not None
+                        and now - last < self.options.status_flush_interval
+                    )
+                    if defer:
+                        self._status_dirty_since.setdefault(key, now)
+                        wake = self.options.status_flush_interval - (now - last)
+                if defer:
+                    self.on_status_coalesced(job)
+                    # The flush ride: a watch event cannot be counted on
+                    # (deferred churn generates none), so the window's
+                    # close schedules its own resync, which re-derives
+                    # the status and finds the stored copy behind.
+                    self.requeue(f"{job.kind}:{job.key()}", wake + 0.05)
+                    return
         job.status.last_reconcile_time = self.clock()
-        # Propagate write failures: the caller's rate-limited queue must
-        # retry, or a terminal condition computed here is lost forever (a
-        # finished job emits no further events to trigger another sync).
-        self.cluster.update_job_status(job.kind, job.namespace, job.name, to_dict(job.status))
+        # new_d was serialized above and only the stamp moved since:
+        # patch it in place instead of re-walking the whole status tree
+        # (this is the hottest write path of a large gang's bring-up).
+        new_d["lastReconcileTime"] = job.status.last_reconcile_time
+        if self._coalescing:
+            self.cluster.patch_job_status(
+                job.kind, job.namespace, job.name, new_d
+            )
+        else:
+            self.cluster.update_job_status(
+                job.kind, job.namespace, job.name, new_d
+            )
+        now = self.clock()
+        with self._status_lock:
+            self._status_last_flush[key] = now
+            dirty_since = self._status_dirty_since.pop(key, None)
+        if dirty_since is not None:
+            self.on_status_flush(job, max(0.0, now - dirty_since))

@@ -45,7 +45,7 @@ from collections import OrderedDict, deque
 from contextlib import contextmanager
 from typing import Dict, List, Optional, Tuple
 
-WRITE_VERBS = frozenset({"create", "update", "delete"})
+WRITE_VERBS = frozenset({"create", "update", "patch", "delete"})
 
 
 class Span:
@@ -415,6 +415,19 @@ class Tracer:
     def total_writes(self) -> int:
         with self._lock:
             return sum(t.writes for t in self._traces.values())
+
+    def total_writes_by_resource(self) -> Dict[str, int]:
+        """Attributed write counts aggregated per resource — what lets the
+        scale benchmark split writes-per-converged-job into its structural
+        floor (pod/service creates) and the coalescible remainder
+        (events, status updates/patches) the write-pressure gate bounds."""
+        out: Dict[str, int] = {}
+        with self._lock:
+            for trace in self._traces.values():
+                for (verb, resource, _code), n in trace.requests.items():
+                    if verb in WRITE_VERBS:
+                        out[resource] = out.get(resource, 0) + n
+        return out
 
 
 # Process-wide default, like metrics.METRICS. Tests and benchmarks that

@@ -118,6 +118,15 @@ class Metrics:
             "unobserved remainder of its expectation batch and requeued "
             "rate-limited",
         ),
+        "training_operator_status_writes_coalesced_total": (
+            ("job_namespace", "framework"),
+            "Status writes absorbed by the per-job coalescing buffer "
+            "(core/job_controller.py write coalescing): the sync's status "
+            "delta was pure replica-count churn inside the rate window, so "
+            "no apiserver request was issued — a scheduled flush carries "
+            "it later. Each increment is one apiserver write saved; a "
+            "high rate with a low flush rate is the coalescer working",
+        ),
         "training_operator_apiserver_requests_total": (
             ("verb", "resource", "code"),
             "Apiserver requests issued through the cluster seam "
@@ -166,6 +175,13 @@ class Metrics:
         "training_operator_queue_wait_seconds": (
             0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30, 60,
         ),
+        # How long coalesced status churn sat dirty before its flush
+        # landed: bounded by status_flush_interval when healthy, so the
+        # buckets cluster around sub-second values; a tail past the
+        # interval means flush requeues are starving behind queue wait.
+        "training_operator_status_write_flush_latency_seconds": (
+            0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30,
+        ),
     }
 
     def __init__(self):
@@ -198,6 +214,8 @@ class Metrics:
                 # dimension (a queue serves every namespace): series are
                 # keyed ("", framework).
                 "training_operator_queue_wait_seconds",
+                # Dirty-buffer age at flush (write coalescing).
+                "training_operator_status_write_flush_latency_seconds",
             )
         }
         # Unlabeled gauges: leader flag etc. (legacy tf_operator_is_leader,
@@ -253,6 +271,24 @@ class Metrics:
         self._inc_labeled(
             "training_operator_sync_errors_total", namespace, framework, exception,
         )
+
+    def status_coalesced_inc(self, namespace: str, framework: str) -> None:
+        """One status write absorbed by the coalescing buffer (no
+        apiserver request issued this sync; a scheduled flush carries
+        the churn later)."""
+        self._inc_labeled(
+            "training_operator_status_writes_coalesced_total",
+            namespace, framework,
+        )
+
+    def observe_status_flush_latency(self, namespace: str, framework: str,
+                                     seconds: float) -> None:
+        """One coalesced buffer flushed: `seconds` is how long the oldest
+        deferred churn sat dirty before landing on the apiserver."""
+        with self._lock:
+            self._histograms[
+                "training_operator_status_write_flush_latency_seconds"
+            ][(namespace, framework)].observe(seconds)
 
     def apiserver_request_inc(self, verb: str, resource: str, code: str) -> None:
         """One apiserver request completed (any verb, any outcome)."""

@@ -196,7 +196,8 @@ def check_dependents_invariants(
 def check_span_invariants(traces: Sequence[dict]) -> List[str]:
     """Span-order invariants over a `Tracer.export()` payload. The one
     hard rule today: a counted gang restart's successful status write
-    (`api.update` child, resource=status, code=200) precedes every
+    (`api.update` or `api.patch` child, resource=status, code=200)
+    precedes every
     teardown pod delete (`api.delete` child, resource=pods) in span-id
     order — span ids are assigned at record time under one lock, so id
     order IS causal order. A counted span with deletes but no successful
@@ -212,9 +213,14 @@ def check_span_invariants(traces: Sequence[dict]) -> List[str]:
                 continue
             attrs = span.get("attrs") or {}
             children = by_parent.get(span.get("id"), [])
+            # api.update = the legacy full-object status write; api.patch
+            # = the coalescing writer's single-request apply. Either one
+            # satisfies the protocol — counted writes bypass coalescing's
+            # deferral but still flow through the patch verb when the
+            # capability is on, and the invariant must hold in both modes.
             status_writes = [
                 c["id"] for c in children
-                if c.get("name") == "api.update"
+                if c.get("name") in ("api.update", "api.patch")
                 and (c.get("attrs") or {}).get("resource") == "status"
                 and (c.get("attrs") or {}).get("code") == "200"
             ]

@@ -315,8 +315,17 @@ class StubApiServer:
             # .status ignored) are enforced by mem.update_job itself.
             return handler._json(200, self.mem.update_job(body))
         if method == "PATCH" and m["status"]:
-            status = handler._body().get("status", {})
-            return handler._json(200, self.mem.update_job_status(kind, ns, name, status))
+            # Merge-patch semantics: a null value deletes the key (the
+            # coalescing writer nulls cleared optional fields explicitly,
+            # KubeCluster.patch_job_status), everything else lands as
+            # sent. Routed to the store's patch verb so the single-request
+            # cost model matches a real apiserver's.
+            status = {
+                k: v
+                for k, v in (handler._body().get("status") or {}).items()
+                if v is not None
+            }
+            return handler._json(200, self.mem.patch_job_status(kind, ns, name, status))
         if method == "DELETE":
             self.mem.delete_job(kind, ns, name)
             return handler._json(200, {})
