@@ -298,6 +298,18 @@ def default_dag() -> List[Step]:
              pytest + ["tests/test_chaos.py", "tests/test_disruption.py",
                        "tests/test_stall.py", "-m", "not slow"],
              deps=["operator-integration"], retries=2),
+        # Shard-failover tier (docs/design/sharded_control_plane.md): the
+        # sharded active-active control plane — ring/coordinator protocol
+        # units, two-manager split/steal/handback integration, and the
+        # ShardFailoverDriver seeded scenarios (replica dies mid-gang-
+        # restart, survivor steals the shard, exactly-once ledgers +
+        # span-order audit across the migration; lease-steal and
+        # delayed-renew contested-claim windows). Fixed seeds,
+        # byte-reproducible; the randomized shard sweep rides chaos-sweep.
+        Step("shard-failover",
+             pytest + ["tests/test_sharding.py", "tests/test_shard_failover.py",
+                       "-m", "not slow"],
+             deps=["operator-integration"], retries=2),
         # Crash tier (docs/design/crash_consistency.md): the controller
         # itself dies at seeded CrashPoints (before/after-write variants)
         # and a cold-started replacement must converge every job with the
@@ -313,8 +325,10 @@ def default_dag() -> List[Step]:
         # The full randomized sweeps, serialized after the fixed seeds.
         Step("chaos-sweep",
              pytest + ["tests/test_chaos.py", "tests/test_stall.py",
-                       "tests/test_crash_failover.py", "-m", "slow"],
-             deps=["chaos-seeded", "crash-seeded"], retries=2),
+                       "tests/test_crash_failover.py",
+                       "tests/test_shard_failover.py", "-m", "slow"],
+             deps=["chaos-seeded", "crash-seeded", "shard-failover"],
+             retries=2),
         # Residency under sustained churn (VERDICT r4 #6): ~10 min of
         # create/churn/succeed/delete waves over the HTTP backend with two
         # leader-elected replicas; asserts the RSS plateau, reconcile p90,

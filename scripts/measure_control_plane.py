@@ -246,6 +246,41 @@ SMOKE_WRITES_REGRESSION = 1.10
 STATUS_FLUSH_INTERVAL = 0.25  # benchmark flush window (seconds)
 
 
+def _kubelet_sim(mem):
+    """Watch-driven kubelet sim over an InMemoryCluster: the watch
+    handler only ENQUEUES (running the Running-marking write inside the
+    create's own event dispatch would charge kubelet work to the write
+    path under measurement); a separate marker thread performs the phase
+    writes. Returns (stop_event, thread) — set and join to tear down."""
+    import threading
+
+    stop = threading.Event()
+    born: "list[tuple]" = []
+    born_lock = threading.Lock()
+
+    def on_pod(event_type, pod):
+        if event_type in ("ADDED", "SYNC") and pod.status.phase == "Pending":
+            with born_lock:
+                born.append((pod.metadata.namespace, pod.metadata.name))
+
+    mem.watch("pods", on_pod)
+
+    def pump():
+        while not stop.is_set():
+            with born_lock:
+                batch, born[:] = born[:], []
+            for ns, name in batch:
+                try:
+                    mem.set_pod_phase(ns, name, "Running")
+                except Exception:  # noqa: BLE001 — pod raced away
+                    pass
+            stop.wait(0.002)
+
+    thread = threading.Thread(target=pump, daemon=True)
+    thread.start()
+    return stop, thread
+
+
 def _measure_gang_bringup(gang, jobs, parallel, qps, burst, latency,
                           workers=4, timeout=120.0, coalescing=True):
     """One bring-up measurement: `jobs` TFJobs of `gang` replicas against
@@ -260,40 +295,11 @@ def _measure_gang_bringup(gang, jobs, parallel, qps, burst, latency,
     (--workers / MaxConcurrentReconciles); `coalescing` is the write-
     coalescing lever (False = the legacy per-object-event,
     update-per-sync write path, the PR 6 baseline's shape)."""
-    import threading
-
     from tf_operator_tpu.cluster.memory import InMemoryCluster
     from tf_operator_tpu.cluster.throttled import LatencyCluster
 
     mem = InMemoryCluster()
-    # Kubelet sim: the watch handler only ENQUEUES (cheap — running the
-    # Running-marking write inside the create's own event dispatch would
-    # charge kubelet work to the write path under measurement); a
-    # separate marker thread performs the phase writes.
-    stop_kubelet = threading.Event()
-    born: "list[tuple]" = []
-    born_lock = threading.Lock()
-
-    def on_pod(event_type, pod):
-        if event_type in ("ADDED", "SYNC") and pod.status.phase == "Pending":
-            with born_lock:
-                born.append((pod.metadata.namespace, pod.metadata.name))
-
-    mem.watch("pods", on_pod)
-
-    def kubelet_pump():
-        while not stop_kubelet.is_set():
-            with born_lock:
-                batch, born[:] = born[:], []
-            for ns, name in batch:
-                try:
-                    mem.set_pod_phase(ns, name, "Running")
-                except Exception:  # noqa: BLE001 — pod raced away
-                    pass
-            stop_kubelet.wait(0.002)
-
-    kubelet = threading.Thread(target=kubelet_pump, daemon=True)
-    kubelet.start()
+    stop_kubelet, kubelet = _kubelet_sim(mem)
     metrics = Metrics()
     tracer = Tracer()
     manager = OperatorManager(
@@ -434,6 +440,157 @@ def workers_main(workers_list, qps=0.0, burst=0, latency=0.01) -> int:
     return 0
 
 
+# ----------------------------------------------------- multi-replica legs
+
+# The sharded-control-plane sweep fixes shards and per-replica workers so
+# replica count is the only variable: a deliberately queue-wait-bound
+# load (the PR 4/5 100-job regime) with a SMALL per-replica pool, where
+# adding replicas is the only way to add sync capacity.
+REPLICA_SWEEP_SHARDS = 4
+REPLICA_SWEEP_WORKERS = 2
+
+
+def _measure_replica_bringup(gang, jobs, replicas, qps, burst, latency,
+                             workers=REPLICA_SWEEP_WORKERS,
+                             shards=REPLICA_SWEEP_SHARDS, timeout=None):
+    """One sharded-fleet bring-up: `replicas` OperatorManagers over ONE
+    InMemoryCluster, each claiming its lease-ranked shard subset
+    (--shards; replicas=1 runs shards=1 — the true single-leader
+    baseline, zero sharding machinery). Jobs are created only after the
+    full ring is claimed, so the measurement is steady-state capacity,
+    not claim latency. Returns (startups, makespan, total writes per
+    converged job across the fleet — lease coordination traffic rides
+    the raw seam and is invisible to it, like every other control-plane
+    internal read)."""
+    from tf_operator_tpu.cluster.memory import InMemoryCluster
+    from tf_operator_tpu.cluster.throttled import LatencyCluster
+
+    mem = InMemoryCluster()
+    stop_kubelet, kubelet = _kubelet_sim(mem)
+    managers, tracers = [], []
+    timeout = timeout or max(120.0, 3.0 * jobs)
+    try:
+        for r in range(replicas):
+            tracer = Tracer()
+            manager = OperatorManager(
+                LatencyCluster(mem, latency),
+                OperatorOptions(
+                    enabled_schemes=["TFJob"], health_port=0, metrics_port=0,
+                    threadiness=workers, resync_period=5.0,
+                    qps=qps, burst=burst,
+                    shards=shards if replicas > 1 else 1,
+                    replica_id=f"bench-r{r}",
+                    lease_duration=1.0,
+                    status_flush_interval=STATUS_FLUSH_INTERVAL,
+                ),
+                metrics=Metrics(), tracer=tracer,
+            )
+            manager.start()
+            managers.append(manager)
+            tracers.append(tracer)
+        if replicas > 1:
+            ring = set(range(shards))
+
+            def fully_claimed():
+                owned = []
+                for m in managers:
+                    owned.extend(m.coordinator.owned_shards())
+                return set(owned) == ring and len(owned) == shards
+
+            if not wait_for(fully_claimed, 30.0):
+                raise SystemExit(
+                    "replica sweep: the shard ring never settled "
+                    f"({[m.coordinator.owned_shards() for m in managers]})"
+                )
+        startups = []
+        makespan = 0.0
+        created = []
+        t_sweep = time.monotonic()
+        for i in range(jobs):
+            name = f"g{i}"
+            created.append((name, time.monotonic()))
+            mem.create_job(manifest(name, workers=gang))
+        deadline = time.monotonic() + timeout
+        pending = dict(created)
+        while pending and time.monotonic() < deadline:
+            running = {}
+            for pod in mem.list_pods("default"):
+                if pod.status.phase == "Running":
+                    jn = pod.metadata.labels.get("job-name", "")
+                    running[jn] = running.get(jn, 0) + 1
+            now = time.monotonic()
+            for name in [n for n, _ in created if n in pending]:
+                if running.get(name, 0) >= gang:
+                    startups.append(now - pending.pop(name))
+            if not pending:
+                makespan = now - t_sweep
+            time.sleep(0.01)
+        if pending:
+            raise SystemExit(
+                f"replica sweep: {len(pending)} job(s) never came up within "
+                f"{timeout}s (replicas={replicas})"
+            )
+        # Drain trailing coalesced flushes (same reason as the gang legs).
+        time.sleep(STATUS_FLUSH_INTERVAL + 0.3)
+    finally:
+        stop_kubelet.set()
+        for manager in managers:
+            manager.stop()
+        kubelet.join(timeout=5)
+    writes_per_job = round(
+        sum(t.total_writes() for t in tracers) / max(jobs, 1), 2)
+    return startups, makespan, writes_per_job
+
+
+def replicas_main(replicas_list, qps=0.0, burst=0, latency=0.01) -> int:
+    """The sharded-fleet sweep (--mode scale --replicas 1,2,4): the
+    100-job queue-bound load at a fixed small per-replica worker pool,
+    replica count the only variable. Horizontal capacity: makespan must
+    fall as replicas rise, and writes-per-converged-job must hold flat —
+    sharding splits the work, it may not duplicate any of it."""
+    gang, jobs = 8, 100
+    results = []
+    for replicas in replicas_list:
+        startups, makespan, writes = _measure_replica_bringup(
+            gang, jobs, replicas, qps, burst, latency)
+        results.append({
+            "replicas": replicas,
+            "shards": REPLICA_SWEEP_SHARDS if replicas > 1 else 1,
+            "workers_per_replica": REPLICA_SWEEP_WORKERS,
+            "startup_p50_s": round(_pct(startups, 0.5), 4),
+            "startup_p90_s": round(_pct(startups, 0.9), 4),
+            "makespan_s": round(makespan, 4),
+            "writes_per_converged_job": writes,
+        })
+    base = next((r for r in results if r["replicas"] == 1), results[0])
+    best = min(results, key=lambda r: r["makespan_s"])
+    print(json.dumps({
+        "mode": "scale-replicas",
+        "backend": "memory+latency",
+        "latency_s": latency,
+        "qps": qps,
+        "burst": burst,
+        "gang": gang,
+        "jobs": jobs,
+        "combos": results,
+        "makespan_speedup_best": round(
+            base["makespan_s"] / max(best["makespan_s"], 1e-9), 2),
+    }))
+    return 0
+
+
+# Smoke-tier replica gate (the sharded-control-plane acceptance): on the
+# 100-job queue-bound load, a 2-replica sharded fleet must beat one
+# replica on makespan — horizontal capacity is real — while
+# writes-per-converged-job stays within parity: shard ownership SPLITS
+# the reconcile work, it must never duplicate a single apiserver write
+# (lease coordination traffic is not attributed to jobs and the status
+# flush window makes the status share mildly timing-dependent, hence a
+# small gap bound rather than exact equality).
+SMOKE_REPLICA_GANG = 8
+SMOKE_REPLICA_JOBS = 100
+SMOKE_REPLICA_FLEET = 2
+
 # Smoke-tier worker gate: a deliberately queue-wait-bound load (many small
 # jobs — the PR 4 scale sweep's 100-job regime scaled down for CI time)
 # where a multi-worker pool must beat one worker on p50 queue wait AND
@@ -554,6 +711,39 @@ def scale_main(smoke=False, qps=0.0, burst=0, latency=0.01) -> int:
                 f"makespan ({multi['makespan_s']}s vs "
                 f"{single['makespan_s']}s)"
             )
+        # Sharded-fleet gate: 2 replicas must beat 1 on the 100-job
+        # queue-bound makespan (horizontal control-plane capacity), with
+        # per-job write cost unchanged (sharding splits work, never
+        # duplicates it). Same-process legs, so co-load cancels like the
+        # other ratio gates.
+        s_start, s_makespan, s_writes = _measure_replica_bringup(
+            SMOKE_REPLICA_GANG, SMOKE_REPLICA_JOBS, 1, qps, burst, latency)
+        m_start, m_makespan, m_writes = _measure_replica_bringup(
+            SMOKE_REPLICA_GANG, SMOKE_REPLICA_JOBS, SMOKE_REPLICA_FLEET,
+            qps, burst, latency)
+        out["replicas_gate"] = {
+            "single": {"makespan_s": round(s_makespan, 4),
+                       "startup_p50_s": round(_pct(s_start, 0.5), 4),
+                       "writes_per_converged_job": s_writes},
+            "multi": {"replicas": SMOKE_REPLICA_FLEET,
+                      "makespan_s": round(m_makespan, 4),
+                      "startup_p50_s": round(_pct(m_start, 0.5), 4),
+                      "writes_per_converged_job": m_writes},
+        }
+        if m_makespan >= 0.9 * s_makespan:
+            regressions.append(
+                f"{SMOKE_REPLICA_FLEET} sharded replicas did not beat 1 "
+                f"on the {SMOKE_REPLICA_JOBS}-job makespan "
+                f"({m_makespan:.1f}s vs {s_makespan:.1f}s)"
+            )
+        replica_parity_gap = abs(m_writes - s_writes)
+        if replica_parity_gap > max(SMOKE_WRITES_PARITY_ABS,
+                                    SMOKE_WRITES_PARITY_REL * s_writes):
+            regressions.append(
+                f"sharded fleet write cost diverged from single-replica "
+                f"({m_writes} vs {s_writes}: shard ownership is "
+                "duplicating reconcile work)"
+            )
         # Writes-per-converged-job: the PR 6 report-only column, now a
         # GATE (this is the write-coalescing PR the baseline was recorded
         # for). Four checks: the absolute PR 6 bar, the ≥3x coalescible
@@ -627,20 +817,31 @@ if __name__ == "__main__":
                         help="scale mode: comma-separated sync-worker pool "
                         "sizes (e.g. 1,2,4,8) — sweeps the gang/job grid "
                         "over --workers instead of parallel-vs-serial")
+    parser.add_argument("--replicas", default="",
+                        help="scale mode: comma-separated operator replica "
+                        "counts (e.g. 1,2,4) — the sharded-fleet sweep on "
+                        "the 100-job queue-bound load (lease-claimed "
+                        "shards, small fixed per-replica worker pool)")
     parser.add_argument("--qps", type=float, default=0.0)
     parser.add_argument("--burst", type=int, default=0)
     parser.add_argument("--write-latency", type=float, default=0.01,
                         help="scale mode: injected per-write apiserver "
                         "round-trip stand-in (seconds)")
     args = parser.parse_args()
-    if args.smoke and args.workers:
-        # Silently routing to the sweep would drop every CI gate.
-        parser.error("--smoke and --workers are mutually exclusive: the "
-                     "smoke tier has its own fixed worker gate")
-    if args.workers and args.mode != "scale":
+    if args.smoke and (args.workers or args.replicas):
+        # Silently routing to a sweep would drop every CI gate.
+        parser.error("--smoke and --workers/--replicas are mutually "
+                     "exclusive: the smoke tier has its own fixed gates")
+    if (args.workers or args.replicas) and args.mode != "scale":
         # Dropping the flag would hand back a plausible-looking JSON
         # object for the wrong experiment.
-        parser.error("--workers requires --mode scale")
+        parser.error("--workers/--replicas require --mode scale")
+    if args.workers and args.replicas:
+        parser.error("--workers and --replicas are separate sweeps: pick one")
+    if args.mode == "scale" and args.replicas:
+        sys.exit(replicas_main(
+            [int(r) for r in args.replicas.split(",") if r.strip()],
+            qps=args.qps, burst=args.burst, latency=args.write_latency))
     if args.mode == "scale" and args.workers:
         sys.exit(workers_main(
             [int(w) for w in args.workers.split(",") if w.strip()],

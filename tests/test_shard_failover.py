@@ -1,0 +1,351 @@
+"""Shard-failover tier: exactly-once handoff under crashes and lease
+contention (testing/failover.py ShardFailoverDriver + core/sharding.py).
+
+The acceptance property of the sharded control plane: kill a replica
+mid-gang-restart, let a survivor steal the shard, and the persisted
+protocols (count-before-teardown, stamp-before-delete) must hold across
+the ownership migration — exactly-once ledgers, no orphans, span-order
+audit — for explicit crash points AND hash-rate-swept ones, with the
+whole schedule byte-reproducible from (seed, plan, drive sequence).
+
+Fixed seeds here run in tier-1; the broader randomized sweep is `slow`
+and rides the chaos-sweep CI step.
+"""
+
+import dataclasses
+
+import pytest
+
+from tf_operator_tpu.api.k8s import POD_FAILED, POD_PENDING, POD_RUNNING
+from tf_operator_tpu.cluster.chaos import (
+    ChaosCluster,
+    ChaosSpec,
+    CrashPoint,
+    ScheduledLeaseSteal,
+    ScheduledRenewDelay,
+)
+from tf_operator_tpu.cluster.memory import InMemoryCluster
+from tf_operator_tpu.controllers.jax import JAXController
+from tf_operator_tpu.core.workqueue import WorkQueue
+from tf_operator_tpu.metrics import Metrics
+from tf_operator_tpu.testing.failover import ShardFailoverDriver
+from tf_operator_tpu.testing.invariants import assert_invariants
+from tf_operator_tpu.core.tracing import Tracer
+
+
+def jaxjob(name, workers=4, backoff=0):
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "JAXJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "jaxReplicaSpecs": {
+                "Worker": {
+                    "replicas": workers,
+                    "template": {"spec": {"containers": [
+                        {"name": "jax", "image": "test:1"}]}},
+                }
+            },
+            "runPolicy": {"backoffLimit": backoff},
+        },
+    }
+
+
+def make_driver(chaos, tracer=None, shards=2, replicas=2, duration=10.0):
+    def factory(cluster, owns):
+        return JAXController(
+            cluster, queue=WorkQueue(), metrics=Metrics(), tracer=tracer,
+            owns=owns,
+        )
+
+    return ShardFailoverDriver(
+        chaos, factory, shards=shards, replicas=replicas, kinds=("JAXJob",),
+        duration=duration,
+    )
+
+
+def mark_running(inner):
+    for pod in inner.list_pods("default"):
+        if pod.status.phase == POD_PENDING:
+            inner.set_pod_phase("default", pod.metadata.name, POD_RUNNING)
+
+
+def bring_up(driver, inner, name="llama", workers=4):
+    inner.create_job(jaxjob(name, workers=workers))
+    driver.settle()
+    mark_running(inner)
+    driver.settle()
+    pods = inner.list_pods("default")
+    assert len(pods) == workers and all(
+        p.status.phase == POD_RUNNING for p in pods
+    )
+
+
+def drive_to_green(driver, inner, workers=4, rounds=40):
+    """Crash-tolerant convergence: settle, heal pending pods, advance the
+    clock so orphaned shards (their owners died) get stolen, repeat."""
+    for _ in range(rounds):
+        driver.settle()
+        mark_running(inner)
+        driver.settle()
+        pods = inner.list_pods("default")
+        if (
+            len(pods) == workers
+            and all(p.status.phase == POD_RUNNING for p in pods)
+            and all(p.metadata.deletion_timestamp is None for p in pods)
+            and driver.owner_of("default", "llama") is not None
+        ):
+            return
+        driver.advance(driver.duration + 1.0)
+    raise AssertionError(
+        f"never converged: pods={[(p.metadata.name, p.status.phase) for p in inner.list_pods('default')]}, "
+        f"owned={driver.owned_map()}, crashes={driver.crashes}"
+    )
+
+
+class TestShardStealMidGangRestart:
+    """The headline scenario: the shard owner dies between the counted
+    status write and the teardown of a gang restart; a survivor steals
+    the shard and must finish the restart WITHOUT double-counting any
+    ledger — all pods lingering Terminating through their grace windows
+    across the migration."""
+
+    def _run(self, before_write, seed=17):
+        inner = InMemoryCluster()
+        chaos = ChaosCluster(inner, ChaosSpec(seed=seed))
+        tracer = Tracer()
+        driver = make_driver(chaos, tracer=tracer)
+        driver.settle()
+        assert driver.owned_map() == {"replica-0": [0], "replica-1": [1]}
+        bring_up(driver, inner)
+
+        # Real-apiserver semantics: deletes wedge in their grace window;
+        # worker-2 is preempted; the owner dies at its counted status
+        # write (before/after variants — both crash windows of PR 3).
+        inner.hold_pod_termination()
+        inner.set_pod_phase(
+            "default", "llama-worker-2", POD_FAILED, exit_code=137,
+            disruption_target="Preempted",
+        )
+        owner = driver.owner_of("default", "llama")
+        survivor = next(r for r in driver.replicas if r != owner)
+        idx = chaos.next_call_index("update_job_status")
+        chaos.spec = dataclasses.replace(chaos.spec, crash_points=(
+            CrashPoint("update_job_status", idx, before_write=before_write),
+        ))
+        driver.replicas[owner].controller.queue.add("JAXJob:default/llama")
+        driver.settle()
+        assert len(driver.crashes) == 1, driver.crashes
+        assert owner not in driver.replicas
+
+        status = inner.get_job("JAXJob", "default", "llama")["status"]
+        if before_write:
+            assert "disruptionCounts" not in status, (
+                "before-write crash: the count died with the process")
+        else:
+            assert status["disruptionCounts"] == {"Worker": 1}, (
+                "after-write crash: the count landed before the death")
+
+        # The survivor steals the orphaned shard after expiry and — from
+        # nothing but persisted status — finishes (or for the
+        # before-write variant: re-detects, counts ONCE, performs) the
+        # teardown over the held graceful deletions.
+        driver.advance(driver.duration + 1.0)
+        driver.settle()
+        assert driver.owner_of("default", "llama") == survivor
+        assert any(
+            h.startswith(f"{survivor}:steal:") for h in driver.handoffs
+        ), driver.handoffs
+        for _ in range(3):  # repeated syncs over lingering pods: no re-count
+            driver.replicas[survivor].controller.queue.add("JAXJob:default/llama")
+            driver.settle()
+        pods = inner.list_pods("default")
+        assert len(pods) == 4
+        assert all(p.metadata.deletion_timestamp is not None for p in pods), (
+            "the stealing replica must finish the gang teardown")
+        status = inner.get_job("JAXJob", "default", "llama")["status"]
+        assert status["disruptionCounts"] == {"Worker": 1}, (
+            "ledger doubled or lost across the shard migration")
+
+        inner.release_pod_terminations()
+        drive_to_green(driver, inner)
+        assert_invariants(
+            inner, kinds=("JAXJob",),
+            expect_ledgers={
+                "disruptionCounts": {"Worker": 1},
+                "restartCounts": {},
+                "stallCounts": {},
+            },
+            tracer=tracer,
+            label=f"shard-steal-{'before' if before_write else 'after'}",
+        )
+        return chaos, driver, tracer
+
+    def test_after_write_crash_exactly_once(self):
+        self._run(before_write=False)
+
+    def test_before_write_crash_exactly_once(self):
+        self._run(before_write=True)
+
+    def test_replay_is_byte_identical(self):
+        """The determinism half of the acceptance: the same (seed, plan,
+        drive sequence) replays the identical fault log, crash list,
+        handoff order AND span sequence — a red shard-failover run is
+        reproducible from its seed alone."""
+        first = self._run(before_write=False, seed=23)
+        second = self._run(before_write=False, seed=23)
+        assert first[0].fault_log == second[0].fault_log
+        assert first[1].crashes == second[1].crashes
+        assert first[1].handoffs == second[1].handoffs
+        assert first[2].span_sequence() == second[2].span_sequence()
+
+
+class TestHashRateSweptCrashes:
+    """Rate-driven crash points (the PR 3 sweep idiom, now with replicas
+    dying instead of one controller): whatever subset of writes the
+    seeded hash stream kills, replacement replicas plus survivors must
+    converge the job with the structural invariants green."""
+
+    def _sweep(self, seed):
+        inner = InMemoryCluster()
+        chaos = ChaosCluster(inner, ChaosSpec(
+            seed=seed, crash_rate=0.05, max_crashes=4,
+        ))
+        tracer = Tracer()
+        driver = make_driver(chaos, tracer=tracer)
+        driver.settle()
+        inner.create_job(jaxjob("llama", backoff=6))
+        boots = 2
+        for _ in range(60):
+            driver.settle()
+            mark_running(inner)
+            driver.settle()
+            # Keep the fleet at 2: a killed replica is replaced by a
+            # fresh boot (rolling-restart semantics) which claims the
+            # dead one's shards once they expire.
+            while len(driver.replicas) < 2:
+                driver.boot(f"replica-{boots}")
+                boots += 1
+            pods = inner.list_pods("default")
+            if (
+                len(pods) == 4
+                and all(p.status.phase == POD_RUNNING for p in pods)
+                and all(p.metadata.deletion_timestamp is None for p in pods)
+            ):
+                break
+            driver.advance(driver.duration + 1.0)
+        else:
+            raise AssertionError(
+                f"seed {seed} never converged: crashes={driver.crashes}, "
+                f"owned={driver.owned_map()}"
+            )
+        assert_invariants(inner, kinds=("JAXJob",), tracer=tracer,
+                          label=f"shard-sweep-{seed}")
+        return driver
+
+    @pytest.mark.parametrize("seed", [3, 11])
+    def test_fixed_seeds(self, seed):
+        self._sweep(seed)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", list(range(20, 32)))
+    def test_randomized_sweep(self, seed):
+        self._sweep(seed)
+
+
+class TestContestedClaims:
+    """Seeded lease-contention faults (cluster/chaos.py): a rival write
+    forcing a contested claim, and silently dropped renewals opening the
+    delayed-renew window — the two adversaries of the handoff protocol,
+    explored byte-reproducibly."""
+
+    def test_lease_steal_victim_gates_off_then_steals_back(self):
+        inner = InMemoryCluster()
+        chaos = ChaosCluster(inner, ChaosSpec(seed=5, lease_steals=(
+            # The 4th matching renew of shard 0's lease is preempted by a
+            # rival write; the legitimate holder pays the 409 a real
+            # losing racer pays.
+            ScheduledLeaseSteal(at_renew=3, name_contains="shard-ha-shard-0",
+                                rival="rogue"),
+        )))
+        driver = make_driver(chaos, shards=2, replicas=2)
+        driver.settle()
+        victim = next(
+            r for r, owned in driver.owned_map().items() if 0 in owned
+        )
+        bring_up(driver, inner)
+        driver.settle()
+        assert any("lease-steal:" in entry for entry in chaos.fault_log)
+        # The victim observed the foreign holder and dropped the shard —
+        # involuntarily ("lost"), gating its keys off immediately.
+        assert f"{victim}:lost:0" in driver.handoffs
+        assert 0 not in driver.replicas[victim].coordinator.owned_shards()
+        # The rogue never renews: after a full duration on the victim's
+        # observation clock the shard is stolen back and jobs converge.
+        driver.advance(driver.duration + 1.0)
+        driver.settle()
+        assert driver.owner_of("default", "llama") is not None
+        drive_to_green(driver, inner)
+        assert_invariants(inner, kinds=("JAXJob",))
+
+    def test_delayed_renew_lets_peer_steal_exactly_once(self):
+        """Every renewal replica-0 WRITES (member lease and shard lease
+        alike) silently vanishes — the per-client partition / GC-pause
+        failure mode. replica-1 ranks it dead, steals its shard (and
+        renews it normally: the drop keys on the writer, so the thief is
+        unaffected), and the stale holder gates off on its next
+        observation. No double-sync: at most one replica ever holds the
+        lease, so the job's pods stay exactly-once through the whole
+        contested window."""
+        inner = InMemoryCluster()
+        chaos = ChaosCluster(inner, ChaosSpec(seed=9, renew_delays=(
+            ScheduledRenewDelay(after_renews=4, drop_renews=100_000,
+                                holder_contains="replica-0"),
+        )))
+        driver = make_driver(chaos, shards=2, replicas=2)
+        driver.settle()
+        assert driver.owned_map() == {"replica-0": [0], "replica-1": [1]}
+        bring_up(driver, inner)
+        assert any("renew-delay:" in entry for entry in chaos.fault_log)
+        # Wall time passes with BOTH replicas ticking: replica-1 keeps
+        # itself fresh while replica-0's swallowed renewals age it out;
+        # replica-1 re-ranks alone, steals shard 0, and replica-0
+        # discovers the foreign holder and drops to zero shards.
+        driver.run_clock(driver.duration + 2.0)
+        assert driver.replicas["replica-1"].coordinator.owned_shards() == [0, 1]
+        assert driver.replicas["replica-0"].coordinator.owned_shards() == []
+        assert "replica-0:lost:0" in driver.handoffs or any(
+            h.startswith("replica-0:lost:") for h in driver.handoffs
+        ), driver.handoffs
+        # The migrated world is intact and exactly-once: same 4 pods, no
+        # duplicates, no orphans, ledgers untouched.
+        pods = inner.list_pods("default")
+        assert len(pods) == 4
+        assert_invariants(
+            inner, kinds=("JAXJob",),
+            expect_ledgers={"disruptionCounts": {}, "restartCounts": {},
+                            "stallCounts": {}},
+        )
+
+    def test_contested_window_replay_is_byte_identical(self):
+        def run():
+            inner = InMemoryCluster()
+            chaos = ChaosCluster(inner, ChaosSpec(seed=31, lease_steals=(
+                ScheduledLeaseSteal(at_renew=2, name_contains="shard-ha-shard-1",
+                                    rival="rogue"),
+            ), renew_delays=(
+                ScheduledRenewDelay(after_renews=6, drop_renews=3,
+                                    name_contains="shard-ha-member-replica-1"),
+            )))
+            driver = make_driver(chaos, shards=2, replicas=2)
+            driver.settle()
+            inner.create_job(jaxjob("llama"))
+            driver.settle()
+            mark_running(inner)
+            driver.settle()
+            driver.advance(driver.duration + 1.0)
+            driver.settle()
+            return chaos.fault_log, driver.handoffs
+
+
+        assert run() == run()

@@ -75,6 +75,18 @@ class OperatorOptions:
     leader_elect: bool = False
     lease_duration: float = 15.0
     lease_name: str = "tf-operator-tpu-lock"
+    # Sharded active-active control plane (core/sharding.py): the job key
+    # space is hash-split into this many shards, each guarded by its own
+    # Lease; N replicas each claim their membership-ranked subset and
+    # reconcile ONLY their shards' jobs. 1 (the default) builds none of
+    # it — the global is_leader gate and zero extra lease traffic, so
+    # every seeded chaos/crash tier replays byte-identically. >1
+    # supersedes --leader-elect (the shard claims ARE the election).
+    shards: int = 1
+    # Stable replica identity for membership ranking + lease holdership
+    # (recommended: the StatefulSet pod name). Empty = hostname + a uuid
+    # suffix, which still works but reshuffles shard targets on restart.
+    replica_id: str = ""
     enable_debugz: bool = False  # /debugz exposes thread stacks: opt-in only
     # /tracez exposes per-job timelines (pod names, restart causes, the
     # full apiserver call sequence) on the 0.0.0.0 metrics port — same
@@ -139,6 +151,17 @@ def build_arg_parser() -> argparse.ArgumentParser:
     parser.add_argument("--lease-duration", type=float, default=15.0, help="Leader lease seconds.")
     parser.add_argument("--lease-name", default="tf-operator-tpu-lock",
                         help="Name of the coordination.k8s.io Lease used for election.")
+    parser.add_argument("--shards", type=int, default=1,
+                        help="Shard the job key space across this many "
+                        "lease-claimed shards (consistent namespace/name "
+                        "hash); run N replicas with the same --shards and "
+                        "each claims its membership-ranked subset. 1 "
+                        "(default) = the single-leader behavior; >1 "
+                        "supersedes --leader-elect.")
+    parser.add_argument("--replica-id", default="",
+                        help="Stable identity for shard membership ranking "
+                        "(recommended: the StatefulSet pod name). Default: "
+                        "hostname plus a random suffix.")
     parser.add_argument("--enable-debugz", action="store_true",
                         help="Expose /debugz (thread stacks, queue depths) on the metrics port.")
     parser.add_argument("--enable-tracez", action="store_true",
@@ -199,6 +222,8 @@ def options_from_args(args: argparse.Namespace) -> OperatorOptions:
         leader_elect=args.leader_elect,
         lease_duration=args.lease_duration,
         lease_name=args.lease_name,
+        shards=args.shards,
+        replica_id=args.replica_id,
         enable_debugz=args.enable_debugz,
         enable_tracez=args.enable_tracez,
         enable_gang_scheduling=args.enable_gang_scheduling,
@@ -385,9 +410,11 @@ class OperatorManager:
                 name=self.options.lease_name,
             )
         self.lease = lease
-        # Identity = pod name in-cluster (reference uses hostname), plus a
-        # uuid suffix so colliding local runs stay distinct.
-        self.identity = identity or (
+        # Identity = --replica-id (stable pod name, the recommended form
+        # for shard membership ranking), else pod name in-cluster
+        # (reference uses hostname) plus a uuid suffix so colliding local
+        # runs stay distinct.
+        self.identity = identity or self.options.replica_id or (
             f"{os.environ.get('HOSTNAME', 'operator')}-{uuid.uuid4().hex[:8]}"
         )
         self._stop = threading.Event()
@@ -395,7 +422,32 @@ class OperatorManager:
         self._server: Optional[ThreadingHTTPServer] = None
         self._metrics_server: Optional[ThreadingHTTPServer] = None
         self._started = False
-        self._is_leader = not self.options.leader_elect
+        # Sharded mode replaces the all-or-nothing leader flag with
+        # per-shard ownership: _is_leader then means "owns at least one
+        # shard" (the worker parking condition + the is_leader gauge),
+        # while the per-ITEM gate consults the coordinator. Built BEFORE
+        # the controllers so their enqueue scope filter can reference it.
+        self.coordinator = None
+        owns = None
+        if self.options.shards > 1:
+            from .core.sharding import ShardCoordinator
+
+            self.coordinator = ShardCoordinator(
+                cluster,
+                shards=self.options.shards,
+                identity=self.identity,
+                namespace=self.options.namespace or None,
+                lease_name=self.options.lease_name,
+                duration=self.options.lease_duration,
+                on_claim=self._on_shard_claimed,
+                on_release=self._on_shard_released,
+                drain_check=self._shard_drained,
+                drain_timeout=5.0,
+            )
+            owns = self.coordinator.allows
+        self._is_leader = (
+            not self.options.leader_elect and self.coordinator is None
+        )
 
         engine_options = EngineOptions(
             enable_gang_scheduling=self.options.enable_gang_scheduling,
@@ -435,6 +487,7 @@ class OperatorManager:
                 limiter=shared_limiter,
                 tracer=self.tracer,
                 watch_cache=self.watch_cache,
+                owns=owns,
             )
         # Effective pool size per kind: the requested --workers ANDed with
         # the cluster seam's supports_concurrent_syncs capability
@@ -483,6 +536,15 @@ class OperatorManager:
                 kind: c.queue.depth() for kind, c in self.controllers.items()
             },
             "sync_workers": dict(self.sync_workers),
+            # Shard map (core/sharding.py snapshot): per-shard last
+            # observed holder, the membership-derived target owner, and
+            # this replica's owned/draining sets — the first thing to
+            # read when a job "nobody reconciles" is suspected (its
+            # shard's holder row answers who should).
+            "shards": (
+                self.coordinator.snapshot()
+                if self.coordinator is not None else None
+            ),
             "threads": threads,
         }
 
@@ -511,19 +573,102 @@ class OperatorManager:
             self._stop.wait(duration / 3.0)
         self.lease.release(self.identity)
 
+    # -------------------------------------------------------- shard claims
+    def _shard_loop(self) -> None:
+        """The sharded replacement for _elect_loop: one coordinator tick
+        per election period. Leadership becomes per-shard; the manager-
+        level flag (gauge + worker parking) means "owns >= 1 shard"."""
+        duration = self.options.lease_duration
+        while not self._stop.is_set():
+            try:
+                self.coordinator.tick()
+            except Exception:  # noqa: BLE001 — a tick must never kill the loop
+                log.warning("shard tick raised", exc_info=True)
+            owns_any = self.coordinator.owns_any()
+            if owns_any != self._is_leader:
+                self._is_leader = owns_any
+                self._set_leader_gauge()
+                log.info(
+                    "shard ownership %s (%s: shards %s)",
+                    "active" if owns_any else "idle",
+                    self.identity, self.coordinator.owned_shards(),
+                )
+            # Serving shards (draining excluded): a replica mid-rebalance
+            # still holds the draining lease but admits no work for it.
+            self.metrics.set_gauge(
+                "training_operator_owned_shards",
+                float(len(self.coordinator.serving_shards())),
+            )
+            self._stop.wait(duration / 3.0)
+        # Clean exit: drain + release every shard (standbys win the next
+        # tick) and retire the member lease. All failure-tolerant — a
+        # crashing replica must not wedge its own shutdown.
+        self.coordinator.shutdown()
+        self.metrics.set_gauge("training_operator_owned_shards", 0.0)
+
+    def _on_shard_claimed(self, shard: int, cause: str) -> None:
+        """The claim half of the handoff protocol: a shard just became
+        ours (fresh claim, expiry-steal, or a cancelled drain reclaiming
+        the keys its window dropped). The cold-start path runs PER SHARD
+        via the shared resync_shard_jobs helper. Cost note: one
+        list_jobs per kind per claimed shard — claims are rare
+        control-plane events (boot, failover, rebalance), so the read
+        amplification of a multi-shard claim tick is accepted; if
+        --shards grows large enough to matter, batch the tick's claims
+        into one list."""
+        self.metrics.shard_handoff_inc(cause)
+        from .core.sharding import resync_shard_jobs
+
+        namespace = self.options.namespace or None
+        count = 0
+        for kind, controller in self.controllers.items():
+            count += resync_shard_jobs(
+                controller, self.cluster, kind, namespace, shard,
+                self.options.shards,
+            )
+        self.metrics.set_owned_jobs(str(shard), count)
+
+    def _on_shard_released(self, shard: int, cause: str) -> None:
+        self.metrics.shard_handoff_inc(cause)
+        # Drop the released shard's job-count series: a stale gauge here
+        # would read as a double owner beside the new holder's.
+        self.metrics.clear_owned_jobs(str(shard))
+
+    def _shard_drained(self, shard: int) -> bool:
+        """True when no worker is inside a sync of the shard's jobs —
+        the release precondition of a graceful handoff (releasing
+        mid-sync would let the next owner reconcile beside us)."""
+        from .core.sharding import shard_for_key
+
+        for controller in self.controllers.values():
+            for item in controller.queue.processing_items():
+                ns, _, name = item.partition(":")[2].partition("/")
+                if shard_for_key(ns, name, self.options.shards) == shard:
+                    return False
+        return True
+
+    def _sync_gate(self, item: str) -> bool:
+        """The post-pop sync gate, per item: global leadership when
+        unsharded; the item's SHARD ownership (owned and not draining)
+        when sharded — the PR 5 checked-then-blocked rule generalized
+        from one flag to one flag per key."""
+        if self.coordinator is None:
+            return self._is_leader
+        ns, _, name = item.partition(":")[2].partition("/")
+        return self.coordinator.allows(ns, name)
+
     def _worker_loop(self, kind: str) -> None:
         controller = self.controllers[kind]
-        # The gate re-checks leadership AFTER the blocking queue pop: a
-        # worker parked in get() across a leadership flip must hand its
-        # item back, not sync it (see process_next). Each of the N pool
-        # workers carries the same gate — quiescing is per-worker, not
-        # per-pool.
-        gate = lambda: self._is_leader  # noqa: E731
+        # The gate re-checks authority AFTER the blocking queue pop: a
+        # worker parked in get() across a leadership flip (or a shard
+        # handoff) must hand its item back, not sync it (see
+        # process_next). Each of the N pool workers carries the same gate
+        # — quiescing is per-worker, not per-pool.
         while not self._stop.is_set():
             if not self._is_leader:
                 self._stop.wait(0.05)
                 continue
-            controller.process_next(timeout=0.1, gate=gate)
+            controller.process_next(timeout=0.1, gate=self._sync_gate)
 
     def _resync_loop(self) -> None:
         """Periodic full relist: re-enqueue every job of every enabled kind
@@ -547,14 +692,27 @@ class OperatorManager:
         hash fraction of it (clock-injected through the WorkQueue — no
         `random`, so a seeded harness replays the identical schedule)."""
         namespace = self.options.namespace or None
+        owned_counts: Dict[int, int] = {}
         for kind, controller in self.controllers.items():
             for job in self.cluster.list_jobs(kind, namespace):
                 meta = job.get("metadata", {})
                 ns = meta.get("namespace", "default")
                 name = meta.get("name", "")
+                if self.coordinator is not None:
+                    shard = self.coordinator.shard_of(ns, name)
+                    if self.coordinator.owns(shard):
+                        owned_counts[shard] = owned_counts.get(shard, 0) + 1
                 controller._enqueue_after(
                     ns, name,
                     resync_jitter_seconds(f"{kind}:{ns}/{name}", jitter_window),
+                )
+        if self.coordinator is not None:
+            # Refresh the per-shard job-count gauges off the relist we
+            # just paid for (claims set them too; churn between resyncs
+            # is tolerated staleness).
+            for shard in self.coordinator.owned_shards():
+                self.metrics.set_owned_jobs(
+                    str(shard), owned_counts.get(shard, 0)
                 )
 
     # --------------------------------------------------------- http server
@@ -598,7 +756,14 @@ class OperatorManager:
         # every new loop thread exit on its first check.
         self._stop.clear()
         self._threads = []
-        if self.options.leader_elect:
+        if self.coordinator is not None:
+            # Sharded mode: the shard claim loop IS the election —
+            # running the global elect loop beside it would gate workers
+            # on a lock no peer contends per-shard.
+            thread = threading.Thread(target=self._shard_loop, daemon=True)
+            thread.start()
+            self._threads.append(thread)
+        elif self.options.leader_elect:
             thread = threading.Thread(target=self._elect_loop, daemon=True)
             thread.start()
             self._threads.append(thread)

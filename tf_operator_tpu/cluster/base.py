@@ -281,6 +281,18 @@ class Cluster:
         NotImplementedError default; callers treat it as best-effort."""
         raise NotImplementedError
 
+    def list_leases(self, namespace: Optional[str] = None,
+                    name_prefix: str = "") -> List[dict]:
+        """List Lease objects, optionally restricted to one namespace and
+        a name prefix (the shard coordinator's member-roster discovery:
+        every replica renews `<lock>-member-<identity>` and lists the
+        prefix to rank the live fleet — core/sharding.py). The prefix is
+        a client-side convenience filter; HTTP backends still issue one
+        collection GET. Backends that predate the verb inherit this
+        NotImplementedError default — sharding requires a backend that
+        can enumerate leases."""
+        raise NotImplementedError
+
     # ---- events ----
     def record_event(self, event: Event) -> None:
         raise NotImplementedError

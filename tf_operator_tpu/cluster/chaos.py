@@ -160,6 +160,51 @@ class CrashPoint:
 
 
 @dataclass
+class ScheduledLeaseSteal:
+    """A contested lease claim planted in the schedule: on the
+    `at_renew`-th update_lease call whose lease name matches (per-entry
+    0-based match counter), a rival identity is written over the current
+    holder FIRST — so the legitimate caller's own write lands on a stale
+    resourceVersion and takes the 409 a real losing racer takes. The
+    rival never renews, so the victim's skew-safe observation timer
+    re-arms and it steals back after a full duration: the contested-claim
+    window of the shard handoff protocol (core/sharding.py), explored
+    byte-reproducibly. The rival's renewTime is copied from the CALLER's
+    intended write — "freshly renewed" without the proxy needing a clock
+    of its own."""
+
+    at_renew: int
+    name_contains: str = ""
+    namespace: Optional[str] = None
+    rival: str = "chaos-rival"
+
+
+@dataclass
+class ScheduledRenewDelay:
+    """Silently dropped lease renewals (the slow-renewer failure mode —
+    a GC pause or apiserver brownout between a holder and its lease):
+    matching update_lease calls with per-entry match index in
+    [after_renews, after_renews + drop_renews) are swallowed — the
+    holder believes each renewal landed while peers watch the lease age
+    toward expiry and steal it. The delayed-renew half of the contested
+    window: the stale holder still THINKS it leads until its next
+    successful read shows the thief. Deterministic: indices count
+    matching calls, no clocks involved.
+
+    `name_contains` matches the lease NAME (one specific lock);
+    `holder_contains` matches the WRITER's holderIdentity — the
+    per-client partition shape: every renewal one replica issues (its
+    member lease AND its shard leases) vanishes, while a peer that later
+    steals the same lease renews it normally."""
+
+    after_renews: int
+    drop_renews: int = 1
+    name_contains: str = ""
+    holder_contains: str = ""
+    namespace: Optional[str] = None
+
+
+@dataclass
 class ScheduledStuckTermination:
     """A dead-kubelet event planted in the schedule: after the proxy has
     seen `after_writes` total writes, graceful deletes of matching pods
@@ -199,6 +244,15 @@ class ChaosSpec:
     crash_points: Tuple[CrashPoint, ...] = ()
     # Dead-kubelet plan: write-clock-scheduled stuck-terminating holds.
     stuck_terminations: Tuple[ScheduledStuckTermination, ...] = ()
+    # Lease-contention plan (the sharded control plane's adversary):
+    # rival writes forcing contested claims, and silently dropped
+    # renewals opening the delayed-renew steal window. Both key on
+    # per-entry MATCH counters (not the write clock — lease traffic does
+    # not advance it), so PR 1-7 schedules are untouched by the fields'
+    # existence and a sharded test replays byte-identically from its
+    # seed + plan.
+    lease_steals: Tuple[ScheduledLeaseSteal, ...] = ()
+    renew_delays: Tuple[ScheduledRenewDelay, ...] = ()
     # Methods exempt from error/conflict injection (latency still
     # applies). Default: none — every write, record_event included, is
     # faultable; the engine's best-effort event recording is itself a
@@ -507,8 +561,60 @@ class ChaosCluster:
         if self._hang_matches(ns, name):
             self._log(f"hang:{ns}/{name}:drop-renew")
             return lease
+        if self._renew_dropped(ns, name, lease):
+            return lease  # swallowed: the holder believes it renewed
+        self._maybe_steal(ns, name, lease)
         self._inject("update_lease")
         return self._inner.update_lease(lease)
+
+    def _renew_dropped(self, ns: str, name: str, lease: dict) -> bool:
+        """Delayed-renew injection: matching renewals inside a planted
+        window vanish without an error — the holder's lock records a
+        successful renew while the stored lease ages toward stealability."""
+        holder = str((lease.get("spec") or {}).get("holderIdentity") or "")
+        dropped = False
+        for i, delay in enumerate(self.spec.renew_delays):
+            if delay.name_contains and delay.name_contains not in name:
+                continue
+            if delay.holder_contains and delay.holder_contains not in holder:
+                continue
+            if delay.namespace is not None and delay.namespace != ns:
+                continue
+            idx = self._next_index(f"renew-delay:{i}")
+            if delay.after_renews <= idx < delay.after_renews + delay.drop_renews:
+                self._log(f"renew-delay:{ns}/{name}#{idx}:drop")
+                dropped = True
+        return dropped
+
+    def _maybe_steal(self, ns: str, name: str, lease: dict) -> None:
+        """Lease-steal injection: write the rival over the stored lease
+        BEFORE the caller's matching renew, so the caller pays the same
+        Conflict a real losing racer pays and must re-observe the (now
+        foreign, freshly-renewed) lease for a full duration before it can
+        steal back."""
+        for i, steal in enumerate(self.spec.lease_steals):
+            if steal.name_contains and steal.name_contains not in name:
+                continue
+            if steal.namespace is not None and steal.namespace != ns:
+                continue
+            idx = self._next_index(f"lease-steal:{i}")
+            if idx != steal.at_renew:
+                continue
+            try:
+                current = self._inner.get_lease(ns, name)
+            except Exception:  # noqa: BLE001 — nothing to steal
+                continue
+            cspec = current.setdefault("spec", {})
+            cspec["holderIdentity"] = steal.rival
+            cspec["leaseTransitions"] = int(cspec.get("leaseTransitions") or 0) + 1
+            caller_renew = (lease.get("spec") or {}).get("renewTime")
+            if caller_renew:
+                cspec["renewTime"] = caller_renew
+            try:
+                self._inner.update_lease(current)
+            except Exception:  # noqa: BLE001 — raced away; the log stays honest
+                continue
+            self._log(f"lease-steal:{ns}/{name}#{idx}:{steal.rival}")
 
     # ------------------------------------------------------- preemption
     def preempt_pods(

@@ -768,6 +768,20 @@ class KubeCluster(Cluster):
     def delete_lease(self, namespace: str, name: str) -> None:
         self._request("DELETE", self._lease_path(namespace, name))
 
+    def list_leases(self, namespace: Optional[str] = None,
+                    name_prefix: str = "") -> List[dict]:
+        # One collection GET per namespace; the prefix filter is applied
+        # client-side (lease names carry no labels to select on).
+        namespace = namespace or self.namespace or "default"
+        body = self._request("GET", self._lease_path(namespace))
+        items = body.get("items") or []
+        return [
+            lease for lease in items
+            if ((lease.get("metadata") or {}).get("name", "")).startswith(
+                name_prefix
+            )
+        ]
+
     # --------------------------------------------------------------- events
     def record_event(self, event: Event) -> None:
         kind, _, key = event.involved_object.partition("/")

@@ -600,6 +600,16 @@ class InMemoryCluster(base.Cluster):
             self._publish_locked("leases", DELETED, lease)
         self._drain_events()
 
+    def list_leases(self, namespace: Optional[str] = None,
+                    name_prefix: str = "") -> List[dict]:
+        with self._lock:
+            return [
+                copy.deepcopy(lease)
+                for (ns, name), lease in sorted(self._leases.items())
+                if (namespace is None or ns == namespace)
+                and name.startswith(name_prefix)
+            ]
+
     # ---------------------------------------------------------------- events
     def record_event(self, event: Event) -> None:
         with self._lock:

@@ -87,6 +87,7 @@ class FrameworkController(FrameworkHooks):
         limiter: Optional[TokenBucket] = None,
         tracer=None,
         watch_cache=None,
+        owns=None,
     ):
         opts = options or EngineOptions()
         if metrics is None:
@@ -135,10 +136,18 @@ class FrameworkController(FrameworkHooks):
         self.cluster = cluster
         # `queue or WorkQueue()` would DROP an injected queue: WorkQueue
         # defines __len__, so an empty (= freshly constructed) queue is
-        # falsy and a caller's fake-clock queue was silently replaced.
+        # falsy and a caller's fake-clock queue is silently replaced.
         self.queue = WorkQueue() if queue is None else queue
         # Namespace scoping (legacy --namespace, options.go:36): empty = all.
         self.namespace = namespace
+        # Shard-ownership scoping (core/sharding.py): `owns(ns, name)`
+        # answers "does this replica hold the job's shard?". Applied at
+        # every enqueue like the namespace scope — an unowned key never
+        # enters the queue, so the post-pop gate's hand-back (which
+        # re-enqueues THROUGH this filter) cannot spin on keys another
+        # replica is reconciling. None (the single-replica default) owns
+        # everything: byte-identical to the pre-sharding behavior.
+        self.owns = owns
         self.clock = clock
         # Last observed queue wait of THIS worker thread (item, seconds):
         # stashed by the on_wait hook at pop time, consumed by sync() to
@@ -191,8 +200,15 @@ class FrameworkController(FrameworkHooks):
         self.cluster.watch("pods", self._on_dependent_event("pods"))
         self.cluster.watch("services", self._on_dependent_event("services"))
 
-    def _enqueue(self, namespace: str, name: str) -> None:
+    def _in_scope(self, namespace: str, name: str) -> bool:
+        """Namespace + shard-ownership scoping, single-sourced for every
+        enqueue path (watch events, resync, the post-pop hand-back)."""
         if self.namespace and namespace != self.namespace:
+            return False
+        return self.owns is None or self.owns(namespace, name)
+
+    def _enqueue(self, namespace: str, name: str) -> None:
+        if not self._in_scope(namespace, name):
             return
         self.queue.add(f"{self.kind}:{namespace}/{name}")
         # Depth sampled on ADD as well as on pop (_observe_queue_wait):
@@ -207,32 +223,43 @@ class FrameworkController(FrameworkHooks):
         if delay <= 0:
             self._enqueue(namespace, name)
             return
-        if self.namespace and namespace != self.namespace:
+        if not self._in_scope(namespace, name):
             return
         self.queue.add_after(f"{self.kind}:{namespace}/{name}", delay)
 
     def _on_job_event(self, event_type: str, job_dict: dict) -> None:
         meta = job_dict.get("metadata", {})
-        if self.namespace and meta.get("namespace", "default") != self.namespace:
+        namespace = meta.get("namespace", "default")
+        name = meta.get("name", "")
+        if self.namespace and namespace != self.namespace:
             # Out of scope entirely — a scoped informer would never deliver
             # this event, so neither metrics nor the queue may see it.
             return
-        if event_type == ADDED:
-            self.metrics.created_inc(meta.get("namespace", "default"), self.kind)
+        # Shard scoping: every replica's watch sees every event, but only
+        # the shard owner counts it — otherwise a fleet of N replicas
+        # inflates the created/deleted counters N-fold in aggregation.
+        # Deliberate trade-off: an event landing while its shard is
+        # mid-migration (owner dead, lease not yet stolen; or draining)
+        # is counted by NO replica — undercounting during a failover
+        # window is accepted over N-fold steady-state inflation; the
+        # claim resync re-covers the WORK either way.
+        owned = self.owns is None or self.owns(namespace, name)
+        if event_type == ADDED and owned:
+            self.metrics.created_inc(namespace, self.kind)
         if event_type == DELETED:
-            self.metrics.deleted_inc(meta.get("namespace", "default"), self.kind)
+            if owned:
+                self.metrics.deleted_inc(namespace, self.kind)
             # The job is gone and is never enqueued again: drop its
             # in-memory bookkeeping HERE — the sync-path NotFound cleanup
             # only runs if some later event enqueues the dead key.
-            self._forget(
-                f"{meta.get('namespace', 'default')}/{meta.get('name', '')}",
-                uid=meta.get("uid", ""),
-            )
+            # Unconditionally: stale per-key state from a PREVIOUS
+            # ownership stint must not outlive the job either (forgetting
+            # an unowned key is a no-op).
+            self._forget(f"{namespace}/{name}", uid=meta.get("uid", ""))
             return
-        if meta.get("uid"):
-            key = f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
-            self._note_uid(key, meta["uid"])
-        self._enqueue(meta.get("namespace", "default"), meta.get("name", ""))
+        if meta.get("uid") and owned:
+            self._note_uid(f"{namespace}/{name}", meta["uid"])
+        self._enqueue(namespace, name)
 
     def _on_dependent_event(self, dependent_kind: str):
         def handler(event_type: str, obj) -> None:
@@ -544,17 +571,24 @@ class FrameworkController(FrameworkHooks):
         the same item, so per-job state stays single-threaded while
         different jobs sync in parallel.
 
-        `gate` (e.g. the manager's leadership flag) is re-checked AFTER
-        the pop: a worker blocked in queue.get() when leadership flips
-        would otherwise sync an item popped seconds into its standby —
-        the checked-then-blocked race that lets a demoted operator write
-        beside the new leader. A gated-out item is handed back unsynced."""
+        `gate` (the manager's leadership flag, or the per-key shard-
+        ownership check — it receives the popped item) is re-checked
+        AFTER the pop: a worker blocked in queue.get() when leadership
+        flips would otherwise sync an item popped seconds into its
+        standby — the checked-then-blocked race that lets a demoted
+        operator write beside the new leader. A gated-out item is handed
+        back unsynced THROUGH the enqueue scope filter: under global
+        election the key re-queues for when leadership returns; under
+        sharding a key whose shard moved away is dropped here — the new
+        owner's claim resync re-enqueues it on ITS queue, while re-adding
+        locally would spin pop/gate/re-add forever."""
         item = self.queue.get(timeout=timeout)
         if item is None:
             return False
-        if gate is not None and not gate():
+        if gate is not None and not gate(item):
             self.queue.done(item)
-            self.queue.add(item)
+            namespace, _, name = item.partition(":")[2].partition("/")
+            self._enqueue(namespace, name)
             return False
         # Busy-worker gauge (client-go workqueue "busy workers" parity):
         # bracketed around the sync so saturation — every worker inside a

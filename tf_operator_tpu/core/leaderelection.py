@@ -114,6 +114,11 @@ class ClusterLeaseLock:
         # Local deadline until which we keep claiming leadership across
         # transient renew errors (0 = not holding).
         self._renew_ok_until: float = 0.0
+        # Holder identity read at the top of the last try_acquire/observe
+        # round (None = lease absent). Advisory: the shard coordinator
+        # uses it to classify a successful claim as fresh-claim vs
+        # expiry-steal; election decisions never do.
+        self.last_holder_seen: Optional[str] = None
 
     # ----------------------------------------------------------------- api
     def try_acquire(self, identity: str, duration: float) -> bool:
@@ -125,6 +130,7 @@ class ClusterLeaseLock:
         try:
             lease = self.cluster.get_lease(self.namespace, self.name)
         except NotFound:
+            self.last_holder_seen = None
             return self._create(identity, duration, now, local)
         except Exception:
             log.warning("lease get failed", exc_info=True)
@@ -132,6 +138,7 @@ class ClusterLeaseLock:
 
         spec = lease.setdefault("spec", {})
         holder = spec.get("holderIdentity")
+        self.last_holder_seen = holder or None
         renew_raw = str(spec.get("renewTime"))
         # A foreign/malformed lease can carry an explicit null or garbage
         # leaseDurationSeconds; arithmetic on it must never escape an
@@ -167,6 +174,14 @@ class ClusterLeaseLock:
             # extra standby tick beats dual leaders).
             self._renew_ok_until = 0.0
             return False
+        except NotFound:
+            # The lease was DELETED between our read and write (operator
+            # GC, namespace cleanup, an admin's kubectl). Riding the
+            # renew-deadline here is split-brain bait: with no live lease
+            # blocking them, every standby's next round CREATES and wins
+            # while we still claim leadership. Race the create instead —
+            # either we win it cleanly or the Conflict demotes us now.
+            return self._create(identity, duration, now, local)
         except Exception:
             log.warning("lease update failed", exc_info=True)
             return self._survives_error(local)
@@ -180,22 +195,66 @@ class ClusterLeaseLock:
         abdicate after (the live lease still blocks standbys meanwhile)."""
         return local < self._renew_ok_until
 
+    def observe(self) -> Optional[str]:
+        """Read-only observation round: refresh the local expiry timer
+        (same skew-safe rule as try_acquire — the timer restarts whenever
+        the remote record CHANGES) without writing anything. The shard
+        coordinator runs this on foreign shards every tick, so by the
+        time a membership change targets one here, its lease has already
+        been sitting on our observation clock — a dead owner's shard is
+        stealable on the first claiming tick instead of one full duration
+        later. Returns the observed holder (None = absent/unreadable)."""
+        local = self._mono()
+        try:
+            lease = self.cluster.get_lease(self.namespace, self.name)
+        except NotFound:
+            self.last_holder_seen = None
+            self._observed = None
+            return None
+        except Exception:  # noqa: BLE001 — observation is best-effort
+            return self.last_holder_seen
+        spec = lease.get("spec") or {}
+        holder = spec.get("holderIdentity") or None
+        renew_raw = str(spec.get("renewTime"))
+        if holder and self._observed != (holder, renew_raw):
+            self._observed = (holder, renew_raw)
+            self._observed_at = local
+        self.last_holder_seen = holder
+        return holder
+
     def release(self, identity: str) -> None:
         """Voluntary handoff on clean shutdown (reference ReleaseOnCancel):
         clear the holder so a standby wins the very next tick instead of
-        waiting out the lease duration."""
+        waiting out the lease duration.
+
+        MUST NOT raise, whatever the apiserver answers: release runs on
+        the shutdown path of a possibly-crashing replica, and a 404 (the
+        lease was GC'd), a 409 (a rival stole it between our read and
+        write — release-after-steal), or any transient 5xx must not wedge
+        the exit. The failure directions are all safe: an unreleased
+        lease merely costs standbys one expiry wait."""
         self._renew_ok_until = 0.0
         try:
             lease = self.cluster.get_lease(self.namespace, self.name)
+        except NotFound:
+            return  # already gone: nothing to hand off
         except Exception:
+            log.debug("lease read failed at release", exc_info=True)
             return
         spec = lease.setdefault("spec", {})
         if spec.get("holderIdentity") != identity:
+            # Stolen (or never ours): clearing the CURRENT holder's claim
+            # would hand a live lease to nobody — leave it alone.
             return
         spec["holderIdentity"] = ""
         spec["renewTime"] = None
         try:
             self.cluster.update_lease(lease)
+        except (Conflict, NotFound):
+            # Conflict: a rival wrote between our read and write — it is
+            # the holder's lease now, not ours to clear. NotFound: deleted
+            # under us. Both mean "no handoff needed from us".
+            log.debug("lease release superseded", exc_info=True)
         except Exception:
             log.debug("lease release failed", exc_info=True)
 

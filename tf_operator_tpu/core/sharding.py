@@ -1,0 +1,469 @@
+"""Sharded active-active control plane: lease-claimed job shards.
+
+One leader owning every job (`core/leaderelection.py`) caps control-plane
+capacity at a single process; worker pools (PR 5) scale within it but not
+across it. This module shards job OWNERSHIP across N operator replicas:
+
+- the job key space is split into a fixed ring of `--shards` shards by a
+  consistent hash of the job's `namespace/name` (the queue-item identity —
+  stable across job incarnations, known before any read, and identical on
+  every replica);
+- each shard is guarded by its own coordination.k8s.io Lease
+  (`<lease-name>-shard-<i>`), claimed/renewed/stolen through the same
+  `ClusterLeaseLock` OCC idiom the global election uses — two replicas can
+  NEVER both hold a shard, so per-job exactly-once degrades to the
+  single-leader argument shard by shard;
+- replica membership is itself lease-based: every replica renews a
+  `<lease-name>-member-<identity>` Lease and lists the member prefix, so
+  all replicas converge on the same sorted live-member ranking and
+  therefore the same target assignment (`shard % members == my_rank`)
+  with no configuration of peer addresses;
+- handoff is claim -> resync (the manager re-enqueues every job of the
+  claimed shard and resets its expectations: a fresh owner has none of
+  its predecessor's in-memory ledger, exactly like a cold-started
+  process), drain-before-release on graceful rebalance (stop admitting
+  the shard's keys, wait out in-flight syncs, then release so the next
+  owner wins the lease immediately), and expiry-steal on crash (a dead
+  replica stops renewing member + shard leases at once; survivors
+  recompute targets and steal once the shard lease has sat unchanged a
+  full duration on THEIR clock — the skew-safe observation rule).
+
+Single-replica default (`--shards 1`) builds none of this: the manager
+keeps the PR 5 global `is_leader` gate and issues zero lease traffic, so
+every seeded chaos/crash/stall tier replays byte-identical fault logs
+and span sequences (the same capability-gating contract as parallel
+fan-out, sync workers, and write coalescing).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..cluster.base import Conflict, NotFound
+from .leaderelection import ClusterLeaseLock, _pod_namespace
+
+log = logging.getLogger(__name__)
+
+# A member lease that has not changed for this many lease durations on the
+# observer's clock is garbage-collected (best-effort): dead replicas must
+# not grow the member list forever, but the GC bound stays well past the
+# liveness bound so a slow renewer is never deleted while still counted.
+_MEMBER_GC_DURATIONS = 4.0
+
+
+def shard_for_key(namespace: str, name: str, shards: int) -> int:
+    """Consistent shard id for one job key. Hashes the `namespace/name`
+    queue-item identity (NOT the uid: the gate must place a key before
+    any read, and a delete+recreate keeping its shard avoids a gratuitous
+    ownership migration mid-churn). SHA-256 like every other seeded
+    decision in this repo — identical placement on every replica, every
+    run, every platform."""
+    if shards <= 1:
+        return 0
+    digest = hashlib.sha256(f"{namespace}/{name}".encode()).digest()
+    return int.from_bytes(digest[:8], "big") % shards
+
+
+def shard_lease_name(lease_name: str, shard: int) -> str:
+    return f"{lease_name}-shard-{shard}"
+
+
+def member_lease_prefix(lease_name: str) -> str:
+    return f"{lease_name}-member-"
+
+
+def resync_shard_jobs(controller, cluster, kind: str,
+                      namespace: Optional[str], shard: int,
+                      shards: int) -> int:
+    """The claim half of the handoff protocol, single-sourced for the
+    operator manager, the shard failover harness, and the flap-storm
+    regression (three hand-rolled copies would silently drift as the
+    protocol grows steps): reset the shard's pod/service expectations —
+    a fresh owner has none of its predecessor's in-memory ledger, and
+    waiting on OUR stale ledger from a previous stint would wedge each
+    job for the expectation-expiry window — and re-enqueue every job of
+    the shard (the cold-start resync_once idiom, shard-scoped). Returns
+    the number of jobs covered."""
+    count = 0
+    for job in cluster.list_jobs(kind, namespace):
+        meta = job.get("metadata", {}) or {}
+        ns = meta.get("namespace", "default")
+        name = meta.get("name", "")
+        if shard_for_key(ns, name, shards) != shard:
+            continue
+        key = f"{ns}/{name}"
+        controller.expectations.delete_expectations(key, "pods")
+        controller.expectations.delete_expectations(key, "services")
+        controller._enqueue(ns, name)
+        count += 1
+    return count
+
+
+class ShardCoordinator:
+    """One replica's view of the shard ring: claims its target shards,
+    renews what it holds, drains and releases what the membership says
+    belongs elsewhere, and steals expired leases of dead owners.
+
+    Driven by `tick()` from the manager's shard loop (or a test harness),
+    never from a watch thread: every tick is a bounded number of lease
+    reads plus one write per owned/target shard — all against the RAW
+    cluster seam (no accounting, no throttle), so shard coordination is
+    invisible to the per-job apiserver write attribution.
+
+    `on_claim(shard, cause)` / `on_release(shard, cause)` fire from the
+    tick thread AFTER the lease state changed; the manager uses them for
+    the claim-resync handoff and the handoff metrics. Gating reads
+    (`allows`, `owns_any`) are lock-protected and cheap — they run on
+    every worker pop."""
+
+    def __init__(
+        self,
+        cluster,
+        shards: int,
+        identity: str,
+        namespace: Optional[str] = None,
+        lease_name: str = "tf-operator-tpu-lock",
+        duration: float = 15.0,
+        clock=time.time,
+        mono=None,
+        on_claim: Optional[Callable[[int, str], None]] = None,
+        on_release: Optional[Callable[[int, str], None]] = None,
+        drain_check: Optional[Callable[[int], bool]] = None,
+        drain_timeout: float = 30.0,
+    ):
+        if shards < 1:
+            raise ValueError("shards must be >= 1")
+        self.cluster = cluster
+        self.shards = shards
+        self.identity = identity
+        self.namespace = namespace or _pod_namespace()
+        self.lease_name = lease_name
+        self.duration = duration
+        self._clock = clock
+        # Same monotonic-clock split as ClusterLeaseLock: liveness timers
+        # must not move with NTP steps; fake-clock tests inject one clock
+        # for both.
+        self._mono = mono if mono is not None else (
+            time.monotonic if clock is time.time else clock
+        )
+        self.on_claim = on_claim
+        self.on_release = on_release
+        # drain_check(shard) -> True when no sync of that shard's jobs is
+        # in flight. None = always drained (single-threaded harnesses).
+        self.drain_check = drain_check
+        self.drain_timeout = drain_timeout
+        self._locks = [
+            ClusterLeaseLock(
+                cluster, namespace=self.namespace,
+                name=shard_lease_name(lease_name, i),
+                clock=clock, mono=self._mono,
+            )
+            for i in range(shards)
+        ]
+        self._member_lock = ClusterLeaseLock(
+            cluster, namespace=self.namespace,
+            name=f"{lease_name}-member-{identity}",
+            clock=clock, mono=self._mono,
+        )
+        self._lock = threading.Lock()
+        self._owned: Set[int] = set()
+        self._draining: Set[int] = set()
+        self._drain_since: Dict[int, float] = {}
+        # Member-liveness observation: lease name -> (renew_raw, local
+        # time the value last CHANGED). Liveness is "changed within one
+        # duration on MY clock" — never a remote-timestamp comparison.
+        self._member_obs: Dict[str, Tuple[str, float]] = {}
+        self._live_members: List[str] = [identity]
+        # Last observed holder per shard (observability/debugz; advisory).
+        self._holders: Dict[int, Optional[str]] = {}
+
+    # ------------------------------------------------------------- gating
+    def shard_of(self, namespace: str, name: str) -> int:
+        return shard_for_key(namespace, name, self.shards)
+
+    def allows(self, namespace: str, name: str) -> bool:
+        """The per-key sync gate: this replica holds the job's shard and
+        is not draining it. Checked at enqueue AND re-checked after the
+        blocking queue pop (the PR 5 post-pop rule, per key)."""
+        shard = self.shard_of(namespace, name)
+        with self._lock:
+            return shard in self._owned and shard not in self._draining
+
+    def owns(self, shard: int) -> bool:
+        with self._lock:
+            return shard in self._owned
+
+    def owns_any(self) -> bool:
+        with self._lock:
+            return bool(self._owned - self._draining)
+
+    def owned_shards(self) -> List[int]:
+        with self._lock:
+            return sorted(self._owned)
+
+    def serving_shards(self) -> List[int]:
+        """Owned AND admitting work (draining shards excluded) — the set
+        the owned_shards gauge reports: a replica mid-rebalance still
+        HOLDS the draining lease but is no longer serving its keys."""
+        with self._lock:
+            return sorted(self._owned - self._draining)
+
+    def snapshot(self) -> dict:
+        """Shard map for /debugz: per-shard holder (last observed),
+        target owner under the current membership, and this replica's
+        owned/draining view."""
+        with self._lock:
+            members = list(self._live_members)
+            owned = sorted(self._owned)
+            draining = sorted(self._draining)
+            holders = dict(self._holders)
+        targets = {
+            s: members[s % len(members)] if members else None
+            for s in range(self.shards)
+        }
+        return {
+            "identity": self.identity,
+            "shards": self.shards,
+            "members": members,
+            "owned": owned,
+            "draining": draining,
+            "holders": {str(s): holders.get(s) for s in range(self.shards)},
+            "targets": {str(s): targets[s] for s in range(self.shards)},
+        }
+
+    # ------------------------------------------------------------ protocol
+    def _renew_membership(self) -> None:
+        """Keep our member lease fresh. A failed renew is survivable for
+        the same renew-deadline window the shard locks grant; persistent
+        failure lets peers rank us dead and drain toward the remainder —
+        the safe direction."""
+        try:
+            self._member_lock.try_acquire(self.identity, self.duration)
+        except Exception:  # noqa: BLE001 — a tick must never die here
+            log.warning("member lease renew failed", exc_info=True)
+
+    def _compute_members(self) -> List[str]:
+        """Sorted live-member identities from the member-lease prefix.
+        Every replica lists the same objects and applies the same
+        observation rule, so rankings converge within one tick of any
+        membership change."""
+        local = self._mono()
+        prefix = member_lease_prefix(self.lease_name)
+        try:
+            leases = self.cluster.list_leases(self.namespace, name_prefix=prefix)
+        except Exception:  # noqa: BLE001 — keep the last view on a blip
+            log.warning("member lease list failed", exc_info=True)
+            with self._lock:
+                return list(self._live_members)
+        live: Set[str] = {self.identity}
+        seen_names: Set[str] = set()
+        for lease in leases:
+            meta = lease.get("metadata") or {}
+            name = meta.get("name", "")
+            ident = name[len(prefix):]
+            if not ident:
+                continue
+            seen_names.add(name)
+            spec = lease.get("spec") or {}
+            renew_raw = str(spec.get("renewTime"))
+            try:
+                held = float(spec.get("leaseDurationSeconds"))
+            except (TypeError, ValueError):
+                held = self.duration
+            with self._lock:
+                prev = self._member_obs.get(name)
+                if prev is None or prev[0] != renew_raw:
+                    self._member_obs[name] = (renew_raw, local)
+                    observed_at = local
+                else:
+                    observed_at = prev[1]
+            if ident == self.identity or local < observed_at + held:
+                live.add(ident)
+            elif local >= observed_at + held * _MEMBER_GC_DURATIONS:
+                # Long-dead member: GC its lease so the roster doesn't
+                # accrete one object per replica ever started. Best
+                # effort — a racing peer's delete wins harmlessly.
+                try:
+                    self.cluster.delete_lease(self.namespace, name)
+                except Exception:  # noqa: BLE001
+                    pass
+        with self._lock:
+            for name in list(self._member_obs):
+                if name not in seen_names:
+                    self._member_obs.pop(name, None)
+            self._live_members = sorted(live)
+            return list(self._live_members)
+
+    def _targets(self, members: List[str]) -> Set[int]:
+        """This replica's target shards under the given membership:
+        `shard % len(members) == rank(identity)`. Deterministic and
+        identical on every replica with the same view, so a stable
+        membership yields a stable, non-overlapping assignment."""
+        if self.identity not in members:
+            return set()
+        rank = members.index(self.identity)
+        return {s for s in range(self.shards) if s % len(members) == rank}
+
+    def _drained(self, shard: int) -> bool:
+        if self.drain_check is None:
+            return True
+        try:
+            return bool(self.drain_check(shard))
+        except Exception:  # noqa: BLE001 — a broken check must not wedge
+            log.warning("drain check failed; treating as drained", exc_info=True)
+            return True
+
+    def tick(self) -> None:
+        """One coordination round: renew membership, recompute targets,
+        then per shard acquire/renew/observe/drain as the assignment
+        dictates. Cheap and bounded; the manager runs it every
+        duration/3 like the elect loop."""
+        self._renew_membership()
+        members = self._compute_members()
+        targets = self._targets(members)
+        for shard in range(self.shards):
+            lock = self._locks[shard]
+            with self._lock:
+                mine = shard in self._owned
+                draining = shard in self._draining
+            if shard in targets:
+                if draining:
+                    # Re-targeted to us mid-drain (membership flapped
+                    # back): cancel the drain and keep serving — but the
+                    # drain window DROPPED this shard's enqueues (watch
+                    # events, post-pop hand-backs hit the allows() gate),
+                    # and since ownership never changed hands, no peer's
+                    # claim resync covers the gap. Fire our own:
+                    # cause="reclaim" runs the same expectation-reset +
+                    # re-enqueue handoff a real claim runs.
+                    with self._lock:
+                        self._draining.discard(shard)
+                        self._drain_since.pop(shard, None)
+                    self._notify(self.on_claim, shard, "reclaim")
+                self._try_claim(shard, lock, mine)
+            elif mine:
+                self._drain_and_release(shard, lock)
+            else:
+                # Foreign shard: observe only, so the expiry timer is
+                # already armed if a membership change later targets it
+                # here (steal latency = one tick, not one extra
+                # duration), and /debugz can show the full holder map.
+                self._holders[shard] = lock.observe()
+
+    def _try_claim(self, shard: int, lock: ClusterLeaseLock, mine: bool) -> None:
+        try:
+            got = lock.try_acquire(self.identity, self.duration)
+        except Exception:  # noqa: BLE001 — abdicate the shard, not the tick
+            log.warning("shard %d claim round raised", shard, exc_info=True)
+            got = False
+        self._holders[shard] = self.identity if got else lock.last_holder_seen
+        if got and not mine:
+            # Fresh claim: free/released lease = "claim"; a lease whose
+            # last holder was a (now-expired) peer = "steal".
+            cause = (
+                "steal"
+                if lock.last_holder_seen not in (None, "", self.identity)
+                else "claim"
+            )
+            with self._lock:
+                self._owned.add(shard)
+            log.info("shard %d %sed by %s", shard, cause, self.identity)
+            self._notify(self.on_claim, shard, cause)
+        elif not got and mine:
+            # Lost a held shard (stolen, or renew errors past the
+            # deadline): gate off IMMEDIATELY — the new holder's claim
+            # resync re-enqueues everything, so dropping our queue's
+            # copies is safe, while syncing beside the new owner is not.
+            with self._lock:
+                self._owned.discard(shard)
+                self._draining.discard(shard)
+                self._drain_since.pop(shard, None)
+            log.warning("shard %d lost by %s", shard, self.identity)
+            self._notify(self.on_release, shard, "lost")
+
+    def _drain_and_release(self, shard: int, lock: ClusterLeaseLock) -> None:
+        """Graceful rebalance: the membership re-assigned a shard we
+        hold. Gate its keys off (allows() excludes draining shards), keep
+        RENEWING while in-flight syncs finish — releasing mid-sync would
+        let the next owner start beside us — then release so the target
+        owner wins the very next tick instead of waiting out expiry."""
+        with self._lock:
+            if shard not in self._draining:
+                self._draining.add(shard)
+                self._drain_since[shard] = self._mono()
+            started = self._drain_since[shard]
+        if not self._drained(shard):
+            if self._mono() < started + self.drain_timeout:
+                try:
+                    if not lock.try_acquire(self.identity, self.duration):
+                        # Stolen out from under the drain: same as lost.
+                        self._try_claim_lost(shard)
+                    return
+                except Exception:  # noqa: BLE001
+                    log.warning("shard %d drain renew raised", shard,
+                                exc_info=True)
+                    return
+            log.warning(
+                "shard %d drain timed out after %.1fs; releasing anyway",
+                shard, self.drain_timeout,
+            )
+        lock.release(self.identity)
+        with self._lock:
+            self._owned.discard(shard)
+            self._draining.discard(shard)
+            self._drain_since.pop(shard, None)
+        self._holders[shard] = None
+        log.info("shard %d released by %s (rebalance)", shard, self.identity)
+        self._notify(self.on_release, shard, "rebalance")
+
+    def _try_claim_lost(self, shard: int) -> None:
+        with self._lock:
+            self._owned.discard(shard)
+            self._draining.discard(shard)
+            self._drain_since.pop(shard, None)
+        self._notify(self.on_release, shard, "lost")
+
+    def _notify(self, hook, shard: int, cause: str) -> None:
+        if hook is None:
+            return
+        try:
+            hook(shard, cause)
+        except Exception:  # noqa: BLE001 — observer errors never stall claims
+            log.warning("shard hook failed for shard %d", shard, exc_info=True)
+
+    # ----------------------------------------------------------- lifecycle
+    def shutdown(self, sleep=time.sleep) -> None:
+        """Clean exit: drain and release every owned shard (standbys win
+        the next tick, like the global lock's ReleaseOnCancel) and delete
+        our member lease so peers re-rank without waiting out liveness.
+        EVERY step tolerates apiserver failure — a crashing replica must
+        never wedge its own shutdown on a lease it can no longer write."""
+        with self._lock:
+            owned = sorted(self._owned)
+            self._draining.update(owned)
+        for shard in owned:
+            deadline = self._mono() + self.drain_timeout
+            while not self._drained(shard) and self._mono() < deadline:
+                sleep(0.05)
+            try:
+                self._locks[shard].release(self.identity)
+            except Exception:  # noqa: BLE001
+                log.debug("shard %d release failed at shutdown", shard,
+                          exc_info=True)
+            self._notify(self.on_release, shard, "shutdown")
+        with self._lock:
+            self._owned.clear()
+            self._draining.clear()
+            self._drain_since.clear()
+        try:
+            self.cluster.delete_lease(
+                self.namespace, f"{self.lease_name}-member-{self.identity}"
+            )
+        except (NotFound, Conflict):
+            pass
+        except Exception:  # noqa: BLE001
+            log.debug("member lease delete failed at shutdown", exc_info=True)

@@ -66,6 +66,14 @@ class WorkQueue:
             heapq.heappush(self._delayed, (self._clock() + delay, self._seq, item))
             self._cond.notify()
 
+    def processing_items(self) -> List[str]:
+        """Items currently held by workers (snapshot). The shard drain
+        check uses this to answer "is any sync of shard S's jobs still in
+        flight?" before a lease release — counting (depth()) cannot say
+        WHICH keys are busy."""
+        with self._cond:
+            return list(self._processing)
+
     def depth(self) -> dict:
         """Queue introspection for the operator's /debugz endpoint."""
         with self._cond:

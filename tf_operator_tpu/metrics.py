@@ -127,6 +127,20 @@ class Metrics:
             "it later. Each increment is one apiserver write saved; a "
             "high rate with a low flush rate is the coalescer working",
         ),
+        "training_operator_shard_handoffs_total": (
+            ("cause",),
+            "Shard ownership transitions at this replica "
+            "(core/sharding.py): cause=claim (free/released lease "
+            "acquired), steal (expired lease of a dead peer taken over), "
+            "rebalance (drained and released because the membership "
+            "re-assigned it), reclaim (a drain cancelled mid-flight — "
+            "ownership never moved, but the drain window dropped "
+            "enqueues so the claim resync re-runs), lost (lease stolen "
+            "or renewals failed past the deadline — involuntary), "
+            "shutdown (released on clean exit). A sustained "
+            "claim/steal/lost rate with stable membership is ownership "
+            "flapping",
+        ),
         "training_operator_apiserver_requests_total": (
             ("verb", "resource", "code"),
             "Apiserver requests issued through the cluster seam "
@@ -154,6 +168,13 @@ class Metrics:
             "(client-go workqueue_depth analog; sampled on every worker "
             "get). Sustained depth means the workers cannot keep up with "
             "the event rate — scale --workers or raise --qps",
+        ),
+        "training_operator_owned_jobs": (
+            ("shard",),
+            "Jobs (all kinds) living in each shard THIS replica owns "
+            "(core/sharding.py; updated on claim and on every resync). "
+            "Summed across the fleet it must equal the live job count — "
+            "a persistent shortfall is an orphaned shard (no live owner)",
         ),
         "training_operator_busy_workers": (
             ("framework",),
@@ -295,6 +316,31 @@ class Metrics:
         self._inc_labeled(
             "training_operator_apiserver_requests_total", verb, resource, code,
         )
+
+    def shard_handoff_inc(self, cause: str) -> None:
+        """One shard ownership transition at this replica (cause = claim|
+        steal|rebalance|lost|shutdown)."""
+        self._inc_labeled("training_operator_shard_handoffs_total", cause)
+
+    def set_owned_jobs(self, shard: str, count: float) -> None:
+        with self._lock:
+            self._labeled_gauges["training_operator_owned_jobs"][
+                (shard,)
+            ] = float(count)
+
+    def clear_owned_jobs(self, shard: str) -> None:
+        """Drop a released shard's series — a stale gauge would read as a
+        double owner beside the new holder's."""
+        with self._lock:
+            self._labeled_gauges["training_operator_owned_jobs"].pop(
+                (shard,), None
+            )
+
+    def owned_jobs_value(self, shard: str) -> Optional[float]:
+        with self._lock:
+            return self._labeled_gauges["training_operator_owned_jobs"].get(
+                (shard,)
+            )
 
     def busy_workers_inc(self, framework: str) -> None:
         with self._lock:

@@ -31,7 +31,7 @@ stay byte-reproducible with the worker pool feature enabled.
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 from ..cluster.base import Cluster
 from ..cluster.chaos import SimulatedCrash
@@ -148,3 +148,267 @@ class FailoverDriver:
             if self.controller.queue.empty_and_idle():
                 return
             self.step()
+
+
+# --------------------------------------------------------------- sharded HA
+
+
+class _AliveGate:
+    """Per-replica cluster proxy: watch handlers registered through it go
+    dead with the replica (the multi-replica analog of _GenerationGate —
+    a crashed replica's process receives no events, but the in-memory
+    backends have no unsubscribe)."""
+
+    def __init__(self, inner: Cluster, replica: "_ShardReplica"):
+        self._inner = inner
+        self._replica = replica
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def watch(self, kind, handler):
+        def gated(event_type, obj):
+            if not self._replica.alive:
+                return
+            handler(event_type, obj)
+
+        self._inner.watch(kind, gated)
+
+
+class _ShardReplica:
+    """One replica slot of the ShardFailoverDriver: a ShardCoordinator
+    plus a controller incarnation, both discarded wholesale on a
+    simulated crash."""
+
+    def __init__(self, identity: str):
+        self.identity = identity
+        self.alive = True
+        self.coordinator = None
+        self.controller = None
+
+
+class ShardFailoverDriver:
+    """The sharded extension of FailoverDriver: N replica slots over ONE
+    shared (usually chaos-proxied) cluster, each with its own
+    ShardCoordinator (core/sharding.py) and its own controller built by
+    `controller_factory(cluster, owns)` — the factory must wire `owns`
+    into the controller's enqueue scope filter, exactly as
+    OperatorManager does.
+
+    Time is FULLY driver-owned: one fake clock (`self.now`, advanced via
+    `advance()`) feeds every lease lock and liveness observation, so
+    lease expiry — and with it the steal schedule — is a pure function of
+    the step/advance sequence. One `step()` = one coordinator tick per
+    live replica (sorted identity order) followed by one process_next
+    per live replica; a SimulatedCrash escaping either kills THAT replica
+    wholesale (controller, coordinator, expectations, queue, watches —
+    nothing survives but persisted cluster state and the replica's
+    now-unrenewed leases). Survivors steal its shards once `advance()`
+    ages the leases past their duration on the survivors' observation
+    clocks.
+
+    The chaos proxy's per-method counters live on the shared cluster, so
+    a fixed (seed, plan, drive sequence) replays the identical fault AND
+    crash schedule byte-for-byte — the property the shard-failover tier
+    asserts across ownership migrations."""
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        controller_factory: Callable[[Cluster, Callable[[str, str], bool]], object],
+        shards: int = 4,
+        replicas: int = 2,
+        kinds: Sequence[str] = ("JAXJob",),
+        namespace: Optional[str] = None,
+        lease_name: str = "shard-ha",
+        duration: float = 10.0,
+        max_failovers: int = 100,
+        tracer=None,
+    ):
+        from ..core.sharding import ShardCoordinator, shard_for_key
+
+        self._cluster = cluster
+        self._factory = controller_factory
+        self.shards = shards
+        self.kinds = tuple(kinds)
+        self.namespace = namespace
+        self.lease_name = lease_name
+        self.duration = duration
+        self.max_failovers = max_failovers
+        self.tracer = tracer
+        self.now = 1000.0  # the one clock; advance() moves it
+        self.crashes: List[str] = []
+        self.handoffs: List[str] = []  # "identity:claim|steal|...:shard"
+        self._shard_for_key = shard_for_key
+        self._coordinator_cls = ShardCoordinator
+        self.replicas: Dict[str, _ShardReplica] = {}
+        for i in range(replicas):
+            self.boot(f"replica-{i}")
+
+    def _clock(self) -> float:
+        return self.now
+
+    # ------------------------------------------------------------ lifecycle
+    def boot(self, identity: str) -> _ShardReplica:
+        """Start (or restart after kill) one replica: fresh coordinator,
+        fresh controller, nothing carried over — `revive` semantics for a
+        rolling-restart scenario."""
+        replica = _ShardReplica(identity)
+        gate = _AliveGate(self._cluster, replica)
+
+        def on_claim(shard: int, cause: str, _replica=replica) -> None:
+            self.handoffs.append(f"{_replica.identity}:{cause}:{shard}")
+            self._resync_shard(_replica, shard)
+
+        def on_release(shard: int, cause: str, _replica=replica) -> None:
+            self.handoffs.append(f"{_replica.identity}:{cause}:{shard}")
+
+        replica.coordinator = self._coordinator_cls(
+            gate,
+            shards=self.shards,
+            identity=identity,
+            namespace=self.namespace or "default",
+            lease_name=self.lease_name,
+            duration=self.duration,
+            clock=self._clock,
+            mono=self._clock,
+            on_claim=on_claim,
+            on_release=on_release,
+            # The driver steps replicas from one thread: nothing is ever
+            # mid-sync at tick time, so drains complete instantly and
+            # deterministically.
+            drain_check=None,
+        )
+        owns = replica.coordinator.allows
+        replica.controller = self._factory(gate, owns)
+        self.replicas[identity] = replica
+        return replica
+
+    def kill(self, identity: str, crash: Optional[BaseException] = None) -> None:
+        """Simulated process death: the replica stops renewing member and
+        shard leases at once (no release — that is the crash/steal path,
+        not the drain path) and its in-memory state is discarded."""
+        replica = self.replicas.pop(identity)
+        replica.alive = False
+        self.crashes.append(str(crash) if crash is not None else f"killed:{identity}")
+        if len(self.crashes) > self.max_failovers:
+            message = (
+                f"failover budget exceeded ({self.max_failovers}): the "
+                "crash schedule never lets the fleet converge"
+            )
+            if self.tracer is not None:
+                from .invariants import dump_trace
+
+                path = dump_trace(self.tracer, "shard_failover_budget_exceeded")
+                if path:
+                    message += f"; trace dump: {path}"
+            raise AssertionError(message) from crash
+
+    def advance(self, seconds: float) -> None:
+        """Move the fake clock: leases age, liveness observations go
+        stale, steal windows open. One full-duration jump ages EVERY
+        lease at once — live replicas then mutually rank each other dead
+        on their next tick (nobody renewed "during" the jump). That is
+        the right tool for "the fleet was frozen/partitioned"; for
+        ordinary wall-time passage where live replicas keep renewing, use
+        run_clock."""
+        self.now += seconds
+
+    def run_clock(self, seconds: float, step: Optional[float] = None) -> None:
+        """Advance the fake clock the way real time passes: in
+        sub-duration increments with coordination+sync rounds between,
+        so LIVE replicas keep each other's liveness observations fresh
+        (their elect loops tick every duration/3) while anything that
+        genuinely stopped renewing — a killed replica, a holder whose
+        renewals chaos swallows — ages toward expiry and steal."""
+        step = step if step is not None else self.duration / 3.0
+        remaining = seconds
+        while remaining > 0:
+            delta = min(step, remaining)
+            self.now += delta
+            remaining -= delta
+            self.settle()
+
+    # ------------------------------------------------------------- queries
+    def _live(self) -> List[_ShardReplica]:
+        return [self.replicas[k] for k in sorted(self.replicas)]
+
+    def shard_of(self, namespace: str, name: str) -> int:
+        return self._shard_for_key(namespace, name, self.shards)
+
+    def owner_of(self, namespace: str, name: str) -> Optional[str]:
+        """Which live replica owns the job's shard right now (None = the
+        shard is currently orphaned — mid-migration)."""
+        shard = self.shard_of(namespace, name)
+        for replica in self._live():
+            if replica.coordinator.owns(shard):
+                return replica.identity
+        return None
+
+    def owned_map(self) -> Dict[str, List[int]]:
+        return {
+            r.identity: r.coordinator.owned_shards() for r in self._live()
+        }
+
+    # ------------------------------------------------------------- driving
+    def _resync_shard(self, replica: _ShardReplica, shard: int) -> None:
+        """The claim half of the handoff — the SAME resync_shard_jobs
+        helper OperatorManager runs, so the harness can never drift from
+        the production protocol. All a new owner has is persisted status."""
+        from ..core.sharding import resync_shard_jobs
+
+        controller = replica.controller
+        if controller is None:
+            return  # claim fired during boot, before the controller exists
+        for kind in self.kinds:
+            resync_shard_jobs(
+                controller, self._cluster, kind, self.namespace, shard,
+                self.shards,
+            )
+
+    def tick(self) -> None:
+        """One coordination round per live replica, in identity order."""
+        for replica in self._live():
+            try:
+                replica.coordinator.tick()
+            except SimulatedCrash as crash:
+                self.kill(replica.identity, crash)
+
+    def step(self) -> bool:
+        """tick + one process_next per live replica; crashes kill the
+        crashing replica and the fleet drives on. Returns whether any
+        replica made progress (or died trying)."""
+        self.tick()
+        processed = False
+        for replica in self._live():
+            def gate(item, _c=replica.coordinator):
+                ns, _, name = item.partition(":")[2].partition("/")
+                return _c.allows(ns, name)
+
+            try:
+                processed = replica.controller.process_next(
+                    timeout=0.01, gate=gate
+                ) or processed
+            except SimulatedCrash as crash:
+                self.kill(replica.identity, crash)
+                processed = True
+        return processed
+
+    def settle(self, max_iterations: int = 10_000) -> None:
+        """Drive until every live replica's queue is idle for two full
+        rounds (ticks keep running inside — claims and drains settle as
+        part of it)."""
+        idle_rounds = 0
+        for _ in range(max_iterations):
+            if self.step() or not all(
+                r.controller.queue.empty_and_idle() for r in self._live()
+            ):
+                idle_rounds = 0
+                continue
+            idle_rounds += 1
+            if idle_rounds >= 2:
+                return
+        raise AssertionError(
+            f"shard fleet never settled in {max_iterations} iterations "
+            f"(owned={self.owned_map()}, crashes={self.crashes})"
+        )

@@ -451,6 +451,11 @@ class StubApiServer:
 
     def _leases(self, handler, method, m) -> None:
         ns, name = m["ns"], m["name"]
+        if method == "GET" and not name:
+            # Collection list (the shard coordinator's member discovery).
+            return handler._json(
+                200, {"items": self.mem.list_leases(ns)}
+            )
         if method == "GET":
             return handler._json(200, self.mem.get_lease(ns, name))
         if method == "POST":
