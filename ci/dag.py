@@ -298,6 +298,27 @@ def default_dag() -> List[Step]:
              pytest + ["tests/test_chaos.py", "tests/test_disruption.py",
                        "tests/test_stall.py", "-m", "not slow"],
              deps=["operator-integration"], retries=2),
+        # Gang-admission tier (docs/design/gang_admission.md): the
+        # capacity-aware admission layer under seeded contention —
+        # quota'd queueing, priority preemption through the counted
+        # disruption protocol (exactly-once across the crash window),
+        # bounded backfill with the aging starvation bound, the seeded
+        # capacity-revocation fault with byte-identical fault_log +
+        # span_sequence replay, and the PodGroup/admission lifecycle
+        # hygiene regressions.
+        Step("admission-chaos",
+             pytest + ["tests/test_admission.py", "-m", "not slow"],
+             deps=["operator-integration"], retries=2),
+        # Contention smoke (scripts/measure_control_plane.py --mode
+        # contention --smoke): under a pool sized for half the submitted
+        # jobs — zero quota violations, strict priority order of
+        # completions among unquota'd jobs, exactly-once seed preemption,
+        # and backfill beating FIFO on makespan by >10% (the measured
+        # utilization margin lands in build/contention_smoke_last.json).
+        Step("contention-smoke",
+             [PY, "scripts/measure_control_plane.py", "--mode", "contention",
+              "--smoke"],
+             deps=["admission-chaos"], retries=3),
         # Shard-failover tier (docs/design/sharded_control_plane.md): the
         # sharded active-active control plane — ring/coordinator protocol
         # units, two-manager split/steal/handback integration, and the

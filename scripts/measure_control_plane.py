@@ -251,27 +251,52 @@ def _kubelet_sim(mem):
     handler only ENQUEUES (running the Running-marking write inside the
     create's own event dispatch would charge kubelet work to the write
     path under measurement); a separate marker thread performs the phase
-    writes. Returns (stop_event, thread) — set and join to tear down."""
+    writes. Pods carrying a `bench.tpu/duration-seconds` annotation (the
+    contention mode's simulated training time) additionally terminate
+    Succeeded once it elapses; without the annotation pods run forever
+    (the bring-up measurements). Returns (stop_event, thread) — set and
+    join to tear down."""
     import threading
 
     stop = threading.Event()
+    lock = threading.Lock()
     born: "list[tuple]" = []
-    born_lock = threading.Lock()
+    running: "dict[tuple, float]" = {}
 
     def on_pod(event_type, pod):
         if event_type in ("ADDED", "SYNC") and pod.status.phase == "Pending":
-            with born_lock:
-                born.append((pod.metadata.namespace, pod.metadata.name))
+            duration = pod.metadata.annotations.get(
+                "bench.tpu/duration-seconds")
+            with lock:
+                born.append((pod.metadata.namespace, pod.metadata.name,
+                             float(duration) if duration else None))
+        elif event_type == "DELETED":
+            with lock:
+                running.pop(
+                    (pod.metadata.namespace, pod.metadata.name), None)
 
     mem.watch("pods", on_pod)
 
     def pump():
         while not stop.is_set():
-            with born_lock:
+            now = time.monotonic()
+            with lock:
                 batch, born[:] = born[:], []
-            for ns, name in batch:
+                due = [k for k, deadline in running.items()
+                       if deadline <= now]
+                for key in due:
+                    running.pop(key)
+            for ns, name, duration in batch:
                 try:
                     mem.set_pod_phase(ns, name, "Running")
+                except Exception:  # noqa: BLE001 — pod raced away
+                    continue
+                if duration is not None:
+                    with lock:
+                        running[(ns, name)] = time.monotonic() + duration
+            for ns, name in due:
+                try:
+                    mem.set_pod_phase(ns, name, "Succeeded", exit_code=0)
                 except Exception:  # noqa: BLE001 — pod raced away
                     pass
             stop.wait(0.002)
@@ -800,6 +825,277 @@ def scale_main(smoke=False, qps=0.0, burst=0, latency=0.01) -> int:
     return rc
 
 
+# ---------------------------------------------------------- contention mode
+
+CONTENTION_BASELINE_PATH = os.path.join(
+    REPO, "build", "contention_smoke_last.json")
+
+
+def _contention_job(name, workers, duration, priority="", namespace="default"):
+    spec = {
+        "jaxReplicaSpecs": {
+            "Worker": {
+                "replicas": workers,
+                "template": {
+                    "metadata": {"annotations": {
+                        "bench.tpu/duration-seconds": str(duration)}},
+                    "spec": {"containers": [
+                        {"name": "jax", "image": "bench:1"}]},
+                },
+            }
+        },
+    }
+    if priority:
+        spec["runPolicy"] = {"schedulingPolicy": {"priorityClass": priority}}
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "JAXJob",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": spec,
+    }
+
+
+def _run_contention(waves, capacity_pods, quotas=(), backfill_max_members=8,
+                    timeout=30.0):
+    """One contention scenario: submit `waves` (a list of manifest
+    lists) against a `capacity_pods`-slot admission pool and run to full
+    completion. Each wave is submitted only once every job of the prior
+    wave is REGISTERED with the arbiter (live pods, or a Queued
+    condition) — the scenarios are about admission order, and racing a
+    whole batch through 4 concurrent sync workers would leave "who asked
+    first" to thread scheduling. Returns completion times (job name ->
+    seconds since scenario start), the makespan, the pod-slot
+    utilization integral, the per-poll max of each namespace's live
+    pods, and the manager's admission arbiter (for the invariant
+    check). Everything runs through the real OperatorManager stack —
+    admission kicks, counted preemption teardowns, the lot."""
+    from tf_operator_tpu.cluster.memory import InMemoryCluster
+    from tf_operator_tpu.core.tracing import Tracer
+
+    mem = InMemoryCluster()
+    stop_kubelet, kubelet = _kubelet_sim(mem)
+    metrics = Metrics()
+    tracer = Tracer()
+    manager = OperatorManager(
+        mem,
+        OperatorOptions(
+            enabled_schemes=["JAXJob"], health_port=0, metrics_port=0,
+            threadiness=4, resync_period=0.2,
+            enable_gang_admission=True,
+            capacity=f"pods={capacity_pods}",
+            namespace_quotas=list(quotas),
+            backfill_max_members=backfill_max_members,
+            admission_aging_seconds=300.0,
+        ),
+        metrics=metrics,
+        tracer=tracer,
+    )
+    manager.start()
+    completions = {}
+    ns_peak: dict = {}
+    util_area = 0.0
+    def registered(ns, name):
+        """The job reached the arbiter: it owns live pods (admitted) or
+        carries the Queued condition (waiting)."""
+        if mem.list_pods(ns, labels={"job-name": name}):
+            return True
+        job = mem.get_job("JAXJob", ns, name)
+        return any(
+            c["type"] == "Queued"
+            for c in (job.get("status") or {}).get("conditions") or []
+        )
+
+    try:
+        t0 = time.monotonic()
+        pending = {}
+        for wave in waves:
+            for manifest in wave:
+                mem.create_job(manifest)
+                pending[manifest["metadata"]["name"]] = (
+                    manifest["metadata"]["namespace"])
+            wave_deadline = time.monotonic() + 10.0
+            while time.monotonic() < wave_deadline and not all(
+                registered(m["metadata"]["namespace"], m["metadata"]["name"])
+                for m in wave
+            ):
+                time.sleep(0.01)
+        deadline = t0 + timeout
+        last = time.monotonic()
+        while pending and time.monotonic() < deadline:
+            time.sleep(0.01)
+            now = time.monotonic()
+            live = [
+                p for p in mem.list_pods()
+                if p.metadata.deletion_timestamp is None
+                and p.status.phase in ("Pending", "Running")
+            ]
+            util_area += len(live) * (now - last)
+            last = now
+            by_ns: dict = {}
+            for pod in live:
+                ns = pod.metadata.namespace
+                by_ns[ns] = by_ns.get(ns, 0) + 1
+            for ns, count in by_ns.items():
+                ns_peak[ns] = max(ns_peak.get(ns, 0), count)
+            for name, ns in list(pending.items()):
+                job = mem.get_job("JAXJob", ns, name)
+                conds = (job.get("status") or {}).get("conditions") or []
+                if any(c["type"] == "Succeeded" and c["status"] == "True"
+                       for c in conds):
+                    completions[name] = now - t0
+                    pending.pop(name)
+        if pending:
+            raise SystemExit(
+                f"contention: {sorted(pending)} never completed within "
+                f"{timeout}s (backfill_max_members={backfill_max_members})"
+            )
+        makespan = max(completions.values())
+        utilization = util_area / max(capacity_pods * makespan, 1e-9)
+        admission = manager.admission
+    finally:
+        stop_kubelet.set()
+        manager.stop()
+        kubelet.join(timeout=5)
+    return {
+        "completions": {k: round(v, 3) for k, v in completions.items()},
+        "makespan_s": round(makespan, 3),
+        "utilization": round(utilization, 4),
+        "ns_peak_pods": ns_peak,
+        "admission": admission,
+        "cluster": mem,
+    }
+
+
+def contention_main(smoke=False) -> int:
+    """--mode contention: the gang-admission behavioral benchmark
+    (docs/design/gang_admission.md). Two scenarios:
+
+    1. PRIORITY + QUOTA: a low-priority seed gang fills the 4-slot pool;
+       high-band gangs preempt it (exactly one counted disruption), a
+       quota'd tenant is capped at its share throughout, and among the
+       unquota'd jobs every high-band completion precedes every low-band
+       completion — the strict-priority contract.
+    2. BACKFILL vs FIFO: a 12-slot gang runs long while a 16-slot gang
+       heads the queue; six 4-slot shorties either wait behind it (FIFO,
+       backfill disabled) or backfill the 4-slot gap (default). The
+       measured makespan/utilization margin is the number backfill buys.
+
+    --smoke turns both into CI gates and records the margins in
+    build/contention_smoke_last.json."""
+    from tf_operator_tpu.testing.invariants import check_admission_invariants
+
+    regressions = []
+
+    # Scenario 1: priority + quota under a 4-slot pool, half-capacity
+    # load. The seed fills the pool FIRST (its own wave — admission
+    # order is the subject, so it must not race the batch), then the
+    # contenders arrive together.
+    waves = [
+        [_contention_job("seed", 4, 0.6, priority="low")],
+        [
+            _contention_job("h1", 2, 0.3, priority="high"),
+            _contention_job("h2", 2, 0.3, priority="high"),
+            _contention_job("l1", 2, 0.3, priority="low"),
+            _contention_job("l2", 2, 0.3, priority="low"),
+            _contention_job("t1", 2, 0.3, priority="high",
+                            namespace="tenant"),
+            _contention_job("t2", 2, 0.3, priority="high",
+                            namespace="tenant"),
+        ],
+    ]
+    prio = _run_contention(
+        waves, capacity_pods=4, quotas=["tenant:pods=2"])
+    completions = prio["completions"]
+    highs = [completions[n] for n in ("h1", "h2")]
+    lows = [completions[n] for n in ("seed", "l1", "l2")]
+    strict_priority = max(highs) < min(lows)
+    tenant_peak = prio["ns_peak_pods"].get("tenant", 0)
+    seed_status = (
+        prio["cluster"].get_job("JAXJob", "default", "seed").get("status")
+        or {}
+    )
+    admission_violations = check_admission_invariants(
+        prio["admission"], cluster=prio["cluster"], kinds=["JAXJob"])
+    if not strict_priority:
+        regressions.append(
+            f"priority order violated: a low-band job completed before a "
+            f"high-band one ({completions})"
+        )
+    if tenant_peak > 2:
+        regressions.append(
+            f"quota violated: tenant ran {tenant_peak} pods against a "
+            "2-pod quota"
+        )
+    if seed_status.get("disruptionCounts") != {"Worker": 1}:
+        regressions.append(
+            f"seed preemption not counted exactly once: "
+            f"{seed_status.get('disruptionCounts')}"
+        )
+    if admission_violations:
+        regressions.append(
+            "admission invariants: " + "; ".join(admission_violations))
+
+    # Scenario 2: backfill vs FIFO on the gap-shaped load. Waves pin the
+    # arrival order (big admitted, then head queued, then the shorties)
+    # so FIFO-vs-backfill is the only variable.
+    def backfill_jobs():
+        return [
+            [_contention_job("big", 12, 2.0)],
+            [_contention_job("head", 16, 0.4)],
+            [_contention_job(f"s{i}", 4, 0.25) for i in range(6)],
+        ]
+
+    fifo = _run_contention(
+        backfill_jobs(), capacity_pods=16, backfill_max_members=0)
+    backfill = _run_contention(
+        backfill_jobs(), capacity_pods=16, backfill_max_members=8)
+    backfilled = [
+        e for e in backfill["admission"].admit_log if e["backfill"]
+    ]
+    margin = round(
+        fifo["makespan_s"] / max(backfill["makespan_s"], 1e-9), 3)
+    if smoke:
+        if not backfilled:
+            regressions.append(
+                "backfill never fired on the gap-shaped load")
+        if backfill["makespan_s"] >= 0.9 * fifo["makespan_s"]:
+            regressions.append(
+                f"backfill did not beat FIFO on makespan "
+                f"({backfill['makespan_s']}s vs {fifo['makespan_s']}s)"
+            )
+
+    out = {
+        "mode": "contention",
+        "smoke": smoke,
+        "priority_quota": {
+            "completions": completions,
+            "strict_priority": strict_priority,
+            "tenant_peak_pods": tenant_peak,
+            "seed_disruption_counts": seed_status.get("disruptionCounts"),
+        },
+        "backfill_gate": {
+            "fifo_makespan_s": fifo["makespan_s"],
+            "backfill_makespan_s": backfill["makespan_s"],
+            "fifo_utilization": fifo["utilization"],
+            "backfill_utilization": backfill["utilization"],
+            "makespan_speedup": margin,
+            "backfill_admits": len(backfilled),
+        },
+        "regression": "; ".join(regressions) or None,
+    }
+    rc = 1 if (smoke and regressions) else 0
+    if smoke and rc == 0:
+        os.makedirs(os.path.dirname(CONTENTION_BASELINE_PATH), exist_ok=True)
+        with open(CONTENTION_BASELINE_PATH, "w") as f:
+            json.dump({
+                "makespan_speedup": margin,
+                "fifo_utilization": fifo["utilization"],
+                "backfill_utilization": backfill["utilization"],
+            }, f)
+    print(json.dumps(out))
+    return rc
+
+
 if __name__ == "__main__":
     import argparse
 
@@ -807,12 +1103,15 @@ if __name__ == "__main__":
     parser.add_argument("trials", nargs="?", type=int, default=10)
     parser.add_argument("--backend", choices=("process", "http"),
                         default="process")
-    parser.add_argument("--mode", choices=("latency", "scale"),
+    parser.add_argument("--mode", choices=("latency", "scale", "contention"),
                         default="latency")
     parser.add_argument("--smoke", action="store_true",
                         help="scale mode: fast CI check (32-replica-gang "
                         "fan-out gate + the multi-vs-single sync-worker "
-                        "gate on a queue-wait-bound load)")
+                        "gate on a queue-wait-bound load); contention "
+                        "mode: the gang-admission gates (strict priority "
+                        "order, zero quota violations, exactly-once "
+                        "preemption, backfill-beats-FIFO margin)")
     parser.add_argument("--workers", default="",
                         help="scale mode: comma-separated sync-worker pool "
                         "sizes (e.g. 1,2,4,8) — sweeps the gang/job grid "
@@ -832,6 +1131,8 @@ if __name__ == "__main__":
         # Silently routing to a sweep would drop every CI gate.
         parser.error("--smoke and --workers/--replicas are mutually "
                      "exclusive: the smoke tier has its own fixed gates")
+    if args.mode == "contention":
+        sys.exit(contention_main(smoke=args.smoke))
     if (args.workers or args.replicas) and args.mode != "scale":
         # Dropping the flag would hand back a plausible-looking JSON
         # object for the wrong experiment.

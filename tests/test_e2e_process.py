@@ -654,6 +654,148 @@ class TestCheckpointResumeAfterPreemption:
         )
 
 
+@pytest.mark.slow
+class TestGangAdmissionPreemptionResume:
+    """Gang-admission preemption-resume regression (core/admission.py,
+    docs/design/gang_admission.md): a RUNNING low-priority JAXJob is
+    preempted by a higher-priority gang under a one-slot capacity pool,
+    re-queues at the head of its band, re-admits when the high job
+    finishes, resumes from its orbax checkpoint, and completes with
+    exactly one counted disruption and the span-order invariants green.
+    Budget-guarded like the other live llama cases (PR 5): a CPU world
+    too slow to checkpoint or finish skips, never wedges the tier."""
+
+    def test_preempted_victim_requeues_resumes_and_finishes(self, tmp_path):
+        from tf_operator_tpu.core.tracing import Tracer
+        from tf_operator_tpu.testing.invariants import check_span_invariants
+
+        cluster = LocalProcessCluster(child_env=CHILD_ENV)
+        tracer = Tracer()
+        manager = OperatorManager(
+            cluster,
+            OperatorOptions(
+                enabled_schemes=["JAXJob"], health_port=0, metrics_port=0,
+                resync_period=0.2,
+                enable_gang_admission=True, capacity="pods=1",
+            ),
+            metrics=Metrics(),
+            tracer=tracer,
+        )
+        manager.start()
+        try:
+            ckpt_dir = str(tmp_path / "ckpt")
+            train_cmd = [
+                sys.executable,
+                os.path.join(REPO_ROOT, "examples", "jax", "llama",
+                             "llama_train.py"),
+                "--model", "llama-tiny", "--steps", "600", "--batch", "4",
+                "--seq", "32", "--checkpoint-every", "25", "--log-every",
+                "100", "--checkpoint-dir", ckpt_dir,
+            ]
+            cluster.create_job({
+                "apiVersion": "kubeflow.org/v1",
+                "kind": "JAXJob",
+                "metadata": {"name": "victim", "namespace": "default"},
+                "spec": {
+                    "runPolicy": {
+                        "schedulingPolicy": {"priorityClass": "low"},
+                    },
+                    "jaxReplicaSpecs": {
+                        "Worker": {
+                            "replicas": 1,
+                            "template": {"spec": {"containers": [{
+                                "name": "jax", "image": "local",
+                                "command": train_cmd,
+                            }]}},
+                        }
+                    },
+                },
+            })
+
+            def committed_checkpoint():
+                if not os.path.isdir(ckpt_dir):
+                    return False
+                return any(e.name.isdigit() for e in os.scandir(ckpt_dir))
+
+            if not wait_for(committed_checkpoint, timeout=120):
+                pytest.skip(
+                    "llama world committed no checkpoint within 120s — "
+                    "environment too slow for the admission preemption e2e")
+
+            # A higher-priority gang arrives; capacity is one pod slot,
+            # so the admission layer must preempt the victim.
+            cluster.create_job({
+                "apiVersion": "kubeflow.org/v1",
+                "kind": "JAXJob",
+                "metadata": {"name": "vip", "namespace": "default"},
+                "spec": {
+                    "runPolicy": {
+                        "schedulingPolicy": {"priorityClass": "high"},
+                    },
+                    "jaxReplicaSpecs": {
+                        "Worker": {
+                            "replicas": 1,
+                            "template": {"spec": {"containers": [{
+                                "name": "jax", "image": "local",
+                                "command": [sys.executable, "-c",
+                                            "import time; time.sleep(2)"],
+                            }]}},
+                        }
+                    },
+                },
+            })
+
+            def victim_preempted():
+                status = (cluster.get_job("JAXJob", "default", "victim")
+                          .get("status") or {})
+                return (status.get("disruptionCounts") or {}) == {"Worker": 1}
+
+            assert wait_for(victim_preempted, timeout=60), (
+                "victim was never preempted by the higher-priority gang")
+            assert any(
+                "GangPreempted" in e.reason
+                for e in cluster.list_events("JAXJob/default/victim")
+            )
+            assert wait_for(
+                lambda: job_condition(cluster, "JAXJob", "vip", "Succeeded"),
+                timeout=90,
+            ), "high-priority job never completed"
+
+            def victim_back():
+                try:
+                    pod = cluster.get_pod("default", "victim-worker-0")
+                except KeyError:
+                    return False
+                return pod.metadata.deletion_timestamp is None
+
+            assert wait_for(victim_back, timeout=60), (
+                "victim was never re-admitted after the capacity freed")
+            if not wait_for(
+                lambda: job_condition(
+                    cluster, "JAXJob", "victim", "Succeeded"),
+                timeout=180,
+            ):
+                log = cluster.get_pod_log("default", "victim-worker-0")
+                if "resumed from step" in log:
+                    pytest.skip(
+                        "victim resumed from checkpoint but did not finish "
+                        "600 CPU steps within the 180s window")
+                raise AssertionError(
+                    f"victim never resumed after re-admission: {log[-3000:]}")
+            log = cluster.get_pod_log("default", "victim-worker-0")
+            assert "resumed from step" in log, log
+            # Exactly once, end to end: one preemption, one disruption.
+            status = (cluster.get_job("JAXJob", "default", "victim")
+                      .get("status") or {})
+            assert status.get("disruptionCounts") == {"Worker": 1}
+            assert not job_condition(cluster, "JAXJob", "victim", "Failed")
+            violations = check_span_invariants(tracer.export())
+            assert not violations, violations
+        finally:
+            manager.stop()
+            cluster.shutdown()
+
+
 class TestDistributedLlamaTraining:
     def test_two_process_llama_train_to_completion(self, harness):
         """Capstone distributed e2e (SURVEY.md §7 stage 3 'minimum e2e

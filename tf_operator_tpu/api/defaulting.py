@@ -7,7 +7,7 @@ pkg/apis/*/validation/validation.go.
 
 from __future__ import annotations
 
-from typing import Dict, Iterable
+from typing import Dict, Iterable, Optional
 
 from .common import ReplicaSpec, ReplicaType, RunPolicy
 from .k8s import ContainerPort, PodSpec
@@ -60,7 +60,85 @@ def _positive_int(value) -> bool:
     return isinstance(value, int) and not isinstance(value, bool) and value > 0
 
 
-def validate_run_policy(run_policy: RunPolicy, kind: str) -> None:
+def validate_scheduling_policy(
+    run_policy: RunPolicy, kind: str,
+    specs: Optional[Dict[ReplicaType, ReplicaSpec]] = None,
+) -> None:
+    """Admission validation of runPolicy.schedulingPolicy — previously
+    these passed through silently and failed LATE in the controller (a
+    minAvailable above the topology produced a gang no pod set can ever
+    satisfy; an unknown priority class silently landed in the default
+    band; a malformed minResources quantity crashed the PodGroup
+    aggregation mid-reconcile). With the gang-admission layer these
+    fields decide capacity arbitration, so they are typed errors at
+    admission time:
+
+    - minAvailable: positive integer, and (when the replica topology is
+      known) at most the total declared replicas;
+    - priorityClass: a known band name, a bare non-negative integer, or
+      any legal PriorityClass name (which rides the default band —
+      foreign class names keep flowing to the gang scheduler verbatim);
+      rejected only when the value could never name a PriorityClass
+      (negative, non-DNS-shaped — core/admission.py
+      parse_priority_class). Deliberate upgrade note: a STORED job
+      carrying a non-DNS value is failed on its next sync — such a
+      value can never match a real PriorityClass object (k8s rejects
+      the object name), so the job could never gang-schedule anyway;
+      a typed early failure beats an eternal unschedulable Pending;
+    - minResources: every quantity must parse as a Kubernetes
+      resource.Quantity and be non-negative."""
+    sp = run_policy.scheduling_policy
+    if sp is None:
+        return
+    ma = sp.min_available
+    if ma is not None:
+        if not _positive_int(ma):
+            raise ValidationError(
+                f"{kind}Spec is not valid: schedulingPolicy.minAvailable "
+                f"must be a positive integer, got {ma!r}"
+            )
+        if specs:
+            total = sum(
+                (s.replicas or 0) for s in specs.values() if s is not None
+            )
+            if total and ma > total:
+                raise ValidationError(
+                    f"{kind}Spec is not valid: schedulingPolicy.minAvailable "
+                    f"({ma}) exceeds the declared replica topology ({total} "
+                    "replica(s)) — the gang could never be satisfied"
+                )
+    if sp.priority_class:
+        from ..core.admission import parse_priority_class
+
+        try:
+            parse_priority_class(sp.priority_class)
+        except ValueError:
+            raise ValidationError(
+                f"{kind}Spec is not valid: schedulingPolicy.priorityClass "
+                f"{sp.priority_class!r} is not a priority band, a "
+                "non-negative integer, or a legal PriorityClass name"
+            )
+    for name, qty in (sp.min_resources or {}).items():
+        from ..core.job_controller import parse_quantity
+
+        try:
+            value = parse_quantity(qty)
+        except (ValueError, ZeroDivisionError, TypeError):
+            raise ValidationError(
+                f"{kind}Spec is not valid: schedulingPolicy.minResources"
+                f"[{name}] = {qty!r} is not a valid resource quantity"
+            )
+        if value < 0:
+            raise ValidationError(
+                f"{kind}Spec is not valid: schedulingPolicy.minResources"
+                f"[{name}] = {qty!r} must be non-negative"
+            )
+
+
+def validate_run_policy(
+    run_policy: RunPolicy, kind: str,
+    specs: Optional[Dict[ReplicaType, ReplicaSpec]] = None,
+) -> None:
     """Admission validation of the gang-liveness deadlines (the rest of
     RunPolicy predates this check and keeps its permissive parsing).
 
@@ -98,6 +176,10 @@ def validate_run_policy(run_policy: RunPolicy, kind: str) -> None:
             f"{kind}Spec is not valid: runPolicy.forceDeleteAfterSeconds "
             f"must be a positive integer, got {fda!r}"
         )
+    # Scheduling-policy hardening rides the same entry point every kind
+    # already calls; `specs` is optional so legacy callers keep working
+    # (they just skip the topology bound).
+    validate_scheduling_policy(run_policy, kind, specs)
 
 
 def validate_replica_specs(

@@ -161,7 +161,7 @@ def set_defaults(job: JAXJob) -> None:
 
 
 def validate(spec: JAXJobSpec) -> None:
-    validate_run_policy(spec.run_policy, KIND)
+    validate_run_policy(spec.run_policy, KIND, spec.jax_replica_specs)
     validate_replica_specs(spec.jax_replica_specs, DEFAULT_CONTAINER_NAME, KIND)
     if spec.elastic is not None:
         el = spec.elastic

@@ -114,7 +114,7 @@ def set_defaults(job: MXJob) -> None:
 def validate(spec: MXJobSpec) -> None:
     """reference pkg/apis/mxnet/validation/validation.go — containers and
     images present, container named `mxnet`, at most one Scheduler."""
-    validate_run_policy(spec.run_policy, KIND)
+    validate_run_policy(spec.run_policy, KIND, spec.mx_replica_specs)
     if not spec.mx_replica_specs:
         raise ValidationError("MXJobSpec is not valid")
     found_scheduler = 0

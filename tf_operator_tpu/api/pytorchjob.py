@@ -97,7 +97,7 @@ def validate(spec: PyTorchJobSpec) -> None:
     """reference pkg/apis/pytorch/validation/validation.go:ValidateV1PyTorchJobSpec —
     valid replica types only, images set, container named `pytorch`, and
     exactly one Master with replicas == 1."""
-    validate_run_policy(spec.run_policy, KIND)
+    validate_run_policy(spec.run_policy, KIND, spec.pytorch_replica_specs)
     if not spec.pytorch_replica_specs:
         raise ValidationError("PyTorchJobSpec is not valid")
     for rtype in spec.pytorch_replica_specs:

@@ -131,7 +131,7 @@ def set_defaults(tfjob: TFJob) -> None:
 
 def validate(spec: TFJobSpec) -> None:
     """reference validation/validation.go:27-66 (ValidateV1TFJobSpec)"""
-    validate_run_policy(spec.run_policy, KIND)
+    validate_run_policy(spec.run_policy, KIND, spec.tf_replica_specs)
     validate_replica_specs(spec.tf_replica_specs, DEFAULT_CONTAINER_NAME, KIND)
     found_chief = sum(1 for rt in spec.tf_replica_specs if is_chief_or_master(rt))
     if found_chief > 1:

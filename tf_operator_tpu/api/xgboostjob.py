@@ -78,7 +78,7 @@ def validate(spec: XGBoostJobSpec) -> None:
     """reference pkg/apis/xgboost/validation/validation.go — valid replica
     types, images set, container named `xgboost`, exactly one Master with
     replicas == 1."""
-    validate_run_policy(spec.run_policy, KIND)
+    validate_run_policy(spec.run_policy, KIND, spec.xgb_replica_specs)
     if not spec.xgb_replica_specs:
         raise ValidationError("XGBoostJobSpec is not valid")
     for rtype in spec.xgb_replica_specs:

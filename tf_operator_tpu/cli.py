@@ -114,6 +114,29 @@ class OperatorOptions:
     # Per-job floor between coalesced status flushes: churn inside the
     # window is buffered and carried by a scheduled flush.
     status_flush_interval: float = 1.0
+    # Capacity-aware gang admission (core/admission.py,
+    # docs/design/gang_admission.md). Off (the default) = first-come,
+    # capacity-blind admission exactly as before — every PR 1-8 seeded
+    # tier replays byte-identically because the arbiter is never built.
+    # On: jobs queue against the declared --capacity pool with per-tenant
+    # quotas, priority bands, preempt-lowest-band on contention, and
+    # bounded backfill with an aging starvation bound.
+    enable_gang_admission: bool = False
+    # The declared capacity pool: "res=qty[,res=qty...]", e.g.
+    # "google.com/tpu=128,pods=32". The synthetic `pods` resource counts
+    # gang members (summed minMember), so pools can be declared in plain
+    # pod slots when templates carry no resource requests. Backends with
+    # a schedulable-capacity model (the in-memory simulator) also bound
+    # the pool live — a seeded capacity revocation shrinks it mid-run.
+    capacity: str = ""
+    # Per-tenant quotas: each entry "ns:res=qty[,res=qty...]".
+    namespace_quotas: List[str] = field(default_factory=list)
+    # Backfill bound: a waiting gang with at most this many members may
+    # jump the queue into a capacity gap; 0 disables backfill.
+    backfill_max_members: int = 8
+    # Aging bound: once the head-of-line gang has waited this long, no
+    # backfill admits until it does (starvation-freedom).
+    admission_aging_seconds: float = 300.0
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -170,6 +193,30 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "with scripts/trace_dump.py.")
     parser.add_argument("--enable-gang-scheduling", action="store_true")
     parser.add_argument("--gang-scheduler-name", default="volcano")
+    parser.add_argument("--enable-gang-admission", action="store_true",
+                        help="Capacity-aware gang admission "
+                        "(core/admission.py): jobs queue against the "
+                        "--capacity pool with per-tenant quotas, priority "
+                        "bands (schedulingPolicy.priorityClass), "
+                        "preempt-lowest-band on contention, and bounded "
+                        "backfill. Default off = first-come admission "
+                        "exactly as before.")
+    parser.add_argument("--capacity", default="",
+                        help="Declared admission pool, 'res=qty[,res=qty]' "
+                        "(e.g. 'google.com/tpu=128,pods=32'); 'pods' "
+                        "counts gang members. Empty = unbounded (quota/"
+                        "priority arbitration only).")
+    parser.add_argument("--namespace-quota", action="append", default=[],
+                        metavar="NS:RES=QTY[,RES=QTY]",
+                        help="Per-tenant admission quota (repeatable).")
+    parser.add_argument("--backfill-max-members", type=int, default=8,
+                        help="Largest gang (by member count) eligible to "
+                        "backfill into a capacity gap ahead of the "
+                        "head-of-line; 0 disables backfill.")
+    parser.add_argument("--admission-aging-seconds", type=float, default=300.0,
+                        help="Once the head-of-line gang has waited this "
+                        "long, backfill stops until it admits "
+                        "(starvation bound).")
     parser.add_argument("--json-log-format", action="store_true",
                         help="Deprecated alias for --log-format json.")
     parser.add_argument("--log-format", choices=("text", "json"), default="text",
@@ -235,6 +282,11 @@ def options_from_args(args: argparse.Namespace) -> OperatorOptions:
         fanout_max_parallelism=args.fanout_max_parallelism,
         write_coalescing=not args.disable_write_coalescing,
         status_flush_interval=args.status_flush_interval,
+        enable_gang_admission=args.enable_gang_admission,
+        capacity=args.capacity,
+        namespace_quotas=list(args.namespace_quota),
+        backfill_max_members=args.backfill_max_members,
+        admission_aging_seconds=args.admission_aging_seconds,
     )
 
 
@@ -460,6 +512,40 @@ class OperatorManager:
             write_coalescing=self.options.write_coalescing,
             status_flush_interval=self.options.status_flush_interval,
         )
+        # ONE gang-admission arbiter shared by every framework controller
+        # (core/admission.py): capacity and quota are operator-wide, so a
+        # per-kind arbiter would double-count a mixed fleet. Built only
+        # when opted in — the None default keeps every seeded tier's
+        # engine byte-identical. Backends with a schedulable-capacity
+        # model (the in-memory simulator; the chaos proxy passes it
+        # through) also bound the pool live, which is how the seeded
+        # capacity-revocation fault reaches admission.
+        self.admission = None
+        if self.options.enable_gang_admission:
+            from .core.admission import (
+                AdmissionController,
+                parse_quota_flag,
+                parse_resource_list,
+            )
+
+            quotas: Dict[str, Dict[str, str]] = {}
+            for entry in self.options.namespace_quotas:
+                # Merge per-namespace: two --namespace-quota entries for
+                # one tenant compose their resource bounds (a wholesale
+                # dict replace would silently drop the first entry's).
+                for ns, resources in parse_quota_flag(entry).items():
+                    quotas.setdefault(ns, {}).update(resources)
+            self.admission = AdmissionController(
+                capacity=(
+                    parse_resource_list(self.options.capacity)
+                    if self.options.capacity else None
+                ),
+                quotas=quotas,
+                backfill_max_members=self.options.backfill_max_members,
+                aging_seconds=self.options.admission_aging_seconds,
+                metrics=self.metrics,
+                capacity_fn=getattr(cluster, "schedulable_capacity", None),
+            )
         from .core.control import TokenBucket
 
         shared_limiter = TokenBucket(self.options.qps, self.options.burst)
@@ -488,6 +574,7 @@ class OperatorManager:
                 tracer=self.tracer,
                 watch_cache=self.watch_cache,
                 owns=owns,
+                admission=self.admission,
             )
         # Effective pool size per kind: the requested --workers ANDed with
         # the cluster seam's supports_concurrent_syncs capability
@@ -544,6 +631,14 @@ class OperatorManager:
             "shards": (
                 self.coordinator.snapshot()
                 if self.coordinator is not None else None
+            ),
+            # Admission queue dump (core/admission.py snapshot): bands,
+            # queue positions, aging clocks, usage vs capacity/quotas,
+            # pending preemptions — the first read when a job sits
+            # Queued "for no reason".
+            "admission": (
+                self.admission.snapshot()
+                if self.admission is not None else None
             ),
             "threads": threads,
         }

@@ -205,6 +205,21 @@ class ScheduledRenewDelay:
 
 
 @dataclass
+class ScheduledCapacityRevocation:
+    """A capacity revocation planted in the schedule: after the proxy has
+    seen `after_writes` total writes, the backend's schedulable-capacity
+    pool is REPLACED with `capacity` (normally smaller — a reservation
+    reclaimed, a maintenance window fencing hosts). Already-bound pods
+    keep running; reconciling the admitted set down to the shrunk pool is
+    the gang-admission layer's job (preempt-lowest-band-to-fit,
+    core/admission.py). Fires at most once; requires a backend with
+    set_schedulable_capacity (the in-memory simulator)."""
+
+    after_writes: int
+    capacity: Dict[str, str] = None  # type: ignore[assignment]
+
+
+@dataclass
 class ScheduledStuckTermination:
     """A dead-kubelet event planted in the schedule: after the proxy has
     seen `after_writes` total writes, graceful deletes of matching pods
@@ -244,6 +259,11 @@ class ChaosSpec:
     crash_points: Tuple[CrashPoint, ...] = ()
     # Dead-kubelet plan: write-clock-scheduled stuck-terminating holds.
     stuck_terminations: Tuple[ScheduledStuckTermination, ...] = ()
+    # Capacity-revocation plan (the gang-admission layer's adversary):
+    # write-clock-scheduled shrinks of the backend's schedulable pool.
+    # The admission layer observes the new bound through its capacity_fn
+    # and must preempt lowest-band gangs until the admitted set fits.
+    capacity_revocations: Tuple[ScheduledCapacityRevocation, ...] = ()
     # Lease-contention plan (the sharded control plane's adversary):
     # rival writes forcing contested claims, and silently dropped
     # renewals opening the delayed-renew steal window. Both key on
@@ -298,6 +318,7 @@ class ChaosCluster:
         self._writes_seen = 0
         self._preempted = [False] * len(spec.preemptions)
         self._stuck_fired = [False] * len(spec.stuck_terminations)
+        self._capacity_fired = [False] * len(spec.capacity_revocations)
         self._crashes_fired = 0
         # Direct-lever hangs (freeze_heartbeats) appended at test-chosen
         # points, beside the write-clock-scheduled spec.hangs.
@@ -414,6 +435,13 @@ class ChaosCluster:
             ]
             for i in stuck_due:
                 self._stuck_fired[i] = True
+            capacity_due = [
+                i for i, c in enumerate(self.spec.capacity_revocations)
+                if not self._capacity_fired[i]
+                and self._writes_seen >= c.after_writes
+            ]
+            for i in capacity_due:
+                self._capacity_fired[i] = True
         for i in due:
             p = self.spec.preemptions[i]
             self.preempt_pods(
@@ -425,6 +453,8 @@ class ChaosCluster:
             self.stick_terminating(
                 name_contains=s.name_contains, namespace=s.namespace,
             )
+        for i in capacity_due:
+            self.revoke_capacity(self.spec.capacity_revocations[i].capacity)
 
     # ------------------------------------------------------------ proxy
     def __getattr__(self, name):
@@ -515,6 +545,25 @@ class ChaosCluster:
             )
         hold(name_contains=name_contains, namespace=namespace)
         self._log(f"stuck-terminating:{namespace or '*'}:{name_contains}")
+
+    def revoke_capacity(self, capacity: Optional[Dict[str, str]]) -> None:
+        """Direct capacity-revocation lever (the preempt_pods analog):
+        replace the backend's schedulable pool — normally with a smaller
+        one. The gang-admission layer observes the shrink through its
+        capacity_fn and must preempt lowest-band gangs until the
+        admitted set fits again. Requires a backend with
+        set_schedulable_capacity (the in-memory simulator)."""
+        setter = getattr(self._inner, "set_schedulable_capacity", None)
+        if setter is None:
+            raise TypeError(
+                "chaos revoke_capacity needs a backend with "
+                "set_schedulable_capacity (the in-memory simulator)"
+            )
+        setter(capacity)
+        self._log(
+            "capacity-revoke:"
+            + ",".join(f"{k}={v}" for k, v in sorted((capacity or {}).items()))
+        )
 
     def unstick_terminating(self) -> None:
         """Release every termination hold (the kubelet coming back): held

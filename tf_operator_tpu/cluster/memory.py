@@ -95,6 +95,15 @@ class InMemoryCluster(base.Cluster):
         # is (namespace-or-None, name substring).
         self._termination_holds: List[Tuple[Optional[str], str]] = []
         self._held_deletions: set = set()  # (ns, name) with a delete pending
+        # Schedulable-capacity model (None = unbounded, the historical
+        # behavior): when set, step() binds a pending pod only while the
+        # bound pods' resource demand (container requests, falling back
+        # to limits, plus one synthetic `pods` slot each) still fits.
+        # Deliberately PER-POD, not per-gang: a capacity-blind first-come
+        # operator therefore really does strand partial gangs under
+        # contention — the failure regime the admission layer
+        # (core/admission.py) exists to prevent, made reproducible here.
+        self._capacity: Optional[Dict[str, str]] = None
 
     # ------------------------------------------------------------------ util
     def latest_rv(self) -> int:
@@ -547,6 +556,72 @@ class InMemoryCluster(base.Cluster):
         with self._lock:
             self._pod_groups.pop((namespace, name), None)
 
+    def set_pod_group_phase(self, namespace: str, name: str, phase: str) -> None:
+        """Set a PodGroup's status.phase (Pending/Inqueue/Running) — the
+        slice of the Volcano state machine the simulator models. The gang
+        admission layer mirrors its verdicts here so phase-driven
+        surfaces (_sync_pod_group's Queued check, dashboards) agree with
+        the arbiter; on a real cluster Volcano owns this field."""
+        with self._lock:
+            group = self._pod_groups.get((namespace, name))
+            if group is None:
+                raise NotFound(f"podgroup {namespace}/{name}")
+            group.setdefault("status", {})["phase"] = phase
+
+    # ------------------------------------------------- schedulable capacity
+    def set_schedulable_capacity(
+        self, resources: Optional[Dict[str, str]]
+    ) -> None:
+        """Declare (or with None, remove) the cluster's schedulable
+        capacity. Shrinking it mid-run is the capacity-revocation fault:
+        already-bound pods keep running — reclaiming them is the
+        operator's job (preempt-to-fit), not the simulator's."""
+        with self._lock:
+            self._capacity = dict(resources) if resources else None
+
+    def schedulable_capacity(self) -> Optional[Dict[str, str]]:
+        """The declared pool (None = unbounded). The admission layer's
+        capacity_fn reads this, which is how a seeded revocation becomes
+        an admission-visible event."""
+        with self._lock:
+            return dict(self._capacity) if self._capacity else None
+
+    @staticmethod
+    def _pod_demand(pod: Pod) -> Dict[str, object]:
+        from ..core.job_controller import parse_quantity
+
+        demand: Dict[str, object] = {"pods": 1}
+        for container in pod.spec.containers:
+            resources = container.resources or {}
+            requests = resources.get("requests") or resources.get("limits") or {}
+            for name, qty in requests.items():
+                try:
+                    demand[name] = demand.get(name, 0) + parse_quantity(qty)
+                except (ValueError, ZeroDivisionError):
+                    continue
+        return demand
+
+    def _bound_usage_locked(self) -> Dict[str, object]:
+        usage: Dict[str, object] = {}
+        for pod in self._pods.values():
+            if pod.status.phase != POD_RUNNING:
+                continue
+            for name, qty in self._pod_demand(pod).items():
+                usage[name] = usage.get(name, 0) + qty
+        return usage
+
+    def _capacity_allows_locked(self, usage, demand) -> bool:
+        if self._capacity is None:
+            return True
+        from ..core.job_controller import parse_quantity
+
+        for name, qty in demand.items():
+            if name not in self._capacity:
+                continue
+            if usage.get(name, 0) + qty > parse_quantity(self._capacity[name]):
+                return False
+        return True
+
     # ---------------------------------------------------------------- leases
     def get_lease(self, namespace: str, name: str) -> dict:
         with self._lock:
@@ -654,16 +729,31 @@ class InMemoryCluster(base.Cluster):
 
     def step(self) -> None:
         """Advance the simulated cluster by one tick: bind pending pods
-        (gang-aware) and run container behaviors of running pods."""
+        (gang-aware, capacity-bounded when a pool is declared) and run
+        container behaviors of running pods."""
         updates = []
         with self._lock:
+            usage = (
+                self._bound_usage_locked() if self._capacity is not None
+                else None
+            )
             for key, pod in list(self._pods.items()):
                 if pod.status.phase == POD_PENDING:
+                    demand = (
+                        self._pod_demand(pod) if usage is not None else None
+                    )
+                    if usage is not None and not self._capacity_allows_locked(
+                        usage, demand
+                    ):
+                        continue  # no room: stays Pending (contention!)
                     if self._gang_schedulable(pod):
                         pod.status.phase = POD_RUNNING
                         pod.status.start_time = self._clock()
                         pod.metadata.resource_version = str(next(self._rv))
                         updates.append(pod.deep_copy())
+                        if usage is not None:
+                            for name, qty in demand.items():
+                                usage[name] = usage.get(name, 0) + qty
                 elif pod.status.phase == POD_RUNNING:
                     behavior = self._behaviors.get(key)
                     if behavior is not None:

@@ -88,6 +88,7 @@ class FrameworkController(FrameworkHooks):
         tracer=None,
         watch_cache=None,
         owns=None,
+        admission=None,
     ):
         opts = options or EngineOptions()
         if metrics is None:
@@ -183,6 +184,11 @@ class FrameworkController(FrameworkHooks):
             on_status_coalesced=self._record_status_coalesced,
             on_status_flush=self._record_status_flush,
             tracer=tracer,
+            # Gang admission arbiter (core/admission.py): ONE shared
+            # instance per operator, passed by the manager when
+            # --enable-gang-admission is on; None (the default) keeps the
+            # engine's admission gate a single None-check.
+            admission=admission,
         )
         # Queue-wait observer (enqueue -> worker pop), fed straight into
         # the queue_wait histogram; injected custom queues without the

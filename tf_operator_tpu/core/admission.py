@@ -1,0 +1,684 @@
+"""Capacity-aware gang admission: quota'd queueing, priority preemption,
+and bounded backfill (docs/design/gang_admission.md).
+
+The reference operator fires PodGroups at Volcano and forgets them; the
+gang unit here (per-slice PodGroups, the JOB_QUEUED condition) already
+exists but admission was first-come and capacity-blind — under contention
+jobs race, deadlock on partial gangs, or starve. This module is the
+operator-side admission arbiter the Gavel line of work (arXiv:2008.09213)
+argues for: a declared capacity pool, all-or-nothing job admission (a
+job's pods stay UNBORN while it queues — no partial gang can ever exist),
+per-tenant (namespace) quotas, priority bands from
+``SchedulingPolicy.priorityClass``, preempt-lowest-priority-gang on
+contention, and bounded backfill of small gangs into capacity gaps with
+an aging bound so backfill can never starve the head-of-line gang.
+
+Everything is deterministic given a deterministic call sequence and
+clock: decisions are pure functions of (registered gangs, capacity,
+clock) — no randomness — so the seeded chaos/crash tiers replay
+byte-identically with admission ON, and with the flag OFF (the default)
+the engine never constructs this object at all and the PR 1–8 behavior
+is untouched byte-for-byte.
+
+Ordering rules, in one place:
+
+- The wait queue is ordered by (band desc, seq asc): higher priority
+  bands first, FIFO within a band. ``seq`` is a monotonic admission-
+  controller sequence; a preempted gang re-enters at the HEAD of its
+  band (seq below every current waiter of that band).
+- The head-of-line is the first waiting gang whose own namespace quota
+  would allow it (a tenant that exhausted its own quota must not hold
+  the line against other tenants — its wait can only end with its own
+  releases).
+- A non-head gang may only be BACKFILLED: it must fit the free gap, its
+  member count must not exceed ``backfill_max_members``, and the
+  head-of-line must not have waited past ``aging_seconds`` — once the
+  head ages past the bound, backfill stops until the head admits
+  (starvation-freedom; audited from the admit log by
+  testing/invariants.py).
+- When the head does not fit, admitted gangs of STRICTLY lower band are
+  preempted — lowest band first, most-recently-admitted first — until
+  the head would fit. Victims are only MARKED here; the engine routes
+  the teardown through the count-before-teardown disruption protocol
+  and acknowledges with :meth:`note_preempted` once the counted write
+  is durable, so the preemption lands in the budget-free
+  ``disruptionCounts`` ledger exactly once.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from fractions import Fraction
+from typing import Callable, Dict, List, Optional
+
+from .job_controller import parse_quantity
+
+# Priority bands for SchedulingPolicy.priorityClass. Scheduler-style
+# class names map onto small integers; bare non-negative integers are
+# accepted verbatim so clusters with numeric PriorityClass conventions
+# can express finer ladders. Other legal PriorityClass names ride the
+# DEFAULT band (never band 0 — an unrecognized name must not make a job
+# globally preemptible); only un-nameable values (negative, non-DNS) are
+# ValidationErrors at admission (api/defaulting.py).
+PRIORITY_CLASSES = {
+    "low": 0,
+    "preemptible": 0,
+    "best-effort": 0,
+    "": 1,
+    "default": 1,
+    "normal": 1,
+    "high": 2,
+    "critical": 3,
+}
+
+# Preemption causes (the gang_preemptions_total{cause} label values).
+PREEMPT_CAUSE_PRIORITY = "PriorityPreemption"
+PREEMPT_CAUSE_CAPACITY = "CapacityRevoked"
+
+
+import re as _re
+
+# A legal Kubernetes PriorityClass name (DNS-1123 subdomain shape). Names
+# outside the band vocabulary but inside this shape are legitimate
+# cluster PriorityClasses the operator merely has no band opinion about —
+# they ride the default band (and pass through to the PodGroup verbatim,
+# exactly as before this layer existed). Anything outside the shape can
+# never name a real PriorityClass and is a typed ValidationError.
+_K8S_NAME_RE = _re.compile(r"^[a-z0-9]([-a-z0-9.]*[a-z0-9])?$")
+
+
+def parse_priority_class(value) -> int:
+    """Band of a priorityClass: a known band name (case-insensitive), a
+    bare non-negative integer, or any OTHER legal PriorityClass name —
+    which maps to the default band (the operator ranks only its own band
+    vocabulary; foreign class names are Volcano's business and must keep
+    flowing through untouched). Raises ValueError only for values that
+    could never name a PriorityClass: negatives (they would sort below
+    every band and permanently starve the job) and non-DNS-shaped
+    strings."""
+    v = str(value or "").strip()
+    band = PRIORITY_CLASSES.get(v.lower())
+    if band is not None:
+        return band
+    if v.isdigit():
+        return int(v)
+    if _K8S_NAME_RE.match(v):
+        return PRIORITY_CLASSES[""]
+    raise ValueError(f"malformed priority class {value!r}")
+
+
+def parse_resource_list(text) -> Dict[str, str]:
+    """Parse "res=qty[,res=qty...]" (the --capacity / quota flag syntax)
+    into a resource dict; quantities stay strings (parse_quantity-legal,
+    validated here). Empty input -> {}."""
+    out: Dict[str, str] = {}
+    for part in str(text or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, qty = part.partition("=")
+        if not sep or not name.strip():
+            raise ValueError(f"malformed resource entry {part!r} (want res=qty)")
+        parse_quantity(qty.strip())  # raises on malformed quantities
+        out[name.strip()] = qty.strip()
+    return out
+
+
+def parse_quota_flag(text) -> Dict[str, Dict[str, str]]:
+    """Parse one "--namespace-quota ns:res=qty[,res=qty...]" value."""
+    ns, sep, resources = str(text or "").partition(":")
+    if not sep or not ns.strip():
+        raise ValueError(
+            f"malformed quota {text!r} (want namespace:res=qty[,res=qty])"
+        )
+    return {ns.strip(): parse_resource_list(resources)}
+
+
+def gang_demand(groups: List[dict]) -> Dict[str, Fraction]:
+    """Aggregate a job's gang groups (hooks.gang_groups output) into one
+    admission demand: the summed minResources plus a synthetic ``pods``
+    resource (the summed minMember) so a pool can be declared in plain
+    pod slots even when templates carry no resource requests."""
+    demand: Dict[str, Fraction] = {}
+    members = Fraction(0)
+    for group in groups:
+        spec = group.get("spec") or {}
+        members += int(spec.get("minMember") or 0)
+        for name, qty in (spec.get("minResources") or {}).items():
+            try:
+                demand[name] = demand.get(name, Fraction(0)) + parse_quantity(qty)
+            except (ValueError, ZeroDivisionError):
+                continue  # malformed stored quantity: validation rejects new ones
+    if members:
+        demand["pods"] = demand.get("pods", Fraction(0)) + members
+    return demand
+
+
+def _parse_resources(resources) -> Dict[str, Fraction]:
+    return {k: parse_quantity(v) for k, v in (resources or {}).items()}
+
+
+@dataclass
+class AdmitResult:
+    """One try_admit verdict. ``newly_admitted``/``newly_queued`` fire
+    exactly once per transition (the engine's event/span triggers);
+    ``waited`` is the queue wait of a newly-admitted gang (the
+    ``admission.queue`` span duration); ``blocked_on`` names the binding
+    constraint of a queued gang (capacity | quota | order | priority)."""
+
+    admitted: bool
+    newly_admitted: bool = False
+    newly_queued: bool = False
+    waited: float = 0.0
+    blocked_on: str = ""
+
+
+@dataclass
+class _Gang:
+    key: str  # "<Kind>:<ns>/<name>" — the workqueue item identity
+    kind: str
+    namespace: str
+    name: str
+    uid: str
+    band: int
+    demand: Dict[str, Fraction]
+    members: int
+    seq: int
+    enqueued_at: float
+    kick: Optional[Callable[[], None]] = None
+    admitted_at: Optional[float] = None
+    backfilled: bool = False
+    blocked_on: str = ""
+    announced_admit: bool = False
+    announced_queue: bool = False
+    # Last blocked_on verdict the metric layer saw: the quota-denial
+    # counter fires on the TRANSITION into "quota", not on every
+    # fallback-requeue poll of a still-blocked gang (which would trip
+    # the denial-rate alert forever for one patiently-waiting job).
+    reported_block: str = ""
+
+
+class AdmissionController:
+    """The shared (one per operator process) admission arbiter. All
+    state is in-memory by design — like expectations and the heartbeat
+    observation cache, an operator restart rebuilds it from the cluster:
+    jobs with live pods re-ADOPT their admission unconditionally
+    (has_pods), jobs without re-queue, and any over-capacity left by the
+    adoption resolves through the same preemption path a capacity
+    revocation takes."""
+
+    def __init__(
+        self,
+        capacity: Optional[Dict[str, str]] = None,
+        quotas: Optional[Dict[str, Dict[str, str]]] = None,
+        backfill_max_members: int = 8,
+        aging_seconds: float = 300.0,
+        clock=time.time,
+        metrics=None,
+        capacity_fn: Optional[Callable[[], Optional[Dict[str, str]]]] = None,
+    ):
+        self._declared = _parse_resources(capacity) if capacity else None
+        self.quotas: Dict[str, Dict[str, Fraction]] = {
+            ns: _parse_resources(res) for ns, res in (quotas or {}).items()
+        }
+        self.backfill_max_members = int(backfill_max_members)
+        self.aging_seconds = float(aging_seconds)
+        self.clock = clock
+        if metrics is None:
+            from ..metrics import METRICS
+
+            metrics = METRICS
+        self.metrics = metrics
+        # Live capacity provider (the memory cluster's schedulable-
+        # capacity model, through which the seeded capacity-revocation
+        # fault arrives): the effective pool is the per-resource MIN of
+        # the declared pool and whatever the provider reports.
+        self._capacity_fn = capacity_fn
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._admitted: Dict[str, _Gang] = {}
+        self._waiting: Dict[str, _Gang] = {}
+        self._preempt: Dict[str, str] = {}  # key -> cause, engine-acknowledged
+        self._kicks: List[Callable[[], None]] = []
+        # Audit ledgers (testing/invariants.py): every admit with its
+        # backfill verdict + the head-of-line wait at that instant, and
+        # every acknowledged preemption (key, uid, cause) — exactly one
+        # entry per physical preemption by construction (note_preempted
+        # pops the pending marker first). BOUNDED rings (the Tracer
+        # convention): a long-lived operator churning jobs must not grow
+        # RSS forever, and /debugz snapshots copy these under the lock —
+        # the invariants read the retained window, which is exactly the
+        # recent history a test scenario produces.
+        from collections import deque
+
+        self.admit_log: "deque[dict]" = deque(maxlen=1024)
+        self.preemption_ledger: "deque[tuple]" = deque(maxlen=512)
+
+    # --------------------------------------------------------- capacity
+    def effective_capacity(self) -> Optional[Dict[str, Fraction]]:
+        """None = unlimited. With both a declared pool and a live
+        provider, a resource's bound is the smaller of the two (a
+        revocation can only shrink the pool, never grow past --capacity)."""
+        cap = dict(self._declared) if self._declared is not None else None
+        if self._capacity_fn is not None:
+            try:
+                live = self._capacity_fn()
+            except Exception:  # noqa: BLE001 — a flaky provider must not wedge admission
+                live = None
+            if live:
+                parsed = _parse_resources(live)
+                if cap is None:
+                    cap = parsed
+                else:
+                    for name, qty in parsed.items():
+                        cap[name] = min(cap.get(name, qty), qty)
+        return cap
+
+    def _usage_locked(self, exclude=()) -> Dict[str, Fraction]:
+        usage: Dict[str, Fraction] = {}
+        for key, gang in self._admitted.items():
+            if key in exclude:
+                continue
+            for name, qty in gang.demand.items():
+                usage[name] = usage.get(name, Fraction(0)) + qty
+        return usage
+
+    def _ns_usage_locked(self, namespace: str, exclude=()) -> Dict[str, Fraction]:
+        usage: Dict[str, Fraction] = {}
+        for key, gang in self._admitted.items():
+            if key in exclude or gang.namespace != namespace:
+                continue
+            for name, qty in gang.demand.items():
+                usage[name] = usage.get(name, Fraction(0)) + qty
+        return usage
+
+    @staticmethod
+    def _fits(demand, usage, cap) -> bool:
+        """Resources absent from the pool are unconstrained (a pool
+        declared in chips does not bound cpu)."""
+        if cap is None:
+            return True
+        return all(
+            usage.get(name, Fraction(0)) + qty <= cap[name]
+            for name, qty in demand.items()
+            if name in cap
+        )
+
+    def _quota_ok_locked(self, gang: _Gang, exclude=()) -> bool:
+        quota = self.quotas.get(gang.namespace)
+        if not quota:
+            return True
+        usage = self._ns_usage_locked(gang.namespace, exclude=exclude)
+        return all(
+            usage.get(name, Fraction(0)) + qty <= quota[name]
+            for name, qty in gang.demand.items()
+            if name in quota
+        )
+
+    # ------------------------------------------------------------- pump
+    def _waiting_order_locked(self) -> List[_Gang]:
+        return sorted(self._waiting.values(), key=lambda g: (-g.band, g.seq))
+
+    def _admit_locked(self, gang: _Gang, now: float, backfill: bool,
+                      head_wait: Optional[float]) -> None:
+        self._waiting.pop(gang.key, None)
+        gang.admitted_at = now
+        gang.backfilled = backfill
+        gang.blocked_on = ""
+        gang.announced_admit = False
+        self._admitted[gang.key] = gang
+        self.admit_log.append({
+            "key": gang.key, "band": gang.band, "backfill": backfill,
+            "head_wait_at_admit": head_wait,
+            "wait": now - gang.enqueued_at,
+        })
+        self.metrics.observe_admission_wait(
+            gang.namespace, gang.kind, max(0.0, now - gang.enqueued_at)
+        )
+        if gang.kick is not None:
+            self._kicks.append(gang.kick)
+
+    def _mark_preempt_locked(self, gang: _Gang, cause: str) -> None:
+        if gang.key in self._preempt:
+            return
+        self._preempt[gang.key] = cause
+        if gang.kick is not None:
+            self._kicks.append(gang.kick)
+
+    def _pump_locked(self, now: float) -> None:
+        """The decision procedure, run after every state change. Marks
+        preemption victims, admits every currently-eligible waiter, and
+        leaves a blocked_on verdict on the rest."""
+        cap = self.effective_capacity()
+        # Capacity revocation: the pool shrank under the admitted set —
+        # preempt lowest-band (then most-recently-admitted) gangs until
+        # what remains fits. Pending victims still count as usage until
+        # the engine's counted teardown acknowledges them, so the check
+        # excludes only gangs already marked.
+        if cap is not None:
+            victims_pool = sorted(
+                (g for g in self._admitted.values() if g.key not in self._preempt),
+                key=lambda g: (g.band, -g.seq),
+            )
+            excluded = set(self._preempt)
+            for victim in victims_pool:
+                usage = self._usage_locked(exclude=excluded)
+                if all(usage.get(r, Fraction(0)) <= cap[r] for r in cap):
+                    break
+                self._mark_preempt_locked(victim, PREEMPT_CAUSE_CAPACITY)
+                excluded.add(victim.key)
+        # Admission scan, priority order. Head-of-line = first waiter its
+        # own quota allows; it admits as soon as it fits, schedules
+        # preemption of strictly-lower bands when it doesn't, and bounds
+        # backfill behind it by its age.
+        # While preemptions are PENDING (marked but not yet acknowledged
+        # by the engine's counted teardown), the capacity they will free
+        # is spoken for — the head the arbiter is evicting FOR must get
+        # it. Backfill is suppressed until the dust settles, or a victim
+        # could slip right back into the gap its own eviction opened (and
+        # the arbiter would evict it again: a preemption livelock).
+        pending_preempt = bool(self._preempt)
+        head: Optional[_Gang] = None
+        head_wait = 0.0
+        # Usage computed ONCE per pump and updated incrementally on each
+        # admit (per-namespace views built lazily): the naive
+        # recompute-per-waiter made every sync of every admitted job
+        # O(admitted x waiters) inside this lock.
+        usage = self._usage_locked()
+        ns_usage: Dict[str, Dict[str, Fraction]] = {}
+
+        def ns_usage_of(namespace: str) -> Dict[str, Fraction]:
+            if namespace not in ns_usage:
+                ns_usage[namespace] = self._ns_usage_locked(namespace)
+            return ns_usage[namespace]
+
+        def quota_ok(gang: _Gang) -> bool:
+            quota = self.quotas.get(gang.namespace)
+            if not quota:
+                return True
+            used = ns_usage_of(gang.namespace)
+            return all(
+                used.get(name, Fraction(0)) + qty <= quota[name]
+                for name, qty in gang.demand.items()
+                if name in quota
+            )
+
+        def charge(gang: _Gang) -> None:
+            for name, qty in gang.demand.items():
+                usage[name] = usage.get(name, Fraction(0)) + qty
+            used = ns_usage_of(gang.namespace)
+            for name, qty in gang.demand.items():
+                used[name] = used.get(name, Fraction(0)) + qty
+
+        for gang in self._waiting_order_locked():
+            if not quota_ok(gang):
+                gang.blocked_on = "quota"
+                continue
+            is_head = head is None
+            if is_head:
+                head = gang
+                head_wait = now - gang.enqueued_at
+            if self._fits(gang.demand, usage, cap):
+                if is_head:
+                    self._admit_locked(gang, now, backfill=False, head_wait=None)
+                    charge(gang)
+                    head = None  # the next eligible waiter takes the line
+                elif (
+                    not pending_preempt
+                    and self.backfill_max_members > 0
+                    and gang.members <= self.backfill_max_members
+                    and head_wait < self.aging_seconds
+                ):
+                    self._admit_locked(gang, now, backfill=True,
+                                       head_wait=head_wait)
+                    charge(gang)
+                else:
+                    gang.blocked_on = "order"
+                continue
+            if is_head:
+                # Priority preemption: strictly lower bands only — equal-
+                # band contention waits its turn (FIFO within a band is
+                # the fairness contract).
+                candidates = sorted(
+                    (g for g in self._admitted.values()
+                     if g.band < gang.band and g.key not in self._preempt),
+                    key=lambda g: (g.band, -g.seq),
+                )
+                # Check-before-marking, INCLUDING the already-pending set:
+                # a pump landing between a victim's mark and its
+                # teardown-ack must see that the pending evictions alone
+                # already satisfy the head — otherwise every intervening
+                # pump would escalate one more innocent victim until the
+                # whole lower band was condemned for a single head.
+                freed: set = set(self._preempt)
+                chosen: List[_Gang] = []
+                satisfiable = self._fits(
+                    gang.demand, self._usage_locked(exclude=freed), cap
+                ) and self._quota_ok_locked(gang, exclude=freed)
+                if not satisfiable:
+                    for candidate in candidates:
+                        chosen.append(candidate)
+                        freed.add(candidate.key)
+                        if self._fits(
+                            gang.demand, self._usage_locked(exclude=freed), cap
+                        ) and self._quota_ok_locked(gang, exclude=freed):
+                            satisfiable = True
+                            break
+                if satisfiable:
+                    for victim in chosen:
+                        self._mark_preempt_locked(victim, PREEMPT_CAUSE_PRIORITY)
+                    pending_preempt = True
+                    gang.blocked_on = "priority"
+                else:
+                    gang.blocked_on = "capacity"
+            else:
+                gang.blocked_on = "capacity"
+        self._update_gauges_locked()
+
+    def _update_gauges_locked(self) -> None:
+        depths: Dict[int, int] = {}
+        for gang in self._waiting.values():
+            depths[gang.band] = depths.get(gang.band, 0) + 1
+        self.metrics.set_admission_queue_depths(
+            {str(band): depth for band, depth in depths.items()}
+        )
+
+    def _drain_kicks_locked(self) -> List[Callable[[], None]]:
+        kicks, self._kicks = self._kicks, []
+        return kicks
+
+    # -------------------------------------------------------- engine API
+    def try_admit(
+        self, *, key: str, kind: str, namespace: str, name: str, uid: str,
+        priority_class: str = "", demand: Optional[Dict[str, Fraction]] = None,
+        members: int = 0, has_pods: bool = False,
+        kick: Optional[Callable[[], None]] = None,
+    ) -> AdmitResult:
+        """One job's admission question, asked on every sync. Admitted
+        jobs take a fast path (plus a pump so capacity revocations are
+        noticed on the admitted side too); waiting jobs are (re)registered
+        and the queue pumped. ``has_pods`` (live, non-terminating pods
+        exist) is the adoption path: those pods were admitted by a prior
+        operator incarnation and holding them "unborn" is impossible —
+        admit unconditionally and let the revocation path resolve any
+        over-commit."""
+        try:
+            band = parse_priority_class(priority_class)
+        except ValueError:
+            band = PRIORITY_CLASSES[""]  # stored pre-validation jobs: default band
+        demand = dict(demand or {})
+        with self._lock:
+            now = self.clock()
+            gang = self._admitted.get(key)
+            if gang is not None:
+                # Refresh demand (elastic resize changes it) and notice
+                # revocations; a same-sync re-ask stays admitted.
+                gang.demand = demand or gang.demand
+                gang.members = members or gang.members
+                gang.uid = uid or gang.uid
+                gang.kick = kick or gang.kick
+                self._pump_locked(now)
+                newly = not gang.announced_admit
+                gang.announced_admit = True
+                waited = (
+                    max(0.0, (gang.admitted_at or now) - gang.enqueued_at)
+                    if newly else 0.0
+                )
+                kicks = self._drain_kicks_locked()
+                result = AdmitResult(True, newly_admitted=newly, waited=waited)
+            else:
+                gang = self._waiting.get(key)
+                if gang is None:
+                    self._seq += 1
+                    gang = _Gang(
+                        key=key, kind=kind, namespace=namespace, name=name,
+                        uid=uid, band=band, demand=demand, members=members,
+                        seq=self._seq, enqueued_at=now, kick=kick,
+                    )
+                    self._waiting[key] = gang
+                else:
+                    gang.band = band
+                    gang.demand = demand or gang.demand
+                    gang.members = members or gang.members
+                    gang.uid = uid or gang.uid
+                    gang.kick = kick or gang.kick
+                if has_pods:
+                    self._admit_locked(gang, now, backfill=False, head_wait=None)
+                    gang.announced_admit = True
+                    self._pump_locked(now)
+                    kicks = self._drain_kicks_locked()
+                    result = AdmitResult(True, newly_admitted=True)
+                else:
+                    self._pump_locked(now)
+                    if key in self._admitted:
+                        gang.announced_admit = True
+                        result = AdmitResult(
+                            True, newly_admitted=True,
+                            waited=max(0.0, now - gang.enqueued_at),
+                        )
+                    else:
+                        newly_queued = not gang.announced_queue
+                        gang.announced_queue = True
+                        if (
+                            gang.blocked_on == "quota"
+                            and gang.reported_block != "quota"
+                        ):
+                            self.metrics.quota_denial_inc(namespace)
+                        gang.reported_block = gang.blocked_on
+                        result = AdmitResult(
+                            False, newly_queued=newly_queued,
+                            blocked_on=gang.blocked_on or "capacity",
+                        )
+                    kicks = self._drain_kicks_locked()
+        for fn in kicks:
+            fn()
+        return result
+
+    def preemption_requested(self, key: str) -> Optional[str]:
+        """The pending preemption cause for a job, if any — the engine's
+        signal to run the counted teardown."""
+        with self._lock:
+            return self._preempt.get(key)
+
+    def note_preempted(self, key: str, uid: str, cause: str = "") -> bool:
+        """Engine acknowledgment that the preemption's COUNTED status
+        write is durable (or that nothing was left to tear down): release
+        the gang's capacity, re-queue it at the head of its band with a
+        fresh aging clock, and record the exactly-once ledger entry.
+        Idempotent: a second call for an already-acknowledged preemption
+        is a no-op (returns False) — the crash-retry path re-enters here
+        after a teardown resume without double-counting."""
+        with self._lock:
+            pending = self._preempt.pop(key, None)
+            if pending is None:
+                return False
+            cause = cause or pending
+            now = self.clock()
+            gang = self._admitted.pop(key, None)
+            if gang is not None:
+                band_seqs = [
+                    g.seq for g in self._waiting.values() if g.band == gang.band
+                ]
+                gang.seq = (min(band_seqs) - 1) if band_seqs else gang.seq
+                gang.enqueued_at = now
+                gang.admitted_at = None
+                gang.backfilled = False
+                gang.announced_admit = False
+                gang.announced_queue = False
+                gang.reported_block = ""
+                self._waiting[gang.key] = gang
+                self.preemption_ledger.append((key, uid, cause))
+                self.metrics.gang_preemption_inc(cause, str(gang.band))
+            self._pump_locked(now)
+            kicks = self._drain_kicks_locked()
+        for fn in kicks:
+            fn()
+        return True
+
+    def release(self, key: str) -> None:
+        """The job left the contention domain (terminal, suspended, or
+        deleted): free its capacity/quota and admit whoever is next. A
+        key this controller never saw is a no-op — release is called
+        unconditionally from every cleanup path."""
+        with self._lock:
+            was_admitted = self._admitted.pop(key, None) is not None
+            was_waiting = self._waiting.pop(key, None) is not None
+            self._preempt.pop(key, None)
+            if not (was_admitted or was_waiting):
+                return
+            self._pump_locked(self.clock())
+            kicks = self._drain_kicks_locked()
+        for fn in kicks:
+            fn()
+
+    # ------------------------------------------------------ observability
+    def is_admitted(self, key: str) -> bool:
+        with self._lock:
+            return key in self._admitted
+
+    def snapshot(self) -> dict:
+        """The /debugz admission dump: bands, queue positions, aging
+        clocks, usage vs capacity/quotas, pending preemptions, and the
+        audit ledgers the invariants run over."""
+        with self._lock:
+            now = self.clock()
+            cap = self.effective_capacity()
+
+            def fmt(resources):
+                return {k: str(v) for k, v in (resources or {}).items()}
+
+            return {
+                "capacity": fmt(cap) if cap is not None else None,
+                "usage": fmt(self._usage_locked()),
+                "quotas": {ns: fmt(q) for ns, q in self.quotas.items()},
+                "namespace_usage": {
+                    ns: fmt(self._ns_usage_locked(ns))
+                    for ns in {g.namespace for g in self._admitted.values()}
+                },
+                "aging_seconds": self.aging_seconds,
+                "backfill_max_members": self.backfill_max_members,
+                "admitted": [
+                    {
+                        "key": g.key, "band": g.band, "members": g.members,
+                        "demand": fmt(g.demand), "backfilled": g.backfilled,
+                        "admitted_for": round(now - (g.admitted_at or now), 3),
+                    }
+                    for g in sorted(
+                        self._admitted.values(), key=lambda g: (-g.band, g.seq)
+                    )
+                ],
+                "waiting": [
+                    {
+                        "key": g.key, "band": g.band, "position": i,
+                        "members": g.members, "demand": fmt(g.demand),
+                        "waited": round(now - g.enqueued_at, 3),
+                        "blocked_on": g.blocked_on,
+                    }
+                    for i, g in enumerate(self._waiting_order_locked())
+                ],
+                "preempting": dict(self._preempt),
+                "admit_log": list(self.admit_log),
+                "preemption_ledger": [list(t) for t in self.preemption_ledger],
+            }

@@ -87,6 +87,14 @@ REASON_FAILED = "Failed"
 REASON_SUSPENDED = "Suspended"
 REASON_RESUMED = "Resumed"
 REASON_QUEUED = "GangQueued"
+# Gang admission (core/admission.py, --enable-gang-admission): the job's
+# gang cleared capacity/quota/priority arbitration and its pods may now
+# be born; and the counterpart Warning when a running gang is preempted
+# by the admission layer (a higher-priority gang needed its capacity, or
+# the pool shrank) — the restart lands in the budget-free
+# disruptionCounts ledger and the job re-queues at the head of its band.
+REASON_GANG_ADMITTED = "GangAdmitted"
+REASON_GANG_PREEMPTED = "GangPreempted"
 
 # Disruption restart backoff (jittered exponential, engine
 # `_disruption_backoff_seconds`): the FIRST disruption restarts

@@ -401,6 +401,14 @@ class EngineOptions:
     # in order regardless of this flag.
     write_coalescing: bool = True
     status_flush_interval: float = 1.0
+    # Capacity-aware gang admission (core/admission.py,
+    # --enable-gang-admission) has NO EngineOptions field on purpose:
+    # the switch is the `admission` object itself — the operator manager
+    # builds ONE AdmissionController when the flag is on and passes it
+    # to every engine; None (the default) keeps reconcile_job's gate a
+    # single check and the PR 1-8 behavior byte-identical. A boolean
+    # here would be a second source of truth that could disagree with
+    # the arbiter's presence.
 
 
 def resolve_write_coalescing(options: EngineOptions, cluster) -> bool:
@@ -448,6 +456,7 @@ class JobController:
         on_status_coalesced: Optional[Callable[[JobObject], None]] = None,
         on_status_flush: Optional[Callable[[JobObject, float], None]] = None,
         tracer=None,
+        admission=None,
     ):
         self.hooks = hooks
         self.cluster = cluster
@@ -527,6 +536,13 @@ class JobController:
 
             tracer = NOOP_TRACER
         self.tracer = tracer
+        # Gang admission arbiter (core/admission.py), shared across every
+        # framework controller of one operator. None (the default, and
+        # whenever --enable-gang-admission is off) keeps reconcile_job's
+        # admission gate a single None-check — the PR 1-8 seeded tiers
+        # replay byte-identically because this path does not exist for
+        # them.
+        self._admission = admission
         # (job key, uid) -> last-declared gang-group names: gates the stale
         # sweep's uncached LIST to declared-set changes (and once per
         # operator lifetime per job, since this cache is in-memory).
@@ -552,6 +568,12 @@ class JobController:
     def forget_job(self, key: str) -> None:
         """Drop per-job in-memory bookkeeping after the job is gone
         (called from the controller's deletion/NotFound cleanup)."""
+        if self._admission is not None:
+            # A deleted job must free its admission (capacity AND quota)
+            # immediately: a leaked admitted/Inqueue entry would pin the
+            # tenant's quota forever — the PodGroup-leak failure mode,
+            # at the admission layer.
+            self._admission.release(f"{self.hooks.kind}:{key}")
         with self._gang_declared_lock:
             for cache_key in [k for k in self._gang_declared if k[0] == key]:
                 self._gang_declared.pop(cache_key, None)
@@ -854,6 +876,17 @@ class JobController:
 
         if self.options.enable_gang_scheduling:
             self._sync_pod_group(job, replicas, run_policy)
+
+        # Capacity-aware gang admission (core/admission.py): with the
+        # arbiter present, a job proceeds to pod work only once its gang
+        # is admitted — queued jobs end the sync here with the JOB_QUEUED
+        # condition and ZERO pods (no partial gang can ever exist), and a
+        # preemption verdict runs the counted disruption teardown before
+        # releasing the gang's capacity. None (the default) is one check.
+        if self._admission is not None and not self._admission_gate(
+            job, replicas, run_policy, pods, old_status
+        ):
+            return
 
         # Elastic resize: a membership change (slice added/removed, worker
         # scale) invalidates every live pod's injected world. Delete ALL
@@ -1456,9 +1489,13 @@ class JobController:
         self, job: JobObject, pods: List[Pod], targets: List[Pod],
         trigger: Pod, rtype: str, cause: str, reason: str, msg: str,
         old_status: JobStatus,
-    ) -> None:
+    ) -> List[tuple]:
         """The count-before-teardown protocol, single-sourced for the
-        gang-failure and stall restart paths. (The failure path used to
+        gang-failure, stall, and admission-preemption restart paths.
+        Returns the teardown's (name, exc) delete failures — empty on a
+        complete teardown; the phase-1-abort path reports the trigger as
+        undeleted so callers can distinguish "nothing happened yet" from
+        "done". (The failure path used to
         count at teardown COMPLETION; its crash window — trigger deleted,
         process dies before the counted status write — destroyed the only
         re-detectable evidence and lost the restart from every ledger.
@@ -1495,7 +1532,7 @@ class JobController:
             "trigger": trigger.metadata.name, "targets": len(targets),
             "counted": counted,
         }):
-            self._restart_gang_counted_traced(
+            return self._restart_gang_counted_traced(
                 job, pods, targets, trigger, rtype, cause, reason, msg,
                 old_status, key, handled, counted,
             )
@@ -1504,7 +1541,7 @@ class JobController:
         self, job: JobObject, pods: List[Pod], targets: List[Pod],
         trigger: Pod, rtype: str, cause: str, reason: str, msg: str,
         old_status: JobStatus, key: str, handled: set, counted: bool,
-    ) -> None:
+    ) -> List[tuple]:
         job.status._restarting_this_sync = True
         if counted:
             present = {p.metadata.uid for p in pods}
@@ -1521,7 +1558,7 @@ class JobController:
                 # Nothing was deleted: the trigger re-detects identically
                 # on the retry, so aborting here keeps counting exact.
                 self.requeue(f"{job.kind}:{key}", 1.0)
-                return
+                return [(trigger.metadata.name, None)]
             record_event_best_effort(
                 self.cluster,
                 Event(
@@ -1550,6 +1587,7 @@ class JobController:
             )
             self.requeue(f"{job.kind}:{key}", 1.0)
         self._write_status_if_changed(job, old_status)
+        return delete_errors
 
     def _count_restart(self, job: JobObject, rtype: str, cause: str) -> None:
         """Charge one restart to the budget its cause draws from, and open
@@ -2253,6 +2291,11 @@ class JobController:
         """Delete every pod and service (and gang groups) of a live job
         without marking it Failed; the Suspended condition records why
         nothing is running."""
+        if self._admission is not None:
+            # Suspension releases the whole slice back to the scheduler —
+            # the admission reservation goes with it; resume re-enters
+            # through the admission gate like a fresh gang.
+            self._admission.release(f"{job.kind}:{job.key()}")
         already = capi.get_condition(job.status, capi.JOB_SUSPENDED)
         settled = (
             already is not None
@@ -2313,6 +2356,11 @@ class JobController:
         self, job: JobObject, pods: List[Pod], replicas: Dict[str, ReplicaSpec], run_policy
     ) -> None:
         """CleanPodPolicy + TTL GC once the job reached Succeeded/Failed."""
+        if self._admission is not None:
+            # A finished gang frees its capacity/quota immediately (and
+            # exactly as often as it likes — release is idempotent);
+            # waiting gangs are kicked by the arbiter.
+            self._admission.release(f"{job.kind}:{job.key()}")
         self._delete_pods_and_services(job, pods, run_policy)
         if run_policy.progress_deadline_seconds is not None:
             gc_key = (job.key(), job.metadata.uid)
@@ -2467,6 +2515,171 @@ class JobController:
                 f"gang(s) waiting for scheduler capacity: {names}",
                 now=self.clock(),
             )
+
+    # ------------------------------------------------------ gang admission
+    def _admission_gate(
+        self, job: JobObject, replicas: Dict[str, ReplicaSpec], run_policy,
+        pods: List[Pod], old_status: JobStatus,
+    ) -> bool:
+        """The per-sync admission decision (core/admission.py). Returns
+        True when the job is admitted and the normal pod reconcile may
+        proceed; False ends the sync — either QUEUED (condition + event
+        written, pods held unborn, a fallback requeue armed beside the
+        arbiter's kicks) or PREEMPTING (the counted disruption teardown
+        ran; the admission release is acknowledged only once the counted
+        write is durable, so the disruption ledger and the preemption
+        ledger agree exactly-once across crashes)."""
+        from .admission import gang_demand
+
+        adm = self._admission
+        key = job.key()
+        item = f"{job.kind}:{key}"
+
+        cause = adm.preemption_requested(item)
+        if cause is not None:
+            live = [p for p in pods if p.metadata.deletion_timestamp is None]
+            if live:
+                trigger = max(live, key=lambda p: p.metadata.name)
+                trigger_rt = trigger.metadata.labels.get(
+                    constants.LABEL_REPLICA_TYPE, ""
+                )
+                rtype = next(
+                    (rt for rt in replicas if rt.lower() == trigger_rt),
+                    next(iter(replicas), ""),
+                )
+                reason = constants.job_reason(
+                    self.hooks.kind, constants.REASON_GANG_PREEMPTED
+                )
+                msg = (
+                    f"{self.hooks.kind} {job.name} is preempted by gang "
+                    f"admission ({cause}): the gang releases its capacity "
+                    "and re-queues at the head of its priority band."
+                )
+                # The shared count-before-teardown protocol: the
+                # disruption count is durable before any pod dies, the
+                # trigger dies last, retries never re-count (the
+                # handled-uid stamp), and the span-order audit holds.
+                errors = self._restart_gang_counted(
+                    job, pods, live, trigger, rtype,
+                    capi.RESTART_CAUSE_DISRUPTION, reason, msg, old_status,
+                )
+                if not errors and trigger.metadata.uid in (
+                    job.status.gang_handled_uids or ()
+                ):
+                    # Counted write landed (this sync or a crashed
+                    # predecessor's) AND the teardown completed: the
+                    # preemption may be acknowledged — quota released,
+                    # re-queued at the head of its band, exactly one
+                    # ledger entry. A PARTIAL teardown keeps the
+                    # preemption pending instead: acking early would let
+                    # the next sync's adoption path (has_pods) re-admit
+                    # a half-torn-down gang; the teardown's own requeue
+                    # resumes it off the stamp without re-counting.
+                    adm.note_preempted(item, job.metadata.uid, cause)
+                return False
+            # Nothing left to tear down (pods already gone): acknowledge
+            # and fall through to the queued path below.
+            adm.note_preempted(item, job.metadata.uid, cause)
+
+        sp = run_policy.scheduling_policy
+        groups = self.hooks.gang_groups(job, replicas, run_policy)
+        result = adm.try_admit(
+            key=item, kind=job.kind, namespace=job.namespace, name=job.name,
+            uid=job.metadata.uid,
+            priority_class=(sp.priority_class if sp is not None else "") or "",
+            demand=gang_demand(groups),
+            members=sum(
+                int((g.get("spec") or {}).get("minMember") or 0)
+                for g in groups
+            ),
+            has_pods=any(
+                p.metadata.deletion_timestamp is None for p in pods
+            ),
+            kick=lambda item=item: self.requeue(item, 0.0),
+        )
+        if result.admitted:
+            if result.newly_admitted and capi.has_condition(
+                job.status, capi.JOB_QUEUED
+            ):
+                # The queued -> admitted transition: the measured wait
+                # becomes the admission.queue span (the trace's
+                # queue-wait analog at the capacity layer) and one event.
+                self.tracer.record_span(
+                    "admission.queue", duration=result.waited,
+                    attrs={"wait": round(result.waited, 3)},
+                )
+                record_event_best_effort(
+                    self.cluster,
+                    Event(
+                        type="Normal",
+                        reason=constants.job_reason(
+                            job.kind, constants.REASON_GANG_ADMITTED
+                        ),
+                        message=(
+                            f"{self.hooks.kind} {job.name} was admitted "
+                            f"after waiting {result.waited:.1f}s for "
+                            "capacity."
+                        ),
+                        involved_object=f"{job.kind}/{key}",
+                    ),
+                )
+            self._set_group_phases(job, groups, "Running")
+            return True
+
+        # Queued: pods stay unborn. The condition is the observable
+        # surface (plus the mirrored PodGroup Inqueue phase on backends
+        # that model it); the fallback requeue keeps the decision fresh
+        # even if every admission kick is lost.
+        names = ", ".join(
+            sorted((g.get("metadata") or {}).get("name", "") for g in groups)
+        )
+        capi.update_job_conditions(
+            job.status,
+            capi.JOB_QUEUED,
+            constants.job_reason(job.kind, constants.REASON_QUEUED),
+            f"gang admission: waiting on {result.blocked_on or 'capacity'}"
+            f" ({names})",
+            now=self.clock(),
+        )
+        if result.newly_queued:
+            record_event_best_effort(
+                self.cluster,
+                Event(
+                    type="Normal",
+                    reason=constants.job_reason(
+                        job.kind, constants.REASON_QUEUED
+                    ),
+                    message=(
+                        f"{self.hooks.kind} {job.name} is queued by gang "
+                        f"admission (blocked on "
+                        f"{result.blocked_on or 'capacity'})."
+                    ),
+                    involved_object=f"{job.kind}/{key}",
+                ),
+            )
+        self._set_group_phases(job, groups, "Inqueue")
+        self._write_status_if_changed(job, old_status)
+        self.requeue(item, 1.0)
+        return False
+
+    def _set_group_phases(self, job: JobObject, groups: List[dict],
+                          phase: str) -> None:
+        """Mirror the admission verdict onto the job's PodGroup phases so
+        the existing phase-driven surfaces (the _sync_pod_group Queued
+        check, dashboards reading PodGroups) agree with the arbiter.
+        Best-effort and only on backends that model group status (the
+        in-memory simulator); on a real cluster Volcano owns the phase."""
+        if not self.options.enable_gang_scheduling:
+            return
+        setter = getattr(self.cluster, "set_pod_group_phase", None)
+        if setter is None:
+            return
+        for group in groups:
+            meta = group.get("metadata") or {}
+            try:
+                setter(meta.get("namespace", job.namespace), meta["name"], phase)
+            except Exception:  # noqa: BLE001 — a mirror, never a gate
+                pass
 
     # -------------------------------------------------------------- status
     # Status keys whose change may be COALESCED: pure bring-up/teardown

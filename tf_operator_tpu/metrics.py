@@ -141,6 +141,23 @@ class Metrics:
             "claim/steal/lost rate with stable membership is ownership "
             "flapping",
         ),
+        "training_operator_gang_preemptions_total": (
+            ("cause", "band"),
+            "Gangs preempted by the admission layer (core/admission.py), "
+            "by cause (PriorityPreemption = a higher-priority gang needed "
+            "the capacity; CapacityRevoked = the declared pool shrank "
+            "under the admitted set) and the VICTIM's priority band. "
+            "Each increment is exactly one counted disruption restart — "
+            "the preempted job re-queued at the head of its band",
+        ),
+        "training_operator_quota_denials_total": (
+            ("job_namespace",),
+            "Admission attempts a namespace quota blocked "
+            "(core/admission.py): the tenant's admitted usage plus the "
+            "gang's demand exceeded its --namespace-quota. A sustained "
+            "rate from one namespace is that tenant queueing on itself, "
+            "not on cluster capacity",
+        ),
         "training_operator_apiserver_requests_total": (
             ("verb", "resource", "code"),
             "Apiserver requests issued through the cluster seam "
@@ -175,6 +192,14 @@ class Metrics:
             "(core/sharding.py; updated on claim and on every resync). "
             "Summed across the fleet it must equal the live job count — "
             "a persistent shortfall is an orphaned shard (no live owner)",
+        ),
+        "training_operator_admission_queue_depth": (
+            ("band",),
+            "Gangs waiting in each admission priority band "
+            "(core/admission.py; only exported with "
+            "--enable-gang-admission). Sustained depth in a high band "
+            "beside free capacity is an admission bug; depth in low "
+            "bands under contention is the design working",
         ),
         "training_operator_busy_workers": (
             ("framework",),
@@ -237,6 +262,11 @@ class Metrics:
                 "training_operator_queue_wait_seconds",
                 # Dirty-buffer age at flush (write coalescing).
                 "training_operator_status_write_flush_latency_seconds",
+                # Gang queue wait: enqueue -> admission (core/admission.py).
+                # The default seconds-to-minutes buckets fit: healthy
+                # waits are sub-minute, contention pushes toward the
+                # aging bound.
+                "training_operator_admission_wait_seconds",
             )
         }
         # Unlabeled gauges: leader flag etc. (legacy tf_operator_is_leader,
@@ -310,6 +340,41 @@ class Metrics:
             self._histograms[
                 "training_operator_status_write_flush_latency_seconds"
             ][(namespace, framework)].observe(seconds)
+
+    def gang_preemption_inc(self, cause: str, band: str) -> None:
+        """One gang preempted by the admission layer (exactly one counted
+        disruption restart; band = the victim's priority band)."""
+        self._inc_labeled(
+            "training_operator_gang_preemptions_total", cause, band,
+        )
+
+    def quota_denial_inc(self, namespace: str) -> None:
+        """One admission attempt blocked by the namespace's quota."""
+        self._inc_labeled(
+            "training_operator_quota_denials_total", namespace,
+        )
+
+    def observe_admission_wait(self, namespace: str, framework: str,
+                               seconds: float) -> None:
+        """One gang admitted: `seconds` is its enqueue -> admission wait."""
+        with self._lock:
+            self._histograms["training_operator_admission_wait_seconds"][
+                (namespace, framework)
+            ].observe(seconds)
+
+    def set_admission_queue_depths(self, depths: Dict[str, float]) -> None:
+        """Replace the admission queue-depth gauge wholesale (bands that
+        emptied drop their series rather than freezing at a stale depth)."""
+        with self._lock:
+            self._labeled_gauges["training_operator_admission_queue_depth"] = {
+                (band,): float(depth) for band, depth in depths.items()
+            }
+
+    def admission_queue_depth_value(self, band: str) -> Optional[float]:
+        with self._lock:
+            return self._labeled_gauges[
+                "training_operator_admission_queue_depth"
+            ].get((band,))
 
     def apiserver_request_inc(self, verb: str, resource: str, code: str) -> None:
         """One apiserver request completed (any verb, any outcome)."""

@@ -249,6 +249,119 @@ def check_span_invariants(traces: Sequence[dict]) -> List[str]:
     return violations
 
 
+def check_admission_invariants(
+    admission, cluster=None, kinds: Sequence[str] = (),
+    namespace: Optional[str] = None,
+) -> List[str]:
+    """Admission-layer invariants (core/admission.py), over the arbiter's
+    snapshot + ledgers and (when a cluster is given) the live state:
+
+    - capacity never exceeded at a converged state: admitted usage fits
+      the effective pool (a transient overshoot exists only between a
+      revocation and the preempt-to-fit teardown — call this after
+      settling);
+    - quota never exceeded: per-namespace admitted usage within the
+      declared quota (hard — admission enforces it at admit time, and
+      revocations never change quotas);
+    - no partially-admitted gang: a WAITING job owns zero live
+      (non-terminating) pods — its pods are held unborn, so a partial
+      gang cannot exist by construction;
+    - backfill never starves the head-of-line: every backfill admit in
+      the admit log happened while the head's wait was under the aging
+      bound;
+    - preemption counted exactly once: the ledger holds one entry per
+      acknowledged preemption, and every ledgered job's disruption
+      ledger covers at least its admission preemptions (the counted
+      write precedes the acknowledgment by protocol)."""
+    from ..core.job_controller import parse_quantity
+
+    violations: List[str] = []
+    snap = admission.snapshot()
+    cap = snap.get("capacity")
+    usage = snap.get("usage") or {}
+    if cap is not None:
+        for resource, bound in cap.items():
+            used = usage.get(resource)
+            if used is not None and parse_quantity(used) > parse_quantity(bound):
+                violations.append(
+                    f"admission: usage of {resource} ({used}) exceeds "
+                    f"capacity ({bound}) at a converged state"
+                )
+    for ns, quota in (snap.get("quotas") or {}).items():
+        ns_usage = (snap.get("namespace_usage") or {}).get(ns) or {}
+        for resource, bound in quota.items():
+            used = ns_usage.get(resource)
+            if used is not None and parse_quantity(used) > parse_quantity(bound):
+                violations.append(
+                    f"admission: namespace {ns} usage of {resource} ({used}) "
+                    f"exceeds its quota ({bound})"
+                )
+    aging = snap.get("aging_seconds")
+    for entry in snap.get("admit_log") or []:
+        head_wait = entry.get("head_wait_at_admit")
+        if entry.get("backfill") and head_wait is not None and aging is not None:
+            if head_wait >= aging:
+                violations.append(
+                    f"admission: {entry.get('key')} was backfilled while the "
+                    f"head-of-line had waited {head_wait:.1f}s >= the aging "
+                    f"bound {aging:.1f}s (backfill starved the head)"
+                )
+    ledger = [tuple(t) for t in snap.get("preemption_ledger") or []]
+    if cluster is not None:
+        preempted_by_uid: Dict[str, int] = {}
+        for _key, uid, _cause in ledger:
+            preempted_by_uid[uid] = preempted_by_uid.get(uid, 0) + 1
+        jobs_by_uid = {}
+        for kind in kinds:
+            for job in cluster.list_jobs(kind, namespace):
+                jobs_by_uid[(job.get("metadata") or {}).get("uid")] = job
+        for uid, count in preempted_by_uid.items():
+            job = jobs_by_uid.get(uid)
+            if job is None:
+                continue  # job since deleted; nothing left to cross-check
+            status = job.get("status") or {}
+            if any(
+                c.get("type") == "Suspended"
+                for c in status.get("conditions") or []
+            ):
+                # Resume deliberately resets the disruption ledger (a
+                # fresh lifecycle window) while the arbiter's ledger is
+                # append-only — the cross-check would report a false
+                # "acknowledged before counted" for a healthy job.
+                continue
+            disruptions = sum(
+                (status.get("disruptionCounts") or {}).values()
+            )
+            if disruptions < count:
+                violations.append(
+                    f"admission: job uid {uid} has {count} ledgered "
+                    f"preemption(s) but only {disruptions} counted "
+                    "disruption restart(s) — a preemption was acknowledged "
+                    "before its counted write"
+                )
+        for waiter in snap.get("waiting") or []:
+            kind, _, rest = str(waiter.get("key", "")).partition(":")
+            ns, _, name = rest.partition("/")
+            if not name:
+                continue
+            live = [
+                p for p in cluster.list_pods(
+                    namespace=ns,
+                    labels={
+                        constants.LABEL_GROUP_NAME: constants.GROUP_NAME,
+                        constants.LABEL_JOB_NAME: name,
+                    },
+                )
+                if p.metadata.deletion_timestamp is None
+            ]
+            if live:
+                violations.append(
+                    f"admission: waiting gang {waiter.get('key')} owns "
+                    f"{len(live)} live pod(s) — a partially-admitted gang"
+                )
+    return violations
+
+
 def dump_trace(tracer, label: str) -> Optional[str]:
     """Write the tracer's full export into build/ (override the directory
     with TRACE_DUMP_DIR) for post-mortem; returns the path, or None
@@ -296,12 +409,19 @@ def assert_invariants(
     expect_ledgers: Optional[Dict[str, Dict[str, int]]] = None,
     tracer=None,
     label: str = "invariants",
+    admission=None,
 ) -> None:
     violations = check_job_invariants(
         cluster, kinds, namespace=namespace, expect_ledgers=expect_ledgers
     )
     if tracer is not None:
         violations.extend(check_span_invariants(tracer.export()))
+    if admission is not None:
+        violations.extend(
+            check_admission_invariants(
+                admission, cluster=cluster, kinds=kinds, namespace=namespace
+            )
+        )
     if not violations:
         return
     message = "invariant violations:\n  " + "\n  ".join(violations)
