@@ -270,11 +270,27 @@ def default_dag() -> List[Step]:
         # (measured ≈4), parallel and serial write costs must agree
         # (no fan-out write amplification), and the writes column may
         # not regress >10% run-over-run.
-        # Retried like the other timing-sensitive tiers.
+        # Retried like the other timing-sensitive tiers. --skip-fleet:
+        # the fleet-scale legs run in their own step below, so this one
+        # keeps its pre-fleet runtime; both merge their own keys into
+        # build/scale_smoke_last.json.
         Step("scale-smoke",
              [PY, "scripts/measure_control_plane.py", "--mode", "scale",
-              "--smoke"],
+              "--smoke", "--skip-fleet"],
              deps=["operator-integration"], retries=3),
+        # Fleet-scale smoke (the 10k-job item, smoke-sized): 1/2/4
+        # sharded replicas over a 24-tenant 96-job load with
+        # namespace-affinity placement and shard-scoped watch caches.
+        # Gates: per-replica watch-cache traffic at 4 replicas <=
+        # (1/4 + 25% slack) of the single-replica number, writes-per-
+        # converged-job parity (scale never duplicates a write), and the
+        # 2->4 replica makespan improving >=15%; ratcheted run-over-run
+        # via build/scale_smoke_last.json like the PR 4/7/8 gates. The
+        # full 10k-job leg is the same sweep via --replicas/--jobs.
+        Step("fleet-scale-smoke",
+             [PY, "scripts/measure_control_plane.py", "--mode", "scale",
+              "--smoke", "--fleet-only"],
+             deps=["shard-failover"], retries=3),
         # Tracing tier (docs/design/tracing.md): deterministic-ID span
         # timelines + apiserver request accounting — Tracer semantics,
         # the accounting proxy's 1:1 pass-through, the /tracez and
@@ -327,8 +343,12 @@ def default_dag() -> List[Step]:
         # span-order audit across the migration; lease-steal and
         # delayed-renew contested-claim windows). Fixed seeds,
         # byte-reproducible; the randomized shard sweep rides chaos-sweep.
+        # (+ the shard-scoped watch-cache tier: scope filtering, claim
+        # prime / release teardown, scoped serving fallbacks, live
+        # resize protocol + adoption barrier, namespace-affinity ring.)
         Step("shard-failover",
              pytest + ["tests/test_sharding.py", "tests/test_shard_failover.py",
+                       "tests/test_watchcache_scope.py",
                        "-m", "not slow"],
              deps=["operator-integration"], retries=2),
         # Crash tier (docs/design/crash_consistency.md): the controller

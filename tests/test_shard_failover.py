@@ -51,12 +51,24 @@ def jaxjob(name, workers=4, backoff=0):
     }
 
 
-def make_driver(chaos, tracer=None, shards=2, replicas=2, duration=10.0):
-    def factory(cluster, owns):
-        return JAXController(
+def make_driver(chaos, tracer=None, shards=2, replicas=2, duration=10.0,
+                sync_log=None):
+    def factory(cluster, owns, watch_cache=None):
+        controller = JAXController(
             cluster, queue=WorkQueue(), metrics=Metrics(), tracer=tracer,
-            owns=owns,
+            owns=owns, watch_cache=watch_cache,
         )
+        if sync_log is not None:
+            # Ownership audit: record (owner-at-sync-time, key) for every
+            # sync — the "no key synced by a non-owner" resize invariant.
+            inner_sync = controller.sync
+
+            def audited(namespace, name):
+                sync_log.append((owns(namespace, name), f"{namespace}/{name}"))
+                inner_sync(namespace, name)
+
+            controller.sync = audited
+        return controller
 
     return ShardFailoverDriver(
         chaos, factory, shards=shards, replicas=replicas, kinds=("JAXJob",),
@@ -251,6 +263,174 @@ class TestHashRateSweptCrashes:
     @pytest.mark.parametrize("seed", list(range(20, 32)))
     def test_randomized_sweep(self, seed):
         self._sweep(seed)
+
+
+class TestLiveResizeMidGangRestart:
+    """The resize satellite: shard count changes 4->8 (and back 8->4)
+    while a gang restart is mid-flight over held graceful deletions.
+    Drain-based migration must complete with exactly-once ledgers, no
+    key ever synced by a non-owner, zero invariant violations, and the
+    whole schedule byte-reproducible."""
+
+    def _run(self, seed=41):
+        inner = InMemoryCluster()
+        chaos = ChaosCluster(inner, ChaosSpec(seed=seed))
+        tracer = Tracer()
+        sync_log = []
+        driver = make_driver(chaos, tracer=tracer, shards=4, replicas=2,
+                             sync_log=sync_log)
+        driver.settle()
+        assert driver.owned_map() == {"replica-0": [0, 2],
+                                      "replica-1": [1, 3]}
+        bring_up(driver, inner)
+
+        # Mid-gang-restart: deletes wedge in their grace windows,
+        # worker-2 is preempted, the counted teardown starts...
+        inner.hold_pod_termination()
+        inner.set_pod_phase(
+            "default", "llama-worker-2", POD_FAILED, exit_code=137,
+            disruption_target="Preempted",
+        )
+        owner = driver.owner_of("default", "llama")
+        driver.replicas[owner].controller.queue.add("JAXJob:default/llama")
+        driver.settle()
+        status = inner.get_job("JAXJob", "default", "llama")["status"]
+        assert status["disruptionCounts"] == {"Worker": 1}
+        pods = inner.list_pods("default")
+        assert any(p.metadata.deletion_timestamp is not None for p in pods), (
+            "teardown must be in flight (held graceful deletions)")
+
+        # ...and the ring resizes 4 -> 8 under it. Every replica drains,
+        # adopts epoch 1, and re-claims; the (possibly new) owner must
+        # finish the restart from persisted status alone.
+        driver.request_resize(8)
+        driver.settle()
+        for replica in driver.replicas.values():
+            assert replica.coordinator.ring_epoch == 1
+            assert replica.coordinator.shards == 8
+        owned = sorted(
+            s for r in driver.replicas.values()
+            for s in r.coordinator.owned_shards())
+        assert owned == list(range(8)), owned
+        assert any(":resize:" in h for h in driver.handoffs), driver.handoffs
+        # Old-ring leases all released — nobody still claims epoch 0.
+        for s in range(4):
+            lease = inner.get_lease("default", f"shard-ha-shard-{s}")
+            assert lease["spec"]["holderIdentity"] == "", (s, lease["spec"])
+
+        # Repeated syncs over the lingering teardown: counted exactly once
+        # across the migration.
+        new_owner = driver.owner_of("default", "llama")
+        assert new_owner is not None
+        for _ in range(3):
+            driver.replicas[new_owner].controller.queue.add(
+                "JAXJob:default/llama")
+            driver.settle()
+        status = inner.get_job("JAXJob", "default", "llama")["status"]
+        assert status["disruptionCounts"] == {"Worker": 1}, (
+            "ledger doubled or lost across the live resize")
+
+        inner.release_pod_terminations()
+        drive_to_green(driver, inner)
+
+        # Shrink back 8 -> 4 (epoch 2) with the converged world: the
+        # migration must stay invariant-clean in both directions.
+        driver.request_resize(4)
+        driver.settle()
+        for replica in driver.replicas.values():
+            assert replica.coordinator.ring_epoch == 2
+            assert replica.coordinator.shards == 4
+        drive_to_green(driver, inner)
+
+        # No key was ever synced by a replica that did not own its shard
+        # at that moment — the resize barrier held.
+        assert sync_log and all(owned for owned, _ in sync_log), [
+            entry for entry in sync_log if not entry[0]]
+        assert_invariants(
+            inner, kinds=("JAXJob",),
+            expect_ledgers={
+                "disruptionCounts": {"Worker": 1},
+                "restartCounts": {},
+                "stallCounts": {},
+            },
+            tracer=tracer,
+            label=f"resize-migration-{seed}",
+        )
+        return chaos, driver, tracer
+
+    def test_resize_4_to_8_to_4_exactly_once(self):
+        self._run()
+
+    def test_resize_replay_is_byte_identical(self):
+        first = self._run(seed=43)
+        second = self._run(seed=43)
+        assert first[0].fault_log == second[0].fault_log
+        assert first[1].handoffs == second[1].handoffs
+        assert first[2].span_sequence() == second[2].span_sequence()
+
+
+class TestColdCachePrimeOnClaim:
+    """The handoff cold-cache satellite: on shard claim the scoped watch
+    cache primes BEFORE the resync enqueues keys, so the first post-claim
+    syncs — even right after a steal — pay ZERO accounted LIST/GETs (the
+    PR 7 zero-read property extended across an ownership migration)."""
+
+    READ_VERBS = (("list", "pods"), ("list", "services"),
+                  ("get", "jobs"), ("get", "pods"), ("get", "services"))
+    REQS = "training_operator_apiserver_requests_total"
+
+    def _reads(self, metrics):
+        return {
+            (verb, res): metrics.labeled_counter_value(
+                self.REQS, verb, res, "200")
+            for verb, res in self.READ_VERBS
+        }
+
+    def test_zero_accounted_reads_on_first_sync_after_steal(self):
+        inner = InMemoryCluster()  # no chaos: the cache needs the
+        # lossless-watch capability (supports_watch_cache)
+        per_replica_metrics = {}
+
+        def factory(cluster, owns, watch_cache=None):
+            metrics = Metrics()
+            controller = JAXController(
+                cluster, queue=WorkQueue(), metrics=metrics,
+                owns=owns, watch_cache=watch_cache,
+            )
+            per_replica_metrics[id(controller)] = metrics
+            controller._bench_metrics = metrics
+            return controller
+
+        driver = ShardFailoverDriver(
+            inner, factory, shards=2, replicas=2, kinds=("JAXJob",),
+            duration=10.0, use_watch_cache=True,
+        )
+        driver.settle()
+        assert driver.owned_map() == {"replica-0": [0], "replica-1": [1]}
+        bring_up(driver, inner)
+        owner = driver.owner_of("default", "llama")
+        survivor = next(r for r in driver.replicas if r != owner)
+
+        # Steady state reached: snapshot the survivor's accounted reads,
+        # then kill the owner and let the survivor steal + resync.
+        survivor_metrics = driver.replicas[survivor].controller._bench_metrics
+        before = self._reads(survivor_metrics)
+        driver.kill(owner)
+        driver.advance(driver.duration + 1.0)
+        driver.settle()
+        assert driver.owner_of("default", "llama") == survivor
+        assert any(
+            h.startswith(f"{survivor}:steal:") for h in driver.handoffs
+        ), driver.handoffs
+        # The steal's claim resync already synced the stolen job (settle
+        # drains it) — and paid no accounted read: the cache was primed
+        # before the resync enqueued the key.
+        after = self._reads(survivor_metrics)
+        assert after == before, (before, after)
+        # The job really is served from the survivor's cache.
+        cache = driver.replicas[survivor].cache
+        assert cache.get_object_or_none(
+            "JAXJob", "default", "llama") is not None
 
 
 class TestContestedClaims:

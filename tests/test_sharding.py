@@ -1,23 +1,35 @@
 """Sharded active-active control plane (core/sharding.py).
 
-Unit tier: the consistent ring, the ShardCoordinator claim/rebalance/
-drain/steal protocol on fake clocks (fully deterministic), the
-list_leases verb across backends, and the shard observability surfaces.
+Unit tier: the consistent ring (uniform AND namespace-affinity
+rendezvous placement), the ShardCoordinator claim/rebalance/drain/steal
+protocol on fake clocks (fully deterministic), the live-resize
+config-lease protocol (drain-based migration, adoption barrier), the
+list_leases verb across backends (label-selected member discovery), and
+the shard observability surfaces.
 Integration tier: two real OperatorManagers over one cluster splitting
-the job space and converging everything exactly once, plus the
-single-replica default proving the capability gate (zero lease traffic,
-no coordinator — byte-identical to the pre-sharding operator).
+the job space and converging everything exactly once, a live 2->4
+resize through a running manager (plus the /debugz resize verb and the
+SIGHUP --shards-file reload), plus the single-replica default proving
+the capability gate (zero lease traffic, no coordinator —
+byte-identical to the pre-sharding operator).
 """
 
+import json
 import time
+import urllib.request
 
 import pytest
 
 from tf_operator_tpu.cli import OperatorManager, OperatorOptions
 from tf_operator_tpu.cluster.memory import InMemoryCluster
 from tf_operator_tpu.core.sharding import (
+    LABEL_RING_EPOCH,
+    LABEL_SHARD_MEMBER,
     ShardCoordinator,
     member_lease_prefix,
+    publish_ring_resize,
+    read_ring_config,
+    ring_shard_lease_name,
     shard_for_key,
     shard_lease_name,
 )
@@ -79,6 +91,106 @@ class TestShardRing:
         assert len(placements) > 1
 
 
+class TestAffinityRing:
+    """Namespace-affinity placement (shard_for_key affinity="namespace"):
+    rendezvous-hash the tenant first so its jobs co-locate on one
+    replica's warm caches, with the spread knob as the outgrow fallback."""
+
+    def test_tenant_colocates_on_one_shard(self):
+        for ns in ("team-a", "team-b", "prod"):
+            homes = {
+                shard_for_key(ns, f"job-{i}", 8, affinity="namespace")
+                for i in range(40)
+            }
+            assert len(homes) == 1, (ns, homes)
+
+    def test_deterministic_and_distinct_across_tenants(self):
+        homes = {
+            ns: shard_for_key(ns, "x", 8, affinity="namespace")
+            for ns in (f"tenant-{i}" for i in range(64))
+        }
+        assert homes == {
+            ns: shard_for_key(ns, "y", 8, affinity="namespace")
+            for ns in homes
+        }
+        assert len(set(homes.values())) > 4  # tenants spread over the ring
+
+    def test_spread_widens_within_top_k_and_falls_back_to_uniform(self):
+        placements = {
+            shard_for_key("big-tenant", f"job-{i}", 8, affinity="namespace",
+                          affinity_spread=3)
+            for i in range(200)
+        }
+        assert len(placements) == 3, placements
+        home = shard_for_key("big-tenant", "job-0", 8, affinity="namespace")
+        assert home in placements
+        # spread >= shards: the uniform per-key spread (the fallback for
+        # a tenant that outgrows any co-location).
+        wide = {
+            shard_for_key("big-tenant", f"job-{i}", 8, affinity="namespace",
+                          affinity_spread=8)
+            for i in range(400)
+        }
+        assert len(wide) == 8
+
+    def test_rendezvous_moves_minimally_on_resize(self):
+        """Growing 4 -> 8 shards must move a namespace ONLY to one of the
+        NEW shards (a new candidate out-scored its old home); everything
+        else keeps its exact placement — the property that makes a live
+        resize cheap."""
+        moved = 0
+        for i in range(200):
+            ns = f"tenant-{i}"
+            old = shard_for_key(ns, "j", 4, affinity="namespace")
+            new = shard_for_key(ns, "j", 8, affinity="namespace")
+            if new != old:
+                moved += 1
+                assert new >= 4, (ns, old, new)
+        # Expected ~half move (4 new candidates vs 4 old); all moving or
+        # none moving would both mean the hash is not rendezvous.
+        assert 40 < moved < 160, moved
+
+    def test_uniform_default_unchanged(self):
+        import hashlib
+
+        digest = hashlib.sha256(b"default/llama").digest()
+        expected = int.from_bytes(digest[:8], "big") % 16
+        assert shard_for_key("default", "llama", 16) == expected
+
+
+class TestRingConfigLease:
+    def test_publish_and_read_roundtrip(self):
+        mem = InMemoryCluster()
+        assert read_ring_config(mem, "default", "ha") is None
+        assert publish_ring_resize(mem, "default", "ha", 8) == 1
+        assert read_ring_config(mem, "default", "ha") == (1, 8)
+        assert publish_ring_resize(mem, "default", "ha", 4) == 2
+        assert read_ring_config(mem, "default", "ha") == (2, 4)
+
+    def test_republishing_current_count_is_idempotent(self):
+        """A SIGHUP with an unchanged shards file (routine config-reload
+        convention) must not bump the epoch — an epoch bump is a
+        fleet-wide drain-and-reclaim for zero ring change."""
+        mem = InMemoryCluster()
+        assert publish_ring_resize(mem, "default", "ha", 8) == 1
+        assert publish_ring_resize(mem, "default", "ha", 8) == 1
+        assert read_ring_config(mem, "default", "ha") == (1, 8)
+        assert publish_ring_resize(mem, "default", "ha", 4) == 2
+        assert publish_ring_resize(mem, "default", "ha", 4) == 2
+
+    def test_lease_names_qualified_by_epoch(self):
+        assert ring_shard_lease_name("ha", 0, 3) == shard_lease_name("ha", 3)
+        assert ring_shard_lease_name("ha", 2, 3) == "ha-r2-shard-3"
+
+    def test_malformed_config_ignored(self):
+        mem = InMemoryCluster()
+        mem.create_lease({
+            "metadata": {"name": "ha-config", "namespace": "default"},
+            "spec": {"holderIdentity": "garbage"},
+        })
+        assert read_ring_config(mem, "default", "ha") is None
+
+
 class TestListLeases:
     def test_memory_prefix_and_namespace_filter(self):
         mem = InMemoryCluster()
@@ -114,6 +226,74 @@ class TestListLeases:
         finally:
             kube.shutdown()
 
+    def test_memory_label_filter(self):
+        """The membership-discovery seam: a label-selected list returns
+        only stamped member leases, however many heartbeat/job leases
+        share the namespace."""
+        mem = InMemoryCluster()
+        mem.create_lease({
+            "metadata": {"name": "ha-member-a", "namespace": "default",
+                         "labels": {LABEL_SHARD_MEMBER: "ha"}},
+            "spec": {},
+        })
+        for i in range(20):  # fleet noise: per-job heartbeat leases
+            mem.create_lease({
+                "metadata": {"name": f"hb-job-{i}", "namespace": "default"},
+                "spec": {},
+            })
+        out = mem.list_leases("default", labels={LABEL_SHARD_MEMBER: "ha"})
+        assert [lease["metadata"]["name"] for lease in out] == ["ha-member-a"]
+        assert mem.list_leases(
+            "default", labels={LABEL_SHARD_MEMBER: "other"}) == []
+
+    def test_kube_stub_label_selector_server_side(self):
+        """kube passes the selector as ?labelSelector= and the stub
+        filters SERVER-side: the response must not scale with the
+        fleet-wide lease count."""
+        from tf_operator_tpu.cluster.kube import KubeCluster
+        from tf_operator_tpu.testing.stub_apiserver import StubApiServer
+
+        stub = StubApiServer()
+        kube = KubeCluster(base_url=stub.url, token="t")
+        try:
+            stub.mem.create_lease({
+                "metadata": {"name": "ha-member-r0", "namespace": "default",
+                             "labels": {LABEL_SHARD_MEMBER: "ha"}},
+                "spec": {},
+            })
+            for i in range(10):
+                stub.mem.create_lease({
+                    "metadata": {"name": f"hb-{i}", "namespace": "default"},
+                    "spec": {},
+                })
+            out = kube.list_leases(
+                "default", name_prefix="ha-member-",
+                labels={LABEL_SHARD_MEMBER: "ha"})
+            assert [lease["metadata"]["name"] for lease in out] == [
+                "ha-member-r0"]
+            # The selector went over the wire (server-side filtering).
+            lease_lists = [
+                query for method, path, query in stub.requests
+                if method == "GET" and path.endswith("/leases")
+            ]
+            assert any(
+                q.get("labelSelector") == f"{LABEL_SHARD_MEMBER}=ha"
+                for q in lease_lists
+            ), lease_lists
+        finally:
+            kube.shutdown()
+            stub.shutdown()
+
+    def test_coordinator_member_lease_carries_labels(self):
+        mem = InMemoryCluster()
+        now = {"t": 0.0}
+        a = make_coordinator(mem, "a", now, shards=2)
+        a.tick()
+        lease = mem.get_lease("default", "ha-member-a")
+        labels = lease["metadata"]["labels"]
+        assert labels[LABEL_SHARD_MEMBER] == "ha"
+        assert labels[LABEL_RING_EPOCH] == "0"
+
 
 def make_coordinator(cluster, identity, now, shards=4, duration=10.0,
                      on_claim=None, on_release=None, drain_check=None,
@@ -131,6 +311,29 @@ class TestShardCoordinator:
     """Protocol unit tests: one fake clock drives every lease lock and
     liveness observation, so each scenario is a pure function of the
     tick/advance sequence."""
+
+    def test_sync_gate_excludes_warming_shard_but_enqueue_admits(self):
+        """The claim-to-prime race guard: while the claim hooks (cache
+        prime + resync) run, the shard is OWNED (deltas apply, enqueues
+        admitted) but the sync gate holds until the warm-up completes —
+        a worker must never sync a just-claimed key against a cache
+        whose shard slice is still priming."""
+        mem = InMemoryCluster()
+        now = {"t": 0.0}
+        seen = {}
+
+        a = make_coordinator(mem, "a", now, shards=1)
+        key = ("default", "anything")
+
+        def on_claim(shard, cause):
+            seen["during"] = (a.owns(shard), a.admits(*key), a.allows(*key))
+
+        a.on_claim = on_claim
+        a.tick()
+        assert seen["during"] == (True, True, False), seen
+        # Warm-up done: the gate opens.
+        assert a.allows(*key) and a.admits(*key)
+        assert a.snapshot()["warming"] == []
 
     def test_sole_member_claims_every_shard(self):
         mem = InMemoryCluster()
@@ -354,6 +557,113 @@ class TestShardCoordinator:
         assert names == ["ha-member-a"]
 
 
+class TestCoordinatorResize:
+    """The live-resize protocol on fake clocks: config lease observed ->
+    drain-and-release EVERYTHING (the PR 8 drain protocol, cause
+    'resize') -> adopt the new ring (epoch-qualified lease names) ->
+    wait for every live member to adopt -> claim new targets."""
+
+    def test_single_coordinator_resizes_2_to_4(self):
+        mem = InMemoryCluster()
+        now = {"t": 0.0}
+        events = []
+        a = make_coordinator(
+            mem, "a", now, shards=2,
+            on_claim=lambda s, c: events.append(("claim", s, c)),
+            on_release=lambda s, c: events.append(("release", s, c)))
+        a.tick()
+        assert a.owned_shards() == [0, 1]
+        publish_ring_resize(mem, "default", "ha", 4)
+        a.tick()  # observe config -> drain + release both (instant drain)
+        assert a.owned_shards() == []
+        assert ("release", 0, "resize") in events
+        assert ("release", 1, "resize") in events
+        a.tick()  # adopt + claim the new ring (sole member: barrier clear)
+        assert a.ring_epoch == 1 and a.shards == 4
+        assert a.owned_shards() == [0, 1, 2, 3]
+        # New-ring leases carry epoch-qualified names; old ring released.
+        assert mem.get_lease("default", "ha-r1-shard-0")[
+            "spec"]["holderIdentity"] == "a"
+        assert mem.get_lease("default", "ha-shard-0")[
+            "spec"]["holderIdentity"] == ""
+        # Member lease advertises the adopted epoch.
+        assert mem.get_lease("default", "ha-member-a")[
+            "metadata"]["labels"][LABEL_RING_EPOCH] == "1"
+        # And back down: 4 -> 2 (epoch 2).
+        publish_ring_resize(mem, "default", "ha", 2)
+        a.tick()
+        assert a.owned_shards() == []
+        a.tick()
+        assert a.ring_epoch == 2 and a.shards == 2
+        assert a.owned_shards() == [0, 1]
+
+    def test_adoption_barrier_holds_until_all_members_adopt(self):
+        """A replica that has adopted the new ring must NOT first-claim
+        while a live peer still advertises the old epoch — the laggard
+        may still hold old-ring leases over the same keys."""
+        mem = InMemoryCluster()
+        now = {"t": 0.0}
+        a = make_coordinator(mem, "a", now, shards=2)
+        b = make_coordinator(mem, "b", now, shards=2)
+        for _ in range(2):
+            a.tick()
+            b.tick()
+        assert a.owned_shards() == [0]
+        assert b.owned_shards() == [1]
+        publish_ring_resize(mem, "default", "ha", 4)
+        a.tick()   # a drains + releases shard 0
+        assert a.owned_shards() == []
+        a.tick()   # a adopts epoch 1; b still advertises 0 -> no claims
+        assert a.ring_epoch == 1
+        assert a.owned_shards() == []
+        b.tick()   # b drains + releases
+        b.tick()   # b adopts; a's lease already shows epoch 1 -> b claims
+        assert b.ring_epoch == 1
+        a.tick()   # a now sees b adopted -> claims its targets
+        b.tick()
+        a.tick()
+        owned = sorted(a.owned_shards() + b.owned_shards())
+        assert owned == [0, 1, 2, 3], (a.owned_shards(), b.owned_shards())
+        assert not (set(a.owned_shards()) & set(b.owned_shards()))
+
+    def test_resize_snapshot_exposes_migration_state(self):
+        mem = InMemoryCluster()
+        now = {"t": 0.0}
+        a = make_coordinator(mem, "a", now, shards=2)
+        a.tick()
+        publish_ring_resize(mem, "default", "ha", 4)
+        a.tick()
+        snap = a.snapshot()
+        assert snap["resize_target"] == [1, 4]
+        a.tick()
+        snap = a.snapshot()
+        assert snap["resize_target"] is None
+        assert snap["ring_epoch"] == 1
+        assert snap["shards"] == 4
+
+    def test_crashed_peer_does_not_wedge_resize_forever(self):
+        """A peer that dies mid-resize stops renewing its member lease;
+        once it ages out of the live ranking, the survivors' adoption
+        barrier clears and the migration completes."""
+        mem = InMemoryCluster()
+        now = {"t": 0.0}
+        a = make_coordinator(mem, "a", now, shards=2, duration=10.0)
+        b = make_coordinator(mem, "b", now, shards=2, duration=10.0)
+        for _ in range(2):
+            a.tick()
+            b.tick()
+        publish_ring_resize(mem, "default", "ha", 4)
+        # b dies before ever observing the resize. a drains + adopts but
+        # is barred while b still ranks live on a's observation clock.
+        a.tick()
+        a.tick()
+        assert a.ring_epoch == 1 and a.owned_shards() == []
+        now["t"] += 10.1  # b's member lease ages out
+        a.tick()
+        a.tick()
+        assert a.owned_shards() == [0, 1, 2, 3]
+
+
 class TestShardedManagers:
     """Two real OperatorManagers over one InMemoryCluster: the job space
     splits, everything converges exactly once, crash steal works at the
@@ -535,6 +845,87 @@ class TestShardedManagers:
             k1.shutdown()
             k2.shutdown()
             stub.shutdown()
+
+    def test_manager_live_resize_2_to_4_reconciles_through(self):
+        """End-to-end live resize through a running OperatorManager: the
+        /debugz verb path (request_resize), drain-based migration, and a
+        job landing AFTER the resize reconciling on the new ring."""
+        mem = InMemoryCluster()
+        manager = OperatorManager(mem, self._opts("solo", shards=2),
+                                  metrics=Metrics(), tracer=Tracer())
+        manager.start()
+        try:
+            assert wait_until(
+                lambda: manager.coordinator.owned_shards() == [0, 1])
+            mem.create_job(tfjob("before", workers=1))
+            assert wait_until(lambda: len(mem.list_pods("default")) == 1)
+            epoch = manager.request_resize(4)
+            assert epoch == 1
+            assert wait_until(
+                lambda: manager.coordinator.ring_epoch == 1
+                and manager.coordinator.owned_shards() == [0, 1, 2, 3],
+                timeout=20.0,
+            ), manager.coordinator.snapshot()
+            assert manager.coordinator.shards == 4
+            assert manager.metrics.labeled_counter_value(
+                "training_operator_shard_handoffs_total", "resize") >= 2
+            mem.create_job(tfjob("after", workers=1))
+            assert wait_until(lambda: len(mem.list_pods("default")) == 2)
+        finally:
+            manager.stop()
+
+    def test_debugz_resize_verb_and_sighup_reload(self, tmp_path):
+        """The two admin surfaces: POST /debugz/resize?shards=N (gated on
+        --enable-debugz) and SIGHUP + --shards-file both publish the
+        config lease."""
+        import http.server
+
+        from tf_operator_tpu.cli import _MetricsHandler
+
+        shards_file = tmp_path / "shards"
+        shards_file.write_text("4\n")
+        mem = InMemoryCluster()
+        opts = self._opts("solo", shards=2)
+        opts.enable_debugz = True
+        opts.shards_file = str(shards_file)
+        manager = OperatorManager(mem, opts, metrics=Metrics(),
+                                  tracer=Tracer())
+        handler = type("H", (_MetricsHandler,), {"manager": manager})
+        server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        server_thread = __import__("threading").Thread(
+            target=server.serve_forever, daemon=True)
+        server_thread.start()
+        manager.start()
+        try:
+            assert wait_until(
+                lambda: manager.coordinator.owned_shards() == [0, 1])
+            req = urllib.request.Request(
+                f"{base}/debugz/resize?shards=4", method="POST")
+            body = json.load(urllib.request.urlopen(req))
+            assert body == {"shards": 4, "ring_epoch": 1}
+            assert wait_until(
+                lambda: manager.coordinator.shards == 4, timeout=20.0)
+            # Bad input is a 400, not a published epoch.
+            try:
+                urllib.request.urlopen(urllib.request.Request(
+                    f"{base}/debugz/resize?shards=zero", method="POST"))
+            except urllib.error.HTTPError as err:
+                assert err.code == 400
+            else:
+                raise AssertionError("non-integer shards must 400")
+            # SIGHUP path: the handler re-reads the file and publishes.
+            shards_file.write_text("8\n")
+            manager._handle_sighup()
+            assert wait_until(
+                lambda: manager.coordinator.shards == 8
+                and manager.coordinator.ring_epoch == 2,
+                timeout=20.0,
+            ), manager.coordinator.snapshot()
+        finally:
+            manager.stop()
+            server.shutdown()
+            server.server_close()
 
     def test_metrics_render_includes_shard_series(self):
         metrics = Metrics()

@@ -1,0 +1,284 @@
+"""Shard-scoped shared watch cache (cluster/watchcache.py + the scoped
+WatchCacheCluster serving rules).
+
+The 10k-fleet property under test: a replica's delta-fed store holds (and
+pays maintenance for) ONLY its owned shards' objects — out-of-shard
+deltas are dropped at the cache boundary (the served/filtered counter
+pair), a claim primes the new shard's slice BEFORE any sync needs it,
+and a release tears the slice down. Scoped reads that cannot be
+attributed to an owned job key fall through to the inner chain: a scoped
+store is a subset of the world and must never masquerade as all of it.
+"""
+
+import threading
+
+from tf_operator_tpu.api.k8s import ObjectMeta, Pod
+from tf_operator_tpu.cli import OperatorManager, OperatorOptions
+from tf_operator_tpu.cluster.base import NotFound
+from tf_operator_tpu.cluster.memory import InMemoryCluster
+from tf_operator_tpu.cluster.watchcache import SharedWatchCache, WatchCacheCluster
+from tf_operator_tpu.core.sharding import shard_for_key
+from tf_operator_tpu.core.tracing import Tracer
+from tf_operator_tpu.metrics import Metrics
+
+REQS = "training_operator_apiserver_requests_total"
+
+
+class FakeScope:
+    """Stand-in for a ShardCoordinator: a fixed ring with a mutable
+    owned set (tests flip ownership to simulate claims/releases)."""
+
+    def __init__(self, shards=4, owned=()):
+        self.shards = shards
+        self.owned_set = set(owned)
+
+    def shard_of(self, namespace, name):
+        return shard_for_key(namespace, name, self.shards)
+
+    def owns(self, shard):
+        return shard in self.owned_set
+
+
+def job_dict(name, namespace="default", rv="1"):
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "TFJob",
+        "metadata": {"name": name, "namespace": namespace,
+                     "resourceVersion": rv},
+        "spec": {},
+    }
+
+
+def pod_for(job, podname, namespace="default"):
+    return Pod(metadata=ObjectMeta(
+        name=podname, namespace=namespace, labels={"job-name": job}))
+
+
+def keys_in_shard(scope, shard, count=5, namespace="default"):
+    out = []
+    i = 0
+    while len(out) < count:
+        name = f"job-{i}"
+        if scope.shard_of(namespace, name) == shard:
+            out.append(name)
+        i += 1
+    return out
+
+
+class TestScopedStore:
+    def test_out_of_shard_deltas_filtered_and_counted(self):
+        mem = InMemoryCluster()
+        scope = FakeScope(shards=4, owned={0})
+        metrics = Metrics()
+        cache = SharedWatchCache(mem, metrics=metrics, scope=scope)
+        owned = keys_in_shard(scope, 0, count=2)
+        foreign = keys_in_shard(scope, 1, count=2)
+        for job in owned + foreign:
+            mem.create_pod(pod_for(job, f"{job}-worker-0"))
+        with cache._lock:
+            stored = {name for _, name in cache._stores["pods"]}
+        assert stored == {f"{j}-worker-0" for j in owned}, stored
+        served, filtered = metrics.watch_cache_totals()
+        assert served == 2 and filtered == 2
+
+    def test_unattributable_objects_not_stored_under_scope(self):
+        mem = InMemoryCluster()
+        cache = SharedWatchCache(mem, scope=FakeScope(shards=4, owned={0, 1, 2, 3}))
+        mem.create_pod(Pod(metadata=ObjectMeta(name="naked", namespace="default")))
+        with cache._lock:
+            assert not cache._stores["pods"]
+
+    def test_prime_shard_merges_only_the_claimed_slice(self):
+        mem = InMemoryCluster()
+        scope = FakeScope(shards=4, owned=set())
+        cache = SharedWatchCache(mem, scope=scope)
+        cache.register_kind("TFJob")
+        in_zero = keys_in_shard(scope, 0, count=3)
+        in_one = keys_in_shard(scope, 1, count=3)
+        for job in in_zero + in_one:
+            mem.create_job(job_dict(job))
+            mem.create_pod(pod_for(job, f"{job}-worker-0"))
+        with cache._lock:  # nothing owned: nothing stored
+            assert not cache._stores["TFJob"] and not cache._stores["pods"]
+        # Claim shard 0: ownership flips, THEN the prime (cli ordering).
+        scope.owned_set.add(0)
+        cache.prime_shard(0)
+        with cache._lock:
+            jobs = {name for _, name in cache._stores["TFJob"]}
+            pods = {name for _, name in cache._stores["pods"]}
+        assert jobs == set(in_zero)
+        assert pods == {f"{j}-worker-0" for j in in_zero}
+
+    def test_drop_shard_tears_down_the_released_slice(self):
+        mem = InMemoryCluster()
+        scope = FakeScope(shards=4, owned={0, 1})
+        cache = SharedWatchCache(mem, scope=scope)
+        cache.register_kind("TFJob")
+        in_zero = keys_in_shard(scope, 0, count=2)
+        in_one = keys_in_shard(scope, 1, count=2)
+        for job in in_zero + in_one:
+            mem.create_job(job_dict(job))
+            mem.create_pod(pod_for(job, f"{job}-worker-0"))
+        scope.owned_set.discard(1)
+        cache.drop_shard(1)
+        with cache._lock:
+            jobs = {name for _, name in cache._stores["TFJob"]}
+            pods = {name for _, name in cache._stores["pods"]}
+        assert jobs == set(in_zero)
+        assert pods == {f"{j}-worker-0" for j in in_zero}
+
+    def test_deletion_racing_a_shard_prime_never_resurrects(self):
+        """The tombstone rule during prime_shard: a DELETED delta landing
+        between the LIST snapshot and the merge must win."""
+        mem = InMemoryCluster()
+        scope = FakeScope(shards=4, owned=set())
+        cache = SharedWatchCache(mem, scope=scope)
+        cache.register_kind("TFJob")
+        job = keys_in_shard(scope, 0, count=1)[0]
+        mem.create_job(job_dict(job))
+        scope.owned_set.add(0)
+        # Simulate the race: list first (the prime's snapshot), delete,
+        # then merge the stale snapshot through the tombstone guard.
+        listed = mem.list_jobs("TFJob", None)
+        real_list = cache._list_backend
+
+        def stale_list(resource):
+            if resource == "TFJob":
+                mem.delete_job("TFJob", "default", job)  # DELETED delta
+                return listed
+            return real_list(resource)
+
+        cache._list_backend = stale_list
+        cache.prime_shard(0)
+        assert cache.get_object_or_none("TFJob", "default", job) is None
+
+
+class TestScopedProxyReads:
+    def _setup(self, owned):
+        mem = InMemoryCluster()
+        scope = FakeScope(shards=4, owned=set(owned))
+        metrics = Metrics()
+        cache = SharedWatchCache(mem, metrics=metrics, scope=scope)
+        from tf_operator_tpu.cluster.accounting import AccountingCluster
+
+        acct = AccountingCluster(mem, metrics=metrics, tracer=Tracer())
+        proxy = WatchCacheCluster(acct, cache, "TFJob")
+        return mem, scope, metrics, cache, proxy
+
+    def test_attributed_list_serves_from_cache(self):
+        mem, scope, metrics, cache, proxy = self._setup(owned={0, 1, 2, 3})
+        job = keys_in_shard(scope, 0, count=1)[0]
+        mem.create_pod(pod_for(job, f"{job}-worker-0"))
+        out = proxy.list_pods(namespace="default", labels={"job-name": job})
+        assert [p.metadata.name for p in out] == [f"{job}-worker-0"]
+        assert metrics.labeled_counter_value(REQS, "list", "pods", "200") == 0
+
+    def test_unattributed_list_delegates(self):
+        mem, scope, metrics, cache, proxy = self._setup(owned={0, 1, 2, 3})
+        job = keys_in_shard(scope, 0, count=1)[0]
+        mem.create_pod(pod_for(job, f"{job}-worker-0"))
+        out = proxy.list_pods(namespace="default")  # no job-name selector
+        assert len(out) == 1
+        assert metrics.labeled_counter_value(REQS, "list", "pods", "200") == 1
+
+    def test_out_of_shard_reads_delegate(self):
+        mem, scope, metrics, cache, proxy = self._setup(owned={0})
+        foreign = keys_in_shard(scope, 1, count=1)[0]
+        mem.create_job(job_dict(foreign))
+        got = proxy.get_job("TFJob", "default", foreign)
+        assert got["metadata"]["name"] == foreign
+        assert metrics.labeled_counter_value(REQS, "get", "jobs", "200") == 1
+
+    def test_scoped_get_miss_falls_through_not_notfound(self):
+        """A scoped store's miss is ambiguous (deleted vs out of scope):
+        the proxy must consult the inner chain, not synthesize 404."""
+        mem, scope, metrics, cache, proxy = self._setup(owned={0})
+        job = keys_in_shard(scope, 0, count=1)[0]
+        # Object exists on the server but the store is cold (created
+        # before any prime covered it; force by clearing the store).
+        mem.create_pod(pod_for(job, f"{job}-worker-0"))
+        with cache._lock:
+            cache._stores["pods"].clear()
+        pod = proxy.get_pod("default", f"{job}-worker-0")
+        assert pod.metadata.name == f"{job}-worker-0"
+        # And a genuinely missing object still raises through the inner.
+        try:
+            proxy.get_pod("default", "never-existed")
+        except NotFound:
+            pass
+        else:
+            raise AssertionError("missing pod must raise NotFound")
+
+    def test_scoped_list_jobs_always_delegates(self):
+        mem, scope, metrics, cache, proxy = self._setup(owned={0, 1, 2, 3})
+        mem.create_job(job_dict("j0"))
+        assert len(proxy.list_jobs("TFJob", None)) == 1
+        assert metrics.labeled_counter_value(REQS, "list", "jobs", "200") == 1
+
+
+class TestScopedManagers:
+    """Two sharded OperatorManagers over one cluster: each replica's
+    cache indexes only its shards' objects, and the served/filtered
+    split partitions the fleet's watch traffic."""
+
+    def _opts(self, rid):
+        return OperatorOptions(
+            enabled_schemes=["TFJob"], shards=2, replica_id=rid,
+            lease_duration=1.0, health_port=0, metrics_port=0,
+            resync_period=0.5,
+        )
+
+    def test_two_replicas_partition_cache_maintenance(self):
+        import time
+
+        def tfjob(name, workers=1):
+            return {
+                "apiVersion": "kubeflow.org/v1",
+                "kind": "TFJob",
+                "metadata": {"name": name, "namespace": "default"},
+                "spec": {"tfReplicaSpecs": {"Worker": {
+                    "replicas": workers,
+                    "template": {"spec": {"containers": [
+                        {"name": "tensorflow", "image": "tf:1"}]}},
+                }}},
+            }
+
+        mem = InMemoryCluster()
+        m1 = OperatorManager(mem, self._opts("r0"), metrics=Metrics(),
+                             tracer=Tracer())
+        m2 = OperatorManager(mem, self._opts("r1"), metrics=Metrics(),
+                             tracer=Tracer())
+        m1.start()
+        m2.start()
+        try:
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if (m1.coordinator.owned_shards() == [0]
+                        and m2.coordinator.owned_shards() == [1]):
+                    break
+                time.sleep(0.02)
+            for i in range(8):
+                mem.create_job(tfjob(f"j{i}"))
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if len(mem.list_pods("default")) == 8:
+                    break
+                time.sleep(0.02)
+            assert len(mem.list_pods("default")) == 8
+            by_shard = {0: set(), 1: set()}
+            for i in range(8):
+                by_shard[shard_for_key("default", f"j{i}", 2)].add(f"j{i}")
+            with m1.watch_cache._lock:
+                r0_jobs = {n for _, n in m1.watch_cache._stores["TFJob"]}
+            with m2.watch_cache._lock:
+                r1_jobs = {n for _, n in m2.watch_cache._stores["TFJob"]}
+            assert r0_jobs == by_shard[0], (r0_jobs, by_shard)
+            assert r1_jobs == by_shard[1], (r1_jobs, by_shard)
+            s1, f1 = m1.metrics.watch_cache_totals()
+            s2, f2 = m2.metrics.watch_cache_totals()
+            # Both replicas saw the same stream; each applied only its
+            # share and filtered the rest.
+            assert s1 > 0 and s2 > 0 and f1 > 0 and f2 > 0
+        finally:
+            m1.stop()
+            m2.stop()

@@ -87,6 +87,21 @@ class OperatorOptions:
     # (recommended: the StatefulSet pod name). Empty = hostname + a uuid
     # suffix, which still works but reshuffles shard targets on restart.
     replica_id: str = ""
+    # Shard placement mode (core/sharding.py shard_for_key). "uniform"
+    # (default): sha256(ns/name) — the PR 8 behavior, byte-identical.
+    # "namespace": rendezvous-hash the NAMESPACE first so one tenant's
+    # jobs co-locate on one replica's warm watch caches; the spread knob
+    # below widens a tenant over its top-K rendezvous shards when it
+    # outgrows one (spread >= shards degrades to the uniform per-key
+    # spread). Must be configured identically on every replica, like
+    # --shards itself.
+    shard_affinity: str = "uniform"
+    shard_affinity_spread: int = 1
+    # Optional path whose integer content is the DESIRED shard count:
+    # SIGHUP re-reads it and publishes a live resize (the config-lease
+    # protocol every replica migrates through). The /debugz resize verb
+    # does the same without a file.
+    shards_file: str = ""
     enable_debugz: bool = False  # /debugz exposes thread stacks: opt-in only
     # /tracez exposes per-job timelines (pod names, restart causes, the
     # full apiserver call sequence) on the 0.0.0.0 metrics port — same
@@ -185,6 +200,24 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         help="Stable identity for shard membership ranking "
                         "(recommended: the StatefulSet pod name). Default: "
                         "hostname plus a random suffix.")
+    parser.add_argument("--shard-affinity", choices=("uniform", "namespace"),
+                        default="uniform",
+                        help="Shard placement: 'uniform' hashes ns/name "
+                        "(the default); 'namespace' rendezvous-hashes the "
+                        "namespace first so one tenant's jobs co-locate on "
+                        "one replica's warm watch caches. Set identically "
+                        "on every replica.")
+    parser.add_argument("--shard-affinity-spread", type=int, default=1,
+                        help="With --shard-affinity namespace: spread each "
+                        "tenant over its top-K rendezvous shards (1 = whole "
+                        "tenant on one shard; >= --shards = the uniform "
+                        "per-key spread — the fallback for a tenant that "
+                        "outgrows a shard).")
+    parser.add_argument("--shards-file", default="",
+                        help="Path holding the desired shard count; SIGHUP "
+                        "re-reads it and publishes a LIVE resize (drain-"
+                        "based migration, no redeploy). /debugz/resize is "
+                        "the HTTP equivalent.")
     parser.add_argument("--enable-debugz", action="store_true",
                         help="Expose /debugz (thread stacks, queue depths) on the metrics port.")
     parser.add_argument("--enable-tracez", action="store_true",
@@ -271,6 +304,9 @@ def options_from_args(args: argparse.Namespace) -> OperatorOptions:
         lease_name=args.lease_name,
         shards=args.shards,
         replica_id=args.replica_id,
+        shard_affinity=args.shard_affinity,
+        shard_affinity_spread=args.shard_affinity_spread,
+        shards_file=args.shards_file,
         enable_debugz=args.enable_debugz,
         enable_tracez=args.enable_tracez,
         enable_gang_scheduling=args.enable_gang_scheduling,
@@ -420,6 +456,41 @@ class _MetricsHandler(_BaseHandler):
         else:
             self._respond(404, "not found")
 
+    def do_POST(self):  # noqa: N802 (stdlib API)
+        # /debugz/resize?shards=N — the live shard-count admin verb
+        # (SIGHUP + --shards-file is the file-driven equivalent). Same
+        # exposure gate as the rest of /debugz: a mutation verb on the
+        # 0.0.0.0 metrics port is strictly opt-in.
+        if not self.path.startswith("/debugz/resize"):
+            self._respond(404, "not found")
+            return
+        if not self.manager.options.enable_debugz:
+            self._respond(404, "debugz disabled (--enable-debugz)")
+            return
+        from urllib.parse import parse_qs, urlparse
+
+        query = parse_qs(urlparse(self.path).query)
+        raw = (query.get("shards") or [""])[0]
+        try:
+            shards = int(raw)
+            if shards < 1:
+                raise ValueError
+        except ValueError:
+            self._respond(400, "shards must be a positive integer")
+            return
+        try:
+            epoch = self.manager.request_resize(shards)
+        except RuntimeError as err:
+            self._respond(409, str(err))
+            return
+        except Exception as err:  # noqa: BLE001 — apiserver write failed
+            self._respond(502, f"resize publish failed: {err}")
+            return
+        self._respond(
+            200, json.dumps({"shards": shards, "ring_epoch": epoch}),
+            "application/json",
+        )
+
 
 # ----------------------------------------------------------------- manager
 
@@ -495,8 +566,13 @@ class OperatorManager:
                 on_release=self._on_shard_released,
                 drain_check=self._shard_drained,
                 drain_timeout=5.0,
+                affinity=self.options.shard_affinity,
+                affinity_spread=self.options.shard_affinity_spread,
             )
-            owns = self.coordinator.allows
+            # Enqueue filter = admits (warming shards included, so the
+            # claim resync's enqueues land); the post-pop SYNC gate
+            # (_sync_gate -> allows) additionally excludes warming.
+            owns = self.coordinator.admits
         self._is_leader = (
             not self.options.leader_elect and self.coordinator is None
         )
@@ -560,8 +636,14 @@ class OperatorManager:
         if getattr(cluster, "supports_watch_cache", False):
             from .cluster.watchcache import SharedWatchCache
 
+            # Shard-scoped when sharded: the coordinator is the scope —
+            # the cache keeps (and serves) only owned shards' objects, so
+            # per-replica watch/list maintenance falls ~1/N instead of
+            # staying fleet-wide. scope=None (single replica) is the
+            # PR 7 fleet-wide cache, byte-identical.
             self.watch_cache = SharedWatchCache(
-                cluster, namespace=self.options.namespace or None
+                cluster, namespace=self.options.namespace or None,
+                metrics=self.metrics, scope=self.coordinator,
             )
         self.controllers: Dict[str, object] = {}
         for kind in enabled_kinds(self.options.enabled_schemes):
@@ -704,14 +786,19 @@ class OperatorManager:
     def _on_shard_claimed(self, shard: int, cause: str) -> None:
         """The claim half of the handoff protocol: a shard just became
         ours (fresh claim, expiry-steal, or a cancelled drain reclaiming
-        the keys its window dropped). The cold-start path runs PER SHARD
-        via the shared resync_shard_jobs helper. Cost note: one
-        list_jobs per kind per claimed shard — claims are rare
-        control-plane events (boot, failover, rebalance), so the read
+        the keys its window dropped). ORDER MATTERS: the scoped watch
+        cache primes FIRST, so by the time the resync below enqueues the
+        shard's keys, their first syncs read entirely from the warm
+        store — zero accounted LIST/GETs even on the sync right after a
+        steal (the cold-cache handoff gap). Cost note: one list per
+        resource per claimed shard — claims are rare control-plane
+        events (boot, failover, rebalance, resize), so the read
         amplification of a multi-shard claim tick is accepted; if
         --shards grows large enough to matter, batch the tick's claims
         into one list."""
         self.metrics.shard_handoff_inc(cause)
+        if self.watch_cache is not None:
+            self.watch_cache.prime_shard(shard)
         from .core.sharding import resync_shard_jobs
 
         namespace = self.options.namespace or None
@@ -719,12 +806,23 @@ class OperatorManager:
         for kind, controller in self.controllers.items():
             count += resync_shard_jobs(
                 controller, self.cluster, kind, namespace, shard,
-                self.options.shards,
+                self.coordinator.shards,
+                shard_of=self.coordinator.shard_of,
             )
         self.metrics.set_owned_jobs(str(shard), count)
 
     def _on_shard_released(self, shard: int, cause: str) -> None:
         self.metrics.shard_handoff_inc(cause)
+        # Tear down the released shard's slice of the scoped watch cache
+        # and every controller's per-key in-memory state: a 10k-job
+        # fleet under rebalance churn must not leave each replica
+        # holding the union of everything it EVER owned.
+        if self.watch_cache is not None:
+            self.watch_cache.drop_shard(shard)
+        for controller in self.controllers.values():
+            forget = getattr(controller, "forget_shard", None)
+            if forget is not None:
+                forget(shard, self.coordinator.shard_of)
         # Drop the released shard's job-count series: a stale gauge here
         # would read as a double owner beside the new holder's.
         self.metrics.clear_owned_jobs(str(shard))
@@ -733,14 +831,66 @@ class OperatorManager:
         """True when no worker is inside a sync of the shard's jobs —
         the release precondition of a graceful handoff (releasing
         mid-sync would let the next owner reconcile beside us)."""
-        from .core.sharding import shard_for_key
-
+        shard_of = self.coordinator.shard_of
         for controller in self.controllers.values():
             for item in controller.queue.processing_items():
                 ns, _, name = item.partition(":")[2].partition("/")
-                if shard_for_key(ns, name, self.options.shards) == shard:
+                if shard_of(ns, name) == shard:
                     return False
         return True
+
+    # ------------------------------------------------------- live resize
+    def request_resize(self, shards: int) -> int:
+        """Publish a live shard-count change (the config-lease protocol,
+        core/sharding.py): every replica drains and releases its old-ring
+        shards (in-flight syncs finish first — the PR 8 drain-before-
+        release protocol), adopts the new ring, waits for every live
+        member to adopt, then claims its new targets. No redeploy, no
+        cold start beyond the per-shard claim resync. Returns the
+        published ring epoch."""
+        if self.coordinator is None:
+            raise RuntimeError(
+                "live resize requires a sharded control plane "
+                "(--shards > 1); a single-replica operator has no ring "
+                "to migrate"
+            )
+        shards = int(shards)
+        from .core.sharding import read_ring_config
+
+        # Idempotence also for the never-resized fleet: publishing the
+        # boot ring size as "epoch 1" would drain-and-reclaim every
+        # shard for zero change (publish_ring_resize can only dedupe
+        # against an EXISTING config lease).
+        if (read_ring_config(self.cluster, self.coordinator.namespace,
+                             self.options.lease_name) is None
+                and shards == self.coordinator.shards):
+            log.info("resize to %d is the current ring; nothing published",
+                     shards)
+            return 0
+        epoch = self.coordinator.request_resize(shards)
+        log.info("published ring resize: shards=%d epoch=%d", shards, epoch)
+        return epoch
+
+    def _handle_sighup(self, signum=None, frame=None) -> None:
+        """SIGHUP = re-read --shards-file and publish the resize. Runs
+        the read + publish on a one-shot thread: a signal handler must
+        not issue blocking apiserver writes on the main thread."""
+        path = self.options.shards_file
+        if not path:
+            log.warning(
+                "SIGHUP received but no --shards-file configured; "
+                "use /debugz/resize?shards=N instead")
+            return
+
+        def reload_and_publish():
+            try:
+                with open(path) as f:
+                    shards = int(f.read().strip())
+                self.request_resize(shards)
+            except Exception:  # noqa: BLE001 — a bad file must not kill us
+                log.warning("SIGHUP resize reload failed", exc_info=True)
+
+        threading.Thread(target=reload_and_publish, daemon=True).start()
 
     def _sync_gate(self, item: str) -> bool:
         """The post-pop sync gate, per item: global leadership when
@@ -897,6 +1047,15 @@ class OperatorManager:
 
     def run_forever(self) -> None:
         self.start()
+        try:
+            import signal
+
+            # Config-reload signal (resize via --shards-file). Only
+            # installable from the main thread; embedded managers (tests,
+            # benches) simply don't get the signal surface.
+            signal.signal(signal.SIGHUP, self._handle_sighup)
+        except (ValueError, AttributeError, OSError):
+            pass
         try:
             while not self._stop.is_set():
                 time.sleep(0.5)

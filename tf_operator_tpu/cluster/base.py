@@ -282,15 +282,20 @@ class Cluster:
         raise NotImplementedError
 
     def list_leases(self, namespace: Optional[str] = None,
-                    name_prefix: str = "") -> List[dict]:
-        """List Lease objects, optionally restricted to one namespace and
-        a name prefix (the shard coordinator's member-roster discovery:
-        every replica renews `<lock>-member-<identity>` and lists the
-        prefix to rank the live fleet — core/sharding.py). The prefix is
-        a client-side convenience filter; HTTP backends still issue one
-        collection GET. Backends that predate the verb inherit this
-        NotImplementedError default — sharding requires a backend that
-        can enumerate leases."""
+                    name_prefix: str = "",
+                    labels: Optional[Dict[str, str]] = None) -> List[dict]:
+        """List Lease objects, optionally restricted to one namespace,
+        a name prefix, and an equality label selector (the shard
+        coordinator's member-roster discovery: every replica renews a
+        labeled `<lock>-member-<identity>` lease and lists the selector
+        to rank the live fleet — core/sharding.py). `labels` is the
+        filter that keeps membership observation O(members): HTTP
+        backends push it server-side as a labelSelector, so the response
+        stops scaling with the fleet-wide lease count (per-job heartbeat
+        leases outnumber members ~jobs:replicas). The prefix remains a
+        client-side convenience filter. Backends that predate the verb
+        inherit this NotImplementedError default — sharding requires a
+        backend that can enumerate leases."""
         raise NotImplementedError
 
     # ---- events ----

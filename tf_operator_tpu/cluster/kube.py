@@ -769,11 +769,18 @@ class KubeCluster(Cluster):
         self._request("DELETE", self._lease_path(namespace, name))
 
     def list_leases(self, namespace: Optional[str] = None,
-                    name_prefix: str = "") -> List[dict]:
-        # One collection GET per namespace; the prefix filter is applied
-        # client-side (lease names carry no labels to select on).
+                    name_prefix: str = "",
+                    labels: Optional[Dict[str, str]] = None) -> List[dict]:
+        # One collection GET per namespace. `labels` goes server-side as
+        # a labelSelector — membership discovery must not download every
+        # heartbeat lease in the namespace just to rank a handful of
+        # members; the name prefix stays a client-side filter (lease
+        # names cannot be prefix-selected by the apiserver).
         namespace = namespace or self.namespace or "default"
-        body = self._request("GET", self._lease_path(namespace))
+        path = self._lease_path(namespace)
+        if labels:
+            path += self._selector_query(labels)
+        body = self._request("GET", path)
         items = body.get("items") or []
         return [
             lease for lease in items

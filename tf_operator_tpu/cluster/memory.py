@@ -676,13 +676,21 @@ class InMemoryCluster(base.Cluster):
         self._drain_events()
 
     def list_leases(self, namespace: Optional[str] = None,
-                    name_prefix: str = "") -> List[dict]:
+                    name_prefix: str = "",
+                    labels: Optional[Dict[str, str]] = None) -> List[dict]:
+        def selected(lease: dict) -> bool:
+            if not labels:
+                return True
+            stamped = (lease.get("metadata") or {}).get("labels") or {}
+            return all(stamped.get(k) == v for k, v in labels.items())
+
         with self._lock:
             return [
                 copy.deepcopy(lease)
                 for (ns, name), lease in sorted(self._leases.items())
                 if (namespace is None or ns == namespace)
                 and name.startswith(name_prefix)
+                and selected(lease)
             ]
 
     # ---------------------------------------------------------------- events

@@ -42,6 +42,23 @@ watch delivery is ordered and lossless opt in (the in-memory simulator).
 The chaos seam pins it off — its seeded watch-drop injection would
 poison a delta-fed store permanently — which also keeps every seeded
 fault tier's read sequence byte-identical to the pre-cache engine.
+
+Shard scoping (the 10k-job fleet-scale piece): with `--shards > 1` the
+manager passes its ShardCoordinator as `scope`, and the cache keeps only
+objects whose OWNING-JOB key (the job's ns/name for CR objects; the
+`job-name` label for pods/services) lands in an owned shard. Every other
+delta is dropped at this boundary — counted in
+`watch_cache_events_filtered_total` against `..._served_total` — so
+per-replica cache maintenance falls ~1/N instead of staying fleet-wide.
+The scope set follows ownership live: `prime_shard` merges a freshly
+claimed shard's objects from one backend LIST (called BEFORE the claim
+resync enqueues keys, so the first post-claim syncs are cache-warm —
+zero accounted reads even right after a steal), and `drop_shard` tears a
+released shard's slice down so a long-lived replica's memory tracks its
+share of the fleet, not all of it. Scoped reads that cannot be
+attributed to a job key (a list without a job-name selector, a get of an
+object the store lacks) fall through to the inner chain — a scoped store
+is authoritative only for owned keys.
 """
 
 from __future__ import annotations
@@ -52,6 +69,7 @@ from typing import Dict, List, Optional, Tuple
 
 from . import base
 from .base import ADDED, DELETED, MODIFIED, NotFound, SYNC
+from ..core.constants import LABEL_JOB_NAME
 
 _UPSERTS = (ADDED, MODIFIED, SYNC)
 
@@ -78,24 +96,55 @@ def _copy(obj):
     return obj.deep_copy() if hasattr(obj, "deep_copy") else copy.deepcopy(obj)
 
 
+def _job_key(resource: str, obj) -> Optional[Tuple[str, str]]:
+    """(namespace, owning-job name) of one cached object — the shard
+    placement identity. CR objects ARE the job; pods/services carry the
+    operator's `job-name` label. None = unattributable (an object the
+    operator did not stamp): a scoped store neither keeps nor serves it,
+    the proxy delegates such reads."""
+    if isinstance(obj, dict):
+        meta = obj.get("metadata") or {}
+        return meta.get("namespace", "default"), meta.get("name", "")
+    if resource in ("pods", "services"):
+        name = obj.metadata.labels.get(LABEL_JOB_NAME)
+        if not name:
+            return None
+        return obj.metadata.namespace, name
+    return obj.metadata.namespace, obj.metadata.name
+
+
 class SharedWatchCache:
     """Delta-fed store over one backend, shared by every controller of a
     process. Construct it ONCE, before any controller registers watches
     of its own (the manager does; see the module docstring's ordering
-    contract)."""
+    contract).
 
-    def __init__(self, backend, namespace: Optional[str] = None):
+    `scope` (optional) is the shard-ownership view — any object with
+    `shard_of(ns, name)` and `owns(shard)`; the manager passes its
+    ShardCoordinator. None (single-replica) keeps the store fleet-wide,
+    byte-identical to the unscoped PR 7 cache. `metrics` feeds the
+    watch_cache_events_{served,filtered}_total pair either way."""
+
+    def __init__(self, backend, namespace: Optional[str] = None,
+                 metrics=None, scope=None):
         self.backend = backend
         # Cache scope (None = every namespace): the LIST that primes a
         # resource uses it, and reads outside the scope fall through.
         self.namespace = namespace or None
+        self.scope = scope
+        self._metrics = metrics
         self._lock = threading.Lock()
         self._stores: Dict[str, Dict[Tuple[str, str], object]] = {}
         self._bookmarks: Dict[str, int] = {}
         self._primed: set = set()
         # (resource, ns, name) -> rv of a DELETED delta observed before
-        # that resource finished priming: the merge must not resurrect.
+        # that resource finished priming (or while a shard re-prime is in
+        # flight): the merge must not resurrect.
         self._tombstones: Dict[Tuple[str, str, str], int] = {}
+        # >0 while prime_shard is re-listing: the handler then records
+        # every deletion as a tombstone so the merge cannot resurrect an
+        # object deleted between the LIST snapshot and the merge.
+        self._repriming = 0
         self._registered: set = set()
         for resource in ("pods", "services"):
             self._register(resource)
@@ -115,6 +164,30 @@ class SharedWatchCache:
         self._register(kind)
         self._prime(kind, lambda: self.backend.list_jobs(kind, self.namespace))
 
+    # -------------------------------------------------------------- scope
+    def scope_allows_key(self, namespace: str, job_name: str) -> bool:
+        """Whether the (ns, job) key lies in this replica's owned shards
+        (True when unscoped)."""
+        if self.scope is None:
+            return True
+        return self.scope.owns(self.scope.shard_of(namespace, job_name))
+
+    def _in_scope(self, resource: str, obj) -> bool:
+        if self.scope is None:
+            return True
+        key = _job_key(resource, obj)
+        if key is None:
+            return False
+        return self.scope.owns(self.scope.shard_of(*key))
+
+    def _count(self, resource: str, served: bool) -> None:
+        if self._metrics is None:
+            return
+        if served:
+            self._metrics.watch_cache_served_inc(resource)
+        else:
+            self._metrics.watch_cache_filtered_inc(resource)
+
     def _handler(self, resource: str):
         def on_event(event_type: str, obj) -> None:
             ns, name, rv = _meta(obj)
@@ -122,12 +195,27 @@ class SharedWatchCache:
                 # Out-of-scope delta: covers() guarantees it could never
                 # be served, so storing it would only grow the store with
                 # other tenants' churn, unbounded.
+                self._count(resource, served=False)
+                return
+            if not self._in_scope(resource, obj):
+                # Out-of-shard delta (scoped fleet): dropped here, which
+                # is exactly the ~(N-1)/N of fleet watch traffic this
+                # replica no longer pays to index. A DELETED still clears
+                # any stale store entry (scope may have shrunk after the
+                # object was stored) and tombstones while a re-prime is
+                # in flight.
+                with self._lock:
+                    if event_type == DELETED:
+                        self._stores[resource].pop((ns, name), None)
+                        if resource not in self._primed or self._repriming:
+                            self._tombstones[(resource, ns, name)] = rv
+                self._count(resource, served=False)
                 return
             with self._lock:
                 store = self._stores[resource]
                 if event_type == DELETED:
                     store.pop((ns, name), None)
-                    if resource not in self._primed:
+                    if resource not in self._primed or self._repriming:
                         self._tombstones[(resource, ns, name)] = rv
                 elif event_type in _UPSERTS:
                     current = store.get((ns, name))
@@ -136,12 +224,15 @@ class SharedWatchCache:
                 self._bookmarks[resource] = max(
                     self._bookmarks.get(resource, 0), rv
                 )
+            self._count(resource, served=True)
 
         return on_event
 
     def _prime(self, resource: str, lister) -> None:
         """Initial LIST, merged under the watch-before-list rule: deltas
-        already flowing win on rv, tombstoned deletions never resurrect."""
+        already flowing win on rv, tombstoned deletions never resurrect.
+        Scoped caches merge only in-scope objects — the store must track
+        this replica's share of the fleet from the very first LIST."""
         with self._lock:
             if resource in self._primed:
                 return
@@ -152,6 +243,8 @@ class SharedWatchCache:
             store = self._stores[resource]
             for obj in listed:
                 ns, name, rv = _meta(obj)
+                if not self._in_scope(resource, obj):
+                    continue
                 if self._tombstones.get((resource, ns, name), -1) >= rv:
                     continue
                 current = store.get((ns, name))
@@ -161,20 +254,98 @@ class SharedWatchCache:
                     self._bookmarks.get(resource, 0), rv
                 )
             self._primed.add(resource)
-            self._tombstones = {
-                k: v for k, v in self._tombstones.items() if k[0] != resource
-            }
+            if not self._repriming:
+                self._tombstones = {
+                    k: v for k, v in self._tombstones.items()
+                    if k[0] != resource
+                }
 
     def ensure_primed(self, resource: str) -> None:
+        self._prime(resource, lambda: self._list_backend(resource))
+
+    def _list_backend(self, resource: str) -> list:
         if resource == "pods":
-            self._prime(resource, lambda: self.backend.list_pods(
-                namespace=self.namespace))
-        elif resource == "services":
-            self._prime(resource, lambda: self.backend.list_services(
-                namespace=self.namespace))
-        else:
-            self._prime(resource, lambda: self.backend.list_jobs(
-                resource, self.namespace))
+            return self.backend.list_pods(namespace=self.namespace)
+        if resource == "services":
+            return self.backend.list_services(namespace=self.namespace)
+        return self.backend.list_jobs(resource, self.namespace)
+
+    def prime_shard(self, shard: int) -> None:
+        """Scope grew (shard claimed): merge the shard's objects from one
+        backend LIST per registered resource, so the store is warm BEFORE
+        the claim resync enqueues the shard's keys — the first post-claim
+        syncs (even right after a steal) read entirely from cache, zero
+        accounted apiserver reads. Deletions racing the LIST are guarded
+        by the same tombstone rule the initial prime uses (the handler
+        records every DELETED while `_repriming` is up).
+
+        Cost note: one full backend LIST per registered resource per
+        claimed shard, filtered client-side — the same accepted
+        amplification as the claim resync (claims are rare control-plane
+        events), and a real apiserver pages these. A resize re-claims
+        the whole ring, so if --shards grows large enough to matter,
+        batch one LIST per resource across a tick's claims (the
+        coordinator would need to aggregate its on_claim notifications
+        per tick)."""
+        if self.scope is None:
+            return
+        with self._lock:
+            resources = sorted(self._registered)
+            self._repriming += 1
+        try:
+            for resource in resources:
+                with self._lock:
+                    primed = resource in self._primed
+                if not primed:
+                    # Never base-primed: the full prime (scope-filtered,
+                    # and the claimed shard is owned by the time on_claim
+                    # fires) covers this shard's slice too.
+                    self.ensure_primed(resource)
+                    continue
+                listed = self._list_backend(resource)
+                with self._lock:
+                    store = self._stores[resource]
+                    for obj in listed:
+                        ns, name, rv = _meta(obj)
+                        if self.namespace is not None and ns != self.namespace:
+                            continue
+                        key = _job_key(resource, obj)
+                        if key is None or self.scope.shard_of(*key) != shard:
+                            continue
+                        if self._tombstones.get(
+                                (resource, ns, name), -1) >= rv:
+                            continue
+                        current = store.get((ns, name))
+                        if current is None or _meta(current)[2] < rv:
+                            store[(ns, name)] = obj
+                        self._bookmarks[resource] = max(
+                            self._bookmarks.get(resource, 0), rv
+                        )
+        finally:
+            with self._lock:
+                self._repriming -= 1
+                if not self._repriming:
+                    self._tombstones = {
+                        k: v for k, v in self._tombstones.items()
+                        if k[0] not in self._primed
+                    }
+
+    def drop_shard(self, shard: int) -> None:
+        """Scope shrank (shard released/lost): tear the shard's slice out
+        of every store, so a replica's cache memory tracks what it OWNS —
+        at 10k jobs, holding the whole fleet's objects on every replica
+        is exactly the constant this module exists to break."""
+        if self.scope is None:
+            return
+        with self._lock:
+            for resource, store in self._stores.items():
+                doomed = []
+                for key, obj in store.items():
+                    jk = _job_key(resource, obj)
+                    if jk is not None and self.scope.shard_of(*jk) == shard:
+                        doomed.append(key)
+                for key in doomed:
+                    store.pop(key, None)
 
     # -------------------------------------------------------------- reads
     def bookmark(self, resource: str) -> int:
@@ -220,13 +391,31 @@ class SharedWatchCache:
             raise NotFound(f"{resource} {namespace}/{name}")
         return _copy(obj)
 
+    def get_object_or_none(self, resource: str, namespace: str, name: str):
+        """Store lookup WITHOUT NotFound semantics — the scoped proxy's
+        read path: a scoped store's miss is ambiguous (deleted vs never
+        in scope), so the caller must fall through to the inner chain
+        rather than conclude the object is gone."""
+        self.ensure_primed(resource)
+        with self._lock:
+            obj = self._stores[resource].get((namespace, name))
+        return None if obj is None else _copy(obj)
+
 
 class WatchCacheCluster:
     """Per-controller proxy serving the hot-path reads from a
     SharedWatchCache; everything else — writes, watches, uncached reads —
     delegates to `inner` (the controller's accounted/throttled chain), so
     a cache hit costs zero apiserver requests, exactly like an informer
-    read in the reference."""
+    read in the reference.
+
+    Under a SHARD-SCOPED cache the serving rule tightens: a read is
+    served from the store only when it is attributable to an owned job
+    key (a job get/list keyed by ns/name, a pod/service list selected by
+    the `job-name` label) or when the store simply has the object (gets).
+    Everything ambiguous — unselected lists, store misses — delegates:
+    the scoped store is a subset of the world and must never masquerade
+    as all of it."""
 
     def __init__(self, inner, cache: SharedWatchCache, kind: str):
         self._inner = inner
@@ -237,30 +426,52 @@ class WatchCacheCluster:
     def __getattr__(self, name):
         return getattr(self._inner, name)
 
+    def _scoped(self) -> bool:
+        return self._cache.scope is not None
+
     # ------------------------------------------------------------- reads
-    def list_pods(self, namespace=None, labels=None, owner_uid=None):
+    def _list_dependents(self, resource, namespace, labels, owner_uid,
+                         inner_list):
         if not self._cache.covers(namespace):
-            return self._inner.list_pods(
+            return inner_list(
                 namespace=namespace, labels=labels, owner_uid=owner_uid)
+        if self._scoped():
+            job = (labels or {}).get(LABEL_JOB_NAME)
+            if (namespace is None or not job
+                    or not self._cache.scope_allows_key(namespace, job)):
+                # Unattributable (no job-name selector) or out-of-shard:
+                # the scoped store is not authoritative — delegate.
+                return inner_list(
+                    namespace=namespace, labels=labels, owner_uid=owner_uid)
         return self._cache.list_objects(
-            "pods", namespace=namespace, labels=labels, owner_uid=owner_uid)
+            resource, namespace=namespace, labels=labels,
+            owner_uid=owner_uid)
+
+    def list_pods(self, namespace=None, labels=None, owner_uid=None):
+        return self._list_dependents(
+            "pods", namespace, labels, owner_uid, self._inner.list_pods)
 
     def list_services(self, namespace=None, labels=None, owner_uid=None):
-        if not self._cache.covers(namespace):
-            return self._inner.list_services(
-                namespace=namespace, labels=labels, owner_uid=owner_uid)
-        return self._cache.list_objects(
-            "services", namespace=namespace, labels=labels,
-            owner_uid=owner_uid)
+        return self._list_dependents(
+            "services", namespace, labels, owner_uid,
+            self._inner.list_services)
 
     def get_pod(self, namespace: str, name: str):
         if not self._cache.covers(namespace):
             return self._inner.get_pod(namespace, name)
+        if self._scoped():
+            obj = self._cache.get_object_or_none("pods", namespace, name)
+            return obj if obj is not None else self._inner.get_pod(
+                namespace, name)
         return self._cache.get_object("pods", namespace, name)
 
     def get_service(self, namespace: str, name: str):
         if not self._cache.covers(namespace):
             return self._inner.get_service(namespace, name)
+        if self._scoped():
+            obj = self._cache.get_object_or_none("services", namespace, name)
+            return obj if obj is not None else self._inner.get_service(
+                namespace, name)
         return self._cache.get_object("services", namespace, name)
 
     def get_job(self, kind: str, namespace: str, name: str) -> dict:
@@ -268,10 +479,22 @@ class WatchCacheCluster:
         # exactly its kind); a cross-kind read (SDK helpers) delegates.
         if kind != self._kind or not self._cache.covers(namespace):
             return self._inner.get_job(kind, namespace, name)
+        if self._scoped():
+            if not self._cache.scope_allows_key(namespace, name):
+                return self._inner.get_job(kind, namespace, name)
+            obj = self._cache.get_object_or_none(kind, namespace, name)
+            # Owned key, store miss: the job is genuinely gone OR it was
+            # created in the claim-prime race window — the inner read is
+            # the authority either way (a NotFound here drives _forget).
+            return obj if obj is not None else self._inner.get_job(
+                kind, namespace, name)
         return self._cache.get_object(kind, namespace, name)
 
     def list_jobs(self, kind: str, namespace=None):
-        if kind != self._kind or not self._cache.covers(namespace):
+        if (kind != self._kind or not self._cache.covers(namespace)
+                or self._scoped()):
+            # A scoped store holds only owned shards — never serve it as
+            # a full listing (resyncs and SDK helpers want the world).
             return self._inner.list_jobs(kind, namespace)
         return self._cache.list_objects(kind, namespace=namespace)
 

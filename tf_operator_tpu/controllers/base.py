@@ -316,6 +316,30 @@ class FrameworkController(FrameworkHooks):
         if uid:
             self.metrics.forget_terminal(self.kind, uid)
 
+    def forget_shard(self, shard: int, shard_of) -> None:
+        """Shard released (rebalance, resize migration, lost lease):
+        drop the per-key in-memory state of every job that just moved
+        away — expectations, the engine's gang/heartbeat/status-writer
+        caches, the heartbeat-age gauge, the known-uid map. Without
+        this, a long-lived replica in a 10k-job fleet accretes state for
+        the union of everything it EVER owned, healed only when each job
+        is finally deleted. The metrics terminal-dedup entries are
+        deliberately KEPT: the DELETED watch event prunes them by uid
+        regardless of ownership, and dropping them here would re-count a
+        re-claimed job's terminal transition."""
+        with self._uid_lock:
+            keys = list(self._known_uids)
+        for key in keys:
+            namespace, _, name = key.partition("/")
+            if shard_of(namespace, name) != shard:
+                continue
+            self.expectations.delete_expectations(key, "pods")
+            self.expectations.delete_expectations(key, "services")
+            self.engine.forget_job(key)
+            self.metrics.clear_heartbeat_age(namespace, self.kind, name)
+            with self._uid_lock:
+                self._known_uids.pop(key, None)
+
     def _record_restart(self, job: JobObject, rtype: str, cause: str) -> None:
         self.metrics.restarted_inc(job.namespace, self.kind)
         self.metrics.restarted_by_cause_inc(job.namespace, self.kind, cause)

@@ -93,10 +93,17 @@ class ClusterLeaseLock:
         name: str = "tf-operator-tpu-lock",
         clock=time.time,
         mono=None,
+        labels=None,
     ):
         self.cluster = cluster
         self.namespace = namespace or _pod_namespace()
         self.name = name
+        # Labels stamped onto the lease's metadata on create AND merged on
+        # every renew (the caller may mutate the dict between rounds —
+        # the shard coordinator advertises its adopted ring epoch this
+        # way). Lease labels are what lets membership discovery be a
+        # label-selected LIST instead of a namespace-wide scan.
+        self.labels = labels if labels is not None else {}
         self._clock = clock
         # Local observation/deadline timers run on the MONOTONIC clock: a
         # wall-clock NTP step would otherwise age a freshly renewed lease
@@ -166,6 +173,9 @@ class ClusterLeaseLock:
         spec["holderIdentity"] = identity
         spec["renewTime"] = _format_microtime(now)
         spec["leaseDurationSeconds"] = int(duration)
+        if self.labels:
+            lease.setdefault("metadata", {}).setdefault(
+                "labels", {}).update(self.labels)
         try:
             self.cluster.update_lease(lease)
         except Conflict:
@@ -277,10 +287,13 @@ class ClusterLeaseLock:
     def _create(self, identity: str, duration: float, now: float,
                 local: Optional[float] = None) -> bool:
         local = self._mono() if local is None else local
+        meta = {"namespace": self.namespace, "name": self.name}
+        if self.labels:
+            meta["labels"] = dict(self.labels)
         lease = {
             "apiVersion": "coordination.k8s.io/v1",
             "kind": "Lease",
-            "metadata": {"namespace": self.namespace, "name": self.name},
+            "metadata": meta,
             "spec": {
                 "holderIdentity": identity,
                 "leaseDurationSeconds": int(duration),

@@ -28,6 +28,23 @@ across it. This module shards job OWNERSHIP across N operator replicas:
   recompute targets and steal once the shard lease has sat unchanged a
   full duration on THEIR clock — the skew-safe observation rule).
 
+Fleet-scale extensions (docs/design/sharded_control_plane.md):
+
+- **Namespace-affinity rings** (`--shard-affinity namespace`): placement
+  rendezvous-hashes the NAMESPACE first so one tenant's jobs co-locate
+  on one replica's warm shard-scoped watch caches, with
+  `--shard-affinity-spread` as the deterministic fallback toward the
+  uniform per-key spread for tenants that outgrow one shard.
+- **Live shard-count resize**: a config Lease (`<lock>-config`) carries
+  (epoch, shards); replicas observing a newer epoch drain-and-release
+  everything they own (the same drain-before-release protocol as a
+  rebalance), adopt the new ring (epoch-qualified lease names so rings
+  never contend), advertise the adoption on their member lease, and
+  first-claim new-ring shards only once EVERY live member has adopted —
+  the barrier that makes "no job synced by two replicas" hold across
+  the migration. Published via `/debugz/resize` or SIGHUP +
+  `--shards-file`; a resize is a per-shard claim resync, not a redeploy.
+
 Single-replica default (`--shards 1`) builds none of this: the manager
 keeps the PR 5 global `is_leader` gate and issues zero lease traffic, so
 every seeded chaos/crash/stall tier replays byte-identical fault logs
@@ -37,6 +54,7 @@ fan-out, sync workers, and write coalescing).
 
 from __future__ import annotations
 
+import functools
 import hashlib
 import logging
 import threading
@@ -54,31 +72,183 @@ log = logging.getLogger(__name__)
 # liveness bound so a slow renewer is never deleted while still counted.
 _MEMBER_GC_DURATIONS = 4.0
 
+# shard_for_key placement modes. "uniform" is the PR 8 behavior (sha256
+# of ns/name, byte-identical); "namespace" rendezvous-hashes the
+# NAMESPACE first so one tenant's jobs co-locate on one replica's warm
+# caches, falling back toward the uniform spread as --shard-affinity-
+# spread grows (the lever for a tenant that outgrows one shard).
+AFFINITY_UNIFORM = "uniform"
+AFFINITY_NAMESPACE = "namespace"
+AFFINITY_MODES = (AFFINITY_UNIFORM, AFFINITY_NAMESPACE)
 
-def shard_for_key(namespace: str, name: str, shards: int) -> int:
+# Labels stamped on shard-member leases so membership discovery can be a
+# label-selected LIST (server-side on HTTP backends) instead of a scan of
+# every lease in the namespace — at 10k jobs the heartbeat leases alone
+# outnumber members 1000:1 (docs/design/sharded_control_plane.md).
+LABEL_SHARD_MEMBER = "training.tpu/shard-member"
+# Ring epoch the member has ADOPTED — the live-resize barrier: a replica
+# first-claims new-ring shards only once every live member advertises the
+# new epoch (all old-ring ownership provably released).
+LABEL_RING_EPOCH = "training.tpu/ring-epoch"
+
+
+def _uniform_hash(namespace: str, name: str) -> int:
+    digest = hashlib.sha256(f"{namespace}/{name}".encode()).digest()
+    return int.from_bytes(digest[:8], "big")
+
+
+@functools.lru_cache(maxsize=8192)
+def _ranked_shards(namespace: str, shards: int) -> Tuple[int, ...]:
+    """Rendezvous (highest-random-weight) ranking of the ring for one
+    namespace: shard s scores sha256("ns@s"); the namespace's home is the
+    top scorer. Rendezvous, not modulo, so a ring RESIZE moves a
+    namespace only when a newly added shard out-scores its old home —
+    minimal migration, which is what makes live resize cheap. Cached per
+    (namespace, shards): the gate consults placement on every enqueue
+    and pop."""
+    scores = [
+        (int.from_bytes(
+            hashlib.sha256(f"{namespace}@{s}".encode()).digest()[:8], "big"),
+         s)
+        for s in range(shards)
+    ]
+    return tuple(s for _, s in sorted(scores, key=lambda p: (-p[0], p[1])))
+
+
+def shard_for_key(namespace: str, name: str, shards: int,
+                  affinity: str = AFFINITY_UNIFORM,
+                  affinity_spread: int = 1) -> int:
     """Consistent shard id for one job key. Hashes the `namespace/name`
     queue-item identity (NOT the uid: the gate must place a key before
     any read, and a delete+recreate keeping its shard avoids a gratuitous
     ownership migration mid-churn). SHA-256 like every other seeded
     decision in this repo — identical placement on every replica, every
-    run, every platform."""
+    run, every platform.
+
+    affinity="namespace" biases placement so one tenant co-locates: the
+    namespace's top `affinity_spread` rendezvous shards are the
+    candidates and the uniform key hash picks among them. spread=1 (the
+    default) puts the whole tenant on one shard — one replica's watch
+    cache stays warm for it; spread=S degrades to the uniform per-key
+    spread, the fallback for a tenant that outgrows a shard. Placement
+    stays a pure function of (key, shards, config): every replica agrees
+    with zero coordination, the same determinism contract as the ring
+    itself."""
     if shards <= 1:
         return 0
-    digest = hashlib.sha256(f"{namespace}/{name}".encode()).digest()
-    return int.from_bytes(digest[:8], "big") % shards
+    if affinity != AFFINITY_NAMESPACE:
+        return _uniform_hash(namespace, name) % shards
+    spread = min(max(int(affinity_spread), 1), shards)
+    candidates = _ranked_shards(namespace, shards)[:spread]
+    if spread == 1:
+        return candidates[0]
+    return candidates[_uniform_hash(namespace, name) % spread]
 
 
 def shard_lease_name(lease_name: str, shard: int) -> str:
     return f"{lease_name}-shard-{shard}"
 
 
+def ring_shard_lease_name(lease_name: str, epoch: int, shard: int) -> str:
+    """Per-shard lease name, qualified by ring epoch once a live resize
+    has happened: epoch 0 keeps the PR 8 names (`<lock>-shard-<i>`), so
+    an unresized fleet is byte-identical; later epochs get
+    `<lock>-r<epoch>-shard-<i>` so an old ring's leases and a new ring's
+    can NEVER contend — the resize barrier, not lease OCC, is what keeps
+    the rings exclusive."""
+    if epoch <= 0:
+        return shard_lease_name(lease_name, shard)
+    return f"{lease_name}-r{epoch}-shard-{shard}"
+
+
 def member_lease_prefix(lease_name: str) -> str:
     return f"{lease_name}-member-"
 
 
+def config_lease_name(lease_name: str) -> str:
+    return f"{lease_name}-config"
+
+
+def read_ring_config(cluster, namespace: str,
+                     lease_name: str) -> Optional[Tuple[int, int]]:
+    """Read the ring-config lease: (epoch, shards) or None when no resize
+    was ever published (epoch 0, the boot --shards ring). The config
+    rides a Lease — the one object kind the coordinator already has RBAC
+    and seams for — with `spec.holderIdentity = "shards=N"` and
+    `spec.leaseTransitions` as the monotonically increasing epoch."""
+    try:
+        lease = cluster.get_lease(namespace, config_lease_name(lease_name))
+    except NotFound:
+        return None
+    return _parse_ring_config(lease)
+
+
+def _parse_ring_config(lease: dict) -> Optional[Tuple[int, int]]:
+    spec = lease.get("spec") or {}
+    holder = str(spec.get("holderIdentity") or "")
+    if not holder.startswith("shards="):
+        return None
+    try:
+        shards = int(holder.partition("=")[2])
+        epoch = int(spec.get("leaseTransitions") or 0)
+    except (TypeError, ValueError):
+        return None
+    if shards < 1 or epoch < 1:
+        return None
+    return epoch, shards
+
+
+def publish_ring_resize(cluster, namespace: str, lease_name: str,
+                        shards: int) -> int:
+    """Publish a new ring size (the `/debugz/resize` verb and SIGHUP
+    reload both land here): bump the config lease's epoch and record the
+    target shard count. Every replica's next coordinator tick observes
+    it and runs the drain-based migration. OCC via the lease's
+    resourceVersion: two racing admins get one Conflict instead of two
+    epochs. IDEMPOTENT on the target: re-publishing the count the config
+    already carries returns the existing epoch without a bump — a SIGHUP
+    with an unchanged --shards-file (routine config-reload convention)
+    must not force a fleet-wide drain-and-reclaim for zero ring change.
+    Returns the effective epoch."""
+    if shards < 1:
+        raise ValueError("shards must be >= 1")
+    name = config_lease_name(lease_name)
+    try:
+        lease = cluster.get_lease(namespace, name)
+    except NotFound:
+        lease = None
+    if lease is not None:
+        current = _parse_ring_config(lease)
+        if current is not None and current[1] == shards:
+            return current[0]
+    if lease is None:
+        epoch = 1
+        cluster.create_lease({
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"namespace": namespace, "name": name},
+            "spec": {
+                "holderIdentity": f"shards={shards}",
+                "leaseTransitions": epoch,
+            },
+        })
+        return epoch
+    spec = lease.setdefault("spec", {})
+    try:
+        epoch = int(spec.get("leaseTransitions") or 0) + 1
+    except (TypeError, ValueError):
+        epoch = 1
+    spec["holderIdentity"] = f"shards={shards}"
+    spec["leaseTransitions"] = epoch
+    cluster.update_lease(lease)
+    return epoch
+
+
 def resync_shard_jobs(controller, cluster, kind: str,
                       namespace: Optional[str], shard: int,
-                      shards: int) -> int:
+                      shards: int,
+                      shard_of: Optional[Callable[[str, str], int]] = None,
+                      ) -> int:
     """The claim half of the handoff protocol, single-sourced for the
     operator manager, the shard failover harness, and the flap-storm
     regression (three hand-rolled copies would silently drift as the
@@ -87,13 +257,19 @@ def resync_shard_jobs(controller, cluster, kind: str,
     waiting on OUR stale ledger from a previous stint would wedge each
     job for the expectation-expiry window — and re-enqueue every job of
     the shard (the cold-start resync_once idiom, shard-scoped). Returns
-    the number of jobs covered."""
+    the number of jobs covered.
+
+    `shard_of` overrides the placement function (the coordinator's live
+    ring view — shard count AND affinity mode); the plain `shards` int
+    keeps the uniform-hash behavior for legacy callers."""
+    if shard_of is None:
+        shard_of = lambda ns, name: shard_for_key(ns, name, shards)  # noqa: E731
     count = 0
     for job in cluster.list_jobs(kind, namespace):
         meta = job.get("metadata", {}) or {}
         ns = meta.get("namespace", "default")
         name = meta.get("name", "")
-        if shard_for_key(ns, name, shards) != shard:
+        if shard_of(ns, name) != shard:
             continue
         key = f"{ns}/{name}"
         controller.expectations.delete_expectations(key, "pods")
@@ -134,15 +310,23 @@ class ShardCoordinator:
         on_release: Optional[Callable[[int, str], None]] = None,
         drain_check: Optional[Callable[[int], bool]] = None,
         drain_timeout: float = 30.0,
+        affinity: str = AFFINITY_UNIFORM,
+        affinity_spread: int = 1,
     ):
         if shards < 1:
             raise ValueError("shards must be >= 1")
+        if affinity not in AFFINITY_MODES:
+            raise ValueError(f"unknown shard affinity {affinity!r}")
         self.cluster = cluster
         self.shards = shards
         self.identity = identity
         self.namespace = namespace or _pod_namespace()
         self.lease_name = lease_name
         self.duration = duration
+        # Placement mode (must be configured identically on every replica,
+        # like --shards itself): see shard_for_key.
+        self.affinity = affinity
+        self.affinity_spread = affinity_spread
         self._clock = clock
         # Same monotonic-clock split as ClusterLeaseLock: liveness timers
         # must not move with NTP steps; fake-clock tests inject one clock
@@ -156,42 +340,83 @@ class ShardCoordinator:
         # in flight. None = always drained (single-threaded harnesses).
         self.drain_check = drain_check
         self.drain_timeout = drain_timeout
-        self._locks = [
-            ClusterLeaseLock(
-                cluster, namespace=self.namespace,
-                name=shard_lease_name(lease_name, i),
-                clock=clock, mono=self._mono,
-            )
-            for i in range(shards)
-        ]
+        # Live-resize state: the adopted ring epoch (0 = the boot ring,
+        # legacy lease names) and, while a published resize is migrating,
+        # the (epoch, shards) target. Mutated only on the tick thread.
+        self.ring_epoch = 0
+        self._resize_target: Optional[Tuple[int, int]] = None
+        self._locks = self._build_locks()
+        # Member lease labels: the selector that keeps membership listing
+        # O(members) instead of O(all leases), plus the adopted ring
+        # epoch — the resize barrier peers wait on.
+        self._member_labels = {
+            LABEL_SHARD_MEMBER: lease_name,
+            LABEL_RING_EPOCH: "0",
+        }
         self._member_lock = ClusterLeaseLock(
             cluster, namespace=self.namespace,
             name=f"{lease_name}-member-{identity}",
             clock=clock, mono=self._mono,
+            labels=self._member_labels,
         )
         self._lock = threading.Lock()
         self._owned: Set[int] = set()
         self._draining: Set[int] = set()
+        # Shards owned but still WARMING: the claim hooks (watch-cache
+        # prime + claim resync) have not finished. The sync gate
+        # (allows) excludes them — a worker syncing a just-claimed key
+        # against a cache whose shard slice is still priming would read
+        # an incomplete world — while the enqueue filter (admits) takes
+        # them, so the claim resync's own enqueues are not dropped; the
+        # post-pop gate re-checks and hands back until the warm-up
+        # completes (a bounded sub-second window).
+        self._warming: Set[int] = set()
         self._drain_since: Dict[int, float] = {}
         # Member-liveness observation: lease name -> (renew_raw, local
         # time the value last CHANGED). Liveness is "changed within one
         # duration on MY clock" — never a remote-timestamp comparison.
         self._member_obs: Dict[str, Tuple[str, float]] = {}
         self._live_members: List[str] = [identity]
+        # Ring epoch each live member advertises (member-lease label);
+        # refreshed by _compute_members, read by the resize claim barrier.
+        self._member_epochs: Dict[str, int] = {identity: 0}
         # Last observed holder per shard (observability/debugz; advisory).
         self._holders: Dict[int, Optional[str]] = {}
 
+    def _build_locks(self) -> List[ClusterLeaseLock]:
+        return [
+            ClusterLeaseLock(
+                self.cluster, namespace=self.namespace,
+                name=ring_shard_lease_name(self.lease_name, self.ring_epoch, i),
+                clock=self._clock, mono=self._mono,
+            )
+            for i in range(self.shards)
+        ]
+
     # ------------------------------------------------------------- gating
     def shard_of(self, namespace: str, name: str) -> int:
-        return shard_for_key(namespace, name, self.shards)
+        return shard_for_key(namespace, name, self.shards,
+                             self.affinity, self.affinity_spread)
 
-    def allows(self, namespace: str, name: str) -> bool:
-        """The per-key sync gate: this replica holds the job's shard and
-        is not draining it. Checked at enqueue AND re-checked after the
-        blocking queue pop (the PR 5 post-pop rule, per key)."""
+    def admits(self, namespace: str, name: str) -> bool:
+        """The ENQUEUE filter: this replica holds the job's shard and is
+        not draining it. Warming shards (claim hooks still running) are
+        admitted — the claim resync enqueues THROUGH this filter, and
+        dropping its keys would lose the handoff."""
         shard = self.shard_of(namespace, name)
         with self._lock:
             return shard in self._owned and shard not in self._draining
+
+    def allows(self, namespace: str, name: str) -> bool:
+        """The per-key SYNC gate (the post-pop re-check, PR 5 rule, per
+        key): admits AND the shard has finished warming — a sync must
+        never run against a claim whose watch-cache prime is still in
+        flight (it would read the primed-resource store as authoritative
+        while the shard's slice is incomplete)."""
+        shard = self.shard_of(namespace, name)
+        with self._lock:
+            return (shard in self._owned and shard not in self._draining
+                    and shard not in self._warming)
 
     def owns(self, shard: int) -> bool:
         with self._lock:
@@ -220,7 +445,10 @@ class ShardCoordinator:
             members = list(self._live_members)
             owned = sorted(self._owned)
             draining = sorted(self._draining)
+            warming = sorted(self._warming)
             holders = dict(self._holders)
+            member_epochs = dict(self._member_epochs)
+            resize_target = self._resize_target
         targets = {
             s: members[s % len(members)] if members else None
             for s in range(self.shards)
@@ -228,9 +456,18 @@ class ShardCoordinator:
         return {
             "identity": self.identity,
             "shards": self.shards,
+            "ring_epoch": self.ring_epoch,
+            "affinity": self.affinity,
+            "affinity_spread": self.affinity_spread,
+            # Non-None while a published resize is mid-migration here:
+            # (target epoch, target shard count). The member_epochs map
+            # shows who the claim barrier is still waiting on.
+            "resize_target": list(resize_target) if resize_target else None,
+            "member_epochs": member_epochs,
             "members": members,
             "owned": owned,
             "draining": draining,
+            "warming": warming,
             "holders": {str(s): holders.get(s) for s in range(self.shards)},
             "targets": {str(s): targets[s] for s in range(self.shards)},
         }
@@ -250,16 +487,30 @@ class ShardCoordinator:
         """Sorted live-member identities from the member-lease prefix.
         Every replica lists the same objects and applies the same
         observation rule, so rankings converge within one tick of any
-        membership change."""
+        membership change. The LIST is label-selected (the
+        LABEL_SHARD_MEMBER stamp every member lease carries) so it stays
+        O(members) however many heartbeat/job leases share the namespace;
+        the prefix remains a second, client-side filter. Also refreshes
+        each live member's advertised ring epoch (the resize barrier)."""
         local = self._mono()
         prefix = member_lease_prefix(self.lease_name)
         try:
-            leases = self.cluster.list_leases(self.namespace, name_prefix=prefix)
+            try:
+                leases = self.cluster.list_leases(
+                    self.namespace, name_prefix=prefix,
+                    labels={LABEL_SHARD_MEMBER: self.lease_name},
+                )
+            except TypeError:
+                # Backend predating the labels parameter: prefix-only
+                # (full-collection scan — correct, just not cheap).
+                leases = self.cluster.list_leases(
+                    self.namespace, name_prefix=prefix)
         except Exception:  # noqa: BLE001 — keep the last view on a blip
             log.warning("member lease list failed", exc_info=True)
             with self._lock:
                 return list(self._live_members)
         live: Set[str] = {self.identity}
+        epochs: Dict[str, int] = {self.identity: self.ring_epoch}
         seen_names: Set[str] = set()
         for lease in leases:
             meta = lease.get("metadata") or {}
@@ -283,6 +534,13 @@ class ShardCoordinator:
                     observed_at = prev[1]
             if ident == self.identity or local < observed_at + held:
                 live.add(ident)
+                if ident != self.identity:
+                    try:
+                        epochs[ident] = int(
+                            (meta.get("labels") or {}).get(
+                                LABEL_RING_EPOCH, 0))
+                    except (TypeError, ValueError):
+                        epochs[ident] = 0
             elif local >= observed_at + held * _MEMBER_GC_DURATIONS:
                 # Long-dead member: GC its lease so the roster doesn't
                 # accrete one object per replica ever started. Best
@@ -296,6 +554,7 @@ class ShardCoordinator:
                 if name not in seen_names:
                     self._member_obs.pop(name, None)
             self._live_members = sorted(live)
+            self._member_epochs = epochs
             return list(self._live_members)
 
     def _targets(self, members: List[str]) -> Set[int]:
@@ -317,20 +576,88 @@ class ShardCoordinator:
             log.warning("drain check failed; treating as drained", exc_info=True)
             return True
 
+    def _check_ring_config(self) -> None:
+        """Observe the published ring config; a NEWER epoch than ours
+        starts the resize migration (drain everything, adopt, re-claim).
+        One lease GET per tick — bounded, and invisible to per-job write
+        attribution like all coordination traffic."""
+        try:
+            cfg = read_ring_config(self.cluster, self.namespace,
+                                   self.lease_name)
+        except Exception:  # noqa: BLE001 — a config blip must not kill ticks
+            log.warning("ring config read failed", exc_info=True)
+            return
+        if cfg is None:
+            return
+        epoch, shards = cfg
+        if epoch <= self.ring_epoch or self._resize_target == cfg:
+            return
+        log.info(
+            "ring resize published: epoch %d -> %d, shards %d -> %d; "
+            "draining all owned shards (%s)",
+            self.ring_epoch, epoch, self.shards, shards, self.identity,
+        )
+        self._resize_target = cfg
+
+    def _adopt_ring(self) -> None:
+        """All old-ring ownership released: switch to the target ring and
+        advertise the adoption on the member lease. First-claims on the
+        new ring stay barred until EVERY live member advertises the same
+        epoch (_claims_allowed) — released-by-all is what makes the two
+        rings' disjoint lease names safe."""
+        epoch, shards = self._resize_target
+        old_epoch, old_shards = self.ring_epoch, self.shards
+        with self._lock:
+            self.ring_epoch = epoch
+            self.shards = shards
+            self._resize_target = None
+            self._holders = {}
+            self._member_epochs[self.identity] = epoch
+        self._locks = self._build_locks()
+        self._member_labels[LABEL_RING_EPOCH] = str(epoch)
+        log.info(
+            "ring adopted by %s: epoch %d (%d shards) -> epoch %d (%d shards)",
+            self.identity, old_epoch, old_shards, epoch, shards,
+        )
+
+    def _claims_allowed(self) -> bool:
+        """The resize barrier: new-ring FIRST-claims (renewals of shards
+        already held are never barred) require every live member to have
+        adopted our ring epoch — a laggard still advertising the old
+        epoch may still hold old-ring leases over the same keys. A
+        freshly booted epoch-0 replica trips this for at most one tick
+        (it adopts on its first)."""
+        with self._lock:
+            epochs = dict(self._member_epochs)
+            members = list(self._live_members)
+        return all(epochs.get(m, 0) == self.ring_epoch for m in members)
+
     def tick(self) -> None:
-        """One coordination round: renew membership, recompute targets,
-        then per shard acquire/renew/observe/drain as the assignment
-        dictates. Cheap and bounded; the manager runs it every
-        duration/3 like the elect loop."""
+        """One coordination round: observe the ring config (live resize),
+        renew membership, recompute targets, then per shard acquire/
+        renew/observe/drain as the assignment dictates. Cheap and
+        bounded; the manager runs it every duration/3 like the elect
+        loop."""
+        self._check_ring_config()
+        if self._resize_target is not None:
+            with self._lock:
+                still_owned = bool(self._owned)
+            if not still_owned:
+                self._adopt_ring()
+        resizing = self._resize_target is not None
         self._renew_membership()
         members = self._compute_members()
-        targets = self._targets(members)
+        # Mid-resize every owned shard drains (targets empty); after
+        # adoption, targets come from the new ring but first-claims wait
+        # on the all-members-adopted barrier.
+        targets = set() if resizing else self._targets(members)
+        claims_ok = resizing or self._claims_allowed()
         for shard in range(self.shards):
             lock = self._locks[shard]
             with self._lock:
                 mine = shard in self._owned
                 draining = shard in self._draining
-            if shard in targets:
+            if shard in targets and (mine or claims_ok):
                 if draining:
                     # Re-targeted to us mid-drain (membership flapped
                     # back): cancel the drain and keep serving — but the
@@ -343,14 +670,21 @@ class ShardCoordinator:
                     with self._lock:
                         self._draining.discard(shard)
                         self._drain_since.pop(shard, None)
-                    self._notify(self.on_claim, shard, "reclaim")
+                        self._warming.add(shard)
+                    try:
+                        self._notify(self.on_claim, shard, "reclaim")
+                    finally:
+                        with self._lock:
+                            self._warming.discard(shard)
                 self._try_claim(shard, lock, mine)
             elif mine:
-                self._drain_and_release(shard, lock)
+                self._drain_and_release(
+                    shard, lock, cause="resize" if resizing else "rebalance")
             else:
-                # Foreign shard: observe only, so the expiry timer is
-                # already armed if a membership change later targets it
-                # here (steal latency = one tick, not one extra
+                # Foreign shard (or a target we may not first-claim yet —
+                # the resize barrier): observe only, so the expiry timer
+                # is already armed if a membership change later targets
+                # it here (steal latency = one tick, not one extra
                 # duration), and /debugz can show the full holder map.
                 self._holders[shard] = lock.observe()
 
@@ -363,7 +697,9 @@ class ShardCoordinator:
         self._holders[shard] = self.identity if got else lock.last_holder_seen
         if got and not mine:
             # Fresh claim: free/released lease = "claim"; a lease whose
-            # last holder was a (now-expired) peer = "steal".
+            # last holder was a (now-expired) peer = "steal". The shard
+            # WARMS until the claim hooks (cache prime + resync) finish:
+            # owned (deltas apply, enqueues admitted) but not yet synced.
             cause = (
                 "steal"
                 if lock.last_holder_seen not in (None, "", self.identity)
@@ -371,8 +707,13 @@ class ShardCoordinator:
             )
             with self._lock:
                 self._owned.add(shard)
+                self._warming.add(shard)
             log.info("shard %d %sed by %s", shard, cause, self.identity)
-            self._notify(self.on_claim, shard, cause)
+            try:
+                self._notify(self.on_claim, shard, cause)
+            finally:
+                with self._lock:
+                    self._warming.discard(shard)
         elif not got and mine:
             # Lost a held shard (stolen, or renew errors past the
             # deadline): gate off IMMEDIATELY — the new holder's claim
@@ -381,13 +722,16 @@ class ShardCoordinator:
             with self._lock:
                 self._owned.discard(shard)
                 self._draining.discard(shard)
+                self._warming.discard(shard)
                 self._drain_since.pop(shard, None)
             log.warning("shard %d lost by %s", shard, self.identity)
             self._notify(self.on_release, shard, "lost")
 
-    def _drain_and_release(self, shard: int, lock: ClusterLeaseLock) -> None:
-        """Graceful rebalance: the membership re-assigned a shard we
-        hold. Gate its keys off (allows() excludes draining shards), keep
+    def _drain_and_release(self, shard: int, lock: ClusterLeaseLock,
+                           cause: str = "rebalance") -> None:
+        """Graceful rebalance (or resize migration — same drain protocol,
+        cause="resize"): the membership re-assigned a shard we hold.
+        Gate its keys off (allows() excludes draining shards), keep
         RENEWING while in-flight syncs finish — releasing mid-sync would
         let the next owner start beside us — then release so the target
         owner wins the very next tick instead of waiting out expiry."""
@@ -417,13 +761,14 @@ class ShardCoordinator:
             self._draining.discard(shard)
             self._drain_since.pop(shard, None)
         self._holders[shard] = None
-        log.info("shard %d released by %s (rebalance)", shard, self.identity)
-        self._notify(self.on_release, shard, "rebalance")
+        log.info("shard %d released by %s (%s)", shard, self.identity, cause)
+        self._notify(self.on_release, shard, cause)
 
     def _try_claim_lost(self, shard: int) -> None:
         with self._lock:
             self._owned.discard(shard)
             self._draining.discard(shard)
+            self._warming.discard(shard)
             self._drain_since.pop(shard, None)
         self._notify(self.on_release, shard, "lost")
 
@@ -434,6 +779,13 @@ class ShardCoordinator:
             hook(shard, cause)
         except Exception:  # noqa: BLE001 — observer errors never stall claims
             log.warning("shard hook failed for shard %d", shard, exc_info=True)
+
+    def request_resize(self, shards: int) -> int:
+        """Publish a new ring size through the config lease; every
+        replica (this one included) observes it on its next tick and
+        runs the drain-based migration. Returns the published epoch."""
+        return publish_ring_resize(
+            self.cluster, self.namespace, self.lease_name, shards)
 
     # ----------------------------------------------------------- lifecycle
     def shutdown(self, sleep=time.sleep) -> None:

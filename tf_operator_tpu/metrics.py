@@ -158,6 +158,24 @@ class Metrics:
             "rate from one namespace is that tenant queueing on itself, "
             "not on cluster capacity",
         ),
+        "training_operator_watch_cache_events_served_total": (
+            ("resource",),
+            "Watch deltas APPLIED to this replica's shared watch-cache "
+            "store (cluster/watchcache.py), by resource. Under "
+            "shard-scoped caching (--shards > 1) only deltas of owned "
+            "shards are applied, so the per-replica rate must fall ~1/N "
+            "as replicas are added — the fleet-scale gate's "
+            "watch-traffic number",
+        ),
+        "training_operator_watch_cache_events_filtered_total": (
+            ("resource",),
+            "Watch deltas DROPPED at the cache boundary: the object's "
+            "owning-job key lies outside this replica's owned shards "
+            "(or outside the namespace scope). On a balanced N-replica "
+            "scoped fleet filtered/(served+filtered) ≈ (N-1)/N; near "
+            "zero with --shards > 1 means scoping is not engaged and "
+            "every replica is paying fleet-wide watch load",
+        ),
         "training_operator_apiserver_requests_total": (
             ("verb", "resource", "code"),
             "Apiserver requests issued through the cluster seam "
@@ -381,6 +399,28 @@ class Metrics:
         self._inc_labeled(
             "training_operator_apiserver_requests_total", verb, resource, code,
         )
+
+    def watch_cache_served_inc(self, resource: str) -> None:
+        """One watch delta applied to the shared watch-cache store."""
+        self._inc_labeled(
+            "training_operator_watch_cache_events_served_total", resource,
+        )
+
+    def watch_cache_filtered_inc(self, resource: str) -> None:
+        """One watch delta dropped at the cache's shard/namespace scope."""
+        self._inc_labeled(
+            "training_operator_watch_cache_events_filtered_total", resource,
+        )
+
+    def watch_cache_totals(self) -> Tuple[int, int]:
+        """(served, filtered) summed over resources — the per-replica
+        watch-traffic number the fleet-scale benchmark gates on."""
+        with self._lock:
+            served = sum(self._labeled_counters[
+                "training_operator_watch_cache_events_served_total"].values())
+            filtered = sum(self._labeled_counters[
+                "training_operator_watch_cache_events_filtered_total"].values())
+        return served, filtered
 
     def shard_handoff_inc(self, cause: str) -> None:
         """One shard ownership transition at this replica (cause = claim|

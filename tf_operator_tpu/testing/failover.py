@@ -177,14 +177,16 @@ class _AliveGate:
 
 class _ShardReplica:
     """One replica slot of the ShardFailoverDriver: a ShardCoordinator
-    plus a controller incarnation, both discarded wholesale on a
-    simulated crash."""
+    plus a controller incarnation (and, when the driver runs with watch
+    caches, a per-replica shard-scoped SharedWatchCache), all discarded
+    wholesale on a simulated crash."""
 
     def __init__(self, identity: str):
         self.identity = identity
         self.alive = True
         self.coordinator = None
         self.controller = None
+        self.cache = None
 
 
 class ShardFailoverDriver:
@@ -224,6 +226,9 @@ class ShardFailoverDriver:
         duration: float = 10.0,
         max_failovers: int = 100,
         tracer=None,
+        affinity: str = "uniform",
+        affinity_spread: int = 1,
+        use_watch_cache: bool = False,
     ):
         from ..core.sharding import ShardCoordinator, shard_for_key
 
@@ -236,6 +241,16 @@ class ShardFailoverDriver:
         self.duration = duration
         self.max_failovers = max_failovers
         self.tracer = tracer
+        self.affinity = affinity
+        self.affinity_spread = affinity_spread
+        # When True each replica gets its own shard-scoped
+        # SharedWatchCache (cluster/watchcache.py) wired exactly like
+        # OperatorManager does: scope = the replica's coordinator, prime
+        # on claim BEFORE the resync, teardown on release. The factory
+        # is then called with a `watch_cache=` keyword. Requires a
+        # backend whose supports_watch_cache is True (NOT the chaos
+        # seam).
+        self.use_watch_cache = use_watch_cache
         self.now = 1000.0  # the one clock; advance() moves it
         self.crashes: List[str] = []
         self.handoffs: List[str] = []  # "identity:claim|steal|...:shard"
@@ -258,10 +273,18 @@ class ShardFailoverDriver:
 
         def on_claim(shard: int, cause: str, _replica=replica) -> None:
             self.handoffs.append(f"{_replica.identity}:{cause}:{shard}")
+            # Same ordering contract as OperatorManager._on_shard_claimed:
+            # warm the scoped cache FIRST, so the resync's enqueued keys
+            # sync against a primed store (zero accounted reads even on
+            # the first post-steal sync).
+            if _replica.cache is not None:
+                _replica.cache.prime_shard(shard)
             self._resync_shard(_replica, shard)
 
         def on_release(shard: int, cause: str, _replica=replica) -> None:
             self.handoffs.append(f"{_replica.identity}:{cause}:{shard}")
+            if _replica.cache is not None:
+                _replica.cache.drop_shard(shard)
 
         replica.coordinator = self._coordinator_cls(
             gate,
@@ -278,9 +301,25 @@ class ShardFailoverDriver:
             # mid-sync at tick time, so drains complete instantly and
             # deterministically.
             drain_check=None,
+            affinity=self.affinity,
+            affinity_spread=self.affinity_spread,
         )
-        owns = replica.coordinator.allows
-        replica.controller = self._factory(gate, owns)
+        # Enqueue filter = admits (the claim resync enqueues through it
+        # while the shard is still warming); the step() gate syncs
+        # through allows, exactly like OperatorManager.
+        owns = replica.coordinator.admits
+        if self.use_watch_cache:
+            from ..cluster.watchcache import SharedWatchCache
+
+            # Built AFTER the coordinator (it is the scope) and BEFORE
+            # the controller (the cache's watch handlers must run first
+            # in dispatch order — the PR 7 ordering contract).
+            replica.cache = SharedWatchCache(
+                gate, namespace=self.namespace, scope=replica.coordinator)
+            replica.controller = self._factory(
+                gate, owns, watch_cache=replica.cache)
+        else:
+            replica.controller = self._factory(gate, owns)
         self.replicas[identity] = replica
         return replica
 
@@ -334,16 +373,35 @@ class ShardFailoverDriver:
         return [self.replicas[k] for k in sorted(self.replicas)]
 
     def shard_of(self, namespace: str, name: str) -> int:
-        return self._shard_for_key(namespace, name, self.shards)
+        """Placement under the CURRENT ring: a live replica's coordinator
+        view when one exists (it tracks live resizes), else the boot
+        parameters."""
+        live = self._live()
+        if live:
+            return live[0].coordinator.shard_of(namespace, name)
+        return self._shard_for_key(namespace, name, self.shards,
+                                   self.affinity, self.affinity_spread)
 
     def owner_of(self, namespace: str, name: str) -> Optional[str]:
         """Which live replica owns the job's shard right now (None = the
-        shard is currently orphaned — mid-migration)."""
-        shard = self.shard_of(namespace, name)
+        shard is currently orphaned — mid-migration). Each replica's
+        placement is computed under ITS ring view: mid-resize the views
+        diverge, and a replica only counts as owner by its own ring."""
         for replica in self._live():
-            if replica.coordinator.owns(shard):
+            coordinator = replica.coordinator
+            if coordinator.owns(coordinator.shard_of(namespace, name)):
                 return replica.identity
         return None
+
+    def request_resize(self, shards: int) -> int:
+        """Publish a live ring resize through the shared cluster (the
+        config-lease protocol); replicas migrate on their next ticks.
+        Returns the published epoch."""
+        from ..core.sharding import publish_ring_resize
+
+        return publish_ring_resize(
+            self._cluster, self.namespace or "default", self.lease_name,
+            shards)
 
     def owned_map(self) -> Dict[str, List[int]]:
         return {
@@ -363,7 +421,8 @@ class ShardFailoverDriver:
         for kind in self.kinds:
             resync_shard_jobs(
                 controller, self._cluster, kind, self.namespace, shard,
-                self.shards,
+                replica.coordinator.shards,
+                shard_of=replica.coordinator.shard_of,
             )
 
     def tick(self) -> None:

@@ -286,7 +286,7 @@ class StubApiServer:
             )
         m = _LEASE_RE.match(path)
         if m:
-            return self._leases(handler, method, m)
+            return self._leases(handler, method, m, labels=labels)
         raise KeyError(path)
 
     def _jobs(self, handler, method, m, watching, q) -> None:
@@ -449,12 +449,16 @@ class StubApiServer:
             return handler._json(200, {})
         raise KeyError(method)
 
-    def _leases(self, handler, method, m) -> None:
+    def _leases(self, handler, method, m, labels=None) -> None:
         ns, name = m["ns"], m["name"]
         if method == "GET" and not name:
             # Collection list (the shard coordinator's member discovery).
+            # labelSelector is honored SERVER-side: the response must not
+            # scale with the fleet-wide lease count (per-job heartbeat
+            # leases share this namespace) when the client selects on the
+            # member-lease label.
             return handler._json(
-                200, {"items": self.mem.list_leases(ns)}
+                200, {"items": self.mem.list_leases(ns, labels=labels)}
             )
         if method == "GET":
             return handler._json(200, self.mem.get_lease(ns, name))
