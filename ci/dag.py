@@ -314,6 +314,21 @@ def default_dag() -> List[Step]:
              pytest + ["tests/test_chaos.py", "tests/test_disruption.py",
                        "tests/test_stall.py", "-m", "not slow"],
              deps=["operator-integration"], retries=2),
+        # Multislice chaos tier (docs/design/failure_modes.md §12):
+        # slice-scoped failure domains under seeded schedules — a
+        # preempted slice restarts ALONE (surviving slices UID-stable,
+        # trace-audited teardown confinement), coordinator/quorum loss
+        # escalates to exactly one counted world restart, two-slice
+        # concurrent loss without a quorum bound counts each slice once
+        # (the flat model's hidden suppression window), per-slice
+        # admission preempts one slice on revocation, and the scheduled
+        # slice preemption replays fault_log + span_sequence
+        # byte-identically. Capability story: the new ScheduledSlice-
+        # Preemption plan field defaults empty, so every PR 1-10 seeded
+        # schedule replays unchanged.
+        Step("multislice-chaos",
+             pytest + ["tests/test_multislice_chaos.py", "-m", "not slow"],
+             deps=["operator-integration"], retries=2),
         # Gang-admission tier (docs/design/gang_admission.md): the
         # capacity-aware admission layer under seeded contention —
         # quota'd queueing, priority preemption through the counted

@@ -82,8 +82,14 @@ def main(argv=None) -> int:
     if args.checkpoint_dir:
         from tf_operator_tpu.train.checkpoint import CheckpointManager
 
+        ckpt_dir = args.checkpoint_dir
+        if getattr(topo, "slice_world", False) and topo.num_slices > 1:
+            # Slice-local worlds (JAX_SLICE_LOCAL_WORLD) are independent
+            # training replicas: each slice owns its own checkpoint
+            # stream, or two coordinators would race one orbax dir.
+            ckpt_dir = os.path.join(ckpt_dir, f"slice-{topo.slice_index}")
         ckpt = CheckpointManager(
-            args.checkpoint_dir, sharding=sharding, model_meta=config.geometry()
+            ckpt_dir, sharding=sharding, model_meta=config.geometry()
         )
         state, restored_step = ckpt.restore_latest(state)
         if restored_step is not None:

@@ -1220,6 +1220,112 @@ def _run_contention(waves, capacity_pods, quotas=(), backfill_max_members=8,
     }
 
 
+def _multislice_contention_job(name, slices, hosts, duration, priority="",
+                               namespace="default"):
+    manifest = _contention_job(name, slices * hosts, duration,
+                               priority=priority, namespace=namespace)
+    manifest["spec"]["numSlices"] = slices
+    return manifest
+
+
+def _run_slice_backfill(timeout=30.0):
+    """The per-slice backfill scenario (slice-granular admission,
+    --admission-slice-granularity): a low-band 2-slice job fills the
+    4-slot pool; a high-band 2-slot job arrives and the arbiter must
+    free EXACTLY ONE slice (slice-local counted teardown — the
+    surviving slice's pods keep their UIDs), admit the newcomer into
+    the freed slice's capacity, and re-admit the evicted slice once the
+    newcomer finishes. Returns the samples the gate needs."""
+    from tf_operator_tpu.cluster.memory import InMemoryCluster
+    from tf_operator_tpu.core.tracing import Tracer
+
+    mem = InMemoryCluster()
+    stop_kubelet, kubelet = _kubelet_sim(mem)
+    metrics = Metrics()
+    tracer = Tracer()
+    manager = OperatorManager(
+        mem,
+        OperatorOptions(
+            enabled_schemes=["JAXJob"], health_port=0, metrics_port=0,
+            threadiness=4, resync_period=0.2,
+            enable_gang_admission=True,
+            capacity="pods=4",
+            admission_slice_granularity=True,
+        ),
+        metrics=metrics,
+        tracer=tracer,
+    )
+    manager.start()
+
+    def live_uids(name, slice_index=None):
+        out = {}
+        for p in mem.list_pods("default", labels={"job-name": name}):
+            if p.metadata.deletion_timestamp is not None:
+                continue
+            if slice_index is not None and p.metadata.labels.get(
+                "tpu-slice-index"
+            ) != str(slice_index):
+                continue
+            out[p.metadata.name] = p.metadata.uid
+        return out
+
+    def succeeded(name):
+        job = mem.get_job("JAXJob", "default", name)
+        return any(
+            c["type"] == "Succeeded" and c["status"] == "True"
+            for c in (job.get("status") or {}).get("conditions") or []
+        )
+
+    try:
+        t0 = time.monotonic()
+        mem.create_job(_multislice_contention_job(
+            "ms", slices=2, hosts=2, duration=3.0, priority="low"))
+        deadline = t0 + timeout
+        while time.monotonic() < deadline and len(live_uids("ms")) < 4:
+            time.sleep(0.01)
+        survivor_uids_before = live_uids("ms", slice_index=0)
+        if len(survivor_uids_before) != 2:
+            raise SystemExit(
+                "slice-backfill: the 2-slice job never brought up both "
+                f"slices ({sorted(live_uids('ms'))})"
+            )
+
+        # The high-band contender: the pool is full, so admitting it
+        # requires freeing exactly one low-band SLICE.
+        mem.create_job(_contention_job("hi", 2, 0.4, priority="high"))
+        while time.monotonic() < deadline and not succeeded("hi"):
+            time.sleep(0.01)
+        hi_done = succeeded("hi")
+        survivor_uids_at_hi_done = live_uids("ms", slice_index=0)
+
+        while time.monotonic() < deadline and not succeeded("ms"):
+            time.sleep(0.01)
+        ms_done = succeeded("ms")
+        ms_status = (
+            mem.get_job("JAXJob", "default", "ms").get("status") or {}
+        )
+        admission = manager.admission
+        slice_preemptions = [
+            list(t) for t in admission.preemption_ledger
+            if "#slice-" in t[0]
+        ]
+    finally:
+        stop_kubelet.set()
+        manager.stop()
+        kubelet.join(timeout=5)
+    return {
+        "hi_done": hi_done,
+        "ms_done": ms_done,
+        "survivor_uids_before": survivor_uids_before,
+        "survivor_uids_at_hi_done": survivor_uids_at_hi_done,
+        "slice_preemptions": slice_preemptions,
+        "ms_disruption_counts": ms_status.get("disruptionCounts"),
+        "ms_slice_restart_counts": ms_status.get("sliceRestartCounts"),
+        "admission": admission,
+        "cluster": mem,
+    }
+
+
 def contention_main(smoke=False) -> int:
     """--mode contention: the gang-admission behavioral benchmark
     (docs/design/gang_admission.md). Two scenarios:
@@ -1233,8 +1339,14 @@ def contention_main(smoke=False) -> int:
        heads the queue; six 4-slot shorties either wait behind it (FIFO,
        backfill disabled) or backfill the 4-slot gap (default). The
        measured makespan/utilization margin is the number backfill buys.
+    3. PER-SLICE BACKFILL (--admission-slice-granularity): a high-band
+       2-slot job against a pool filled by a low-band 2-slice job — the
+       arbiter frees exactly ONE slice (counted slice-local teardown),
+       the surviving slice's pods keep their UIDs through the whole
+       incident, and the evicted slice is re-admitted and completes
+       once the newcomer finishes.
 
-    --smoke turns both into CI gates and records the margins in
+    --smoke turns all three into CI gates and records the margins in
     build/contention_smoke_last.json."""
     from tf_operator_tpu.testing.invariants import check_admission_invariants
 
@@ -1318,6 +1430,36 @@ def contention_main(smoke=False) -> int:
                 f"({backfill['makespan_s']}s vs {fifo['makespan_s']}s)"
             )
 
+    # Scenario 3: per-slice backfill under slice-granular admission.
+    sliced = _run_slice_backfill()
+    slice_violations = check_admission_invariants(
+        sliced["admission"], cluster=sliced["cluster"], kinds=["JAXJob"])
+    if not sliced["hi_done"] or not sliced["ms_done"]:
+        regressions.append(
+            f"slice backfill: jobs did not complete (hi={sliced['hi_done']}"
+            f", ms={sliced['ms_done']})"
+        )
+    if len(sliced["slice_preemptions"]) != 1:
+        regressions.append(
+            f"slice backfill: expected exactly one slice preemption, got "
+            f"{sliced['slice_preemptions']}"
+        )
+    if sliced["survivor_uids_at_hi_done"] != sliced["survivor_uids_before"]:
+        regressions.append(
+            "slice backfill: the surviving slice's pods were replaced — "
+            f"{sliced['survivor_uids_before']} -> "
+            f"{sliced['survivor_uids_at_hi_done']} (the freed slice must "
+            "be backfilled WITHOUT evicting the remaining slices)"
+        )
+    if sliced["ms_disruption_counts"] != {"Worker": 1}:
+        regressions.append(
+            f"slice backfill: slice preemption not counted exactly once: "
+            f"{sliced['ms_disruption_counts']}"
+        )
+    if slice_violations:
+        regressions.append(
+            "slice admission invariants: " + "; ".join(slice_violations))
+
     out = {
         "mode": "contention",
         "smoke": smoke,
@@ -1334,6 +1476,15 @@ def contention_main(smoke=False) -> int:
             "backfill_utilization": backfill["utilization"],
             "makespan_speedup": margin,
             "backfill_admits": len(backfilled),
+        },
+        "slice_backfill_gate": {
+            "slice_preemptions": sliced["slice_preemptions"],
+            "survivor_uids_stable": (
+                sliced["survivor_uids_at_hi_done"]
+                == sliced["survivor_uids_before"]
+            ),
+            "ms_disruption_counts": sliced["ms_disruption_counts"],
+            "ms_slice_restart_counts": sliced["ms_slice_restart_counts"],
         },
         "regression": "; ".join(regressions) or None,
     }

@@ -175,6 +175,40 @@ class TestJAXJobValidation:
         )
         jaxjob.validate(spec)
 
+    def test_min_slices_quorum_bounds(self):
+        def spec(**kw):
+            return jaxjob.JAXJobSpec(
+                jax_replica_specs={
+                    jaxjob.REPLICA_TYPE_WORKER: replica("jax", replicas=8)
+                },
+                num_slices=4,
+                **kw,
+            )
+
+        jaxjob.validate(spec(min_slices=2))
+        with pytest.raises(ValidationError, match="minSlices must be >= 1"):
+            jaxjob.validate(spec(min_slices=0))
+        with pytest.raises(ValidationError, match="exceeds numSlices"):
+            jaxjob.validate(spec(min_slices=5))
+
+    def test_elastic_below_quorum_rejected(self):
+        """elastic.minSlices < minSlices would let a perfectly legal
+        scale() produce a spec validation must reject — bricking the
+        live job at its next sync. The inconsistent declaration is
+        refused up front instead."""
+        spec = jaxjob.JAXJobSpec(
+            jax_replica_specs={
+                jaxjob.REPLICA_TYPE_WORKER: replica("jax", replicas=8)
+            },
+            num_slices=4,
+            min_slices=2,
+            elastic=jaxjob.ElasticPolicy(min_slices=1),
+        )
+        with pytest.raises(ValidationError, match="below the restart quorum"):
+            jaxjob.validate(spec)
+        spec.elastic = jaxjob.ElasticPolicy(min_slices=2)
+        jaxjob.validate(spec)
+
     def test_exit_code_retry_taxonomy(self):
         # 1-127 permanent, 128+ retryable (reference design doc :84).
         assert not common.is_retryable_exit_code(1)

@@ -463,6 +463,31 @@ class TestJAXController:
         with pytest.raises(ValueError, match="not elastic"):
             client.scale("llama", num_slices=2)
 
+    def test_scale_rejects_non_slice_divisible_replicas(self):
+        """Regression: a stored Worker count that does not divide over
+        numSlices used to make scale() silently SKIP the replicas patch
+        — shipping a numSlices that disagreed with the worker count.
+        Now it refuses with a typed error before anything is written."""
+        from tf_operator_tpu.api.defaulting import ValidationError
+        from tf_operator_tpu.sdk.client import JobClient
+
+        manifest = jax_manifest(num_slices=2)
+        manifest["spec"]["elastic"] = {"minSlices": 1, "maxSlices": 4}
+        self.cluster.create_job(manifest)
+        self.controller.run_until_idle()
+        # Corrupt the stored spec the way a manual edit or an older
+        # operator could: 5 workers over 2 slices.
+        job = self.cluster.get_job("JAXJob", "default", "llama")
+        job["spec"]["jaxReplicaSpecs"]["Worker"]["replicas"] = 5
+        self.cluster.update_job(job)
+        client = JobClient(self.cluster, kind="JAXJob")
+        before = self.cluster.get_job("JAXJob", "default", "llama")["spec"]
+        with pytest.raises(ValidationError, match="not slice-divisible"):
+            client.scale("llama", num_slices=4)
+        after = self.cluster.get_job("JAXJob", "default", "llama")["spec"]
+        assert after["numSlices"] == before["numSlices"], (
+            "a rejected resize must write nothing")
+
     def test_elastic_bounds_validated(self):
         manifest = jax_manifest(num_slices=2)
         manifest["spec"]["elastic"] = {"minSlices": 3}

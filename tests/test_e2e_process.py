@@ -931,6 +931,130 @@ class TestMultisliceTraining:
             assert "[llama] done" in log, log
 
 
+class TestSliceLocalGangRestart:
+    def test_sigkill_one_slice_keeps_other_slice_and_resumes(self, tmp_path):
+        """Slice-scoped failure domains LIVE (docs/design/failure_modes.md
+        §12): a 2-slice CPU world (2 procs per slice, slice-local
+        jax.distributed worlds via JAX_SLICE_LOCAL_WORLD — the CPU
+        stand-in for megascale's DCN layer) trains llama-tiny with
+        per-slice checkpoints. SIGKILL BOTH of slice 1's processes: the
+        operator must restart slice 1 ALONE — slice 0's pods keep their
+        UIDs across the whole recovery — and the recreated slice resumes
+        from ITS checkpoint, with exactly one counted, slice-attributed
+        restart."""
+        metrics = Metrics()
+        cluster = LocalProcessCluster(child_env=CHILD_ENV)
+        manager = OperatorManager(
+            cluster,
+            OperatorOptions(enabled_schemes=["JAXJob"], health_port=0,
+                            metrics_port=0, resync_period=0.2),
+            metrics=metrics,
+        )
+        manager.start()
+        ckpt_dir = str(tmp_path / "ckpt")
+        train_cmd = [
+            sys.executable,
+            os.path.join(REPO_ROOT, "examples", "jax", "llama", "llama_train.py"),
+            "--model", "llama-tiny", "--steps", "80", "--batch", "16",
+            "--seq", "32", "--checkpoint-every", "5", "--log-every", "40",
+            "--checkpoint-dir", ckpt_dir,
+        ]
+        try:
+            cluster.create_job({
+                "apiVersion": "kubeflow.org/v1",
+                "kind": "JAXJob",
+                "metadata": {"name": "slc", "namespace": "default"},
+                "spec": {
+                    "numSlices": 2,
+                    "jaxReplicaSpecs": {"Worker": {
+                        "replicas": 4,
+                        "template": {"spec": {"containers": [{
+                            "name": "jax", "image": "local",
+                            "command": train_cmd,
+                            "env": [{"name": "JAX_SLICE_LOCAL_WORLD",
+                                     "value": "1"}],
+                        }]}},
+                    }},
+                },
+            })
+            names = [f"slc-worker-{i}" for i in range(4)]
+            slice1 = ["slc-worker-2", "slc-worker-3"]
+
+            def slice1_checkpoint():
+                d = os.path.join(ckpt_dir, "slice-1")
+                return os.path.isdir(d) and any(
+                    e.name.isdigit() for e in os.scandir(d))
+
+            # Whole-test budget (the PR 5 evidence-based guard): the
+            # property under test is the operator's slice-scoped restart
+            # + per-slice checkpoint resume; workload SPEED on a loaded
+            # CPU container is environment, so a too-slow world skips
+            # instead of wedging the tier.
+            deadline = time.monotonic() + 600
+            if not wait_for(slice1_checkpoint, timeout=240):
+                pytest.skip(
+                    "2-slice llama world committed no slice-1 checkpoint "
+                    "within 240s — environment too slow for this e2e")
+            uids_before = {
+                n: cluster.get_pod("default", n).metadata.uid for n in names
+            }
+            for name in slice1:
+                try:
+                    cluster.kill_pod("default", name)
+                except KeyError:
+                    pass  # already finished: the kill raced a fast world
+
+            def slice1_recreated():
+                try:
+                    pods = {n: cluster.get_pod("default", n) for n in names}
+                except KeyError:
+                    return False
+                return all(
+                    pods[n].metadata.uid != uids_before[n] for n in slice1
+                ) and all(
+                    pods[n].metadata.uid == uids_before[n]
+                    for n in names if n not in slice1
+                )
+
+            assert wait_for(slice1_recreated, timeout=120), (
+                "slice-1 was not recreated beside UID-stable slice-0 pods")
+
+            if not wait_for(
+                lambda: job_condition(cluster, "JAXJob", "slc", "Succeeded"),
+                timeout=max(240.0, deadline - time.monotonic()),
+            ):
+                log2 = cluster.get_pod_log("default", "slc-worker-2")
+                if "resumed from step" in log2:
+                    pytest.skip(
+                        "recreated slice resumed from its checkpoint but "
+                        "did not finish within the 600s test budget")
+                raise AssertionError(
+                    f"recreated slice never resumed: {log2[-3000:]}")
+
+            # Slice 0 rode through: same pod UIDs end to end.
+            for n in ("slc-worker-0", "slc-worker-1"):
+                assert cluster.get_pod(
+                    "default", n).metadata.uid == uids_before[n], (
+                    f"{n} was replaced by a slice-1 restart")
+            # The recreated slice resumed from ITS OWN checkpoint stream.
+            resumed = any(
+                "resumed from step" in cluster.get_pod_log("default", n)
+                for n in slice1
+            )
+            assert resumed, cluster.get_pod_log("default", "slc-worker-2")[-2000:]
+            job = cluster.get_job("JAXJob", "default", "slc")
+            counts = job["status"]
+            total = (sum(counts.get("restartCounts", {}).values())
+                     + sum(counts.get("disruptionCounts", {}).values()))
+            assert total == 1, (
+                f"one slice restart, not one per pod: {counts}")
+            assert counts.get("sliceRestartCounts") == {"1": 1}, counts
+            assert not job_condition(cluster, "JAXJob", "slc", "Failed")
+        finally:
+            manager.stop()
+            cluster.shutdown()
+
+
 class TestProgressStallLiveProcesses:
     def test_sigstop_wedged_worker_restarts_with_progress_stall(self, harness):
         """The gang-liveness e2e (ISSUE 2 acceptance): SIGSTOP one worker

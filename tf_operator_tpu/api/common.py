@@ -278,6 +278,17 @@ class JobStatus:
     # each stall restart is rate-limited by its own deadline window, and
     # activeDeadlineSeconds remains the hard wall-clock bound.
     stall_counts: Dict[str, int] = field(default_factory=dict)
+    # Per-SLICE restart attribution (slice-scoped failure domains,
+    # docs/design/failure_modes.md §12): counted restarts whose teardown
+    # was scoped to one slice of a multislice job, keyed by the slice
+    # index as a string ("3" -> 2 means slice 3 was restarted twice).
+    # Escalated whole-world restarts (coordinator/quorum loss) do NOT
+    # land here — they are visible in the three cause ledgers above and
+    # in the SliceQuorumLost condition reason. Purely attributive: no
+    # budget draws from this map (the cause ledgers keep that job), so
+    # it can never disagree with them on totals — a slice restart
+    # increments exactly one cause ledger AND its slice's entry here.
+    slice_restart_counts: Dict[str, int] = field(default_factory=dict)
     # Consecutive disruption restarts since the job last reached Running:
     # drives the jittered exponential restart backoff (first disruption
     # restarts immediately; a preemption loop backs off). Reset on Running.

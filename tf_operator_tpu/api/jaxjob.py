@@ -98,6 +98,16 @@ class JAXJobSpec:
     # Multislice: number of DCN-connected slices; each slice is one gang of
     # `hosts_for(tpu)` workers and the global mesh gains a leading DCN axis.
     num_slices: int = 1
+    # Slice-restart quorum (slice-scoped failure domains): a retryable
+    # loss of one slice restarts only that slice, UNLESS the healthy
+    # slice count would drop below this bound within the restart window —
+    # then the whole world restarts through the same counted protocol
+    # (one ledger entry, reason SliceQuorumLost). None (the default)
+    # disables the quorum rule: only the coordinator-slice rule (losing
+    # slice 0 always escalates) applies. Distinct from elastic.minSlices,
+    # which bounds INTENTIONAL resize — this bounds how much concurrent
+    # FAILURE the running world is declared to tolerate.
+    min_slices: Optional[int] = None
     # Logical mesh the workload should build, e.g. {"dp": 1, "fsdp": 8, "tp": 4}.
     # Published to every pod as JAX_MESH_SPEC (JSON); axes sizes must multiply
     # to the global chip count when both are known.
@@ -180,6 +190,29 @@ def validate(spec: JAXJobSpec) -> None:
             raise ValidationError(
                 f"JAXJobSpec is not valid: numSlices {spec.num_slices} outside "
                 f"elastic bounds [{el.min_slices}, {el.max_slices}]"
+            )
+    if spec.min_slices is not None:
+        if spec.min_slices < 1:
+            raise ValidationError(
+                f"JAXJobSpec is not valid: minSlices must be >= 1, got "
+                f"{spec.min_slices}"
+            )
+        if spec.min_slices > max(1, spec.num_slices):
+            raise ValidationError(
+                f"JAXJobSpec is not valid: minSlices ({spec.min_slices}) "
+                f"exceeds numSlices ({max(1, spec.num_slices)}) — the quorum "
+                "could never be met"
+            )
+        if spec.elastic is not None and spec.elastic.min_slices < spec.min_slices:
+            # Declared inconsistency: elastic permits resizing BELOW the
+            # failure quorum, so a perfectly legal scale() would produce
+            # a spec this same validator must reject — bricking a live
+            # job at its next sync. Refuse the combination up front.
+            raise ValidationError(
+                f"JAXJobSpec is not valid: elastic.minSlices "
+                f"({spec.elastic.min_slices}) < minSlices "
+                f"({spec.min_slices}) — a legal resize could drop below "
+                "the restart quorum"
             )
     for rtype in spec.jax_replica_specs:
         if rtype not in CANONICAL_REPLICA_TYPES:

@@ -152,6 +152,13 @@ class OperatorOptions:
     # Aging bound: once the head-of-line gang has waited this long, no
     # backfill admits until it does (starvation-freedom).
     admission_aging_seconds: float = 300.0
+    # Per-SLICE admission granularity (flagged headroom): a multislice
+    # job's slices register as individually admittable/preemptable/
+    # backfillable demands, so a capacity revocation preempts ONE slice
+    # (slice-local counted teardown + slice-local re-queue) instead of
+    # evicting the whole job. Off (default) keeps the PR 9 job-granular
+    # arbiter byte-identical.
+    admission_slice_granularity: bool = False
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -250,6 +257,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         help="Once the head-of-line gang has waited this "
                         "long, backfill stops until it admits "
                         "(starvation bound).")
+    parser.add_argument("--admission-slice-granularity", action="store_true",
+                        help="Admit multislice jobs one SLICE at a time: "
+                        "each slice is its own admission demand — "
+                        "individually admittable, preemptable (slice-"
+                        "local counted teardown; surviving slices keep "
+                        "running) and backfillable. Default off = the "
+                        "job-granular arbiter.")
     parser.add_argument("--json-log-format", action="store_true",
                         help="Deprecated alias for --log-format json.")
     parser.add_argument("--log-format", choices=("text", "json"), default="text",
@@ -323,6 +337,7 @@ def options_from_args(args: argparse.Namespace) -> OperatorOptions:
         namespace_quotas=list(args.namespace_quota),
         backfill_max_members=args.backfill_max_members,
         admission_aging_seconds=args.admission_aging_seconds,
+        admission_slice_granularity=args.admission_slice_granularity,
     )
 
 
@@ -621,6 +636,7 @@ class OperatorManager:
                 aging_seconds=self.options.admission_aging_seconds,
                 metrics=self.metrics,
                 capacity_fn=getattr(cluster, "schedulable_capacity", None),
+                slice_granular=self.options.admission_slice_granularity,
             )
         from .core.control import TokenBucket
 

@@ -47,7 +47,11 @@ from ..api.k8s import (
     ContainerStatus,
     PodCondition,
 )
-from ..core.constants import HEARTBEAT_LEASE_SUFFIX
+from ..core.constants import (
+    HEARTBEAT_LEASE_SUFFIX,
+    LABEL_JOB_NAME,
+    LABEL_SLICE_INDEX,
+)
 from .base import Cluster, Conflict, ServerError
 
 # Writes eligible for fault injection — the same surface ThrottledCluster
@@ -112,6 +116,24 @@ class ScheduledPreemption:
     after_writes: int
     namespace: Optional[str] = None
     labels: Optional[Dict[str, str]] = None
+    reason: str = "Preempted"
+    exit_code: int = 137
+
+
+@dataclass
+class ScheduledSlicePreemption:
+    """A whole-SLICE preemption planted in the schedule — the multislice
+    analog of ScheduledPreemption, selecting by `slice_index`: after the
+    proxy has seen `after_writes` total writes, every pod of `job_name`
+    carrying the matching tpu-slice-index label is batch-killed in one
+    event (a reclaimed slice takes all its hosts at once). The
+    slice-scoped failure-domain machinery must restart THAT slice only;
+    the other slices' pods must keep their UIDs. Fires at most once."""
+
+    after_writes: int
+    job_name: str = ""
+    slice_index: int = 0
+    namespace: Optional[str] = None
     reason: str = "Preempted"
     exit_code: int = 137
 
@@ -246,6 +268,11 @@ class ChaosSpec:
     # Kinds whose watch events may be dropped; empty tuple = all kinds.
     drop_watch_kinds: Tuple[str, ...] = ()
     preemptions: Tuple[ScheduledPreemption, ...] = ()
+    # Slice-targeted preemptions (slice-scoped failure domains): a new
+    # plan field, default empty — every pre-existing seeded schedule is
+    # untouched by its existence (nothing fires, the write clock and
+    # fault_log are byte-identical).
+    slice_preemptions: Tuple[ScheduledSlicePreemption, ...] = ()
     hangs: Tuple[ScheduledHang, ...] = ()
     # Controller-crash plan: hash-driven crashes at `crash_rate` per
     # eligible write (variant — before/after the write lands — drawn from
@@ -317,6 +344,7 @@ class ChaosCluster:
         self._counters: Dict[str, int] = {}
         self._writes_seen = 0
         self._preempted = [False] * len(spec.preemptions)
+        self._slice_preempted = [False] * len(spec.slice_preemptions)
         self._stuck_fired = [False] * len(spec.stuck_terminations)
         self._capacity_fired = [False] * len(spec.capacity_revocations)
         self._crashes_fired = 0
@@ -429,6 +457,13 @@ class ChaosCluster:
             ]
             for i in due:
                 self._preempted[i] = True
+            slice_due = [
+                i for i, p in enumerate(self.spec.slice_preemptions)
+                if not self._slice_preempted[i]
+                and self._writes_seen >= p.after_writes
+            ]
+            for i in slice_due:
+                self._slice_preempted[i] = True
             stuck_due = [
                 i for i, s in enumerate(self.spec.stuck_terminations)
                 if not self._stuck_fired[i] and self._writes_seen >= s.after_writes
@@ -447,6 +482,13 @@ class ChaosCluster:
             self.preempt_pods(
                 namespace=p.namespace, labels=p.labels,
                 reason=p.reason, exit_code=p.exit_code,
+            )
+        for i in slice_due:
+            p = self.spec.slice_preemptions[i]
+            self.preempt_slice(
+                job_name=p.job_name, slice_index=p.slice_index,
+                namespace=p.namespace, reason=p.reason,
+                exit_code=p.exit_code,
             )
         for i in stuck_due:
             s = self.spec.stuck_terminations[i]
@@ -713,4 +755,35 @@ class ChaosCluster:
                 f":{reason}:{exit_code}"
             )
             killed += 1
+        return killed
+
+    def preempt_slice(
+        self,
+        job_name: str,
+        slice_index: int,
+        namespace: Optional[str] = None,
+        reason: str = "Preempted",
+        exit_code: int = 137,
+    ) -> int:
+        """Slice-targeted batch kill (the ScheduledSlicePreemption lever):
+        every pod of `job_name` stamped with the matching tpu-slice-index
+        label dies in one event — a reclaimed slice takes all its hosts
+        at once, and ONLY its hosts. Selection is by the label the
+        controllers stamp on every slice-shaped pod, so the kill set is
+        exactly the restart domain the engine must scope its teardown
+        to. Fault-log entries ride the same `preempt:` prefix with a
+        slice marker, so replay diffs show which slice went."""
+        killed = self.preempt_pods(
+            namespace=namespace,
+            labels={
+                LABEL_JOB_NAME: job_name,
+                LABEL_SLICE_INDEX: str(slice_index),
+            },
+            reason=reason,
+            exit_code=exit_code,
+        )
+        self._log(
+            f"preempt-slice:{namespace or '*'}/{job_name}"
+            f":slice-{slice_index}:killed={killed}"
+        )
         return killed

@@ -177,6 +177,7 @@ class FrameworkController(FrameworkHooks):
             requeue=lambda key, after: self.queue.add_after(key, after),
             clock=clock,
             on_job_restarting=self._record_restart,
+            on_gang_restart=self._record_gang_restart,
             on_heartbeat_age=self._record_heartbeat_age,
             on_force_delete=self._record_force_delete,
             on_fanout_batch=self._record_fanout_batch,
@@ -343,6 +344,17 @@ class FrameworkController(FrameworkHooks):
     def _record_restart(self, job: JobObject, rtype: str, cause: str) -> None:
         self.metrics.restarted_inc(job.namespace, self.kind)
         self.metrics.restarted_by_cause_inc(job.namespace, self.kind, cause)
+
+    def _record_gang_restart(self, job: JobObject, scope: str,
+                             slice_index, cause: str) -> None:
+        """One counted gang restart, scope-labeled (slice|world); slice-
+        scoped restarts additionally land in the per-slice-index counter
+        the flapping alert watches."""
+        self.metrics.gang_restart_inc(job.namespace, self.kind, scope, cause)
+        if scope == "slice" and slice_index is not None:
+            self.metrics.slice_restart_inc(
+                job.namespace, self.kind, str(slice_index)
+            )
 
     def _record_heartbeat_age(self, job: JobObject, age: float) -> None:
         self.metrics.set_heartbeat_age(job.namespace, self.kind, job.name, age)

@@ -52,6 +52,16 @@ class JAXController(FrameworkController):
         template.metadata.labels[constants.LABEL_WORLD_GENERATION] = (
             jaxdist.world_generation(job)
         )
+        # Slice stamp on every WORKER pod (not just spec.tpu ones, which
+        # attach_tpu_to_template already stamps identically): the
+        # slice-scoped failure-domain machinery, chaos slice selectors,
+        # and dashboards all key on it — a CPU e2e multislice world must
+        # carry the same per-slice identity a real pod-slice does.
+        if rtype == jaxapi.REPLICA_TYPE_WORKER:
+            per_slice = jaxdist.hosts_per_slice(job)
+            template.metadata.labels[constants.LABEL_SLICE_INDEX] = str(
+                min(index // max(1, per_slice), max(1, job.spec.num_slices) - 1)
+            )
         self._attach_tpu_resources(job, template, rtype, index)
 
     def restart_peers_on_failure(self, rtype: str) -> bool:
@@ -106,6 +116,25 @@ class JAXController(FrameworkController):
         per_slice = jaxdist.hosts_per_slice(job)
         _tpu.attach_tpu_to_template(
             tpu, template, index // per_slice, self.default_container_name
+        )
+
+    def slice_topology(self, job, replicas):
+        """Slice-indexed restart domains (core/job_controller.py
+        SliceTopology): one domain per DCN-connected slice, so a
+        retryable loss in slice s tears down slice s's pods only — the
+        surviving slices' per-slice ICI meshes are untouched and the
+        recreated slice re-rendezvouses through the stable worker-0
+        coordinator service. Single-slice jobs return None: the flat
+        whole-world restart path, byte-identical to before."""
+        num_slices = max(1, job.spec.num_slices)
+        if num_slices <= 1:
+            return None
+        from ..core.job_controller import SliceTopology
+
+        return SliceTopology(
+            num_slices=num_slices,
+            hosts_per_slice=jaxdist.hosts_per_slice(job),
+            min_slices=job.spec.min_slices,
         )
 
     # ---------------------------------------------------------------- gang

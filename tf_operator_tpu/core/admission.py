@@ -187,6 +187,14 @@ class _Gang:
     members: int
     seq: int
     enqueued_at: float
+    # Victim preference within a band (higher = evicted sooner). The
+    # engine ranks a multislice job's slices by slice index so the
+    # coordinator slice (rank 0 — the worker-0 jax.distributed
+    # coordinator every sibling depends on) is only ever chosen once no
+    # other slice of any job in the band remains; flat jobs rank 0, so
+    # with slice granularity off every ordering is byte-identical to
+    # the rank-free arbiter.
+    victim_rank: int = 0
     kick: Optional[Callable[[], None]] = None
     admitted_at: Optional[float] = None
     backfilled: bool = False
@@ -218,7 +226,17 @@ class AdmissionController:
         clock=time.time,
         metrics=None,
         capacity_fn: Optional[Callable[[], Optional[Dict[str, str]]]] = None,
+        slice_granular: bool = False,
     ):
+        # Per-SLICE admission (--admission-slice-granularity, flagged
+        # headroom for multislice jobs): the ENGINE reads this and
+        # registers each slice of a multislice job as its own demand
+        # under the key "<Kind>:<ns>/<name>#slice-<s>" — individually
+        # admittable, preemptable (slice-local counted teardown) and
+        # backfillable, so a capacity revocation evicts one slice, not
+        # the job. The arbiter itself is key-agnostic; the flag lives
+        # here so the engine and the manager share one source of truth.
+        self.slice_granular = bool(slice_granular)
         self._declared = _parse_resources(capacity) if capacity else None
         self.quotas: Dict[str, Dict[str, Fraction]] = {
             ns: _parse_resources(res) for ns, res in (quotas or {}).items()
@@ -360,7 +378,7 @@ class AdmissionController:
         if cap is not None:
             victims_pool = sorted(
                 (g for g in self._admitted.values() if g.key not in self._preempt),
-                key=lambda g: (g.band, -g.seq),
+                key=lambda g: (g.band, -g.victim_rank, -g.seq),
             )
             excluded = set(self._preempt)
             for victim in victims_pool:
@@ -444,7 +462,7 @@ class AdmissionController:
                 candidates = sorted(
                     (g for g in self._admitted.values()
                      if g.band < gang.band and g.key not in self._preempt),
-                    key=lambda g: (g.band, -g.seq),
+                    key=lambda g: (g.band, -g.victim_rank, -g.seq),
                 )
                 # Check-before-marking, INCLUDING the already-pending set:
                 # a pump landing between a victim's mark and its
@@ -495,6 +513,7 @@ class AdmissionController:
         priority_class: str = "", demand: Optional[Dict[str, Fraction]] = None,
         members: int = 0, has_pods: bool = False,
         kick: Optional[Callable[[], None]] = None,
+        victim_rank: int = 0,
     ) -> AdmitResult:
         """One job's admission question, asked on every sync. Admitted
         jobs take a fast path (plus a pump so capacity revocations are
@@ -519,6 +538,7 @@ class AdmissionController:
                 gang.members = members or gang.members
                 gang.uid = uid or gang.uid
                 gang.kick = kick or gang.kick
+                gang.victim_rank = victim_rank
                 self._pump_locked(now)
                 newly = not gang.announced_admit
                 gang.announced_admit = True
@@ -535,7 +555,8 @@ class AdmissionController:
                     gang = _Gang(
                         key=key, kind=kind, namespace=namespace, name=name,
                         uid=uid, band=band, demand=demand, members=members,
-                        seq=self._seq, enqueued_at=now, kick=kick,
+                        seq=self._seq, enqueued_at=now,
+                        victim_rank=victim_rank, kick=kick,
                     )
                     self._waiting[key] = gang
                 else:
@@ -544,6 +565,7 @@ class AdmissionController:
                     gang.members = members or gang.members
                     gang.uid = uid or gang.uid
                     gang.kick = kick or gang.kick
+                    gang.victim_rank = victim_rank
                 if has_pods:
                     self._admit_locked(gang, now, backfill=False, head_wait=None)
                     gang.announced_admit = True
@@ -621,13 +643,77 @@ class AdmissionController:
         """The job left the contention domain (terminal, suspended, or
         deleted): free its capacity/quota and admit whoever is next. A
         key this controller never saw is a no-op — release is called
-        unconditionally from every cleanup path."""
+        unconditionally from every cleanup path. Releases the key's
+        per-slice sub-entries ("<key>#slice-<s>") along with it: the
+        cleanup paths know only the job, and a leaked slice admission
+        would pin its share of the tenant's quota forever. The sub-key
+        sweep runs only under slice granularity — the only mode that
+        can create them — so the job-granular arbiter keeps its O(1)
+        release on every terminal/suspend/delete sync."""
         with self._lock:
-            was_admitted = self._admitted.pop(key, None) is not None
-            was_waiting = self._waiting.pop(key, None) is not None
-            self._preempt.pop(key, None)
-            if not (was_admitted or was_waiting):
+            doomed = {key}
+            if self.slice_granular:
+                prefix = key + "#slice-"
+                doomed |= {
+                    k
+                    for k in (
+                        set(self._admitted) | set(self._waiting)
+                        | set(self._preempt)
+                    )
+                    if k.startswith(prefix)
+                }
+            released = False
+            for k in doomed:
+                released |= self._admitted.pop(k, None) is not None
+                released |= self._waiting.pop(k, None) is not None
+                self._preempt.pop(k, None)
+            if not released:
                 return
+            self._pump_locked(self.clock())
+            kicks = self._drain_kicks_locked()
+        for fn in kicks:
+            fn()
+
+    def release_stale_granularity(self, key: str, sliced: bool) -> None:
+        """Granularity-transition hygiene (an elastic resize crossing the
+        numSlices>1 boundary switches which admission gate a job uses):
+        entering the SLICED gate drops a stale plain-key registration;
+        entering the FLAT gate drops stale '#slice-' sub-entries.
+        Without this, the old granularity's admissions double-charge the
+        pool and the tenant quota for the job's whole remaining life,
+        and a pending preemption against a stale key is never serviced.
+        Fast no-op when nothing stale exists — the flat branch probes the
+        O(1) '#slice-0' sentinel (sliced registrations always include
+        slice 0) before paying the full key scan, so a fleet of
+        single-slice jobs never scans the arbiter per sync."""
+        with self._lock:
+            if sliced:
+                doomed = [key] if (
+                    key in self._admitted or key in self._waiting
+                    or key in self._preempt
+                ) else []
+            else:
+                sentinel = f"{key}#slice-0"
+                if not (
+                    sentinel in self._admitted or sentinel in self._waiting
+                    or sentinel in self._preempt
+                ):
+                    return
+                prefix = key + "#slice-"
+                doomed = [
+                    k
+                    for k in (
+                        set(self._admitted) | set(self._waiting)
+                        | set(self._preempt)
+                    )
+                    if k.startswith(prefix)
+                ]
+            if not doomed:
+                return
+            for k in doomed:
+                self._admitted.pop(k, None)
+                self._waiting.pop(k, None)
+                self._preempt.pop(k, None)
             self._pump_locked(self.clock())
             kicks = self._drain_kicks_locked()
         for fn in kicks:

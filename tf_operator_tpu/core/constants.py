@@ -95,6 +95,20 @@ REASON_QUEUED = "GangQueued"
 # disruptionCounts ledger and the job re-queues at the head of its band.
 REASON_GANG_ADMITTED = "GangAdmitted"
 REASON_GANG_PREEMPTED = "GangPreempted"
+# Slice-scoped failure domains (docs/design/failure_modes.md §12): a
+# multislice job's retryable failure restarts only the lost slice — the
+# same Restarting condition TYPE, reason carrying the slice scope so a
+# slice-local incident is distinguishable from a whole-world restart.
+REASON_SLICE_RESTARTING = "SliceRestarting"
+REASON_SLICE_DISRUPTION_RESTARTING = "SliceDisruptionRestarting"
+REASON_SLICE_STALL_RESTARTING = "SliceProgressStallRestarting"
+# Escalation out of the slice domain: losing the coordinator slice
+# (slice 0 hosts the worker-0 jax.distributed coordinator every other
+# slice re-rendezvouses through) or dropping below the spec.minSlices
+# quorum within the restart window restarts the WHOLE world through the
+# same counted protocol — exactly one ledger entry, labeled with this
+# reason so dashboards can tell "a slice bounced" from "the world went".
+REASON_SLICE_QUORUM_LOST = "SliceQuorumLost"
 
 # Disruption restart backoff (jittered exponential, engine
 # `_disruption_backoff_seconds`): the FIRST disruption restarts

@@ -266,6 +266,24 @@ def update_job_replica_statuses(job_status: JobStatus, rtype: str, pod: Pod) -> 
         status.failed += 1
 
 
+@dataclass(frozen=True)
+class SliceTopology:
+    """Slice-indexed restart domains of one multislice job (TF-Replicator's
+    multi-level topology applied to recovery, docs/design/failure_modes.md
+    §12): `num_slices` domains of `hosts_per_slice` world pods each. A
+    retryable loss inside one domain restarts that domain alone;
+    `coordinator_slice` (slice 0, hosting the worker-0 jax.distributed
+    coordinator every other slice re-rendezvouses through) and the
+    `min_slices` quorum escalate to a whole-world restart. None from the
+    hook (single-slice jobs, kinds without slice semantics) keeps every
+    restart path byte-identical to the flat model."""
+
+    num_slices: int
+    hosts_per_slice: int
+    min_slices: Optional[int] = None
+    coordinator_slice: int = 0
+
+
 class FrameworkHooks:
     """Per-framework policy plugged into the engine (the reference's
     common.ControllerInterface, tfjob_controller.go:206-595)."""
@@ -306,6 +324,27 @@ class FrameworkHooks:
         pod-slice: a slice is all-or-nothing, but one free slice of a
         multislice job may start while others queue."""
         return job.name
+
+    def slice_topology(self, job: JobObject, replicas: Dict[str, ReplicaSpec]):
+        """The job's slice-indexed restart domains (SliceTopology), or
+        None for kinds/jobs without slice semantics — None keeps every
+        restart path the flat whole-world model, byte-identical."""
+        return None
+
+    def replica_slice_index(
+        self, job: JobObject, topo: SliceTopology,
+        replicas: Dict[str, ReplicaSpec], rtype: str, index: int,
+    ) -> int:
+        """Which slice domain a replica belongs to. Default mirrors the
+        gang-group placement every slice-aware kind already uses: world
+        members (restart_peers_on_failure types) are slice-shaped —
+        rank // hosts_per_slice — while out-of-world auxiliaries spread
+        round-robin (index % num_slices, the JAX gang_group_name rule)."""
+        if self.restart_peers_on_failure(rtype):
+            return min(
+                index // max(1, topo.hosts_per_slice), topo.num_slices - 1
+            )
+        return index % max(1, topo.num_slices)
 
     def stale_world_pods(
         self, job: JobObject, replicas: Dict[str, ReplicaSpec], pods: List[Pod]
@@ -449,6 +488,7 @@ class JobController:
         requeue: Optional[Callable[[str, float], None]] = None,
         clock=time.time,
         on_job_restarting: Optional[Callable[[JobObject, str, str], None]] = None,
+        on_gang_restart: Optional[Callable[[JobObject, str, Optional[int], str], None]] = None,
         on_heartbeat_age: Optional[Callable[[JobObject, float], None]] = None,
         on_force_delete: Optional[Callable[[JobObject, str], None]] = None,
         on_fanout_batch: Optional[Callable[[str, int], None]] = None,
@@ -469,6 +509,13 @@ class JobController:
         # (job, rtype, cause) — cause is a RESTART_CAUSE_* constant so the
         # controller's metrics can label restarts by what actually happened.
         self.on_job_restarting = on_job_restarting or (lambda job, rtype, cause: None)
+        # (job, scope, slice index or None, cause) — fires once per COUNTED
+        # gang restart, labeling its restart-domain scope (slice|world);
+        # the controller exports it as gang_restarts_total{scope,cause}
+        # and slice_restarts_total{slice}.
+        self.on_gang_restart = on_gang_restart or (
+            lambda job, scope, slice_index, cause: None
+        )
         # (job, worst staleness seconds) — fires on every liveness check of
         # a deadline-opted-in job; the controller exports it as the
         # heartbeat_age_seconds gauge.
@@ -764,6 +811,11 @@ class JobController:
         # re-detectable evidence — dies only once that count is durable).
         # Transient, like _restarting_this_sync.
         job.status._deferred_deletes = []
+        # Slice-granular admission verdict (set by _admission_gate_sliced):
+        # None = every slice may create pods; a set limits reconcile_pods'
+        # missing-slot creation to admitted slices — a queued slice's pods
+        # stay unborn while its siblings run. Transient per sync.
+        job.status._admitted_slices = None
 
         pods = self.get_pods_for_job(job)
 
@@ -816,6 +868,7 @@ class JobController:
             job.status.restart_counts = {}
             job.status.disruption_counts = {}
             job.status.stall_counts = {}
+            job.status.slice_restart_counts = {}
             job.status.disruption_streak = 0
             job.status.restart_backoff_until = None
             capi.update_job_conditions(
@@ -984,23 +1037,64 @@ class JobController:
                 in world_types
             ]
             disrupted = cause == capi.RESTART_CAUSE_DISRUPTION
-            reason = constants.job_reason(
-                self.hooks.kind,
-                constants.REASON_DISRUPTION_RESTARTING if disrupted
-                else constants.REASON_RESTARTING,
-            )
             detail = (
                 "was disrupted (preempted/evicted/drained)" if disrupted
                 else "failed retryably"
             )
-            msg = (
-                f"{self.hooks.kind} {job.name} is restarting the whole gang: "
-                f"{rtype} replica {failed_pod.metadata.name} {detail} "
-                "and the SPMD world restarts as one unit."
+            # Slice-scoped restart domains: for a multislice job the
+            # failure is first attributed to its slice, and the counted
+            # teardown runs against that slice's pods ONLY — surviving
+            # slices are never deleted, and the recreated slice
+            # re-rendezvouses through the stable worker-0 coordinator
+            # service. Losing the coordinator slice, or dropping below
+            # the spec.minSlices quorum within the restart window,
+            # escalates to the whole world through the same counted
+            # protocol (one ledger entry, reason SliceQuorumLost).
+            topo, scope, slice_idx, why = self._slice_restart_scope(
+                job, replicas, pods, failed_pod, world_types
             )
+            if scope == "slice":
+                targets = [
+                    p for p in targets
+                    if self._pod_slice_index(job, topo, replicas, p)
+                    == slice_idx
+                ]
+                reason = constants.job_reason(
+                    self.hooks.kind,
+                    constants.REASON_SLICE_DISRUPTION_RESTARTING if disrupted
+                    else constants.REASON_SLICE_RESTARTING,
+                )
+                msg = (
+                    f"{self.hooks.kind} {job.name} is restarting slice "
+                    f"{slice_idx}: {rtype} replica "
+                    f"{failed_pod.metadata.name} {detail}; the slice "
+                    "restarts as one unit while the other "
+                    f"{topo.num_slices - 1} slice(s) keep running."
+                )
+            elif why is not None:
+                reason = constants.job_reason(
+                    self.hooks.kind, constants.REASON_SLICE_QUORUM_LOST
+                )
+                msg = (
+                    f"{self.hooks.kind} {job.name} is restarting the whole "
+                    f"world: {rtype} replica {failed_pod.metadata.name} "
+                    f"{detail} in slice {slice_idx} and {why}."
+                )
+            else:
+                reason = constants.job_reason(
+                    self.hooks.kind,
+                    constants.REASON_DISRUPTION_RESTARTING if disrupted
+                    else constants.REASON_RESTARTING,
+                )
+                msg = (
+                    f"{self.hooks.kind} {job.name} is restarting the whole gang: "
+                    f"{rtype} replica {failed_pod.metadata.name} {detail} "
+                    "and the SPMD world restarts as one unit."
+                )
             self._restart_gang_counted(
                 job, pods, targets, failed_pod, rtype, cause, reason, msg,
-                old_status,
+                old_status, scope=scope, slice_index=slice_idx, topo=topo,
+                escalated=why is not None,
             )
             return
 
@@ -1276,6 +1370,97 @@ class JobController:
         except ValueError:
             return -1
 
+    # --------------------------------------------- slice restart domains
+    def _pod_slice_index(
+        self, job: JobObject, topo: SliceTopology,
+        replicas: Dict[str, ReplicaSpec], pod: Pod,
+    ) -> Optional[int]:
+        """Slice domain of one pod (labels -> hooks.replica_slice_index),
+        or None for pods without parseable replica identity."""
+        rt = pod.metadata.labels.get(constants.LABEL_REPLICA_TYPE, "")
+        rtype = next((r for r in replicas if r.lower() == rt), None)
+        index = self._replica_index(pod)
+        if rtype is None or index < 0:
+            return None
+        return self.hooks.replica_slice_index(job, topo, replicas, rtype, index)
+
+    def _impaired_slices(
+        self, job: JobObject, topo: SliceTopology,
+        replicas: Dict[str, ReplicaSpec], pods: List[Pod],
+        world_types: set,
+    ) -> set:
+        """Slices that cannot currently field their full world membership:
+        an in-range world pod Failed or Terminating, or fewer live world
+        pods than hosts_per_slice (mid-teardown, awaiting recreation).
+        This is the quorum rule's 'within the restart window' predicate —
+        a slice whose counted teardown ran stays impaired until its
+        recreated pods exist again."""
+        live: Dict[int, int] = {}
+        impaired: set = set()
+        for rtype, spec in replicas.items():
+            if rtype.lower() not in world_types:
+                continue
+            num_replicas = spec.replicas or 0
+            for pod in filter_pods_for_replica_type(pods, rtype):
+                index = self._replica_index(pod)
+                if index < 0 or index >= num_replicas:
+                    continue
+                s = self.hooks.replica_slice_index(
+                    job, topo, replicas, rtype, index
+                )
+                if (
+                    pod.status.phase == POD_FAILED
+                    or pod.metadata.deletion_timestamp is not None
+                ):
+                    impaired.add(s)
+                else:
+                    live[s] = live.get(s, 0) + 1
+        for s in range(topo.num_slices):
+            if live.get(s, 0) < topo.hosts_per_slice:
+                impaired.add(s)
+        return impaired
+
+    def _slice_restart_scope(
+        self, job: JobObject, replicas: Dict[str, ReplicaSpec],
+        pods: List[Pod], trigger: Pod, world_types: set,
+    ) -> Tuple[Optional[SliceTopology], str, Optional[int], Optional[str]]:
+        """Restart-domain verdict for one gang-restart trigger:
+        (topo, scope, slice index, escalation detail). scope is "world"
+        for flat jobs (topo None / single slice) and for escalations —
+        losing the coordinator slice, or the healthy-slice count dropping
+        below spec.minSlices within the restart window — where the
+        detail string says why; "slice" confines the counted teardown to
+        the trigger's slice. Deterministic: a pure function of (spec,
+        pod states), so a crash-resume sync recomputes the identical
+        verdict from the re-detected trigger."""
+        topo = self.hooks.slice_topology(job, replicas)
+        if topo is None or topo.num_slices <= 1 or not world_types:
+            return None, "world", None, None
+        slice_idx = self._pod_slice_index(job, topo, replicas, trigger)
+        if slice_idx is None:
+            # Unattributable trigger (unparseable replica identity): the
+            # safe scope is the whole world, but it is a PLAIN world
+            # restart — labeling it SliceQuorumLost would page for a
+            # coordinator/quorum loss that never happened.
+            return topo, "world", None, None
+        if slice_idx == topo.coordinator_slice:
+            return topo, "world", slice_idx, (
+                f"slice {slice_idx} hosts the worker-0 coordinator every "
+                "other slice re-rendezvouses through"
+            )
+        if topo.min_slices is not None:
+            impaired = self._impaired_slices(
+                job, topo, replicas, pods, world_types
+            )
+            impaired.add(slice_idx)
+            healthy = topo.num_slices - len(impaired)
+            if healthy < topo.min_slices:
+                return topo, "world", slice_idx, (
+                    f"only {healthy} of {topo.num_slices} slices healthy, "
+                    f"below the minSlices quorum ({topo.min_slices})"
+                )
+        return topo, "slice", slice_idx, None
+
     # -------------------------------------------------------- gang liveness
     def _check_liveness(
         self, job: JobObject, replicas: Dict[str, ReplicaSpec], run_policy,
@@ -1462,6 +1647,7 @@ class JobController:
             rt.lower() for rt in replicas
             if self.hooks.restart_peers_on_failure(rt)
         }
+        scope, slice_idx, topo, escalated = "world", None, None, False
         if world_types and stalled_pod.metadata.labels.get(
             constants.LABEL_REPLICA_TYPE
         ) in world_types:
@@ -1470,25 +1656,60 @@ class JobController:
                 if p.metadata.labels.get(constants.LABEL_REPLICA_TYPE)
                 in world_types
             ]
+            # Slice-scoped stall domains, same rules as the failure path:
+            # a wedged collective only holds ITS slice's peers hostage
+            # (per-slice ICI mesh), so the stall restart confines to the
+            # stalled replica's slice unless the coordinator slice or
+            # the minSlices quorum escalates it.
+            topo, scope, slice_idx, esc_why = self._slice_restart_scope(
+                job, replicas, pods, stalled_pod, world_types
+            )
+            escalated = esc_why is not None
+            if scope == "slice":
+                targets = [
+                    p for p in targets
+                    if self._pod_slice_index(job, topo, replicas, p)
+                    == slice_idx
+                ]
         else:
             targets = [stalled_pod]
-        reason = constants.job_reason(
-            self.hooks.kind, constants.REASON_STALL_RESTARTING
-        )
-        msg = (
-            f"{self.hooks.kind} {job.name} is restarting "
-            f"{'the whole gang' if len(targets) > 1 else 'a stalled replica'}"
-            f": {why}."
-        )
+        if scope == "slice":
+            reason = constants.job_reason(
+                self.hooks.kind, constants.REASON_SLICE_STALL_RESTARTING
+            )
+            msg = (
+                f"{self.hooks.kind} {job.name} is restarting stalled slice "
+                f"{slice_idx}: {why}."
+            )
+        elif escalated:
+            reason = constants.job_reason(
+                self.hooks.kind, constants.REASON_SLICE_QUORUM_LOST
+            )
+            msg = (
+                f"{self.hooks.kind} {job.name} is restarting the whole "
+                f"world for a stall in slice {slice_idx}: {why}."
+            )
+        else:
+            reason = constants.job_reason(
+                self.hooks.kind, constants.REASON_STALL_RESTARTING
+            )
+            msg = (
+                f"{self.hooks.kind} {job.name} is restarting "
+                f"{'the whole gang' if len(targets) > 1 else 'a stalled replica'}"
+                f": {why}."
+            )
         self._restart_gang_counted(
             job, pods, targets, stalled_pod, rtype, capi.RESTART_CAUSE_STALL,
-            reason, msg, old_status,
+            reason, msg, old_status, scope=scope, slice_index=slice_idx,
+            topo=topo, escalated=escalated,
         )
 
     def _restart_gang_counted(
         self, job: JobObject, pods: List[Pod], targets: List[Pod],
         trigger: Pod, rtype: str, cause: str, reason: str, msg: str,
-        old_status: JobStatus,
+        old_status: JobStatus, scope: str = "world",
+        slice_index: Optional[int] = None,
+        topo: Optional[SliceTopology] = None, escalated: bool = False,
     ) -> List[tuple]:
         """The count-before-teardown protocol, single-sourced for the
         gang-failure, stall, and admission-preemption restart paths.
@@ -1527,24 +1748,46 @@ class JobController:
         # counted status write precedes every teardown delete in span
         # order.
         counted = trigger.metadata.uid not in handled
-        with self.tracer.span("gang.restart", attrs={
+        attrs = {
             "cause": cause, "rtype": rtype,
             "trigger": trigger.metadata.name, "targets": len(targets),
-            "counted": counted,
-        }):
+            "counted": counted, "scope": scope,
+        }
+        if slice_index is not None:
+            attrs["slice"] = slice_index
+        if escalated:
+            attrs["escalated"] = True
+        if scope == "slice" and topo is not None:
+            # The slice-scope audit's self-contained evidence
+            # (testing/invariants.py check_span_invariants): the exact
+            # target set plus the slice geometry, so a trace alone can
+            # prove the teardown never reached outside the slice.
+            attrs["hosts_per_slice"] = topo.hosts_per_slice
+            attrs["target_names"] = ",".join(
+                sorted(p.metadata.name for p in targets)
+            )
+        with self.tracer.span("gang.restart", attrs=attrs):
             return self._restart_gang_counted_traced(
                 job, pods, targets, trigger, rtype, cause, reason, msg,
                 old_status, key, handled, counted,
+                scope=scope, slice_index=slice_index,
             )
 
     def _restart_gang_counted_traced(
         self, job: JobObject, pods: List[Pod], targets: List[Pod],
         trigger: Pod, rtype: str, cause: str, reason: str, msg: str,
         old_status: JobStatus, key: str, handled: set, counted: bool,
+        scope: str = "world", slice_index: Optional[int] = None,
     ) -> List[tuple]:
         job.status._restarting_this_sync = True
         if counted:
             present = {p.metadata.uid for p in pods}
+            # Slice-scoped stamping: the stamp covers exactly the TARGET
+            # set, merged with still-present previously-handled uids — so
+            # a slice-2 restart (or its crash-resume) never stamps a
+            # concurrently-failed slice-5 pod, whose own failure must be
+            # counted by its own slice's restart. (The flat model stamped
+            # every world pod, which hid exactly that suppression.)
             job.status.gang_handled_uids = sorted(
                 (handled & present) | {p.metadata.uid for p in targets}
             )
@@ -1552,6 +1795,14 @@ class JobController:
                 job.status, capi.JOB_RESTARTING, reason, msg, now=self.clock()
             )
             self._count_restart(job, rtype, cause)
+            if scope == "slice" and slice_index is not None:
+                # Per-slice attribution (status.sliceRestartCounts): made
+                # durable by the same phase-1 write as the cause ledger,
+                # so the two can never disagree across a crash.
+                slot = str(slice_index)
+                job.status.slice_restart_counts[slot] = (
+                    job.status.slice_restart_counts.get(slot, 0) + 1
+                )
             try:
                 self._write_status_if_changed(job, old_status)
             except Exception:  # noqa: BLE001 — conflict/transient write error
@@ -1569,6 +1820,7 @@ class JobController:
                 ),
             )
             self.on_job_restarting(job, rtype, cause)
+            self.on_gang_restart(job, scope, slice_index, cause)
             old_status = copy.deepcopy(job.status)
         delete_errors = self._teardown_gang_pods(job, targets, trigger)
         if delete_errors:
@@ -2013,6 +2265,20 @@ class JobController:
 
             update_job_replica_statuses(job_status, rtype, pod)
 
+        admitted_slices = getattr(job_status, "_admitted_slices", None)
+        if to_create and admitted_slices is not None:
+            # Slice-granular admission: only admitted slices' indices may
+            # be born — a queued slice's pods stay unborn (the no-partial-
+            # gang rule, applied per slice), while its admitted siblings
+            # create normally.
+            topo = self.hooks.slice_topology(job, replicas)
+            if topo is not None:
+                to_create = [
+                    index for index in to_create
+                    if self.hooks.replica_slice_index(
+                        job, topo, replicas, rtype, index
+                    ) in admitted_slices
+                ]
         if to_create:
             self._create_pods_batch(job, rtype, to_create, spec, replicas)
 
@@ -2535,6 +2801,22 @@ class JobController:
         key = job.key()
         item = f"{job.kind}:{key}"
 
+        # Slice-granular admission (flagged, --admission-slice-granularity):
+        # a multislice job's slices are individually admittable,
+        # preemptable and backfillable demands — a capacity revocation
+        # preempts one slice (slice-local counted teardown, slice-local
+        # re-queue) instead of evicting the job.
+        topo = self.hooks.slice_topology(job, replicas)
+        if getattr(adm, "slice_granular", False):
+            if topo is not None and topo.num_slices > 1:
+                return self._admission_gate_sliced(
+                    job, replicas, run_policy, pods, old_status, topo
+                )
+            # Granularity transition (elastic resize to a single slice):
+            # stale '#slice-' registrations from the sliced gate must
+            # not keep double-charging the pool beside this flat one.
+            adm.release_stale_granularity(item, sliced=False)
+
         cause = adm.preemption_requested(item)
         if cause is not None:
             live = [p for p in pods if p.metadata.deletion_timestamp is None]
@@ -2661,6 +2943,205 @@ class JobController:
         self._write_status_if_changed(job, old_status)
         self.requeue(item, 1.0)
         return False
+
+    def _admission_gate_sliced(
+        self, job: JobObject, replicas: Dict[str, ReplicaSpec], run_policy,
+        pods: List[Pod], old_status: JobStatus, topo: SliceTopology,
+    ) -> bool:
+        """The per-SLICE admission decision (flagged headroom over the
+        PR 9 arbiter): each slice of a multislice job registers its own
+        demand under "<item>#slice-<s>" — hooks.gang_groups already
+        emits one PodGroup per slice, so slice s's demand is exactly
+        group s's. Verdicts compose per slice:
+
+        - a slice with a pending preemption runs the SLICE-SCOPED counted
+          disruption teardown (surviving slices' pods never deleted) and
+          is acknowledged to the arbiter only once the counted write is
+          durable and the teardown complete — then it re-queues at the
+          head of its band, slice-local;
+        - admitted slices proceed to pod work; reconcile_pods creates
+          only their indices (status._admitted_slices);
+        - queued slices hold their pods unborn. Zero admitted slices is
+          the whole-job queued path (JOB_QUEUED condition, sync ends);
+          a partial admission proceeds with a fallback requeue polling
+          for the waiting slices.
+
+        Release paths (terminal/suspend/delete) free every slice at once:
+        AdmissionController.release treats "#slice-" sub-keys of the job
+        key as part of it."""
+        from .admission import gang_demand
+
+        adm = self._admission
+        key = job.key()
+        item = f"{job.kind}:{key}"
+        # Granularity transition (resize 1 -> N slices): a stale
+        # plain-key registration from the flat gate must not linger
+        # beside the per-slice ones.
+        adm.release_stale_granularity(item, sliced=True)
+        sp = run_policy.scheduling_policy
+        groups = self.hooks.gang_groups(job, replicas, run_policy)
+        world_types = {
+            rt.lower() for rt in replicas
+            if self.hooks.restart_peers_on_failure(rt)
+        }
+
+        pods_by_slice: Dict[int, List[Pod]] = {}
+        for pod in pods:
+            s = self._pod_slice_index(job, topo, replicas, pod)
+            if s is not None:
+                pods_by_slice.setdefault(s, []).append(pod)
+
+        # Pending slice preemptions first — ONE counted slice teardown per
+        # sync (its requeue resumes any others, exactly like the gang
+        # teardown's own retry protocol).
+        for s in range(len(groups)):
+            skey = f"{item}#slice-{s}"
+            cause = adm.preemption_requested(skey)
+            if cause is None:
+                continue
+            live = [
+                p for p in pods_by_slice.get(s, ())
+                if p.metadata.deletion_timestamp is None
+                and p.metadata.labels.get(constants.LABEL_REPLICA_TYPE)
+                in world_types
+            ]
+            if live:
+                trigger = max(live, key=lambda p: p.metadata.name)
+                trigger_rt = trigger.metadata.labels.get(
+                    constants.LABEL_REPLICA_TYPE, ""
+                )
+                rtype = next(
+                    (rt for rt in replicas if rt.lower() == trigger_rt),
+                    next(iter(replicas), ""),
+                )
+                reason = constants.job_reason(
+                    self.hooks.kind, constants.REASON_GANG_PREEMPTED
+                )
+                msg = (
+                    f"{self.hooks.kind} {job.name} slice {s} is preempted "
+                    f"by gang admission ({cause}): the slice releases its "
+                    "capacity and re-queues at the head of its priority "
+                    "band; surviving slices keep running."
+                )
+                errors = self._restart_gang_counted(
+                    job, pods, live, trigger, rtype,
+                    capi.RESTART_CAUSE_DISRUPTION, reason, msg, old_status,
+                    scope="slice", slice_index=s, topo=topo,
+                )
+                if not errors and trigger.metadata.uid in (
+                    job.status.gang_handled_uids or ()
+                ):
+                    # Same ack rule as the flat gate: counted write
+                    # durable AND teardown complete, else the pending
+                    # marker keeps the slice's capacity charged.
+                    adm.note_preempted(skey, job.metadata.uid, cause)
+                return False
+            adm.note_preempted(skey, job.metadata.uid, cause)
+
+        admitted: set = set()
+        blocked: List[tuple] = []
+        for s, group in enumerate(groups):
+            skey = f"{item}#slice-{s}"
+            gspec = group.get("spec") or {}
+            result = adm.try_admit(
+                key=skey, kind=job.kind, namespace=job.namespace,
+                name=f"{job.name}#slice-{s}", uid=job.metadata.uid,
+                priority_class=(
+                    sp.priority_class if sp is not None else ""
+                ) or "",
+                demand=gang_demand([group]),
+                members=int(gspec.get("minMember") or 0),
+                has_pods=any(
+                    p.metadata.deletion_timestamp is None
+                    for p in pods_by_slice.get(s, ())
+                ),
+                kick=lambda item=item: self.requeue(item, 0.0),
+                # Victim preference: evict higher slices first so the
+                # coordinator slice (0) is only ever chosen once no
+                # other slice in the band remains — the admission-side
+                # mirror of the coordinator-escalation rule.
+                victim_rank=s,
+            )
+            if result.admitted:
+                admitted.add(s)
+                # Announce on the measured wait, not the JOB_QUEUED
+                # condition: under partial admission the job may carry
+                # Running (a sibling slice) while THIS slice waited out
+                # its whole queue time — the aging/starvation telemetry
+                # must still see that wait.
+                if result.newly_admitted and result.waited > 0.0:
+                    self.tracer.record_span(
+                        "admission.queue", duration=result.waited,
+                        attrs={"wait": round(result.waited, 3), "slice": s},
+                    )
+                    record_event_best_effort(
+                        self.cluster,
+                        Event(
+                            type="Normal",
+                            reason=constants.job_reason(
+                                job.kind, constants.REASON_GANG_ADMITTED
+                            ),
+                            message=(
+                                f"{self.hooks.kind} {job.name} slice {s} "
+                                f"was admitted after waiting "
+                                f"{result.waited:.1f}s for capacity."
+                            ),
+                            involved_object=f"{job.kind}/{key}",
+                        ),
+                    )
+            else:
+                blocked.append((s, result))
+                if result.newly_queued:
+                    record_event_best_effort(
+                        self.cluster,
+                        Event(
+                            type="Normal",
+                            reason=constants.job_reason(
+                                job.kind, constants.REASON_QUEUED
+                            ),
+                            message=(
+                                f"{self.hooks.kind} {job.name} slice {s} is "
+                                f"queued by gang admission (blocked on "
+                                f"{result.blocked_on or 'capacity'})."
+                            ),
+                            involved_object=f"{job.kind}/{key}",
+                        ),
+                    )
+
+        if not admitted:
+            names = ", ".join(
+                sorted(
+                    (g.get("metadata") or {}).get("name", "") for g in groups
+                )
+            )
+            blocked_on = ", ".join(
+                sorted({r.blocked_on or "capacity" for _, r in blocked})
+            )
+            capi.update_job_conditions(
+                job.status,
+                capi.JOB_QUEUED,
+                constants.job_reason(job.kind, constants.REASON_QUEUED),
+                f"gang admission: waiting on {blocked_on or 'capacity'}"
+                f" ({names})",
+                now=self.clock(),
+            )
+            self._set_group_phases(job, groups, "Inqueue")
+            self._write_status_if_changed(job, old_status)
+            self.requeue(item, 1.0)
+            return False
+
+        job.status._admitted_slices = admitted
+        self._set_group_phases(
+            job, [groups[s] for s in sorted(admitted)], "Running"
+        )
+        if blocked:
+            self._set_group_phases(
+                job, [groups[s] for s, _ in blocked], "Inqueue"
+            )
+            # Fallback poll for the waiting slices (admission kicks are
+            # the fast path, this keeps the verdict fresh if one is lost).
+            self.requeue(item, 1.0)
+        return True
 
     def _set_group_phases(self, job: JobObject, groups: List[dict],
                           phase: str) -> None:

@@ -150,6 +150,25 @@ class Metrics:
             "Each increment is exactly one counted disruption restart — "
             "the preempted job re-queued at the head of its band",
         ),
+        "training_operator_gang_restarts_total": (
+            ("job_namespace", "framework", "scope", "cause"),
+            "Counted gang restarts by restart-domain scope "
+            "(core/job_controller.py slice-scoped failure domains): "
+            "scope=slice is a restart confined to one slice of a "
+            "multislice job (surviving slices untouched); scope=world is "
+            "a whole-world restart — single-slice jobs, coordinator-"
+            "slice loss, or a minSlices quorum escalation. Each "
+            "increment is exactly one counted restart in the cause's "
+            "ledger",
+        ),
+        "training_operator_slice_restarts_total": (
+            ("job_namespace", "framework", "slice"),
+            "Slice-scoped counted restarts by SLICE INDEX (mirrors "
+            "status.sliceRestartCounts). A sustained rate on one slice "
+            "index across a fleet is slice-restart flapping — a bad "
+            "host/link inside that slice's ICI domain restarting the "
+            "same slice over and over without ever escalating",
+        ),
         "training_operator_quota_denials_total": (
             ("job_namespace",),
             "Admission attempts a namespace quota blocked "
@@ -358,6 +377,23 @@ class Metrics:
             self._histograms[
                 "training_operator_status_write_flush_latency_seconds"
             ][(namespace, framework)].observe(seconds)
+
+    def gang_restart_inc(self, namespace: str, framework: str, scope: str,
+                         cause: str) -> None:
+        """One counted gang restart, labeled with its restart-domain
+        scope (slice|world) beside the existing cause breakdown."""
+        self._inc_labeled(
+            "training_operator_gang_restarts_total",
+            namespace, framework, scope, cause,
+        )
+
+    def slice_restart_inc(self, namespace: str, framework: str,
+                          slice_index: str) -> None:
+        """One slice-scoped counted restart, labeled by slice index."""
+        self._inc_labeled(
+            "training_operator_slice_restarts_total",
+            namespace, framework, slice_index,
+        )
 
     def gang_preemption_inc(self, cause: str, band: str) -> None:
         """One gang preempted by the admission layer (exactly one counted

@@ -126,7 +126,12 @@ def constrain(x, *axes):
     mesh = current_mesh()
     if mesh is None:
         return x
-    abstract = jax.sharding.get_abstract_mesh()
+    # jax 0.4.x compat: get_abstract_mesh (and AxisType) first appeared in
+    # 0.5 — on older jax there is no manual-axis trace state to consult,
+    # so the constraint applies unconditionally (shard_map regions there
+    # use the explicit in-spec plumbing instead).
+    get_abstract = getattr(jax.sharding, "get_abstract_mesh", None)
+    abstract = get_abstract() if get_abstract is not None else None
     if abstract is not None and abstract.shape:
         manual = {
             name

@@ -31,6 +31,16 @@ from ..bootstrap import jaxdist
 
 _initialized = False
 
+# Workload-side opt-in (template env, NOT operator-injected): form one
+# jax.distributed world PER SLICE instead of one global world. Each
+# slice's processes rendezvous among themselves — coordinator = the
+# slice's first host (TPU_WORKER_HOSTNAMES is already slice-local),
+# process id = TPU_WORKER_ID — so slices train as independent worlds
+# (DiLoCo-style loosely-coupled replicas, or the CPU e2e stand-in for
+# megascale's DCN layer: a slice-local gang restart re-rendezvouses
+# only the lost slice while the surviving slices' worlds keep running).
+ENV_SLICE_LOCAL_WORLD = "JAX_SLICE_LOCAL_WORLD"
+
 
 def _force_declared_platform() -> None:
     """Make an explicit JAX_PLATFORMS env choice stick.
@@ -62,6 +72,10 @@ class Topology:
     num_slices: int = 1
     slice_index: int = 0
     mesh_axes: Dict[str, int] = field(default_factory=dict)
+    # True when JAX_SLICE_LOCAL_WORLD remapped this topology to a
+    # per-slice world: num_processes/process_id/coordinator_address are
+    # slice-scoped, and the mesh gets no DCN `slice` axis.
+    slice_world: bool = False
 
     @property
     def distributed(self) -> bool:
@@ -96,17 +110,48 @@ def topology_from_env(env: Optional[Dict[str, str]] = None) -> Topology:
     hostnames = tuple(
         h for h in env.get(jaxdist.ENV_TPU_WORKER_HOSTNAMES, "").split(",") if h
     )
+    coordinator = env.get(jaxdist.ENV_COORDINATOR_ADDRESS) or None
+    num_processes = _int(jaxdist.ENV_NUM_PROCESSES, 1)
+    process_id = _int(jaxdist.ENV_PROCESS_ID, 0)
+    worker_id = _int(jaxdist.ENV_TPU_WORKER_ID, 0)
+    num_slices = _int(jaxdist.ENV_NUM_SLICES, 1)
+    slice_world = (
+        str(env.get(ENV_SLICE_LOCAL_WORLD, "")).lower() in ("1", "true", "yes")
+        and num_slices > 1
+        and bool(hostnames)
+        and coordinator is not None
+    )
+    if slice_world:
+        # Per-slice world: this slice's processes rendezvous among
+        # themselves. TPU_WORKER_HOSTNAMES already lists exactly the
+        # slice's hosts in rank order, so the slice coordinator is its
+        # first entry, and the in-slice process id is the libtpu worker
+        # ordinal. The port is offset by the slice index: jax's
+        # coordinator service binds ALL interfaces, so N slice
+        # coordinators sharing one dev host (the CPU e2e tier) would
+        # otherwise contend for one port and cross-wire the worlds'
+        # barriers; on a real fleet each coordinator has its own host
+        # and the offset is merely unused port space.
+        slice_index = _int(jaxdist.ENV_SLICE_INDEX, 0)
+        try:
+            port = int(coordinator.rsplit(":", 1)[-1]) + slice_index
+        except ValueError:
+            port = coordinator.rsplit(":", 1)[-1]
+        coordinator = f"{hostnames[0]}:{port}"
+        num_processes = len(hostnames)
+        process_id = worker_id
     return Topology(
-        coordinator_address=env.get(jaxdist.ENV_COORDINATOR_ADDRESS) or None,
-        num_processes=_int(jaxdist.ENV_NUM_PROCESSES, 1),
-        process_id=_int(jaxdist.ENV_PROCESS_ID, 0),
-        worker_id=_int(jaxdist.ENV_TPU_WORKER_ID, 0),
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        worker_id=worker_id,
         worker_hostnames=hostnames,
         accelerator_type=env.get(jaxdist.ENV_TPU_ACCELERATOR_TYPE, ""),
         tpu_topology=env.get(jaxdist.ENV_TPU_TOPOLOGY, ""),
-        num_slices=_int(jaxdist.ENV_NUM_SLICES, 1),
+        num_slices=num_slices,
         slice_index=_int(jaxdist.ENV_SLICE_INDEX, 0),
         mesh_axes=mesh_axes,
+        slice_world=slice_world,
     )
 
 
@@ -178,7 +223,9 @@ def global_mesh(topology: Optional[Topology] = None):
     topo = topology or topology_from_env()
     n = jax.device_count()
     axes = dict(topo.mesh_axes)
-    if topo.num_slices > 1 and "slice" not in axes:
+    # A slice-local world never gets the DCN axis: its devices are ONE
+    # slice's, and a declared global mesh falls back via the size check.
+    if topo.num_slices > 1 and not topo.slice_world and "slice" not in axes:
         axes["slice"] = topo.num_slices
     if not axes:
         return standard_mesh(n)

@@ -172,7 +172,24 @@ class JobClient:
         ).get("replicas")
         old_slices = spec.get("numSlices") or 1
         patch: dict = {"spec": {"numSlices": num_slices}}
-        if replicas is not None and replicas % max(1, old_slices) == 0:
+        if replicas is not None:
+            if replicas % max(1, old_slices) != 0:
+                # A stored Worker count that is not slice-divisible means
+                # hosts-per-slice is unknowable — silently skipping the
+                # replicas patch (the old behavior) shipped a numSlices
+                # that disagreed with the worker count and either failed
+                # validation server-side or, worse, re-split the same
+                # workers over a different slice count. Refuse with a
+                # typed error BEFORE anything reaches the store.
+                from ..api.defaulting import ValidationError
+
+                raise ValidationError(
+                    f"JAXJob {namespace}/{name} has {replicas} workers "
+                    f"over {old_slices} slice(s) — not slice-divisible, "
+                    "so scale() cannot derive hosts-per-slice; fix the "
+                    "stored spec (workers must be a multiple of "
+                    "numSlices) before resizing"
+                )
             per_slice = replicas // max(1, old_slices)
             patch["spec"]["jaxReplicaSpecs"] = {
                 "Worker": {"replicas": per_slice * num_slices}

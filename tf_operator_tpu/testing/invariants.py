@@ -44,7 +44,13 @@ from ..core import constants
 # Condition pairs that may never be simultaneously True.
 _EXCLUSIVE = (("Succeeded", "Failed"), ("Running", "Restarting"))
 
-_LEDGERS = ("restartCounts", "disruptionCounts", "stallCounts")
+_LEDGERS = (
+    "restartCounts", "disruptionCounts", "stallCounts",
+    # Per-slice restart attribution (slice-scoped failure domains): purely
+    # attributive — no budget draws from it — but still exactly-once, so
+    # tests pin it with expect_ledgers like the cause ledgers.
+    "sliceRestartCounts",
+)
 
 
 def _conditions(status: dict) -> List[dict]:
@@ -229,11 +235,27 @@ def check_span_invariants(traces: Sequence[dict]) -> List[str]:
                 if c.get("name") == "api.delete"
                 and (c.get("attrs") or {}).get("resource") == "pods"
             ]
+            where = f"{trace.get('trace_id')}: gang.restart span {span.get('id')}"
+            # Slice-scope audit (slice-scoped failure domains): an
+            # escalation (coordinator/quorum loss) may never record a
+            # slice-scoped span, and a slice-scoped span's teardown may
+            # only target ITS slice's pods — checked from the span's own
+            # target_names/slice/hosts_per_slice attrs, so the trace
+            # alone proves the teardown never crossed a domain boundary.
+            if attrs.get("escalated") and attrs.get("scope") == "slice":
+                violations.append(
+                    f"{where}: escalated (quorum/coordinator loss) but "
+                    "scope is 'slice' — an escalation must restart the "
+                    "whole world"
+                )
+            if attrs.get("scope") == "slice":
+                violations.extend(
+                    _check_slice_targets(where, attrs, len(deletes))
+                )
             if not attrs.get("counted") or not deletes:
                 # Resume span (count already durable), or phase 1 aborted
                 # before anything died — nothing to order.
                 continue
-            where = f"{trace.get('trace_id')}: gang.restart span {span.get('id')}"
             if not status_writes:
                 violations.append(
                     f"{where} deleted {len(deletes)} pod(s) with no "
@@ -247,6 +269,69 @@ def check_span_invariants(traces: Sequence[dict]) -> List[str]:
                     f"{min(status_writes)})"
                 )
     return violations
+
+
+def _check_slice_targets(where: str, attrs: dict, deletes: int) -> List[str]:
+    """Target-set half of the slice-scope audit: every pod the slice
+    restart declares as a teardown target must live inside the span's
+    slice (replica index in [slice*h, (slice+1)*h)), and the span may
+    not issue more pod deletes than it declared targets — together, a
+    counted slice restart provably never deletes a surviving slice's
+    pod."""
+    violations: List[str] = []
+    slice_index = attrs.get("slice")
+    hosts = attrs.get("hosts_per_slice")
+    names = [n for n in str(attrs.get("target_names") or "").split(",") if n]
+    if slice_index is None or not hosts:
+        violations.append(
+            f"{where}: slice-scoped span missing slice/hosts_per_slice "
+            "attrs (the audit has nothing to check against)"
+        )
+        return violations
+    lo, hi = slice_index * hosts, (slice_index + 1) * hosts
+    for name in names:
+        tail = name.rsplit("-", 1)[-1]
+        if not tail.isdigit():
+            violations.append(
+                f"{where}: target {name!r} has no parseable replica index"
+            )
+            continue
+        index = int(tail)
+        if not lo <= index < hi:
+            violations.append(
+                f"{where}: slice-{slice_index} restart targets {name!r} "
+                f"(index {index} outside [{lo}, {hi})) — the teardown "
+                "crossed a slice boundary"
+            )
+    if deletes > len(names):
+        violations.append(
+            f"{where}: slice restart issued {deletes} pod delete(s) for "
+            f"{len(names)} declared target(s) — an undeclared pod died "
+            "inside the slice teardown span"
+        )
+    return violations
+
+
+def count_gang_restarts(
+    traces: Sequence[dict], scope: Optional[str] = None,
+    counted_only: bool = True,
+) -> int:
+    """Counted gang.restart spans across an export, optionally filtered
+    by restart-domain scope ('slice' | 'world') — the trace-side tally a
+    scenario pins against its ledger expectation (e.g. quorum escalation
+    produces exactly ONE counted world-restart span)."""
+    total = 0
+    for trace in traces:
+        for span in trace.get("spans") or []:
+            if span.get("name") != "gang.restart":
+                continue
+            attrs = span.get("attrs") or {}
+            if counted_only and not attrs.get("counted"):
+                continue
+            if scope is not None and attrs.get("scope") != scope:
+                continue
+            total += 1
+    return total
 
 
 def check_admission_invariants(
@@ -344,14 +429,19 @@ def check_admission_invariants(
             ns, _, name = rest.partition("/")
             if not name:
                 continue
+            # Slice-granular keys ("<ns>/<name>#slice-<s>"): the waiting
+            # unit is ONE slice, so only that slice's pods (stamped with
+            # the tpu-slice-index label) count — its admitted sibling
+            # slices legitimately own live pods.
+            name, _, slice_suffix = name.partition("#slice-")
+            selector = {
+                constants.LABEL_GROUP_NAME: constants.GROUP_NAME,
+                constants.LABEL_JOB_NAME: name,
+            }
+            if slice_suffix:
+                selector[constants.LABEL_SLICE_INDEX] = slice_suffix
             live = [
-                p for p in cluster.list_pods(
-                    namespace=ns,
-                    labels={
-                        constants.LABEL_GROUP_NAME: constants.GROUP_NAME,
-                        constants.LABEL_JOB_NAME: name,
-                    },
-                )
+                p for p in cluster.list_pods(namespace=ns, labels=selector)
                 if p.metadata.deletion_timestamp is None
             ]
             if live:
