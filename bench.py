@@ -35,12 +35,44 @@ PEAK_TFLOPS = {
 }
 
 
-def peak_tflops_for(device) -> float:
+def peak_tflops_for(device):
+    """(peak bf16 TFLOP/s, assumed-chip name or None).
+
+    Unknown device kinds score against an ASSUMED chip (v5e for TPUs, the
+    1.0 cpu token otherwise) — returned as the second element and stamped
+    into the per-config extra by the callers, with a stderr warning, so an
+    MFU computed on new hardware is never silently wrong-looking-right."""
     kind = getattr(device, "device_kind", "cpu").lower()
     for key, val in PEAK_TFLOPS.items():
         if kind.startswith(key):
-            return val
-    return 197.0 if device.platform == "tpu" else 1.0
+            return val, None
+    assumed = "tpu v5 lite" if device.platform == "tpu" else "cpu"
+    print(
+        f"bench: WARNING unknown device kind {kind!r} "
+        f"(platform={device.platform}) — MFU scored against assumed "
+        f"{assumed!r} peak {PEAK_TFLOPS[assumed]} TFLOP/s; add the chip to "
+        "PEAK_TFLOPS for a real number",
+        file=sys.stderr,
+    )
+    return PEAK_TFLOPS[assumed], assumed
+
+
+def _device_batches(host_iter, data_sharding):
+    """Host batches -> device arrays, overlapped by default: a
+    DevicePrefetch double buffer issues batch k+1's transfer while step k
+    runs, so the one host->device copy per step (a network round trip on
+    remote-relay PJRT backends) leaves the critical path.
+    TF_OPERATOR_BENCH_OVERLAP=0 restores the in-line device_put (the
+    overlap-off A/B lever; loss sequences are byte-identical either way —
+    tests/test_train_pipeline.py)."""
+    import jax
+
+    if os.environ.get("TF_OPERATOR_BENCH_OVERLAP", "1") != "0":
+        from tf_operator_tpu.train.data import DevicePrefetch
+
+        return DevicePrefetch(host_iter, data_sharding, depth=2)
+    it = iter(host_iter)
+    return (jax.device_put(next(it), data_sharding) for _ in iter(int, 1))
 
 
 def _timed_steps(step_fn, state, batches, steps):
@@ -77,13 +109,23 @@ def bench_llama(config_name, batch, seq, steps, warmup, mesh, devices,
     layers_env = os.environ.get("TF_OPERATOR_BENCH_LAYERS")
     if layers_env:
         config = type(config)(**{**config.__dict__, "n_layers": int(layers_env)})
+    # Remat sweep knob: override the config's measured default policy
+    # (models/llama.py REMAT_SAVEABLE vocabulary) without a code edit;
+    # recorded in the per-config extra so a sweep's JSON is self-describing.
+    remat_env = os.environ.get("TF_OPERATOR_REMAT_POLICY")
+    if remat_env:
+        config = type(config)(**{**config.__dict__, "remat_policy": remat_env})
     model = llama.Llama(config)
     optimizer = make_optimizer(warmup_steps=10, decay_steps=1000)
     # Born-sharded init: a 7B state never exists unsharded on one chip.
     state, sharding = init_sharded_train_state(
         model, jax.random.PRNGKey(0), optimizer, mesh, batch=1, seq=min(seq, 128)
     )
-    step_fn, _ = make_train_step(model, optimizer, mesh, state, sharding=sharding)
+    # Batch donated: with the prefetch stage each batch is a fresh device
+    # buffer, so the step recycles the previous one's HBM in place.
+    step_fn, _ = make_train_step(
+        model, optimizer, mesh, state, sharding=sharding, donate_batch=True
+    )
 
     data_sharding = batch_sharding(mesh, with_sp=False)
     if loader_path is not None:
@@ -93,8 +135,7 @@ def bench_llama(config_name, batch, seq, steps, warmup, mesh, devices,
         data = SyntheticTokens(batch, seq, config.vocab_size)
         native = None
 
-    it = iter(data)
-    batches = (jax.device_put(next(it), data_sharding) for _ in iter(int, 1))
+    batches = _device_batches(data, data_sharding)
     for _ in range(max(warmup, 1)):
         state, loss = step_fn(state, next(batches))
     float(loss)
@@ -103,7 +144,8 @@ def bench_llama(config_name, batch, seq, steps, warmup, mesh, devices,
     n = len(devices)
     tokens_per_sec = batch * seq * steps / dt
     achieved = tokens_per_sec / n * config.flops_per_token(seq) / 1e12
-    mfu = achieved / peak_tflops_for(devices[0])
+    peak, assumed_chip = peak_tflops_for(devices[0])
+    mfu = achieved / peak
     out = {
         "tokens_per_sec_chip": round(tokens_per_sec / n, 1),
         "mfu": round(mfu, 4),
@@ -113,6 +155,10 @@ def bench_llama(config_name, batch, seq, steps, warmup, mesh, devices,
         "seq": seq,
         "batch": batch,
     }
+    if assumed_chip is not None:
+        out["assumed_chip"] = assumed_chip
+    if remat_env:
+        out["remat_policy"] = remat_env
     if native is not None:
         out["native_loader"] = bool(native)
     return out
@@ -155,7 +201,9 @@ def bench_bert(config_name, batch, seq, steps, warmup, mesh, devices):
 
         return shared_loss(model, params, batch_ids)
 
-    step_fn, sharding = make_train_step_for(loss_fn, optimizer, mesh, state)
+    step_fn, sharding = make_train_step_for(
+        loss_fn, optimizer, mesh, state, donate_batch=True
+    )
     state = jax.tree.map(jax.device_put, state, sharding)
 
     import numpy as np
@@ -163,15 +211,12 @@ def bench_bert(config_name, batch, seq, steps, warmup, mesh, devices):
     rng_np = np.random.default_rng(0)
     data_sharding = batch_sharding(mesh, with_sp=False)
 
-    def batches():
+    def host_batches():
         while True:
-            yield jax.device_put(
-                rng_np.integers(0, config.vocab_size, size=(batch, seq + 1),
-                                dtype=np.int32),
-                data_sharding,
-            )
+            yield rng_np.integers(0, config.vocab_size, size=(batch, seq + 1),
+                                  dtype=np.int32)
 
-    it = batches()
+    it = _device_batches(host_batches(), data_sharding)
     for _ in range(max(warmup, 1)):
         state, loss = step_fn(state, next(it))
     float(loss)
@@ -180,8 +225,9 @@ def bench_bert(config_name, batch, seq, steps, warmup, mesh, devices):
     n = len(devices)
     tokens_per_sec = batch * seq * steps / dt
     achieved = tokens_per_sec / n * config.flops_per_token(seq) / 1e12
-    mfu = achieved / peak_tflops_for(devices[0])
-    return {
+    peak, assumed_chip = peak_tflops_for(devices[0])
+    mfu = achieved / peak
+    out = {
         "tokens_per_sec_chip": round(tokens_per_sec / n, 1),
         "mfu": round(mfu, 4),
         "achieved_tflops_per_chip": round(achieved, 2),
@@ -190,6 +236,65 @@ def bench_bert(config_name, batch, seq, steps, warmup, mesh, devices):
         "seq": seq,
         "batch": batch,
     }
+    if assumed_chip is not None:
+        out["assumed_chip"] = assumed_chip
+    return out
+
+
+def _check_floors(floors_path: str, model: str, headline: dict,
+                  configs: dict, device) -> int:
+    """Compare EVERY measured config (headline under its model name, plus
+    each extra.configs entry) against the committed per-platform floor
+    table (ci/bench_floors.json). Returns 0 on pass, 3 on any violation —
+    a secondary config regressing (or silently vanishing from the suite,
+    or erroring) fails CI, not just the headline.
+
+    Floor keys are device-kind prefixes (same matching as peak_tflops_for),
+    longest first; an unlisted platform passes report-only so new hardware
+    is never red on day one."""
+    try:
+        with open(floors_path) as fh:
+            floors = json.load(fh)
+    except (OSError, ValueError) as exc:
+        print(f"bench --check: cannot read floors {floors_path}: {exc}",
+              file=sys.stderr)
+        return 3
+    kind = getattr(device, "device_kind", "cpu").lower()
+    table = None
+    for key in sorted((k for k in floors if not k.startswith("_")),
+                      key=len, reverse=True):
+        if kind.startswith(key):
+            table = floors[key]
+            break
+    if table is None:
+        print(f"bench --check: no floor table for device kind {kind!r} — "
+              "report-only pass", file=sys.stderr)
+        return 0
+    measured = {model: headline, **configs}
+    failures = []
+    for name, floor in table.items():
+        entry = measured.get(name)
+        if entry is None:
+            failures.append(f"{name}: floored config missing from results")
+        elif "error" in entry:
+            failures.append(f"{name}: errored: {entry['error']}")
+        elif entry.get("mfu", 0.0) < floor:
+            failures.append(
+                f"{name}: mfu {entry.get('mfu')} < floor {floor}"
+            )
+    for name, entry in measured.items():
+        if name not in table and isinstance(entry, dict) and "error" in entry:
+            failures.append(f"{name}: errored (unfloored): {entry['error']}")
+    if failures:
+        for f in failures:
+            print(f"bench --check FAIL: {f}", file=sys.stderr)
+        return 3
+    print(
+        f"bench --check OK: {len(table)} floors held on {kind!r} "
+        f"({len(measured)} configs measured)",
+        file=sys.stderr,
+    )
+    return 0
 
 
 def _emit_error(stage: str, exc: BaseException, extra: dict | None = None) -> None:
@@ -344,6 +449,13 @@ def main() -> int:
     parser.add_argument("--warmup", type=int, default=3)
     parser.add_argument("--suite", choices=("full", "headline"), default=None,
                         help="full = headline + moe/bert/loader secondaries (TPU default)")
+    parser.add_argument("--check", action="store_true",
+                        help="compare every measured config against the "
+                             "committed floor table; exit 3 on regression")
+    parser.add_argument("--floors",
+                        default=os.path.join(os.path.dirname(
+                            os.path.abspath(__file__)), "ci", "bench_floors.json"),
+                        help="floor table for --check (ci/bench_floors.json)")
     args = parser.parse_args()
 
     import jax
@@ -452,6 +564,16 @@ def main() -> int:
             # (e.g. studying the dispatch-amortization artifact on CPU).
             seq = min(seq, 128)
         suite = args.suite or ("full" if on_tpu else "headline")
+        if args.check and suite != "full":
+            if args.suite == "headline":
+                # Explicit contradiction: the floor tables cover the whole
+                # suite, so a headline-only check would report every
+                # secondary as missing — refuse loudly rather than fail
+                # confusingly.
+                print("bench --check requires the full suite; drop "
+                      "--suite headline", file=sys.stderr)
+                return 2
+            suite = "full"  # --check implies the full suite off-TPU too
 
         mesh = standard_mesh(n)  # pure FSDP by default
     except Exception as exc:  # noqa: BLE001 — empty device list, mesh factory
@@ -557,6 +679,11 @@ def main() -> int:
             ))
 
     print(json.dumps(result_line(configs)))
+    if args.check:
+        # After the result line (the stdout contract keeps the last line a
+        # valid measurement either way); violations go to stderr, rc=3.
+        return _check_floors(args.floors, args.model, headline, configs,
+                             devices[0])
     return 0
 
 

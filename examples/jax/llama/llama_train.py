@@ -41,9 +41,10 @@ def main(argv=None) -> int:
 
     from tf_operator_tpu.models import llama
     from tf_operator_tpu.parallel.sharding import batch_sharding
+    from tf_operator_tpu.runtime.heartbeat import record_progress
     from tf_operator_tpu.runtime.profiling import step_profiler
     from tf_operator_tpu.runtime.tpu_init import tpu_init
-    from tf_operator_tpu.train.data import SyntheticTokens, shard_batch
+    from tf_operator_tpu.train.data import DevicePrefetch, SyntheticTokens
     from tf_operator_tpu.train.train_step import (
         init_sharded_train_state,
         make_optimizer,
@@ -76,7 +77,11 @@ def main(argv=None) -> int:
     state, sharding = init_sharded_train_state(
         model, jax.random.PRNGKey(0), optimizer, mesh, batch=1, seq=min(args.seq, 128)
     )
-    step_fn, _ = make_train_step(model, optimizer, mesh, state, sharding=sharding)
+    # donate_batch: with the device-prefetch stage below every batch is a
+    # fresh device buffer, so the step may recycle the consumed one.
+    step_fn, _ = make_train_step(
+        model, optimizer, mesh, state, sharding=sharding, donate_batch=True
+    )
 
     ckpt = None
     if args.checkpoint_dir:
@@ -134,11 +139,18 @@ def main(argv=None) -> int:
         data = SyntheticTokens(local_batch, args.seq, config.vocab_size,
                                seed=topo.process_id)
     data_spec = batch_sharding(mesh, with_sp=False)
+    # Device-side double buffer: batch k+1's host->device transfer is
+    # issued while step k runs (multi-process it rides
+    # make_array_from_process_local_data via shard_batch). Restart-safe by
+    # construction: the window stream is a pure function of the STEP count
+    # (skip_windows = start_step * local_batch above), so the in-flight
+    # batches of a killed process are re-produced by its successor and a
+    # checkpoint resume can never double-consume or skip data.
+    batches = DevicePrefetch(data, data_spec, depth=2)
 
     t0 = time.perf_counter()
     for step in range(start_step, args.steps):
-        tokens = shard_batch(next(data), data_spec)
-        state, loss = step_fn(state, tokens)
+        state, loss = step_fn(state, next(batches))
         # XLA trace capture when TPU_PROFILE_DIR is set (no-op otherwise).
         step_profiler(step)
         if step % args.log_every == 0 or step == args.steps - 1:
@@ -150,6 +162,12 @@ def main(argv=None) -> int:
                 f"tokens/sec {tps:,.0f} ({tps / max(n,1):,.0f}/chip)",
                 flush=True,
             )
+            # Surface throughput to the operator (gang liveness already
+            # rides the heartbeat; this adds the utilization signal the
+            # autoscaler consumes as training_workload_tokens_per_sec).
+            # Log-cadence, not per-step: each call wakes the renewal
+            # thread, and a lease write per step would be apiserver spam.
+            record_progress(step=step, tokens_per_sec=tps)
         if ckpt is not None and (step + 1) % args.checkpoint_every == 0:
             ckpt.save(state)
     if ckpt is not None:

@@ -1,0 +1,182 @@
+"""Unit tier for the bench harness's non-measuring parts: the multi-config
+floor check (`bench.py --check` against ci/bench_floors.json), the
+peak-TFLOPs fallthrough contract (unknown chips are ASSUMED loudly, never
+silently scored), and the expert-axis sharding resolution the MoE perf
+work rides (parallel/sharding.py).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+import bench
+
+
+class FakeDevice:
+    def __init__(self, device_kind, platform):
+        self.device_kind = device_kind
+        self.platform = platform
+
+
+class TestPeakTflops:
+    def test_known_kinds_have_no_assumption(self):
+        peak, assumed = bench.peak_tflops_for(FakeDevice("TPU v5 lite", "tpu"))
+        assert peak == 197.0 and assumed is None
+        peak, assumed = bench.peak_tflops_for(FakeDevice("cpu", "cpu"))
+        assert peak == 1.0 and assumed is None
+
+    def test_unknown_tpu_kind_assumes_v5e_and_says_so(self, capsys):
+        peak, assumed = bench.peak_tflops_for(FakeDevice("TPU v9 mega", "tpu"))
+        assert peak == 197.0
+        assert assumed == "tpu v5 lite"
+        assert "WARNING" in capsys.readouterr().err
+
+    def test_unknown_non_tpu_assumes_cpu(self, capsys):
+        peak, assumed = bench.peak_tflops_for(FakeDevice("H100", "gpu"))
+        assert peak == 1.0 and assumed == "cpu"
+        assert "WARNING" in capsys.readouterr().err
+
+
+class TestCheckFloors:
+    def _floors(self, tmp_path, table):
+        path = tmp_path / "floors.json"
+        path.write_text(json.dumps(table))
+        return str(path)
+
+    def test_all_floors_held_passes(self, tmp_path):
+        path = self._floors(tmp_path, {
+            "tpu v5 lite": {"llama-400m": 0.64, "moe-125m": 0.38},
+        })
+        rc = bench._check_floors(
+            path, "llama-400m", {"mfu": 0.70},
+            {"moe-125m": {"mfu": 0.52}},
+            FakeDevice("TPU v5 lite", "tpu"),
+        )
+        assert rc == 0
+
+    def test_secondary_regression_fails_not_just_headline(self, tmp_path):
+        path = self._floors(tmp_path, {
+            "tpu v5 lite": {"llama-400m": 0.64, "moe-125m": 0.38},
+        })
+        rc = bench._check_floors(
+            path, "llama-400m", {"mfu": 0.70},
+            {"moe-125m": {"mfu": 0.30}},  # headline fine, secondary not
+            FakeDevice("TPU v5 lite", "tpu"),
+        )
+        assert rc == 3
+
+    def test_missing_floored_config_fails(self, tmp_path):
+        """A secondary silently dropped from the suite is a check failure —
+        the ratchet gates presence, not just values."""
+        path = self._floors(tmp_path, {
+            "tpu v5 lite": {"llama-400m": 0.64, "moe-125m": 0.38},
+        })
+        rc = bench._check_floors(
+            path, "llama-400m", {"mfu": 0.70}, {},
+            FakeDevice("TPU v5 lite", "tpu"),
+        )
+        assert rc == 3
+
+    def test_errored_config_fails_even_unfloored(self, tmp_path):
+        path = self._floors(tmp_path, {"cpu": {"llama-tiny": 0.0}})
+        rc = bench._check_floors(
+            path, "llama-tiny", {"mfu": 0.1},
+            {"bert-tiny": {"error": "ValueError: boom"}},
+            FakeDevice("cpu", "cpu"),
+        )
+        assert rc == 3
+
+    def test_unknown_platform_is_report_only(self, tmp_path):
+        path = self._floors(tmp_path, {"tpu v5 lite": {"llama-400m": 0.64}})
+        rc = bench._check_floors(
+            path, "llama-400m", {"mfu": 0.01}, {},
+            FakeDevice("TPU v9 mega", "tpu"),
+        )
+        assert rc == 0
+
+    def test_longest_platform_prefix_wins(self, tmp_path):
+        """'tpu v5 lite' must match its own table, not the shorter
+        'tpu v5' (v5p) prefix."""
+        path = self._floors(tmp_path, {
+            "tpu v5": {"llama-400m": 0.99},
+            "tpu v5 lite": {"llama-400m": 0.60},
+        })
+        rc = bench._check_floors(
+            path, "llama-400m", {"mfu": 0.65}, {},
+            FakeDevice("TPU v5 lite", "tpu"),
+        )
+        assert rc == 0
+
+    def test_committed_floors_parse_and_cover_the_r05_suite(self):
+        with open(bench.os.path.join(
+                bench.os.path.dirname(bench.os.path.abspath(bench.__file__)),
+                "ci", "bench_floors.json")) as fh:
+            floors = json.load(fh)
+        tpu = floors["tpu v5 lite"]
+        for name in ("llama-400m", "llama-400m+native-loader", "moe-125m",
+                     "bert-base", "llama-1b"):
+            assert name in tpu and 0.0 < tpu[name] < 1.0
+        assert set(floors["cpu"]) == {
+            "llama-400m", "llama-400m+native-loader", "moe-tiny", "bert-tiny",
+        }
+
+
+class TestExpertShardingResolution:
+    """parallel/sharding.py: where MoE expert weights land per mesh."""
+
+    def _mesh(self, **axes):
+        import numpy as np
+
+        jax = pytest.importorskip("jax")
+        total = 1
+        for v in axes.values():
+            total *= v
+        if total > len(jax.devices()):
+            pytest.skip("not enough host devices")
+        arr = np.array(jax.devices()[:total]).reshape(tuple(axes.values()))
+        return jax.sharding.Mesh(arr, tuple(axes))
+
+    def test_ep_mesh_keeps_ep(self):
+        from tf_operator_tpu.parallel.sharding import moe_expert_axes
+
+        mesh = self._mesh(fsdp=4, ep=2)
+        ax, batch = moe_expert_axes(mesh, 8)
+        assert ax == "ep" and "ep" not in batch and "fsdp" in batch
+
+    def test_epless_mesh_rides_fsdp_when_divisible(self):
+        from tf_operator_tpu.parallel.sharding import (
+            moe_expert_axes,
+            spec_for_param,
+        )
+
+        mesh = self._mesh(fsdp=4)
+        ax, batch = moe_expert_axes(mesh, 8)
+        assert ax == "fsdp" and "fsdp" not in batch
+        # Weight rule: scanned stack [layers, e, d, f] -> experts over
+        # fsdp, d UNsharded (the axis cannot be used twice).
+        spec = spec_for_param("params/layers/feed_forward/experts_w1",
+                              4, mesh, shape=(12, 8, 768, 2048))
+        assert tuple(spec) == (None, "fsdp", None, None)
+
+    def test_epless_mesh_replicates_when_not_divisible(self):
+        from tf_operator_tpu.parallel.sharding import (
+            moe_expert_axes,
+            spec_for_param,
+        )
+
+        mesh = self._mesh(fsdp=4)
+        ax, batch = moe_expert_axes(mesh, 6)  # 6 % 4 != 0
+        assert ax is None and "fsdp" in batch
+        # Weights fall back to the old layout: d over fsdp.
+        spec = spec_for_param("params/layers/feed_forward/experts_w1",
+                              4, mesh, shape=(12, 6, 768, 2048))
+        assert tuple(spec) == (None, None, "fsdp", None)
+
+    def test_shape_blind_call_preserves_legacy_layout(self):
+        from tf_operator_tpu.parallel.sharding import spec_for_param
+
+        mesh = self._mesh(fsdp=4)
+        spec = spec_for_param("params/layers/feed_forward/experts_w2", 4, mesh)
+        assert tuple(spec) == (None, None, None, "fsdp")

@@ -244,7 +244,7 @@ class TestRingWithFlashBlocks:
         fwd AND grad (the combine's lse algebra is differentiable)."""
         from functools import partial
 
-        from jax import shard_map
+        from tf_operator_tpu.parallel.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from tf_operator_tpu.parallel.mesh import standard_mesh
@@ -261,13 +261,17 @@ class TestRingWithFlashBlocks:
         # check_vma=False: the Pallas INTERPRETER (CPU stand-in for the TPU
         # kernel) does not propagate varying-mesh-axes through its internal
         # dynamic slices; the compiled TPU path needs no such relaxation.
+        # (jax 0.4.x spells the knob check_rep — compat resolves the name.)
+        from tf_operator_tpu.parallel.compat import rep_check_kwarg
+
+        relax = rep_check_kwarg()
         ring = jax.jit(shard_map(
             partial(ring_attention, axis_name="sp",
                     block_impl="flash_interpret"),
             mesh=mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,
-            check_vma=False,
+            **{relax: False},
         ))
         expected = xla_attention(q, k, v, causal=True)
         np.testing.assert_allclose(

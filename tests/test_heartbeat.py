@@ -110,7 +110,7 @@ class TestHeartbeatPublisher:
         beats = []
         done = threading.Event()
 
-        def sink(seq, step):
+        def sink(seq, step, tps=None):
             beats.append((seq, step))
             if len(beats) >= 2:
                 done.set()
@@ -127,7 +127,7 @@ class TestHeartbeatPublisher:
             pub.stop()
 
     def test_sink_failure_never_escapes(self):
-        def sink(seq, step):
+        def sink(seq, step, tps=None):
             raise RuntimeError("boom")
 
         pub = hb.HeartbeatPublisher(sink, interval=10.0)
@@ -202,11 +202,12 @@ class Harness:
             self.cluster.set_pod_phase("default", p.metadata.name, "Running")
         self.controller.run_until_idle()
 
-    def beat(self, *names, step=None):
+    def beat(self, *names, step=None, tokens_per_sec=None):
         for name in names:
             assert hb.publish_heartbeat(
                 self.cluster, "default", heartbeat_lease_name(name), name,
-                step=step, clock=lambda: self.now[0],
+                step=step, tokens_per_sec=tokens_per_sec,
+                clock=lambda: self.now[0],
             )
 
     def sync(self):
@@ -354,6 +355,61 @@ class TestEngineStallDetection:
         h.cluster.delete_job("JAXJob", "default", "llama")
         h.controller.run_until_idle()
         assert h.metrics.heartbeat_age_value("default", "JAXJob", "llama") is None
+
+    def test_workload_throughput_gauge_exported(self):
+        """record_progress(tokens_per_sec=) rides the lease annotations to
+        the training_workload_tokens_per_sec gauge: MAX over replicas (a
+        global-throughput reporter yields the job number), updated on the
+        next liveness check, DROPPED on terminal (a 0.0 would page
+        low-throughput alerts for finished jobs), cleared on delete."""
+        h = Harness(run_policy={"progressDeadlineSeconds": 300,
+                                "cleanPodPolicy": "All"})
+        # No reports yet: the gauge stays unexported (no bogus zeros).
+        h.beat("llama-worker-0", "llama-worker-1")
+        h.sync()
+        assert h.metrics.workload_tokens_per_sec_value(
+            "default", "JAXJob", "llama") is None
+        h.now[0] += 5
+        h.beat("llama-worker-0", step=10, tokens_per_sec=45203.2)
+        h.beat("llama-worker-1", step=10, tokens_per_sec=44100.0)
+        h.sync()
+        assert h.metrics.workload_tokens_per_sec_value(
+            "default", "JAXJob", "llama") == pytest.approx(45203.2)
+        assert 'training_workload_tokens_per_sec{job_namespace="default"' \
+            in h.metrics.render()
+        # Terminal: the series is dropped — not zeroed, not lingering.
+        for name in ("llama-worker-0", "llama-worker-1"):
+            h.cluster.set_pod_phase("default", name, "Succeeded", exit_code=0)
+        h.sync()
+        assert h.metrics.workload_tokens_per_sec_value(
+            "default", "JAXJob", "llama") is None
+
+    def test_throughput_annotation_file_bridge_round_trip(self, tmp_path):
+        """The process-tier file bridge carries tokens_per_sec beside step."""
+        path = str(tmp_path / "beat.hb")
+        hb.write_heartbeat_file(path, seq=4, step=20, tokens_per_sec=1234.5)
+        beat = hb.read_heartbeat_file(path)
+        assert beat["tokens_per_sec"] == pytest.approx(1234.5)
+        # And the lease half: annotation lands beside the step.
+        from tf_operator_tpu.core.constants import ANNOTATION_HEARTBEAT_TPS
+
+        cluster = InMemoryCluster()
+        assert hb.publish_heartbeat(
+            cluster, "default", "p-0-hb", "p-0", step=20,
+            tokens_per_sec=1234.5,
+        )
+        lease = cluster.get_lease("default", "p-0-hb")
+        assert lease["metadata"]["annotations"][
+            ANNOTATION_HEARTBEAT_TPS] == "1234.5"
+        # A later beat WITHOUT a report keeps the last value (telemetry is
+        # level-triggered; staleness is the age gauge's job).
+        assert hb.publish_heartbeat(cluster, "default", "p-0-hb", "p-0",
+                                    step=21)
+        lease = cluster.get_lease("default", "p-0-hb")
+        assert lease["metadata"]["annotations"][
+            ANNOTATION_HEARTBEAT_TPS] == "1234.5"
+        assert lease["metadata"]["annotations"][
+            ANNOTATION_HEARTBEAT_STEP] == "21"
 
     def test_terminal_job_gcs_heartbeat_leases(self):
         h = Harness(run_policy={"progressDeadlineSeconds": 30,

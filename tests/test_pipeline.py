@@ -9,6 +9,20 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from tf_operator_tpu.parallel.compat import supports_partial_manual
+
+# Capability gate, not a version pin: pipeline_apply needs PARTIAL-manual
+# shard_map (only pp mapped, the rest auto-partitioned). jax 0.4.x spells
+# that `auto=`, and its jaxlib then fails the lowering with "PartitionId
+# instruction is not supported for SPMD partitioning" — the feature is
+# genuinely absent on that toolchain, so the tier self-skips there (the
+# evidence-based-skip rule the llama e2e budgets follow).
+pytestmark = pytest.mark.skipif(
+    not supports_partial_manual(),
+    reason="partial-manual shard_map (axis_names=) unsupported on this jax; "
+           "jax 0.4.x jaxlib cannot lower PartitionId under partial SPMD",
+)
+
 from tf_operator_tpu.models import llama
 from tf_operator_tpu.parallel.mesh import standard_mesh
 from tf_operator_tpu.parallel.pipeline import pipeline_apply, split_stages

@@ -1,0 +1,131 @@
+"""Multi-process throughput-parity e2e (tentpole (c), docs/design/
+workload_performance.md): a 2-process CPU world formed from the
+OPERATOR-INJECTED mesh env (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+JAX_PROCESS_ID / JAX_MESH_SPEC — exactly the variables bootstrap/jaxdist.py
+publishes into pods) must reach per-chip step time within the documented
+tolerance of a single-process run over the same mesh shape and global
+batch. This ties the control-plane story to the hardware-speed north star:
+the operator's env injection, the declared-mesh path in runtime/tpu_init,
+and the overlapped input pipeline (DevicePrefetch through the multi-process
+make_array_from_process_local_data seam) all sit on the measured path.
+
+Tolerance: on CPU/gloo the 2-process run must hold >= 0.2x of the
+single-process per-chip throughput (PARITY_MIN_RATIO) — transport dominates
+a llama-tiny step on localhost sockets, so the CPU gate is a wiring/decade
+check, not a speed promise; the TPU/ICI contract (>= 0.9x) is documented in
+the design doc and measured by the live-chip tiers. Marked slow: two cold
+JAX process starts; the CI dag runs it in its own step (throughput-parity).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+PARITY_MIN_RATIO = 0.2  # CPU/gloo bound; TPU contract documented at 0.9
+STEPS, WARMUP, GLOBAL_BATCH, SEQ = 20, 3, 8, 64
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _base_env(device_count: int) -> dict:
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={device_count}",
+        # The declared mesh the operator would publish (JAX_MESH_SPEC).
+        "JAX_MESH_SPEC": json.dumps({"fsdp": 2}),
+        "JAX_COMPILATION_CACHE_DIR": "/tmp/jax-ci-compile-cache",
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "10",
+    })
+    # A stray operator env from the harness must not leak in.
+    for key in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID", "JAX_NUM_SLICES", "JAX_SLICE_INDEX",
+                "TPU_HEARTBEAT_LEASE", "TPU_HEARTBEAT_FILE"):
+        env.pop(key, None)
+    return env
+
+
+def _workload_cmd() -> list:
+    return [sys.executable, "-m", "tf_operator_tpu.testing.parity_workload",
+            "--steps", str(STEPS), "--warmup", str(WARMUP),
+            "--global-batch", str(GLOBAL_BATCH), "--seq", str(SEQ)]
+
+
+def _parse_result(proc: subprocess.CompletedProcess) -> dict:
+    assert proc.returncode == 0, (
+        f"parity workload rc={proc.returncode}\n"
+        f"stdout:\n{proc.stdout[-2000:]}\nstderr:\n{proc.stderr[-2000:]}"
+    )
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    return json.loads(lines[-1])
+
+
+@pytest.mark.slow
+class TestThroughputParity:
+    def test_two_process_world_holds_per_chip_throughput(self):
+        # Single-process reference: 2 local devices, same mesh/global batch.
+        single = _parse_result(subprocess.run(
+            _workload_cmd(), env=_base_env(2),
+            capture_output=True, text=True, timeout=600,
+        ))
+        assert single["devices"] == 2 and single["num_processes"] == 1
+
+        # 2-process world through the operator env contract: 1 device per
+        # process, rendezvous at an injected coordinator address.
+        port = _free_port()
+        procs = []
+        for pid in (0, 1):
+            env = _base_env(1)
+            env.update({
+                "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+                "JAX_NUM_PROCESSES": "2",
+                "JAX_PROCESS_ID": str(pid),
+            })
+            procs.append(subprocess.Popen(
+                _workload_cmd(), env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            ))
+        results = []
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=600)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise
+            results.append(_parse_result(
+                subprocess.CompletedProcess(p.args, p.returncode, out, err)
+            ))
+
+        multi = results[0]
+        assert multi["devices"] == 2, "rendezvous did not federate devices"
+        assert multi["num_processes"] == 2
+        # Both processes time the same global steps; their numbers must
+        # agree (they block on the same collectives).
+        assert results[1]["tokens_per_sec_chip"] == pytest.approx(
+            multi["tokens_per_sec_chip"],
+            rel=0.5,
+        )
+        ratio = multi["tokens_per_sec_chip"] / single["tokens_per_sec_chip"]
+        print(
+            f"[parity] single={single['tokens_per_sec_chip']} tok/s/chip "
+            f"({single['step_ms']} ms/step) "
+            f"multi={multi['tokens_per_sec_chip']} tok/s/chip "
+            f"({multi['step_ms']} ms/step) ratio={ratio:.3f}"
+        )
+        assert ratio >= PARITY_MIN_RATIO, (
+            f"2-process per-chip throughput {multi['tokens_per_sec_chip']} "
+            f"is {ratio:.3f}x of single-process "
+            f"{single['tokens_per_sec_chip']} — below the documented "
+            f"{PARITY_MIN_RATIO}x CPU/gloo tolerance"
+        )

@@ -1,0 +1,176 @@
+"""Overlapped input pipeline, correctness tier (docs/design/
+workload_performance.md): the device-side double buffer
+(train.data.DevicePrefetch) may change WHEN host->device transfers happen,
+never WHAT the model trains on.
+
+Three contracts:
+- loss parity: overlap on vs off, same seed -> byte-equal loss sequence
+  (the seed-determinism half of the acceptance rule: prefetch needs no
+  capability gate because it cannot perturb a replay);
+- donation safety: a step donating its batch buffer never aliases the
+  in-flight buffer (every yielded batch is a distinct transfer);
+- resume accounting: the TokenFileDataset skip-window contract holds
+  THROUGH the device stage — skip is a function of steps trained, and the
+  in-flight batches of a killed process are re-produced, not skipped.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from tf_operator_tpu.models import llama  # noqa: E402
+from tf_operator_tpu.parallel.mesh import standard_mesh  # noqa: E402
+from tf_operator_tpu.parallel.sharding import batch_sharding  # noqa: E402
+from tf_operator_tpu.train.data import (  # noqa: E402
+    DevicePrefetch,
+    SyntheticTokens,
+    TokenFileDataset,
+    shard_batch,
+    write_token_file,
+)
+from tf_operator_tpu.train.train_step import (  # noqa: E402
+    init_sharded_train_state,
+    make_optimizer,
+    make_train_step,
+)
+
+BATCH, SEQ = 4, 32
+
+
+def _tiny_step(donate_batch=False, n_devices=2):
+    cfg = llama.CONFIGS["llama-tiny"]
+    mesh = standard_mesh(n_devices, devices=jax.devices()[:n_devices])
+    model = llama.Llama(cfg)
+    opt = make_optimizer(warmup_steps=1, decay_steps=10)
+    state, sharding = init_sharded_train_state(
+        model, jax.random.PRNGKey(0), opt, mesh, batch=1, seq=SEQ
+    )
+    step_fn, _ = make_train_step(
+        model, opt, mesh, state, sharding=sharding, donate_batch=donate_batch
+    )
+    return cfg, mesh, step_fn, state
+
+
+class TestLossParity:
+    def test_overlap_on_off_byte_equal_loss_sequence(self):
+        """Same seed, same steps: the prefetched run's loss floats must be
+        BIT-identical to the inline-device_put run's — the overlap stage
+        feeds the exact same batches in the exact same order."""
+        runs = []
+        for overlap in (False, True):
+            cfg, mesh, step_fn, state = _tiny_step()
+            data = SyntheticTokens(BATCH, SEQ, cfg.vocab_size, seed=7)
+            spec = batch_sharding(mesh, with_sp=False)
+            if overlap:
+                it = DevicePrefetch(data, spec, depth=2)
+            else:
+                host = iter(data)
+                it = (shard_batch(next(host), spec) for _ in iter(int, 1))
+            losses = []
+            for _ in range(6):
+                state, loss = step_fn(state, next(it))
+                losses.append(float(loss))
+            runs.append(losses)
+        assert runs[0] == runs[1]  # exact float equality, not approx
+
+
+class TestDonationSafety:
+    def test_distinct_buffers_and_no_use_after_donate(self):
+        """Every yielded batch is its own device buffer; with the batch
+        argument donated, stepping never invalidates an in-flight batch."""
+        cfg, mesh, step_fn, state = _tiny_step(donate_batch=True)
+        data = SyntheticTokens(BATCH, SEQ, cfg.vocab_size, seed=3)
+        pf = DevicePrefetch(data, batch_sharding(mesh, with_sp=False), depth=3)
+        seen_ids = set()
+        for _ in range(5):
+            batch = next(pf)
+            assert id(batch) not in seen_ids
+            seen_ids.add(id(batch))
+            state, loss = step_fn(state, batch)
+            # The IN-FLIGHT buffers must remain readable after the step
+            # donated `batch` — an aliasing bug would have deleted them.
+            for pending in list(pf._buf):
+                np.asarray(pending)
+        assert np.isfinite(float(loss))
+
+    def test_depth_one_degrades_to_inline_transfer(self):
+        cfg, mesh, step_fn, state = _tiny_step()
+        data = SyntheticTokens(BATCH, SEQ, cfg.vocab_size, seed=1)
+        pf = DevicePrefetch(data, batch_sharding(mesh, with_sp=False), depth=1)
+        state, loss = step_fn(state, next(pf))
+        assert np.isfinite(float(loss))
+        with pytest.raises(ValueError):
+            DevicePrefetch(data, batch_sharding(mesh, with_sp=False), depth=0)
+
+    def test_finite_host_iterator_drains_cleanly(self):
+        mesh = standard_mesh(2, devices=jax.devices()[:2])
+        spec = batch_sharding(mesh, with_sp=False)
+        host = [np.full((2, 4), i, np.int32) for i in range(3)]
+        pf = DevicePrefetch(iter(host), spec, depth=2)
+        got = [int(np.asarray(b)[0, 0]) for b in pf]
+        assert got == [0, 1, 2]
+        with pytest.raises(StopIteration):
+            next(pf)
+
+
+class TestSkipWindowResume:
+    def _write_shard(self, tmp_path, n_tokens=20_000):
+        path = str(tmp_path / "tokens.bin")
+        rng = np.random.default_rng(11)
+        write_token_file(path, rng.integers(0, 250, size=n_tokens,
+                                            dtype=np.int32))
+        return path
+
+    def test_resume_stream_matches_through_device_stage(self, tmp_path):
+        """Train k steps through the prefetcher, 'crash', resume with
+        skip_windows = k * batch: the resumed HOST stream must produce
+        exactly the batch the prefetched run yields at step k — the
+        in-flight buffer is neither double-consumed nor skipped."""
+        path = self._write_shard(tmp_path)
+        batch, seq = 2, 16
+        mesh = standard_mesh(2, devices=jax.devices()[:2])
+        spec = batch_sharding(mesh, with_sp=False)
+        trained_steps = 3
+        ds = TokenFileDataset(path, batch, seq)
+        pf = DevicePrefetch(ds, spec, depth=2)
+        first_run = [np.asarray(next(pf)) for _ in range(trained_steps)]
+        # The prefetcher has in-flight batches beyond the trained steps —
+        # the ones a crash would discard.
+        assert pf.in_flight > 0
+        # Resume: a fresh dataset skipping exactly steps*batch windows
+        # (what llama_train derives from the checkpointed step count).
+        ds_resume = TokenFileDataset(path, batch, seq,
+                                     skip_windows=trained_steps * batch)
+        expected_step4 = next(iter(ds_resume))
+        np.testing.assert_array_equal(np.asarray(next(pf)), expected_step4)
+        # And the discarded-buffer path: a fresh prefetcher over the
+        # resumed dataset continues the same stream.
+        pf_resume = DevicePrefetch(ds_resume, spec, depth=2)
+        ds_check = TokenFileDataset(path, batch, seq,
+                                    skip_windows=(trained_steps + 1) * batch)
+        np.testing.assert_array_equal(
+            np.asarray(next(pf_resume)), next(iter(ds_check))
+        )
+        for d in (ds, ds_resume, ds_check):
+            d.close()
+        assert first_run[0].shape == (batch, seq + 1)
+
+    def test_python_and_native_paths_agree_through_prefetch(self, tmp_path):
+        """Both loader backends feed identical batches through the device
+        stage (the native ring + device buffer compose)."""
+        path = self._write_shard(tmp_path)
+        mesh = standard_mesh(2, devices=jax.devices()[:2])
+        spec = batch_sharding(mesh, with_sp=False)
+        ds_py = TokenFileDataset(path, 2, 16, force_python=True)
+        ds_any = TokenFileDataset(path, 2, 16)
+        pf_py = DevicePrefetch(ds_py, spec, depth=2)
+        pf_any = DevicePrefetch(ds_any, spec, depth=2)
+        for _ in range(4):
+            np.testing.assert_array_equal(
+                np.asarray(next(pf_py)), np.asarray(next(pf_any))
+            )
+        ds_py.close()
+        ds_any.close()

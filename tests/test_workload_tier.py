@@ -24,6 +24,16 @@ from tf_operator_tpu.train.train_step import (
 )
 
 
+def _partial_manual_shard_map_supported() -> bool:
+    """True when shard_map supports partial-manual mode (axis_names=) —
+    absent on jax 0.4.x, whose jaxlib also cannot lower PartitionId under
+    partial SPMD (the pp pipeline's mode). The dryrun self-skips its pp leg
+    there; tests keyed on that leg follow the same probe."""
+    from tf_operator_tpu.parallel.compat import supports_partial_manual
+
+    return supports_partial_manual()
+
+
 class TestMesh:
     def test_eight_virtual_devices(self):
         assert len(jax.devices()) == 8
@@ -292,7 +302,7 @@ class TestRingAttention:
         attention on the gathered sequence."""
         from functools import partial
 
-        from jax import shard_map
+        from tf_operator_tpu.parallel.compat import shard_map
 
         from tf_operator_tpu.ops.attention import xla_attention
         from tf_operator_tpu.ops.ring_attention import ring_attention
@@ -319,7 +329,7 @@ class TestRingAttention:
     def test_gqa_ring(self):
         from functools import partial
 
-        from jax import shard_map
+        from tf_operator_tpu.parallel.compat import shard_map
 
         from tf_operator_tpu.ops.attention import xla_attention
         from tf_operator_tpu.ops.ring_attention import ring_attention
@@ -539,6 +549,13 @@ class TestGraftEntry:
         assert proc.returncode == 0, proc.stderr[-4000:]
         for tag in ("dense dp*fsdp*tp", "ring sp*fsdp", "moe ep*fsdp",
                     "pipeline pp*fsdp", "multislice slice*fsdp"):
+            if (tag == "pipeline pp*fsdp"
+                    and not _partial_manual_shard_map_supported()):
+                # jax 0.4.x: dryrun_multichip self-skips the pp leg (its
+                # jaxlib cannot lower PartitionId under partial SPMD) and
+                # says so — the skip line, not silence, is the contract.
+                assert f"dryrun_multichip[{tag}] SKIP" in proc.stdout
+                continue
             assert f"dryrun_multichip[{tag}] OK" in proc.stdout, (
                 f"layout {tag!r} missing at {n_devices} devices:\n"
                 f"{proc.stdout}\n{proc.stderr[-2000:]}")
@@ -585,6 +602,14 @@ class TestGraftEntry:
             f"{state_bytes/8/1e9:.2f}GB — params not actually sharded"
         )
 
+    @pytest.mark.skipif(
+        not _partial_manual_shard_map_supported(),
+        reason="jax 0.4.x partitioner emits involuntary-remat warnings for "
+               "the scan-boundary tensors even on the pre-annotation code "
+               "(measured 7 at pristine HEAD+import-compat on this "
+               "container) — the zero-remat invariant is a property of the "
+               "current partitioner the driver toolchain runs",
+    )
     def test_dryrun_multichip_reshard_clean(self):
         """Regression guard: the sharded train step must compile with ZERO
         SPMD involuntary-full-rematerialization warnings on every mesh

@@ -364,9 +364,13 @@ class LocalProcessCluster(InMemoryCluster):
                 continue
             state[3] = beat.get("seq")
             step = beat.get("step")
+            tps = beat.get("tokens_per_sec")
             hb_runtime.publish_heartbeat(
                 self, lease_ns, lease_name, identity=key[1],
                 step=int(step) if isinstance(step, (int, float)) else None,
+                tokens_per_sec=(
+                    float(tps) if isinstance(tps, (int, float)) else None
+                ),
             )
 
     def kill_pod(self, namespace: str, name: str, sig: int = signal.SIGKILL) -> None:

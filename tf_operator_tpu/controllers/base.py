@@ -179,6 +179,7 @@ class FrameworkController(FrameworkHooks):
             on_job_restarting=self._record_restart,
             on_gang_restart=self._record_gang_restart,
             on_heartbeat_age=self._record_heartbeat_age,
+            on_workload_throughput=self._record_workload_throughput,
             on_force_delete=self._record_force_delete,
             on_fanout_batch=self._record_fanout_batch,
             on_fanout_abort=self._record_fanout_abort,
@@ -311,6 +312,7 @@ class FrameworkController(FrameworkHooks):
         self.engine.forget_job(key)
         namespace, _, name = key.partition("/")
         self.metrics.clear_heartbeat_age(namespace, self.kind, name)
+        self.metrics.clear_workload_tokens_per_sec(namespace, self.kind, name)
         with self._uid_lock:
             uid = uid or self._known_uids.get(key, "")
             self._known_uids.pop(key, None)
@@ -338,6 +340,7 @@ class FrameworkController(FrameworkHooks):
             self.expectations.delete_expectations(key, "services")
             self.engine.forget_job(key)
             self.metrics.clear_heartbeat_age(namespace, self.kind, name)
+            self.metrics.clear_workload_tokens_per_sec(namespace, self.kind, name)
             with self._uid_lock:
                 self._known_uids.pop(key, None)
 
@@ -358,6 +361,18 @@ class FrameworkController(FrameworkHooks):
 
     def _record_heartbeat_age(self, job: JobObject, age: float) -> None:
         self.metrics.set_heartbeat_age(job.namespace, self.kind, job.name, age)
+
+    def _record_workload_throughput(self, job: JobObject, tps) -> None:
+        if tps is None:
+            # Terminal: drop the series (a finished job has no live
+            # throughput; 0.0 would trip low-throughput alerts forever).
+            self.metrics.clear_workload_tokens_per_sec(
+                job.namespace, self.kind, job.name
+            )
+            return
+        self.metrics.set_workload_tokens_per_sec(
+            job.namespace, self.kind, job.name, tps
+        )
 
     def _record_force_delete(self, job: JobObject, cause: str) -> None:
         self.metrics.force_delete_inc(job.namespace, self.kind, cause)

@@ -126,6 +126,10 @@ DISRUPTION_BACKOFF_MAX_SECONDS = 300.0
 # observed — the leaderelection skew rule — never remote-vs-local time.
 HEARTBEAT_LEASE_SUFFIX = "-hb"
 ANNOTATION_HEARTBEAT_STEP = "tpu.kubeflow.org/progress-step"
+# Workload-reported training throughput (record_progress(tokens_per_sec=)),
+# riding the same lease annotations: the utilization signal the controller
+# exports as training_workload_tokens_per_sec for autoscaling/dashboards.
+ANNOTATION_HEARTBEAT_TPS = "tpu.kubeflow.org/tokens-per-sec"
 # Renewal cadence injected into heartbeat-enabled pods: a quarter of the
 # progress deadline, floored — several renewals must fit inside one
 # deadline window or scheduling jitter alone could trip it.

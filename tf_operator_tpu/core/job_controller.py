@@ -490,6 +490,7 @@ class JobController:
         on_job_restarting: Optional[Callable[[JobObject, str, str], None]] = None,
         on_gang_restart: Optional[Callable[[JobObject, str, Optional[int], str], None]] = None,
         on_heartbeat_age: Optional[Callable[[JobObject, float], None]] = None,
+        on_workload_throughput: Optional[Callable[[JobObject, float], None]] = None,
         on_force_delete: Optional[Callable[[JobObject, str], None]] = None,
         on_fanout_batch: Optional[Callable[[str, int], None]] = None,
         on_fanout_abort: Optional[Callable[[str], None]] = None,
@@ -520,6 +521,17 @@ class JobController:
         # a deadline-opted-in job; the controller exports it as the
         # heartbeat_age_seconds gauge.
         self.on_heartbeat_age = on_heartbeat_age or (lambda job, age: None)
+        # (job, tokens/sec or None) — fires when a liveness check observes
+        # a workload-reported throughput annotation on any heartbeat lease
+        # (record_progress(tokens_per_sec=)); the controller exports the
+        # freshest gang-wide value as training_workload_tokens_per_sec —
+        # the utilization signal the autoscaler consumes. None means "this
+        # job reports no more" (terminal): the series is dropped, not
+        # zeroed — a 0.0 would both invent a series for never-reporting
+        # jobs and trip low-throughput alerts on every finished job.
+        self.on_workload_throughput = on_workload_throughput or (
+            lambda job, tps: None
+        )
         # (job, cause) — fires once per grace-period-0 escalation of a
         # stuck-Terminating pod; the controller exports it as the
         # cause-labeled force_deletes_total counter.
@@ -1504,6 +1516,7 @@ class JobController:
         cache_key = (job.key(), job.metadata.uid)
         stalled: Optional[Tuple[str, Pod, str]] = None
         worst_age = 0.0
+        best_tps: Optional[float] = None
         next_check: Optional[float] = None
 
         def sooner(remaining: float) -> None:
@@ -1564,6 +1577,24 @@ class JobController:
                         f"{lease_spec.get('holderIdentity')}"
                         f"@{lease_spec.get('renewTime')}"
                     )
+                    # Workload-reported throughput rides the lease
+                    # annotations (record_progress(tokens_per_sec=)). The
+                    # job gauge is the MAX over replicas' latest reports: a
+                    # workload reporting GLOBAL throughput (llama_train)
+                    # yields the job number directly, per-replica
+                    # reporters yield the fastest replica's view. Pure
+                    # telemetry — no liveness verdict ever rides on it.
+                    tps_raw = ((lease.get("metadata") or {})
+                               .get("annotations") or {}).get(
+                        constants.ANNOTATION_HEARTBEAT_TPS
+                    )
+                    if tps_raw is not None:
+                        try:
+                            tps = float(tps_raw)
+                        except (TypeError, ValueError):
+                            tps = None
+                        if tps is not None and tps >= 0:
+                            best_tps = max(best_tps or 0.0, tps)
                 if not state.baselined:
                     # First read for this pod incarnation: record the
                     # lease content as a BASELINE without crediting it
@@ -1623,6 +1654,8 @@ class JobController:
         for uid in [u for u in obs if u not in present]:
             obs.pop(uid)
         self.on_heartbeat_age(job, worst_age)
+        if best_tps is not None:
+            self.on_workload_throughput(job, best_tps)
         if stalled is None and next_check is not None:
             # Wake just past the earliest deadline (the +0.1 keeps a
             # same-instant wake from re-reading "age == deadline - 0").
@@ -2638,8 +2671,13 @@ class JobController:
                 # A job that went terminal while stale must not keep
                 # exporting its last (above-threshold) heartbeat age —
                 # the staleness alert would page forever for a job that
-                # is already Succeeded/Failed.
+                # is already Succeeded/Failed. Its throughput series is
+                # DROPPED for the dual reason: a lingering last value
+                # reads as live throughput, and a 0.0 would trip
+                # low-throughput alerts on every finished job (and invent
+                # a series for jobs that never reported).
                 self.on_heartbeat_age(job, 0.0)
+                self.on_workload_throughput(job, None)
 
         ttl = run_policy.ttl_seconds_after_finished
         if ttl is not None:

@@ -216,6 +216,19 @@ class Metrics:
             "for jobs with runPolicy.progressDeadlineSeconds set). Crossing "
             "the deadline drives a ProgressStall gang restart",
         ),
+        "training_workload_tokens_per_sec": (
+            ("job_namespace", "framework", "job_name"),
+            "Training throughput the workload last reported through its "
+            "heartbeat (runtime.heartbeat.record_progress(tokens_per_sec=), "
+            "observed by the liveness check as a lease annotation; max over "
+            "the gang's replicas, so a global-throughput reporter yields "
+            "the job number directly). Only exported for jobs with "
+            "runPolicy.progressDeadlineSeconds set AND a reporting "
+            "workload; the series is dropped on terminal/delete. The "
+            "utilization signal for "
+            "autoscaling: sustained low values beside full capacity mean "
+            "the gang holds chips it cannot feed",
+        ),
         "training_operator_workqueue_depth": (
             ("framework",),
             "Items waiting in the controller's immediate workqueue "
@@ -552,6 +565,30 @@ class Metrics:
         (same leak class as the terminal-dedup set)."""
         with self._lock:
             self._labeled_gauges["training_operator_heartbeat_age_seconds"].pop(
+                (namespace, framework, job_name), None
+            )
+
+    def set_workload_tokens_per_sec(self, namespace: str, framework: str,
+                                    job_name: str, tps: float) -> None:
+        """Latest workload-reported training throughput of one job
+        (lease-annotation payload surfaced by the liveness check)."""
+        with self._lock:
+            self._labeled_gauges["training_workload_tokens_per_sec"][
+                (namespace, framework, job_name)
+            ] = tps
+
+    def workload_tokens_per_sec_value(self, namespace: str, framework: str,
+                                      job_name: str) -> Optional[float]:
+        with self._lock:
+            return self._labeled_gauges[
+                "training_workload_tokens_per_sec"
+            ].get((namespace, framework, job_name))
+
+    def clear_workload_tokens_per_sec(self, namespace: str, framework: str,
+                                      job_name: str) -> None:
+        """Drop a deleted job's series (same leak class as heartbeat age)."""
+        with self._lock:
+            self._labeled_gauges["training_workload_tokens_per_sec"].pop(
                 (namespace, framework, job_name), None
             )
 

@@ -106,17 +106,31 @@ class Layer(nn.Module):
 
     @nn.compact
     def __call__(self, x, attention_mask):
+        from ..parallel.sharding import DATA_AXES, constrain
+
         cfg = self.config
         ln = lambda name: nn.LayerNorm(  # noqa: E731
             epsilon=cfg.norm_eps, dtype=jnp.float32, param_dtype=jnp.float32, name=name
         )
+        # Residual-stream boundary annotations, mirroring models/llama.py
+        # Block: pin [b, s, d] to the canonical batch layout at layer entry
+        # and between the attention and FFN sublayers (no-op without a
+        # scoped mesh — the bench's make_train_step_for provides one).
+        x = constrain(x, DATA_AXES, None, None)
         # Post-LN, the original BERT arrangement.
         attn = SelfAttention(cfg, name="attention")(x, attention_mask)
         x = ln("ln_attn")((x + attn).astype(jnp.float32)).astype(cfg.dtype)
+        x = constrain(x, DATA_AXES, None, None)
         h = nn.Dense(cfg.ffn_dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="ffn_in")(x)
         h = nn.gelu(h)
+        # ffn-dim activation stays tp-sharded between the two FFN matmuls
+        # (same pin as the Llama MLP).
+        h = constrain(h, DATA_AXES, None, "tp")
         h = nn.Dense(cfg.dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="ffn_out")(h)
-        return ln("ln_ffn")((x + h).astype(jnp.float32)).astype(cfg.dtype)
+        return constrain(
+            ln("ln_ffn")((x + h).astype(jnp.float32)).astype(cfg.dtype),
+            DATA_AXES, None, None,
+        )
 
 
 def _remat_policy(cfg: BertConfig):

@@ -70,9 +70,20 @@ class LlamaConfig:
     # bandwidth — measured SLOWER on the chip (32.1% vs 39.3% MFU at
     # moe-125m) and kept as the independent differential-testing oracle
     # for the routing algebra (tests/test_workload_tier.py TestMoE);
-    # indices must stay shard-local, so meshes with an `ep` axis fall
-    # back to einsum.
+    # indices must stay shard-local, so meshes with a resolved expert
+    # axis (`ep`, or `fsdp` carrying the expert dim) fall back to einsum.
     moe_impl: str = "einsum"
+    # GShard grouped dispatch: tokens route in independent groups of this
+    # many sequence positions (0 = one group spanning the sequence).
+    # The dispatch/combine one-hot einsums cost b·s·e·cap·d MACs with
+    # cap ∝ s/e — QUADRATIC in tokens-per-group, and at moe-125m
+    # (s=2048, e=8, cap=640) they outweigh the expert FFN itself: the
+    # uncounted routing tax behind the 0.39 MFU. Grouping divides that
+    # cost (and the [b,s,e,cap] mask footprint) by the group count while
+    # keeping the same static-shaped algebra; capacity is enforced
+    # per group (more local drops — standard GShard group_size
+    # semantics, arXiv:2006.16668 §3.2).
+    moe_group_size: int = 0
     # Microbatches per pipeline round when the mesh has a pp axis
     # (0 = one per stage). More microbatches shrink the GPipe bubble
     # ((pp-1)/(M+pp-1)) at the cost of smaller per-stage matmuls.
@@ -165,6 +176,9 @@ CONFIGS = {
     "moe-125m": LlamaConfig(
         dim=768, n_layers=12, n_heads=6, n_kv_heads=6, ffn_dim=2048,
         n_experts=8, experts_per_token=2, remat_policy="dots+rope+norms",
+        # 256-token groups: 8x less dispatch/combine work at seq 2048
+        # (cap 640 -> 80 per group) — see moe_group_size.
+        moe_group_size=256,
     ),
     "moe-tiny": LlamaConfig(
         vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=128,
@@ -281,7 +295,19 @@ class Attention(nn.Module):
         else:
             out = attn_ops.xla_attention(q, k, v, causal=True)
 
-        return dense(features=cfg.dim, axis=(-2, -1), name="wo")(out)
+        from ..parallel.sharding import DATA_AXES, constrain
+
+        # Attention boundary annotations: the kernel output keeps heads on
+        # tp (where the wo contraction consumes them) and the projection
+        # back to the residual stream lands directly in the canonical
+        # batch layout — without the pins the partitioner is free to pick
+        # a head-sharded layout for the residual add and bridge the clash
+        # with a resharding copy per layer.
+        out = constrain(out, DATA_AXES, "sp", "tp", None)
+        return constrain(
+            dense(features=cfg.dim, axis=(-2, -1), name="wo")(out),
+            DATA_AXES, "sp", None,
+        )
 
 
 class MLP(nn.Module):
@@ -297,14 +323,21 @@ class MLP(nn.Module):
             param_dtype=cfg.param_dtype,
             kernel_init=nn.initializers.normal(0.02),
         )
+        from ..parallel.sharding import DATA_AXES, constrain
+
         # Separate gate/up, NOT a fused [d, 2f] w13: measured ~2.5% slower
         # fused on v5e (same split-copy cost as the wqkv experiment).
         gate = dense(cfg.ffn_dim, name="w1")(x)
         up = dense(cfg.ffn_dim, name="w3")(x)
         # Named for optional checkpointing (remat_policy "dots+act"): under
         # plain "dots" the silu*up product is recomputed in the backward.
-        act = checkpoint_name(nn.silu(gate) * up, "mlp_act")
-        return dense(cfg.dim, name="w2")(act)
+        # The ffn-dim activation is pinned tp-sharded (where w1/w3 produce
+        # it and w2 consumes it) so the elementwise silu*up never collects
+        # a tp all-gather between the two matmuls.
+        act = checkpoint_name(
+            constrain(nn.silu(gate) * up, DATA_AXES, "sp", "tp"), "mlp_act"
+        )
+        return constrain(dense(cfg.dim, name="w2")(act), DATA_AXES, "sp", None)
 
 
 class MoE(nn.Module):
@@ -326,10 +359,20 @@ class MoE(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        from ..parallel.sharding import constrain
+        from ..parallel.sharding import DATA_AXES, constrain, moe_expert_axes
 
         cfg = self.config
-        b, s, d = x.shape
+        b0, s0, d = x.shape
+        # Grouped dispatch (see moe_group_size): fold sequence groups into
+        # the batch dim so the routing algebra below runs unchanged on
+        # [b·g, group, d] with a per-group capacity. Init traces (short
+        # probe sequences) fall through g=1; params are shape-independent.
+        groups = 1
+        if (cfg.moe_group_size and s0 > cfg.moe_group_size
+                and s0 % cfg.moe_group_size == 0):
+            groups = s0 // cfg.moe_group_size
+            x = x.reshape(b0 * groups, cfg.moe_group_size, d)
+        b, s, _ = x.shape
         e, k = cfg.n_experts, cfg.experts_per_token
         cap = max(1, int(cfg.capacity_factor * s * k / e))
 
@@ -364,8 +407,13 @@ class MoE(nn.Module):
         from ..parallel.mesh import current_mesh
 
         mesh = current_mesh()
-        ep = int(mesh.shape.get("ep", 1)) if mesh is not None else 1
-        use_gather = cfg.moe_impl == "gather" and ep == 1
+        # Expert placement mirrors the weight rules (parallel/sharding.py):
+        # `ep` when the mesh has one, else `fsdp` when e divides it (each
+        # device holds whole experts; dispatch is the all-to-all), else
+        # replicated. The gather oracle needs shard-local indices, so any
+        # resolved expert axis falls back to einsum.
+        expert_ax, expert_batch_axes = moe_expert_axes(mesh, e)
+        use_gather = cfg.moe_impl == "gather" and expert_ax is None
 
         init = nn.initializers.normal(0.02)
         w1 = self.param("experts_w1", init, (e, d, cfg.ffn_dim), cfg.param_dtype)
@@ -443,19 +491,26 @@ class MoE(nn.Module):
                 combine = combine + (
                     keep * gate[:, :, j, None].astype(cfg.dtype)
                 )[..., None] * slot
+            # Token-layout routing masks pinned to the canonical batch
+            # layout: left unconstrained, the partitioner propagates the
+            # expert-sharded dispatch OUTPUT's layout backwards into the
+            # mask construction and the whole residual stream reshards
+            # around the MoE layer every step.
+            combine = constrain(combine, DATA_AXES, "sp", None, None)
             dispatch = (combine > 0).astype(cfg.dtype)
 
             # Dispatch: tokens -> per-expert slots. The constraint reshards
-            # the expert dim onto ep (all-to-all); batch stays on the other
-            # data axes. dispatch is a 0/1 mask (exactly representable in
-            # bf16), so the largest routing contraction runs at full MXU
-            # rate in model dtype.
+            # the expert dim onto the resolved expert axis (the MoE
+            # all-to-all); batch stays on the remaining data axes.
+            # dispatch is a 0/1 mask (exactly representable in bf16), so
+            # the largest routing contraction runs at full MXU rate in
+            # model dtype.
             expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, x.astype(cfg.dtype))
             expert_in = constrain(
-                expert_in, "ep", ("slice", "dp", "fsdp"), None, None
+                expert_in, expert_ax, expert_batch_axes, None, None
             )
             out = expert_ffn(expert_in)
-            out = constrain(out, "ep", ("slice", "dp", "fsdp"), None, None)
+            out = constrain(out, expert_ax, expert_batch_axes, None, None)
 
             # Combine: weighted return all-to-all back to token layout.
             # bf16 operands / fp32 accumulation: a genuinely fp32 einsum
@@ -469,13 +524,18 @@ class MoE(nn.Module):
             )
 
         # Switch load-balance loss: e * Σ_i f_i·P_i (f = dispatch fraction,
-        # P = mean router prob); minimized at uniform routing.
+        # P = mean router prob); minimized at uniform routing. Means over
+        # (batch, position) are group-invariant: the grouped reshape
+        # changes which tokens race for capacity, not these statistics.
         f_frac = onehot.astype(jnp.float32).sum(axis=2).mean(axis=(0, 1)) / k
         p_mean = probs.mean(axis=(0, 1))
         aux = e * jnp.sum(f_frac * p_mean) * cfg.router_aux_weight
         self.sow("losses", "moe_aux", aux)
 
-        return y.astype(x.dtype)
+        y = y.astype(x.dtype)
+        if groups > 1:
+            y = y.reshape(b0, s0, d)
+        return constrain(y, DATA_AXES, "sp", None)
 
 
 class Block(nn.Module):
@@ -489,16 +549,30 @@ class Block(nn.Module):
         from ..parallel.sharding import DATA_AXES, constrain
 
         cfg = self.config
-        # Pin activations to the canonical layout at block boundaries so the
-        # partitioner doesn't oscillate between layouts across the residual
-        # stream (a no-op without a scoped mesh).
+        # Pin activations to the canonical layout at every residual-stream
+        # boundary — block entry, between the attention and MLP sublayers,
+        # block exit — so the partitioner doesn't oscillate between layouts
+        # across the residual stream (a no-op without a scoped mesh).
         x = constrain(x, DATA_AXES, "sp", None)
         x = x + Attention(cfg, name="attention")(
             RMSNorm(cfg.norm_eps, cfg.param_dtype, name="attention_norm")(x)
         )
+        x = constrain(x, DATA_AXES, "sp", None)
         ffn = MoE(cfg, name="feed_forward") if cfg.n_experts else MLP(cfg, name="feed_forward")
         x = x + ffn(RMSNorm(cfg.norm_eps, cfg.param_dtype, name="ffn_norm")(x))
         return constrain(x, DATA_AXES, "sp", None), None
+
+
+# Saveable-tensor vocabulary for the "dots+..." remat policies: token ->
+# checkpoint_name tags. The policy string is an open composition ("dots"
+# plus any "+"-joined subset, order-free) so bench sweeps can tune the
+# HBM-vs-recompute point per config without a code change
+# (TF_OPERATOR_REMAT_POLICY in bench.py).
+REMAT_SAVEABLE = {
+    "act": ("mlp_act",),
+    "rope": ("rope_q", "rope_k"),
+    "norms": ("norm_out",),
+}
 
 
 def _remat_policy(cfg: LlamaConfig):
@@ -507,26 +581,24 @@ def _remat_policy(cfg: LlamaConfig):
     ops/flash_pallas.py): with q/k/v already dot-saveable, every VJP
     residual is checkpointed and the backward replay skips re-running the
     forward kernel. The "dots+..." variants trade more HBM for less
-    backward recompute (remat sweep, BASELINE.md): "+act" also saves the
-    SwiGLU silu*up product, "+rope" the rotated q/k."""
-
-    def dots_plus(*names):
-        return jax.checkpoint_policies.save_from_both_policies(
-            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            jax.checkpoint_policies.save_only_these_names(
-                "flash_o", "flash_lse", *names
-            ),
+    backward recompute (remat sweep, BASELINE.md): any "+"-joined
+    combination of REMAT_SAVEABLE tokens, e.g. "dots+rope+norms"."""
+    name = cfg.remat_policy
+    if name == "nothing":
+        return jax.checkpoint_policies.nothing_saveable
+    parts = name.split("+")
+    if parts[0] != "dots" or not all(p in REMAT_SAVEABLE for p in parts[1:]):
+        raise ValueError(
+            f"unknown remat_policy {name!r}: expected 'nothing' or 'dots' "
+            f"joined with any of {sorted(REMAT_SAVEABLE)} (e.g. 'dots+rope')"
         )
-
-    return {
-        "nothing": jax.checkpoint_policies.nothing_saveable,
-        "dots": dots_plus(),
-        "dots+act": dots_plus("mlp_act"),
-        "dots+rope": dots_plus("rope_q", "rope_k"),
-        "dots+act+rope": dots_plus("mlp_act", "rope_q", "rope_k"),
-        "dots+norms": dots_plus("norm_out"),
-        "dots+rope+norms": dots_plus("rope_q", "rope_k", "norm_out"),
-    }[cfg.remat_policy]
+    names = [tag for p in parts[1:] for tag in REMAT_SAVEABLE[p]]
+    return jax.checkpoint_policies.save_from_both_policies(
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        jax.checkpoint_policies.save_only_these_names(
+            "flash_o", "flash_lse", *names
+        ),
+    )
 
 
 class Llama(nn.Module):

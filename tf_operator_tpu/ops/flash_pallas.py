@@ -49,6 +49,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax 0.4.x names it TPUCompilerParams; renamed to CompilerParams in 0.5+.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
 # Large-but-finite mask value: exp(x - x) on a fully-masked row must not
 # produce inf-inf = nan, so we avoid true -inf in the score matrix.
 MASK_VALUE = -1e30
@@ -208,7 +213,7 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running denom l
             pltpu.VMEM((block_q, head_dim), jnp.float32),  # output accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -412,7 +417,7 @@ def _flash_backward(causal, block_q, block_k, interpret, residuals, do,
             (1, 1, block_q, head_dim), lambda b, h, qi, ki: (b, h, qi, 0)
         ),
         scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -467,7 +472,7 @@ def _flash_backward(causal, block_q, block_k, interpret, residuals, do,
             pltpu.VMEM((block_k, head_dim), jnp.float32),
             pltpu.VMEM((block_k, head_dim), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=(
                 "parallel",
                 "parallel",

@@ -171,9 +171,9 @@ def sharded_ring_attention(q, k, v):
     to full causal attention when no mesh is scoped or it has no sp axis."""
     from functools import partial
 
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
+    from ..parallel.compat import is_legacy_shard_map, shard_map
     from ..parallel.mesh import current_mesh
     from ..parallel.sharding import DATA_AXES, _present
 
@@ -184,6 +184,13 @@ def sharded_ring_attention(q, k, v):
     # as a data axis outside expert compute; a divergent hardcoded tuple
     # here would crash sp+ep meshes at trace time).
     spec = P(*_present(mesh, DATA_AXES, "sp", "tp", None))
+    kwargs = {}
+    if is_legacy_shard_map():
+        # jax 0.4.x: the replication checker mis-types the ring's cond
+        # carries ("branches of cond produced mismatched replication
+        # types") — upstream's own suggested workaround is check_rep=False;
+        # the varying-axes typing that replaces it doesn't exist there.
+        kwargs["check_rep"] = False
     return shard_map(
         partial(
             ring_attention, axis_name="sp", vary_axes=tuple(mesh.axis_names)
@@ -191,4 +198,5 @@ def sharded_ring_attention(q, k, v):
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        **kwargs,
     )(q, k, v)

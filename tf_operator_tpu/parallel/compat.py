@@ -1,0 +1,53 @@
+"""jax version compatibility shims for the sharding/shard_map surface.
+
+One place for the 0.4.x-vs-0.5+ API drift every shard_map consumer needs
+(ring attention, the pp pipeline, the dryrun entry, tests), instead of a
+copy of the probe in each:
+
+- ``shard_map``: top-level in jax >= 0.5, under ``jax.experimental`` in
+  0.4.x.
+- ``supports_partial_manual()``: 0.5+ spells partially-manual regions
+  ``axis_names={...}``; 0.4.x spells them inversely (``auto=``) and its
+  jaxlib then fails the lowering ("PartitionId instruction is not
+  supported for SPMD partitioning") — so the feature is effectively
+  absent there and callers gate/skip on this probe.
+- ``rep_check_kwarg()``: the replication/varying-axes checker knob is
+  ``check_vma`` in 0.5+ and ``check_rep`` in 0.4.x.
+- ``is_legacy_shard_map()``: True on the 0.4.x experimental module —
+  where the rep checker predates varying-axes typing and mis-types some
+  control-flow carries (callers pass ``check_rep=False`` there, the
+  upstream-suggested workaround).
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.5 exposes it at top level; 0.4.x under experimental
+    from jax import shard_map
+except ImportError:  # pragma: no cover - exercised on 0.4.x containers
+    from jax.experimental.shard_map import shard_map
+
+__all__ = [
+    "shard_map",
+    "supports_partial_manual",
+    "rep_check_kwarg",
+    "is_legacy_shard_map",
+]
+
+_PARAMS = frozenset(inspect.signature(shard_map).parameters)
+
+
+def supports_partial_manual() -> bool:
+    """True when shard_map takes ``axis_names=`` (partial-manual mode)."""
+    return "axis_names" in _PARAMS
+
+
+def rep_check_kwarg() -> str:
+    """Name of the replication-check knob on this jax."""
+    return "check_vma" if "check_vma" in _PARAMS else "check_rep"
+
+
+def is_legacy_shard_map() -> bool:
+    """True on the jax 0.4.x experimental implementation."""
+    return "experimental" in getattr(shard_map, "__module__", "")

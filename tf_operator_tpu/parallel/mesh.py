@@ -146,11 +146,15 @@ def mark_varying(x, axes):
     carries of collective schedules (ring attention, the pp pipeline) must
     match their body outputs' varying-axes type. Uses `jax.lax.pcast`
     (current API) with `pvary` fallback; NameError (axis not bound — an
-    unmapped fallback path) leaves x unmarked."""
+    unmapped fallback path) leaves x unmarked. jax 0.4.x has NEITHER (no
+    varying-axes type system at all) — nothing to mark, x passes through."""
     fn = getattr(jax.lax, "pcast", None)
     try:
         if fn is not None:
             return fn(x, tuple(axes), to="varying")
-        return jax.lax.pvary(x, tuple(axes))
+        fn = getattr(jax.lax, "pvary", None)
+        if fn is not None:
+            return fn(x, tuple(axes))
+        return x
     except NameError:
         return x

@@ -32,8 +32,9 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
+
+from .compat import shard_map, supports_partial_manual
 
 
 def pipeline_apply(
@@ -121,13 +122,29 @@ def pipeline_apply(
         return outputs
 
     params_spec = jax.tree.map(lambda _: P(axis_name), stage_params)
-    out = shard_map(
-        pipelined,
-        mesh=mesh,
-        axis_names={axis_name},
-        in_specs=(params_spec, P(), *(P() for _ in consts)),
-        out_specs=P(axis_name),  # stacked per-stage: [pp, M, mb, ...]
-    )(stage_params, x_mb, *consts)
+    if supports_partial_manual():
+        wrapped = shard_map(
+            pipelined,
+            mesh=mesh,
+            axis_names={axis_name},
+            in_specs=(params_spec, P(), *(P() for _ in consts)),
+            out_specs=P(axis_name),  # stacked per-stage: [pp, M, mb, ...]
+        )
+    else:
+        # jax 0.4.x: partially-manual shard_map is declared inversely —
+        # `auto` lists the axes that STAY auto-partitioned (and rep
+        # checking doesn't support the mixed mode). Best-effort: that
+        # jaxlib typically cannot lower the result (PartitionId under
+        # partial SPMD) — callers gate on supports_partial_manual().
+        wrapped = shard_map(
+            pipelined,
+            mesh=mesh,
+            in_specs=(params_spec, P(), *(P() for _ in consts)),
+            out_specs=P(axis_name),
+            auto=frozenset(mesh.axis_names) - {axis_name},
+            check_rep=False,
+        )
+    out = wrapped(stage_params, x_mb, *consts)
     # Only the last stage's slot holds real outputs.
     out = out.reshape(pp, num_microbatches, mb, *x.shape[1:])[-1]
     return out.reshape(batch, *x.shape[1:]).astype(compute_dtype)

@@ -41,11 +41,19 @@ def _present(mesh: Mesh, *axes: str) -> Tuple:
 # '/' and lowercased, e.g. "params/layers_0/attention/wq/kernel". A dict
 # value selects by ndim (attention kernels are [d, heads, head_dim] when the
 # head axes are kept separate, [d, h*hd] when merged).
+#
+# The "expert" pseudo-axis on MoE weights resolves per mesh (see
+# _resolve_expert_axis): `ep` when the mesh has one; otherwise the leading
+# expert dim shards over `fsdp` whenever the expert count divides it —
+# each device then stores its experts WHOLE and the dispatch all-to-all
+# moves tokens to them, instead of FSDP slicing every expert over `d` and
+# all-gathering ALL e experts' weights every step (e× the dense FFN's
+# weight traffic; the measured moe-125m killer on ep-less meshes).
 _PARAM_RULES = [
     # MoE expert weights [experts, d, ffn] / [experts, ffn, d]: experts over
-    # ep, then the usual megatron layout within each expert.
-    (r"experts.*(w1|w3|gate|up).*", ("ep", "fsdp", "tp")),
-    (r"experts.*(w2|down).*", ("ep", "tp", "fsdp")),
+    # the resolved expert axis, then the usual megatron layout within each.
+    (r"experts.*(w1|w3|gate|up).*", ("expert", "fsdp", "tp")),
+    (r"experts.*(w2|down).*", ("expert", "tp", "fsdp")),
     (r"router.*kernel", (None, None)),
     # Embedding [vocab, d]: vocab over fsdp, d over tp. The reverse
     # (vocab/tp, d/fsdp) makes both the fwd token gather and the bwd
@@ -62,12 +70,55 @@ _PARAM_RULES = [
 ]
 
 
-def spec_for_param(path: str, ndim: int, mesh: Mesh) -> P:
+def _resolve_expert_axis(mesh: Mesh, n_experts: Optional[int]) -> Optional[str]:
+    """Mesh axis carrying the MoE expert dim: `ep` when present, else
+    `fsdp` when the expert count divides it (each device holds whole
+    experts — expert parallelism riding the data axis), else None
+    (replicated experts; an fsdp extent that doesn't divide e would leave
+    devices idle during expert compute)."""
+    if "ep" in mesh.shape:
+        return "ep"
+    fsdp = mesh.shape.get("fsdp", 0)
+    if fsdp and fsdp > 1 and n_experts and n_experts % fsdp == 0:
+        return "fsdp"
+    return None
+
+
+def moe_expert_axes(mesh: Optional[Mesh], n_experts: int):
+    """(expert_axis, batch_axes) for the MoE dispatch/combine activation
+    constraints ([e, b, cap, d] tensors): the expert dim rides the resolved
+    expert axis, the batch dim the REMAINING data axes — the same
+    resolution the expert-weight rules use, so dispatch output lands
+    exactly on the layout the expert matmuls want."""
+    if mesh is None:
+        return None, DATA_AXES
+    expert_ax = _resolve_expert_axis(mesh, n_experts)
+    batch_axes = tuple(a for a in DATA_AXES if a != expert_ax and a != "ep")
+    return expert_ax, batch_axes
+
+
+def spec_for_param(path: str, ndim: int, mesh: Mesh, shape=None) -> P:
     path = path.lower()
     for pattern, axes in _PARAM_RULES:
         if re.search(pattern, path):
             if isinstance(axes, dict):
                 axes = axes.get(ndim, axes[max(axes)])
+            if "expert" in axes:
+                # Resolve the expert pseudo-axis against the ACTUAL expert
+                # count. Rules shorter than ndim are right-aligned (the
+                # scanned stack prepends a [n_layers] dim), so the shape
+                # element under the placeholder sits at pad_offset + index.
+                i = tuple(axes).index("expert")
+                offset = max(0, ndim - len(axes))
+                n_experts = None
+                if shape is not None and offset + i < len(shape):
+                    n_experts = shape[offset + i]
+                expert_ax = _resolve_expert_axis(mesh, n_experts)
+                axes = tuple(
+                    expert_ax if a == "expert"
+                    else (None if a == expert_ax else a)
+                    for a in axes
+                )
             axes = _present(mesh, *axes)
             if len(axes) < ndim:
                 pad = [None] * (ndim - len(axes))
@@ -87,7 +138,10 @@ def shard_params_spec(params: Any, mesh: Mesh) -> Any:
         if isinstance(node, dict):
             return {k: walk(path_parts + (k,), v) for k, v in node.items()}
         path = "/".join(str(p) for p in path_parts)
-        return spec_for_param(path, getattr(node, "ndim", 0), mesh)
+        return spec_for_param(
+            path, getattr(node, "ndim", 0), mesh,
+            shape=getattr(node, "shape", None),
+        )
 
     return walk((), params)
 

@@ -37,14 +37,27 @@ from ..bootstrap.heartbeat import (
     ENV_HEARTBEAT_LEASE,
     ENV_HEARTBEAT_NAMESPACE,
 )
-from ..core.constants import ANNOTATION_HEARTBEAT_STEP
+from ..core.constants import ANNOTATION_HEARTBEAT_STEP, ANNOTATION_HEARTBEAT_TPS
 
 log = logging.getLogger(__name__)
 
 
 # ------------------------------------------------------------- publication
+def _progress_annotations(step: Optional[int],
+                          tokens_per_sec: Optional[float]) -> Dict[str, str]:
+    """Lease annotations for the workload-reported progress payload."""
+    out: Dict[str, str] = {}
+    if step is not None:
+        out[ANNOTATION_HEARTBEAT_STEP] = str(step)
+    if tokens_per_sec is not None:
+        out[ANNOTATION_HEARTBEAT_TPS] = f"{float(tokens_per_sec):.1f}"
+    return out
+
+
 def publish_heartbeat(cluster, namespace: str, name: str, identity: str,
-                      step: Optional[int] = None, clock=time.time) -> bool:
+                      step: Optional[int] = None,
+                      tokens_per_sec: Optional[float] = None,
+                      clock=time.time) -> bool:
     """One heartbeat renewal through the Cluster seam. True iff the beat
     landed; False on a lost optimistic-concurrency round (retry next tick).
 
@@ -73,10 +86,9 @@ def publish_heartbeat(cluster, namespace: str, name: str, identity: str,
                 "leaseDurationSeconds": 0,
             },
         }
-        if step is not None:
-            lease["metadata"]["annotations"] = {
-                ANNOTATION_HEARTBEAT_STEP: str(step)
-            }
+        annotations = _progress_annotations(step, tokens_per_sec)
+        if annotations:
+            lease["metadata"]["annotations"] = annotations
         try:
             cluster.create_lease(lease)
             return True
@@ -92,10 +104,11 @@ def publish_heartbeat(cluster, namespace: str, name: str, identity: str,
     spec = lease.setdefault("spec", {})
     spec["holderIdentity"] = identity
     spec["renewTime"] = _format_microtime(now)
-    if step is not None:
+    new_annotations = _progress_annotations(step, tokens_per_sec)
+    if new_annotations:
         meta = lease.setdefault("metadata", {})
         annotations = meta.get("annotations") or {}
-        annotations[ANNOTATION_HEARTBEAT_STEP] = str(step)
+        annotations.update(new_annotations)
         meta["annotations"] = annotations
     try:
         cluster.update_lease(lease)
@@ -107,14 +120,18 @@ def publish_heartbeat(cluster, namespace: str, name: str, identity: str,
         return False
 
 
-def write_heartbeat_file(path: str, seq: int, step: Optional[int]) -> None:
+def write_heartbeat_file(path: str, seq: int, step: Optional[int],
+                         tokens_per_sec: Optional[float] = None) -> None:
     """The file half of the process-tier bridge: one JSON object, replaced
     wholesale each beat (write-to-temp + rename so the reader never sees a
     torn write). ``seq`` strictly increases so the bridge can tell a fresh
     beat from a re-read."""
     tmp = f"{path}.tmp"
+    payload = {"seq": seq, "step": step, "ts": time.time()}
+    if tokens_per_sec is not None:
+        payload["tokens_per_sec"] = float(tokens_per_sec)
     with open(tmp, "w") as fh:
-        json.dump({"seq": seq, "step": step, "ts": time.time()}, fh)
+        json.dump(payload, fh)
     os.replace(tmp, path)
 
 
@@ -132,14 +149,16 @@ def read_heartbeat_file(path: str) -> Optional[dict]:
 # --------------------------------------------------------------- publisher
 class HeartbeatPublisher:
     """Daemon renewal loop around one sink. ``record_progress`` updates the
-    step AND wakes the loop so a long sleep never delays the proof of the
-    step that just completed."""
+    step (and, optionally, the workload-reported throughput) AND wakes the
+    loop so a long sleep never delays the proof of the step that just
+    completed."""
 
-    def __init__(self, sink: Callable[[int, Optional[int]], None],
+    def __init__(self, sink: Callable[[int, Optional[int], Optional[float]], None],
                  interval: float):
         self._sink = sink
         self.interval = max(0.05, float(interval))
         self._step: Optional[int] = None
+        self._tokens_per_sec: Optional[float] = None
         self._seq = 0
         self._wake = threading.Event()
         self._stopped = threading.Event()
@@ -153,9 +172,12 @@ class HeartbeatPublisher:
             self._thread.start()
         return self
 
-    def record_progress(self, step: Optional[int] = None) -> None:
+    def record_progress(self, step: Optional[int] = None,
+                        tokens_per_sec: Optional[float] = None) -> None:
         if step is not None:
             self._step = int(step)
+        if tokens_per_sec is not None:
+            self._tokens_per_sec = float(tokens_per_sec)
         self._wake.set()
 
     def beat_once(self) -> None:
@@ -163,7 +185,7 @@ class HeartbeatPublisher:
         broken sink must not take the training process down with it."""
         self._seq += 1
         try:
-            self._sink(self._seq, self._step)
+            self._sink(self._seq, self._step, self._tokens_per_sec)
         except Exception:  # noqa: BLE001 — liveness must never kill training
             log.debug("heartbeat sink failed", exc_info=True)
 
@@ -216,8 +238,10 @@ def start_from_env(cluster=None,
         file_path = env.get(ENV_HEARTBEAT_FILE)
         if file_path:
             def sink(seq: int, step: Optional[int],
+                     tokens_per_sec: Optional[float] = None,
                      _path=file_path) -> None:
-                write_heartbeat_file(_path, seq, step)
+                write_heartbeat_file(_path, seq, step,
+                                     tokens_per_sec=tokens_per_sec)
         else:
             if cluster is None and "KUBERNETES_SERVICE_HOST" in env:
                 try:
@@ -231,21 +255,27 @@ def start_from_env(cluster=None,
             if cluster is None:
                 return None
 
-            def sink(seq: int, step: Optional[int], _c=cluster,
+            def sink(seq: int, step: Optional[int],
+                     tokens_per_sec: Optional[float] = None, _c=cluster,
                      _ns=namespace, _name=lease, _id=identity) -> None:
-                publish_heartbeat(_c, _ns, _name, _id, step=step)
+                publish_heartbeat(_c, _ns, _name, _id, step=step,
+                                  tokens_per_sec=tokens_per_sec)
 
         _active = HeartbeatPublisher(sink, interval).start()
         return _active
 
 
-def record_progress(step: Optional[int] = None) -> None:
-    """Training-loop API: prove liveness now (and record the step). A
-    no-op when no publisher is active, so workloads can call it
-    unconditionally — the same script runs with and without the operator."""
+def record_progress(step: Optional[int] = None,
+                    tokens_per_sec: Optional[float] = None) -> None:
+    """Training-loop API: prove liveness now (and record the step; and,
+    optionally, the measured training throughput — exported by the
+    operator as the ``training_workload_tokens_per_sec`` gauge, the
+    utilization signal autoscaling consumes). A no-op when no publisher is
+    active, so workloads can call it unconditionally — the same script
+    runs with and without the operator."""
     publisher = _active
     if publisher is not None:
-        publisher.record_progress(step)
+        publisher.record_progress(step, tokens_per_sec=tokens_per_sec)
 
 
 def stop() -> None:

@@ -1,0 +1,102 @@
+"""Throughput-parity workload: the measurement half of the multi-process
+parity e2e (tests/test_throughput_parity.py).
+
+Where rendezvous_workload proves the collective FABRIC through the
+operator-injected env, this proves the fabric's SPEED: the same sharded
+llama train step the bench harness times, run through ``tpu_init()`` (env
+rendezvous + declared mesh) with the full input pipeline — host stream ->
+DevicePrefetch device double-buffer -> donated batch — and timed. One JSON
+line on stdout per process::
+
+    {"process_id": N, "devices": N, "tokens_per_sec_chip": X,
+     "step_ms": X, "loss": X}
+
+The e2e compares a 2-process run (1 device per process, cross-process
+collectives over gloo) against a single-process run of the SAME global
+batch over the SAME mesh shape (2 local devices, in-process collectives):
+the operator-injected env must cost nothing but the transport. Tolerance is
+documented in docs/design/workload_performance.md — on CPU/gloo the bound
+is deliberately loose (transport dominates tiny models); on TPU/ICI the
+contract is near-parity.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--warmup", type=int, default=3)
+    parser.add_argument("--global-batch", type=int, default=8)
+    parser.add_argument("--seq", type=int, default=64)
+    parser.add_argument("--model", default="llama-tiny")
+    args = parser.parse_args(argv)
+
+    import jax
+
+    from tf_operator_tpu.models import llama
+    from tf_operator_tpu.parallel.sharding import batch_sharding
+    from tf_operator_tpu.runtime.tpu_init import tpu_init
+    from tf_operator_tpu.train.data import DevicePrefetch, SyntheticTokens
+    from tf_operator_tpu.train.train_step import (
+        init_sharded_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    topo, mesh = tpu_init(timeout_seconds=60)
+    n = jax.device_count()
+    print(
+        f"[parity] process {topo.process_id}/{topo.num_processes} devices={n} "
+        f"mesh={dict(zip(mesh.axis_names, mesh.devices.shape))}",
+        file=sys.stderr, flush=True,
+    )
+    if args.global_batch % topo.num_processes:
+        print("[parity] global batch must divide process count", file=sys.stderr)
+        return 2
+    local_batch = args.global_batch // topo.num_processes
+
+    config = llama.CONFIGS[args.model]
+    model = llama.Llama(config)
+    opt = make_optimizer(warmup_steps=1, decay_steps=max(args.steps, 10))
+    state, sharding = init_sharded_train_state(
+        model, jax.random.PRNGKey(0), opt, mesh, batch=1,
+        seq=min(args.seq, 128),
+    )
+    step_fn, _ = make_train_step(
+        model, opt, mesh, state, sharding=sharding, donate_batch=True
+    )
+    data = SyntheticTokens(local_batch, args.seq, config.vocab_size,
+                           seed=topo.process_id)
+    batches = DevicePrefetch(data, batch_sharding(mesh, with_sp=False),
+                             depth=2)
+    for _ in range(max(args.warmup, 1)):
+        state, loss = step_fn(state, next(batches))
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, loss = step_fn(state, next(batches))
+    final_loss = float(loss)  # device->host fetch is the barrier
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = args.global_batch * args.seq * args.steps / dt
+    print(json.dumps({
+        "process_id": topo.process_id,
+        "num_processes": topo.num_processes,
+        "devices": n,
+        "tokens_per_sec_chip": round(tokens_per_sec / n, 1),
+        "step_ms": round(dt / args.steps * 1000.0, 3),
+        "loss": round(final_loss, 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

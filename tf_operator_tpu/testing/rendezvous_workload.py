@@ -53,10 +53,7 @@ def main(argv=None) -> int:
 
     from jax.sharding import PartitionSpec as P
 
-    try:  # jax >= 0.5 exposes it at top level; 0.4.x under experimental
-        shard_map = jax.shard_map
-    except AttributeError:
-        from jax.experimental.shard_map import shard_map
+    from tf_operator_tpu.parallel.compat import shard_map
 
     def contribute():
         total = jnp.float32(1.0)

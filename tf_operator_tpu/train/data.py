@@ -2,11 +2,14 @@
 
 Synthetic token streams (deterministic, seeded) so benchmarks measure the
 training path, not disk IO. Batches are produced host-side as numpy and
-device_put onto the data sharding — the one host->device transfer per step.
+device_put onto the data sharding; `DevicePrefetch` issues that transfer a
+step AHEAD of the consumer so the one host->device copy per step overlaps
+the running device step instead of sitting on the critical path.
 """
 
 from __future__ import annotations
 
+import collections
 import ctypes
 
 import numpy as np
@@ -153,6 +156,79 @@ def write_token_file(path: str, tokens: np.ndarray) -> None:
     if arr.dtype not in (np.dtype("int32"), np.dtype("uint16")):
         raise ValueError(f"token dtype must be uint16 or int32, got {arr.dtype}")
     arr.tofile(path)
+
+
+class DevicePrefetch:
+    """Device-side double-buffered prefetch: the second stage of the input
+    pipeline, after the host-side ring (native dataloader / SyntheticTokens).
+
+    Wraps a host batch iterator and keeps up to ``depth`` batches already
+    transferred onto ``sharding``. ``jax.device_put`` (and the multi-process
+    ``make_array_from_process_local_data``) only ENQUEUES the copy — so by
+    issuing batch k+1's transfer before batch k is consumed by the step, the
+    host->device hop (a network round trip on remote-relay PJRT backends)
+    runs concurrently with step k's compute. depth=2 is classic double
+    buffering: one batch feeding the step, one in flight.
+
+    Consumption accounting (checkpoint/restart contract): one host batch is
+    drawn per yielded batch PLUS the ``in_flight`` batches buffered ahead.
+    A resume must therefore derive its skip from STEPS TRAINED
+    (``skip_windows = start_step * local_batch`` — what llama_train passes
+    to TokenFileDataset), never from how many batches the host iterator
+    produced: the in-flight batches of a killed process were never trained
+    on and are simply re-produced by the resumed one. Double-consumption is
+    structurally impossible because the window index is a pure function of
+    the step count, not of this buffer.
+
+    Donation safety: every yielded array is a DISTINCT device buffer (one
+    transfer per host batch, nothing reused), so a train step donating its
+    batch argument (``make_train_step_for(donate_batch=True)``) can never
+    alias a batch still in flight; the step consuming batch k donates k's
+    buffer while k+1 already owns its own.
+    """
+
+    def __init__(self, host_iter, sharding=None, depth: int = 2, place=None):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        if place is None:
+            if sharding is None:
+                raise ValueError("DevicePrefetch needs a sharding or a place fn")
+
+            def place(batch, _sharding=sharding):
+                return shard_batch(batch, _sharding)
+
+        self._place = place
+        self._it = iter(host_iter)
+        self.depth = depth
+        self._buf = collections.deque()
+        self._exhausted = False
+
+    @property
+    def in_flight(self) -> int:
+        """Batches transferred but not yet yielded (resume accounting)."""
+        return len(self._buf)
+
+    def __iter__(self):
+        return self
+
+    def _fill(self) -> None:
+        while not self._exhausted and len(self._buf) < self.depth:
+            try:
+                batch = next(self._it)
+            except StopIteration:
+                self._exhausted = True
+                return
+            self._buf.append(self._place(batch))
+
+    def __next__(self):
+        self._fill()
+        if not self._buf:
+            raise StopIteration
+        out = self._buf.popleft()
+        # Issue the replacement transfer NOW — before the caller dispatches
+        # the step on `out` — so the copy overlaps that step end to end.
+        self._fill()
+        return out
 
 
 def shard_batch(batch, sharding):

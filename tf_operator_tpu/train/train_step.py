@@ -174,17 +174,26 @@ def state_sharding(state: TrainState, mesh: Mesh) -> TrainState:
         parts = []
         for p in path:
             parts.append(str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p)))))
-        return NamedSharding(mesh, spec_for_param("/".join(parts), ndim, mesh))
+        return NamedSharding(mesh, spec_for_param(
+            "/".join(parts), ndim, mesh, shape=getattr(leaf, "shape", None)
+        ))
 
     return jax.tree_util.tree_map_with_path(leaf_sharding, state)
 
 
 def make_train_step_for(custom_loss_fn, optimizer, mesh: Mesh, state: TrainState,
-                        sharding=None):
+                        sharding=None, donate_batch: bool = False):
     """Generic sharded step for ANY loss_fn(params, batch) -> scalar: jit
     over `mesh` with explicit in/out shardings, state donated so params/opt
     buffers update in place. The Llama path and the bench's BERT path both
-    ride this."""
+    ride this.
+
+    ``donate_batch=True`` additionally donates the batch argument so its
+    HBM buffer is recycled instead of allocated fresh each step. Opt-in,
+    not default: a donated batch array is dead after the step, so the
+    caller must never reuse it — safe under the one-transfer-per-batch
+    contract of ``data.DevicePrefetch`` (and the plain per-step
+    device_put loops), unsafe for callers that step twice on one array."""
     if sharding is None:
         sharding = state_sharding(state, mesh)
     data = batch_sharding(mesh, with_sp=False)  # [batch, seq(+1)]
@@ -207,15 +216,17 @@ def make_train_step_for(custom_loss_fn, optimizer, mesh: Mesh, state: TrainState
         stepper,
         in_shardings=(sharding, data),
         out_shardings=(sharding, NamedSharding(mesh, P())),
-        donate_argnums=(0,),
+        donate_argnums=(0, 1) if donate_batch else (0,),
     )
     return step, sharding
 
 
-def make_train_step(model, optimizer, mesh: Mesh, state: TrainState, sharding=None):
+def make_train_step(model, optimizer, mesh: Mesh, state: TrainState, sharding=None,
+                    donate_batch: bool = False):
     """jit the model LM step over `mesh` (see make_train_step_for)."""
     return make_train_step_for(
-        functools.partial(loss_fn, model), optimizer, mesh, state, sharding
+        functools.partial(loss_fn, model), optimizer, mesh, state, sharding,
+        donate_batch=donate_batch,
     )
 
 
