@@ -338,7 +338,8 @@ def default_dag() -> List[Step]:
         # span_sequence replay, and the PodGroup/admission lifecycle
         # hygiene regressions.
         Step("admission-chaos",
-             pytest + ["tests/test_admission.py", "-m", "not slow"],
+             pytest + ["tests/test_admission.py", "tests/test_policies.py",
+                       "-m", "not slow"],
              deps=["operator-integration"], retries=2),
         # Contention smoke (scripts/measure_control_plane.py --mode
         # contention --smoke): under a pool sized for half the submitted
@@ -350,6 +351,32 @@ def default_dag() -> List[Step]:
              [PY, "scripts/measure_control_plane.py", "--mode", "contention",
               "--smoke"],
              deps=["admission-chaos"], retries=3),
+        # Policy matrix (docs/design/gang_admission.md "Policy seam"):
+        # the contention comparison scenarios once per admission policy
+        # (priority / gavel / drf), each leg gating its own contract —
+        # gavel >=10% better effective fleet throughput than the
+        # chip-count-greedy default on the mixed-generation pool, drf
+        # bounding the dominant-share spread at <=1.5x the declared
+        # weight ratio while staying work-conserving vs the hard-quota
+        # baseline, and check_admission_invariants green under every
+        # policy. Each leg merge-writes only its own key into
+        # build/contention_policies_last.json (the per-policy ratchet).
+        # Depends on contention-smoke (not just admission-chaos): both
+        # steps read-modify-write the same ratchet file, and the legs
+        # must not interleave with the full table's write. The gavel/
+        # drf legs deliberately re-run their own in-process priority
+        # baselines (co-load cancels, like every other ratio gate) —
+        # ~two redundant short scenarios per run, accepted for gate
+        # robustness over reading a stale cross-process baseline.
+        Step("policy-matrix",
+             ["/bin/sh", "-c",
+              f"{PY} scripts/measure_control_plane.py --mode contention"
+              " --smoke --policy priority"
+              f" && {PY} scripts/measure_control_plane.py --mode contention"
+              " --smoke --policy gavel"
+              f" && {PY} scripts/measure_control_plane.py --mode contention"
+              " --smoke --policy drf"],
+             deps=["contention-smoke"], retries=3),
         # Shard-failover tier (docs/design/sharded_control_plane.md): the
         # sharded active-active control plane — ring/coordinator protocol
         # units, two-manager split/steal/handback integration, and the

@@ -1083,9 +1083,15 @@ def scale_main(smoke=False, qps=0.0, burst=0, latency=0.01,
 
 CONTENTION_BASELINE_PATH = os.path.join(
     REPO, "build", "contention_smoke_last.json")
+# The policy-vs-policy comparison table (--mode contention): one key per
+# admission policy, merge-written so the per-policy CI matrix steps
+# (policy-matrix) and the full comparison update only their own rows.
+CONTENTION_POLICIES_PATH = os.path.join(
+    REPO, "build", "contention_policies_last.json")
 
 
-def _contention_job(name, workers, duration, priority="", namespace="default"):
+def _contention_job(name, workers, duration, priority="", namespace="default",
+                    ratios=None):
     spec = {
         "jaxReplicaSpecs": {
             "Worker": {
@@ -1099,8 +1105,13 @@ def _contention_job(name, workers, duration, priority="", namespace="default"):
             }
         },
     }
-    if priority:
-        spec["runPolicy"] = {"schedulingPolicy": {"priorityClass": priority}}
+    if priority or ratios:
+        sp = {}
+        if priority:
+            sp["priorityClass"] = priority
+        if ratios:
+            sp["throughputRatios"] = dict(ratios)
+        spec["runPolicy"] = {"schedulingPolicy": sp}
     return {
         "apiVersion": "kubeflow.org/v1",
         "kind": "JAXJob",
@@ -1110,7 +1121,8 @@ def _contention_job(name, workers, duration, priority="", namespace="default"):
 
 
 def _run_contention(waves, capacity_pods, quotas=(), backfill_max_members=8,
-                    timeout=30.0):
+                    timeout=30.0, capacity=None, policy="priority",
+                    tenant_weights=()):
     """One contention scenario: submit `waves` (a list of manifest
     lists) against a `capacity_pods`-slot admission pool and run to full
     completion. Each wave is submitted only once every job of the prior
@@ -1122,7 +1134,15 @@ def _run_contention(waves, capacity_pods, quotas=(), backfill_max_members=8,
     utilization integral, the per-poll max of each namespace's live
     pods, and the manager's admission arbiter (for the invariant
     check). Everything runs through the real OperatorManager stack —
-    admission kicks, counted preemption teardowns, the lot."""
+    admission kicks, counted preemption teardowns, the lot.
+
+    `capacity` overrides the default flat "pods=N" pool with a raw
+    --capacity string (the generation-split pools of the policy table);
+    `capacity_pods` stays the utilization denominator either way.
+    `policy`/`tenant_weights` select the admission policy
+    (core/policies.py) and the drf fairness weights; the returned dict
+    additionally carries the effective-throughput time integral and the
+    per-tenant dominant-share samples the policy gates read."""
     from tf_operator_tpu.cluster.memory import InMemoryCluster
     from tf_operator_tpu.core.tracing import Tracer
 
@@ -1136,18 +1156,23 @@ def _run_contention(waves, capacity_pods, quotas=(), backfill_max_members=8,
             enabled_schemes=["JAXJob"], health_port=0, metrics_port=0,
             threadiness=4, resync_period=0.2,
             enable_gang_admission=True,
-            capacity=f"pods={capacity_pods}",
+            capacity=capacity or f"pods={capacity_pods}",
             namespace_quotas=list(quotas),
             backfill_max_members=backfill_max_members,
             admission_aging_seconds=300.0,
+            admission_policy=policy,
+            tenant_weights=list(tenant_weights),
         ),
         metrics=metrics,
         tracer=tracer,
     )
     manager.start()
     completions = {}
+    completion_ns = {}
     ns_peak: dict = {}
     util_area = 0.0
+    eff_area = 0.0
+    share_samples: list = []
     def registered(ns, name):
         """The job reached the arbiter: it owns live pods (admitted) or
         carries the Queued condition (waiting)."""
@@ -1184,6 +1209,13 @@ def _run_contention(waves, capacity_pods, quotas=(), backfill_max_members=8,
                 and p.status.phase in ("Pending", "Running")
             ]
             util_area += len(live) * (now - last)
+            # Effective-throughput time integral (Σ ratio × members over
+            # the admitted set, sampled per poll) and the per-tenant
+            # dominant-share trace — the gavel and drf gate inputs.
+            eff_area += manager.admission.effective_throughput() * (now - last)
+            shares = manager.admission.dominant_shares()
+            if shares:
+                share_samples.append((now - t0, shares))
             last = now
             by_ns: dict = {}
             for pod in live:
@@ -1197,6 +1229,7 @@ def _run_contention(waves, capacity_pods, quotas=(), backfill_max_members=8,
                 if any(c["type"] == "Succeeded" and c["status"] == "True"
                        for c in conds):
                     completions[name] = now - t0
+                    completion_ns[name] = ns
                     pending.pop(name)
         if pending:
             raise SystemExit(
@@ -1212,8 +1245,11 @@ def _run_contention(waves, capacity_pods, quotas=(), backfill_max_members=8,
         kubelet.join(timeout=5)
     return {
         "completions": {k: round(v, 3) for k, v in completions.items()},
+        "completion_ns": completion_ns,
         "makespan_s": round(makespan, 3),
         "utilization": round(utilization, 4),
+        "avg_effective_throughput": round(eff_area / max(makespan, 1e-9), 3),
+        "share_samples": share_samples,
         "ns_peak_pods": ns_peak,
         "admission": admission,
         "cluster": mem,
@@ -1326,7 +1362,240 @@ def _run_slice_backfill(timeout=30.0):
     }
 
 
-def contention_main(smoke=False) -> int:
+# ------------------------------------------------- policy comparison table
+
+# Mixed-generation scenario (the gavel-vs-default head-to-head): a
+# 16-slot pool split across two device generations. Two GEN-SENSITIVE
+# jobs arrive first (0.25x on the lite generation, 1.0x on current-gen —
+# a big model that thrashes a small chip's HBM), then two FLEXIBLE jobs
+# (1.0x everywhere). The chip-count-greedy default first-fits the
+# sensitive pair onto v5lite (alphabetical first fit — a slot is a
+# slot), parking 2×4 members at 0.25x; gavel places them on v6 and hands
+# v5lite to the jobs that don't care. Single-job waves pin arrival
+# order, so "who asked first" never races the 4-worker pool.
+GENERATION_CAPACITY = "pods@v5lite=8,pods@v6=8"
+GENERATION_POOL_PODS = 16
+SENSITIVE_RATIOS = {"v5lite": 0.25, "v6": 1.0}
+# gavel must beat the default by >=10% on effective fleet throughput
+# (the acceptance bar; the scenario's analytic margin is 1.6x).
+POLICY_ETW_MIN_GAIN = 1.10
+
+# Fairness scenario (the drf-vs-hard-quota head-to-head): a flat
+# 16-slot pool, tenant alpha (weight 2) streaming 12 small jobs beside
+# tenant beta (weight 1) streaming 4 short ones. The hard-quota
+# baseline half-splits the pool (8/8) — once beta's demand drains,
+# HALF the pool idles beside alpha's queue for alpha's whole remaining
+# tail (the structural waste a reservation-style ceiling buys). drf
+# replaces the ceiling with the work-conserving share bound: under
+# contention admitted shares track the declared 2:1 weights, and once
+# beta's demand ends alpha takes the whole pool.
+FAIRNESS_POOL_PODS = 16
+FAIRNESS_WEIGHTS = ("alpha=2", "beta=1")
+FAIRNESS_QUOTAS = ("alpha:pods=8", "beta:pods=8")
+FAIRNESS_WEIGHT_RATIO = 2.0
+# drf's contention-window share spread must stay within 1.5x the
+# declared weight ratio, and utilization must not fall below the hard-
+# quota baseline by more than measurement noise (work conservation).
+POLICY_SHARE_SPREAD = 1.5
+POLICY_UTILIZATION_EPS = 0.03
+
+
+def _generation_waves():
+    return [
+        [_contention_job("s0", 4, 0.5, ratios=SENSITIVE_RATIOS)],
+        [_contention_job("s1", 4, 0.5, ratios=SENSITIVE_RATIOS)],
+        [_contention_job("f0", 4, 0.5)],
+        [_contention_job("f1", 4, 0.5)],
+    ]
+
+
+def _fairness_waves():
+    # One wave: the stream races the worker pool, which is fine — drf
+    # fairness emerges from release-time selection, not arrival order.
+    return [
+        [_contention_job(f"a{i}", 2, 0.4, namespace="alpha")
+         for i in range(12)]
+        + [_contention_job(f"b{i}", 2, 0.4, namespace="beta")
+           for i in range(4)],
+    ]
+
+
+def _etw_completion(admission) -> float:
+    """Effective-throughput-weighted completion: each job's LAST
+    admission placement weighted ratio×members, normalized by the best
+    placement it could have had — 1.0 means every member ran at its
+    best generation's speed, the chip-count-greedy default pays its
+    misplacements here. (Assignment-based, so it is deterministic under
+    benchmark timing noise — the primary gavel gate number; the
+    time-integral average is reported beside it.)"""
+    last = {}
+    for entry in admission.admit_log:
+        if "ratio" in entry:
+            last[entry["key"]] = entry
+    if not last:
+        return 1.0
+    num = sum(e["ratio"] * e.get("members", 1) for e in last.values())
+    den = sum(e["best_ratio"] * e.get("members", 1) for e in last.values())
+    return num / den if den else 1.0
+
+
+def _share_spread(result, tenants=("alpha", "beta")) -> dict:
+    """Mean dominant share per tenant over the CONTENTION window (both
+    tenants still have uncompleted jobs — after one drains, divergence
+    is work conservation, not unfairness), and the max/min ratio."""
+    ends = {}
+    for name, t in result["completions"].items():
+        ns = result["completion_ns"].get(name, "")
+        ends[ns] = max(ends.get(ns, 0.0), t)
+    busy_end = min((ends.get(ns, 0.0) for ns in tenants), default=0.0)
+    sums = {ns: 0.0 for ns in tenants}
+    counts = {ns: 0 for ns in tenants}
+    for t, shares in result["share_samples"]:
+        if t > busy_end:
+            break
+        for ns in tenants:
+            if ns in shares:
+                sums[ns] += shares[ns]
+                counts[ns] += 1
+    means = {
+        ns: (sums[ns] / counts[ns]) if counts[ns] else 0.0 for ns in tenants
+    }
+    lo = min(means.values()) if means else 0.0
+    hi = max(means.values()) if means else 0.0
+    return {
+        "mean_shares": {ns: round(v, 4) for ns, v in means.items()},
+        "ratio": round(hi / lo, 3) if lo > 0 else float("inf"),
+        "busy_window_s": round(busy_end, 3),
+    }
+
+
+def _policy_legs(policy):
+    """Run both comparison scenarios under one policy, with its native
+    fairness configuration: the default runs the fairness leg behind
+    the HARD quotas it replaces nothing with; drf swaps them for tenant
+    weights; gavel runs quota-less (bands are its only fairness)."""
+    from tf_operator_tpu.testing.invariants import check_admission_invariants
+
+    gen = _run_contention(
+        _generation_waves(), capacity_pods=GENERATION_POOL_PODS,
+        capacity=GENERATION_CAPACITY, policy=policy)
+    fair = _run_contention(
+        _fairness_waves(), capacity_pods=FAIRNESS_POOL_PODS,
+        policy=policy,
+        quotas=FAIRNESS_QUOTAS if policy == "priority" else (),
+        tenant_weights=FAIRNESS_WEIGHTS if policy == "drf" else ())
+    violations = []
+    for leg, result in (("generation", gen), ("fairness", fair)):
+        for violation in check_admission_invariants(
+            result["admission"], cluster=result["cluster"], kinds=["JAXJob"]
+        ):
+            violations.append(f"{policy}/{leg}: {violation}")
+    row = {
+        "policy": policy,
+        "generation": {
+            "makespan_s": gen["makespan_s"],
+            "utilization": gen["utilization"],
+            "etw_completion": round(_etw_completion(gen["admission"]), 4),
+            "avg_effective_throughput": gen["avg_effective_throughput"],
+            "preemptions": len(gen["admission"].preemption_ledger),
+        },
+        "fairness": {
+            "makespan_s": fair["makespan_s"],
+            "utilization": fair["utilization"],
+            "dominant_share": _share_spread(fair),
+            "preemptions": len(fair["admission"].preemption_ledger),
+        },
+    }
+    return row, violations
+
+
+def _policy_comparison(policies=("priority", "gavel", "drf"),
+                       smoke=False) -> "tuple[dict, list, dict]":
+    """The policy-vs-policy head-to-head (the PR's deliverable): every
+    requested policy over the SAME two scenarios, gates evaluated
+    against the in-process priority baseline (co-load cancels, like the
+    parallel/serial legs). Returns (table dict, regression strings,
+    per-policy baseline updates for contention_policies_last.json)."""
+    rows = {}
+    regressions: list = []
+    need_baseline = any(p != "priority" for p in policies)
+    run_list = list(policies)
+    if need_baseline and "priority" not in run_list:
+        run_list.insert(0, "priority")
+    for policy in run_list:
+        row, violations = _policy_legs(policy)
+        rows[policy] = row
+        regressions.extend(violations)
+    base = rows.get("priority")
+    if smoke and base is not None:
+        if "gavel" in rows:
+            gavel_etw = rows["gavel"]["generation"]["etw_completion"]
+            base_etw = base["generation"]["etw_completion"]
+            if gavel_etw < POLICY_ETW_MIN_GAIN * base_etw:
+                regressions.append(
+                    f"gavel effective throughput {gavel_etw} did not beat "
+                    f"the chip-count-greedy default ({base_etw}) by >="
+                    f"{POLICY_ETW_MIN_GAIN}x on the mixed-generation pool"
+                )
+        if "drf" in rows:
+            spread = rows["drf"]["fairness"]["dominant_share"]
+            bound = POLICY_SHARE_SPREAD * FAIRNESS_WEIGHT_RATIO
+            if not all(v > 0 for v in spread["mean_shares"].values()):
+                regressions.append(
+                    f"drf starved a tenant during the contention window "
+                    f"({spread['mean_shares']})"
+                )
+            elif spread["ratio"] > bound:
+                regressions.append(
+                    f"drf dominant-share spread {spread['ratio']}x exceeds "
+                    f"{POLICY_SHARE_SPREAD}x the declared weight ratio "
+                    f"(bound {bound}x)"
+                )
+            drf_util = rows["drf"]["fairness"]["utilization"]
+            base_util = base["fairness"]["utilization"]
+            if drf_util < base_util - POLICY_UTILIZATION_EPS:
+                regressions.append(
+                    f"drf is not work-conserving: utilization {drf_util} "
+                    f"fell below the hard-quota baseline {base_util}"
+                )
+    table = {
+        "scenarios": {
+            "generation": {
+                "capacity": GENERATION_CAPACITY,
+                "sensitive_ratios": SENSITIVE_RATIOS,
+            },
+            "fairness": {
+                "pool_pods": FAIRNESS_POOL_PODS,
+                "weights": list(FAIRNESS_WEIGHTS),
+                "quotas_baseline": list(FAIRNESS_QUOTAS),
+            },
+        },
+        "policies": [rows[p] for p in run_list],
+    }
+    updates = {p: rows[p] for p in policies if p in rows}
+    return table, regressions, updates
+
+
+def _merge_policy_baseline(updates: dict) -> None:
+    """Merge-write build/contention_policies_last.json under
+    data["policies"][<policy>] — each policy-matrix leg owns only its
+    key, like the scale ratchet's split steps. Written atomically
+    (temp + rename): contention-smoke and policy-matrix are serialized
+    in the DAG, but a reader racing a crashed half-write must never see
+    (and then silently discard) a torn file — _read_baseline swallows
+    corrupt JSON as {}, which would wipe every recorded policy."""
+    data = _read_baseline(CONTENTION_POLICIES_PATH)
+    policies = data.setdefault("policies", {})
+    for name, row in updates.items():
+        policies[name] = row
+    os.makedirs(os.path.dirname(CONTENTION_POLICIES_PATH), exist_ok=True)
+    tmp = CONTENTION_POLICIES_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f)
+    os.replace(tmp, CONTENTION_POLICIES_PATH)
+
+
+def contention_main(smoke=False, policy=None) -> int:
     """--mode contention: the gang-admission behavioral benchmark
     (docs/design/gang_admission.md). Two scenarios:
 
@@ -1345,10 +1614,36 @@ def contention_main(smoke=False) -> int:
        the surviving slice's pods keep their UIDs through the whole
        incident, and the evicted slice is re-admitted and completes
        once the newcomer finishes.
+    4. POLICY COMPARISON (core/policies.py): priority vs gavel vs drf
+       head-to-head over a mixed-generation pool and a two-tenant
+       fairness load — makespan, utilization, effective-throughput-
+       weighted completion, dominant-share spread, preemption count per
+       policy, persisted to build/contention_policies_last.json.
 
-    --smoke turns all three into CI gates and records the margins in
-    build/contention_smoke_last.json."""
+    --smoke turns all of it into CI gates and records the margins in
+    build/contention_smoke_last.json. `policy` (the --policy flag, the
+    policy-matrix CI step) runs ONLY the comparison scenarios for that
+    one policy — plus the in-process priority baseline its gates
+    compare against — and merge-writes just its key; the legacy
+    scenarios 1-3 run on the default-policy path only, where their
+    byte-identical replay contract lives."""
     from tf_operator_tpu.testing.invariants import check_admission_invariants
+
+    if policy is not None:
+        table, regressions, updates = _policy_comparison(
+            (policy,), smoke=smoke)
+        out = {
+            "mode": "contention",
+            "smoke": smoke,
+            "policy": policy,
+            "policy_table": table,
+            "regression": "; ".join(regressions) or None,
+        }
+        rc = 1 if (smoke and regressions) else 0
+        if smoke and rc == 0:
+            _merge_policy_baseline(updates)
+        print(json.dumps(out))
+        return rc
 
     regressions = []
 
@@ -1460,6 +1755,13 @@ def contention_main(smoke=False) -> int:
         regressions.append(
             "slice admission invariants: " + "; ".join(slice_violations))
 
+    # Scenario 4: the policy-vs-policy comparison table (all three
+    # policies over the mixed-generation + fairness scenarios; the
+    # gavel/drf gates ride the same runs).
+    policy_table, policy_regressions, policy_updates = _policy_comparison(
+        smoke=smoke)
+    regressions.extend(policy_regressions)
+
     out = {
         "mode": "contention",
         "smoke": smoke,
@@ -1486,6 +1788,7 @@ def contention_main(smoke=False) -> int:
             "ms_disruption_counts": sliced["ms_disruption_counts"],
             "ms_slice_restart_counts": sliced["ms_slice_restart_counts"],
         },
+        "policy_table": policy_table,
         "regression": "; ".join(regressions) or None,
     }
     rc = 1 if (smoke and regressions) else 0
@@ -1497,6 +1800,7 @@ def contention_main(smoke=False) -> int:
                 "fifo_utilization": fifo["utilization"],
                 "backfill_utilization": backfill["utilization"],
             }, f)
+        _merge_policy_baseline(policy_updates)
     print(json.dumps(out))
     return rc
 
@@ -1550,6 +1854,18 @@ if __name__ == "__main__":
                         help="with --mode scale --smoke: run the legacy "
                         "gates without the fleet legs (the scale-smoke CI "
                         "step, which leaves the fleet legs to its sibling)")
+    from tf_operator_tpu.core.policies import POLICIES
+
+    parser.add_argument("--policy", choices=sorted(POLICIES),
+                        default=None,
+                        help="contention mode: run ONLY the policy-"
+                        "comparison scenarios for this one admission "
+                        "policy (plus the in-process priority baseline "
+                        "its gates need) and merge-write its key into "
+                        "build/contention_policies_last.json — the "
+                        "policy-matrix CI step. Without it, contention "
+                        "mode runs the legacy gates plus the full "
+                        "three-policy table")
     parser.add_argument("--qps", type=float, default=0.0)
     parser.add_argument("--burst", type=int, default=0)
     parser.add_argument("--write-latency", type=float, default=0.01,
@@ -1560,8 +1876,10 @@ if __name__ == "__main__":
         # Silently routing to a sweep would drop every CI gate.
         parser.error("--smoke and --workers/--replicas are mutually "
                      "exclusive: the smoke tier has its own fixed gates")
+    if args.policy and args.mode != "contention":
+        parser.error("--policy requires --mode contention")
     if args.mode == "contention":
-        sys.exit(contention_main(smoke=args.smoke))
+        sys.exit(contention_main(smoke=args.smoke, policy=args.policy))
     if (args.workers or args.replicas) and args.mode != "scale":
         # Dropping the flag would hand back a plausible-looking JSON
         # object for the wrong experiment.

@@ -169,6 +169,15 @@ class SchedulingPolicy:
     queue: str = ""
     min_resources: Dict[str, str] = field(default_factory=dict)
     priority_class: str = ""
+    # Per-device-generation normalized throughput (Gavel,
+    # arXiv:2008.09213): generation name (as declared in the operator's
+    # --capacity res@generation=qty pool) -> this job's relative
+    # throughput there, e.g. {"v5lite": 0.25, "v6": 1.0}. Consumed by
+    # --admission-policy gavel to place the gang where it maximizes
+    # fleet-wide effective throughput; generations absent from the map
+    # ride 1.0, and an empty map means generation-indifferent. Values
+    # must be positive finite numbers (api/defaulting.py).
+    throughput_ratios: Dict[str, float] = field(default_factory=dict)
 
 
 @dataclass

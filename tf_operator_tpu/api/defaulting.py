@@ -133,6 +133,31 @@ def validate_scheduling_policy(
                 f"{kind}Spec is not valid: schedulingPolicy.minResources"
                 f"[{name}] = {qty!r} must be non-negative"
             )
+    # throughputRatios (the gavel placement input): generation ->
+    # positive finite number. Zero is rejected — "this job cannot run on
+    # that generation" is expressed by capacity (it will simply never be
+    # placed there profitably), and a zero ratio would make the
+    # effective-throughput objective divide the job out of existence;
+    # negatives/NaN/inf could invert or wedge the greedy comparison.
+    for gen, ratio in (sp.throughput_ratios or {}).items():
+        if not isinstance(gen, str) or not gen.strip():
+            raise ValidationError(
+                f"{kind}Spec is not valid: schedulingPolicy."
+                f"throughputRatios has a non-string generation key "
+                f"{gen!r}"
+            )
+        if isinstance(ratio, bool) or not isinstance(ratio, (int, float)):
+            raise ValidationError(
+                f"{kind}Spec is not valid: schedulingPolicy."
+                f"throughputRatios[{gen}] = {ratio!r} is not a number"
+            )
+        ratio = float(ratio)
+        if not (0.0 < ratio < float("inf")) or ratio != ratio:
+            raise ValidationError(
+                f"{kind}Spec is not valid: schedulingPolicy."
+                f"throughputRatios[{gen}] = {ratio!r} must be a positive "
+                "finite number"
+            )
 
 
 def validate_run_policy(

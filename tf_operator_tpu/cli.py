@@ -143,7 +143,25 @@ class OperatorOptions:
     # pod slots when templates carry no resource requests. Backends with
     # a schedulable-capacity model (the in-memory simulator) also bound
     # the pool live — a seeded capacity revocation shrinks it mid-run.
+    # "res@generation=qty" entries declare device-GENERATION sub-pools
+    # (e.g. "pods@v5lite=8,pods@v6=8"): the flat pool is their sum, and
+    # --admission-policy gavel places gangs per generation to maximize
+    # effective fleet throughput (schedulingPolicy.throughputRatios).
     capacity: str = ""
+    # The admission decision procedure (core/policies.py):
+    # priority (default — the PR 9 bands+quotas+backfill arbiter,
+    # byte-identical), gavel (heterogeneity-aware effective-throughput
+    # placement), or drf (weighted dominant-resource fairness).
+    admission_policy: str = "priority"
+    # Weighted-DRF tenant weights, each entry "ns=w" (positive float);
+    # tenants absent ride weight 1.0. Only --admission-policy drf reads
+    # them.
+    tenant_weights: List[str] = field(default_factory=list)
+    # Explicit decision seed threaded into every policy call: classical
+    # policies ignore it, a learned/randomized policy draws its entropy
+    # ONLY from it — decisions stay a pure function of
+    # (queue, pool, usage, seed).
+    admission_seed: int = 0
     # Per-tenant quotas: each entry "ns:res=qty[,res=qty...]".
     namespace_quotas: List[str] = field(default_factory=list)
     # Backfill bound: a waiting gang with at most this many members may
@@ -245,7 +263,37 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         help="Declared admission pool, 'res=qty[,res=qty]' "
                         "(e.g. 'google.com/tpu=128,pods=32'); 'pods' "
                         "counts gang members. Empty = unbounded (quota/"
-                        "priority arbitration only).")
+                        "priority arbitration only). 'res@generation=qty' "
+                        "entries declare device-generation sub-pools "
+                        "(e.g. 'pods@v5lite=8,pods@v6=8') for "
+                        "--admission-policy gavel; the flat pool is "
+                        "their sum.")
+    from .core.policies import POLICIES
+
+    parser.add_argument("--admission-policy",
+                        choices=sorted(POLICIES),
+                        default="priority",
+                        help="Admission decision procedure "
+                        "(core/policies.py): 'priority' (default) = the "
+                        "bands+quotas+backfill arbiter, byte-identical "
+                        "to before the policy seam; 'gavel' = "
+                        "heterogeneity-aware placement maximizing "
+                        "effective fleet throughput across device "
+                        "generations (schedulingPolicy.throughputRatios)"
+                        "; 'drf' = weighted dominant-resource fairness "
+                        "across tenants (--tenant-weight), replacing "
+                        "hard quota ceilings with a work-conserving "
+                        "share bound.")
+    parser.add_argument("--tenant-weight", action="append", default=[],
+                        metavar="NS=WEIGHT",
+                        help="Weighted-DRF tenant weight (repeatable; "
+                        "positive number, default 1.0 per tenant). Read "
+                        "by --admission-policy drf.")
+    parser.add_argument("--admission-seed", type=int, default=0,
+                        help="Decision seed threaded into the admission "
+                        "policy (decisions are a pure function of "
+                        "queue/pool/usage/seed; classical policies "
+                        "ignore it).")
     parser.add_argument("--namespace-quota", action="append", default=[],
                         metavar="NS:RES=QTY[,RES=QTY]",
                         help="Per-tenant admission quota (repeatable).")
@@ -338,6 +386,9 @@ def options_from_args(args: argparse.Namespace) -> OperatorOptions:
         backfill_max_members=args.backfill_max_members,
         admission_aging_seconds=args.admission_aging_seconds,
         admission_slice_granularity=args.admission_slice_granularity,
+        admission_policy=args.admission_policy,
+        tenant_weights=list(args.tenant_weight),
+        admission_seed=args.admission_seed,
     )
 
 
@@ -615,8 +666,9 @@ class OperatorManager:
         if self.options.enable_gang_admission:
             from .core.admission import (
                 AdmissionController,
+                parse_capacity_flag,
                 parse_quota_flag,
-                parse_resource_list,
+                parse_tenant_weight,
             )
 
             quotas: Dict[str, Dict[str, str]] = {}
@@ -626,17 +678,28 @@ class OperatorManager:
                 # dict replace would silently drop the first entry's).
                 for ns, resources in parse_quota_flag(entry).items():
                     quotas.setdefault(ns, {}).update(resources)
+            weights: Dict[str, float] = {}
+            for entry in self.options.tenant_weights:
+                weights.update(parse_tenant_weight(entry))
+            # Extended --capacity syntax: plain entries declare the flat
+            # pool; res@generation entries declare device-generation
+            # sub-pools (the gavel placement unit).
+            flat_capacity, generations = parse_capacity_flag(
+                self.options.capacity)
             self.admission = AdmissionController(
-                capacity=(
-                    parse_resource_list(self.options.capacity)
-                    if self.options.capacity else None
-                ),
+                capacity=flat_capacity or None,
+                generations=generations or None,
                 quotas=quotas,
                 backfill_max_members=self.options.backfill_max_members,
                 aging_seconds=self.options.admission_aging_seconds,
                 metrics=self.metrics,
                 capacity_fn=getattr(cluster, "schedulable_capacity", None),
+                generations_fn=getattr(
+                    cluster, "schedulable_generations", None),
                 slice_granular=self.options.admission_slice_granularity,
+                policy=self.options.admission_policy,
+                tenant_weights=weights,
+                seed=self.options.admission_seed,
             )
         from .core.control import TokenBucket
 

@@ -104,6 +104,14 @@ class InMemoryCluster(base.Cluster):
         # contention — the failure regime the admission layer
         # (core/admission.py) exists to prevent, made reproducible here.
         self._capacity: Optional[Dict[str, str]] = None
+        # Device-GENERATION sub-pools (gen -> resource -> qty): the
+        # heterogeneous-fleet half of the capacity model (e.g. v5-lite
+        # beside current-gen chips). Read by the admission layer's
+        # generations_fn so gavel-style placement sees live per-
+        # generation bounds; step()'s per-pod binding stays against the
+        # FLAT pool — which generation a pod's chips come from is the
+        # operator's placement decision, not the simulator's.
+        self._capacity_generations: Optional[Dict[str, Dict[str, str]]] = None
 
     # ------------------------------------------------------------------ util
     def latest_rv(self) -> int:
@@ -570,14 +578,23 @@ class InMemoryCluster(base.Cluster):
 
     # ------------------------------------------------- schedulable capacity
     def set_schedulable_capacity(
-        self, resources: Optional[Dict[str, str]]
+        self, resources: Optional[Dict[str, str]],
+        generations: Optional[Dict[str, Dict[str, str]]] = None,
     ) -> None:
         """Declare (or with None, remove) the cluster's schedulable
         capacity. Shrinking it mid-run is the capacity-revocation fault:
         already-bound pods keep running — reclaiming them is the
-        operator's job (preempt-to-fit), not the simulator's."""
+        operator's job (preempt-to-fit), not the simulator's.
+        ``generations`` optionally declares per-device-generation
+        sub-pools beside (not instead of) the flat pool; a generation-
+        scoped revocation shrinks one sub-pool and the admission layer
+        reconciles placement."""
         with self._lock:
             self._capacity = dict(resources) if resources else None
+            self._capacity_generations = (
+                {gen: dict(res) for gen, res in generations.items()}
+                if generations else None
+            )
 
     def schedulable_capacity(self) -> Optional[Dict[str, str]]:
         """The declared pool (None = unbounded). The admission layer's
@@ -585,6 +602,17 @@ class InMemoryCluster(base.Cluster):
         an admission-visible event."""
         with self._lock:
             return dict(self._capacity) if self._capacity else None
+
+    def schedulable_generations(self) -> Optional[Dict[str, Dict[str, str]]]:
+        """The declared per-generation sub-pools (None = homogeneous).
+        The admission layer's generations_fn reads this — how a live
+        generation-scoped shrink reaches gavel placement."""
+        with self._lock:
+            return (
+                {gen: dict(res)
+                 for gen, res in self._capacity_generations.items()}
+                if self._capacity_generations else None
+            )
 
     @staticmethod
     def _pod_demand(pod: Pod) -> Dict[str, object]:

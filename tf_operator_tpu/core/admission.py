@@ -14,13 +14,21 @@ contention, and bounded backfill of small gangs into capacity gaps with
 an aging bound so backfill can never starve the head-of-line gang.
 
 Everything is deterministic given a deterministic call sequence and
-clock: decisions are pure functions of (registered gangs, capacity,
-clock) — no randomness — so the seeded chaos/crash tiers replay
+clock: decisions are pure functions of (queue, pool, usage, seed) — the
+DECISION PROCEDURE itself lives behind the policy seam in
+core/policies.py (`policy.decide(PolicyState) -> Decisions`, selected
+by --admission-policy: the default `priority` policy is the original
+arbiter byte-for-byte; `gavel` adds heterogeneity-aware placement over
+device-generation sub-pools; `drf` replaces hard quotas with weighted
+work-conserving fairness). This class owns registration, decision
+APPLICATION (in the policy's order), the preemption handshake, and the
+audit ledgers — including the decision log, the byte-equality artifact
+of the determinism contract. Seeded chaos/crash tiers replay
 byte-identically with admission ON, and with the flag OFF (the default)
 the engine never constructs this object at all and the PR 1–8 behavior
 is untouched byte-for-byte.
 
-Ordering rules, in one place:
+Ordering rules of the DEFAULT policy, in one place:
 
 - The wait queue is ordered by (band desc, seq asc): higher priority
   bands first, FIFO within a band. ``seq`` is a monotonic admission-
@@ -49,11 +57,23 @@ from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from fractions import Fraction
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from .job_controller import parse_quantity
+from .policies import (
+    Admit,
+    AdmissionPolicy,
+    GangView,
+    PolicyState,
+    PREEMPT_CAUSE_CAPACITY,
+    PREEMPT_CAUSE_PRIORITY,
+    PREEMPT_CAUSE_THROUGHPUT,
+    Preempt,
+    build_policy,
+    ratio_of,
+)
 
 # Priority bands for SchedulingPolicy.priorityClass. Scheduler-style
 # class names map onto small integers; bare non-negative integers are
@@ -73,9 +93,9 @@ PRIORITY_CLASSES = {
     "critical": 3,
 }
 
-# Preemption causes (the gang_preemptions_total{cause} label values).
-PREEMPT_CAUSE_PRIORITY = "PriorityPreemption"
-PREEMPT_CAUSE_CAPACITY = "CapacityRevoked"
+# Preemption causes (the gang_preemptions_total{cause} label values):
+# defined once in core/policies.py (the emitting side) and re-exported
+# here, the historical import home — one source of truth, no drift.
 
 
 import re as _re
@@ -109,11 +129,14 @@ def parse_priority_class(value) -> int:
     raise ValueError(f"malformed priority class {value!r}")
 
 
-def parse_resource_list(text) -> Dict[str, str]:
-    """Parse "res=qty[,res=qty...]" (the --capacity / quota flag syntax)
-    into a resource dict; quantities stay strings (parse_quantity-legal,
-    validated here). Empty input -> {}."""
-    out: Dict[str, str] = {}
+def _parse_resource_entries(text):
+    """The shared per-entry parse/validate of every resource-list flag:
+    yields (name, qty) pairs. Quantities must be parse_quantity-legal
+    and non-negative (zero is a legal bound; a negative pool or quota
+    can never be satisfied and would silently wedge every tenant it
+    applies to). Resource NAMES are free-form: unknown keys (device
+    plugins, vendor resources) flow through verbatim, exactly like k8s
+    extended resources."""
     for part in str(text or "").split(","):
         part = part.strip()
         if not part:
@@ -121,9 +144,17 @@ def parse_resource_list(text) -> Dict[str, str]:
         name, sep, qty = part.partition("=")
         if not sep or not name.strip():
             raise ValueError(f"malformed resource entry {part!r} (want res=qty)")
-        parse_quantity(qty.strip())  # raises on malformed quantities
-        out[name.strip()] = qty.strip()
-    return out
+        if parse_quantity(qty.strip()) < 0:  # raises on malformed quantities
+            raise ValueError(
+                f"resource entry {part!r}: quantity must be non-negative")
+        yield name.strip(), qty.strip()
+
+
+def parse_resource_list(text) -> Dict[str, str]:
+    """Parse "res=qty[,res=qty...]" (the --capacity / quota flag syntax)
+    into a resource dict; quantities stay validated strings. Empty
+    input -> {}."""
+    return dict(_parse_resource_entries(text))
 
 
 def parse_quota_flag(text) -> Dict[str, Dict[str, str]]:
@@ -134,6 +165,53 @@ def parse_quota_flag(text) -> Dict[str, Dict[str, str]]:
             f"malformed quota {text!r} (want namespace:res=qty[,res=qty])"
         )
     return {ns.strip(): parse_resource_list(resources)}
+
+
+def parse_capacity_flag(text) -> Tuple[Dict[str, str], Dict[str, Dict[str, str]]]:
+    """Parse the extended --capacity syntax: plain "res=qty" entries
+    declare the homogeneous pool exactly as before; "res@generation=qty"
+    entries declare a device-GENERATION sub-pool (the gavel policy's
+    placement unit — e.g. "pods@v5lite=8,pods@v6=8" is a 16-slot pool
+    split across two chip generations). Returns (flat_entries,
+    generations); the controller sums generation entries into the flat
+    pool, so a generation-split pool bounds totals identically to its
+    flat sum under generation-blind policies."""
+    flat: Dict[str, str] = {}
+    generations: Dict[str, Dict[str, str]] = {}
+    for name, qty in _parse_resource_entries(text):
+        resource, at, generation = name.partition("@")
+        if at:
+            if not resource or not generation:
+                raise ValueError(
+                    f"malformed generation entry {name}={qty} "
+                    "(want res@generation=qty)"
+                )
+            bucket = generations.setdefault(generation, {})
+            if resource in bucket:
+                raise ValueError(
+                    f"duplicate declaration of {resource!r} in "
+                    f"generation {generation!r}"
+                )
+            bucket[resource] = qty
+        else:
+            flat[resource] = qty
+    return flat, generations
+
+
+def parse_tenant_weight(text) -> Dict[str, float]:
+    """Parse one "--tenant-weight ns=w" value (the drf policy's weighted
+    fairness knob). Weights must be positive finite numbers."""
+    ns, sep, weight = str(text or "").partition("=")
+    if not sep or not ns.strip():
+        raise ValueError(f"malformed tenant weight {text!r} (want ns=weight)")
+    try:
+        value = float(weight.strip())
+    except ValueError:
+        raise ValueError(f"tenant weight {weight!r} is not a number")
+    if not value > 0 or value != value or value == float("inf"):
+        raise ValueError(f"tenant weight {weight!r} must be a positive "
+                         "finite number")
+    return {ns.strip(): value}
 
 
 def gang_demand(groups: List[dict]) -> Dict[str, Fraction]:
@@ -199,6 +277,13 @@ class _Gang:
     admitted_at: Optional[float] = None
     backfilled: bool = False
     blocked_on: str = ""
+    # Per-generation normalized throughput from
+    # schedulingPolicy.throughputRatios (empty = generation-
+    # indifferent; absent generations ride 1.0 — policies.DEFAULT_RATIO).
+    throughput_ratios: Dict[str, float] = field(default_factory=dict)
+    # The generation sub-pool an ADMITTED gang was placed in (None on a
+    # homogeneous pool, and while waiting).
+    generation: Optional[str] = None
     announced_admit: bool = False
     announced_queue: bool = False
     # Last blocked_on verdict the metric layer saw: the quota-denial
@@ -227,6 +312,11 @@ class AdmissionController:
         metrics=None,
         capacity_fn: Optional[Callable[[], Optional[Dict[str, str]]]] = None,
         slice_granular: bool = False,
+        policy=None,
+        generations: Optional[Dict[str, Dict[str, str]]] = None,
+        generations_fn: Optional[Callable[[], Optional[Dict]]] = None,
+        tenant_weights: Optional[Dict[str, float]] = None,
+        seed: int = 0,
     ):
         # Per-SLICE admission (--admission-slice-granularity, flagged
         # headroom for multislice jobs): the ENGINE reads this and
@@ -237,7 +327,38 @@ class AdmissionController:
         # the job. The arbiter itself is key-agnostic; the flag lives
         # here so the engine and the manager share one source of truth.
         self.slice_granular = bool(slice_granular)
-        self._declared = _parse_resources(capacity) if capacity else None
+        # The pluggable decision procedure (core/policies.py): a policy
+        # name ("priority"|"gavel"|"drf"), a policy instance, or None =
+        # the default priority policy — the PR 9 arbiter byte-for-byte.
+        if policy is None or isinstance(policy, str):
+            self.policy: AdmissionPolicy = build_policy(policy or "priority")
+        else:
+            self.policy = policy
+        # Explicit decision seed, threaded into every PolicyState: the
+        # classical policies ignore it (they are deterministic without
+        # it), but it makes the purity contract auditable — decisions
+        # are a function of (queue, pool, usage, seed) and nothing else,
+        # and a learned/randomized policy gets its entropy ONLY here.
+        self.seed = int(seed)
+        self.tenant_weights: Dict[str, float] = {
+            ns: float(w) for ns, w in (tenant_weights or {}).items()
+        }
+        # Device-generation sub-pools (the gavel placement unit). The
+        # flat declared pool is the element-wise sum of the generation
+        # pools plus any generation-less entries, so generation-blind
+        # policies see exactly the total they always did.
+        self._declared_gens: Dict[str, Dict[str, Fraction]] = {
+            gen: _parse_resources(res)
+            for gen, res in (generations or {}).items()
+        }
+        declared = _parse_resources(capacity) if capacity else None
+        if self._declared_gens:
+            declared = dict(declared or {})
+            for res_map in self._declared_gens.values():
+                for name, qty in res_map.items():
+                    declared[name] = declared.get(name, Fraction(0)) + qty
+        self._declared = declared
+        self._generations_fn = generations_fn
         self.quotas: Dict[str, Dict[str, Fraction]] = {
             ns: _parse_resources(res) for ns, res in (quotas or {}).items()
         }
@@ -273,6 +394,13 @@ class AdmissionController:
 
         self.admit_log: "deque[dict]" = deque(maxlen=1024)
         self.preemption_ledger: "deque[tuple]" = deque(maxlen=512)
+        # The determinism-audit artifact: one entry per pump that took
+        # an action (admits/preempts, in applied order) — a pure record
+        # of the policy's observable schedule. Same-seed runs over the
+        # same call sequence must produce byte-equal logs
+        # (decision_log_lines); bounded like the other rings.
+        self.decision_log: "deque[dict]" = deque(maxlen=4096)
+        self._pump_count = 0
 
     # --------------------------------------------------------- capacity
     def effective_capacity(self) -> Optional[Dict[str, Fraction]]:
@@ -294,6 +422,27 @@ class AdmissionController:
                         cap[name] = min(cap.get(name, qty), qty)
         return cap
 
+    def effective_generations(self) -> Dict[str, Dict[str, Fraction]]:
+        """The device-generation sub-pools ({} = homogeneous). With a
+        live provider (the memory cluster's schedulable_generations),
+        a declared generation's bound is the per-resource MIN of the
+        two — a generation-scoped revocation can only shrink its
+        sub-pool, mirroring the flat rule."""
+        gens = {g: dict(r) for g, r in self._declared_gens.items()}
+        if self._generations_fn is not None:
+            try:
+                live = self._generations_fn()
+            except Exception:  # noqa: BLE001 — a flaky provider must not wedge admission
+                live = None
+            for gen, resources in (live or {}).items():
+                if gen not in gens:
+                    continue
+                parsed = _parse_resources(resources)
+                bucket = gens[gen]
+                for name, qty in parsed.items():
+                    bucket[name] = min(bucket.get(name, qty), qty)
+        return gens
+
     def _usage_locked(self, exclude=()) -> Dict[str, Fraction]:
         usage: Dict[str, Fraction] = {}
         for key, gang in self._admitted.items():
@@ -312,46 +461,40 @@ class AdmissionController:
                 usage[name] = usage.get(name, Fraction(0)) + qty
         return usage
 
-    @staticmethod
-    def _fits(demand, usage, cap) -> bool:
-        """Resources absent from the pool are unconstrained (a pool
-        declared in chips does not bound cpu)."""
-        if cap is None:
-            return True
-        return all(
-            usage.get(name, Fraction(0)) + qty <= cap[name]
-            for name, qty in demand.items()
-            if name in cap
-        )
-
-    def _quota_ok_locked(self, gang: _Gang, exclude=()) -> bool:
-        quota = self.quotas.get(gang.namespace)
-        if not quota:
-            return True
-        usage = self._ns_usage_locked(gang.namespace, exclude=exclude)
-        return all(
-            usage.get(name, Fraction(0)) + qty <= quota[name]
-            for name, qty in gang.demand.items()
-            if name in quota
-        )
-
     # ------------------------------------------------------------- pump
+    # (Fit/quota predicates live in core/policies.py now — the seam owns
+    # the decision procedure; this class owns registration, application,
+    # and the audit ledgers.)
     def _waiting_order_locked(self) -> List[_Gang]:
         return sorted(self._waiting.values(), key=lambda g: (-g.band, g.seq))
 
     def _admit_locked(self, gang: _Gang, now: float, backfill: bool,
-                      head_wait: Optional[float]) -> None:
+                      head_wait: Optional[float],
+                      generation: Optional[str] = None) -> None:
         self._waiting.pop(gang.key, None)
         gang.admitted_at = now
         gang.backfilled = backfill
         gang.blocked_on = ""
         gang.announced_admit = False
+        gang.generation = generation
         self._admitted[gang.key] = gang
-        self.admit_log.append({
+        entry = {
             "key": gang.key, "band": gang.band, "backfill": backfill,
             "head_wait_at_admit": head_wait,
             "wait": now - gang.enqueued_at,
-        })
+        }
+        if self._declared_gens:
+            # Generation-pool bookkeeping rides the admit log only when
+            # a generation pool exists, so the PR 9 entry shape (and
+            # everything that string-compares it) is untouched on
+            # homogeneous pools.
+            entry["generation"] = generation
+            entry["ratio"] = ratio_of(gang, generation)
+            entry["best_ratio"] = max(
+                ratio_of(gang, g) for g in sorted(self._declared_gens)
+            )
+            entry["members"] = gang.members
+        self.admit_log.append(entry)
         self.metrics.observe_admission_wait(
             gang.namespace, gang.kind, max(0.0, now - gang.enqueued_at)
         )
@@ -365,143 +508,158 @@ class AdmissionController:
         if gang.kick is not None:
             self._kicks.append(gang.kick)
 
+    def _adoption_generation_locked(self, gang: _Gang) -> Optional[str]:
+        """Best-effort generation attribution for the has_pods adoption
+        path (an operator restart must re-admit live pods wherever they
+        physically run — leaving them generation-less would make every
+        sub-pool look empty and let placement oversubscribe real chips).
+        First-fit with room; when every sub-pool is full, the sorted-
+        first generation takes the visible overcommit and the policies'
+        generation-revocation sweep preempts to fit — the same path a
+        flat adoption overcommit resolves through."""
+        gens = self.effective_generations()
+        if not gens:
+            return None
+        from .policies import fits as _fits
+
+        usage: Dict[str, Dict[str, Fraction]] = {}
+        for g in self._admitted.values():
+            if g.generation is None:
+                continue
+            bucket = usage.setdefault(g.generation, {})
+            for name, qty in g.demand.items():
+                bucket[name] = bucket.get(name, Fraction(0)) + qty
+        for name in sorted(gens):
+            if _fits(gang.demand, usage.get(name, {}), gens[name]):
+                return name
+        return sorted(gens)[0]
+
+    @staticmethod
+    def _gang_view(gang: _Gang) -> GangView:
+        return GangView(
+            key=gang.key, namespace=gang.namespace, band=gang.band,
+            seq=gang.seq, demand=gang.demand, members=gang.members,
+            enqueued_at=gang.enqueued_at, victim_rank=gang.victim_rank,
+            throughput_ratios=gang.throughput_ratios,
+            generation=gang.generation,
+        )
+
+    def _policy_state_locked(self, now: float, cap) -> PolicyState:
+        """The pure-function input (queue, pool, usage, seed) — an
+        immutable view of everything a decision may legally depend on.
+        No wall clock reaches the policy except ``now``, which is this
+        controller's injected clock value, so fake-clock replays are
+        exact."""
+        return PolicyState(
+            waiting=tuple(
+                self._gang_view(g) for g in self._waiting_order_locked()
+            ),
+            admitted=tuple(
+                self._gang_view(g) for g in self._admitted.values()
+            ),
+            pending_preempt=frozenset(self._preempt),
+            capacity=cap,
+            generations=self.effective_generations(),
+            quotas=self.quotas,
+            tenant_weights=self.tenant_weights,
+            backfill_max_members=self.backfill_max_members,
+            aging_seconds=self.aging_seconds,
+            now=now,
+            seed=self.seed,
+        )
+
     def _pump_locked(self, now: float) -> None:
-        """The decision procedure, run after every state change. Marks
-        preemption victims, admits every currently-eligible waiter, and
-        leaves a blocked_on verdict on the rest."""
+        """One pump = build the immutable PolicyState, ask the active
+        policy for an ORDERED decision list (core/policies.py), and
+        apply it verbatim: admits register capacity (admit-log entries,
+        wait metrics, and requeue kicks land in list order — a policy's
+        output order IS its observable schedule), preempts mark victims
+        for the engine's counted teardown, and blocked verdicts land on
+        whoever stays waiting. The default priority policy reproduces
+        the PR 9 procedure byte-for-byte."""
+        self._pump_count += 1
         cap = self.effective_capacity()
-        # Capacity revocation: the pool shrank under the admitted set —
-        # preempt lowest-band (then most-recently-admitted) gangs until
-        # what remains fits. Pending victims still count as usage until
-        # the engine's counted teardown acknowledges them, so the check
-        # excludes only gangs already marked.
-        if cap is not None:
-            victims_pool = sorted(
-                (g for g in self._admitted.values() if g.key not in self._preempt),
-                key=lambda g: (g.band, -g.victim_rank, -g.seq),
-            )
-            excluded = set(self._preempt)
-            for victim in victims_pool:
-                usage = self._usage_locked(exclude=excluded)
-                if all(usage.get(r, Fraction(0)) <= cap[r] for r in cap):
-                    break
-                self._mark_preempt_locked(victim, PREEMPT_CAUSE_CAPACITY)
-                excluded.add(victim.key)
-        # Admission scan, priority order. Head-of-line = first waiter its
-        # own quota allows; it admits as soon as it fits, schedules
-        # preemption of strictly-lower bands when it doesn't, and bounds
-        # backfill behind it by its age.
-        # While preemptions are PENDING (marked but not yet acknowledged
-        # by the engine's counted teardown), the capacity they will free
-        # is spoken for — the head the arbiter is evicting FOR must get
-        # it. Backfill is suppressed until the dust settles, or a victim
-        # could slip right back into the gap its own eviction opened (and
-        # the arbiter would evict it again: a preemption livelock).
-        pending_preempt = bool(self._preempt)
-        head: Optional[_Gang] = None
-        head_wait = 0.0
-        # Usage computed ONCE per pump and updated incrementally on each
-        # admit (per-namespace views built lazily): the naive
-        # recompute-per-waiter made every sync of every admitted job
-        # O(admitted x waiters) inside this lock.
-        usage = self._usage_locked()
-        ns_usage: Dict[str, Dict[str, Fraction]] = {}
-
-        def ns_usage_of(namespace: str) -> Dict[str, Fraction]:
-            if namespace not in ns_usage:
-                ns_usage[namespace] = self._ns_usage_locked(namespace)
-            return ns_usage[namespace]
-
-        def quota_ok(gang: _Gang) -> bool:
-            quota = self.quotas.get(gang.namespace)
-            if not quota:
-                return True
-            used = ns_usage_of(gang.namespace)
-            return all(
-                used.get(name, Fraction(0)) + qty <= quota[name]
-                for name, qty in gang.demand.items()
-                if name in quota
-            )
-
-        def charge(gang: _Gang) -> None:
-            for name, qty in gang.demand.items():
-                usage[name] = usage.get(name, Fraction(0)) + qty
-            used = ns_usage_of(gang.namespace)
-            for name, qty in gang.demand.items():
-                used[name] = used.get(name, Fraction(0)) + qty
-
-        for gang in self._waiting_order_locked():
-            if not quota_ok(gang):
-                gang.blocked_on = "quota"
-                continue
-            is_head = head is None
-            if is_head:
-                head = gang
-                head_wait = now - gang.enqueued_at
-            if self._fits(gang.demand, usage, cap):
-                if is_head:
-                    self._admit_locked(gang, now, backfill=False, head_wait=None)
-                    charge(gang)
-                    head = None  # the next eligible waiter takes the line
-                elif (
-                    not pending_preempt
-                    and self.backfill_max_members > 0
-                    and gang.members <= self.backfill_max_members
-                    and head_wait < self.aging_seconds
-                ):
-                    self._admit_locked(gang, now, backfill=True,
-                                       head_wait=head_wait)
-                    charge(gang)
-                else:
-                    gang.blocked_on = "order"
-                continue
-            if is_head:
-                # Priority preemption: strictly lower bands only — equal-
-                # band contention waits its turn (FIFO within a band is
-                # the fairness contract).
-                candidates = sorted(
-                    (g for g in self._admitted.values()
-                     if g.band < gang.band and g.key not in self._preempt),
-                    key=lambda g: (g.band, -g.victim_rank, -g.seq),
+        state = self._policy_state_locked(now, cap)
+        decisions = self.policy.decide(state)
+        applied: List[list] = []
+        admitted_keys: set = set()
+        for action in decisions.actions:
+            if isinstance(action, Admit):
+                gang = self._waiting.get(action.key)
+                if gang is None:
+                    continue  # raced away (released mid-decision impossible under the lock; defensive)
+                self._admit_locked(
+                    gang, now, backfill=action.backfill,
+                    head_wait=action.head_wait,
+                    generation=action.generation,
                 )
-                # Check-before-marking, INCLUDING the already-pending set:
-                # a pump landing between a victim's mark and its
-                # teardown-ack must see that the pending evictions alone
-                # already satisfy the head — otherwise every intervening
-                # pump would escalate one more innocent victim until the
-                # whole lower band was condemned for a single head.
-                freed: set = set(self._preempt)
-                chosen: List[_Gang] = []
-                satisfiable = self._fits(
-                    gang.demand, self._usage_locked(exclude=freed), cap
-                ) and self._quota_ok_locked(gang, exclude=freed)
-                if not satisfiable:
-                    for candidate in candidates:
-                        chosen.append(candidate)
-                        freed.add(candidate.key)
-                        if self._fits(
-                            gang.demand, self._usage_locked(exclude=freed), cap
-                        ) and self._quota_ok_locked(gang, exclude=freed):
-                            satisfiable = True
-                            break
-                if satisfiable:
-                    for victim in chosen:
-                        self._mark_preempt_locked(victim, PREEMPT_CAUSE_PRIORITY)
-                    pending_preempt = True
-                    gang.blocked_on = "priority"
-                else:
-                    gang.blocked_on = "capacity"
-            else:
-                gang.blocked_on = "capacity"
-        self._update_gauges_locked()
+                admitted_keys.add(action.key)
+                applied.append(
+                    ["admit", action.key, bool(action.backfill),
+                     action.generation])
+            elif isinstance(action, Preempt):
+                gang = self._admitted.get(action.key)
+                if gang is None:
+                    continue
+                if gang.key not in self._preempt:
+                    applied.append(["preempt", action.key, action.cause])
+                self._mark_preempt_locked(gang, action.cause)
+        for key, verdict in decisions.blocked.items():
+            if key in admitted_keys:
+                continue  # actions win over a stale verdict (drf's re-sorted passes)
+            gang = self._waiting.get(key)
+            if gang is not None:
+                gang.blocked_on = verdict
+        if applied:
+            self.decision_log.append(
+                {"pump": self._pump_count, "policy": self.policy.name,
+                 "seed": self.seed, "actions": applied}
+            )
+        self._update_gauges_locked(cap)
 
-    def _update_gauges_locked(self) -> None:
+    def _update_gauges_locked(self, cap=None) -> None:
         depths: Dict[int, int] = {}
         for gang in self._waiting.values():
             depths[gang.band] = depths.get(gang.band, 0) + 1
         self.metrics.set_admission_queue_depths(
             {str(band): depth for band, depth in depths.items()}
         )
+        self.metrics.set_gauge(
+            "training_operator_admission_effective_throughput",
+            self._effective_throughput_locked(),
+        )
+        self.metrics.set_admission_dominant_shares(
+            self._dominant_shares_locked(cap)
+        )
+
+    def _effective_throughput_locked(self) -> float:
+        """Fleet-wide effective throughput of the admitted set:
+        Σ ratio(assigned generation) × members — the Gavel objective in
+        normalized chip-equivalents. On a homogeneous pool every ratio
+        is 1.0 and this is simply the admitted member count."""
+        return float(sum(
+            ratio_of(g, g.generation) * max(g.members, 1)
+            for g in self._admitted.values()
+        ))
+
+    def _dominant_shares_locked(self, cap=None) -> Dict[str, float]:
+        """Per-tenant dominant share: max over pool resources of
+        usage/capacity (the DRF coordinate). Empty without a bounded
+        pool — shares are undefined against infinity."""
+        if cap is None:
+            cap = self.effective_capacity()
+        if not cap:
+            return {}
+        shares: Dict[str, float] = {}
+        for ns in sorted({g.namespace for g in self._admitted.values()}):
+            used = self._ns_usage_locked(ns)
+            share = 0.0
+            for resource, bound in cap.items():
+                if bound <= 0:
+                    continue
+                share = max(share, float(used.get(resource, Fraction(0)) / bound))
+            shares[ns] = round(share, 6)
+        return shares
 
     def _drain_kicks_locked(self) -> List[Callable[[], None]]:
         kicks, self._kicks = self._kicks, []
@@ -514,6 +672,7 @@ class AdmissionController:
         members: int = 0, has_pods: bool = False,
         kick: Optional[Callable[[], None]] = None,
         victim_rank: int = 0,
+        throughput_ratios: Optional[Dict[str, float]] = None,
     ) -> AdmitResult:
         """One job's admission question, asked on every sync. Admitted
         jobs take a fast path (plus a pump so capacity revocations are
@@ -539,6 +698,12 @@ class AdmissionController:
                 gang.uid = uid or gang.uid
                 gang.kick = kick or gang.kick
                 gang.victim_rank = victim_rank
+                if throughput_ratios is not None:
+                    # Full replace, including {} — deleting the map from
+                    # the spec must clear the stored ratios, or gavel
+                    # keeps placing on ratios the API object no longer
+                    # declares.
+                    gang.throughput_ratios = dict(throughput_ratios)
                 self._pump_locked(now)
                 newly = not gang.announced_admit
                 gang.announced_admit = True
@@ -557,6 +722,7 @@ class AdmissionController:
                         uid=uid, band=band, demand=demand, members=members,
                         seq=self._seq, enqueued_at=now,
                         victim_rank=victim_rank, kick=kick,
+                        throughput_ratios=dict(throughput_ratios or {}),
                     )
                     self._waiting[key] = gang
                 else:
@@ -566,8 +732,13 @@ class AdmissionController:
                     gang.uid = uid or gang.uid
                     gang.kick = kick or gang.kick
                     gang.victim_rank = victim_rank
+                    if throughput_ratios is not None:
+                        gang.throughput_ratios = dict(throughput_ratios)
                 if has_pods:
-                    self._admit_locked(gang, now, backfill=False, head_wait=None)
+                    self._admit_locked(
+                        gang, now, backfill=False, head_wait=None,
+                        generation=self._adoption_generation_locked(gang),
+                    )
                     gang.announced_admit = True
                     self._pump_locked(now)
                     kicks = self._drain_kicks_locked()
@@ -620,16 +791,30 @@ class AdmissionController:
             now = self.clock()
             gang = self._admitted.pop(key, None)
             if gang is not None:
-                band_seqs = [
-                    g.seq for g in self._waiting.values() if g.band == gang.band
-                ]
-                gang.seq = (min(band_seqs) - 1) if band_seqs else gang.seq
+                if cause == PREEMPT_CAUSE_THROUGHPUT:
+                    # A gavel swap victim YIELDS its place: re-queueing
+                    # at the head of its band (the priority/capacity
+                    # contract) would let an equal-band victim overtake
+                    # the very head it was evicted for and re-take the
+                    # vacated generation — the swap would churn forever
+                    # without the throughput gain that justified it.
+                    # Tail re-queue puts it behind the head; it
+                    # re-places work-conservingly on what remains.
+                    self._seq += 1
+                    gang.seq = self._seq
+                else:
+                    band_seqs = [
+                        g.seq for g in self._waiting.values()
+                        if g.band == gang.band
+                    ]
+                    gang.seq = (min(band_seqs) - 1) if band_seqs else gang.seq
                 gang.enqueued_at = now
                 gang.admitted_at = None
                 gang.backfilled = False
                 gang.announced_admit = False
                 gang.announced_queue = False
                 gang.reported_block = ""
+                gang.generation = None  # re-placed fresh on re-admission
                 self._waiting[gang.key] = gang
                 self.preemption_ledger.append((key, uid, cause))
                 self.metrics.gang_preemption_inc(cause, str(gang.band))
@@ -724,24 +909,67 @@ class AdmissionController:
         with self._lock:
             return key in self._admitted
 
+    def effective_throughput(self) -> float:
+        """Current fleet-wide effective throughput (Σ ratio × members
+        over admitted gangs) — the admission_effective_throughput gauge
+        value, exposed directly for the contention benchmark's
+        time-integral."""
+        with self._lock:
+            return self._effective_throughput_locked()
+
+    def dominant_shares(self) -> Dict[str, float]:
+        """Per-tenant dominant shares (the admission_dominant_share
+        gauge values) — the fairness coordinate the drf gate samples."""
+        with self._lock:
+            return self._dominant_shares_locked()
+
+    def decision_log_lines(self) -> List[str]:
+        """The decision log as canonical JSON lines — the byte-equality
+        artifact of the determinism regression (same seed + same call
+        sequence => identical lines, across runs and policies)."""
+        import json
+
+        with self._lock:
+            entries = list(self.decision_log)
+        return [
+            json.dumps(entry, sort_keys=True, separators=(",", ":"))
+            for entry in entries
+        ]
+
     def snapshot(self) -> dict:
         """The /debugz admission dump: bands, queue positions, aging
-        clocks, usage vs capacity/quotas, pending preemptions, and the
-        audit ledgers the invariants run over."""
+        clocks, usage vs capacity/quotas, pending preemptions, the audit
+        ledgers the invariants run over — and, since the policy seam:
+        the active policy name + seed, the per-generation sub-pools with
+        their usage, and the per-tenant dominant shares. All additive
+        keys: the PR 9 shape (what the smoke JSON and older dashboards
+        read) is unchanged."""
         with self._lock:
             now = self.clock()
             cap = self.effective_capacity()
+            gens = self.effective_generations()
+            gen_usage: Dict[str, Dict[str, Fraction]] = {}
+            for g in self._admitted.values():
+                if g.generation is None:
+                    continue
+                bucket = gen_usage.setdefault(g.generation, {})
+                for name, qty in g.demand.items():
+                    bucket[name] = bucket.get(name, Fraction(0)) + qty
 
             def fmt(resources):
                 return {k: str(v) for k, v in (resources or {}).items()}
 
-            return {
+            out = {
+                "policy": self.policy.name,
+                "seed": self.seed,
                 "capacity": fmt(cap) if cap is not None else None,
                 "usage": fmt(self._usage_locked()),
                 "quotas": {ns: fmt(q) for ns, q in self.quotas.items()},
                 "namespace_usage": {
                     ns: fmt(self._ns_usage_locked(ns))
-                    for ns in {g.namespace for g in self._admitted.values()}
+                    for ns in sorted(
+                        {g.namespace for g in self._admitted.values()}
+                    )
                 },
                 "aging_seconds": self.aging_seconds,
                 "backfill_max_members": self.backfill_max_members,
@@ -750,6 +978,7 @@ class AdmissionController:
                         "key": g.key, "band": g.band, "members": g.members,
                         "demand": fmt(g.demand), "backfilled": g.backfilled,
                         "admitted_for": round(now - (g.admitted_at or now), 3),
+                        **({"generation": g.generation} if gens else {}),
                     }
                     for g in sorted(
                         self._admitted.values(), key=lambda g: (-g.band, g.seq)
@@ -767,4 +996,18 @@ class AdmissionController:
                 "preempting": dict(self._preempt),
                 "admit_log": list(self.admit_log),
                 "preemption_ledger": [list(t) for t in self.preemption_ledger],
+                "effective_throughput": self._effective_throughput_locked(),
+                "dominant_shares": self._dominant_shares_locked(cap),
             }
+            if self.tenant_weights:
+                out["tenant_weights"] = dict(sorted(
+                    self.tenant_weights.items()))
+            if gens:
+                out["generations"] = {
+                    gen: {
+                        "capacity": fmt(gens[gen]),
+                        "usage": fmt(gen_usage.get(gen, {})),
+                    }
+                    for gen in sorted(gens)
+                }
+            return out

@@ -2907,6 +2907,9 @@ class JobController:
             key=item, kind=job.kind, namespace=job.namespace, name=job.name,
             uid=job.metadata.uid,
             priority_class=(sp.priority_class if sp is not None else "") or "",
+            throughput_ratios=dict(
+                (sp.throughput_ratios if sp is not None else None) or {}
+            ),
             demand=gang_demand(groups),
             members=sum(
                 int((g.get("spec") or {}).get("minMember") or 0)
@@ -3087,6 +3090,9 @@ class JobController:
                 priority_class=(
                     sp.priority_class if sp is not None else ""
                 ) or "",
+                throughput_ratios=dict(
+                    (sp.throughput_ratios if sp is not None else None) or {}
+                ),
                 demand=gang_demand([group]),
                 members=int(gspec.get("minMember") or 0),
                 has_pods=any(

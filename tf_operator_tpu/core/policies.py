@@ -1,0 +1,943 @@
+"""Pluggable admission policies: the pure-function seam behind
+``AdmissionController`` (docs/design/gang_admission.md "Policy seam").
+
+PR 9's arbiter was ONE hard-coded decision procedure (priority bands +
+hard namespace quotas + bounded backfill) buried inside
+``AdmissionController._pump_locked``. This module extracts it behind a
+pure function::
+
+    policy.decide(state: PolicyState) -> Decisions
+
+where ``state`` is an immutable view of (queue, pool, usage, seed) and
+``Decisions`` is an ORDERED action list (admit / backfill / preempt)
+plus a blocked-verdict map for whoever stays waiting. Determinism
+contract: ``decide`` reads NO wall clock and NO ambient state — for a
+fixed ``PolicyState`` it returns the same ``Decisions``, byte for byte.
+The controller applies the action list strictly in order (admit-log
+entries, metrics, and requeue kicks land in list order), so a policy's
+output order IS its observable schedule — which is what lets the
+PR 9/11 seeded admission tiers replay byte-identically under the
+default policy: :class:`PriorityPolicy` is the old ``_pump_locked``
+decision procedure transplanted verbatim.
+
+Three policies ship behind ``--admission-policy``:
+
+- ``priority`` (default): the PR 9 arbiter — priority bands, hard
+  namespace quotas, preempt-strictly-lower-band, bounded backfill with
+  the aging starvation bound. Byte-identical to the pre-seam code.
+- ``gavel``: heterogeneity-aware placement (Gavel, arXiv:2008.09213
+  §3). The capacity pool is split into device GENERATIONS (``--capacity
+  pods@v5lite=8,pods@v6=8``) and jobs declare per-generation normalized
+  throughput (``schedulingPolicy.throughputRatios``). Placement
+  greedily maximizes fleet-wide EFFECTIVE throughput
+  (Σ ratio(assigned generation) × members): a gang lands on its
+  best-ratio generation with room, falls back work-conservingly to the
+  best available one, and preemption fires ONLY when evicting the
+  chosen victims strictly raises the fleet-wide effective throughput
+  (never on band alone — see the failure-modes note on
+  preemption-cause attribution).
+- ``drf``: weighted dominant-resource fairness across tenants
+  (``--tenant-weight ns=w``), REPLACING the hard ``--namespace-quota``
+  ceiling with a work-conserving share bound: the next admit always
+  goes to the eligible tenant with the smallest weighted dominant
+  share, and a lone tenant with demand takes the whole pool (no
+  capacity is ever parked behind an absent tenant's reservation).
+
+Every policy is exercised head-to-head by
+``scripts/measure_control_plane.py --mode contention`` (the
+policy-vs-policy table persisted to build/contention_policies_last.json)
+and must pass ``check_admission_invariants`` (no partial gang, pool
+never exceeded).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from fractions import Fraction
+from typing import Dict, List, Mapping, Optional, Tuple
+
+# Preemption causes (the single definition — core/admission.py
+# re-exports them for its historical import home).
+PREEMPT_CAUSE_PRIORITY = "PriorityPreemption"
+PREEMPT_CAUSE_CAPACITY = "CapacityRevoked"
+# Gavel's improvement-gated eviction: the victim was not outranked, it
+# was out-THROUGHPUT — evicting it and placing the head strictly raised
+# fleet-wide effective throughput.
+PREEMPT_CAUSE_THROUGHPUT = "ThroughputPreemption"
+
+_F0 = Fraction(0)
+
+
+# --------------------------------------------------------------- state view
+
+
+@dataclass(frozen=True)
+class GangView:
+    """Immutable per-gang view handed to policies. Mirrors the fields of
+    the controller's ``_Gang`` a decision may legally depend on —
+    policies never see (and can never mutate) controller bookkeeping."""
+
+    key: str
+    namespace: str
+    band: int
+    seq: int
+    demand: Mapping[str, Fraction]
+    members: int
+    enqueued_at: float
+    victim_rank: int = 0
+    # Per-generation normalized throughput (schedulingPolicy.
+    # throughputRatios); a generation absent from the map rides
+    # DEFAULT_RATIO. Empty = the gang is generation-indifferent.
+    throughput_ratios: Mapping[str, float] = field(default_factory=dict)
+    # Set on ADMITTED gangs only: which generation sub-pool holds it.
+    generation: Optional[str] = None
+
+
+#: Throughput assumed for a generation a job declares no ratio for: 1.0
+#: (full speed). Declaring ratios only for slow generations therefore
+#: "just works", and ratio-less jobs are generation-indifferent.
+DEFAULT_RATIO = 1.0
+
+
+def ratio_of(gang: GangView, generation: Optional[str]) -> float:
+    if generation is None:
+        return DEFAULT_RATIO
+    try:
+        return float(gang.throughput_ratios.get(generation, DEFAULT_RATIO))
+    except (TypeError, ValueError):
+        return DEFAULT_RATIO
+
+
+@dataclass(frozen=True)
+class PolicyState:
+    """One pump's immutable input: (queue, pool, usage, seed). ``now``
+    is the controller's injected clock value AT the pump — a policy
+    never reads time itself, so seeded fake-clock replays are exact."""
+
+    # Waiting gangs in canonical queue order (band desc, seq asc) — the
+    # ONE ordering the controller guarantees; policies that want another
+    # (drf) re-sort deterministically.
+    waiting: Tuple[GangView, ...]
+    # Admitted gangs, seq order.
+    admitted: Tuple[GangView, ...]
+    # Keys already marked for preemption (engine ack pending). Their
+    # capacity still counts as used until note_preempted.
+    pending_preempt: frozenset
+    # Effective flat pool (None = unlimited), per-resource Fractions.
+    capacity: Optional[Mapping[str, Fraction]]
+    # Device-generation sub-pools ({} = homogeneous pool, the PR 9
+    # world). The flat pool already includes their element-wise sum.
+    generations: Mapping[str, Mapping[str, Fraction]]
+    quotas: Mapping[str, Mapping[str, Fraction]]
+    # Weighted-DRF tenant weights (ns -> weight > 0); tenants absent
+    # from the map ride weight 1.0.
+    tenant_weights: Mapping[str, float]
+    backfill_max_members: int
+    aging_seconds: float
+    now: float
+    seed: int = 0
+
+
+# ---------------------------------------------------------------- decisions
+
+
+@dataclass(frozen=True)
+class Admit:
+    key: str
+    backfill: bool = False
+    # The head-of-line's wait at a backfill admit (the starvation-audit
+    # number recorded in the admit log); None for head admits.
+    head_wait: Optional[float] = None
+    generation: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class Preempt:
+    key: str
+    cause: str = PREEMPT_CAUSE_PRIORITY
+
+
+@dataclass
+class Decisions:
+    """Ordered decision list + blocked verdicts. ``actions`` is applied
+    strictly in order by the controller (admits register capacity,
+    preempts mark victims); ``blocked`` maps every still-waiting key to
+    the verdict vocabulary the snapshot/conditions surface:
+    capacity | quota | order | priority."""
+
+    actions: List[object] = field(default_factory=list)
+    blocked: Dict[str, str] = field(default_factory=dict)
+
+
+# ------------------------------------------------------------ shared helpers
+
+
+def fits(demand: Mapping[str, Fraction], usage: Mapping[str, Fraction],
+         cap: Optional[Mapping[str, Fraction]]) -> bool:
+    """Resources absent from the pool are unconstrained (a pool declared
+    in chips does not bound cpu) — the PR 9 rule, unchanged."""
+    if cap is None:
+        return True
+    return all(
+        usage.get(name, _F0) + qty <= cap[name]
+        for name, qty in demand.items()
+        if name in cap
+    )
+
+
+def usage_of(gangs, exclude=frozenset()) -> Dict[str, Fraction]:
+    usage: Dict[str, Fraction] = {}
+    for gang in gangs:
+        if gang.key in exclude:
+            continue
+        for name, qty in gang.demand.items():
+            usage[name] = usage.get(name, _F0) + qty
+    return usage
+
+
+def ns_usage_of(gangs, namespace: str, exclude=frozenset()) -> Dict[str, Fraction]:
+    usage: Dict[str, Fraction] = {}
+    for gang in gangs:
+        if gang.key in exclude or gang.namespace != namespace:
+            continue
+        for name, qty in gang.demand.items():
+            usage[name] = usage.get(name, _F0) + qty
+    return usage
+
+
+def gen_usage_of(gangs, exclude=frozenset()) -> Dict[str, Dict[str, Fraction]]:
+    """Per-generation usage from admitted gangs' placements."""
+    out: Dict[str, Dict[str, Fraction]] = {}
+    for gang in gangs:
+        if gang.key in exclude or gang.generation is None:
+            continue
+        bucket = out.setdefault(gang.generation, {})
+        for name, qty in gang.demand.items():
+            bucket[name] = bucket.get(name, _F0) + qty
+    return out
+
+
+def quota_ok(state: PolicyState, gang: GangView, admitted_now,
+             exclude=frozenset()) -> bool:
+    quota = state.quotas.get(gang.namespace)
+    if not quota:
+        return True
+    used = ns_usage_of(admitted_now, gang.namespace, exclude)
+    return all(
+        used.get(name, _F0) + qty <= quota[name]
+        for name, qty in gang.demand.items()
+        if name in quota
+    )
+
+
+def generation_candidates(state: PolicyState, gang: GangView,
+                          admitted_now, exclude=frozenset()) -> List[str]:
+    """Generations with room for the gang (every resource the generation
+    declares bounds it), sorted by name — the deterministic first-fit
+    order the chip-count-greedy default uses."""
+    if not state.generations:
+        return []
+    gen_usage = gen_usage_of(admitted_now, exclude)
+    return [
+        name
+        for name in sorted(state.generations)
+        if fits(gang.demand, gen_usage.get(name, {}), state.generations[name])
+    ]
+
+
+def first_fit_generation(state: PolicyState, gang: GangView,
+                         admitted_now, exclude=frozenset()) -> Optional[str]:
+    candidates = generation_candidates(state, gang, admitted_now, exclude)
+    return candidates[0] if candidates else None
+
+
+def first_fit_in(state: PolicyState, gang: GangView,
+                 gen_usage: Mapping[str, Mapping[str, Fraction]]
+                 ) -> Optional[str]:
+    """first_fit_generation against a PREBUILT per-generation usage map
+    — the hot-path form (scan loops maintain the map incrementally;
+    rebuilding it per waiter is the O(admitted × waiters) lock stall
+    the incremental caches exist to avoid)."""
+    for name in sorted(state.generations):
+        if fits(gang.demand, gen_usage.get(name, {}),
+                state.generations[name]):
+            return name
+    return None
+
+
+def best_ratio(state: PolicyState, gang: GangView) -> float:
+    """The gang's throughput on its best generation (1.0 when the pool
+    is homogeneous) — the ETW denominator."""
+    if not state.generations:
+        return DEFAULT_RATIO
+    return max(ratio_of(gang, g) for g in sorted(state.generations))
+
+
+def _admissible(state: PolicyState, gang: GangView, usage, gen_usage):
+    """(fits, generation) under the flat pool AND the generation
+    sub-pools: with generations declared, a gang must land whole in ONE
+    generation — the flat pool fitting while every sub-pool is
+    fragmented is a wait, not an admit. ``gen_usage`` is the caller's
+    incrementally-maintained per-generation usage map."""
+    if not fits(gang.demand, usage, state.capacity):
+        return False, None
+    if not state.generations:
+        return True, None
+    gen = first_fit_in(state, gang, gen_usage)
+    return (gen is not None), gen
+
+
+# ------------------------------------------------------------------ policies
+
+
+class AdmissionPolicy:
+    """Base class: ``decide`` must be a pure function of ``state``."""
+
+    name = "base"
+
+    def decide(self, state: PolicyState) -> Decisions:  # pragma: no cover
+        raise NotImplementedError
+
+    def _revocation_preempts(self, state: PolicyState, decisions: Decisions,
+                             pending: set, order_key) -> None:
+        """Shared capacity-revocation phase: the pool shrank under the
+        admitted set — preempt gangs in ``order_key`` order until what
+        remains fits. Pending victims still count as usage until the
+        engine's counted teardown acknowledges them, so the check
+        excludes only gangs already marked. (Byte-identical port of the
+        PR 9 revocation phase when ``order_key`` is the priority
+        policy's victim order.)"""
+        cap = state.capacity
+        if cap is None:
+            return
+        victims_pool = sorted(
+            (g for g in state.admitted if g.key not in pending),
+            key=order_key,
+        )
+        excluded = set(pending)
+        for victim in victims_pool:
+            usage = usage_of(state.admitted, excluded)
+            if all(usage.get(r, _F0) <= cap[r] for r in cap):
+                break
+            decisions.actions.append(
+                Preempt(victim.key, PREEMPT_CAUSE_CAPACITY))
+            excluded.add(victim.key)
+            pending.add(victim.key)
+        # Generation sub-pool overcommit (only possible via operator-
+        # restart adoption — live pods must be re-admitted wherever they
+        # physically are — or a live generation-scoped shrink): preempt
+        # gangs placed IN the oversubscribed generation, same order,
+        # until its sub-pool fits. Runs only on generation-split pools,
+        # so homogeneous replays are untouched.
+        for gen_name in sorted(state.generations):
+            bound = state.generations[gen_name]
+            victims_pool = sorted(
+                (g for g in state.admitted
+                 if g.generation == gen_name and g.key not in pending),
+                key=order_key,
+            )
+            for victim in victims_pool:
+                gen_usage = gen_usage_of(
+                    state.admitted, excluded).get(gen_name, {})
+                if all(gen_usage.get(r, _F0) <= bound[r] for r in bound):
+                    break
+                decisions.actions.append(
+                    Preempt(victim.key, PREEMPT_CAUSE_CAPACITY))
+                excluded.add(victim.key)
+                pending.add(victim.key)
+
+
+class PriorityPolicy(AdmissionPolicy):
+    """The PR 9 arbiter, re-expressed behind the seam — the decision
+    procedure of the old ``AdmissionController._pump_locked`` verbatim
+    (same orderings, same verdicts, same action order), so every seeded
+    admission tier replays byte-identically with the seam in place.
+    With generations declared (new territory — no seeded tier predates
+    it), placement is chip-count-greedy first-fit in sorted generation
+    order: the policy is deliberately throughput-BLIND, which is
+    exactly the strawman the gavel gate measures against."""
+
+    name = "priority"
+
+    @staticmethod
+    def _victim_order(g: GangView):
+        return (g.band, -g.victim_rank, -g.seq)
+
+    def decide(self, state: PolicyState) -> Decisions:
+        decisions = Decisions()
+        pending = set(state.pending_preempt)
+        cap = state.capacity
+        self._revocation_preempts(state, decisions, pending,
+                                  self._victim_order)
+        # Admission scan, priority order. Head-of-line = first waiter its
+        # own quota allows; it admits as soon as it fits, schedules
+        # preemption of strictly-lower bands when it doesn't, and bounds
+        # backfill behind it by its age. While preemptions are PENDING,
+        # backfill is suppressed (a victim slipping back into the gap its
+        # own eviction opened is a preemption livelock).
+        pending_preempt = bool(pending)
+        head: Optional[GangView] = None
+        head_wait = 0.0
+        admitted_now: List[GangView] = list(state.admitted)
+        usage = usage_of(admitted_now)
+        gen_usage: Dict[str, Dict[str, Fraction]] = (
+            gen_usage_of(admitted_now) if state.generations else {}
+        )
+        ns_usage: Dict[str, Dict[str, Fraction]] = {}
+
+        def ns_usage_view(namespace: str) -> Dict[str, Fraction]:
+            if namespace not in ns_usage:
+                ns_usage[namespace] = ns_usage_of(admitted_now, namespace)
+            return ns_usage[namespace]
+
+        def scan_quota_ok(gang: GangView) -> bool:
+            quota = state.quotas.get(gang.namespace)
+            if not quota:
+                return True
+            used = ns_usage_view(gang.namespace)
+            return all(
+                used.get(name, _F0) + qty <= quota[name]
+                for name, qty in gang.demand.items()
+                if name in quota
+            )
+
+        def charge(gang: GangView, generation: Optional[str]) -> None:
+            for name, qty in gang.demand.items():
+                usage[name] = usage.get(name, _F0) + qty
+            used = ns_usage_view(gang.namespace)
+            for name, qty in gang.demand.items():
+                used[name] = used.get(name, _F0) + qty
+            if generation is not None:
+                bucket = gen_usage.setdefault(generation, {})
+                for name, qty in gang.demand.items():
+                    bucket[name] = bucket.get(name, _F0) + qty
+            admitted_now.append(GangView(
+                key=gang.key, namespace=gang.namespace, band=gang.band,
+                seq=gang.seq, demand=gang.demand, members=gang.members,
+                enqueued_at=gang.enqueued_at, victim_rank=gang.victim_rank,
+                throughput_ratios=gang.throughput_ratios,
+                generation=generation,
+            ))
+
+        for gang in state.waiting:
+            if not scan_quota_ok(gang):
+                decisions.blocked[gang.key] = "quota"
+                continue
+            is_head = head is None
+            if is_head:
+                head = gang
+                head_wait = state.now - gang.enqueued_at
+            ok, generation = _admissible(state, gang, usage, gen_usage)
+            if ok:
+                if is_head:
+                    decisions.actions.append(
+                        Admit(gang.key, generation=generation))
+                    charge(gang, generation)
+                    head = None  # the next eligible waiter takes the line
+                elif (
+                    not pending_preempt
+                    and state.backfill_max_members > 0
+                    and gang.members <= state.backfill_max_members
+                    and head_wait < state.aging_seconds
+                ):
+                    decisions.actions.append(Admit(
+                        gang.key, backfill=True, head_wait=head_wait,
+                        generation=generation,
+                    ))
+                    charge(gang, generation)
+                else:
+                    decisions.blocked[gang.key] = "order"
+                continue
+            if is_head:
+                # Priority preemption: strictly lower bands only — equal-
+                # band contention waits its turn (FIFO within a band is
+                # the fairness contract). Check-before-marking, INCLUDING
+                # the already-pending set: the pending evictions alone may
+                # already satisfy the head.
+                candidates = sorted(
+                    (g for g in admitted_now
+                     if g.band < gang.band and g.key not in pending),
+                    key=self._victim_order,
+                )
+                freed: set = set(pending)
+                chosen: List[GangView] = []
+
+                def satisfied() -> bool:
+                    flat = fits(
+                        gang.demand, usage_of(admitted_now, freed), cap
+                    ) and quota_ok(state, gang, admitted_now, freed)
+                    if not flat or not state.generations:
+                        return flat
+                    return first_fit_generation(
+                        state, gang, admitted_now, freed) is not None
+
+                satisfiable = satisfied()
+                if not satisfiable:
+                    for candidate in candidates:
+                        chosen.append(candidate)
+                        freed.add(candidate.key)
+                        if satisfied():
+                            satisfiable = True
+                            break
+                if satisfiable:
+                    for victim in chosen:
+                        decisions.actions.append(
+                            Preempt(victim.key, PREEMPT_CAUSE_PRIORITY))
+                        pending.add(victim.key)
+                    pending_preempt = True
+                    decisions.blocked[gang.key] = "priority"
+                else:
+                    decisions.blocked[gang.key] = "capacity"
+            else:
+                decisions.blocked[gang.key] = "capacity"
+        return decisions
+
+
+class GavelPolicy(AdmissionPolicy):
+    """Heterogeneity-aware placement (Gavel §3, greedy form): maximize
+    fleet-wide effective throughput Σ ratio(assigned generation) ×
+    members. Wait order stays (band desc, seq asc) — Gavel arbitrates
+    WHERE a gang runs, the band ladder still says WHO asks first.
+
+    Per head-of-line, in order of preference:
+
+    1. admit on the best-RATIO generation with room (ties break by
+       generation name — deterministic, and a tie means the gang is
+       indifferent);
+    2. preempt-to-improve: evict the cheapest victims (lowest current
+       contribution, band ≤ the head's) from the head's best generation
+       IFF the swap STRICTLY raises fleet-wide effective throughput —
+       head.ratio(g*)×members > Σ victims' current contribution AND
+       beats admitting on the best available generation outright. The
+       victims re-queue at the TAIL of their bands (head re-queue would
+       let an equal-band victim overtake the head it was evicted for
+       and re-take the vacated generation — endless churn) and
+       typically re-place on whatever the head left behind (the classic
+       Gavel swap), cause ``ThroughputPreemption``;
+    3. otherwise admit work-conservingly on the best AVAILABLE
+       generation (a 0.25x slot beats an idle slot — utilization is
+       half the objective);
+    4. nothing available and no improving swap → wait ("capacity").
+
+    Bounded backfill and the aging starvation bound carry over
+    unchanged; hard namespace quotas still apply when declared.
+    Capacity revocation evicts lowest-contribution gangs first (the
+    throughput-greedy mirror of the priority policy's
+    lowest-band-first)."""
+
+    name = "gavel"
+
+    @staticmethod
+    def _contribution(g: GangView) -> float:
+        return ratio_of(g, g.generation) * max(g.members, 1)
+
+    def _revocation_order(self, g: GangView):
+        return (self._contribution(g), g.band, -g.victim_rank, -g.seq)
+
+    def _best_generations(self, state: PolicyState, gang: GangView):
+        """Every generation ranked by the gang's preference: ratio
+        desc, then name asc — fully deterministic."""
+        return sorted(
+            state.generations,
+            key=lambda name: (-ratio_of(gang, name), name),
+        )
+
+    def decide(self, state: PolicyState) -> Decisions:
+        decisions = Decisions()
+        pending = set(state.pending_preempt)
+        cap = state.capacity
+        self._revocation_preempts(state, decisions, pending,
+                                  self._revocation_order)
+        pending_preempt = bool(pending)
+        head: Optional[GangView] = None
+        head_wait = 0.0
+        admitted_now: List[GangView] = list(state.admitted)
+        usage = usage_of(admitted_now)
+
+        # Incremental usage caches (the PriorityPolicy discipline — a
+        # naive recompute per waiter makes every sync O(admitted x
+        # waiters) inside the controller lock at fleet scale).
+        gen_usage: Dict[str, Dict[str, Fraction]] = gen_usage_of(admitted_now)
+        ns_usage: Dict[str, Dict[str, Fraction]] = {}
+
+        def ns_usage_view(namespace: str) -> Dict[str, Fraction]:
+            if namespace not in ns_usage:
+                ns_usage[namespace] = ns_usage_of(admitted_now, namespace)
+            return ns_usage[namespace]
+
+        def scan_quota_ok(gang: GangView) -> bool:
+            quota = state.quotas.get(gang.namespace)
+            if not quota:
+                return True
+            used = ns_usage_view(gang.namespace)
+            return all(
+                used.get(name, _F0) + qty <= quota[name]
+                for name, qty in gang.demand.items()
+                if name in quota
+            )
+
+        def place_best(gang: GangView):
+            """Best-ratio generation with room, or None."""
+            for name in self._best_generations(state, gang):
+                if fits(gang.demand, gen_usage.get(name, {}),
+                        state.generations[name]):
+                    return name
+            return None
+
+        def best_free_after_pending(gang: GangView,
+                                    best_gen: str) -> bool:
+            """Would the head fit its BEST generation once the pending
+            teardowns ack? Pending victims' capacity is spoken for the
+            line — the priority policy's pending-evictions-first rule,
+            generation-aware."""
+            return fits(
+                gang.demand, usage_of(admitted_now, pending), cap
+            ) and quota_ok(state, gang, admitted_now, pending) and fits(
+                gang.demand,
+                gen_usage_of(admitted_now, pending).get(best_gen, {}),
+                state.generations[best_gen],
+            )
+
+        def charge(gang: GangView, generation: Optional[str]) -> None:
+            for name, qty in gang.demand.items():
+                usage[name] = usage.get(name, _F0) + qty
+            used = ns_usage_view(gang.namespace)
+            for name, qty in gang.demand.items():
+                used[name] = used.get(name, _F0) + qty
+            if generation is not None:
+                bucket = gen_usage.setdefault(generation, {})
+                for name, qty in gang.demand.items():
+                    bucket[name] = bucket.get(name, _F0) + qty
+            admitted_now.append(GangView(
+                key=gang.key, namespace=gang.namespace, band=gang.band,
+                seq=gang.seq, demand=gang.demand, members=gang.members,
+                enqueued_at=gang.enqueued_at, victim_rank=gang.victim_rank,
+                throughput_ratios=gang.throughput_ratios,
+                generation=generation,
+            ))
+
+        for gang in state.waiting:
+            if not scan_quota_ok(gang):
+                decisions.blocked[gang.key] = "quota"
+                continue
+            is_head = head is None
+            if is_head:
+                head = gang
+                head_wait = state.now - gang.enqueued_at
+            flat_fits = fits(gang.demand, usage, cap)
+            generation = place_best(gang) if state.generations else None
+            fits_somewhere = flat_fits and (
+                not state.generations or generation is not None)
+            if is_head and state.generations:
+                best_gen = self._best_generations(state, gang)[0]
+                current_ratio = (
+                    ratio_of(gang, generation) if fits_somewhere else -1.0
+                )
+                if (
+                    ratio_of(gang, best_gen) > current_ratio
+                    and pending
+                    and best_free_after_pending(gang, best_gen)
+                ):
+                    # A pump landing between a swap's preempt-mark and
+                    # its teardown ack must keep the head WAITING for
+                    # the generation being freed — admitting it onto an
+                    # inferior generation here would waste the eviction
+                    # it (or an earlier head) just ordered.
+                    decisions.blocked[gang.key] = "priority"
+                    continue
+            if fits_somewhere and is_head and state.generations:
+                # Preempt-to-improve beats a worse-generation admit only
+                # when the strict-gain condition holds; checked below.
+                if ratio_of(gang, generation) < ratio_of(gang, best_gen):
+                    swap = self._improving_swap(
+                        state, gang, best_gen, admitted_now, pending,
+                        beat=ratio_of(gang, generation) * max(gang.members, 1),
+                    )
+                    if swap:
+                        # The head stays at the line while its victims
+                        # tear down (pending_preempt suppresses backfill
+                        # into the gap being freed for it).
+                        for victim in swap:
+                            decisions.actions.append(
+                                Preempt(victim.key,
+                                        PREEMPT_CAUSE_THROUGHPUT))
+                            pending.add(victim.key)
+                        pending_preempt = True
+                        decisions.blocked[gang.key] = "priority"
+                        continue
+            if fits_somewhere:
+                if is_head:
+                    decisions.actions.append(
+                        Admit(gang.key, generation=generation))
+                    charge(gang, generation)
+                    head = None
+                elif (
+                    not pending_preempt
+                    and state.backfill_max_members > 0
+                    and gang.members <= state.backfill_max_members
+                    and head_wait < state.aging_seconds
+                ):
+                    decisions.actions.append(Admit(
+                        gang.key, backfill=True, head_wait=head_wait,
+                        generation=generation,
+                    ))
+                    charge(gang, generation)
+                else:
+                    decisions.blocked[gang.key] = "order"
+                continue
+            if is_head:
+                if state.generations:
+                    best_gen = self._best_generations(state, gang)[0]
+                    swap = self._improving_swap(
+                        state, gang, best_gen, admitted_now, pending,
+                        beat=0.0,
+                    )
+                    if swap:
+                        for victim in swap:
+                            decisions.actions.append(
+                                Preempt(victim.key,
+                                        PREEMPT_CAUSE_THROUGHPUT))
+                            pending.add(victim.key)
+                        pending_preempt = True
+                        decisions.blocked[gang.key] = "priority"
+                        continue
+                decisions.blocked[gang.key] = "capacity"
+            else:
+                decisions.blocked[gang.key] = "capacity"
+        return decisions
+
+    def _improving_swap(self, state: PolicyState, gang: GangView,
+                        generation: str, admitted_now, pending,
+                        beat: float) -> Optional[List[GangView]]:
+        """Victims in ``generation`` (band ≤ the head's, cheapest
+        contribution first) whose eviction makes room for the head AND
+        satisfies the STRICT Gavel gain condition:
+        head.ratio(g)×members − Σ victim contribution > ``beat`` (the
+        value of the head's next-best alternative; 0.0 when it has
+        none). Returns None when no improving set exists."""
+        gain_cap = ratio_of(gang, generation) * max(gang.members, 1)
+        if gain_cap <= beat:
+            return None
+
+        def head_fits(freed: set) -> bool:
+            if not fits(
+                gang.demand, usage_of(admitted_now, freed), state.capacity
+            ) or not quota_ok(state, gang, admitted_now, freed):
+                return False
+            gen_usage = gen_usage_of(admitted_now, freed)
+            return fits(gang.demand, gen_usage.get(generation, {}),
+                        state.generations[generation])
+
+        candidates = sorted(
+            (g for g in admitted_now
+             if g.generation == generation and g.key not in pending
+             and g.band <= gang.band),
+            key=lambda g: (self._contribution(g), -g.seq),
+        )
+        chosen: List[GangView] = []
+        freed: set = set(pending)
+        for candidate in candidates:
+            chosen.append(candidate)
+            freed.add(candidate.key)
+            if head_fits(freed):
+                break
+        else:
+            return None
+        # Prune gratuitous victims: the cheapest-contribution-first
+        # greedy can collect small gangs whose room a later, bigger
+        # victim made unnecessary — every survivor of this pass is
+        # load-bearing (dropping it un-fits the head). The strict-gain
+        # check runs on the PRUNED loss, so a big-victim-only swap is
+        # not rejected for the prefix's dead weight.
+        for candidate in list(chosen):
+            trial = freed - {candidate.key}
+            if head_fits(trial):
+                chosen.remove(candidate)
+                freed = trial
+        lost = sum(self._contribution(c) for c in chosen)
+        if gain_cap - lost <= beat:
+            return None
+        return chosen
+
+
+class DrfPolicy(AdmissionPolicy):
+    """Weighted dominant-resource fairness (DRF) across tenants. The
+    next admit always goes to the eligible gang of the tenant with the
+    SMALLEST weighted dominant share (max over pool resources of
+    usage/capacity, divided by the tenant's ``--tenant-weight``; absent
+    tenants ride weight 1.0); ties break (band desc, seq asc) — the
+    fairness ordering REPLACES hard quota ceilings, so the share bound
+    is work-conserving: a tenant alone with demand takes the whole
+    pool, and under contention admitted shares track declared weights
+    (the ``--mode contention`` drf gate bounds the spread at ≤1.5× the
+    weight ratio). Declared ``--namespace-quota``s, if any, still cap a
+    tenant hard (belt over suspenders; drf normally runs without).
+    Backfill/aging carry over against the DRF head-of-line. Capacity
+    revocation evicts from the LARGEST weighted-share tenant first —
+    fairness decides who gives back. Generation placement is first-fit
+    (drf arbitrates shares, not heterogeneity)."""
+
+    name = "drf"
+
+    def _weight(self, state: PolicyState, namespace: str) -> float:
+        try:
+            w = float(state.tenant_weights.get(namespace, 1.0))
+        except (TypeError, ValueError):
+            w = 1.0
+        return w if w > 0 else 1.0
+
+    def _dominant_share(self, state: PolicyState, namespace: str,
+                        admitted_now, exclude=frozenset()) -> float:
+        cap = state.capacity
+        if not cap:
+            return 0.0
+        used = ns_usage_of(admitted_now, namespace, exclude)
+        share = 0.0
+        for resource, bound in cap.items():
+            if bound <= 0:
+                continue
+            share = max(share, float(used.get(resource, _F0) / bound))
+        return share
+
+    def decide(self, state: PolicyState) -> Decisions:
+        decisions = Decisions()
+        pending = set(state.pending_preempt)
+
+        def revocation_order(g: GangView):
+            return (
+                -self._dominant_share(state, g.namespace, state.admitted)
+                / self._weight(state, g.namespace),
+                g.band, -g.victim_rank, -g.seq,
+            )
+
+        self._revocation_preempts(state, decisions, pending,
+                                  revocation_order)
+        pending_preempt = bool(pending)
+        admitted_now: List[GangView] = list(state.admitted)
+        usage = usage_of(admitted_now)
+        remaining: List[GangView] = list(state.waiting)
+        head_wait: Optional[float] = None
+        backfilling = False
+        # Incremental per-tenant usage (shares are recomputed on every
+        # re-sort — a full admitted-set scan per waiter per pass is the
+        # O(admitted x waiters) lock stall PriorityPolicy's caches
+        # exist to avoid).
+        ns_usage: Dict[str, Dict[str, Fraction]] = {}
+        for g in admitted_now:
+            bucket = ns_usage.setdefault(g.namespace, {})
+            for name, qty in g.demand.items():
+                bucket[name] = bucket.get(name, _F0) + qty
+        gen_usage: Dict[str, Dict[str, Fraction]] = (
+            gen_usage_of(admitted_now) if state.generations else {}
+        )
+
+        def dominant_share(namespace: str) -> float:
+            if not state.capacity:
+                return 0.0
+            used = ns_usage.get(namespace, {})
+            share = 0.0
+            for resource, bound in state.capacity.items():
+                if bound <= 0:
+                    continue
+                share = max(share, float(used.get(resource, _F0) / bound))
+            return share
+
+        def charge(gang: GangView, generation: Optional[str]) -> None:
+            for name, qty in gang.demand.items():
+                usage[name] = usage.get(name, _F0) + qty
+            bucket = ns_usage.setdefault(gang.namespace, {})
+            for name, qty in gang.demand.items():
+                bucket[name] = bucket.get(name, _F0) + qty
+            if generation is not None:
+                gen_bucket = gen_usage.setdefault(generation, {})
+                for name, qty in gang.demand.items():
+                    gen_bucket[name] = gen_bucket.get(name, _F0) + qty
+            admitted_now.append(GangView(
+                key=gang.key, namespace=gang.namespace, band=gang.band,
+                seq=gang.seq, demand=gang.demand, members=gang.members,
+                enqueued_at=gang.enqueued_at, victim_rank=gang.victim_rank,
+                throughput_ratios=gang.throughput_ratios,
+                generation=generation,
+            ))
+
+        def drf_order(gang: GangView):
+            return (
+                dominant_share(gang.namespace)
+                / self._weight(state, gang.namespace),
+                -gang.band, gang.seq,
+            )
+
+        # Repeated-selection loop: shares move with every admit, so the
+        # "most underserved tenant" is recomputed after each one —
+        # that recomputation IS the fairness mechanism. Terminates
+        # because every pass either shrinks `remaining` (admit or
+        # quota-block, both `break` to re-sort) or completes break-free
+        # (nothing actionable) and exits via the for/else.
+        while remaining:
+            order = sorted(remaining, key=drf_order)
+            for position, gang in enumerate(order):
+                if not quota_ok(state, gang, admitted_now):
+                    decisions.blocked[gang.key] = "quota"
+                    remaining.remove(gang)
+                    break
+                is_head = position == 0 and not backfilling
+                if is_head and head_wait is None:
+                    head_wait = state.now - gang.enqueued_at
+                ok, generation = _admissible(
+                    state, gang, usage, gen_usage)
+                if ok and (
+                    is_head
+                    or (
+                        not pending_preempt
+                        and state.backfill_max_members > 0
+                        and gang.members <= state.backfill_max_members
+                        and (head_wait or 0.0) < state.aging_seconds
+                    )
+                ):
+                    decisions.actions.append(Admit(
+                        gang.key, backfill=not is_head,
+                        head_wait=None if is_head else head_wait,
+                        generation=generation,
+                    ))
+                    charge(gang, generation)
+                    remaining.remove(gang)
+                    if is_head:
+                        head_wait = None
+                    break
+                if is_head:
+                    # The DRF head doesn't fit: everything behind it may
+                    # only BACKFILL from here on (same starvation rule
+                    # as the priority policy).
+                    backfilling = True
+                    decisions.blocked[gang.key] = "capacity"
+                else:
+                    decisions.blocked[gang.key] = (
+                        "order" if ok else "capacity")
+            else:
+                break
+        # Whoever the inner loop never verdicted (it restarts on every
+        # admit) keeps a capacity verdict.
+        for gang in remaining:
+            decisions.blocked.setdefault(
+                gang.key, "order" if backfilling else "capacity")
+        return decisions
+
+
+POLICIES = {
+    PriorityPolicy.name: PriorityPolicy,
+    GavelPolicy.name: GavelPolicy,
+    DrfPolicy.name: DrfPolicy,
+}
+
+
+def build_policy(name: str) -> AdmissionPolicy:
+    """Policy registry lookup (--admission-policy). Raises ValueError on
+    an unknown name — a typo'd policy silently falling back to the
+    default would run the wrong scheduler for the fleet's whole life."""
+    try:
+        return POLICIES[str(name or "priority")]()
+    except KeyError:
+        raise ValueError(
+            f"unknown admission policy {name!r} "
+            f"(known: {', '.join(sorted(POLICIES))})"
+        )

@@ -251,6 +251,15 @@ class Metrics:
             "beside free capacity is an admission bug; depth in low "
             "bands under contention is the design working",
         ),
+        "training_operator_admission_dominant_share": (
+            ("job_namespace",),
+            "Each tenant's dominant share of the admission pool: max "
+            "over pool resources of admittedUsage/capacity (the DRF "
+            "coordinate, core/policies.py). Under --admission-policy "
+            "drf the ratio between two busy tenants' shares must track "
+            "their --tenant-weight ratio — a sustained skew beyond it "
+            "is the fairness-skew alert (docs/monitoring/README.md)",
+        ),
         "training_operator_busy_workers": (
             ("framework",),
             "Sync workers currently inside a reconcile (client-go "
@@ -442,6 +451,21 @@ class Metrics:
             return self._labeled_gauges[
                 "training_operator_admission_queue_depth"
             ].get((band,))
+
+    def set_admission_dominant_shares(self, shares: Dict[str, float]) -> None:
+        """Replace the per-tenant dominant-share gauge wholesale (a
+        tenant whose last gang released drops its series rather than
+        freezing at a stale share)."""
+        with self._lock:
+            self._labeled_gauges[
+                "training_operator_admission_dominant_share"
+            ] = {(ns,): float(share) for ns, share in shares.items()}
+
+    def admission_dominant_share_value(self, namespace: str) -> Optional[float]:
+        with self._lock:
+            return self._labeled_gauges[
+                "training_operator_admission_dominant_share"
+            ].get((namespace,))
 
     def apiserver_request_inc(self, verb: str, resource: str, code: str) -> None:
         """One apiserver request completed (any verb, any outcome)."""

@@ -372,6 +372,20 @@ def check_admission_invariants(
                     f"admission: usage of {resource} ({used}) exceeds "
                     f"capacity ({bound}) at a converged state"
                 )
+    # Device-generation sub-pools (the gavel placement unit): each
+    # generation's placed usage must fit ITS bound — the flat pool
+    # fitting while one generation is oversubscribed means a policy
+    # placed a gang on chips that aren't there.
+    for gen, pools in (snap.get("generations") or {}).items():
+        gen_cap = pools.get("capacity") or {}
+        gen_used = pools.get("usage") or {}
+        for resource, bound in gen_cap.items():
+            used = gen_used.get(resource)
+            if used is not None and parse_quantity(used) > parse_quantity(bound):
+                violations.append(
+                    f"admission: generation {gen} usage of {resource} "
+                    f"({used}) exceeds its sub-pool ({bound})"
+                )
     for ns, quota in (snap.get("quotas") or {}).items():
         ns_usage = (snap.get("namespace_usage") or {}).get(ns) or {}
         for resource, bound in quota.items():
