@@ -377,6 +377,34 @@ def default_dag() -> List[Step]:
               f" && {PY} scripts/measure_control_plane.py --mode contention"
               " --smoke --policy drf"],
              deps=["contention-smoke"], retries=3),
+        # Autoscaler tier (docs/design/autoscaling.md): the signal-driven
+        # gang autoscaler — the pure decision function (grow watermark +
+        # hold, checkpoint-coordinated shrink, scale-efficiency guard,
+        # dwell/cooldown hysteresis, gavel placement-quality ordering),
+        # the resize × admission no-bypass interplay, the heartbeat
+        # checkpoint rider, stale-throughput pruning after shrink — plus
+        # the seeded chaos half: 3-run byte-equal decision-log replay on
+        # fake clocks, ScheduledCapacityRevocation mid-grow with the
+        # cooldown anti-flap audited from the resize ledger, and the
+        # crash-point sweep over the resize write window proving
+        # exactly-once spec patches.
+        Step("autoscaler-tier",
+             pytest + ["tests/test_autoscaler.py",
+                       "tests/test_autoscaler_chaos.py", "-m", "not slow"],
+             deps=["admission-chaos"], retries=2),
+        # Elasticity smoke (scripts/measure_control_plane.py --mode
+        # elasticity --smoke): the seeded contention + capacity-churn
+        # scenario scoring autoscaler-on against the best static sizing.
+        # Gates: the autoscaler leg beats static on BOTH makespan and
+        # the utilization integral, exercises both grow and shrink, and
+        # finishes with zero admission/autoscaler invariant violations;
+        # margins ratcheted via build/elasticity_smoke_last.json.
+        # Depends on contention-smoke: the admission gates must hold
+        # before the loop that drives them is scored.
+        Step("elasticity-smoke",
+             [PY, "scripts/measure_control_plane.py", "--mode",
+              "elasticity", "--smoke"],
+             deps=["contention-smoke"], retries=2),
         # Shard-failover tier (docs/design/sharded_control_plane.md): the
         # sharded active-active control plane — ring/coordinator protocol
         # units, two-manager split/steal/handback integration, and the

@@ -41,7 +41,10 @@ def main(argv=None) -> int:
 
     from tf_operator_tpu.models import llama
     from tf_operator_tpu.parallel.sharding import batch_sharding
-    from tf_operator_tpu.runtime.heartbeat import record_progress
+    from tf_operator_tpu.runtime.heartbeat import (
+        record_checkpoint,
+        record_progress,
+    )
     from tf_operator_tpu.runtime.profiling import step_profiler
     from tf_operator_tpu.runtime.tpu_init import tpu_init
     from tf_operator_tpu.train.data import DevicePrefetch, SyntheticTokens
@@ -170,8 +173,14 @@ def main(argv=None) -> int:
             record_progress(step=step, tokens_per_sec=tps)
         if ckpt is not None and (step + 1) % args.checkpoint_every == 0:
             ckpt.save(state)
+            # The save returned = the checkpoint is durable: publish the
+            # step so a checkpoint-coordinated elastic shrink (the
+            # operator's autoscaler) knows it may now take workers away
+            # without losing more than one checkpoint interval.
+            record_checkpoint(step)
     if ckpt is not None:
         ckpt.save(state, force=True)
+        record_checkpoint(args.steps - 1)
         ckpt.close()
     print("[llama] done", flush=True)
     return 0

@@ -1805,6 +1805,417 @@ def contention_main(smoke=False, policy=None) -> int:
     return rc
 
 
+# ---------------------------------------------------------- elasticity mode
+
+ELASTICITY_BASELINE_PATH = os.path.join(
+    REPO, "build", "elasticity_smoke_last.json")
+
+# The seeded contention + capacity-churn scenario (docs/design/
+# autoscaling.md "Benchmark"): a 16-slot pool, two elastic jobs with a
+# fixed amount of WORK (progress rate proportional to world size, mild
+# per-slice efficiency falloff), rigid waves that create queue pressure,
+# and a mid-run capacity revocation. The autoscaler-on leg must beat the
+# best static sizing on BOTH makespan (all jobs done) and the
+# utilization integral (running worker-pods / effective capacity).
+ELASTICITY_POOL_PODS = 16
+ELASTICITY_HOSTS_PER_SLICE = 2
+ELASTICITY_MIN_SLICES = 1
+ELASTICITY_MAX_SLICES = 6
+# Every leg starts from the same user sizing (2 slices per job); the
+# static legs STAY there or at 4 slices — "large" being the largest
+# sizing that still lets both gangs coexist in the pool (bigger static
+# sizings serialize the jobs outright and lose by more) — while the
+# autoscaler leg drives itself from the signals.
+ELASTICITY_START_SLICES = 2
+ELASTICITY_STATIC_SMALL = 2
+ELASTICITY_STATIC_LARGE = 4
+# Work units per elastic job (e0 carries the long solo tail — the phase
+# where a static sizing idles half the pool beside the one remaining
+# job, and the autoscaler grows it toward maxSlices instead).
+ELASTICITY_WORK = {"e0": 110.0, "e1": 25.0}
+# Per-worker progress: 1 work-unit/s at 1 slice, with a mild per-extra-
+# slice efficiency falloff (communication tax) — growing stays worth it
+# through maxSlices, but per-worker throughput visibly decays, which is
+# what the autoscaler's scale-efficiency guard watches in real fleets.
+ELASTICITY_EFFICIENCY_FALLOFF = 0.97
+# A checkpoint lands every this many work units (the record_checkpoint
+# rider the shrink gate waits on).
+ELASTICITY_CKPT_EVERY = 1.0
+# Rigid contention waves: (arrival second, jobs, workers, duration).
+# Small gangs — they slip into the watermark buffer the autoscaler
+# keeps free, and backfill the static legs' gaps; the QUEUE pressure
+# that drives checkpoint-coordinated shrink comes from the revocation
+# window below (a preempted elastic gang waiting to re-fit).
+ELASTICITY_WAVES = ((1.0, 3, 2, 0.5), (2.0, 3, 2, 0.5))
+# Capacity churn: [revoke_at, restore_at) the schedulable pool drops
+# while BOTH elastic jobs still run — the admission layer preempts one
+# gang to fit, and the autoscaler must shrink the survivor until the
+# victim re-fits (static sizing just idles the difference).
+ELASTICITY_REVOKE_AT = 2.5
+ELASTICITY_RESTORE_AT = 4.5
+ELASTICITY_REVOKED_PODS = 12
+# Run-over-run ratchet (loose, like the other comparative gates): the
+# makespan/utilization gains over the best static leg may not halve.
+ELASTICITY_GAIN_REGRESSION = 2.0
+
+
+def _elastic_job(name, slices, work):
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "JAXJob",
+        "metadata": {
+            "name": name, "namespace": "default",
+            "annotations": {"bench.tpu/work-units": str(work)},
+        },
+        "spec": {
+            "numSlices": slices,
+            "elastic": {
+                "minSlices": ELASTICITY_MIN_SLICES,
+                "maxSlices": ELASTICITY_MAX_SLICES,
+            },
+            "jaxReplicaSpecs": {
+                "Worker": {
+                    "replicas": slices * ELASTICITY_HOSTS_PER_SLICE,
+                    "template": {
+                        "spec": {"containers": [
+                            {"name": "jax", "image": "bench:1"}]},
+                    },
+                }
+            },
+        },
+    }
+
+
+class _ElasticWorkloadSim:
+    """The workload half of the elasticity scenario: for each elastic job,
+    progress accrues at (running workers × per-worker efficiency) work
+    units per second; heartbeat leases carry the tokens_per_sec and
+    checkpoint-step annotations exactly as runtime.heartbeat would (the
+    autoscaler's signal stream); when the work is done the pods exit 0
+    and the gang completes. Runs on its own thread beside the operator —
+    the same role _kubelet_sim plays for duration-annotated rigid pods."""
+
+    def __init__(self, mem, work):
+        self.mem = mem
+        self.remaining = dict(work)
+        self.done_at = {}
+        self.lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._t0 = None
+
+    def start(self, t0):
+        self._t0 = t0
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def _running_workers(self, name):
+        return [
+            p for p in self.mem.list_pods("default",
+                                          labels={"job-name": name})
+            if p.status.phase == "Running"
+            and p.metadata.deletion_timestamp is None
+        ]
+
+    def _run(self):
+        from tf_operator_tpu.core.constants import heartbeat_lease_name
+        from tf_operator_tpu.runtime.heartbeat import publish_heartbeat
+
+        last = time.monotonic()
+        last_beat = 0.0
+        while not self._stop.is_set():
+            time.sleep(0.02)
+            now = time.monotonic()
+            dt, last = now - last, now
+            beat_due = now - last_beat >= 0.1
+            if beat_due:
+                last_beat = now
+            for name, work in list(self.remaining.items()):
+                pods = self._running_workers(name)
+                n = len(pods)
+                if work > 0 and n > 0:
+                    slices = max(1, n // ELASTICITY_HOSTS_PER_SLICE)
+                    eff = ELASTICITY_EFFICIENCY_FALLOFF ** (slices - 1)
+                    rate = n * eff
+                    with self.lock:
+                        self.remaining[name] = work = max(
+                            0.0, work - rate * dt)
+                    if beat_due and work > 0:
+                        total = ELASTICITY_WORK[name]
+                        ckpt = int(
+                            (total - work) / ELASTICITY_CKPT_EVERY)
+                        for pod in pods:
+                            publish_heartbeat(
+                                self.mem, "default",
+                                heartbeat_lease_name(pod.metadata.name),
+                                identity=pod.metadata.name,
+                                step=ckpt, tokens_per_sec=rate * 100.0,
+                                checkpoint_step=ckpt,
+                            )
+                if work <= 0:
+                    # Work done: every live pod exits 0 (keep marking —
+                    # a resize-in-flight may still birth stragglers).
+                    if name not in self.done_at:
+                        self.done_at[name] = now - self._t0
+                    for pod in self.mem.list_pods(
+                        "default", labels={"job-name": name}
+                    ):
+                        if pod.metadata.deletion_timestamp is not None:
+                            continue
+                        if pod.status.phase in ("Pending", "Running"):
+                            try:
+                                self.mem.set_pod_phase(
+                                    "default", pod.metadata.name,
+                                    "Succeeded", exit_code=0)
+                            except Exception:  # noqa: BLE001 — raced away
+                                pass
+
+
+def _run_elasticity(autoscale, static_slices, timeout=60.0):
+    """One elasticity leg: the full OperatorManager stack (gang admission
+    + optionally the autoscaler) over the seeded scenario. Returns
+    makespan, utilization integral, wasted-worker-seconds, completion
+    times, resize counts, and the controllers for invariant checks."""
+    from tf_operator_tpu.cluster.memory import InMemoryCluster
+
+    mem = InMemoryCluster()
+    stop_kubelet, kubelet = _kubelet_sim(mem)
+    metrics = Metrics()
+    tracer = Tracer()
+    manager = OperatorManager(
+        mem,
+        OperatorOptions(
+            enabled_schemes=["JAXJob"], health_port=0, metrics_port=0,
+            threadiness=4, resync_period=0.2,
+            enable_gang_admission=True,
+            capacity=f"pods={ELASTICITY_POOL_PODS}",
+            backfill_max_members=8,
+            admission_aging_seconds=300.0,
+            enable_autoscaler=autoscale,
+            autoscaler_interval=0.05,
+            autoscaler_watermark_pods=2.0,
+            autoscaler_hold_seconds=0.25,
+            autoscaler_dwell_seconds=0.4,
+            autoscaler_cooldown_seconds=0.8,
+            autoscaler_efficiency_floor=0.5,
+        ),
+        metrics=metrics,
+        tracer=tracer,
+    )
+    manager.start()
+    sim = _ElasticWorkloadSim(mem, ELASTICITY_WORK)
+    completions = {}
+    util_area = 0.0
+    cap_area = 0.0
+    wasted = 0.0
+    try:
+        t0 = time.monotonic()
+        sim.start(t0)
+        for name, work in ELASTICITY_WORK.items():
+            mem.create_job(_elastic_job(
+                name, static_slices.get(name, ELASTICITY_START_SLICES),
+                work))
+        waves = [
+            (t0 + at, [
+                _contention_job(f"w{wi}-{j}", workers, duration)
+                for j in range(jobs)
+            ])
+            for wi, (at, jobs, workers, duration) in enumerate(
+                ELASTICITY_WAVES)
+        ]
+        pending = set(ELASTICITY_WORK) | {
+            m["metadata"]["name"] for _, wave in waves for m in wave
+        }
+        revoked = restored = False
+        deadline = t0 + timeout
+        last = time.monotonic()
+        while pending and time.monotonic() < deadline:
+            time.sleep(0.01)
+            now = time.monotonic()
+            for at, wave in waves:
+                if wave and now >= at:
+                    for manifest in wave:
+                        mem.create_job(manifest)
+                    wave.clear()
+            if not revoked and now - t0 >= ELASTICITY_REVOKE_AT:
+                mem.set_schedulable_capacity(
+                    {"pods": str(ELASTICITY_REVOKED_PODS)})
+                revoked = True
+            if revoked and not restored and (
+                now - t0 >= ELASTICITY_RESTORE_AT
+            ):
+                mem.set_schedulable_capacity(None)
+                restored = True
+            live = len([
+                p for p in mem.list_pods()
+                if p.status.phase == "Running"
+                and p.metadata.deletion_timestamp is None
+            ])
+            cap_now = ELASTICITY_POOL_PODS
+            if revoked and not restored:
+                cap_now = ELASTICITY_REVOKED_PODS
+            dt = now - last
+            util_area += min(live, cap_now) * dt
+            cap_area += cap_now * dt
+            wasted += max(0.0, cap_now - live) * dt
+            last = now
+            for name in list(pending):
+                try:
+                    job = mem.get_job("JAXJob", "default", name)
+                except Exception:  # noqa: BLE001 — wave not yet submitted
+                    continue
+                conds = (job.get("status") or {}).get("conditions") or []
+                if any(c["type"] == "Succeeded" and c["status"] == "True"
+                       for c in conds):
+                    completions[name] = now - t0
+                    pending.discard(name)
+        if pending:
+            raise SystemExit(
+                f"elasticity: {sorted(pending)} never completed within "
+                f"{timeout}s (autoscale={autoscale}, "
+                f"static={static_slices})"
+            )
+        makespan = max(completions.values())
+        admission = manager.admission
+        autoscaler = manager.autoscaler
+    finally:
+        sim.stop()
+        stop_kubelet.set()
+        manager.stop()
+        kubelet.join(timeout=5)
+    resizes = (
+        [dict(e) for e in autoscaler.resize_ledger]
+        if autoscaler is not None else []
+    )
+    return {
+        "completions": {k: round(v, 3) for k, v in completions.items()},
+        "makespan_s": round(makespan, 3),
+        "utilization": round(util_area / max(cap_area, 1e-9), 4),
+        "wasted_worker_seconds": round(wasted, 2),
+        "resizes": resizes,
+        "grow_count": sum(1 for r in resizes if r["direction"] == "grow"),
+        "shrink_count": sum(
+            1 for r in resizes if r["direction"] == "shrink"),
+        "admission": admission,
+        "autoscaler": autoscaler,
+        "cluster": mem,
+    }
+
+
+def elasticity_main(smoke=False) -> int:
+    """--mode elasticity: the autoscaler-vs-static head-to-head on the
+    seeded contention + capacity-churn scenario. --smoke gates: the
+    autoscaler leg beats the BEST static sizing on both makespan and the
+    utilization integral, with zero admission/autoscaler invariant
+    violations; margins ratcheted via build/elasticity_smoke_last.json."""
+    from tf_operator_tpu.testing.invariants import (
+        check_admission_invariants,
+        check_autoscaler_invariants,
+    )
+
+    regressions = []
+    auto = _run_elasticity(True, {})
+    small = _run_elasticity(
+        False, {n: ELASTICITY_STATIC_SMALL for n in ELASTICITY_WORK})
+    large = _run_elasticity(
+        False, {n: ELASTICITY_STATIC_LARGE for n in ELASTICITY_WORK})
+
+    violations = check_admission_invariants(
+        auto["admission"], cluster=auto["cluster"], kinds=["JAXJob"])
+    violations += check_autoscaler_invariants(
+        auto["autoscaler"], cluster=auto["cluster"], kinds=["JAXJob"])
+    if violations:
+        regressions.append(
+            "elasticity invariants: " + "; ".join(violations))
+
+    best_static_makespan = min(small["makespan_s"], large["makespan_s"])
+    best_static_util = max(small["utilization"], large["utilization"])
+    makespan_gain = round(
+        best_static_makespan / max(auto["makespan_s"], 1e-9), 3)
+    util_gain = round(
+        auto["utilization"] / max(best_static_util, 1e-9), 3)
+    if smoke:
+        if auto["makespan_s"] >= best_static_makespan:
+            regressions.append(
+                f"autoscaler did not beat the best static sizing on "
+                f"makespan ({auto['makespan_s']}s vs "
+                f"{best_static_makespan}s)"
+            )
+        if auto["utilization"] <= best_static_util:
+            regressions.append(
+                f"autoscaler did not beat the best static sizing on the "
+                f"utilization integral ({auto['utilization']} vs "
+                f"{best_static_util})"
+            )
+        if auto["grow_count"] < 1 or auto["shrink_count"] < 1:
+            regressions.append(
+                f"the scenario did not exercise both directions "
+                f"(grows={auto['grow_count']}, "
+                f"shrinks={auto['shrink_count']}) — the comparison is "
+                "vacuous"
+            )
+        prev = _read_baseline(ELASTICITY_BASELINE_PATH)
+        prev_makespan_gain = prev.get("makespan_gain")
+        if prev_makespan_gain and makespan_gain < (
+            prev_makespan_gain / ELASTICITY_GAIN_REGRESSION
+        ):
+            regressions.append(
+                f"makespan gain {makespan_gain}x regressed >2x vs "
+                f"previous run ({prev_makespan_gain}x)"
+            )
+        prev_util_gain = prev.get("utilization_gain")
+        if prev_util_gain and util_gain < (
+            prev_util_gain / ELASTICITY_GAIN_REGRESSION
+        ):
+            regressions.append(
+                f"utilization gain {util_gain}x regressed >2x vs "
+                f"previous run ({prev_util_gain}x)"
+            )
+
+    def leg(result, label):
+        return {
+            "leg": label,
+            "makespan_s": result["makespan_s"],
+            "utilization": result["utilization"],
+            "wasted_worker_seconds": result["wasted_worker_seconds"],
+            "completions": result["completions"],
+            "grows": result["grow_count"],
+            "shrinks": result["shrink_count"],
+        }
+
+    out = {
+        "mode": "elasticity",
+        "smoke": smoke,
+        "pool_pods": ELASTICITY_POOL_PODS,
+        "revocation": {
+            "window_s": [ELASTICITY_REVOKE_AT, ELASTICITY_RESTORE_AT],
+            "revoked_pods": ELASTICITY_REVOKED_PODS,
+        },
+        "legs": [
+            leg(auto, "autoscaler"),
+            leg(small, f"static-{ELASTICITY_STATIC_SMALL}"),
+            leg(large, f"static-{ELASTICITY_STATIC_LARGE}"),
+        ],
+        "makespan_gain_vs_best_static": makespan_gain,
+        "utilization_gain_vs_best_static": util_gain,
+        "regression": "; ".join(regressions) or None,
+    }
+    rc = 1 if (smoke and regressions) else 0
+    if smoke and rc == 0:
+        _merge_baseline(ELASTICITY_BASELINE_PATH, {
+            "makespan_gain": makespan_gain,
+            "utilization_gain": util_gain,
+            "autoscaler_makespan_s": auto["makespan_s"],
+            "autoscaler_utilization": auto["utilization"],
+        })
+    print(json.dumps(out))
+    return rc
+
+
 if __name__ == "__main__":
     import argparse
 
@@ -1812,7 +2223,9 @@ if __name__ == "__main__":
     parser.add_argument("trials", nargs="?", type=int, default=10)
     parser.add_argument("--backend", choices=("process", "http"),
                         default="process")
-    parser.add_argument("--mode", choices=("latency", "scale", "contention"),
+    parser.add_argument("--mode",
+                        choices=("latency", "scale", "contention",
+                                 "elasticity"),
                         default="latency")
     parser.add_argument("--smoke", action="store_true",
                         help="scale mode: fast CI check (32-replica-gang "
@@ -1880,6 +2293,8 @@ if __name__ == "__main__":
         parser.error("--policy requires --mode contention")
     if args.mode == "contention":
         sys.exit(contention_main(smoke=args.smoke, policy=args.policy))
+    if args.mode == "elasticity":
+        sys.exit(elasticity_main(smoke=args.smoke))
     if (args.workers or args.replicas) and args.mode != "scale":
         # Dropping the flag would hand back a plausible-looking JSON
         # object for the wrong experiment.

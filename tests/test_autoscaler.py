@@ -1,0 +1,719 @@
+"""Signal-driven gang autoscaler tier (core/autoscaler.py,
+docs/design/autoscaling.md): the pure decision function over an immutable
+AutoscalerState, the checkpoint-coordinated shrink protocol, the
+scale-efficiency guard, hysteresis (dwell / cooldown / surplus hold), the
+gavel placement-quality ordering, the resize × admission interplay (a
+grow beyond headroom queues through the gate — never bypasses it), the
+heartbeat checkpoint rider, and the stale-throughput pruning after an
+elastic shrink.
+
+Determinism contract: with --enable-autoscaler OFF (the default) the
+controller is never constructed (cli.py builds neither object nor loop
+thread), so every seeded PR 1-14 tier replays byte-identically; ON, the
+decision procedure is a pure function of (state, config) — the 3-run
+byte-equal decision-log regression lives in test_autoscaler_chaos.py.
+"""
+
+import pytest
+
+from tf_operator_tpu.cluster.memory import InMemoryCluster
+from tf_operator_tpu.controllers.jax import JAXController
+from tf_operator_tpu.core import constants
+from tf_operator_tpu.core.admission import AdmissionController
+from tf_operator_tpu.core.autoscaler import (
+    AutoscalerConfig,
+    AutoscalerState,
+    ElasticJobView,
+    GangAutoscaler,
+    decide,
+)
+from tf_operator_tpu.core.job_controller import EngineOptions
+from tf_operator_tpu.core.tracing import Tracer
+from tf_operator_tpu.core.workqueue import WorkQueue
+from tf_operator_tpu.metrics import Metrics
+from tf_operator_tpu.runtime import heartbeat as hb
+from tf_operator_tpu.testing.invariants import (
+    assert_invariants,
+    check_admission_invariants,
+    check_autoscaler_invariants,
+)
+
+
+class FakeClock:
+    def __init__(self, now=1000.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds):
+        self.now += seconds
+
+
+def container(name):
+    return {"name": name, "image": "test:1"}
+
+
+def elastic_manifest(name, slices=2, hosts=2, min_slices=1, max_slices=4,
+                     namespace="default", ratios=None, priority=""):
+    spec = {
+        "numSlices": slices,
+        "elastic": {"minSlices": min_slices, "maxSlices": max_slices},
+        "jaxReplicaSpecs": {
+            "Worker": {
+                "replicas": slices * hosts,
+                "template": {"spec": {"containers": [container("jax")]}},
+            }
+        },
+    }
+    sp = {}
+    if ratios:
+        sp["throughputRatios"] = dict(ratios)
+    if priority:
+        sp["priorityClass"] = priority
+    if sp:
+        spec["runPolicy"] = {"schedulingPolicy": sp}
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "JAXJob",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": spec,
+    }
+
+
+def rigid_manifest(name, workers=4, namespace="default"):
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "JAXJob",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "jaxReplicaSpecs": {
+                "Worker": {
+                    "replicas": workers,
+                    "template": {
+                        "spec": {"containers": [container("jax")]}
+                    },
+                }
+            },
+        },
+    }
+
+
+def make_harness(capacity=None, slice_granular=False, clock=None,
+                 config=None, generations=None):
+    clk = clock or FakeClock()
+    inner = InMemoryCluster(clock=clk)
+    metrics = Metrics()
+    tracer = Tracer()
+    adm = AdmissionController(
+        capacity=capacity, clock=clk, metrics=metrics,
+        capacity_fn=inner.schedulable_capacity,
+        generations_fn=inner.schedulable_generations,
+        slice_granular=slice_granular,
+        generations=generations,
+        policy="gavel" if generations else None,
+    )
+    controller = JAXController(
+        inner,
+        queue=WorkQueue(clock=clk),
+        options=EngineOptions(),
+        clock=clk,
+        metrics=metrics,
+        tracer=tracer,
+        admission=adm,
+    )
+    scaler = GangAutoscaler(
+        inner, adm, config or AutoscalerConfig(
+            watermark_pods=1.0, hold_seconds=2.0, dwell_seconds=4.0,
+            cooldown_seconds=6.0,
+        ),
+        clock=clk, metrics=metrics,
+    )
+    return inner, controller, adm, scaler, clk, metrics, tracer
+
+
+def drive_running(inner):
+    for p in inner.list_pods():
+        if p.status.phase == "Pending":
+            inner.set_pod_phase(p.metadata.namespace, p.metadata.name,
+                                "Running")
+
+
+def settle(controller, clk, names, rounds=8, step=0.25):
+    """Deterministic drive: drain, mark pods Running, advance the fake
+    clock, re-enqueue — fixed rounds so runs replay identically."""
+    for _ in range(rounds):
+        controller.run_until_idle()
+        drive_running(controller.cluster)
+        clk.advance(step)
+        for name in names:
+            controller.queue.add(f"JAXJob:default/{name}")
+    controller.run_until_idle()
+
+
+def beat(inner, pod_name, step=None, tps=None, ckpt=None,
+         namespace="default"):
+    """Simulate one workload heartbeat: renew the pod's lease with the
+    progress/throughput/checkpoint annotations, exactly as
+    runtime.heartbeat's sink would."""
+    assert hb.publish_heartbeat(
+        inner, namespace, constants.heartbeat_lease_name(pod_name),
+        identity=pod_name, step=step, tokens_per_sec=tps,
+        checkpoint_step=ckpt,
+    )
+
+
+def running_workers(inner, name, namespace="default"):
+    return sorted(
+        p.metadata.name
+        for p in inner.list_pods(namespace, labels={"job-name": name})
+        if p.status.phase == "Running"
+        and p.metadata.deletion_timestamp is None
+    )
+
+
+def job_slices(inner, name, namespace="default"):
+    job = inner.get_job("JAXJob", namespace, name)
+    return (job.get("spec") or {}).get("numSlices") or 1
+
+
+# ----------------------------------------------------------- decide() unit
+
+
+def view(key="JAXJob:default/e0", slices=2, hosts=2, min_slices=1,
+         max_slices=4, admitted=True, suspended=False, tps=None, ckpt=None,
+         ratios=None, generation=None):
+    ns_name = key.partition(":")[2]
+    ns, _, name = ns_name.partition("/")
+    return ElasticJobView(
+        key=key, kind="JAXJob", namespace=ns, name=name, num_slices=slices,
+        hosts_per_slice=hosts, min_slices=min_slices, max_slices=max_slices,
+        admitted=admitted, suspended=suspended, tokens_per_sec=tps,
+        checkpoint_step=ckpt, throughput_ratios=dict(ratios or {}),
+        generation=generation,
+    )
+
+
+def state(jobs, free=6.0, capacity=16.0, queue_depth=0, gens_free=None,
+          surplus_since=None, cooldowns=None, last_resizes=None,
+          pending=None, baselines=None, now=1000.0):
+    return AutoscalerState(
+        jobs=tuple(jobs), free_pods=free, capacity_pods=capacity,
+        queue_depth=queue_depth, generations_free=dict(gens_free or {}),
+        surplus_since=surplus_since, cooldown_until=dict(cooldowns or {}),
+        last_resize_at=dict(last_resizes or {}),
+        pending_shrinks=dict(pending or {}),
+        grow_baselines=dict(baselines or {}), now=now, seed=0,
+    )
+
+
+CFG = AutoscalerConfig(watermark_pods=2.0, hold_seconds=10.0,
+                       dwell_seconds=30.0, cooldown_seconds=60.0)
+
+
+class TestDecideGrow:
+    def test_no_grow_without_held_surplus(self):
+        # Surplus exists but the hold clock only just started: no grow.
+        s = state([view()], free=6.0, surplus_since=995.0)
+        assert decide(s, CFG).actions == []
+        # Held past the bound: one grow, one slice, to the smallest job.
+        s = state([view()], free=6.0, surplus_since=990.0)
+        actions = decide(s, CFG).actions
+        assert len(actions) == 1
+        assert actions[0].direction == "grow"
+        assert actions[0].from_slices == 2 and actions[0].to_slices == 3
+        assert actions[0].reason == "free-capacity"
+
+    def test_no_grow_under_queue_pressure(self):
+        s = state([view(max_slices=4)], free=6.0, surplus_since=980.0,
+                  queue_depth=1)
+        assert decide(s, CFG).actions == []
+
+    def test_grow_respects_max_and_free_delta(self):
+        at_max = view(slices=4, max_slices=4)
+        s = state([at_max], free=6.0, surplus_since=980.0)
+        assert decide(s, CFG).actions == []
+        # Delta (hosts_per_slice=4) exceeds free: no grow.
+        wide = view(slices=2, hosts=4, max_slices=4)
+        s = state([wide], free=3.0, surplus_since=980.0)
+        assert decide(s, CFG).actions == []
+
+    def test_dwell_and_cooldown_block_grow(self):
+        j = view()
+        s = state([j], free=6.0, surplus_since=980.0,
+                  last_resizes={j.key: 990.0})  # 10s ago < 30s dwell
+        assert decide(s, CFG).actions == []
+        s = state([j], free=6.0, surplus_since=980.0,
+                  cooldowns={j.key: 1010.0})
+        assert decide(s, CFG).actions == []
+
+    def test_scale_efficiency_guard(self):
+        j = view(slices=2, hosts=2, tps=100.0)  # 25/worker
+        # Baseline 50/worker, floor 0.7 -> needs >= 35: blocked.
+        s = state([j], free=6.0, surplus_since=980.0,
+                  baselines={j.key: 50.0})
+        d = decide(s, CFG)
+        assert d.actions == []
+        assert (j.key, "scale-efficiency") in d.blocked
+        # Healthy per-worker throughput: grows.
+        healthy = view(slices=2, hosts=2, tps=180.0)  # 45/worker
+        s = state([healthy], free=6.0, surplus_since=980.0,
+                  baselines={healthy.key: 50.0})
+        assert len(decide(s, CFG).actions) == 1
+        # Grown but not yet reporting: blocked on evidence.
+        silent = view(slices=2, hosts=2, tps=None)
+        s = state([silent], free=6.0, surplus_since=980.0,
+                  baselines={silent.key: 50.0})
+        d = decide(s, CFG)
+        assert d.actions == []
+        assert (silent.key, "awaiting-throughput") in d.blocked
+        # A grow applied BEFORE the first report leaves the 0.0
+        # sentinel: further grows stay blocked until throughput appears
+        # (no unguarded climb to maxSlices on faith).
+        s = state([silent], free=6.0, surplus_since=980.0,
+                  baselines={silent.key: 0.0})
+        d = decide(s, CFG)
+        assert d.actions == []
+        assert (silent.key, "awaiting-throughput") in d.blocked
+
+    def test_unadmitted_and_suspended_never_resize(self):
+        s = state([view(admitted=False), view(key="JAXJob:default/e1",
+                                              suspended=True)],
+                  free=8.0, surplus_since=980.0)
+        assert decide(s, CFG).actions == []
+
+
+class TestDecideShrink:
+    def test_pressure_proposes_widest_job_first(self):
+        a = view(key="JAXJob:default/a", slices=2)
+        b = view(key="JAXJob:default/b", slices=4)
+        s = state([a, b], free=0.0, queue_depth=1)
+        d = decide(s, CFG)
+        assert d.actions == []
+        assert len(d.proposals) == 1
+        assert d.proposals[0].key == b.key
+        assert d.proposals[0].target_slices == 3
+
+    def test_shrink_waits_for_fresh_checkpoint(self):
+        j = view(slices=3, ckpt=7)
+        pending = {j.key: (2, 7)}  # baseline = the step already seen
+        s = state([j], free=0.0, queue_depth=1, pending=pending)
+        d = decide(s, CFG)
+        assert d.actions == []
+        assert (j.key, "no-fresh-checkpoint") in d.blocked
+        # A strictly newer checkpoint credits the shrink.
+        fresh = view(slices=3, ckpt=9)
+        s = state([fresh], free=0.0, queue_depth=1, pending=pending)
+        d = decide(s, CFG)
+        assert len(d.actions) == 1
+        act = d.actions[0]
+        assert act.direction == "shrink"
+        assert act.to_slices == 2
+        assert act.credited_checkpoint == 9
+
+    def test_never_checkpointed_workload_never_shrinks(self):
+        j = view(slices=3, ckpt=None)
+        s = state([j], free=0.0, queue_depth=1,
+                  pending={j.key: (2, None)})
+        d = decide(s, CFG)
+        assert d.actions == []
+        assert (j.key, "no-fresh-checkpoint") in d.blocked
+
+    def test_preempted_proposal_withdraws_and_unblocks_fleet(self):
+        # The proposal's job was preempted (no longer admitted) while
+        # queue pressure persists: the stale single-flight proposal must
+        # withdraw so the SURVIVING job can be proposed — otherwise the
+        # fleet can never shrink to re-fit the victim.
+        victim = view(key="JAXJob:default/a", slices=4, admitted=False)
+        survivor = view(key="JAXJob:default/b", slices=3, ckpt=5)
+        s = state([victim, survivor], free=0.0, queue_depth=1,
+                  pending={victim.key: (3, 7)})
+        d = decide(s, CFG)
+        assert victim.key in d.withdrawals
+
+    def test_grow_preserves_watermark_buffer(self):
+        # free 3, watermark 2, delta 2: growing would eat the buffer the
+        # next small arrival needs — no grow.
+        j = view(slices=2, hosts=2)
+        s = state([j], free=3.0, surplus_since=980.0)
+        assert decide(s, CFG).actions == []
+        s = state([j], free=4.5, surplus_since=980.0)
+        assert len(decide(s, CFG).actions) == 1
+
+    def test_spec_moved_under_proposal_withdraws(self):
+        # A user grow (3 -> 6) lands while a 3->2 shrink proposal waits
+        # on its checkpoint: applying the stale proposal would cut 4
+        # slices at once and silently revert the user's resize — it must
+        # withdraw and re-propose against the current size instead.
+        j = view(slices=6, ckpt=99)
+        s = state([j], free=0.0, queue_depth=1, pending={j.key: (2, 7)})
+        d = decide(s, CFG)
+        assert d.actions == []
+        assert j.key in d.withdrawals
+
+    def test_pressure_drain_withdraws_proposal(self):
+        j = view(slices=3, ckpt=9)
+        s = state([j], free=6.0, queue_depth=0,
+                  pending={j.key: (2, 7)})
+        d = decide(s, CFG)
+        assert d.actions == [] or d.actions[0].direction != "shrink"
+        assert j.key in d.withdrawals
+
+    def test_at_min_floor_blocks(self):
+        j = view(slices=1, min_slices=1)
+        s = state([j], free=0.0, queue_depth=1)
+        d = decide(s, CFG)
+        assert d.actions == [] and d.proposals == []
+        assert (j.key, "at-min") in d.blocked
+
+    def test_shrink_never_below_min(self):
+        j = view(slices=2, min_slices=2)
+        s = state([j], free=0.0, queue_depth=1)
+        assert decide(s, CFG).proposals == []
+
+
+class TestDecidePlacementQuality:
+    """Satellite: with the gavel policy's generation sub-pools declared,
+    the autoscaler reads admission_effective_throughput at its source —
+    grow candidates are ordered by their throughput ratio on the freed
+    generation (a mixed-generation PolicyState-shaped fixture)."""
+
+    def test_prefers_best_ratio_on_freed_generation(self):
+        sensitive = view(key="JAXJob:default/a", slices=1,
+                         ratios={"v5lite": 0.25, "v6": 1.0},
+                         generation="v6")
+        flexible = view(key="JAXJob:default/b", slices=1,
+                        ratios={}, generation="v6")
+        # v5lite holds the freed capacity: the generation-indifferent
+        # job (ratio 1.0 there) must grow before the 0.25x-sensitive one.
+        s = state([sensitive, flexible], free=6.0, surplus_since=980.0,
+                  gens_free={"v5lite": 6.0, "v6": 0.0})
+        actions = decide(s, CFG).actions
+        assert len(actions) == 1
+        assert actions[0].key == flexible.key
+        assert actions[0].reason == "placement-quality"
+        # Flip the headroom to v6: the sensitive job (1.0 on v6) ties
+        # the flexible one; key order breaks the tie deterministically.
+        s = state([sensitive, flexible], free=6.0, surplus_since=980.0,
+                  gens_free={"v5lite": 0.0, "v6": 6.0})
+        actions = decide(s, CFG).actions
+        assert actions[0].key == sensitive.key
+
+
+# -------------------------------------------------------------- controller
+
+
+class TestAutoscalerEndToEnd:
+    def test_grow_into_held_surplus(self):
+        inner, controller, adm, scaler, clk, metrics, tracer = make_harness(
+            capacity={"pods": "8"})
+        inner.create_job(elastic_manifest("e0", slices=2, hosts=2,
+                                          max_slices=4))
+        settle(controller, clk, ["e0"])
+        assert len(running_workers(inner, "e0")) == 4
+        # Surplus (4 free > watermark 1) must HOLD before the grow fires.
+        scaler.tick()
+        assert job_slices(inner, "e0") == 2
+        clk.advance(2.5)  # past hold_seconds=2
+        applied = scaler.tick()
+        assert [r.direction for r in applied] == ["grow"]
+        assert job_slices(inner, "e0") == 3
+        settle(controller, clk, ["e0"])
+        assert len(running_workers(inner, "e0")) == 6
+        assert metrics.labeled_counter_value(
+            "training_operator_autoscaler_resizes_total",
+            "grow", "free-capacity") == 1
+        assert_invariants(inner, kinds=("JAXJob",), tracer=tracer,
+                          admission=adm, autoscaler=scaler,
+                          label="autoscaler_grow")
+
+    def test_checkpoint_coordinated_shrink_under_pressure(self):
+        inner, controller, adm, scaler, clk, metrics, tracer = make_harness(
+            capacity={"pods": "8"})
+        inner.create_job(elastic_manifest("e0", slices=3, hosts=2,
+                                          max_slices=4))
+        settle(controller, clk, ["e0"])
+        assert len(running_workers(inner, "e0")) == 6
+        # A rigid 4-pod job queues (free 2 < 4): shrink pressure.
+        inner.create_job(rigid_manifest("r0", workers=4))
+        settle(controller, clk, ["e0", "r0"])
+        assert running_workers(inner, "r0") == []
+        # Tick 1: proposal only — no checkpoint has ever landed.
+        scaler.tick()
+        assert job_slices(inner, "e0") == 3
+        # Ticks while the workload never checkpoints: blocked, counted.
+        clk.advance(1.0)
+        scaler.tick()
+        assert job_slices(inner, "e0") == 3
+        assert metrics.labeled_counter_value(
+            "training_operator_autoscaler_blocked_shrinks_total",
+            "no-fresh-checkpoint") >= 1
+        # A fresh checkpoint lands on the lease stream: shrink applies.
+        for pod_name in running_workers(inner, "e0"):
+            beat(inner, pod_name, step=120, tps=600.0, ckpt=100)
+        clk.advance(1.0)
+        applied = scaler.tick()
+        assert [r.direction for r in applied] == ["shrink"]
+        assert job_slices(inner, "e0") == 2
+        ledger = scaler.snapshot()["resize_ledger"]
+        assert ledger[-1]["credited_checkpoint"] == 100
+        # The freed capacity admits the rigid job.
+        settle(controller, clk, ["e0", "r0"])
+        assert len(running_workers(inner, "r0")) == 4
+        assert len(running_workers(inner, "e0")) == 4
+        assert_invariants(inner, kinds=("JAXJob",), tracer=tracer,
+                          admission=adm, autoscaler=scaler,
+                          label="autoscaler_shrink")
+
+    def test_disruption_opens_cooldown(self):
+        inner, controller, adm, scaler, clk, metrics, tracer = make_harness(
+            capacity={"pods": "8"})
+        inner.create_job(elastic_manifest("e0", slices=2, hosts=2,
+                                          max_slices=4))
+        settle(controller, clk, ["e0"])
+        scaler.tick()  # baseline churn observation
+        # A capacity revocation preempts the gang: ledger growth must
+        # open the cooldown window and block the next grow.
+        inner.set_schedulable_capacity({"pods": "2"})
+        settle(controller, clk, ["e0"])
+        inner.set_schedulable_capacity({"pods": "8"})
+        settle(controller, clk, ["e0"])
+        clk.advance(2.5)  # hold satisfied; cooldown must still win
+        scaler.tick()
+        assert job_slices(inner, "e0") == 2
+        snap = scaler.snapshot()
+        assert snap["cooldown_until"].get("JAXJob:default/e0", 0) > clk.now
+        # Past the cooldown the surplus grows it again.
+        clk.advance(7.0)
+        scaler.tick()  # restart hold clock (surplus_since resets on churn)
+        clk.advance(2.5)
+        scaler.tick()
+        assert job_slices(inner, "e0") == 3
+        assert_invariants(inner, kinds=("JAXJob",), tracer=tracer,
+                          admission=adm, autoscaler=scaler,
+                          label="autoscaler_cooldown")
+
+
+class TestResizeAdmissionInterplay:
+    """Satellite: a grow decision that exceeds current pool headroom must
+    queue through the admission gate, never bypass it."""
+
+    def test_flat_grow_beyond_headroom_queues(self):
+        inner, controller, adm, scaler, clk, metrics, tracer = make_harness(
+            capacity={"pods": "8"})
+        inner.create_job(elastic_manifest("e0", slices=2, hosts=2,
+                                          max_slices=4))
+        inner.create_job(rigid_manifest("r0", workers=4))
+        settle(controller, clk, ["e0", "r0"])
+        assert len(running_workers(inner, "e0")) == 4
+        assert len(running_workers(inner, "r0")) == 4
+        # Pool full. A grow to 3 slices (6 pods) exceeds headroom: the
+        # job must END UP QUEUED for the delta — the rigid job may never
+        # be preempted by a spec refresh side effect.
+        job = inner.get_job("JAXJob", "default", "e0")
+        job["spec"]["numSlices"] = 3
+        job["spec"]["jaxReplicaSpecs"]["Worker"]["replicas"] = 6
+        inner.update_job(job)
+        settle(controller, clk, ["e0", "r0"], rounds=10)
+        # The rigid job is untouched; the elastic job waits at the gate.
+        assert len(running_workers(inner, "r0")) == 4
+        assert running_workers(inner, "e0") == []
+        assert not adm.is_admitted("JAXJob:default/e0")
+        conds = {
+            c["type"]: c for c in (
+                inner.get_job("JAXJob", "default", "e0").get("status") or {}
+            ).get("conditions") or []
+        }
+        assert conds.get("Queued", {}).get("status") == "True"
+        assert adm.preemption_ledger.__len__() == 0
+        violations = check_admission_invariants(
+            adm, cluster=inner, kinds=["JAXJob"])
+        assert violations == [], violations
+        # Capacity frees: the grown gang admits at its new size.
+        inner.delete_job("JAXJob", "default", "r0")
+        settle(controller, clk, ["e0"], rounds=10)
+        assert len(running_workers(inner, "e0")) == 6
+        assert_invariants(inner, kinds=("JAXJob",), tracer=tracer,
+                          admission=adm, label="grow_queues")
+
+    def test_flat_grow_within_headroom_regrants_in_place(self):
+        inner, controller, adm, scaler, clk, metrics, tracer = make_harness(
+            capacity={"pods": "8"})
+        inner.create_job(elastic_manifest("e0", slices=2, hosts=2,
+                                          max_slices=4))
+        settle(controller, clk, ["e0"])
+        job = inner.get_job("JAXJob", "default", "e0")
+        job["spec"]["numSlices"] = 3
+        job["spec"]["jaxReplicaSpecs"]["Worker"]["replicas"] = 6
+        inner.update_job(job)
+        settle(controller, clk, ["e0"])
+        assert len(running_workers(inner, "e0")) == 6
+        snap = adm.snapshot()
+        entry = next(e for e in snap["admitted"]
+                     if e["key"] == "JAXJob:default/e0")
+        assert entry["demand"] == entry["admitted_demand"]
+        assert check_admission_invariants(
+            adm, cluster=inner, kinds=["JAXJob"]) == []
+
+    def test_slice_granular_grow_queues_new_slice_only(self):
+        inner, controller, adm, scaler, clk, metrics, tracer = make_harness(
+            capacity={"pods": "4"}, slice_granular=True)
+        inner.create_job(elastic_manifest("e0", slices=2, hosts=2,
+                                          max_slices=4))
+        settle(controller, clk, ["e0"])
+        assert len(running_workers(inner, "e0")) == 4
+        # Grow to 3 slices against a full 4-slot pool: the EXISTING
+        # slices re-admit after the world restart; slice 2 queues.
+        job = inner.get_job("JAXJob", "default", "e0")
+        job["spec"]["numSlices"] = 3
+        job["spec"]["jaxReplicaSpecs"]["Worker"]["replicas"] = 6
+        inner.update_job(job)
+        settle(controller, clk, ["e0"], rounds=12)
+        assert len(running_workers(inner, "e0")) == 4
+        assert adm.is_admitted("JAXJob:default/e0#slice-0")
+        assert adm.is_admitted("JAXJob:default/e0#slice-1")
+        assert not adm.is_admitted("JAXJob:default/e0#slice-2")
+        violations = check_admission_invariants(
+            adm, cluster=inner, kinds=["JAXJob"])
+        assert violations == [], violations
+
+
+class TestStaleThroughputPruning:
+    """Satellite: after an elastic shrink the tokens_per_sec gauge must
+    reflect only surviving ranks — a shrunk-away worker's lease (and its
+    last annotation) is pruned instead of lingering until lease GC."""
+
+    def test_shrink_prunes_gauge_and_leases(self):
+        clk = FakeClock()
+        inner = InMemoryCluster(clock=clk)
+        metrics = Metrics()
+        controller = JAXController(
+            inner, queue=WorkQueue(clock=clk),
+            options=EngineOptions(), clock=clk, metrics=metrics,
+            tracer=Tracer(),
+        )
+        manifest = elastic_manifest("e0", slices=4, hosts=1, max_slices=4)
+        manifest["spec"]["runPolicy"] = {"progressDeadlineSeconds": 300}
+        inner.create_job(manifest)
+        for _ in range(6):
+            controller.run_until_idle()
+            drive_running(inner)
+            clk.advance(0.25)
+            controller.queue.add("JAXJob:default/e0")
+        controller.run_until_idle()
+        workers = running_workers(inner, "e0")
+        assert len(workers) == 4
+        # Per-replica reporters: rank 3 is the fastest.
+        for i, pod_name in enumerate(workers):
+            beat(inner, pod_name, step=10, tps=50.0 + 50.0 * (i == 3))
+        controller.queue.add("JAXJob:default/e0")
+        controller.run_until_idle()
+        assert metrics.workload_tokens_per_sec_value(
+            "default", "JAXJob", "e0") == 100.0
+        # Shrink 4 -> 2: next checks must see only surviving ranks.
+        job = inner.get_job("JAXJob", "default", "e0")
+        job["spec"]["numSlices"] = 2
+        job["spec"]["jaxReplicaSpecs"]["Worker"]["replicas"] = 2
+        inner.update_job(job)
+        for _ in range(8):
+            controller.run_until_idle()
+            drive_running(inner)
+            clk.advance(0.25)
+            controller.queue.add("JAXJob:default/e0")
+        controller.run_until_idle()
+        survivors = running_workers(inner, "e0")
+        assert len(survivors) == 2
+        for pod_name in survivors:
+            beat(inner, pod_name, step=20, tps=50.0)
+        controller.queue.add("JAXJob:default/e0")
+        controller.run_until_idle()
+        assert metrics.workload_tokens_per_sec_value(
+            "default", "JAXJob", "e0") == 50.0
+        # The shrunk-away ranks' leases are GONE (not waiting for
+        # terminal lease GC) — a later regrow cannot inherit the stale
+        # 100 tokens/sec annotation.
+        from tf_operator_tpu.cluster.base import NotFound
+
+        for rank in (2, 3):
+            with pytest.raises(NotFound):
+                inner.get_lease(
+                    "default",
+                    constants.heartbeat_lease_name(f"e0-worker-{rank}"),
+                )
+
+
+class TestHeartbeatCheckpointRider:
+    def test_publish_heartbeat_carries_checkpoint(self):
+        inner = InMemoryCluster()
+        assert hb.publish_heartbeat(
+            inner, "default", "p0-hb", identity="p0", step=12,
+            tokens_per_sec=99.5, checkpoint_step=10,
+        )
+        lease = inner.get_lease("default", "p0-hb")
+        annotations = lease["metadata"]["annotations"]
+        assert annotations[constants.ANNOTATION_HEARTBEAT_CKPT] == "10"
+        assert annotations[constants.ANNOTATION_HEARTBEAT_TPS] == "99.5"
+
+    def test_publisher_record_checkpoint_reaches_sink(self):
+        seen = []
+
+        def sink(seq, step, tps, ckpt=None):
+            seen.append((step, tps, ckpt))
+
+        pub = hb.HeartbeatPublisher(sink, interval=60.0)
+        pub.record_progress(step=5, tokens_per_sec=10.0)
+        pub.record_checkpoint(4)
+        pub.beat_once()
+        assert seen[-1] == (5, 10.0, 4)
+
+    def test_file_bridge_roundtrip(self, tmp_path):
+        path = str(tmp_path / "hb.json")
+        hb.write_heartbeat_file(path, 3, 17, tokens_per_sec=8.0,
+                                checkpoint_step=15)
+        data = hb.read_heartbeat_file(path)
+        assert data["checkpoint_step"] == 15
+
+
+class TestAutoscalerInvariants:
+    def _scaler_with_ledger(self, entries):
+        class Snap:
+            @staticmethod
+            def snapshot():
+                return {"resize_ledger": entries}
+
+        return Snap()
+
+    def test_shrink_without_checkpoint_flagged(self):
+        bad = self._scaler_with_ledger([{
+            "key": "JAXJob:default/x", "direction": "shrink", "from": 3,
+            "to": 2, "at": 10.0, "credited_checkpoint": None,
+            "min_slices": 1, "max_slices": 4, "cooldown_until": 0.0,
+            "prev_resize_at": None, "dwell_seconds": 5.0,
+        }])
+        violations = check_autoscaler_invariants(bad)
+        assert any("without a credited" in v for v in violations)
+
+    def test_bounds_and_hysteresis_flagged(self):
+        bad = self._scaler_with_ledger([
+            {"key": "k", "direction": "grow", "from": 4, "to": 5,
+             "at": 10.0, "credited_checkpoint": None, "min_slices": 1,
+             "max_slices": 4, "cooldown_until": 0.0,
+             "prev_resize_at": None, "dwell_seconds": 5.0},
+            {"key": "k", "direction": "grow", "from": 5, "to": 6,
+             "at": 12.0, "credited_checkpoint": None, "min_slices": 1,
+             "max_slices": None, "cooldown_until": 20.0,
+             "prev_resize_at": 10.0, "dwell_seconds": 5.0},
+        ])
+        violations = check_autoscaler_invariants(bad)
+        assert any("above maxSlices" in v for v in violations)
+        assert any("cooldown window" in v for v in violations)
+        assert any("dwell" in v for v in violations)
+
+    def test_clean_ledger_passes(self):
+        ok = self._scaler_with_ledger([{
+            "key": "k", "direction": "shrink", "from": 3, "to": 2,
+            "at": 100.0, "credited_checkpoint": 42, "min_slices": 1,
+            "max_slices": 4, "cooldown_until": 50.0,
+            "prev_resize_at": 10.0, "dwell_seconds": 5.0,
+        }])
+        assert check_autoscaler_invariants(ok) == []

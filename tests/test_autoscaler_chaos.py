@@ -1,0 +1,270 @@
+"""Seeded autoscaler-chaos tier (core/autoscaler.py): the determinism and
+crash-consistency half of the signal-driven gang autoscaler.
+
+- 3-run byte-equal decision-log replay on fake clocks: the decision
+  procedure is a pure function of (state, config), so the same scripted
+  observation sequence must produce identical decision-log lines run
+  over run — the core/policies.py contract, extended to the resize loop;
+- chaos ``ScheduledCapacityRevocation`` mid-grow: the pool shrinks under
+  a freshly-grown gang; the admission layer preempts to fit, the
+  preempted job's ledger bump opens the autoscaler's cooldown window,
+  and the fleet must settle WITHOUT flapping (no resize lands inside a
+  cooldown window — audited from the ledger by
+  check_autoscaler_invariants);
+- crash-point sweep over the resize write window: the operator dies
+  immediately before and immediately after the spec patch; a cold-
+  started autoscaler (all hysteresis memory lost) must converge to the
+  same target with EXACTLY ONE applied spec patch — idempotence of
+  decide-over-current-spec is the exactly-once mechanism, not any
+  durable intent record.
+"""
+
+import pytest
+
+from tf_operator_tpu.cluster.chaos import (
+    ChaosCluster,
+    ChaosSpec,
+    ScheduledCapacityRevocation,
+    SimulatedCrash,
+)
+from tf_operator_tpu.cluster.memory import InMemoryCluster
+from tf_operator_tpu.controllers.jax import JAXController
+from tf_operator_tpu.core.admission import AdmissionController
+from tf_operator_tpu.core.autoscaler import AutoscalerConfig, GangAutoscaler
+from tf_operator_tpu.core.job_controller import EngineOptions
+from tf_operator_tpu.core.tracing import Tracer
+from tf_operator_tpu.core.workqueue import WorkQueue
+from tf_operator_tpu.metrics import Metrics
+from tf_operator_tpu.testing.invariants import (
+    assert_invariants,
+    check_autoscaler_invariants,
+)
+
+from test_autoscaler import (
+    FakeClock,
+    beat,
+    drive_running,
+    elastic_manifest,
+    job_slices,
+    rigid_manifest,
+    running_workers,
+    settle,
+)
+
+
+def build(capacity, clk, chaos_spec=None, seed=0):
+    inner = InMemoryCluster(clock=clk)
+    cluster = inner
+    if chaos_spec is not None:
+        cluster = ChaosCluster(inner, chaos_spec)
+    metrics = Metrics()
+    tracer = Tracer()
+    adm = AdmissionController(
+        capacity=capacity, clock=clk, metrics=metrics,
+        capacity_fn=inner.schedulable_capacity,
+    )
+    controller = JAXController(
+        cluster, queue=WorkQueue(clock=clk), options=EngineOptions(),
+        clock=clk, metrics=metrics, tracer=tracer, admission=adm,
+    )
+    scaler = GangAutoscaler(
+        cluster, adm,
+        AutoscalerConfig(watermark_pods=1.0, hold_seconds=2.0,
+                         dwell_seconds=4.0, cooldown_seconds=6.0,
+                         seed=seed),
+        clock=clk, metrics=metrics,
+    )
+    return inner, cluster, controller, adm, scaler, tracer
+
+
+# ----------------------------------------------------- byte-equal replay
+
+
+def scripted_run(seed):
+    """One fully-scripted elasticity scenario on a fake clock: grow into
+    surplus, a mid-run capacity revocation with queue pressure, a
+    checkpoint-gated shrink, recovery. Returns the decision-log lines —
+    the byte-equality artifact."""
+    clk = FakeClock()
+    inner, cluster, controller, adm, scaler, tracer = build(
+        {"pods": "12"}, clk, seed=seed)
+    inner.create_job(elastic_manifest("e0", slices=2, hosts=2,
+                                      max_slices=5))
+    inner.create_job(elastic_manifest("e1", slices=1, hosts=2,
+                                      max_slices=5))
+    settle(controller, clk, ["e0", "e1"])
+
+    def step(seconds=1.0, ticks=1):
+        for _ in range(ticks):
+            clk.advance(seconds)
+            scaler.tick()
+            settle(controller, clk, ["e0", "e1"], rounds=4)
+
+    step(seconds=2.5, ticks=3)   # surplus held: grows fire
+    # Workloads report; e1 checkpoints.
+    for name in ("e0", "e1"):
+        for pod_name in running_workers(inner, name):
+            beat(inner, pod_name, step=50, tps=400.0, ckpt=40)
+    # Queue pressure arrives: a rigid job that cannot fit.
+    inner.create_job(rigid_manifest("r0", workers=4))
+    settle(controller, clk, ["e0", "e1", "r0"], rounds=4)
+    step(seconds=1.0, ticks=2)   # propose shrink; blocked until fresh ckpt
+    for name in ("e0", "e1"):
+        for pod_name in running_workers(inner, name):
+            beat(inner, pod_name, step=90, tps=400.0, ckpt=80)
+    step(seconds=5.0, ticks=4)   # shrink applies (dwell-paced), r0 admits
+    # Capacity churn: the seeded revocation effect, then restore.
+    inner.set_schedulable_capacity({"pods": "6"})
+    settle(controller, clk, ["e0", "e1", "r0"], rounds=6)
+    step(seconds=1.0, ticks=2)
+    inner.set_schedulable_capacity(None)
+    step(seconds=3.0, ticks=4)
+    violations = check_autoscaler_invariants(
+        scaler, cluster=inner, kinds=("JAXJob",))
+    assert violations == [], violations
+    return scaler.decision_log_lines()
+
+
+class TestDecisionLogReplay:
+    def test_three_runs_byte_equal(self):
+        runs = [scripted_run(seed=7) for _ in range(3)]
+        assert runs[0], "scenario produced no decisions at all"
+        assert runs[0] == runs[1] == runs[2]
+
+    def test_seed_is_threaded_into_the_log(self):
+        lines = scripted_run(seed=13)
+        assert all('"seed":13' in line for line in lines)
+
+
+# ---------------------------------------------- revocation mid-grow
+
+
+class TestRevocationMidGrow:
+    def test_scheduled_revocation_opens_cooldown_no_flap(self):
+        """The chaos ScheduledCapacityRevocation fires on the write
+        clock right after the autoscaler's grow lands: the pool shrinks
+        under the freshly-grown gang, admission preempts to fit, and the
+        disruption must open the cooldown window — the ledger shows no
+        resize inside it (anti-flap), and the fleet converges."""
+        clk = FakeClock()
+        spec = ChaosSpec(
+            seed=11,
+            capacity_revocations=(
+                # Fires once the write clock passes the grown world's
+                # recreation — i.e. mid-grow, the worst moment.
+                ScheduledCapacityRevocation(
+                    after_writes=40, capacity={"pods": "4"}),
+            ),
+        )
+        inner, cluster, controller, adm, scaler, tracer = build(
+            {"pods": "8"}, clk, chaos_spec=spec)
+        inner.create_job(elastic_manifest("e0", slices=2, hosts=2,
+                                          max_slices=4))
+        settle(controller, clk, ["e0"])
+        assert len(running_workers(inner, "e0")) == 4
+
+        grew = revoked = False
+        for _ in range(40):
+            clk.advance(1.0)
+            scaler.tick()
+            settle(controller, clk, ["e0"], rounds=4)
+            grew = grew or job_slices(inner, "e0") > 2
+            revoked = revoked or any(
+                "capacity-revoke" in f for f in cluster.fault_log
+            )
+            if grew and revoked:
+                break
+        assert grew, "the autoscaler never grew into the surplus"
+        assert revoked, "the scheduled revocation never fired"
+        # Let the preempt-to-fit and cooldown play out.
+        for _ in range(12):
+            clk.advance(1.0)
+            scaler.tick()
+            settle(controller, clk, ["e0"], rounds=4)
+        status = (
+            inner.get_job("JAXJob", "default", "e0").get("status") or {}
+        )
+        assert sum((status.get("disruptionCounts") or {}).values()) >= 1
+        violations = check_autoscaler_invariants(
+            scaler, cluster=inner, kinds=("JAXJob",))
+        assert violations == [], violations
+        assert_invariants(inner, kinds=("JAXJob",), tracer=tracer,
+                          admission=adm, autoscaler=scaler,
+                          label="autoscaler_revocation")
+
+
+# ------------------------------------------------- crash-point sweep
+
+
+class ResizeCrashProxy:
+    """Wraps the autoscaler's cluster seam and plants one SimulatedCrash
+    in the resize write window: variant 'before' dies with the spec
+    patch unwritten, 'after' dies with it durable. Counts the spec
+    patches that actually landed — the exactly-once artifact."""
+
+    def __init__(self, inner, variant):
+        self._inner = inner
+        self._variant = variant
+        self._armed = True
+        self.applied = 0
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def update_job(self, job_dict):
+        if self._armed:
+            self._armed = False
+            if self._variant == "before":
+                raise SimulatedCrash("crash before resize write")
+            out = self._inner.update_job(job_dict)
+            self.applied += 1
+            raise SimulatedCrash("crash after resize write")
+        out = self._inner.update_job(job_dict)
+        self.applied += 1
+        return out
+
+
+class TestResizeCrashWindow:
+    @pytest.mark.parametrize("variant", ["before", "after"])
+    def test_exactly_once_spec_patch_across_crash(self, variant):
+        clk = FakeClock()
+        inner = InMemoryCluster(clock=clk)
+        metrics = Metrics()
+        adm = AdmissionController(
+            capacity={"pods": "8"}, clock=clk, metrics=metrics,
+            capacity_fn=inner.schedulable_capacity,
+        )
+        controller = JAXController(
+            inner, queue=WorkQueue(clock=clk), options=EngineOptions(),
+            clock=clk, metrics=metrics, tracer=Tracer(), admission=adm,
+        )
+        proxy = ResizeCrashProxy(inner, variant)
+        config = AutoscalerConfig(watermark_pods=1.0, hold_seconds=2.0,
+                                  dwell_seconds=4.0, cooldown_seconds=6.0)
+        scaler = GangAutoscaler(proxy, adm, config, clock=clk,
+                                metrics=metrics)
+        inner.create_job(elastic_manifest("e0", slices=2, hosts=2,
+                                          max_slices=3))
+        settle(controller, clk, ["e0"])
+        assert len(running_workers(inner, "e0")) == 4
+
+        scaler.tick()  # arms the surplus hold clock
+        clk.advance(2.5)
+        with pytest.raises(SimulatedCrash):
+            scaler.tick()  # the operator dies in the resize write window
+        # Cold start: a fresh autoscaler instance, all memory lost.
+        scaler = GangAutoscaler(proxy, adm, config, clock=clk,
+                                metrics=metrics)
+        for _ in range(4):
+            clk.advance(2.5)
+            scaler.tick()
+            settle(controller, clk, ["e0"], rounds=4)
+        # Exactly one spec patch landed and the target was reached —
+        # never zero (lost resize), never two (doubled resize).
+        assert job_slices(inner, "e0") == 3
+        assert proxy.applied == 1
+        settle(controller, clk, ["e0"])
+        assert len(running_workers(inner, "e0")) == 6
+        violations = check_autoscaler_invariants(
+            scaler, cluster=inner, kinds=("JAXJob",))
+        assert violations == [], violations

@@ -177,6 +177,22 @@ class OperatorOptions:
     # evicting the whole job. Off (default) keeps the PR 9 job-granular
     # arbiter byte-identical.
     admission_slice_granularity: bool = False
+    # Signal-driven gang autoscaler (core/autoscaler.py, one per operator
+    # like the AdmissionController): automatically resizes elastic
+    # JAXJob gangs through the existing spec-resize path from the
+    # admission pool's free-capacity watermark, queue pressure, and the
+    # heartbeat tokens_per_sec/checkpoint lease stream. Off (default) =
+    # the controller is never built and no loop thread exists, so every
+    # seeded PR 1-14 tier replays byte-identically. Requires
+    # --enable-gang-admission (the pool IS the watermark signal).
+    enable_autoscaler: bool = False
+    autoscaler_interval: float = 5.0
+    autoscaler_watermark_pods: float = 2.0
+    autoscaler_hold_seconds: float = 15.0
+    autoscaler_dwell_seconds: float = 30.0
+    autoscaler_cooldown_seconds: float = 60.0
+    autoscaler_efficiency_floor: float = 0.7
+    autoscaler_seed: int = 0
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -305,6 +321,46 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         help="Once the head-of-line gang has waited this "
                         "long, backfill stops until it admits "
                         "(starvation bound).")
+    parser.add_argument("--enable-autoscaler", action="store_true",
+                        help="Signal-driven gang autoscaler "
+                        "(core/autoscaler.py): automatically resizes "
+                        "elastic JAXJobs (spec.elastic bounds) through "
+                        "the validated spec-resize path — grows into "
+                        "held free-capacity surplus (scale-efficiency "
+                        "guarded), shrinks under admission queue "
+                        "pressure only after a fresh checkpoint lands "
+                        "(record_checkpoint lease rider), with dwell + "
+                        "post-disruption cooldown hysteresis. Requires "
+                        "--enable-gang-admission. Default off.")
+    parser.add_argument("--autoscaler-interval", type=float, default=5.0,
+                        help="Seconds between autoscaler control-loop "
+                        "ticks.")
+    parser.add_argument("--autoscaler-watermark-pods", type=float,
+                        default=2.0,
+                        help="Free pod slots above this are growable "
+                        "surplus.")
+    parser.add_argument("--autoscaler-hold-seconds", type=float,
+                        default=15.0,
+                        help="Surplus must persist this long (queue "
+                        "empty throughout) before a grow fires.")
+    parser.add_argument("--autoscaler-dwell-seconds", type=float,
+                        default=30.0,
+                        help="Minimum time between two resizes of one "
+                        "job.")
+    parser.add_argument("--autoscaler-cooldown-seconds", type=float,
+                        default=60.0,
+                        help="No resizes of a job inside this window "
+                        "after an observed disruption/restart (the "
+                        "capacity-revocation anti-flap).")
+    parser.add_argument("--autoscaler-efficiency-floor", type=float,
+                        default=0.7,
+                        help="After a grow, tokens/sec-per-worker must "
+                        "stay >= this fraction of the pre-grow baseline "
+                        "for further grows.")
+    parser.add_argument("--autoscaler-seed", type=int, default=0,
+                        help="Decision seed threaded into the autoscaler "
+                        "state (same purity contract as "
+                        "--admission-seed).")
     parser.add_argument("--admission-slice-granularity", action="store_true",
                         help="Admit multislice jobs one SLICE at a time: "
                         "each slice is its own admission demand — "
@@ -389,6 +445,14 @@ def options_from_args(args: argparse.Namespace) -> OperatorOptions:
         admission_policy=args.admission_policy,
         tenant_weights=list(args.tenant_weight),
         admission_seed=args.admission_seed,
+        enable_autoscaler=args.enable_autoscaler,
+        autoscaler_interval=args.autoscaler_interval,
+        autoscaler_watermark_pods=args.autoscaler_watermark_pods,
+        autoscaler_hold_seconds=args.autoscaler_hold_seconds,
+        autoscaler_dwell_seconds=args.autoscaler_dwell_seconds,
+        autoscaler_cooldown_seconds=args.autoscaler_cooldown_seconds,
+        autoscaler_efficiency_floor=args.autoscaler_efficiency_floor,
+        autoscaler_seed=args.autoscaler_seed,
     )
 
 
@@ -701,6 +765,39 @@ class OperatorManager:
                 tenant_weights=weights,
                 seed=self.options.admission_seed,
             )
+        # Signal-driven gang autoscaler (core/autoscaler.py): one per
+        # operator, built only when opted in — the None default keeps
+        # every seeded tier byte-identical (no object, no loop thread).
+        # It reads the admission pool's watermarks, so the admission
+        # arbiter is a hard prerequisite: without a pool there is no
+        # free-capacity signal to close the loop on.
+        self.autoscaler = None
+        if self.options.enable_autoscaler:
+            if self.admission is None:
+                raise ValueError(
+                    "--enable-autoscaler requires --enable-gang-admission: "
+                    "the admission pool's free-capacity watermark is the "
+                    "autoscaler's grow signal"
+                )
+            from .core.autoscaler import AutoscalerConfig, GangAutoscaler
+
+            self.autoscaler = GangAutoscaler(
+                cluster,
+                self.admission,
+                AutoscalerConfig(
+                    watermark_pods=self.options.autoscaler_watermark_pods,
+                    hold_seconds=self.options.autoscaler_hold_seconds,
+                    dwell_seconds=self.options.autoscaler_dwell_seconds,
+                    cooldown_seconds=(
+                        self.options.autoscaler_cooldown_seconds
+                    ),
+                    efficiency_floor=(
+                        self.options.autoscaler_efficiency_floor
+                    ),
+                    seed=self.options.autoscaler_seed,
+                ),
+                metrics=self.metrics,
+            )
         from .core.control import TokenBucket
 
         shared_limiter = TokenBucket(self.options.qps, self.options.burst)
@@ -800,6 +897,14 @@ class OperatorManager:
             "admission": (
                 self.admission.snapshot()
                 if self.admission is not None else None
+            ),
+            # Autoscaler state (core/autoscaler.py snapshot): hysteresis
+            # clocks, pending checkpoint-gated shrinks, the resize
+            # ledger — the first read when a fleet "resized itself" and
+            # someone wants to know which signal drove it.
+            "autoscaler": (
+                self.autoscaler.snapshot()
+                if self.autoscaler is not None else None
             ),
             "threads": threads,
         }
@@ -981,6 +1086,22 @@ class OperatorManager:
         ns, _, name = item.partition(":")[2].partition("/")
         return self.coordinator.allows(ns, name)
 
+    def _autoscaler_loop(self) -> None:
+        """The autoscaler's control loop: one tick per interval, gated on
+        leadership exactly like the sync workers (a standby replica's
+        autoscaler observing a fleet it doesn't reconcile must not
+        resize it). A tick that raises is logged and the loop survives —
+        the next tick re-observes from scratch; the decision function's
+        idempotence (a function of the CURRENT spec) makes the retry
+        safe."""
+        while not self._stop.is_set():
+            if self._is_leader:
+                try:
+                    self.autoscaler.tick()
+                except Exception:  # noqa: BLE001 — the loop must survive
+                    log.warning("autoscaler tick raised", exc_info=True)
+            self._stop.wait(self.options.autoscaler_interval)
+
     def _worker_loop(self, kind: str) -> None:
         controller = self.controllers[kind]
         # The gate re-checks authority AFTER the blocking queue pop: a
@@ -1102,6 +1223,13 @@ class OperatorManager:
         thread = threading.Thread(target=self._resync_loop, daemon=True)
         thread.start()
         self._threads.append(thread)
+        if self.autoscaler is not None:
+            thread = threading.Thread(
+                target=self._autoscaler_loop, name="gang-autoscaler",
+                daemon=True,
+            )
+            thread.start()
+            self._threads.append(thread)
         self._start_http_servers()
         self.resync_once()
         self._started = True

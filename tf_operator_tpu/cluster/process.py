@@ -365,11 +365,15 @@ class LocalProcessCluster(InMemoryCluster):
             state[3] = beat.get("seq")
             step = beat.get("step")
             tps = beat.get("tokens_per_sec")
+            ckpt = beat.get("checkpoint_step")
             hb_runtime.publish_heartbeat(
                 self, lease_ns, lease_name, identity=key[1],
                 step=int(step) if isinstance(step, (int, float)) else None,
                 tokens_per_sec=(
                     float(tps) if isinstance(tps, (int, float)) else None
+                ),
+                checkpoint_step=(
+                    int(ckpt) if isinstance(ckpt, (int, float)) else None
                 ),
             )
 

@@ -284,6 +284,12 @@ class _Gang:
     # The generation sub-pool an ADMITTED gang was placed in (None on a
     # homogeneous pool, and while waiting).
     generation: Optional[str] = None
+    # The demand the gate actually GRANTED at admit time (None while
+    # waiting). The growth guard keeps ``demand`` pinned to this for
+    # admitted gangs: an elastic grow that fits free headroom re-grants
+    # in place, one that does not must re-queue through the gate — it may
+    # never inflate usage past the pool by side effect of a spec refresh.
+    admitted_demand: Optional[Dict[str, Fraction]] = None
     announced_admit: bool = False
     announced_queue: bool = False
     # Last blocked_on verdict the metric layer saw: the quota-denial
@@ -477,6 +483,7 @@ class AdmissionController:
         gang.blocked_on = ""
         gang.announced_admit = False
         gang.generation = generation
+        gang.admitted_demand = dict(gang.demand)
         self._admitted[gang.key] = gang
         entry = {
             "key": gang.key, "band": gang.band, "backfill": backfill,
@@ -500,6 +507,60 @@ class AdmissionController:
         )
         if gang.kick is not None:
             self._kicks.append(gang.kick)
+
+    def _growth_fits_locked(self, gang: _Gang,
+                            demand: Dict[str, Fraction]) -> bool:
+        """Would re-granting ``demand`` to this ADMITTED gang (in place of
+        its current charge) still fit the flat pool, its generation
+        sub-pool, and its namespace quota? The growth guard's predicate:
+        an elastic grow covered by free headroom is an in-place re-grant;
+        one that is not must release and re-queue through the gate."""
+        from .policies import fits as _fits
+
+        exclude = {gang.key}
+        if not _fits(demand, self._usage_locked(exclude),
+                     self.effective_capacity()):
+            return False
+        quota = self.quotas.get(gang.namespace)
+        if quota:
+            used = self._ns_usage_locked(gang.namespace, exclude)
+            if not all(
+                used.get(name, Fraction(0)) + qty <= quota[name]
+                for name, qty in demand.items()
+                if name in quota
+            ):
+                return False
+        gens = self.effective_generations()
+        if gens and gang.generation in gens:
+            gen_usage: Dict[str, Fraction] = {}
+            for g in self._admitted.values():
+                if g.key in exclude or g.generation != gang.generation:
+                    continue
+                for name, qty in g.demand.items():
+                    gen_usage[name] = gen_usage.get(name, Fraction(0)) + qty
+            if not _fits(demand, gen_usage, gens[gang.generation]):
+                return False
+        return True
+
+    def _demote_to_queue_locked(self, gang: _Gang, now: float) -> None:
+        """Release an admitted gang back to the wait queue (the growth
+        guard's no-bypass path): head of its band with a fresh aging
+        clock — it held capacity in good standing and must not lose its
+        place to later arrivals for asking to grow."""
+        self._admitted.pop(gang.key, None)
+        gang.admitted_at = None
+        gang.backfilled = False
+        gang.announced_admit = False
+        gang.announced_queue = False
+        gang.reported_block = ""
+        gang.admitted_demand = None
+        gang.generation = None
+        band_seqs = [
+            g.seq for g in self._waiting.values() if g.band == gang.band
+        ]
+        gang.seq = (min(band_seqs) - 1) if band_seqs else gang.seq
+        gang.enqueued_at = now
+        self._waiting[gang.key] = gang
 
     def _mark_preempt_locked(self, gang: _Gang, cause: str) -> None:
         if gang.key in self._preempt:
@@ -690,10 +751,35 @@ class AdmissionController:
         with self._lock:
             now = self.clock()
             gang = self._admitted.get(key)
+            if gang is not None and demand:
+                # Growth guard (no-bypass rule): an elastic resize that
+                # RAISES an admitted gang's demand is a fresh capacity
+                # ask, not a bookkeeping refresh. Covered by free
+                # headroom it re-grants in place (below, unchanged);
+                # beyond headroom it must queue through the gate — while
+                # the old world's pods still live (resize teardown in
+                # flight) the gang stays admitted at its GRANTED demand
+                # so the pool keeps charging what actually runs, and
+                # once they are gone it re-queues at the head of its
+                # band instead of inflating usage past the pool (which
+                # would preempt an innocent victim via the revocation
+                # sweep).
+                granted = gang.admitted_demand
+                grew = granted is not None and any(
+                    qty > granted.get(name, Fraction(0))
+                    for name, qty in demand.items()
+                )
+                if grew and not self._growth_fits_locked(gang, demand):
+                    if has_pods:
+                        demand = dict(granted)
+                    else:
+                        self._demote_to_queue_locked(gang, now)
+                        gang = None
             if gang is not None:
                 # Refresh demand (elastic resize changes it) and notice
                 # revocations; a same-sync re-ask stays admitted.
                 gang.demand = demand or gang.demand
+                gang.admitted_demand = dict(gang.demand)
                 gang.members = members or gang.members
                 gang.uid = uid or gang.uid
                 gang.kick = kick or gang.kick
@@ -977,6 +1063,10 @@ class AdmissionController:
                     {
                         "key": g.key, "band": g.band, "members": g.members,
                         "demand": fmt(g.demand), "backfilled": g.backfilled,
+                        "admitted_demand": fmt(
+                            g.admitted_demand
+                            if g.admitted_demand is not None else g.demand
+                        ),
                         "admitted_for": round(now - (g.admitted_at or now), 3),
                         **({"generation": g.generation} if gens else {}),
                     }

@@ -1,0 +1,850 @@
+"""Signal-driven gang autoscaler: closes the loop from observed signals
+(free-capacity watermarks, queue pressure, workload throughput, disruption
+churn) back into the EXISTING elastic spec-resize path.
+
+Elastic resize, suspend/resume, and preemption-resume all work today, but
+only when a human edits the spec — the fleet pays for idle capacity while
+queued gangs wait, and oversized gangs starve the admission pool. Podracer
+(arXiv:2104.06272) is the exemplar: treating worker count as a fluid
+resource is what makes large JAX fleets cheap; Gavel (arXiv:2008.09213)
+shows throughput-aware allocation decisions compound. This module is the
+controller that acts on the signals those PRs built:
+
+- free-capacity watermarks from the admission pool snapshot (PR 9);
+- queue depth per band from the same snapshot;
+- per-job throughput from the heartbeat ``tokens_per_sec`` lease stream
+  (PR 12's ``training_workload_tokens_per_sec`` signal, read at the
+  source — the lease annotations — so the autoscaler needs no metrics
+  round-trip);
+- the checkpoint-step rider (``record_checkpoint``) on the same leases,
+  the coordination signal for shrink;
+- disruption pressure from the per-job ledgers (cooldown after churn);
+- ``admission_effective_throughput`` placement quality: with the gavel
+  policy's generation sub-pools declared, grow candidates are ordered by
+  their throughput ratio on the generation with the most FREED capacity.
+
+Determinism contract (the ``core/policies.py`` contract, verbatim): the
+decision procedure is the pure function ``decide(state, config)`` over an
+immutable :class:`AutoscalerState` — no wall clock, no ambient state, an
+injected clock value and an explicit seed — so seeded fake-clock replays
+produce byte-equal decision logs (``decision_log_lines``). All hysteresis
+memory (surplus hold clocks, per-job dwell stamps, cooldowns, pending
+shrink proposals, grow baselines) lives in the CONTROLLER and is
+snapshotted INTO the state each tick; ``decide`` never mutates it.
+
+Policies:
+
+- GROW: only when free capacity has sat above the watermark for the hold
+  period with an empty admission queue (surplus that nobody queued for),
+  one slice at a time, bounded by ``spec.elastic.maxSlices``, and gated
+  by the scale-efficiency guard: a job whose observed tokens/sec-per-
+  worker regressed past the floor after a previous grow is not grown
+  again (blocked ``scale-efficiency``; a grown job that has not yet
+  reported throughput blocks on ``awaiting-throughput``).
+- SHRINK: checkpoint-coordinated. Queue pressure (waiting gangs) PROPOSES
+  a one-slice shrink of the widest elastic job; the proposal is applied
+  only after the heartbeat stream reports a FRESH checkpoint (step
+  strictly past the one observed at proposal time — ``record_checkpoint``
+  rider, mirrored by llama_train), so a scale-down can never lose more
+  than one checkpoint interval. Pressure draining away withdraws the
+  proposal.
+- HYSTERESIS: minimum dwell between resizes of one job, cooldown after
+  any observed disruption/restart-ledger growth (which is how chaos
+  ``ScheduledCapacityRevocation`` churn is kept from flapping the fleet
+  — every revocation preempts somebody, and the preempted job's ledger
+  bump opens its cooldown window), and the surplus hold clock resets
+  whenever free capacity dips under the watermark.
+
+Resizes are applied through the EXISTING spec-resize path — the SDK's
+validated whole-slice ``scale`` (numSlices + Worker replicas + mesh DCN
+axis patched together, optimistic concurrency) — so the controller's
+stale-world gang restart and the admission growth guard see an
+autoscaler resize exactly as they see a human one. Exactly-once across
+crashes falls out of idempotence: the decision is a function of the
+CURRENT spec, so a crashed apply either never wrote (the next incarnation
+re-decides the same resize) or wrote (the next incarnation observes the
+target reached and decides nothing).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from fractions import Fraction
+from typing import Dict, List, Mapping, Optional, Tuple
+
+from .policies import ratio_of
+
+log = logging.getLogger(__name__)
+
+_F0 = Fraction(0)
+
+
+# --------------------------------------------------------------- state view
+
+
+@dataclass(frozen=True)
+class ElasticJobView:
+    """Immutable per-job view handed to ``decide`` — everything a resize
+    decision may legally depend on, nothing it could mutate."""
+
+    key: str  # "<Kind>:<ns>/<name>" — the admission/workqueue identity
+    kind: str
+    namespace: str
+    name: str
+    num_slices: int
+    hosts_per_slice: int
+    min_slices: int
+    max_slices: Optional[int]  # None = unbounded (capacity is the cap)
+    admitted: bool
+    suspended: bool
+    # Freshest gang throughput from the heartbeat lease stream (max over
+    # live in-range ranks — the _check_liveness aggregation rule); None =
+    # no report yet.
+    tokens_per_sec: Optional[float]
+    # Gang-wide durable checkpoint step (min over reporting ranks — a
+    # slice mid-save holds the shrink gate); None = the workload never
+    # checkpointed (shrink stays blocked).
+    checkpoint_step: Optional[int]
+    # Per-generation normalized throughput (schedulingPolicy.
+    # throughputRatios) — the gavel placement-quality signal.
+    throughput_ratios: Mapping[str, float] = field(default_factory=dict)
+    # The admission generation sub-pool currently hosting the gang.
+    generation: Optional[str] = None
+    # Sum of the job's restart/disruption/stall/sliceRestart ledgers,
+    # read off the same list_jobs dict the view was built from (the
+    # cooldown signal — decide itself never reads it; the controller's
+    # memory update does, without a second per-job apiserver read).
+    churn_total: int = 0
+
+    @property
+    def workers(self) -> int:
+        return self.num_slices * self.hosts_per_slice
+
+
+@dataclass(frozen=True)
+class AutoscalerState:
+    """One tick's immutable input. ``now`` is the controller's injected
+    clock value at the tick — ``decide`` never reads time itself."""
+
+    jobs: Tuple[ElasticJobView, ...]
+    # Free schedulable pod slots in the admission pool (effective
+    # capacity minus admitted usage); None = no bounded pool declared.
+    free_pods: Optional[float]
+    capacity_pods: Optional[float]
+    # Waiting gangs at the admission gate (all bands).
+    queue_depth: int
+    # Per-generation free pod slots ({} = homogeneous pool).
+    generations_free: Mapping[str, float]
+    # Controller memory, snapshotted in (decide never mutates it):
+    surplus_since: Optional[float]  # free > watermark continuously since
+    cooldown_until: Mapping[str, float]  # job key -> cooldown expiry
+    last_resize_at: Mapping[str, float]  # job key -> last applied resize
+    # job key -> (target slices, checkpoint baseline at proposal time)
+    pending_shrinks: Mapping[str, Tuple[int, Optional[int]]]
+    # job key -> tokens/sec-per-worker observed at the last grow (the
+    # scale-efficiency guard's baseline); absent = never grown.
+    grow_baselines: Mapping[str, float]
+    now: float = 0.0
+    seed: int = 0
+
+
+# ---------------------------------------------------------------- decisions
+
+
+@dataclass(frozen=True)
+class Resize:
+    key: str
+    kind: str
+    namespace: str
+    name: str
+    from_slices: int
+    to_slices: int
+    direction: str  # "grow" | "shrink"
+    reason: str
+    # The checkpoint step that credited this shrink (None on grows).
+    credited_checkpoint: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class ShrinkProposal:
+    key: str
+    target_slices: int
+    # job.checkpoint_step at proposal time; the apply gate requires a
+    # step STRICTLY past this (or any step at all when None).
+    baseline_checkpoint: Optional[int]
+
+
+@dataclass
+class Decisions:
+    """One tick's ordered output: at most one resize to APPLY, new shrink
+    proposals to record, withdrawn proposals, and blocked verdicts (the
+    ``autoscaler_blocked_shrinks_total{cause}`` feed)."""
+
+    actions: List[Resize] = field(default_factory=list)
+    proposals: List[ShrinkProposal] = field(default_factory=list)
+    withdrawals: List[str] = field(default_factory=list)
+    blocked: List[Tuple[str, str]] = field(default_factory=list)
+
+
+# ------------------------------------------------------------------- config
+
+
+@dataclass
+class AutoscalerConfig:
+    """Hysteresis and watermark knobs (cli flags ``--autoscaler-*``)."""
+
+    # Free capacity above this many pod slots is "surplus".
+    watermark_pods: float = 2.0
+    # Surplus must persist this long (queue empty throughout) to grow.
+    hold_seconds: float = 15.0
+    # Minimum time between two applied resizes of one job.
+    dwell_seconds: float = 30.0
+    # No resizes of a job within this window after an observed
+    # disruption/restart-ledger bump (revocation churn guard).
+    cooldown_seconds: float = 60.0
+    # Scale-efficiency guard: after a grow, tokens/sec-per-worker must
+    # stay at or above this fraction of the pre-grow baseline for the
+    # job to be grown again.
+    efficiency_floor: float = 0.7
+    seed: int = 0
+
+
+#: The blocked-verdict vocabulary of the SHRINK path — the only causes
+#: the autoscaler_blocked_shrinks_total metric may carry (grow-side
+#: guard verdicts — awaiting-throughput, scale-efficiency — ride the
+#: Decisions object only).
+SHRINK_BLOCK_CAUSES = frozenset(
+    {"no-fresh-checkpoint", "cooldown", "dwell", "at-min"}
+)
+
+
+# ------------------------------------------------------------ pure decision
+
+
+# (Generation-ratio lookups reuse policies.ratio_of — ElasticJobView
+# carries the same .throughput_ratios surface GangView does, so the
+# admission policies and the autoscaler can never disagree about a
+# job's throughput on a generation.)
+
+
+def decide(state: AutoscalerState, config: AutoscalerConfig) -> Decisions:
+    """The pure decision function: at most ONE resize per tick (hysteresis
+    is per-job, pacing is global), shrink arbitration before grow — they
+    cannot co-fire (shrink requires queue pressure, grow requires an empty
+    queue), but the ordering keeps the procedure readable and the log
+    stable."""
+    decisions = Decisions()
+    jobs = sorted(state.jobs, key=lambda j: j.key)
+    eligible = [j for j in jobs if j.admitted and not j.suspended]
+    pressure = state.queue_depth > 0
+    now = state.now
+
+    def in_cooldown(job: ElasticJobView) -> bool:
+        return now < state.cooldown_until.get(job.key, 0.0)
+
+    def in_dwell(job: ElasticJobView) -> bool:
+        last = state.last_resize_at.get(job.key)
+        return last is not None and (now - last) < config.dwell_seconds
+
+    # ---- shrink side: service pending proposals first -----------------
+    # A proposal whose job left the eligible set (preempted/unadmitted,
+    # suspended, or gone) is withdrawn, not parked: proposals are
+    # single-flight fleet-wide, so a wedged one would block every OTHER
+    # job's shrink — exactly the revocation scenario (the victim's own
+    # stale proposal must not stop the survivor from shrinking to
+    # re-fit it).
+    eligible_keys = {j.key for j in eligible}
+    for key in sorted(state.pending_shrinks):
+        if key not in eligible_keys:
+            decisions.withdrawals.append(key)
+    acted = False
+    for job in eligible:
+        pending = state.pending_shrinks.get(job.key)
+        if pending is None:
+            continue
+        target, baseline = pending
+        if not pressure or job.num_slices != target + 1:
+            # Pressure drained, or the spec moved under the proposal —
+            # a user resize in EITHER direction, or a previous apply:
+            # withdraw and re-propose against the current size. Applying
+            # a stale proposal would cut more than one slice at once
+            # (and silently revert a user's explicit grow).
+            decisions.withdrawals.append(job.key)
+            continue
+        if in_cooldown(job):
+            decisions.blocked.append((job.key, "cooldown"))
+            continue
+        if in_dwell(job):
+            decisions.blocked.append((job.key, "dwell"))
+            continue
+        fresh = job.checkpoint_step is not None and (
+            baseline is None or job.checkpoint_step > baseline
+        )
+        if not fresh:
+            # The checkpoint-coordinated contract: no shrink is ever
+            # APPLIED until the lease stream reports a checkpoint landing
+            # past the proposal baseline.
+            decisions.blocked.append((job.key, "no-fresh-checkpoint"))
+            continue
+        if not acted:
+            decisions.actions.append(Resize(
+                key=job.key, kind=job.kind, namespace=job.namespace,
+                name=job.name, from_slices=job.num_slices,
+                to_slices=max(target, job.min_slices), direction="shrink",
+                reason="queue-pressure",
+                credited_checkpoint=job.checkpoint_step,
+            ))
+            acted = True
+
+    # ---- shrink side: propose (single-flight fleet-wide) --------------
+    if pressure and not state.pending_shrinks and not acted:
+        candidates = [
+            j for j in eligible if j.num_slices > j.min_slices
+        ]
+        # Widest headroom first — the job holding the most optional
+        # capacity gives it back first; ties break on key.
+        candidates.sort(
+            key=lambda j: (-(j.num_slices - j.min_slices), -j.num_slices,
+                           j.key)
+        )
+        for job in candidates:
+            if in_cooldown(job):
+                decisions.blocked.append((job.key, "cooldown"))
+                continue
+            if in_dwell(job):
+                decisions.blocked.append((job.key, "dwell"))
+                continue
+            decisions.proposals.append(ShrinkProposal(
+                key=job.key, target_slices=job.num_slices - 1,
+                baseline_checkpoint=job.checkpoint_step,
+            ))
+            break
+        else:
+            if not candidates:
+                # Pressure with every elastic job at its floor: the
+                # at-min verdict (visibility only; nothing to do).
+                for job in eligible:
+                    if job.num_slices <= job.min_slices:
+                        decisions.blocked.append((job.key, "at-min"))
+
+    if acted or pressure:
+        return decisions
+
+    # ---- grow side ----------------------------------------------------
+    if state.free_pods is None:
+        return decisions  # no bounded pool: nothing to watermark against
+    surplus_held = (
+        state.surplus_since is not None
+        and (now - state.surplus_since) >= config.hold_seconds
+    )
+    if not surplus_held:
+        return decisions
+    candidates = []
+    for job in eligible:
+        if job.max_slices is not None and job.num_slices >= job.max_slices:
+            continue
+        delta = job.hosts_per_slice
+        # The watermark buffer stays FREE through a grow: consuming it
+        # would make the very next small arrival queue, and that queue
+        # pressure would shrink the job just grown — the flap the
+        # watermark exists to prevent.
+        if delta <= 0 or delta > state.free_pods - config.watermark_pods:
+            continue
+        if in_cooldown(job) or in_dwell(job):
+            continue
+        baseline = state.grow_baselines.get(job.key)
+        if baseline is not None:
+            # Scale-efficiency guard: a previous grow happened. 0.0 is
+            # the grew-before-first-report sentinel (the controller
+            # upgrades it to a real per-worker baseline at the first
+            # report) — either way, a grown job that has not reported
+            # throughput yet may not grow AGAIN on faith.
+            if job.tokens_per_sec is None:
+                decisions.blocked.append((job.key, "awaiting-throughput"))
+                continue
+            per_worker = job.tokens_per_sec / max(job.workers, 1)
+            if baseline > 0 and (
+                per_worker < config.efficiency_floor * baseline
+            ):
+                decisions.blocked.append((job.key, "scale-efficiency"))
+                continue
+        candidates.append(job)
+    if not candidates:
+        return decisions
+    if state.generations_free:
+        # Placement-quality ordering (the admission_effective_throughput
+        # signal, read at its source): prefer the job with the best
+        # throughput ratio on the generation holding the most freed
+        # capacity — growing a ratio-1.0 job into v6 headroom beats
+        # growing a 0.25x one into it.
+        freed_gen = max(
+            sorted(state.generations_free),
+            key=lambda g: state.generations_free[g],
+        )
+        candidates.sort(
+            key=lambda j: (-ratio_of(j, freed_gen), j.num_slices, j.key)
+        )
+    else:
+        # Smallest world first: surplus lifts the job furthest from its
+        # ceiling, which also keeps a fleet of equals balanced.
+        candidates.sort(key=lambda j: (j.num_slices, j.key))
+    job = candidates[0]
+    decisions.actions.append(Resize(
+        key=job.key, kind=job.kind, namespace=job.namespace, name=job.name,
+        from_slices=job.num_slices, to_slices=job.num_slices + 1,
+        direction="grow",
+        reason=(
+            "placement-quality" if state.generations_free
+            else "free-capacity"
+        ),
+    ))
+    return decisions
+
+
+# -------------------------------------------------------------- controller
+
+
+class GangAutoscaler:
+    """The opt-in controller loop (one per operator, like the
+    AdmissionController): collects the signal state, runs the pure
+    decision function, applies at most one resize per tick through the
+    SDK's validated scale path, and keeps the hysteresis memory + audit
+    ledgers. All state is in-memory by design: an operator restart
+    re-observes everything, and the safe direction of every lost memory
+    is DELAY (a fresh dwell clock, a re-proposed shrink) — never a
+    double resize, because the decision is a function of the current
+    spec."""
+
+    def __init__(self, cluster, admission, config: Optional[AutoscalerConfig]
+                 = None, clock=time.time, metrics=None,
+                 kinds: Tuple[str, ...] = ("JAXJob",)):
+        self.cluster = cluster
+        self.admission = admission
+        self.config = config or AutoscalerConfig()
+        self.clock = clock
+        self.kinds = tuple(kinds)
+        if metrics is None:
+            from ..metrics import METRICS
+
+            metrics = METRICS
+        self.metrics = metrics
+        # One lock over tick() and the observability reads: the loop
+        # thread mutates the hysteresis maps while /debugz snapshots
+        # them from the HTTP thread — the AdmissionController rule.
+        import threading
+
+        self._lock = threading.Lock()
+        self._tick_count = 0
+        self._surplus_since: Optional[float] = None
+        self._cooldown_until: Dict[str, float] = {}
+        self._last_resize: Dict[str, float] = {}
+        self._pending: Dict[str, Tuple[int, Optional[int]]] = {}
+        self._grow_baseline: Dict[str, float] = {}
+        self._last_churn: Dict[str, int] = {}
+        # Audit ledgers (testing/invariants.py check_autoscaler_invariants):
+        # one entry per APPLIED resize, carrying everything the invariants
+        # need to audit bounds/dwell/cooldown/checkpoint from the ledger
+        # alone. Bounded rings, the AdmissionController convention.
+        self.resize_ledger: "deque[dict]" = deque(maxlen=512)
+        # The determinism artifact: one entry per tick that took an
+        # action/proposal/withdrawal, in applied order. Same-seed runs
+        # over the same observation sequence are byte-equal
+        # (decision_log_lines).
+        self.decision_log: "deque[dict]" = deque(maxlen=4096)
+
+    # ------------------------------------------------------- observation
+    @staticmethod
+    def _pods_of(resources: Optional[Mapping[str, str]]) -> Optional[float]:
+        if resources is None:
+            return None
+        raw = resources.get("pods")
+        if raw is None:
+            return None
+        try:
+            from .job_controller import parse_quantity
+
+            return float(parse_quantity(raw))
+        except (ValueError, ZeroDivisionError):
+            return None
+
+    def _read_heartbeats(self, namespace: str, name: str,
+                         workers: int) -> Tuple[Optional[float], Optional[int]]:
+        """(gang tokens/sec, gang-wide durable checkpoint step) from the
+        heartbeat lease stream — live, in-range ranks only (the
+        _check_liveness pruning rule: a shrunk-away rank's lease may
+        never inflate the gang number). Throughput aggregates as MAX
+        (the _check_liveness rule: a global reporter yields the job
+        number directly); the checkpoint step aggregates as MIN over the
+        ranks that report one — with per-slice checkpoint dirs a slice
+        mid-save must hold the shrink gate until ITS shard is durable,
+        or the teardown loses it."""
+        from ..cluster.base import NotFound
+        from . import constants
+
+        best_tps: Optional[float] = None
+        best_ckpt: Optional[int] = None
+        try:
+            pods = self.cluster.list_pods(
+                namespace,
+                labels={
+                    constants.LABEL_GROUP_NAME: constants.GROUP_NAME,
+                    constants.LABEL_JOB_NAME: name,
+                },
+            )
+        except Exception:  # noqa: BLE001 — observation must not kill the tick
+            return None, None
+        for pod in pods:
+            if pod.metadata.deletion_timestamp is not None:
+                continue
+            try:
+                index = int(
+                    pod.metadata.labels.get(constants.LABEL_REPLICA_INDEX, -1)
+                )
+            except (TypeError, ValueError):
+                continue
+            if index < 0 or index >= workers:
+                continue
+            try:
+                lease = self.cluster.get_lease(
+                    namespace,
+                    constants.heartbeat_lease_name(pod.metadata.name),
+                )
+            except NotFound:
+                continue
+            except Exception:  # noqa: BLE001
+                continue
+            annotations = (
+                (lease.get("metadata") or {}).get("annotations") or {}
+            )
+            raw_tps = annotations.get(constants.ANNOTATION_HEARTBEAT_TPS)
+            if raw_tps is not None:
+                try:
+                    tps = float(raw_tps)
+                except (TypeError, ValueError):
+                    tps = None
+                if tps is not None and tps >= 0:
+                    best_tps = max(best_tps or 0.0, tps)
+            raw_ckpt = annotations.get(constants.ANNOTATION_HEARTBEAT_CKPT)
+            if raw_ckpt is not None:
+                try:
+                    ckpt = int(float(raw_ckpt))
+                except (TypeError, ValueError):
+                    ckpt = None
+                if ckpt is not None:
+                    best_ckpt = (
+                        ckpt if best_ckpt is None else min(best_ckpt, ckpt)
+                    )
+        return best_tps, best_ckpt
+
+    def _job_views(self) -> List[ElasticJobView]:
+        views: List[ElasticJobView] = []
+        for kind in self.kinds:
+            try:
+                job_dicts = self.cluster.list_jobs(kind)
+            except Exception:  # noqa: BLE001
+                continue
+            for job in job_dicts:
+                spec = job.get("spec") or {}
+                elastic = spec.get("elastic")
+                if elastic is None:
+                    continue
+                meta = job.get("metadata") or {}
+                namespace = meta.get("namespace", "default")
+                name = meta.get("name", "")
+                status = job.get("status") or {}
+                conditions = status.get("conditions") or []
+                terminal = any(
+                    c.get("type") in ("Succeeded", "Failed")
+                    and c.get("status") == "True"
+                    for c in conditions
+                )
+                if terminal:
+                    continue
+                run_policy = spec.get("runPolicy") or {}
+                suspended = bool(run_policy.get("suspend"))
+                num_slices = int(spec.get("numSlices") or 1)
+                workers = int((
+                    (spec.get("jaxReplicaSpecs") or {}).get("Worker") or {}
+                ).get("replicas") or 0)
+                if workers <= 0 or workers % max(1, num_slices) != 0:
+                    continue  # hosts-per-slice unknowable: never resize it
+                hosts = workers // max(1, num_slices)
+                key = f"{kind}:{namespace}/{name}"
+                admitted = True
+                generation = None
+                if self.admission is not None:
+                    admitted = self.admission.is_admitted(key)
+                    if not admitted and getattr(
+                        self.admission, "slice_granular", False
+                    ):
+                        # Slice-granular gate: the job is "admitted" for
+                        # resize purposes when every current slice is.
+                        admitted = all(
+                            self.admission.is_admitted(f"{key}#slice-{s}")
+                            for s in range(num_slices)
+                        )
+                sp = run_policy.get("schedulingPolicy") or {}
+                ratios = dict(sp.get("throughputRatios") or {})
+                churn = 0
+                for ledger in ("restartCounts", "disruptionCounts",
+                               "stallCounts", "sliceRestartCounts"):
+                    for value in (status.get(ledger) or {}).values():
+                        if isinstance(value, int):
+                            churn += value
+                tps, ckpt = self._read_heartbeats(namespace, name, workers)
+                views.append(ElasticJobView(
+                    key=key, kind=kind, namespace=namespace, name=name,
+                    num_slices=num_slices, hosts_per_slice=hosts,
+                    min_slices=int(elastic.get("minSlices") or 1),
+                    max_slices=(
+                        int(elastic["maxSlices"])
+                        if elastic.get("maxSlices") is not None else None
+                    ),
+                    admitted=admitted, suspended=suspended,
+                    tokens_per_sec=tps, checkpoint_step=ckpt,
+                    throughput_ratios=ratios, generation=generation,
+                    churn_total=churn,
+                ))
+        views.sort(key=lambda v: v.key)
+        return views
+
+    def collect_state(self) -> AutoscalerState:
+        """Build one tick's immutable state AND advance the hysteresis
+        memory (cooldown on ledger growth, the surplus hold clock)."""
+        now = self.clock()
+        views = self._job_views()
+        free = capacity = None
+        queue_depth = 0
+        generations_free: Dict[str, float] = {}
+        if self.admission is not None:
+            snap = self.admission.snapshot()
+            capacity = self._pods_of(snap.get("capacity"))
+            used = self._pods_of(snap.get("usage")) or 0.0
+            if capacity is not None:
+                free = max(0.0, capacity - used)
+            queue_depth = len(snap.get("waiting") or [])
+            for gen, pools in (snap.get("generations") or {}).items():
+                gen_cap = self._pods_of(pools.get("capacity"))
+                gen_used = self._pods_of(pools.get("usage")) or 0.0
+                if gen_cap is not None:
+                    generations_free[gen] = max(0.0, gen_cap - gen_used)
+            # Admission placement attribution for the gavel signal.
+            by_key = {
+                entry.get("key"): entry.get("generation")
+                for entry in snap.get("admitted") or []
+            }
+            if any(by_key.values()):
+                import dataclasses
+
+                views = [
+                    dataclasses.replace(v, generation=by_key.get(v.key))
+                    for v in views
+                ]
+        # Cooldown memory: any ledger growth opens the window (the churn
+        # totals ride the views — read off the same list_jobs pass, no
+        # second per-job apiserver read).
+        live_keys = set()
+        for view in views:
+            live_keys.add(view.key)
+            total = view.churn_total
+            prev = self._last_churn.get(view.key)
+            if prev is not None and total > prev:
+                self._cooldown_until[view.key] = (
+                    now + self.config.cooldown_seconds
+                )
+            self._last_churn[view.key] = total
+            # Upgrade the grew-before-first-report sentinel: the job's
+            # first throughput report after such a grow becomes its
+            # baseline (conservative — the POST-grow number — so any
+            # further regression still trips the guard).
+            if (
+                self._grow_baseline.get(view.key) == 0.0
+                and view.tokens_per_sec
+            ):
+                self._grow_baseline[view.key] = (
+                    view.tokens_per_sec / max(view.workers, 1)
+                )
+        # Prune memory of vanished jobs (terminal/deleted) so a fleet
+        # with churn doesn't grow these maps forever.
+        for stash in (self._cooldown_until, self._last_resize,
+                      self._pending, self._grow_baseline, self._last_churn):
+            for key in [k for k in stash if k not in live_keys]:
+                stash.pop(key, None)
+        # Surplus hold clock: resets the moment free dips under the
+        # watermark or anyone queues — churn can't accumulate hold time.
+        if (free is not None and free > self.config.watermark_pods
+                and queue_depth == 0):
+            if self._surplus_since is None:
+                self._surplus_since = now
+        else:
+            self._surplus_since = None
+        return AutoscalerState(
+            jobs=tuple(views),
+            free_pods=free,
+            capacity_pods=capacity,
+            queue_depth=queue_depth,
+            generations_free=dict(generations_free),
+            surplus_since=self._surplus_since,
+            cooldown_until=dict(self._cooldown_until),
+            last_resize_at=dict(self._last_resize),
+            pending_shrinks=dict(self._pending),
+            grow_baselines=dict(self._grow_baseline),
+            now=now,
+            seed=self.config.seed,
+        )
+
+    # ------------------------------------------------------------- apply
+    def _apply(self, resize: Resize) -> bool:
+        """One resize through the EXISTING validated spec-resize path
+        (sdk scale: numSlices + Worker replicas + mesh DCN axis together,
+        optimistic concurrency). False = the job moved under us (gone,
+        no longer elastic, validation refused) — never an error; the
+        next tick re-decides against fresh state. Unexpected exceptions
+        (including injected crashes) propagate: the loop wrapper owns
+        survival, and a crash-point test must see the crash."""
+        from ..api.defaulting import ValidationError
+        from ..cluster.base import Conflict, NotFound
+        from ..sdk.client import JobClient
+
+        client = JobClient(self.cluster, resize.kind)
+        last: Optional[Exception] = None
+        for _ in range(5):
+            try:
+                client._scale_once(
+                    resize.name, resize.to_slices, resize.namespace
+                )
+                return True
+            except Conflict as exc:
+                last = exc
+                continue
+            except (NotFound, ValidationError, ValueError):
+                return False
+        log.warning("autoscaler resize of %s gave up on conflicts: %s",
+                    resize.key, last)
+        return False
+
+    def tick(self) -> List[Resize]:
+        """One control-loop round: observe → decide (pure) → apply →
+        record. Returns the resizes actually applied. Serialized with
+        the observability reads via the controller lock (one loop
+        thread ticks; /debugz snapshots concurrently)."""
+        with self._lock:
+            return self._tick_locked()
+
+    def _tick_locked(self) -> List[Resize]:
+        started = time.perf_counter()
+        self._tick_count += 1
+        state = self.collect_state()
+        decisions = decide(state, self.config)
+        views = {j.key: j for j in state.jobs}
+        applied: List[Resize] = []
+        logged: List[list] = []
+        for proposal in decisions.proposals:
+            self._pending[proposal.key] = (
+                proposal.target_slices, proposal.baseline_checkpoint
+            )
+            logged.append(["propose-shrink", proposal.key,
+                           proposal.target_slices,
+                           proposal.baseline_checkpoint])
+        for key in decisions.withdrawals:
+            if self._pending.pop(key, None) is not None:
+                logged.append(["withdraw-shrink", key])
+        for resize in decisions.actions:
+            if not self._apply(resize):
+                continue
+            applied.append(resize)
+            logged.append([
+                resize.direction, resize.key, resize.from_slices,
+                resize.to_slices, resize.reason,
+            ])
+            view = views.get(resize.key)
+            self.resize_ledger.append({
+                "key": resize.key,
+                "direction": resize.direction,
+                "from": resize.from_slices,
+                "to": resize.to_slices,
+                "reason": resize.reason,
+                "at": state.now,
+                "credited_checkpoint": resize.credited_checkpoint,
+                "min_slices": view.min_slices if view else None,
+                "max_slices": view.max_slices if view else None,
+                "cooldown_until": self._cooldown_until.get(resize.key, 0.0),
+                "prev_resize_at": self._last_resize.get(resize.key),
+                "dwell_seconds": self.config.dwell_seconds,
+            })
+            self.metrics.autoscaler_resize_inc(
+                resize.direction, resize.reason
+            )
+            self._last_resize[resize.key] = state.now
+            if resize.direction == "shrink":
+                self._pending.pop(resize.key, None)
+            elif view is not None:
+                # The scale-efficiency baseline: per-worker throughput
+                # at the moment we grew past this world size. 0.0 when
+                # the job has not reported yet — the guard then blocks
+                # further grows on "awaiting-throughput" and
+                # collect_state upgrades the sentinel at first report.
+                self._grow_baseline[resize.key] = (
+                    view.tokens_per_sec / max(view.workers, 1)
+                    if view.tokens_per_sec else 0.0
+                )
+        for key, cause in decisions.blocked:
+            # Only shrink-side verdicts feed the blocked-SHRINKS metric;
+            # grow-side guard verdicts (awaiting-throughput,
+            # scale-efficiency) stay in the decisions for tests and
+            # callers but must not masquerade as shrink-coordination
+            # problems on dashboards.
+            if cause in SHRINK_BLOCK_CAUSES:
+                self.metrics.autoscaler_blocked_shrink_inc(cause)
+        if logged:
+            self.decision_log.append({
+                "tick": self._tick_count,
+                "seed": self.config.seed,
+                "actions": logged,
+            })
+        self.metrics.observe_autoscaler_decision_latency(
+            time.perf_counter() - started
+        )
+        return applied
+
+    # ----------------------------------------------------- observability
+    def decision_log_lines(self) -> List[str]:
+        """Canonical JSON lines — the byte-equality artifact (same seed +
+        same observation sequence => identical lines across runs)."""
+        import json
+
+        with self._lock:
+            entries = list(self.decision_log)
+        return [
+            json.dumps(entry, sort_keys=True, separators=(",", ":"))
+            for entry in entries
+        ]
+
+    def snapshot(self) -> dict:
+        """The /debugz autoscaler dump + the invariants' input."""
+        with self._lock:
+            return self._snapshot_locked()
+
+    def _snapshot_locked(self) -> dict:
+        return {
+            "config": {
+                "watermark_pods": self.config.watermark_pods,
+                "hold_seconds": self.config.hold_seconds,
+                "dwell_seconds": self.config.dwell_seconds,
+                "cooldown_seconds": self.config.cooldown_seconds,
+                "efficiency_floor": self.config.efficiency_floor,
+                "seed": self.config.seed,
+            },
+            "ticks": self._tick_count,
+            "surplus_since": self._surplus_since,
+            "cooldown_until": dict(self._cooldown_until),
+            "last_resize_at": dict(self._last_resize),
+            "pending_shrinks": {
+                k: list(v) for k, v in self._pending.items()
+            },
+            "grow_baselines": dict(self._grow_baseline),
+            "resize_ledger": [dict(e) for e in self.resize_ledger],
+        }

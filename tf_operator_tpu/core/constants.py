@@ -130,6 +130,13 @@ ANNOTATION_HEARTBEAT_STEP = "tpu.kubeflow.org/progress-step"
 # riding the same lease annotations: the utilization signal the controller
 # exports as training_workload_tokens_per_sec for autoscaling/dashboards.
 ANNOTATION_HEARTBEAT_TPS = "tpu.kubeflow.org/tokens-per-sec"
+# Last checkpoint the workload reported durable (record_checkpoint(step)),
+# riding the same lease annotations: the coordination signal the autoscaler's
+# checkpoint-gated shrink waits on — a shrink is applied only after a FRESH
+# checkpoint lands (strictly newer than the one observed at proposal time),
+# so an elastic scale-down can never lose more progress than one
+# checkpoint interval.
+ANNOTATION_HEARTBEAT_CKPT = "tpu.kubeflow.org/checkpoint-step"
 # Renewal cadence injected into heartbeat-enabled pods: a quarter of the
 # progress deadline, floored — several renewals must fit inside one
 # deadline window or scheduling jitter alone could trip it.

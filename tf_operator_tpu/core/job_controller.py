@@ -87,6 +87,15 @@ class _HeartbeatState:
     observed_at: float = 0.0  # local time `raw` last changed
     seen: bool = False  # a renewal has been observed to HAPPEN
     baselined: bool = False  # first lease read recorded (content ignored)
+    # Identity of the observed pod, kept so the prune pass can tell a
+    # RESTARTED rank (same index, fresh uid — lease inherited, rebaselined)
+    # from a SHRUNK-AWAY one (index now outside the declared world — the
+    # lease must be GC'd with the observation, or its last tokens-per-sec
+    # annotation outlives the worker until terminal lease GC and a later
+    # regrow's pod at this index inherits the stale number).
+    pod_name: str = ""
+    rtype: str = ""
+    index: int = -1
 
 
 def gen_general_name(job_name: str, rtype: str, index) -> str:
@@ -1474,6 +1483,23 @@ class JobController:
         return topo, "slice", slice_idx, None
 
     # -------------------------------------------------------- gang liveness
+    def _gc_heartbeat_lease(self, job: JobObject, pod_name: str) -> None:
+        """Best-effort delete of one pod's heartbeat lease (elastic-shrink
+        pruning; the terminal path has its own batched GC). NotFound is
+        the common case on repeat syncs; any other failure just leaves
+        the lease to terminal GC — pruning is hygiene, never a verdict."""
+        from ..cluster.base import NotFound
+
+        try:
+            self.cluster.delete_lease(
+                job.namespace, constants.heartbeat_lease_name(pod_name)
+            )
+        except NotFound:
+            pass
+        except Exception:  # noqa: BLE001 — hygiene must not fail the sync
+            log.debug("heartbeat lease GC failed for %s/%s", job.namespace,
+                      pod_name, exc_info=True)
+
     def _check_liveness(
         self, job: JobObject, replicas: Dict[str, ReplicaSpec], run_policy,
         pods: List[Pod],
@@ -1539,12 +1565,24 @@ class JobController:
                 if pod.metadata.deletion_timestamp is not None:
                     continue  # already being replaced; not ours to judge
                 if self._replica_index(pod) >= num_replicas:
-                    continue  # out-of-range: scale-down will delete it
+                    # Out-of-range (elastic shrink / scale-down): the pod
+                    # is on its way out. Drop its observation AND its
+                    # heartbeat lease now — the lease is keyed by pod
+                    # NAME, so left alone its last tokens-per-sec
+                    # annotation would linger until terminal lease GC and
+                    # keep a shrunk-away rank's throughput aggregatable
+                    # (and inheritable by a later regrow at this index).
+                    obs.pop(pod.metadata.uid, None)
+                    self._gc_heartbeat_lease(job, pod.metadata.name)
+                    continue
                 present.add(pod.metadata.uid)
                 state = obs.get(pod.metadata.uid)
                 if state is None:
                     state = obs[pod.metadata.uid] = _HeartbeatState(
-                        running_since=now
+                        running_since=now,
+                        pod_name=pod.metadata.name,
+                        rtype=rtype.lower(),
+                        index=self._replica_index(pod),
                     )
                 lease_name = constants.heartbeat_lease_name(
                     pod.metadata.name
@@ -1650,9 +1688,19 @@ class JobController:
                     sooner(pdl)
         # Prune pods that vanished (restart, scale-down, terminating):
         # a recreated pod gets a fresh state under its new uid, so the
-        # rendezvous clock restarts with the new incarnation.
+        # rendezvous clock restarts with the new incarnation. A vanished
+        # rank that is OUTSIDE the current world (elastic shrink — not a
+        # same-index restart, whose replacement will inherit and
+        # re-baseline the lease) takes its heartbeat lease with it: the
+        # gauge must only ever aggregate surviving ranks' annotations,
+        # and a later regrow must start from a clean lease.
+        declared = {
+            rt.lower(): (spec.replicas or 0) for rt, spec in replicas.items()
+        }
         for uid in [u for u in obs if u not in present]:
-            obs.pop(uid)
+            state = obs.pop(uid)
+            if state.pod_name and state.index >= declared.get(state.rtype, 0):
+                self._gc_heartbeat_lease(job, state.pod_name)
         self.on_heartbeat_age(job, worst_age)
         if best_tps is not None:
             self.on_workload_throughput(job, best_tps)

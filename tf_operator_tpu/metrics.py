@@ -195,6 +195,26 @@ class Metrics:
             "zero with --shards > 1 means scoping is not engaged and "
             "every replica is paying fleet-wide watch load",
         ),
+        "training_operator_autoscaler_resizes_total": (
+            ("direction", "reason"),
+            "Elastic resizes the gang autoscaler APPLIED through the "
+            "spec-resize path (core/autoscaler.py), by direction "
+            "(grow|shrink) and reason (free-capacity = watermark+hold "
+            "surplus; placement-quality = gavel generation headroom; "
+            "queue-pressure = checkpoint-coordinated shrink for waiting "
+            "gangs). A sustained alternation of grow and shrink on one "
+            "fleet is autoscaler flapping — widen the hysteresis knobs",
+        ),
+        "training_operator_autoscaler_blocked_shrinks_total": (
+            ("cause",),
+            "Shrink decisions the autoscaler WANTED but did not apply, "
+            "by binding constraint: no-fresh-checkpoint (waiting on the "
+            "record_checkpoint lease rider), cooldown (disruption churn "
+            "window), dwell (min time between resizes), at-min (every "
+            "elastic job at its minSlices floor). A sustained "
+            "no-fresh-checkpoint rate means workloads checkpoint too "
+            "rarely for elasticity to act",
+        ),
         "training_operator_apiserver_requests_total": (
             ("verb", "resource", "code"),
             "Apiserver requests issued through the cluster seam "
@@ -287,6 +307,12 @@ class Metrics:
         "training_operator_status_write_flush_latency_seconds": (
             0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30,
         ),
+        # One autoscaler tick: observe + decide + apply. ms-scale when
+        # healthy (a handful of lease reads); a tail past a second means
+        # the observation fan-out is too wide for the tick interval.
+        "training_operator_autoscaler_decision_latency_seconds": (
+            0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 5,
+        ),
     }
 
     def __init__(self):
@@ -326,6 +352,9 @@ class Metrics:
                 # waits are sub-minute, contention pushes toward the
                 # aging bound.
                 "training_operator_admission_wait_seconds",
+                # One autoscaler observe+decide+apply tick
+                # (core/autoscaler.py).
+                "training_operator_autoscaler_decision_latency_seconds",
             )
         }
         # Unlabeled gauges: leader flag etc. (legacy tf_operator_is_leader,
@@ -466,6 +495,25 @@ class Metrics:
             return self._labeled_gauges[
                 "training_operator_admission_dominant_share"
             ].get((namespace,))
+
+    def autoscaler_resize_inc(self, direction: str, reason: str) -> None:
+        """One elastic resize the gang autoscaler applied."""
+        self._inc_labeled(
+            "training_operator_autoscaler_resizes_total", direction, reason,
+        )
+
+    def autoscaler_blocked_shrink_inc(self, cause: str) -> None:
+        """One shrink decision blocked by its binding constraint."""
+        self._inc_labeled(
+            "training_operator_autoscaler_blocked_shrinks_total", cause,
+        )
+
+    def observe_autoscaler_decision_latency(self, seconds: float) -> None:
+        """One autoscaler tick's observe+decide+apply duration."""
+        with self._lock:
+            self._histograms[
+                "training_operator_autoscaler_decision_latency_seconds"
+            ][("", "autoscaler")].observe(seconds)
 
     def apiserver_request_inc(self, verb: str, resource: str, code: str) -> None:
         """One apiserver request completed (any verb, any outcome)."""

@@ -37,26 +37,35 @@ from ..bootstrap.heartbeat import (
     ENV_HEARTBEAT_LEASE,
     ENV_HEARTBEAT_NAMESPACE,
 )
-from ..core.constants import ANNOTATION_HEARTBEAT_STEP, ANNOTATION_HEARTBEAT_TPS
+from ..core.constants import (
+    ANNOTATION_HEARTBEAT_CKPT,
+    ANNOTATION_HEARTBEAT_STEP,
+    ANNOTATION_HEARTBEAT_TPS,
+)
 
 log = logging.getLogger(__name__)
 
 
 # ------------------------------------------------------------- publication
 def _progress_annotations(step: Optional[int],
-                          tokens_per_sec: Optional[float]) -> Dict[str, str]:
+                          tokens_per_sec: Optional[float],
+                          checkpoint_step: Optional[int] = None
+                          ) -> Dict[str, str]:
     """Lease annotations for the workload-reported progress payload."""
     out: Dict[str, str] = {}
     if step is not None:
         out[ANNOTATION_HEARTBEAT_STEP] = str(step)
     if tokens_per_sec is not None:
         out[ANNOTATION_HEARTBEAT_TPS] = f"{float(tokens_per_sec):.1f}"
+    if checkpoint_step is not None:
+        out[ANNOTATION_HEARTBEAT_CKPT] = str(int(checkpoint_step))
     return out
 
 
 def publish_heartbeat(cluster, namespace: str, name: str, identity: str,
                       step: Optional[int] = None,
                       tokens_per_sec: Optional[float] = None,
+                      checkpoint_step: Optional[int] = None,
                       clock=time.time) -> bool:
     """One heartbeat renewal through the Cluster seam. True iff the beat
     landed; False on a lost optimistic-concurrency round (retry next tick).
@@ -86,7 +95,8 @@ def publish_heartbeat(cluster, namespace: str, name: str, identity: str,
                 "leaseDurationSeconds": 0,
             },
         }
-        annotations = _progress_annotations(step, tokens_per_sec)
+        annotations = _progress_annotations(step, tokens_per_sec,
+                                            checkpoint_step)
         if annotations:
             lease["metadata"]["annotations"] = annotations
         try:
@@ -104,7 +114,8 @@ def publish_heartbeat(cluster, namespace: str, name: str, identity: str,
     spec = lease.setdefault("spec", {})
     spec["holderIdentity"] = identity
     spec["renewTime"] = _format_microtime(now)
-    new_annotations = _progress_annotations(step, tokens_per_sec)
+    new_annotations = _progress_annotations(step, tokens_per_sec,
+                                            checkpoint_step)
     if new_annotations:
         meta = lease.setdefault("metadata", {})
         annotations = meta.get("annotations") or {}
@@ -121,7 +132,8 @@ def publish_heartbeat(cluster, namespace: str, name: str, identity: str,
 
 
 def write_heartbeat_file(path: str, seq: int, step: Optional[int],
-                         tokens_per_sec: Optional[float] = None) -> None:
+                         tokens_per_sec: Optional[float] = None,
+                         checkpoint_step: Optional[int] = None) -> None:
     """The file half of the process-tier bridge: one JSON object, replaced
     wholesale each beat (write-to-temp + rename so the reader never sees a
     torn write). ``seq`` strictly increases so the bridge can tell a fresh
@@ -130,6 +142,8 @@ def write_heartbeat_file(path: str, seq: int, step: Optional[int],
     payload = {"seq": seq, "step": step, "ts": time.time()}
     if tokens_per_sec is not None:
         payload["tokens_per_sec"] = float(tokens_per_sec)
+    if checkpoint_step is not None:
+        payload["checkpoint_step"] = int(checkpoint_step)
     with open(tmp, "w") as fh:
         json.dump(payload, fh)
     os.replace(tmp, path)
@@ -151,14 +165,33 @@ class HeartbeatPublisher:
     """Daemon renewal loop around one sink. ``record_progress`` updates the
     step (and, optionally, the workload-reported throughput) AND wakes the
     loop so a long sleep never delays the proof of the step that just
-    completed."""
+    completed; ``record_checkpoint`` rides the same wake path for the
+    checkpoint-landed signal the autoscaler's coordinated shrink waits on."""
 
     def __init__(self, sink: Callable[[int, Optional[int], Optional[float]], None],
                  interval: float):
         self._sink = sink
+        # Sink arity resolved ONCE here, not per beat via TypeError
+        # probing: a 4-arg-capable sink that raises TypeError internally
+        # must not be re-invoked with its side effects doubled. Legacy
+        # 3-arg sinks (pre-checkpoint-rider embedders) keep working,
+        # minus the rider.
+        import inspect
+
+        try:
+            params = inspect.signature(sink).parameters.values()
+            positional = [
+                p for p in params
+                if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+            ]
+            var_positional = any(p.kind == p.VAR_POSITIONAL for p in params)
+            self._sink_args = 4 if (var_positional or len(positional) >= 4) else 3
+        except (TypeError, ValueError):  # builtins/C callables: assume current
+            self._sink_args = 4
         self.interval = max(0.05, float(interval))
         self._step: Optional[int] = None
         self._tokens_per_sec: Optional[float] = None
+        self._checkpoint_step: Optional[int] = None
         self._seq = 0
         self._wake = threading.Event()
         self._stopped = threading.Event()
@@ -180,12 +213,25 @@ class HeartbeatPublisher:
             self._tokens_per_sec = float(tokens_per_sec)
         self._wake.set()
 
+    def record_checkpoint(self, step: int) -> None:
+        """A checkpoint for ``step`` is DURABLE (call only after the save
+        returns): published as the checkpoint-step lease annotation. The
+        autoscaler treats a strictly increasing value as 'a fresh
+        checkpoint landed' — the precondition for applying a proposed
+        elastic shrink."""
+        self._checkpoint_step = int(step)
+        self._wake.set()
+
     def beat_once(self) -> None:
         """One synchronous beat (also the loop body): never raises — a
         broken sink must not take the training process down with it."""
         self._seq += 1
         try:
-            self._sink(self._seq, self._step, self._tokens_per_sec)
+            if self._sink_args >= 4:
+                self._sink(self._seq, self._step, self._tokens_per_sec,
+                           self._checkpoint_step)
+            else:
+                self._sink(self._seq, self._step, self._tokens_per_sec)
         except Exception:  # noqa: BLE001 — liveness must never kill training
             log.debug("heartbeat sink failed", exc_info=True)
 
@@ -239,9 +285,11 @@ def start_from_env(cluster=None,
         if file_path:
             def sink(seq: int, step: Optional[int],
                      tokens_per_sec: Optional[float] = None,
+                     checkpoint_step: Optional[int] = None,
                      _path=file_path) -> None:
                 write_heartbeat_file(_path, seq, step,
-                                     tokens_per_sec=tokens_per_sec)
+                                     tokens_per_sec=tokens_per_sec,
+                                     checkpoint_step=checkpoint_step)
         else:
             if cluster is None and "KUBERNETES_SERVICE_HOST" in env:
                 try:
@@ -256,10 +304,12 @@ def start_from_env(cluster=None,
                 return None
 
             def sink(seq: int, step: Optional[int],
-                     tokens_per_sec: Optional[float] = None, _c=cluster,
+                     tokens_per_sec: Optional[float] = None,
+                     checkpoint_step: Optional[int] = None, _c=cluster,
                      _ns=namespace, _name=lease, _id=identity) -> None:
                 publish_heartbeat(_c, _ns, _name, _id, step=step,
-                                  tokens_per_sec=tokens_per_sec)
+                                  tokens_per_sec=tokens_per_sec,
+                                  checkpoint_step=checkpoint_step)
 
         _active = HeartbeatPublisher(sink, interval).start()
         return _active
@@ -276,6 +326,17 @@ def record_progress(step: Optional[int] = None,
     publisher = _active
     if publisher is not None:
         publisher.record_progress(step, tokens_per_sec=tokens_per_sec)
+
+
+def record_checkpoint(step: int) -> None:
+    """Training-loop API: a checkpoint for ``step`` is durable on disk.
+    Published as the checkpoint-step lease annotation (mirrored into the
+    file bridge on the process tier) — the signal a checkpoint-coordinated
+    elastic shrink waits for before any worker is taken away. A no-op
+    without an active publisher, like record_progress."""
+    publisher = _active
+    if publisher is not None:
+        publisher.record_checkpoint(step)
 
 
 def stop() -> None:

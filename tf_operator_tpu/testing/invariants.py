@@ -395,6 +395,23 @@ def check_admission_invariants(
                     f"admission: namespace {ns} usage of {resource} ({used}) "
                     f"exceeds its quota ({bound})"
                 )
+    # No-bypass rule (elastic grow × admission): an admitted gang's live
+    # demand may never exceed what the gate granted — a grow either
+    # re-granted in place (both sides move together) or re-queued through
+    # the gate; a mismatch means a spec refresh inflated usage past the
+    # admitted charge without a decision.
+    for entry in snap.get("admitted") or []:
+        granted = entry.get("admitted_demand")
+        if granted is None:
+            continue
+        for resource, qty in (entry.get("demand") or {}).items():
+            bound = granted.get(resource)
+            if bound is None or parse_quantity(qty) > parse_quantity(bound):
+                violations.append(
+                    f"admission: {entry.get('key')} holds {qty} {resource} "
+                    f"but the gate granted {bound} — an elastic grow "
+                    "bypassed the admission gate"
+                )
     aging = snap.get("aging_seconds")
     for entry in snap.get("admit_log") or []:
         head_wait = entry.get("head_wait_at_admit")
@@ -466,6 +483,89 @@ def check_admission_invariants(
     return violations
 
 
+def check_autoscaler_invariants(
+    autoscaler, cluster=None, kinds: Sequence[str] = ("JAXJob",),
+    namespace: Optional[str] = None,
+) -> List[str]:
+    """Autoscaler-layer invariants (core/autoscaler.py), auditable from
+    the resize ledger + live specs alone:
+
+    - bounds: no applied resize ever targeted below minSlices or above
+      maxSlices, and every live elastic job's numSlices sits inside its
+      declared bounds;
+    - checkpoint-coordinated shrink: every ledgered shrink credits a
+      checkpoint step (a shrink applied without one is the data-loss
+      window the protocol exists to close);
+    - hysteresis: no resize landed inside the job's cooldown window, and
+      consecutive resizes of one job are at least the dwell apart."""
+    violations: List[str] = []
+    snap = autoscaler.snapshot()
+    ledger = snap.get("resize_ledger") or []
+    for entry in ledger:
+        key = entry.get("key")
+        direction = entry.get("direction")
+        to_slices = entry.get("to")
+        lo = entry.get("min_slices")
+        hi = entry.get("max_slices")
+        if lo is not None and to_slices is not None and to_slices < lo:
+            violations.append(
+                f"autoscaler: {key} resized to {to_slices} below "
+                f"minSlices {lo}"
+            )
+        if hi is not None and to_slices is not None and to_slices > hi:
+            violations.append(
+                f"autoscaler: {key} resized to {to_slices} above "
+                f"maxSlices {hi}"
+            )
+        if direction == "shrink" and entry.get("credited_checkpoint") is None:
+            violations.append(
+                f"autoscaler: {key} shrunk to {to_slices} without a "
+                "credited fresh checkpoint"
+            )
+        at = entry.get("at")
+        cooldown_until = entry.get("cooldown_until")
+        if (
+            at is not None and cooldown_until is not None
+            and at < cooldown_until
+        ):
+            violations.append(
+                f"autoscaler: {key} resized at {at:.3f} inside its "
+                f"cooldown window (until {cooldown_until:.3f})"
+            )
+        prev = entry.get("prev_resize_at")
+        dwell = entry.get("dwell_seconds")
+        if (
+            at is not None and prev is not None and dwell is not None
+            and (at - prev) < dwell - 1e-9
+        ):
+            violations.append(
+                f"autoscaler: {key} resized {at - prev:.3f}s after its "
+                f"previous resize (< dwell {dwell}s)"
+            )
+    if cluster is not None:
+        for kind in kinds:
+            for job in cluster.list_jobs(kind, namespace):
+                spec = job.get("spec") or {}
+                elastic = spec.get("elastic")
+                if elastic is None:
+                    continue
+                name = (job.get("metadata") or {}).get("name", "?")
+                num_slices = int(spec.get("numSlices") or 1)
+                lo = int(elastic.get("minSlices") or 1)
+                hi = elastic.get("maxSlices")
+                if num_slices < lo:
+                    violations.append(
+                        f"autoscaler: live job {name} has numSlices "
+                        f"{num_slices} below minSlices {lo}"
+                    )
+                if hi is not None and num_slices > int(hi):
+                    violations.append(
+                        f"autoscaler: live job {name} has numSlices "
+                        f"{num_slices} above maxSlices {hi}"
+                    )
+    return violations
+
+
 def dump_trace(tracer, label: str) -> Optional[str]:
     """Write the tracer's full export into build/ (override the directory
     with TRACE_DUMP_DIR) for post-mortem; returns the path, or None
@@ -514,6 +614,7 @@ def assert_invariants(
     tracer=None,
     label: str = "invariants",
     admission=None,
+    autoscaler=None,
 ) -> None:
     violations = check_job_invariants(
         cluster, kinds, namespace=namespace, expect_ledgers=expect_ledgers
@@ -524,6 +625,12 @@ def assert_invariants(
         violations.extend(
             check_admission_invariants(
                 admission, cluster=cluster, kinds=kinds, namespace=namespace
+            )
+        )
+    if autoscaler is not None:
+        violations.extend(
+            check_autoscaler_invariants(
+                autoscaler, cluster=cluster, kinds=kinds, namespace=namespace
             )
         )
     if not violations:
