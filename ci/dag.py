@@ -405,6 +405,29 @@ def default_dag() -> List[Step]:
              [PY, "scripts/measure_control_plane.py", "--mode",
               "elasticity", "--smoke"],
              deps=["contention-smoke"], retries=2),
+        # Recovery tier (docs/design/checkpoint_recovery.md): the
+        # fast-recovery plane. recovery-chaos runs the seeded restore-path
+        # fault ladder (peer refused / hang / truncated shard / stale
+        # snapshot — byte-identical fault-log replay) plus the durability
+        # barrier units: the listener fires only after the async persist
+        # finalizes, a crash in the persist window resumes on the previous
+        # checkpoint, and the autoscaler's fresh-checkpoint gate can never
+        # observe a non-durable step.
+        Step("recovery-chaos",
+             pytest + ["tests/test_checkpoint_recovery.py",
+                       "tests/test_recovery_chaos.py", "-m", "not slow"],
+             deps=["operator-integration"], retries=2),
+        # Recovery smoke (scripts/measure_control_plane.py --mode recovery
+        # --smoke): storage-vs-peer restore on one durable checkpoint
+        # (peer must beat MODELED remote storage), the seeded
+        # degraded-fallback ladder replayed byte-identically, operator
+        # peer discovery with exactly-once recovery ledgers, and the
+        # kill->restart->step-resumed wall clock; margins ratcheted via
+        # build/recovery_smoke_last.json.
+        Step("recovery-smoke",
+             [PY, "scripts/measure_control_plane.py", "--mode",
+              "recovery", "--smoke"],
+             deps=["recovery-chaos"], retries=3),
         # Shard-failover tier (docs/design/sharded_control_plane.md): the
         # sharded active-active control plane — ring/coordinator protocol
         # units, two-manager split/steal/handback integration, and the

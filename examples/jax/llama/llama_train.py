@@ -43,7 +43,9 @@ def main(argv=None) -> int:
     from tf_operator_tpu.parallel.sharding import batch_sharding
     from tf_operator_tpu.runtime.heartbeat import (
         record_checkpoint,
+        record_peer_address,
         record_progress,
+        record_restore,
     )
     from tf_operator_tpu.runtime.profiling import step_profiler
     from tf_operator_tpu.runtime.tpu_init import tpu_init
@@ -87,8 +89,14 @@ def main(argv=None) -> int:
     )
 
     ckpt = None
+    shard_srv = None
     if args.checkpoint_dir:
+        from tf_operator_tpu.bootstrap.heartbeat import (
+            ENV_PEER_RESTORE_ADDRS,
+            ENV_SHARD_SERVER,
+        )
         from tf_operator_tpu.train.checkpoint import CheckpointManager
+        from tf_operator_tpu.train.restore import restore_with_fallback
 
         ckpt_dir = args.checkpoint_dir
         if getattr(topo, "slice_world", False) and topo.num_slices > 1:
@@ -99,9 +107,33 @@ def main(argv=None) -> int:
         ckpt = CheckpointManager(
             ckpt_dir, sharding=sharding, model_meta=config.geometry()
         )
-        state, restored_step = ckpt.restore_latest(state)
-        if restored_step is not None:
-            print(f"[llama] resumed from step {restored_step}", flush=True)
+        # DURABILITY ORDERING: record_checkpoint fires ONLY from the
+        # persist-finalized callback, never after save() returns — save()
+        # only proves the host snapshot, and publishing a step whose
+        # persist is still in flight would let the operator's
+        # checkpoint-gated elastic shrink take workers away against a
+        # checkpoint a crash in the persist window erases.
+        ckpt.add_durability_listener(record_checkpoint)
+        peers = [
+            a for a in os.environ.get(ENV_PEER_RESTORE_ADDRS, "").split(",")
+            if a
+        ]
+        outcome = restore_with_fallback(state, ckpt, peers)
+        state = outcome.state
+        record_restore(outcome.path, outcome.cause, outcome.seconds)
+        if outcome.step is not None:
+            print(
+                f"[llama] resumed from step {outcome.step} "
+                f"via {outcome.path} ({outcome.cause})",
+                flush=True,
+            )
+        if os.environ.get(ENV_SHARD_SERVER) in ("1", "true", "yes"):
+            # Serve this rank's host snapshot to restoring peers and
+            # advertise the address on the heartbeat lease.
+            from tf_operator_tpu.runtime.shard_server import start_shard_server
+
+            shard_srv = start_shard_server(ckpt)
+            record_peer_address(shard_srv.address)
 
     if args.batch % topo.num_processes:
         raise SystemExit("--batch must divide by the process count")
@@ -152,36 +184,41 @@ def main(argv=None) -> int:
     batches = DevicePrefetch(data, data_spec, depth=2)
 
     t0 = time.perf_counter()
-    for step in range(start_step, args.steps):
-        state, loss = step_fn(state, next(batches))
-        # XLA trace capture when TPU_PROFILE_DIR is set (no-op otherwise).
-        step_profiler(step)
-        if step % args.log_every == 0 or step == args.steps - 1:
-            dt = time.perf_counter() - t0
-            done = step - start_step + 1
-            tps = done * args.batch * args.seq / max(dt, 1e-9)
-            print(
-                f"[llama] step {step} loss {float(loss):.4f} "
-                f"tokens/sec {tps:,.0f} ({tps / max(n,1):,.0f}/chip)",
-                flush=True,
-            )
-            # Surface throughput to the operator (gang liveness already
-            # rides the heartbeat; this adds the utilization signal the
-            # autoscaler consumes as training_workload_tokens_per_sec).
-            # Log-cadence, not per-step: each call wakes the renewal
-            # thread, and a lease write per step would be apiserver spam.
-            record_progress(step=step, tokens_per_sec=tps)
-        if ckpt is not None and (step + 1) % args.checkpoint_every == 0:
-            ckpt.save(state)
-            # The save returned = the checkpoint is durable: publish the
-            # step so a checkpoint-coordinated elastic shrink (the
-            # operator's autoscaler) knows it may now take workers away
-            # without losing more than one checkpoint interval.
-            record_checkpoint(step)
-    if ckpt is not None:
-        ckpt.save(state, force=True)
-        record_checkpoint(args.steps - 1)
-        ckpt.close()
+    try:
+        for step in range(start_step, args.steps):
+            state, loss = step_fn(state, next(batches))
+            # XLA trace capture when TPU_PROFILE_DIR is set (no-op otherwise).
+            step_profiler(step)
+            if step % args.log_every == 0 or step == args.steps - 1:
+                dt = time.perf_counter() - t0
+                done = step - start_step + 1
+                tps = done * args.batch * args.seq / max(dt, 1e-9)
+                print(
+                    f"[llama] step {step} loss {float(loss):.4f} "
+                    f"tokens/sec {tps:,.0f} ({tps / max(n,1):,.0f}/chip)",
+                    flush=True,
+                )
+                # Surface throughput to the operator (gang liveness already
+                # rides the heartbeat; this adds the utilization signal the
+                # autoscaler consumes as training_workload_tokens_per_sec).
+                # Log-cadence, not per-step: each call wakes the renewal
+                # thread, and a lease write per step would be apiserver spam.
+                record_progress(step=step, tokens_per_sec=tps)
+            if ckpt is not None and (step + 1) % args.checkpoint_every == 0:
+                # Synchronous device->host snapshot only; the persist runs
+                # in the background and the durability listener publishes
+                # the step once — and only once — it is finalized.
+                ckpt.save(state)
+        if ckpt is not None:
+            ckpt.save(state, force=True)
+    finally:
+        # Shutdown hygiene: drain the persist queue and close orbax on
+        # EVERY exit path — a completing (or dying) job must never leave
+        # an in-flight async write behind as a torn tmp dir.
+        if ckpt is not None:
+            ckpt.close()
+        if shard_srv is not None:
+            shard_srv.stop()
     print("[llama] done", flush=True)
     return 0
 

@@ -2216,6 +2216,548 @@ def elasticity_main(smoke=False) -> int:
     return rc
 
 
+# ------------------------------------------------------------------ recovery
+RECOVERY_BASELINE_PATH = os.path.join(
+    REPO, "build", "recovery_smoke_last.json")
+RECOVERY_LAYERS = 24
+RECOVERY_DIM = 512
+RECOVERY_STEP = 7
+RECOVERY_TRIALS = 3
+# The storage legs run against local disk (tmpfs in CI), which under-prices
+# a real checkpoint bucket by orders of magnitude. The modeled storage
+# figure charges each on-disk checkpoint object one remote-GET round trip
+# and the total bytes at a sustained single-stream object-store read rate —
+# the published shape of GCS/S3 reads. The peer leg is in-cluster traffic
+# and is never modeled: beating MODELED storage is the claim the peer path
+# exists to make, and the raw numbers ride along in the JSON for audit.
+RECOVERY_REMOTE_RTT_S = 0.015
+RECOVERY_REMOTE_BW_BPS = 250e6
+RECOVERY_REGRESSION = 2.0  # ratchet tolerance vs the last green run
+
+
+def _recovery_state(step=RECOVERY_STEP, fill="random"):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_operator_tpu.train.train_step import TrainState
+
+    if fill == "random":
+        rng = np.random.default_rng(0)
+
+        def leaf(_i):
+            return jnp.asarray(rng.standard_normal(
+                (RECOVERY_DIM, RECOVERY_DIM)).astype(np.float32))
+    else:
+        def leaf(_i):
+            return jnp.zeros((RECOVERY_DIM, RECOVERY_DIM), jnp.float32)
+
+    return TrainState(
+        step=jnp.asarray(step, jnp.int32),
+        params={f"layer{i}": {"w": leaf(i)}
+                for i in range(RECOVERY_LAYERS)},
+        opt_state={
+            f"layer{i}": {"m": jnp.zeros(
+                (RECOVERY_DIM, RECOVERY_DIM), jnp.float32)}
+            for i in range(RECOVERY_LAYERS)
+        },
+    )
+
+
+def _trees_equal(a, b) -> bool:
+    import jax
+    import numpy as np
+
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        bool(np.array_equal(np.asarray(x), np.asarray(y)))
+        for x, y in zip(la, lb)
+    )
+
+
+def _storage_objects(directory, step):
+    """(object count, total bytes) of one orbax step dir — the inputs to
+    the modeled remote-read penalty."""
+    objects = 0
+    total = 0
+    for root, _dirs, files in os.walk(os.path.join(directory, str(step))):
+        for f in files:
+            objects += 1
+            try:
+                total += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                pass
+    return objects, total
+
+
+def _recovery_latency_leg(state, fresh, ckpt_dir, server, regressions):
+    """Leg A: storage-vs-peer restore latency on the same durable
+    checkpoint, byte-equality enforced on both paths."""
+    from tf_operator_tpu.train.checkpoint import CheckpointManager
+    from tf_operator_tpu.train.restore import http_fetch, restore_with_fallback
+
+    # Steady state for a survivor is a warmed snapshot view (the
+    # durability hook builds it at save time); one priming meta round
+    # makes the bench independent of that thread's scheduling.
+    for _ in range(200):
+        try:
+            status, _, _ = http_fetch(server.address, "/v1/meta", 5.0)
+        except OSError:
+            status = 0
+        if status == 200:
+            break
+        time.sleep(0.01)
+
+    storage_s, peer_s = [], []
+    mgr = CheckpointManager(ckpt_dir)
+    try:
+        for trial in range(RECOVERY_TRIALS):
+            o_storage = restore_with_fallback(fresh, mgr, [])
+            o_peer = restore_with_fallback(fresh, mgr, [server.address])
+            storage_s.append(o_storage.seconds)
+            peer_s.append(o_peer.seconds)
+            if trial == 0:
+                if o_storage.path != "storage" or o_storage.step != RECOVERY_STEP:
+                    regressions.append(
+                        f"storage restore landed on {o_storage.path}/"
+                        f"{o_storage.step}, wanted storage/{RECOVERY_STEP}")
+                elif not _trees_equal(o_storage.state, state):
+                    regressions.append(
+                        "storage-restored state differs from the saved state")
+                if (o_peer.path, o_peer.cause) != ("peer", "ok") or \
+                        o_peer.step != RECOVERY_STEP:
+                    regressions.append(
+                        f"peer restore landed on {o_peer.path}/"
+                        f"{o_peer.cause}/{o_peer.step}, wanted "
+                        f"peer/ok/{RECOVERY_STEP}")
+                elif not _trees_equal(o_peer.state, state):
+                    regressions.append(
+                        "peer-restored state differs from the saved state")
+    finally:
+        mgr.close()
+
+    objects, obj_bytes = _storage_objects(ckpt_dir, RECOVERY_STEP)
+    storage_raw = statistics.median(storage_s)
+    remote_penalty = (objects * RECOVERY_REMOTE_RTT_S
+                      + obj_bytes / RECOVERY_REMOTE_BW_BPS)
+    return {
+        "storage_raw_s": round(storage_raw, 4),
+        "storage_modeled_s": round(storage_raw + remote_penalty, 4),
+        "storage_objects": objects,
+        "storage_bytes": obj_bytes,
+        "remote_model": {"rtt_s": RECOVERY_REMOTE_RTT_S,
+                         "bw_bps": RECOVERY_REMOTE_BW_BPS},
+        "peer_s": round(statistics.median(peer_s), 4),
+        "trials": RECOVERY_TRIALS,
+    }
+
+
+# (label, fault kwargs, expected degradation cause) — each scenario must
+# complete on storage at the durable step, twice, with byte-equal fault
+# logs across the two seeded runs.
+RECOVERY_FAULT_SCENARIOS = (
+    ("peer-down-mid-fetch",
+     {"kind": "refuse", "op": "shard", "at_call": 1, "count": 999},
+     "peer-unreachable"),
+    ("truncated-shard",
+     {"kind": "truncate", "op": "shard-body", "at_call": 1, "count": 1},
+     "checksum-mismatch"),
+    ("stale-snapshot",
+     {"kind": "stale-meta", "op": "meta-body", "at_call": 1, "count": 1},
+     "stale-snapshot"),
+)
+
+
+def _recovery_fault_leg(fresh, ckpt_dir, server, regressions):
+    """Leg B: the seeded degraded-fallback ladder. Every scenario ends on
+    storage at the durable step, and replaying the same seed yields a
+    byte-identical fault log."""
+    from tf_operator_tpu.cluster.chaos import (
+        ChaosCluster,
+        ChaosSpec,
+        ScheduledRestoreFault,
+    )
+    from tf_operator_tpu.cluster.memory import InMemoryCluster
+    from tf_operator_tpu.train.checkpoint import CheckpointManager
+    from tf_operator_tpu.train.restore import restore_with_fallback
+
+    results = []
+    mgr = CheckpointManager(ckpt_dir)
+    try:
+        for label, fault_kwargs, want_cause in RECOVERY_FAULT_SCENARIOS:
+            logs = []
+            outcome = None
+            for _run in range(2):
+                chaos = ChaosCluster(InMemoryCluster(), ChaosSpec(
+                    seed=11,
+                    restore_faults=(ScheduledRestoreFault(**fault_kwargs),),
+                ))
+                outcome = restore_with_fallback(
+                    fresh, mgr, [server.address],
+                    fault_injector=chaos.restore_fault_injector(),
+                    sleep=lambda _s: None,
+                )
+                logs.append(list(chaos.fault_log))
+            if (outcome.path, outcome.cause, outcome.step) != (
+                    "storage", want_cause, RECOVERY_STEP):
+                regressions.append(
+                    f"fault scenario {label}: got {outcome.path}/"
+                    f"{outcome.cause}/{outcome.step}, wanted "
+                    f"storage/{want_cause}/{RECOVERY_STEP}")
+            if logs[0] != logs[1]:
+                regressions.append(
+                    f"fault scenario {label}: seeded replay diverged "
+                    f"({logs[0]} vs {logs[1]})")
+            if not logs[0]:
+                regressions.append(
+                    f"fault scenario {label}: no fault fired — the "
+                    "scenario is vacuous")
+            results.append({"scenario": label, "cause": outcome.cause,
+                            "fault_log": logs[0]})
+    finally:
+        mgr.close()
+    return results
+
+
+def _recovery_operator_run(seed):
+    """One seeded operator run: a 2x2 multislice gang under peer-restore,
+    slice 1 preempted mid-training after the survivors advertised their
+    shard servers; the rebuilt pods must come up with the survivor
+    addresses in their env and the job must recover and complete."""
+    from tf_operator_tpu.cluster.chaos import ChaosCluster, ChaosSpec
+    from tf_operator_tpu.cluster.memory import InMemoryCluster
+    from tf_operator_tpu.controllers.jax import JAXController
+    from tf_operator_tpu.core import constants
+    from tf_operator_tpu.core.job_controller import EngineOptions
+    from tf_operator_tpu.core.tracing import Tracer
+    from tf_operator_tpu.runtime import heartbeat as hb
+
+    slices, hosts = 2, 2
+    total = slices * hosts
+    survivor_addrs = {}
+    inner = InMemoryCluster()
+    chaos = ChaosCluster(inner, ChaosSpec(seed=seed))
+    metrics = Metrics()
+    tracer = Tracer()
+    controller = JAXController(
+        chaos, metrics=metrics, tracer=tracer,
+        options=EngineOptions(peer_restore=True),
+    )
+    inner.create_job({
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "JAXJob",
+        "metadata": {"name": "rec", "namespace": "default"},
+        "spec": {
+            "numSlices": slices,
+            "runPolicy": {"backoffLimit": 0,
+                          "progressDeadlineSeconds": 300},
+            "jaxReplicaSpecs": {"Worker": {
+                "replicas": total,
+                "template": {"spec": {"containers": [
+                    {"name": "jax", "image": "test:1"}]}},
+            }},
+        },
+    })
+    state = {"preempted": False, "reported": False, "finished": False}
+
+    def beat(pod_name, index, restore=None):
+        hb.publish_heartbeat(
+            inner, "default", constants.heartbeat_lease_name(pod_name),
+            identity=pod_name, step=RECOVERY_STEP, tokens_per_sec=100.0,
+            checkpoint_step=RECOVERY_STEP,
+            peer_addr=f"10.0.{index}.1:8470", restore=restore,
+        )
+
+    def slice_pods(index):
+        return sorted(
+            (p for p in inner.list_pods("default",
+                                        labels={"job-name": "rec"})
+             if p.metadata.labels.get("tpu-slice-index") == str(index)
+             and p.metadata.deletion_timestamp is None),
+            key=lambda p: p.metadata.name,
+        )
+
+    def drive():
+        for p in inner.list_pods("default"):
+            if p.status.phase == "Pending":
+                inner.set_pod_phase("default", p.metadata.name, "Running")
+        running = [p for p in inner.list_pods("default")
+                   if p.status.phase == "Running"
+                   and p.metadata.deletion_timestamp is None]
+        if not state["preempted"] and len(running) == total:
+            for i, p in enumerate(slice_pods(0)):
+                beat(p.metadata.name, i)
+                survivor_addrs[p.metadata.name] = f"10.0.{i}.1:8470"
+            state["preempted"] = True
+            chaos.preempt_slice(job_name="rec", slice_index=1,
+                                namespace="default")
+        elif state["preempted"] and len(running) == total:
+            if not state["reported"]:
+                # The rebuilt rank reports how it came back; the rider
+                # lands on the controller's restore-observed hook.
+                beat(slice_pods(1)[0].metadata.name, 9,
+                     restore="peer:ok:0.412")
+                state["reported"] = True
+                return
+            for p in running:
+                inner.set_pod_phase("default", p.metadata.name,
+                                    "Succeeded", exit_code=0)
+            state["finished"] = True
+
+    def conds():
+        job = inner.get_job("JAXJob", "default", "rec")
+        return {c["type"]: c for c in
+                (job.get("status") or {}).get("conditions") or []}
+
+    converged = False
+    for _ in range(400):
+        controller.run_until_idle()
+        if state["finished"] and conds().get(
+                "Succeeded", {}).get("status") == "True":
+            converged = True
+            break
+        drive()
+        controller.queue.add("JAXJob:default/rec")
+        time.sleep(0.002)
+
+    def pod_env(pod):
+        containers = getattr(pod.spec, "containers", None) or []
+        if not containers:
+            return {}
+        return {e.name: e.value for e in containers[0].env}
+
+    rebuilt_env = [pod_env(p) for p in slice_pods(1)]
+    return {
+        "converged": converged,
+        "fault_log": list(chaos.fault_log),
+        "survivor_addrs": sorted(survivor_addrs.values()),
+        "rebuilt_env": rebuilt_env,
+        "inner": inner,
+        "tracer": tracer,
+        "metrics": metrics,
+    }
+
+
+def _recovery_operator_leg(regressions):
+    """Leg C: operator-side peer discovery + exactly-once recovery
+    ledgers + seeded byte-identical replay."""
+    from tf_operator_tpu.bootstrap import heartbeat as hb_bootstrap
+    from tf_operator_tpu.testing.invariants import assert_invariants
+
+    first = _recovery_operator_run(seed=23)
+    second = _recovery_operator_run(seed=23)
+    if not first["converged"]:
+        regressions.append("operator leg did not converge to Succeeded")
+    if first["fault_log"] != second["fault_log"]:
+        regressions.append(
+            "operator leg seeded replay diverged: "
+            f"{first['fault_log']} vs {second['fault_log']}")
+    want = sorted(first["survivor_addrs"])
+    for env in first["rebuilt_env"]:
+        addrs = sorted((env.get(
+            hb_bootstrap.ENV_PEER_RESTORE_ADDRS) or "").split(","))
+        if env.get(hb_bootstrap.ENV_SHARD_SERVER) != "1":
+            regressions.append(
+                "rebuilt pod missing the shard-server enable env")
+            break
+        if addrs != want:
+            regressions.append(
+                f"rebuilt pod peer env {addrs} != survivors {want}")
+            break
+    if not first["rebuilt_env"]:
+        regressions.append("operator leg rebuilt no slice-1 pods")
+    if first["metrics"].labeled_counter_value(
+            "training_restore_total", "peer", "ok") < 1:
+        regressions.append(
+            "restore-outcome rider did not land on training_restore_total")
+    try:
+        assert_invariants(
+            first["inner"], kinds=("JAXJob",),
+            expect_ledgers={
+                "disruptionCounts": {"Worker": 1},
+                "restartCounts": {},
+                "stallCounts": {},
+                "sliceRestartCounts": {"1": 1},
+            },
+            tracer=first["tracer"],
+            label="recovery_operator_leg",
+        )
+    except AssertionError as err:
+        regressions.append(f"operator exactly-once ledgers: {err}")
+    return {
+        "converged": first["converged"],
+        "fault_log": first["fault_log"],
+        "survivors": want,
+        "rebuilt_pods": len(first["rebuilt_env"]),
+    }
+
+
+_RECOVERY_RESTART_CHILD = r"""
+import json, sys, time
+t0 = time.perf_counter()
+import jax.numpy as jnp
+from tf_operator_tpu.train.checkpoint import CheckpointManager
+from tf_operator_tpu.train.restore import restore_with_fallback
+from tf_operator_tpu.train.train_step import TrainState
+layers, dim, ckpt_dir = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+peers = [a for a in sys.argv[4].split(",") if a]
+state = TrainState(
+    step=jnp.zeros((), jnp.int32),
+    params={f"layer{i}": {"w": jnp.zeros((dim, dim), jnp.float32)}
+            for i in range(layers)},
+    opt_state={f"layer{i}": {"m": jnp.zeros((dim, dim), jnp.float32)}
+               for i in range(layers)},
+)
+mgr = CheckpointManager(ckpt_dir)
+outcome = restore_with_fallback(state, mgr, peers)
+mgr.close()
+print(json.dumps({
+    "step": outcome.step, "path": outcome.path, "cause": outcome.cause,
+    "restore_s": round(outcome.seconds, 4),
+    "interp_to_resumed_s": round(time.perf_counter() - t0, 3),
+}))
+"""
+
+
+def _recovery_restart_leg(ckpt_dir, peer_address, regressions):
+    """Leg D: kill->restart->step-resumed, end to end — a fresh
+    interpreter (the restarted rank) restores via storage and via a live
+    peer. The delta between the two totals is the recovery win; the
+    shared floor (spawn + imports + init) rides along honestly."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    def one(peers_csv):
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, "-c", _RECOVERY_RESTART_CHILD,
+             str(RECOVERY_LAYERS), str(RECOVERY_DIM), ckpt_dir, peers_csv],
+            capture_output=True, text=True, env=env, timeout=300,
+        )
+        total = time.perf_counter() - t0
+        if proc.returncode != 0:
+            regressions.append(
+                "restart child failed: "
+                + (proc.stderr or "").strip().splitlines()[-1:][0]
+                if proc.stderr else "restart child failed with no stderr")
+            return None
+        data = json.loads(proc.stdout.strip().splitlines()[-1])
+        data["restart_to_resumed_s"] = round(total, 3)
+        return data
+
+    storage = one("")
+    peer = one(peer_address)
+    for label, leg, want_path in (("storage", storage, "storage"),
+                                  ("peer", peer, "peer")):
+        if leg is None:
+            continue
+        if leg["path"] != want_path or leg["step"] != RECOVERY_STEP:
+            regressions.append(
+                f"restart {label} leg resumed via {leg['path']} at step "
+                f"{leg['step']}, wanted {want_path}/{RECOVERY_STEP}")
+    return {"storage": storage, "peer": peer}
+
+
+def recovery_main(smoke=False) -> int:
+    """--mode recovery: the fast-recovery plane head-to-head. Leg A times
+    storage-vs-peer restore on one durable checkpoint (peer must beat the
+    MODELED remote storage read — see RECOVERY_REMOTE_* for the model);
+    leg B replays the seeded degraded-fallback ladder byte-identically;
+    leg C proves operator-side peer discovery with exactly-once recovery
+    ledgers; leg D measures kill->restart->step-resumed wall clock in a
+    fresh interpreter. --smoke gates all of it and ratchets the margins
+    via build/recovery_smoke_last.json."""
+    import shutil
+    import tempfile
+
+    from tf_operator_tpu.runtime.shard_server import start_shard_server
+    from tf_operator_tpu.train.checkpoint import CheckpointManager
+
+    regressions = []
+    workdir = tempfile.mkdtemp(prefix="recovery-bench-")
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    state = _recovery_state()
+    fresh = _recovery_state(step=0, fill="zeros")
+    mgr = CheckpointManager(ckpt_dir)
+    server = start_shard_server(mgr)
+    try:
+        t0 = time.perf_counter()
+        mgr.save(state, force=True)
+        snapshot_stall_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        mgr.wait()
+        persist_s = snapshot_stall_s + (time.perf_counter() - t0)
+        if mgr.last_durable_step() != RECOVERY_STEP:
+            regressions.append(
+                f"save did not become durable at step {RECOVERY_STEP} "
+                f"(last_durable_step={mgr.last_durable_step()})")
+
+        latency = _recovery_latency_leg(
+            state, fresh, ckpt_dir, server, regressions)
+        faults = _recovery_fault_leg(fresh, ckpt_dir, server, regressions)
+        operator = _recovery_operator_leg(regressions)
+        restart = _recovery_restart_leg(
+            ckpt_dir, server.address, regressions)
+    finally:
+        server.stop()
+        mgr.close()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    speedup = round(
+        latency["storage_modeled_s"] / max(latency["peer_s"], 1e-9), 3)
+    if smoke:
+        if latency["peer_s"] >= latency["storage_modeled_s"]:
+            regressions.append(
+                f"peer restore ({latency['peer_s']}s) did not beat "
+                f"modeled remote storage "
+                f"({latency['storage_modeled_s']}s)")
+        if snapshot_stall_s >= persist_s:
+            regressions.append(
+                f"snapshot stall ({snapshot_stall_s:.3f}s) not below the "
+                f"full persist ({persist_s:.3f}s) — the async split "
+                "bought nothing")
+        prev = _read_baseline(RECOVERY_BASELINE_PATH)
+        prev_peer = prev.get("peer_restore_s")
+        if prev_peer and latency["peer_s"] > (
+                prev_peer * RECOVERY_REGRESSION):
+            regressions.append(
+                f"peer restore {latency['peer_s']}s regressed >"
+                f"{RECOVERY_REGRESSION}x vs previous run ({prev_peer}s)")
+        prev_speedup = prev.get("speedup")
+        if prev_speedup and speedup < (prev_speedup / RECOVERY_REGRESSION):
+            regressions.append(
+                f"peer-vs-storage speedup {speedup}x regressed >"
+                f"{RECOVERY_REGRESSION}x vs previous run "
+                f"({prev_speedup}x)")
+
+    out = {
+        "mode": "recovery",
+        "smoke": smoke,
+        "snapshot_stall_s": round(snapshot_stall_s, 4),
+        "persist_s": round(persist_s, 4),
+        "latency": latency,
+        "speedup_vs_modeled_storage": speedup,
+        "faults": faults,
+        "operator": operator,
+        "restart": restart,
+        "regression": "; ".join(regressions) or None,
+    }
+    rc = 1 if (smoke and regressions) else 0
+    if smoke and rc == 0:
+        _merge_baseline(RECOVERY_BASELINE_PATH, {
+            "peer_restore_s": latency["peer_s"],
+            "storage_modeled_s": latency["storage_modeled_s"],
+            "speedup": speedup,
+            "snapshot_stall_s": round(snapshot_stall_s, 4),
+            "restart_to_resumed_peer_s": (
+                (restart.get("peer") or {}).get("restart_to_resumed_s")),
+        })
+    print(json.dumps(out))
+    return rc
+
+
 if __name__ == "__main__":
     import argparse
 
@@ -2225,7 +2767,7 @@ if __name__ == "__main__":
                         default="process")
     parser.add_argument("--mode",
                         choices=("latency", "scale", "contention",
-                                 "elasticity"),
+                                 "elasticity", "recovery"),
                         default="latency")
     parser.add_argument("--smoke", action="store_true",
                         help="scale mode: fast CI check (32-replica-gang "
@@ -2295,6 +2837,8 @@ if __name__ == "__main__":
         sys.exit(contention_main(smoke=args.smoke, policy=args.policy))
     if args.mode == "elasticity":
         sys.exit(elasticity_main(smoke=args.smoke))
+    if args.mode == "recovery":
+        sys.exit(recovery_main(smoke=args.smoke))
     if (args.workers or args.replicas) and args.mode != "scale":
         # Dropping the flag would hand back a plausible-looking JSON
         # object for the wrong experiment.

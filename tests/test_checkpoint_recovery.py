@@ -1,0 +1,518 @@
+"""Fast-recovery plane, workload side (docs/design/checkpoint_recovery.md).
+
+Four suites:
+
+- TestDurabilityBarrier — the async-save ordering contract: durability
+  listeners fire only after the background persist FINALIZES, a crash in
+  the persist window leaves the step non-durable (resume lands on the
+  previous checkpoint), and the autoscaler's fresh-checkpoint shrink gate
+  can never observe a non-durable step when the checkpoint rider is fed
+  from the listener (the llama_train.py wiring).
+- TestShutdownHygiene — close()/wait() drain semantics on every exit path.
+- TestShardServer — the peer-restore wire: meta/shard/bundle endpoints,
+  checksums, step rotation, no-snapshot.
+- TestRestoreLadder — validation edges: corrupt and truncated shards are
+  rejected by checksum (degrade to storage), a peer geometry mismatch
+  HARD-fails (never a silent fallback), and peer-vs-storage staleness
+  arbitration picks the newer step.
+
+Plus the heartbeat riders (peer-address + restore-outcome annotations,
+sink arity compatibility) and the new persist/restore metrics.
+"""
+
+import json
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tf_operator_tpu.cluster.memory import InMemoryCluster
+from tf_operator_tpu.core import constants
+from tf_operator_tpu.core.autoscaler import AutoscalerConfig, decide
+from tf_operator_tpu.metrics import Metrics
+from tf_operator_tpu.runtime import heartbeat as hb
+from tf_operator_tpu.runtime.shard_server import (
+    SnapshotShardServer,
+    decode_shard,
+    parse_bundle,
+    shard_checksum,
+    start_shard_server,
+)
+from tf_operator_tpu.train.checkpoint import CheckpointManager, HostSnapshot
+from tf_operator_tpu.train.restore import (
+    ChecksumMismatch,
+    GeometryMismatch,
+    http_fetch,
+    restore_with_fallback,
+)
+from tf_operator_tpu.train.train_step import TrainState
+
+
+def make_state(step=5, scale=1.0):
+    return TrainState(
+        step=jnp.asarray(step, jnp.int32),
+        params={"w": jnp.full((4, 4), scale, jnp.float32)},
+        opt_state={"m": jnp.full((4, 4), scale * 2, jnp.float32)},
+    )
+
+
+def leaves_equal(a, b):
+    import jax
+
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        bool(np.array_equal(np.asarray(x), np.asarray(y)))
+        for x, y in zip(la, lb)
+    )
+
+
+# ------------------------------------------------------- durability barrier
+class TestDurabilityBarrier:
+    def test_listener_fires_only_after_persist_finalized(self, tmp_path):
+        """save() returning proves the snapshot, not durability: while the
+        background persist is held at the gate, the listener has not fired
+        and nothing is on disk; both happen only at finalize."""
+        durable = []
+        gate = threading.Event()
+        with CheckpointManager(str(tmp_path / "ckpt")) as mgr:
+            mgr.add_durability_listener(durable.append)
+            mgr._persist_gate = lambda step: gate.wait(timeout=30)
+            assert mgr.save(make_state(step=5), force=True)
+            # Training "resumed": the snapshot exists and is servable...
+            assert mgr.host_snapshot() is not None
+            assert mgr.host_snapshot().step == 5
+            # ...but the step is NOT durable and was NOT published.
+            assert durable == []
+            assert mgr.last_durable_step() is None
+            assert mgr.latest_step() is None
+            gate.set()
+            mgr.wait()
+            assert durable == [5]
+            assert mgr.last_durable_step() == 5
+            assert mgr.latest_step() == 5
+
+    def test_crash_in_persist_window_resumes_on_previous_checkpoint(
+            self, tmp_path):
+        """Kill between snapshot and finalize: the newer step never lands
+        on storage, is never published, and a restarted rank resumes on
+        the previous durable checkpoint."""
+        durable = []
+        d = str(tmp_path / "ckpt")
+        with CheckpointManager(d) as mgr:
+            mgr.add_durability_listener(durable.append)
+            assert mgr.save(make_state(step=5, scale=1.0), force=True)
+            mgr.wait()
+            assert durable == [5]
+
+            def crash(step):
+                raise OSError("simulated crash in the persist window")
+
+            mgr._persist_gate = crash
+            assert mgr.save(make_state(step=10, scale=9.0), force=True)
+            mgr.wait()
+            # The persist died: step 10 is not durable, not on disk, and
+            # the listener never saw it.
+            assert durable == [5]
+            assert mgr.last_durable_step() == 5
+            assert mgr.latest_step() == 5
+            assert mgr._persist_errors == 1
+        # The restarted rank lands on step 5 with step-5 bytes.
+        with CheckpointManager(d) as fresh_mgr:
+            restored, step = fresh_mgr.restore_latest(make_state(step=0))
+            assert step == 5
+            assert leaves_equal(restored.params, make_state(scale=1.0).params)
+
+    def test_autoscaler_gate_never_sees_a_non_durable_step(self, tmp_path):
+        """The regression the durability fix exists for: feed the shrink
+        gate's checkpoint rider from the durability listener (the
+        llama_train.py wiring) and a crash-in-persist-window step can
+        never credit a shrink — while the OLD wiring (publish after
+        save() returns) would have."""
+        from test_autoscaler import CFG, state, view
+
+        published = []
+        with CheckpointManager(str(tmp_path / "ckpt")) as mgr:
+            mgr.add_durability_listener(published.append)
+            mgr.save(make_state(step=5), force=True)
+            mgr.wait()
+            mgr._persist_gate = lambda step: (_ for _ in ()).throw(
+                OSError("persist crashed"))
+            mgr.save(make_state(step=10), force=True)
+            mgr.wait()
+            snapshot_step = mgr.host_snapshot().step
+        assert published == [5] and snapshot_step == 10
+
+        pending = {"JAXJob:default/e0": (2, 5)}  # baseline: step 5 seen
+        # Listener-fed rider: the gate observes only the durable step —
+        # no fresh checkpoint, shrink stays blocked.
+        s = state([view(slices=3, ckpt=max(published))],
+                  free=0.0, queue_depth=1, pending=pending)
+        d = decide(s, CFG)
+        assert d.actions == []
+        assert ("JAXJob:default/e0", "no-fresh-checkpoint") in d.blocked
+        # The old publish-after-save() wiring would have advertised the
+        # snapshot step and credited a shrink against bytes that do not
+        # exist — exactly what the barrier forbids.
+        s = state([view(slices=3, ckpt=snapshot_step)],
+                  free=0.0, queue_depth=1, pending=pending)
+        d = decide(s, CFG)
+        assert len(d.actions) == 1
+        assert d.actions[0].credited_checkpoint == 10
+
+    def test_sync_mode_is_durable_on_return(self, tmp_path):
+        durable = []
+        with CheckpointManager(str(tmp_path / "c"), async_persist=False) as m:
+            m.add_durability_listener(durable.append)
+            assert m.save(make_state(step=3), force=True)
+            assert durable == [3]
+            assert m.last_durable_step() == 3
+
+    def test_duplicate_step_save_is_a_noop(self, tmp_path):
+        with CheckpointManager(str(tmp_path / "c")) as mgr:
+            assert mgr.save(make_state(step=4), force=True)
+            assert not mgr.save(make_state(step=4), force=True)
+            mgr.wait()
+            assert not mgr.save(make_state(step=4), force=True)
+
+
+# --------------------------------------------------------- shutdown hygiene
+class TestShutdownHygiene:
+    def test_close_drains_inflight_persist(self, tmp_path):
+        durable = []
+        mgr = CheckpointManager(str(tmp_path / "c"))
+        mgr.add_durability_listener(durable.append)
+        mgr.save(make_state(step=7), force=True)
+        mgr.close()  # no wait() first: close owns the drain
+        assert durable == [7]
+        assert mgr.latest_step() == 7
+
+    def test_close_is_idempotent_and_context_managed(self, tmp_path):
+        with CheckpointManager(str(tmp_path / "c")) as mgr:
+            mgr.save(make_state(step=2), force=True)
+        mgr.close()  # second close: no-op, no raise
+        assert mgr.latest_step() == 2
+
+    def test_close_runs_on_error_paths(self, tmp_path):
+        with pytest.raises(RuntimeError, match="训"):
+            with CheckpointManager(str(tmp_path / "c")) as mgr:
+                mgr.save(make_state(step=9), force=True)
+                raise RuntimeError("训")  # mid-training crash
+        assert mgr.latest_step() == 9  # the in-flight write was not torn
+
+
+# --------------------------------------------------------------- wire level
+@pytest.fixture()
+def snapshot_server():
+    snap = {"value": None}
+    server = SnapshotShardServer(lambda: snap["value"]).start()
+    yield snap, server
+    server.stop()
+
+
+class TestShardServer:
+    def test_meta_503_before_any_snapshot(self, snapshot_server):
+        _snap, server = snapshot_server
+        status, _, body = http_fetch(server.address, "/v1/meta", 5.0)
+        assert status == 503
+        assert json.loads(body)["error"] == "no-snapshot"
+
+    def test_meta_and_shard_roundtrip(self, snapshot_server):
+        snap, server = snapshot_server
+        tree = {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+        snap["value"] = HostSnapshot(step=4, tree=tree,
+                                     model_meta={"heads": 16})
+        status, _, body = http_fetch(server.address, "/v1/meta", 5.0)
+        assert status == 200
+        meta = json.loads(body)
+        assert meta["step"] == 4
+        assert meta["model_meta"] == {"heads": 16}
+        (name, info), = meta["shards"].items()
+        assert info["dtype"] == "float32" and info["shape"] == [2, 3]
+        from urllib.parse import quote
+
+        status, headers, payload = http_fetch(
+            server.address, f"/v1/shard/{quote(name)}?step=4", 5.0)
+        assert status == 200
+        assert headers["X-Checksum"] == info["checksum"]
+        assert shard_checksum(payload) == info["checksum"]
+        assert np.array_equal(decode_shard(payload), tree["w"])
+
+    def test_shard_409_on_rotated_step_and_404_on_unknown(
+            self, snapshot_server):
+        snap, server = snapshot_server
+        snap["value"] = HostSnapshot(step=9, tree={"w": np.ones(2)})
+        status, _, body = http_fetch(
+            server.address, "/v1/shard/%5B'w'%5D?step=4", 5.0)
+        assert status == 409
+        assert json.loads(body)["step"] == 9
+        status, _, _ = http_fetch(
+            server.address, "/v1/shard/nope?step=9", 5.0)
+        assert status == 404
+
+    def test_bundle_roundtrip_and_rotation(self, snapshot_server):
+        snap, server = snapshot_server
+        tree = {"a": np.ones((2, 2), np.float32),
+                "b": np.full((3,), 7, np.int32)}
+        snap["value"] = HostSnapshot(step=6, tree=tree)
+        status, _, meta_body = http_fetch(server.address, "/v1/meta", 5.0)
+        meta = json.loads(meta_body)
+        status, headers, body = http_fetch(
+            server.address, "/v1/bundle?step=6", 5.0)
+        assert status == 200
+        assert headers["X-Step"] == "6"
+        frames = parse_bundle(body)
+        assert sorted(frames) == sorted(meta["shards"])
+        for name, payload in frames.items():
+            assert shard_checksum(payload) == meta["shards"][name]["checksum"]
+        status, _, _ = http_fetch(server.address, "/v1/bundle?step=5", 5.0)
+        assert status == 409
+
+    def test_parse_bundle_rejects_truncation(self, snapshot_server):
+        snap, server = snapshot_server
+        snap["value"] = HostSnapshot(step=1, tree={"w": np.ones(8)})
+        _, _, body = http_fetch(server.address, "/v1/bundle?step=1", 5.0)
+        with pytest.raises(OSError):
+            parse_bundle(body[: len(body) - 5])
+
+
+# ------------------------------------------------------------ restore ladder
+@pytest.fixture()
+def durable_ckpt(tmp_path):
+    """A manager with step 5 durable + a live shard server over it."""
+    mgr = CheckpointManager(str(tmp_path / "src"),
+                            model_meta={"heads": 16, "layers": 2})
+    server = start_shard_server(mgr)
+    mgr.save(make_state(step=5, scale=3.0), force=True)
+    mgr.wait()
+    yield mgr, server, tmp_path
+    server.stop()
+    mgr.close()
+
+
+class TestRestoreLadder:
+    def test_peer_path_restores_exact_bytes(self, durable_ckpt):
+        _mgr, server, tmp_path = durable_ckpt
+        restore_mgr = CheckpointManager(str(tmp_path / "dst"))
+        out = restore_with_fallback(
+            make_state(step=0, scale=0.0), restore_mgr, [server.address])
+        assert (out.path, out.cause, out.step) == ("peer", "ok", 5)
+        assert out.peer == server.address
+        assert leaves_equal(out.state, make_state(step=5, scale=3.0))
+        restore_mgr.close()
+
+    def test_no_peers_clean_storage(self, durable_ckpt):
+        mgr, _server, _ = durable_ckpt
+        out = restore_with_fallback(make_state(step=0), mgr, [])
+        assert (out.path, out.cause, out.step) == ("storage", "ok", 5)
+
+    def test_unreachable_peer_degrades_to_storage(self, durable_ckpt):
+        mgr, _server, _ = durable_ckpt
+        out = restore_with_fallback(
+            make_state(step=0), mgr, ["127.0.0.1:1"],
+            timeout=0.2, retries=1, backoff=0.0)
+        assert (out.path, out.cause, out.step) == (
+            "storage", "peer-unreachable", 5)
+
+    def test_corrupt_bundle_rejected_by_checksum(self, durable_ckpt):
+        """One flipped byte in flight: checksum rejects the shard and the
+        ladder degrades to storage with the corruption named."""
+        mgr, server, _ = durable_ckpt
+
+        def corrupting(peer, path, timeout):
+            status, headers, body = http_fetch(peer, path, timeout)
+            if path.startswith("/v1/bundle") and len(body) > 100:
+                body = body[:100] + bytes([body[100] ^ 0xFF]) + body[101:]
+            return status, headers, body
+
+        out = restore_with_fallback(
+            make_state(step=0), mgr, [server.address], fetcher=corrupting)
+        assert (out.path, out.cause, out.step) == (
+            "storage", "checksum-mismatch", 5)
+        assert leaves_equal(out.state, make_state(step=5, scale=3.0))
+
+    def test_truncated_shard_rejected_by_checksum(self, durable_ckpt):
+        """The seeded truncate fault on the per-shard wire — the chaos
+        tier's deterministic variant of in-flight damage."""
+        from tf_operator_tpu.cluster.chaos import (
+            RestoreFaultInjector,
+            ScheduledRestoreFault,
+        )
+
+        mgr, server, _ = durable_ckpt
+        log = []
+        inj = RestoreFaultInjector((ScheduledRestoreFault(
+            kind="truncate", op="shard-body", at_call=1, count=1),), log=log)
+        out = restore_with_fallback(
+            make_state(step=0), mgr, [server.address],
+            fault_injector=inj, sleep=lambda _s: None)
+        assert (out.path, out.cause) == ("storage", "checksum-mismatch")
+        assert log == ["restore:shard-body#1:truncate:peer0"]
+
+    def test_peer_geometry_mismatch_hard_fails(self, durable_ckpt, tmp_path):
+        """A peer serving a different head grouping is a config error:
+        HARD-FAIL, never a silent storage fallback (which would let a
+        mixed-geometry gang train)."""
+        _mgr, server, _ = durable_ckpt
+        restore_mgr = CheckpointManager(str(tmp_path / "other"))
+        with pytest.raises(GeometryMismatch, match="heads"):
+            restore_with_fallback(
+                make_state(step=0), restore_mgr, [server.address],
+                model_meta={"heads": 8, "layers": 2})
+        restore_mgr.close()
+
+    def test_assemble_shape_mismatch_hard_fails(self, durable_ckpt,
+                                                tmp_path):
+        """Meta passed (no sidecar recorded) but a shard's SHAPE differs
+        from the local state: still a hard geometry failure at assembly."""
+        _mgr, server, _ = durable_ckpt
+        restore_mgr = CheckpointManager(str(tmp_path / "other"))
+        wrong = TrainState(
+            step=jnp.asarray(0, jnp.int32),
+            params={"w": jnp.zeros((8, 8), jnp.float32)},
+            opt_state={"m": jnp.zeros((8, 8), jnp.float32)},
+        )
+        with pytest.raises(GeometryMismatch, match="shape"):
+            restore_with_fallback(wrong, restore_mgr, [server.address])
+        restore_mgr.close()
+
+    def test_stale_peer_loses_to_newer_storage(self, durable_ckpt,
+                                               tmp_path):
+        """Peer snapshot at step 5 but storage already finalized step 9:
+        arbitration picks storage and names the cause."""
+        _mgr, server, _ = durable_ckpt
+        newer = CheckpointManager(str(tmp_path / "newer"))
+        newer.save(make_state(step=9, scale=9.0), force=True)
+        newer.wait()
+        out = restore_with_fallback(
+            make_state(step=0), newer, [server.address])
+        assert (out.path, out.cause, out.step) == (
+            "storage", "stale-snapshot", 9)
+        assert leaves_equal(out.state, make_state(step=9, scale=9.0))
+        newer.close()
+
+    def test_newer_peer_beats_staler_storage_and_best_peer_wins(
+            self, durable_ckpt, tmp_path):
+        """Two peers at different steps + storage in between: the newest
+        peer (>= storage) wins."""
+        mgr, server5, _ = durable_ckpt
+        ahead = CheckpointManager(str(tmp_path / "ahead"))
+        server7 = start_shard_server(ahead)
+        try:
+            ahead.save(make_state(step=7, scale=7.0), force=True)
+            ahead.wait()
+            storage6 = CheckpointManager(str(tmp_path / "mid"))
+            storage6.save(make_state(step=6, scale=6.0), force=True)
+            storage6.wait()
+            out = restore_with_fallback(
+                make_state(step=0), storage6,
+                [server5.address, server7.address])
+            assert (out.path, out.cause, out.step) == ("peer", "ok", 7)
+            assert out.peer == server7.address
+            assert leaves_equal(out.state, make_state(step=7, scale=7.0))
+            storage6.close()
+        finally:
+            server7.stop()
+            ahead.close()
+
+    def test_first_boot_no_peers_no_storage(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "empty"))
+        initial = make_state(step=0)
+        out = restore_with_fallback(initial, mgr, [])
+        assert (out.path, out.step) == ("none", None)
+        assert out.state is initial
+        mgr.close()
+
+
+# ----------------------------------------------------------- heartbeat riders
+class TestHeartbeatRiders:
+    def test_publish_heartbeat_carries_peer_and_restore(self):
+        inner = InMemoryCluster()
+        assert hb.publish_heartbeat(
+            inner, "default", "p0-hb", identity="p0", step=3,
+            tokens_per_sec=8.0, checkpoint_step=2,
+            peer_addr="10.0.0.1:8470", restore="peer:ok:0.412")
+        ann = inner.get_lease("default", "p0-hb")["metadata"]["annotations"]
+        assert ann[constants.ANNOTATION_HEARTBEAT_PEER] == "10.0.0.1:8470"
+        assert ann[constants.ANNOTATION_HEARTBEAT_RESTORE] == "peer:ok:0.412"
+
+    def test_heartbeat_file_roundtrips_riders(self, tmp_path):
+        path = str(tmp_path / "hb.json")
+        hb.write_heartbeat_file(path, 3, 17, tokens_per_sec=8.0,
+                                checkpoint_step=12,
+                                peer_addr="10.0.0.2:8470",
+                                restore="storage:peer-unreachable:1.250")
+        data = hb.read_heartbeat_file(path)
+        assert data["peer_addr"] == "10.0.0.2:8470"
+        assert data["restore"] == "storage:peer-unreachable:1.250"
+
+    def test_publisher_feeds_riders_to_full_arity_sink(self):
+        beats = []
+
+        def sink(seq, step, tps, ckpt, peer, restore):
+            beats.append((step, ckpt, peer, restore))
+
+        pub = hb.HeartbeatPublisher(sink, interval=60)
+        pub.record_progress(step=9, tokens_per_sec=1.0)
+        pub.record_checkpoint(7)
+        pub.record_peer_address("10.0.0.3:8470")
+        pub.record_restore("peer", "ok", 0.4119)
+        pub.beat_once()
+        assert beats == [(9, 7, "10.0.0.3:8470", "peer:ok:0.412")]
+        # None never clears an advertised address (lease GC owns removal).
+        pub.record_peer_address(None)
+        pub.beat_once()
+        assert beats[-1][2] == "10.0.0.3:8470"
+
+    def test_legacy_sinks_keep_working_without_riders(self):
+        three, four = [], []
+        pub3 = hb.HeartbeatPublisher(lambda seq, step, tps:
+                                     three.append((seq, step, tps)), 60)
+        pub4 = hb.HeartbeatPublisher(lambda seq, step, tps, ckpt:
+                                     four.append((seq, step, tps, ckpt)), 60)
+        for pub in (pub3, pub4):
+            pub.record_progress(step=2, tokens_per_sec=5.0)
+            pub.record_checkpoint(1)
+            pub.record_peer_address("10.0.0.4:8470")
+            pub.record_restore("storage", "ok", 1.0)
+            pub.beat_once()
+        assert three == [(1, 2, 5.0)]
+        assert four == [(1, 2, 5.0, 1)]
+
+
+# ------------------------------------------------------------------- metrics
+class TestRecoveryMetrics:
+    def test_persist_histogram(self):
+        m = Metrics()
+        m.observe_checkpoint_persist(0.3)
+        m.observe_checkpoint_persist(4.0)
+        assert m.labeled_histogram_count(
+            "training_checkpoint_persist_seconds") == 2
+        text = m.render()
+        assert 'training_checkpoint_persist_seconds_bucket{le="0.5"} 1' in text
+        assert 'training_checkpoint_persist_seconds_count{} 2' in text
+
+    def test_restore_counter_and_histogram_labels(self):
+        m = Metrics()
+        m.observe_restore("peer", "ok", 0.2)
+        m.observe_restore("storage", "peer-unreachable", 1.5)
+        m.observe_restore("storage", "peer-unreachable", 2.5)
+        assert m.labeled_counter_value(
+            "training_restore_total", "peer", "ok") == 1
+        assert m.labeled_counter_value(
+            "training_restore_total", "storage", "peer-unreachable") == 2
+        assert m.labeled_histogram_count(
+            "training_restore_seconds", "storage", "peer-unreachable") == 2
+        text = m.render()
+        assert ('training_restore_seconds_bucket{path="peer",cause="ok",'
+                'le="0.25"} 1') in text
+
+    def test_durable_step_gauge_set_and_clear(self):
+        m = Metrics()
+        m.set_checkpoint_last_durable_step("default", "jax", "llama", 40)
+        assert m.checkpoint_last_durable_step_value(
+            "default", "jax", "llama") == 40
+        m.clear_checkpoint_last_durable_step("default", "jax", "llama")
+        assert m.checkpoint_last_durable_step_value(
+            "default", "jax", "llama") is None

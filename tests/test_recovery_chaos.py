@@ -1,0 +1,381 @@
+"""Seeded restore-path chaos (fast-recovery plane, operator side).
+
+Three suites:
+
+- TestRestoreFaultInjector — the deterministic fault lever itself:
+  call-windowed scheduling, per-peer targeting, composition, and the
+  ``restore:{op}#{n}:{kind}:peer{i}`` fault-log grammar.
+- TestSeededRestoreLadder — the ladder under seeded faults against a live
+  shard server: a transient refusal heals inside the retry budget, hard
+  refusals/hangs degrade to storage, and every scenario replays its fault
+  log byte-identically from the spec alone.
+- TestOperatorPeerRestore / TestCapabilityGating — the operator loop: a
+  preempted slice's rebuilt pods come up holding the survivor shard-server
+  addresses observed on the heartbeat leases, recovery ledgers stay
+  exactly-once, the restore-outcome rider lands in metrics, seeded replay
+  is byte-identical — and with ``EngineOptions.peer_restore`` off
+  (default), none of it exists: no env, no annotation parsing, and the
+  same chaos seed produces the same fault log as before the feature.
+"""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tf_operator_tpu.bootstrap import heartbeat as hb_bootstrap
+from tf_operator_tpu.cluster.chaos import (
+    ChaosCluster,
+    ChaosSpec,
+    RestoreFaultInjector,
+    ScheduledRestoreFault,
+)
+from tf_operator_tpu.cluster.memory import InMemoryCluster
+from tf_operator_tpu.controllers.jax import JAXController
+from tf_operator_tpu.core import constants
+from tf_operator_tpu.core.job_controller import EngineOptions
+from tf_operator_tpu.core.tracing import Tracer
+from tf_operator_tpu.metrics import Metrics
+from tf_operator_tpu.runtime import heartbeat as hb
+from tf_operator_tpu.runtime.shard_server import start_shard_server
+from tf_operator_tpu.testing.invariants import assert_invariants
+from tf_operator_tpu.train.checkpoint import CheckpointManager
+from tf_operator_tpu.train.restore import restore_with_fallback
+from tf_operator_tpu.train.train_step import TrainState
+
+STEP = 5
+
+
+def make_state(step=STEP, scale=1.0):
+    return TrainState(
+        step=jnp.asarray(step, jnp.int32),
+        params={"w": jnp.full((4, 4), scale, jnp.float32)},
+        opt_state={"m": jnp.full((4, 4), scale * 2, jnp.float32)},
+    )
+
+
+# ------------------------------------------------------------ injector unit
+class TestRestoreFaultInjector:
+    def test_window_and_count(self):
+        log = []
+        inj = RestoreFaultInjector((ScheduledRestoreFault(
+            kind="refuse", op="meta", at_call=2, count=2),), log=log)
+        assert inj.fault_for("meta", 0) is None
+        assert inj.fault_for("meta", 0) == "refuse"
+        assert inj.fault_for("meta", 0) == "refuse"
+        assert inj.fault_for("meta", 0) is None
+        assert log == ["restore:meta#2:refuse:peer0",
+                       "restore:meta#3:refuse:peer0"]
+
+    def test_peer_targeting_and_wildcard_op(self):
+        inj = RestoreFaultInjector((ScheduledRestoreFault(
+            kind="hang", op="*", peer=1, at_call=1, count=99),))
+        assert inj.fault_for("meta", 0) is None
+        assert inj.fault_for("meta", 1) == "hang"
+        assert inj.fault_for("shard", 1) == "hang"
+
+    def test_composed_faults_both_advance(self):
+        """Two windowed faults on one op: the counters of EVERY matching
+        entry advance per call, so windows stay call-indexed regardless
+        of which entry fired."""
+        inj = RestoreFaultInjector((
+            ScheduledRestoreFault(kind="refuse", op="shard",
+                                  at_call=1, count=1),
+            ScheduledRestoreFault(kind="truncate", op="shard-body",
+                                  at_call=1, count=1),
+            ScheduledRestoreFault(kind="refuse", op="shard",
+                                  at_call=3, count=1),
+        ))
+        assert inj.fault_for("shard", 0) == "refuse"      # call 1
+        assert inj.fault_for("shard", 0) is None          # call 2
+        assert inj.fault_for("shard", 0) == "refuse"      # call 3
+        assert inj.fault_for("shard-body", 0) == "truncate"
+
+    def test_chaos_cluster_shares_fault_log(self):
+        chaos = ChaosCluster(InMemoryCluster(), ChaosSpec(
+            seed=3, restore_faults=(ScheduledRestoreFault(
+                kind="refuse", op="meta", at_call=1, count=1),)))
+        inj = chaos.restore_fault_injector()
+        assert inj is chaos.restore_fault_injector()  # one instance
+        assert inj.fault_for("meta", 0) == "refuse"
+        assert chaos.fault_log == ["restore:meta#1:refuse:peer0"]
+
+
+# -------------------------------------------------------------- ladder + seed
+@pytest.fixture()
+def served_checkpoint(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "src"))
+    server = start_shard_server(mgr)
+    mgr.save(make_state(scale=3.0), force=True)
+    mgr.wait()
+    yield mgr, server
+    server.stop()
+    mgr.close()
+
+
+def run_ladder(served, faults, retries=2):
+    mgr, server = served
+    chaos = ChaosCluster(InMemoryCluster(), ChaosSpec(
+        seed=11, restore_faults=tuple(faults)))
+    out = restore_with_fallback(
+        make_state(step=0, scale=0.0), mgr, [server.address],
+        retries=retries, fault_injector=chaos.restore_fault_injector(),
+        sleep=lambda _s: None)
+    return out, list(chaos.fault_log)
+
+
+class TestSeededRestoreLadder:
+    def test_transient_refusal_heals_within_retry_budget(
+            self, served_checkpoint):
+        out, log = run_ladder(served_checkpoint, [ScheduledRestoreFault(
+            kind="refuse", op="meta", at_call=1, count=1)])
+        assert (out.path, out.cause, out.step) == ("peer", "ok", STEP)
+        assert log == ["restore:meta#1:refuse:peer0"]
+
+    def test_hard_refusal_degrades_to_storage(self, served_checkpoint):
+        out, log = run_ladder(served_checkpoint, [ScheduledRestoreFault(
+            kind="refuse", op="*", at_call=1, count=999)])
+        assert (out.path, out.cause, out.step) == (
+            "storage", "peer-unreachable", STEP)
+        assert len(log) == 3  # one meta attempt + two retries, all refused
+
+    def test_peer_hang_is_a_timeout_not_a_stall(self, served_checkpoint):
+        t0 = time.monotonic()
+        out, log = run_ladder(served_checkpoint, [ScheduledRestoreFault(
+            kind="hang", op="shard", at_call=1, count=999)])
+        assert time.monotonic() - t0 < 5.0  # no real sleeps
+        assert (out.path, out.cause) == ("storage", "peer-unreachable")
+        assert all(":hang:" in entry for entry in log)
+
+    def test_stale_meta_arbitrates_to_storage(self, served_checkpoint):
+        out, log = run_ladder(served_checkpoint, [ScheduledRestoreFault(
+            kind="stale-meta", op="meta-body", at_call=1, count=1)])
+        assert (out.path, out.cause, out.step) == (
+            "storage", "stale-snapshot", STEP)
+        assert log == ["restore:meta-body#1:stale-meta:peer0"]
+
+    @pytest.mark.parametrize("fault", [
+        ScheduledRestoreFault(kind="refuse", op="shard", at_call=2,
+                              count=999),
+        ScheduledRestoreFault(kind="truncate", op="shard-body", at_call=1,
+                              count=1),
+        ScheduledRestoreFault(kind="hang", op="meta", at_call=1, count=999),
+    ], ids=["refuse-mid-fetch", "truncate", "hang"])
+    def test_same_spec_replays_fault_log_byte_identically(
+            self, served_checkpoint, fault):
+        out1, log1 = run_ladder(served_checkpoint, [fault])
+        out2, log2 = run_ladder(served_checkpoint, [fault])
+        assert log1 == log2 and log1
+        assert (out1.path, out1.cause) == (out2.path, out2.cause)
+        assert out1.step == out2.step == STEP  # always lands somewhere real
+
+
+# ------------------------------------------------------------- operator loop
+def multislice_manifest(slices=2, hosts=2):
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "JAXJob",
+        "metadata": {"name": "rec", "namespace": "default"},
+        "spec": {
+            "numSlices": slices,
+            "runPolicy": {"backoffLimit": 0,
+                          "progressDeadlineSeconds": 300},
+            "jaxReplicaSpecs": {"Worker": {
+                "replicas": slices * hosts,
+                "template": {"spec": {"containers": [
+                    {"name": "jax", "image": "test:1"}]}},
+            }},
+        },
+    }
+
+
+def pod_env(pod):
+    containers = getattr(pod.spec, "containers", None) or []
+    if not containers:
+        return {}
+    return {e.name: e.value for e in containers[0].env}
+
+
+def run_operator_recovery(seed, peer_restore=True):
+    """One seeded run: 2x2 gang, survivors advertise shard servers on the
+    heartbeat leases, slice 1 preempted; returns what the assertions need."""
+    slices, hosts = 2, 2
+    total = slices * hosts
+    inner = InMemoryCluster()
+    chaos = ChaosCluster(inner, ChaosSpec(seed=seed))
+    metrics = Metrics()
+    tracer = Tracer()
+    controller = JAXController(
+        chaos, metrics=metrics, tracer=tracer,
+        options=EngineOptions(peer_restore=peer_restore))
+    inner.create_job(multislice_manifest(slices, hosts))
+    state = {"preempted": False, "reported": False, "finished": False}
+    survivors = {}
+
+    def slice_pods(index):
+        return sorted(
+            (p for p in inner.list_pods("default",
+                                        labels={"job-name": "rec"})
+             if p.metadata.labels.get("tpu-slice-index") == str(index)
+             and p.metadata.deletion_timestamp is None),
+            key=lambda p: p.metadata.name)
+
+    def beat(pod_name, index, restore=None):
+        hb.publish_heartbeat(
+            inner, "default", constants.heartbeat_lease_name(pod_name),
+            identity=pod_name, step=STEP, tokens_per_sec=100.0,
+            checkpoint_step=STEP, peer_addr=f"10.0.{index}.1:8470",
+            restore=restore)
+
+    def drive():
+        for p in inner.list_pods("default"):
+            if p.status.phase == "Pending":
+                inner.set_pod_phase("default", p.metadata.name, "Running")
+        running = [p for p in inner.list_pods("default")
+                   if p.status.phase == "Running"
+                   and p.metadata.deletion_timestamp is None]
+        if not state["preempted"] and len(running) == total:
+            for i, p in enumerate(slice_pods(0)):
+                beat(p.metadata.name, i)
+                survivors[p.metadata.name] = f"10.0.{i}.1:8470"
+            state["preempted"] = True
+            chaos.preempt_slice(job_name="rec", slice_index=1,
+                                namespace="default")
+        elif state["preempted"] and len(running) == total:
+            if not state["reported"]:
+                beat(slice_pods(1)[0].metadata.name, 9,
+                     restore="peer:ok:0.412")
+                state["reported"] = True
+                return
+            for p in running:
+                inner.set_pod_phase("default", p.metadata.name,
+                                    "Succeeded", exit_code=0)
+            state["finished"] = True
+
+    def succeeded():
+        job = inner.get_job("JAXJob", "default", "rec")
+        conds = {c["type"]: c for c in
+                 (job.get("status") or {}).get("conditions") or []}
+        return conds.get("Succeeded", {}).get("status") == "True"
+
+    converged = False
+    for _ in range(400):
+        controller.run_until_idle()
+        if state["finished"] and succeeded():
+            converged = True
+            break
+        drive()
+        controller.queue.add("JAXJob:default/rec")
+        time.sleep(0.002)
+
+    return {
+        "converged": converged,
+        "fault_log": list(chaos.fault_log),
+        "survivors": sorted(survivors.values()),
+        "rebuilt_env": [pod_env(p) for p in slice_pods(1)],
+        "all_env": [pod_env(p) for p in inner.list_pods("default")],
+        "inner": inner,
+        "tracer": tracer,
+        "metrics": metrics,
+    }
+
+
+class TestOperatorPeerRestore:
+    def test_rebuilt_slice_gets_survivor_addresses_exactly_once_ledgers(
+            self):
+        out = run_operator_recovery(seed=23)
+        assert out["converged"]
+        assert len(out["rebuilt_env"]) == 2
+        for env in out["rebuilt_env"]:
+            assert env[hb_bootstrap.ENV_SHARD_SERVER] == "1"
+            assert sorted(env[
+                hb_bootstrap.ENV_PEER_RESTORE_ADDRS].split(",")) == \
+                out["survivors"]
+        # The rebuilt rank's restore-outcome rider landed in metrics.
+        assert out["metrics"].labeled_counter_value(
+            "training_restore_total", "peer", "ok") == 1
+        # Recovery ledgers: exactly one disruption, one slice restart,
+        # zero world restarts — recounted, never double-counted.
+        assert_invariants(
+            out["inner"], kinds=("JAXJob",),
+            expect_ledgers={
+                "disruptionCounts": {"Worker": 1},
+                "restartCounts": {},
+                "stallCounts": {},
+                "sliceRestartCounts": {"1": 1},
+            },
+            tracer=out["tracer"],
+            label="recovery_peer_restore",
+        )
+
+    def test_seeded_replay_is_byte_identical(self):
+        a = run_operator_recovery(seed=23)
+        b = run_operator_recovery(seed=23)
+        assert a["fault_log"] == b["fault_log"] and a["fault_log"]
+        assert a["survivors"] == b["survivors"]
+        assert [sorted(e.items()) for e in a["rebuilt_env"]] == \
+            [sorted(e.items()) for e in b["rebuilt_env"]]
+
+    def test_min_durable_step_gauge_follows_the_slowest_rank(self):
+        """The operator aggregates the checkpoint rider as MIN over
+        reporting replicas — the same semantics the shrink gate uses —
+        into training_checkpoint_last_durable_step."""
+        inner = InMemoryCluster()
+        metrics = Metrics()
+        controller = JAXController(
+            inner, metrics=metrics,
+            options=EngineOptions(peer_restore=True))
+        inner.create_job(multislice_manifest())
+        controller.run_until_idle()
+        for p in inner.list_pods("default"):
+            inner.set_pod_phase("default", p.metadata.name, "Running")
+        pods = sorted(p.metadata.name
+                      for p in inner.list_pods("default"))
+        for i, name in enumerate(pods):
+            hb.publish_heartbeat(
+                inner, "default", constants.heartbeat_lease_name(name),
+                identity=name, step=STEP, tokens_per_sec=10.0,
+                checkpoint_step=40 + i)
+        controller.queue.add("JAXJob:default/rec")
+        controller.run_until_idle()
+        assert metrics.checkpoint_last_durable_step_value(
+            "default", "JAXJob", "rec") == 40
+        # Terminal: the series is dropped, not frozen at the last value.
+        for name in pods:
+            inner.set_pod_phase("default", name, "Succeeded", exit_code=0)
+        controller.queue.add("JAXJob:default/rec")
+        controller.run_until_idle()
+        assert metrics.checkpoint_last_durable_step_value(
+            "default", "JAXJob", "rec") is None
+
+
+class TestCapabilityGating:
+    def test_default_off_injects_nothing_and_ignores_riders(self):
+        out = run_operator_recovery(seed=23, peer_restore=False)
+        assert out["converged"]
+        for env in out["all_env"]:
+            assert hb_bootstrap.ENV_SHARD_SERVER not in env
+            assert hb_bootstrap.ENV_PEER_RESTORE_ADDRS not in env
+        # The restore rider on the lease is ignored entirely.
+        assert out["metrics"].labeled_counter_value(
+            "training_restore_total", "peer", "ok") == 0
+
+    def test_gated_run_replays_the_same_chaos_stream_as_ungated(self):
+        """The PR 1-15 seeded tiers' contract: with the capability off,
+        the same seed yields a byte-identical fault log — the peer plane
+        adds no nondeterminism and consumes no randomness."""
+        gated = run_operator_recovery(seed=23, peer_restore=False)
+        ungated = run_operator_recovery(seed=23, peer_restore=True)
+        assert gated["fault_log"] == ungated["fault_log"]
+        assert_invariants(
+            gated["inner"], kinds=("JAXJob",),
+            expect_ledgers={
+                "disruptionCounts": {"Worker": 1},
+                "restartCounts": {},
+                "stallCounts": {},
+                "sliceRestartCounts": {"1": 1},
+            },
+            tracer=gated["tracer"],
+            label="recovery_gated_off",
+        )

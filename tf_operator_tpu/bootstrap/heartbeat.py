@@ -35,6 +35,18 @@ ENV_HEARTBEAT_LEASE = "TPU_HEARTBEAT_LEASE"
 ENV_HEARTBEAT_NAMESPACE = "TPU_HEARTBEAT_NAMESPACE"
 ENV_HEARTBEAT_INTERVAL = "TPU_HEARTBEAT_INTERVAL_SECONDS"
 ENV_HEARTBEAT_FILE = "TPU_HEARTBEAT_FILE"
+# Fast-recovery plane (EngineOptions.peer_restore; both absent unless the
+# operator enables it — the peer path is capability-gated off by default):
+# - TPU_SHARD_SERVER=1           the workload should start a
+#                                runtime/shard_server.py over its host
+#                                snapshot and advertise the address via
+#                                record_peer_address().
+# - TPU_PEER_RESTORE_ADDRS       comma-joined "host:port" survivor
+#                                addresses (read from live pods' heartbeat
+#                                leases at pod build time) the restore
+#                                ladder tries before the storage fallback.
+ENV_SHARD_SERVER = "TPU_SHARD_SERVER"
+ENV_PEER_RESTORE_ADDRS = "TPU_PEER_RESTORE_ADDRS"
 
 
 def heartbeat_interval_seconds(progress_deadline_seconds: int) -> float:

@@ -129,6 +129,12 @@ class OperatorOptions:
     # Per-job floor between coalesced status flushes: churn inside the
     # window is buffered and carried by a scheduled flush.
     status_flush_interval: float = 1.0
+    # Fast-recovery peer restore (docs/design/checkpoint_recovery.md):
+    # heartbeat-enabled replicas run a snapshot shard server and recreated
+    # pods get survivor addresses for the restore ladder's peer leg. Off
+    # (the default) = no pod env changes and no new annotations consumed,
+    # so every PR 1-15 seeded tier replays byte-identically.
+    enable_peer_restore: bool = False
     # Capacity-aware gang admission (core/admission.py,
     # docs/design/gang_admission.md). Off (the default) = first-come,
     # capacity-blind admission exactly as before — every PR 1-8 seeded
@@ -389,6 +395,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "create/delete events (the legacy one-update-per-"
                         "sync write path; default is coalesced single-"
                         "request status patches on capable backends).")
+    parser.add_argument("--enable-peer-restore", action="store_true",
+                        help="Fast-recovery peer restore: heartbeat-enabled "
+                             "replicas serve host-snapshot shards and "
+                             "recreated pods receive survivor addresses "
+                             "(TPU_PEER_RESTORE_ADDRS) so their restore "
+                             "ladder can skip the storage round-trip.")
     parser.add_argument("--status-flush-interval", type=float, default=1.0,
                         help="Per-job floor (seconds) between coalesced "
                         "status flushes; replica-count churn inside the "
@@ -436,6 +448,7 @@ def options_from_args(args: argparse.Namespace) -> OperatorOptions:
         fanout_max_parallelism=args.fanout_max_parallelism,
         write_coalescing=not args.disable_write_coalescing,
         status_flush_interval=args.status_flush_interval,
+        enable_peer_restore=args.enable_peer_restore,
         enable_gang_admission=args.enable_gang_admission,
         capacity=args.capacity,
         namespace_quotas=list(args.namespace_quota),
@@ -717,6 +730,7 @@ class OperatorManager:
             sync_workers=self.options.threadiness,
             write_coalescing=self.options.write_coalescing,
             status_flush_interval=self.options.status_flush_interval,
+            peer_restore=self.options.enable_peer_restore,
         )
         # ONE gang-admission arbiter shared by every framework controller
         # (core/admission.py): capacity and quota are operator-wide, so a

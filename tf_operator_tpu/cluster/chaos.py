@@ -255,6 +255,71 @@ class ScheduledStuckTermination:
 
 
 @dataclass
+class ScheduledRestoreFault:
+    """A restore-path fault planted in the schedule (the fast-recovery
+    plane's adversary, consumed by train/restore.py through a
+    :class:`RestoreFaultInjector`). Keys on per-(op, kind-match) consult
+    counters, not the write clock — restore traffic never advances the
+    cluster write clock, so PR 1-15 schedules are untouched by this
+    field's existence.
+
+    Kinds: ``refuse`` (connection refused), ``hang`` (per-peer timeout),
+    ``truncate`` (shard body cut in half — fails sha256 verification),
+    ``stale-meta`` (peer advertises a step one behind storage — loses the
+    staleness arbitration). ``op`` scopes the fault to the client's
+    ``meta`` probe, ``shard`` fetch, or the post-fetch ``shard-body`` /
+    ``meta-body`` mutation points; ``peer`` targets one peer INDEX in the
+    client's discovery order (indices, not addresses — ephemeral ports
+    would break byte-equal replay). ``at_call``/``count`` window the fault
+    over the Nth..N+count-1th matching consults, so a fault can refuse one
+    attempt and let the retry through, or outlive the retry budget."""
+
+    kind: str
+    op: str = "*"                 # meta | shard | meta-body | shard-body | *
+    peer: Optional[int] = None    # discovery-order index; None = any peer
+    at_call: int = 1              # 1-based index of the first faulted consult
+    count: int = 1
+
+
+class RestoreFaultInjector:
+    """Deterministic restore-fault oracle: the client consults
+    ``fault_for(op, peer_index)`` at every fetch attempt/mutation point and
+    applies whatever kind comes back. Consult counters are pure functions
+    of the call sequence (the client iterates peers in discovery order and
+    shards in sorted order), so a seeded run logs — and replays —
+    byte-identically. Standalone-usable; ChaosCluster binds one to its
+    fault_log via :meth:`ChaosCluster.restore_fault_injector`."""
+
+    def __init__(self, faults: Tuple[ScheduledRestoreFault, ...] = (),
+                 log: Optional[List[str]] = None) -> None:
+        self.faults = tuple(faults)
+        self.fault_log = log if log is not None else []
+        self._lock = threading.Lock()
+        self._consults: Dict[int, int] = {}
+
+    def fault_for(self, op: str, peer_index: int) -> Optional[str]:
+        """The fault kind (or None) for this consult. Every matching
+        entry's counter advances (so same-op entries with disjoint
+        at_call windows compose); the first entry whose window covers the
+        consult fires."""
+        fired: Optional[str] = None
+        with self._lock:
+            for i, fault in enumerate(self.faults):
+                if fault.op not in ("*", op):
+                    continue
+                if fault.peer is not None and fault.peer != peer_index:
+                    continue
+                n = self._consults.get(i, 0) + 1
+                self._consults[i] = n
+                if fired is None and fault.at_call <= n < fault.at_call + fault.count:
+                    self.fault_log.append(
+                        f"restore:{op}#{n}:{fault.kind}:peer{peer_index}"
+                    )
+                    fired = fault.kind
+        return fired
+
+
+@dataclass
 class ChaosSpec:
     """The seeded plan. Rates are probabilities in [0, 1] evaluated per
     call from the deterministic hash stream."""
@@ -300,6 +365,11 @@ class ChaosSpec:
     # seed + plan.
     lease_steals: Tuple[ScheduledLeaseSteal, ...] = ()
     renew_delays: Tuple[ScheduledRenewDelay, ...] = ()
+    # Restore-path plan (the fast-recovery plane's adversary): seeded
+    # faults the peer-restore client applies at its fetch hooks. Keys on
+    # per-entry consult counters, not the write clock — default empty, so
+    # every pre-existing seeded schedule replays byte-identically.
+    restore_faults: Tuple[ScheduledRestoreFault, ...] = ()
     # Methods exempt from error/conflict injection (latency still
     # applies). Default: none — every write, record_event included, is
     # faultable; the engine's best-effort event recording is itself a
@@ -351,6 +421,18 @@ class ChaosCluster:
         # Direct-lever hangs (freeze_heartbeats) appended at test-chosen
         # points, beside the write-clock-scheduled spec.hangs.
         self._manual_hangs: List[ScheduledHang] = []
+        self._restore_injector: Optional[RestoreFaultInjector] = None
+
+    def restore_fault_injector(self) -> RestoreFaultInjector:
+        """The injector for this plan's restore_faults, sharing this
+        cluster's fault_log so restore-path faults interleave with the
+        write-clock faults in one byte-comparable artifact. One instance
+        per cluster (consult counters must survive across restores)."""
+        if self._restore_injector is None:
+            self._restore_injector = RestoreFaultInjector(
+                self.spec.restore_faults, log=self.fault_log
+            )
+        return self._restore_injector
 
     # ------------------------------------------------------------- plan
     def next_call_index(self, method: str) -> int:

@@ -366,6 +366,8 @@ class LocalProcessCluster(InMemoryCluster):
             step = beat.get("step")
             tps = beat.get("tokens_per_sec")
             ckpt = beat.get("checkpoint_step")
+            peer = beat.get("peer_addr")
+            restore = beat.get("restore")
             hb_runtime.publish_heartbeat(
                 self, lease_ns, lease_name, identity=key[1],
                 step=int(step) if isinstance(step, (int, float)) else None,
@@ -375,6 +377,8 @@ class LocalProcessCluster(InMemoryCluster):
                 checkpoint_step=(
                     int(ckpt) if isinstance(ckpt, (int, float)) else None
                 ),
+                peer_addr=peer if isinstance(peer, str) else None,
+                restore=restore if isinstance(restore, str) else None,
             )
 
     def kill_pod(self, namespace: str, name: str, sig: int = signal.SIGKILL) -> None:

@@ -180,6 +180,8 @@ class FrameworkController(FrameworkHooks):
             on_gang_restart=self._record_gang_restart,
             on_heartbeat_age=self._record_heartbeat_age,
             on_workload_throughput=self._record_workload_throughput,
+            on_durable_checkpoint=self._record_durable_checkpoint,
+            on_restore_observed=self._record_restore,
             on_force_delete=self._record_force_delete,
             on_fanout_batch=self._record_fanout_batch,
             on_fanout_abort=self._record_fanout_abort,
@@ -373,6 +375,22 @@ class FrameworkController(FrameworkHooks):
         self.metrics.set_workload_tokens_per_sec(
             job.namespace, self.kind, job.name, tps
         )
+
+    def _record_durable_checkpoint(self, job: JobObject, step) -> None:
+        if step is None:
+            # Terminal: drop the series (the on_workload_throughput rule —
+            # a finished job's last durable step is history, not a gate).
+            self.metrics.clear_checkpoint_last_durable_step(
+                job.namespace, self.kind, job.name
+            )
+            return
+        self.metrics.set_checkpoint_last_durable_step(
+            job.namespace, self.kind, job.name, float(step)
+        )
+
+    def _record_restore(self, job: JobObject, path: str, cause: str,
+                        seconds: float) -> None:
+        self.metrics.observe_restore(path, cause, seconds)
 
     def _record_force_delete(self, job: JobObject, cause: str) -> None:
         self.metrics.force_delete_inc(job.namespace, self.kind, cause)

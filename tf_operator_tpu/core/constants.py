@@ -137,6 +137,18 @@ ANNOTATION_HEARTBEAT_TPS = "tpu.kubeflow.org/tokens-per-sec"
 # so an elastic scale-down can never lose more progress than one
 # checkpoint interval.
 ANNOTATION_HEARTBEAT_CKPT = "tpu.kubeflow.org/checkpoint-step"
+# Peer-restore shard-server address (record_peer_address("host:port")),
+# riding the same lease annotations: survivors advertise where a recreated
+# slice can fetch host-resident snapshot shards instead of paying the
+# storage round-trip (docs/design/checkpoint_recovery.md). The engine
+# aggregates live survivors' addresses into TPU_PEER_RESTORE_ADDRS on
+# recreated pods when EngineOptions.peer_restore is on.
+ANNOTATION_HEARTBEAT_PEER = "tpu.kubeflow.org/peer-restore-addr"
+# Last restore outcome (record_restore(path, cause, seconds)), riding the
+# same lease annotations as a compact "path:cause:seconds" string — the
+# observability tail of the restore ladder (which leg won and why),
+# exported by the controller as training_restore_total/seconds.
+ANNOTATION_HEARTBEAT_RESTORE = "tpu.kubeflow.org/restore-outcome"
 # Renewal cadence injected into heartbeat-enabled pods: a quarter of the
 # progress deadline, floored — several renewals must fit inside one
 # deadline window or scheduling jitter alone could trip it.

@@ -96,6 +96,14 @@ class _HeartbeatState:
     pod_name: str = ""
     rtype: str = ""
     index: int = -1
+    # Fast-recovery riders observed on this pod's lease (peer_restore):
+    # the shard-server address this rank advertised (survivor discovery
+    # for recreated pods' TPU_PEER_RESTORE_ADDRS), and the last
+    # restore-outcome string already reported through on_restore_observed
+    # (dedup — the annotation persists across syncs but each restore
+    # must count once).
+    peer_addr: Optional[str] = None
+    restore_raw: Optional[str] = None
 
 
 def gen_general_name(job_name: str, rtype: str, index) -> str:
@@ -449,6 +457,15 @@ class EngineOptions:
     # in order regardless of this flag.
     write_coalescing: bool = True
     status_flush_interval: float = 1.0
+    # Fast-recovery peer restore (--enable-peer-restore): heartbeat-
+    # enabled replicas are told to run a snapshot shard server
+    # (TPU_SHARD_SERVER) and recreated pods receive the survivor
+    # addresses the liveness checks observed on heartbeat leases
+    # (TPU_PEER_RESTORE_ADDRS), so a restoring rank can fetch host-
+    # resident shards instead of paying the storage round-trip. Default
+    # OFF: no pod env changes, no new annotations consumed — every
+    # PR 1-15 seeded tier replays byte-identically.
+    peer_restore: bool = False
     # Capacity-aware gang admission (core/admission.py,
     # --enable-gang-admission) has NO EngineOptions field on purpose:
     # the switch is the `admission` object itself — the operator manager
@@ -500,6 +517,8 @@ class JobController:
         on_gang_restart: Optional[Callable[[JobObject, str, Optional[int], str], None]] = None,
         on_heartbeat_age: Optional[Callable[[JobObject, float], None]] = None,
         on_workload_throughput: Optional[Callable[[JobObject, float], None]] = None,
+        on_durable_checkpoint: Optional[Callable[[JobObject, Optional[int]], None]] = None,
+        on_restore_observed: Optional[Callable[[JobObject, str, str, float], None]] = None,
         on_force_delete: Optional[Callable[[JobObject, str], None]] = None,
         on_fanout_batch: Optional[Callable[[str, int], None]] = None,
         on_fanout_abort: Optional[Callable[[str], None]] = None,
@@ -540,6 +559,24 @@ class JobController:
         # jobs and trip low-throughput alerts on every finished job.
         self.on_workload_throughput = on_workload_throughput or (
             lambda job, tps: None
+        )
+        # (job, step or None) — fires when a liveness check observes the
+        # checkpoint-step lease rider (record_checkpoint, which the
+        # snapshot-then-persist workload fires only from its durability
+        # callback): the MIN over the gang's reporting replicas — the
+        # step every rank has committed, the same aggregation the
+        # autoscaler's shrink gate uses. Exported as the
+        # training_checkpoint_last_durable_step gauge; None drops the
+        # series (terminal), mirroring on_workload_throughput.
+        self.on_durable_checkpoint = on_durable_checkpoint or (
+            lambda job, step: None
+        )
+        # (job, path, cause, seconds) — fires once per NEW restore-outcome
+        # lease rider value observed on any replica (record_restore):
+        # which restore-ladder leg won and why. Exported as
+        # training_restore_total/seconds{path,cause}.
+        self.on_restore_observed = on_restore_observed or (
+            lambda job, path, cause, seconds: None
         )
         # (job, cause) — fires once per grace-period-0 escalation of a
         # stuck-Terminating pod; the controller exports it as the
@@ -1500,6 +1537,20 @@ class JobController:
             log.debug("heartbeat lease GC failed for %s/%s", job.namespace,
                       pod_name, exc_info=True)
 
+    def _peer_restore_addrs(self, job: JobObject,
+                            exclude_pod: str = "") -> List[str]:
+        """Survivor shard-server addresses for one job, from the liveness
+        observation cache (peer-address lease riders seen on live ranks).
+        Sorted for deterministic env rendering; the pod being built is
+        excluded — a restarted rank must not be told to restore from its
+        own predecessor's dead server."""
+        with self._hb_lock:
+            obs = self._hb_obs.get((job.key(), job.metadata.uid)) or {}
+            return sorted({
+                state.peer_addr for state in obs.values()
+                if state.peer_addr and state.pod_name != exclude_pod
+            })
+
     def _check_liveness(
         self, job: JobObject, replicas: Dict[str, ReplicaSpec], run_policy,
         pods: List[Pod],
@@ -1543,6 +1594,7 @@ class JobController:
         stalled: Optional[Tuple[str, Pod, str]] = None
         worst_age = 0.0
         best_tps: Optional[float] = None
+        min_ckpt: Optional[int] = None
         next_check: Optional[float] = None
 
         def sooner(remaining: float) -> None:
@@ -1622,10 +1674,9 @@ class JobController:
                     # yields the job number directly, per-replica
                     # reporters yield the fastest replica's view. Pure
                     # telemetry — no liveness verdict ever rides on it.
-                    tps_raw = ((lease.get("metadata") or {})
-                               .get("annotations") or {}).get(
-                        constants.ANNOTATION_HEARTBEAT_TPS
-                    )
+                    annotations = ((lease.get("metadata") or {})
+                                   .get("annotations") or {})
+                    tps_raw = annotations.get(constants.ANNOTATION_HEARTBEAT_TPS)
                     if tps_raw is not None:
                         try:
                             tps = float(tps_raw)
@@ -1633,6 +1684,41 @@ class JobController:
                             tps = None
                         if tps is not None and tps >= 0:
                             best_tps = max(best_tps or 0.0, tps)
+                    # Durable-checkpoint rider: the gang-wide value is the
+                    # MIN over reporting replicas (the step EVERY rank has
+                    # committed — the autoscaler's shrink-gate aggregation).
+                    # A non-reporting replica simply doesn't vote; pure
+                    # telemetry, no liveness verdict rides on it.
+                    ckpt_raw = annotations.get(constants.ANNOTATION_HEARTBEAT_CKPT)
+                    if ckpt_raw is not None:
+                        try:
+                            ckpt = int(float(ckpt_raw))
+                        except (TypeError, ValueError):
+                            ckpt = None
+                        if ckpt is not None:
+                            min_ckpt = ckpt if min_ckpt is None else min(min_ckpt, ckpt)
+                    # Peer-restore riders, only consumed when the engine
+                    # opted in (capability gating: with the flag off the
+                    # annotations are ignored and nothing downstream
+                    # changes).
+                    if self.options.peer_restore:
+                        addr = annotations.get(constants.ANNOTATION_HEARTBEAT_PEER)
+                        if addr:
+                            state.peer_addr = addr
+                        restore_raw = annotations.get(
+                            constants.ANNOTATION_HEARTBEAT_RESTORE
+                        )
+                        if restore_raw and restore_raw != state.restore_raw:
+                            state.restore_raw = restore_raw
+                            parts = restore_raw.split(":")
+                            if len(parts) == 3:
+                                try:
+                                    seconds = float(parts[2])
+                                except (TypeError, ValueError):
+                                    seconds = 0.0
+                                self.on_restore_observed(
+                                    job, parts[0], parts[1], seconds
+                                )
                 if not state.baselined:
                     # First read for this pod incarnation: record the
                     # lease content as a BASELINE without crediting it
@@ -1704,6 +1790,8 @@ class JobController:
         self.on_heartbeat_age(job, worst_age)
         if best_tps is not None:
             self.on_workload_throughput(job, best_tps)
+        if min_ckpt is not None:
+            self.on_durable_checkpoint(job, min_ckpt)
         if stalled is None and next_check is not None:
             # Wake just past the earliest deadline (the +0.1 keeps a
             # same-instant wake from re-reading "age == deadline - 0").
@@ -2398,6 +2486,19 @@ class JobController:
                 template.metadata.name, job.namespace,
                 run_policy.progress_deadline_seconds,
             )
+            if self.options.peer_restore:
+                # Fast-recovery plane: tell the workload to serve its host
+                # snapshot (TPU_SHARD_SERVER) and hand this — possibly
+                # recreated — pod the survivor shard-server addresses the
+                # liveness checks observed on live ranks' leases, so its
+                # restore ladder can try peers before storage. Addresses
+                # come from the in-memory observation cache (no extra
+                # apiserver reads in the build path); pods that died took
+                # their observations with them, so only survivors appear.
+                hb_env[hb_bootstrap.ENV_SHARD_SERVER] = "1"
+                addrs = self._peer_restore_addrs(job, template.metadata.name)
+                if addrs:
+                    hb_env[hb_bootstrap.ENV_PEER_RESTORE_ADDRS] = ",".join(addrs)
             for container in template.spec.containers:
                 if container.name != self.hooks.default_container_name:
                     continue
@@ -2726,6 +2827,9 @@ class JobController:
                 # a series for jobs that never reported).
                 self.on_heartbeat_age(job, 0.0)
                 self.on_workload_throughput(job, None)
+                # Same reasoning for the durable-step series: a finished
+                # job's last durable step is history, not a live gate.
+                self.on_durable_checkpoint(job, None)
 
         ttl = run_policy.ttl_seconds_after_finished
         if ttl is not None:

@@ -225,6 +225,17 @@ class Metrics:
             "verbs are the apiserver-load number the watch-cache/"
             "status-coalescing work must drive down",
         ),
+        "training_restore_total": (
+            ("path", "cause"),
+            "Restore-ladder outcomes (train/restore.py; workload-reported "
+            "via the restore-outcome lease rider when observed by the "
+            "operator, recorded directly in-process otherwise), by winning "
+            "path (peer|storage|none) and cause (ok on the happy paths; "
+            "peer-unreachable / stale-snapshot / checksum-mismatch / "
+            "partial-snapshot / no-peers when the peer path degraded). A "
+            "sustained storage share with peer restore enabled means the "
+            "fast path is not winning — check the degradation causes",
+        ),
     }
     # Gauges with label sets: name -> (label names, help). Values live in
     # _labeled_gauges keyed by the label-value tuple, in label-name order.
@@ -288,6 +299,18 @@ class Metrics:
             "persistently 0 with depth growing = workers wedged or "
             "quiesced (lost leadership)",
         ),
+        "training_checkpoint_last_durable_step": (
+            ("job_namespace", "framework", "job_name"),
+            "Newest checkpoint step the job's workload reported DURABLE "
+            "(record_checkpoint fired from the persist-finalized "
+            "durability callback; min over the gang's reporting replicas "
+            "— the step every rank has committed). The autoscaler's "
+            "checkpoint-gated shrink keys on the same annotation, so "
+            "this gauge IS the shrink gate's view: a value frozen while "
+            "progress-step advances means persists are failing or the "
+            "durability callback is not wired (alert: recovery taxonomy "
+            "§13, docs/design/failure_modes.md)",
+        ),
     }
     _HISTOGRAM_BUCKETS = (0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600)
     # Reconciles are ms-scale; startup/restart are seconds-scale.
@@ -312,6 +335,23 @@ class Metrics:
         # the observation fan-out is too wide for the tick interval.
         "training_operator_autoscaler_decision_latency_seconds": (
             0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 5,
+        ),
+    }
+    # Histograms with arbitrary label sets (the (namespace, framework)
+    # histograms above predate this): name -> (label names, buckets).
+    _LABELED_HISTOGRAMS = {
+        # Background persist duration: snapshot enqueued -> orbax finalize
+        # (the durability edge). Sub-second locally; object storage pushes
+        # toward the tail. The snapshot stall the TRAINING thread pays is
+        # deliberately not in here — it's the bench's snapshot_stall number.
+        "training_checkpoint_persist_seconds": (
+            (), (0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 15, 60, 300),
+        ),
+        # Restore-ladder duration by winning path + cause (same label
+        # vocabulary as training_restore_total). peer must sit left of
+        # storage or the fast path is not paying for itself.
+        "training_restore_seconds": (
+            ("path", "cause"), (0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 15, 60, 300),
         ),
     }
 
@@ -356,6 +396,10 @@ class Metrics:
                 # (core/autoscaler.py).
                 "training_operator_autoscaler_decision_latency_seconds",
             )
+        }
+        self._labeled_histograms: Dict[str, Dict[Tuple[str, ...], _Histogram]] = {
+            name: defaultdict(lambda bounds=bounds: _Histogram(bounds))
+            for name, (_, bounds) in self._LABELED_HISTOGRAMS.items()
         }
         # Unlabeled gauges: leader flag etc. (legacy tf_operator_is_leader,
         # cmd/tf-operator.v1/app/server.go:66-70).
@@ -664,6 +708,53 @@ class Metrics:
                 (namespace, framework, job_name), None
             )
 
+    def observe_checkpoint_persist(self, seconds: float) -> None:
+        """One background persist finalized (snapshot enqueue -> orbax
+        commit) — observed from the workload's persist worker."""
+        with self._lock:
+            self._labeled_histograms["training_checkpoint_persist_seconds"][
+                ()
+            ].observe(seconds)
+
+    def observe_restore(self, path: str, cause: str, seconds: float) -> None:
+        """One restore-ladder run: which leg won (path), why anything
+        degraded (cause), and how long restart-to-state-restored took."""
+        self._inc_labeled("training_restore_total", path, cause)
+        with self._lock:
+            self._labeled_histograms["training_restore_seconds"][
+                (path, cause)
+            ].observe(seconds)
+
+    def labeled_histogram_count(self, name: str, *label_values: str) -> int:
+        with self._lock:
+            series = self._labeled_histograms[name]
+            key = tuple(label_values)
+            return series[key].count if key in series else 0
+
+    def set_checkpoint_last_durable_step(self, namespace: str, framework: str,
+                                         job_name: str, step: float) -> None:
+        """Newest durable checkpoint step of one job (min over reporting
+        replicas — the lease-rider payload the liveness check surfaces)."""
+        with self._lock:
+            self._labeled_gauges["training_checkpoint_last_durable_step"][
+                (namespace, framework, job_name)
+            ] = step
+
+    def checkpoint_last_durable_step_value(self, namespace: str, framework: str,
+                                           job_name: str) -> Optional[float]:
+        with self._lock:
+            return self._labeled_gauges[
+                "training_checkpoint_last_durable_step"
+            ].get((namespace, framework, job_name))
+
+    def clear_checkpoint_last_durable_step(self, namespace: str, framework: str,
+                                           job_name: str) -> None:
+        """Drop a deleted job's series (same leak class as heartbeat age)."""
+        with self._lock:
+            self._labeled_gauges["training_checkpoint_last_durable_step"].pop(
+                (namespace, framework, job_name), None
+            )
+
     def successful_inc_once(self, namespace: str, framework: str, job_key: str) -> None:
         """`job_key` should be the job UID (unique per incarnation): a
         ns/name key would dedup a deleted-and-recreated job against its
@@ -771,6 +862,21 @@ class Metrics:
                     for bound, cum in zip(hist.bounds, hist.cumulative()):
                         lines.append(f'{name}_bucket{{{label},le="{bound}"}} {cum}')
                     lines.append(f'{name}_bucket{{{label},le="+Inf"}} {hist.count}')
+                    lines.append(f"{name}_sum{{{label}}} {hist.total}")
+                    lines.append(f"{name}_count{{{label}}} {hist.count}")
+            for name, (label_names, _) in self._LABELED_HISTOGRAMS.items():
+                lines.append(f"# HELP {name} {name.replace('_', ' ')}")
+                lines.append(f"# TYPE {name} histogram")
+                for values, hist in sorted(self._labeled_histograms[name].items()):
+                    label = ",".join(
+                        f'{ln}="{esc(lv)}"' for ln, lv in zip(label_names, values)
+                    )
+                    sep = "," if label else ""
+                    for bound, cum in zip(hist.bounds, hist.cumulative()):
+                        lines.append(
+                            f'{name}_bucket{{{label}{sep}le="{bound}"}} {cum}'
+                        )
+                    lines.append(f'{name}_bucket{{{label}{sep}le="+Inf"}} {hist.count}')
                     lines.append(f"{name}_sum{{{label}}} {hist.total}")
                     lines.append(f"{name}_count{{{label}}} {hist.count}")
             for name, (label_names, help_text) in self._LABELED_GAUGES.items():

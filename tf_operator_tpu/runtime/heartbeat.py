@@ -39,6 +39,8 @@ from ..bootstrap.heartbeat import (
 )
 from ..core.constants import (
     ANNOTATION_HEARTBEAT_CKPT,
+    ANNOTATION_HEARTBEAT_PEER,
+    ANNOTATION_HEARTBEAT_RESTORE,
     ANNOTATION_HEARTBEAT_STEP,
     ANNOTATION_HEARTBEAT_TPS,
 )
@@ -49,7 +51,9 @@ log = logging.getLogger(__name__)
 # ------------------------------------------------------------- publication
 def _progress_annotations(step: Optional[int],
                           tokens_per_sec: Optional[float],
-                          checkpoint_step: Optional[int] = None
+                          checkpoint_step: Optional[int] = None,
+                          peer_addr: Optional[str] = None,
+                          restore: Optional[str] = None
                           ) -> Dict[str, str]:
     """Lease annotations for the workload-reported progress payload."""
     out: Dict[str, str] = {}
@@ -59,6 +63,10 @@ def _progress_annotations(step: Optional[int],
         out[ANNOTATION_HEARTBEAT_TPS] = f"{float(tokens_per_sec):.1f}"
     if checkpoint_step is not None:
         out[ANNOTATION_HEARTBEAT_CKPT] = str(int(checkpoint_step))
+    if peer_addr is not None:
+        out[ANNOTATION_HEARTBEAT_PEER] = str(peer_addr)
+    if restore is not None:
+        out[ANNOTATION_HEARTBEAT_RESTORE] = str(restore)
     return out
 
 
@@ -66,6 +74,8 @@ def publish_heartbeat(cluster, namespace: str, name: str, identity: str,
                       step: Optional[int] = None,
                       tokens_per_sec: Optional[float] = None,
                       checkpoint_step: Optional[int] = None,
+                      peer_addr: Optional[str] = None,
+                      restore: Optional[str] = None,
                       clock=time.time) -> bool:
     """One heartbeat renewal through the Cluster seam. True iff the beat
     landed; False on a lost optimistic-concurrency round (retry next tick).
@@ -96,7 +106,8 @@ def publish_heartbeat(cluster, namespace: str, name: str, identity: str,
             },
         }
         annotations = _progress_annotations(step, tokens_per_sec,
-                                            checkpoint_step)
+                                            checkpoint_step, peer_addr,
+                                            restore)
         if annotations:
             lease["metadata"]["annotations"] = annotations
         try:
@@ -115,7 +126,8 @@ def publish_heartbeat(cluster, namespace: str, name: str, identity: str,
     spec["holderIdentity"] = identity
     spec["renewTime"] = _format_microtime(now)
     new_annotations = _progress_annotations(step, tokens_per_sec,
-                                            checkpoint_step)
+                                            checkpoint_step, peer_addr,
+                                            restore)
     if new_annotations:
         meta = lease.setdefault("metadata", {})
         annotations = meta.get("annotations") or {}
@@ -133,7 +145,9 @@ def publish_heartbeat(cluster, namespace: str, name: str, identity: str,
 
 def write_heartbeat_file(path: str, seq: int, step: Optional[int],
                          tokens_per_sec: Optional[float] = None,
-                         checkpoint_step: Optional[int] = None) -> None:
+                         checkpoint_step: Optional[int] = None,
+                         peer_addr: Optional[str] = None,
+                         restore: Optional[str] = None) -> None:
     """The file half of the process-tier bridge: one JSON object, replaced
     wholesale each beat (write-to-temp + rename so the reader never sees a
     torn write). ``seq`` strictly increases so the bridge can tell a fresh
@@ -144,6 +158,10 @@ def write_heartbeat_file(path: str, seq: int, step: Optional[int],
         payload["tokens_per_sec"] = float(tokens_per_sec)
     if checkpoint_step is not None:
         payload["checkpoint_step"] = int(checkpoint_step)
+    if peer_addr is not None:
+        payload["peer_addr"] = str(peer_addr)
+    if restore is not None:
+        payload["restore"] = str(restore)
     with open(tmp, "w") as fh:
         json.dump(payload, fh)
     os.replace(tmp, path)
@@ -172,10 +190,12 @@ class HeartbeatPublisher:
                  interval: float):
         self._sink = sink
         # Sink arity resolved ONCE here, not per beat via TypeError
-        # probing: a 4-arg-capable sink that raises TypeError internally
+        # probing: a wider-arity sink that raises TypeError internally
         # must not be re-invoked with its side effects doubled. Legacy
-        # 3-arg sinks (pre-checkpoint-rider embedders) keep working,
-        # minus the rider.
+        # 3-arg (pre-checkpoint-rider) and 4-arg (pre-recovery-rider)
+        # sinks keep working, minus the riders they predate. The full
+        # payload is 6 positional: (seq, step, tokens_per_sec,
+        # checkpoint_step, peer_addr, restore).
         import inspect
 
         try:
@@ -185,13 +205,20 @@ class HeartbeatPublisher:
                 if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
             ]
             var_positional = any(p.kind == p.VAR_POSITIONAL for p in params)
-            self._sink_args = 4 if (var_positional or len(positional) >= 4) else 3
+            if var_positional or len(positional) >= 6:
+                self._sink_args = 6
+            elif len(positional) >= 4:
+                self._sink_args = 4
+            else:
+                self._sink_args = 3
         except (TypeError, ValueError):  # builtins/C callables: assume current
-            self._sink_args = 4
+            self._sink_args = 6
         self.interval = max(0.05, float(interval))
         self._step: Optional[int] = None
         self._tokens_per_sec: Optional[float] = None
         self._checkpoint_step: Optional[int] = None
+        self._peer_addr: Optional[str] = None
+        self._restore: Optional[str] = None
         self._seq = 0
         self._wake = threading.Event()
         self._stopped = threading.Event()
@@ -222,12 +249,33 @@ class HeartbeatPublisher:
         self._checkpoint_step = int(step)
         self._wake.set()
 
+    def record_peer_address(self, addr: Optional[str]) -> None:
+        """This rank's shard-server ``host:port`` (runtime/shard_server.py):
+        published as the peer-restore lease annotation so the operator can
+        hand survivor addresses to a recreated slice. None clears nothing —
+        the last advertised address stands until the lease is GC'd with
+        the pod."""
+        if addr is not None:
+            self._peer_addr = str(addr)
+        self._wake.set()
+
+    def record_restore(self, path: str, cause: str, seconds: float) -> None:
+        """Which restore-ladder leg won and why (train/restore.py outcome):
+        published as the compact ``path:cause:seconds`` annotation the
+        controller turns into training_restore_total/seconds."""
+        self._restore = f"{path}:{cause}:{float(seconds):.3f}"
+        self._wake.set()
+
     def beat_once(self) -> None:
         """One synchronous beat (also the loop body): never raises — a
         broken sink must not take the training process down with it."""
         self._seq += 1
         try:
-            if self._sink_args >= 4:
+            if self._sink_args >= 6:
+                self._sink(self._seq, self._step, self._tokens_per_sec,
+                           self._checkpoint_step, self._peer_addr,
+                           self._restore)
+            elif self._sink_args >= 4:
                 self._sink(self._seq, self._step, self._tokens_per_sec,
                            self._checkpoint_step)
             else:
@@ -286,10 +334,14 @@ def start_from_env(cluster=None,
             def sink(seq: int, step: Optional[int],
                      tokens_per_sec: Optional[float] = None,
                      checkpoint_step: Optional[int] = None,
+                     peer_addr: Optional[str] = None,
+                     restore: Optional[str] = None,
                      _path=file_path) -> None:
                 write_heartbeat_file(_path, seq, step,
                                      tokens_per_sec=tokens_per_sec,
-                                     checkpoint_step=checkpoint_step)
+                                     checkpoint_step=checkpoint_step,
+                                     peer_addr=peer_addr,
+                                     restore=restore)
         else:
             if cluster is None and "KUBERNETES_SERVICE_HOST" in env:
                 try:
@@ -305,11 +357,15 @@ def start_from_env(cluster=None,
 
             def sink(seq: int, step: Optional[int],
                      tokens_per_sec: Optional[float] = None,
-                     checkpoint_step: Optional[int] = None, _c=cluster,
+                     checkpoint_step: Optional[int] = None,
+                     peer_addr: Optional[str] = None,
+                     restore: Optional[str] = None, _c=cluster,
                      _ns=namespace, _name=lease, _id=identity) -> None:
                 publish_heartbeat(_c, _ns, _name, _id, step=step,
                                   tokens_per_sec=tokens_per_sec,
-                                  checkpoint_step=checkpoint_step)
+                                  checkpoint_step=checkpoint_step,
+                                  peer_addr=peer_addr,
+                                  restore=restore)
 
         _active = HeartbeatPublisher(sink, interval).start()
         return _active
@@ -337,6 +393,26 @@ def record_checkpoint(step: int) -> None:
     publisher = _active
     if publisher is not None:
         publisher.record_checkpoint(step)
+
+
+def record_peer_address(addr: Optional[str]) -> None:
+    """Training-loop API: this rank serves peer-restore shards at ``addr``
+    ("host:port"). Published as the peer-address lease annotation the
+    operator reads when building a recreated slice's pods. A no-op without
+    an active publisher, like record_progress."""
+    publisher = _active
+    if publisher is not None:
+        publisher.record_peer_address(addr)
+
+
+def record_restore(path: str, cause: str, seconds: float) -> None:
+    """Training-loop API: this rank restored via ``path`` ("peer" /
+    "storage" / "none") for ``cause`` in ``seconds``. Published as the
+    restore-outcome lease annotation for operator metrics. A no-op without
+    an active publisher, like record_progress."""
+    publisher = _active
+    if publisher is not None:
+        publisher.record_restore(path, cause, seconds)
 
 
 def stop() -> None:

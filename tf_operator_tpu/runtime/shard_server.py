@@ -1,0 +1,302 @@
+"""Peer-restore shard server: survivors serve host snapshots over HTTP.
+
+The storage round-trip dominates cold recovery: a recreated slice (PR 11
+slice-local restart) or a grown gang pulls every byte of the train state
+back from the checkpoint bucket even though the surviving ranks hold the
+identical step in host memory (the snapshot half of snapshot-then-persist,
+train/checkpoint.py). This module closes that gap: each rank runs a tiny
+read-only HTTP server over its newest :class:`HostSnapshot` and advertises
+``host:port`` through the heartbeat-lease peer-address rider; a restoring
+rank fetches shards from any advertised survivor and only falls back to
+storage when no peer can serve (train/restore.py owns that ladder).
+
+Deliberately minimal: stdlib ``ThreadingHTTPServer``, numpy ``.npy``
+encoding (self-describing dtype/shape), sha256 checksums end-to-end. Two
+endpoints:
+
+- ``GET /v1/meta``  -> ``{step, model_meta, shards: {name: {checksum,
+  bytes, dtype, shape}}}``
+- ``GET /v1/shard/<name>?step=N`` -> raw ``.npy`` bytes with ``X-Step`` /
+  ``X-Checksum`` headers; 409 when the snapshot rotated past N mid-fetch
+  (the client restarts against fresh meta), 503 when no snapshot exists.
+- ``GET /v1/bundle?step=N`` -> every shard in one response, framed as
+  ``[u32 name-len][name][u64 payload-len][payload]`` repeating in sorted
+  name order. One request instead of one per leaf — request overhead is
+  what lets the storage path catch up on small states, and the frames are
+  written straight from the per-shard cache (no bundled second copy).
+
+The server reads the snapshot through a callable seam (usually
+``CheckpointManager.host_snapshot``) so it always serves the newest step
+without any registration dance, and snapshots are treated as immutable
+once published.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import logging
+import struct
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+
+# ------------------------------------------------------------- wire format
+def flatten_tree(tree: Any) -> Dict[str, Any]:
+    """Name every leaf by its joined key path ("/params/dense/kernel") —
+    the shard namespace both ends of the wire share. Names derive from the
+    pytree structure, so identical TrainState definitions (the peer
+    contract) produce identical names."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return {jax.tree_util.keystr(path): leaf for path, leaf in flat}
+
+
+def encode_shard(array) -> bytes:
+    """numpy .npy serialization: self-describing (dtype+shape ride along),
+    zero-copy-ish, and immune to pickle's cross-version hazards."""
+    import numpy as np
+
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(array), allow_pickle=False)
+    return buf.getvalue()
+
+
+def decode_shard(payload: bytes):
+    import numpy as np
+
+    return np.load(io.BytesIO(payload), allow_pickle=False)
+
+
+def shard_checksum(payload: bytes) -> str:
+    return hashlib.sha256(payload).hexdigest()
+
+
+def parse_bundle(body: bytes) -> Dict[str, bytes]:
+    """Split a ``/v1/bundle`` body back into ``{name: payload}``. Raises
+    OSError on any framing damage (truncation mid-frame) so the restore
+    ladder classifies it like any other transport failure."""
+    out: Dict[str, bytes] = {}
+    off = 0
+    try:
+        while off < len(body):
+            (nlen,) = struct.unpack_from(">I", body, off)
+            off += 4
+            name = body[off:off + nlen].decode("utf-8")
+            off += nlen
+            (plen,) = struct.unpack_from(">Q", body, off)
+            off += 8
+            if off + plen > len(body):
+                raise OSError("bundle truncated mid-payload")
+            out[name] = body[off:off + plen]
+            off += plen
+    except (struct.error, UnicodeDecodeError) as err:
+        raise OSError(f"bundle framing damaged: {err}") from err
+    return out
+
+
+class _SnapshotView:
+    """One snapshot, encoded + checksummed once and cached — meta requests
+    and shard fetches from several restoring peers must not re-hash a
+    multi-GB tree per request."""
+
+    def __init__(self, snapshot) -> None:
+        import numpy as np
+
+        self.step = int(snapshot.step)
+        self.model_meta = snapshot.model_meta
+        flat = flatten_tree(snapshot.tree)
+        self.payloads: Dict[str, bytes] = {
+            name: encode_shard(leaf) for name, leaf in flat.items()
+        }
+        self.checksums = {
+            name: shard_checksum(data) for name, data in self.payloads.items()
+        }
+        self.meta = {
+            "step": self.step,
+            "model_meta": self.model_meta,
+            "shards": {
+                name: {
+                    "checksum": self.checksums[name],
+                    "bytes": len(self.payloads[name]),
+                    "dtype": str(np.asarray(leaf).dtype),
+                    "shape": list(np.asarray(leaf).shape),
+                }
+                for name, leaf in flat.items()
+            },
+        }
+
+
+class SnapshotShardServer:
+    """Read-only shard server over a snapshot source callable.
+
+    ``source()`` returns the newest HostSnapshot (or None); the view cache
+    re-encodes only when the step advances. ``address`` is the
+    ``host:port`` string to advertise via the heartbeat rider."""
+
+    def __init__(self, source: Callable[[], Optional[Any]],
+                 host: str = "127.0.0.1", port: int = 0,
+                 advertise_host: Optional[str] = None) -> None:
+        self._source = source
+        self._lock = threading.Lock()
+        self._view: Optional[_SnapshotView] = None
+        self._advertise_host = advertise_host
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: N802 — stdlib name
+                log.debug("shard-server %s", fmt % args)
+
+            def _send(self, code: int, body: bytes,
+                      content_type: str = "application/json",
+                      headers: Optional[Dict[str, str]] = None) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — stdlib name
+                try:
+                    server._handle(self)
+                except BrokenPipeError:
+                    pass  # restoring peer gave up mid-transfer; its retry
+                    # logic owns the consequence
+                except Exception:  # noqa: BLE001 — one bad request must
+                    # not take down the serving thread pool
+                    log.exception("shard-server request failed")
+                    try:
+                        self._send(500, b"{}")
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="shard-server", daemon=True
+        )
+
+    # ------------------------------------------------------------ control
+    def start(self) -> "SnapshotShardServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def address(self) -> str:
+        host = self._advertise_host or self._httpd.server_address[0]
+        return f"{host}:{self.port}"
+
+    def warm(self) -> None:
+        """Build the view for the current snapshot off the request path.
+        Wired to the checkpoint durability listener so the encode+hash cost
+        is paid once at save time, not on the critical restore path of the
+        first peer that asks."""
+        threading.Thread(
+            target=self._current_view, name="shard-server-warm", daemon=True
+        ).start()
+
+    # ------------------------------------------------------------ serving
+    def _current_view(self) -> Optional[_SnapshotView]:
+        snapshot = self._source()
+        if snapshot is None:
+            return None
+        with self._lock:
+            if self._view is None or self._view.step != int(snapshot.step):
+                self._view = _SnapshotView(snapshot)
+            return self._view
+
+    def _handle(self, request) -> None:
+        parsed = urllib.parse.urlparse(request.path)
+        view = self._current_view()
+        if parsed.path == "/v1/meta":
+            if view is None:
+                request._send(503, json.dumps(
+                    {"error": "no-snapshot"}).encode())
+                return
+            request._send(200, json.dumps(view.meta).encode())
+            return
+        if parsed.path.startswith("/v1/shard/"):
+            if view is None:
+                request._send(503, json.dumps(
+                    {"error": "no-snapshot"}).encode())
+                return
+            name = urllib.parse.unquote(parsed.path[len("/v1/shard/"):])
+            query = urllib.parse.parse_qs(parsed.query)
+            want_step = query.get("step", [None])[0]
+            if want_step is not None and int(want_step) != view.step:
+                # Snapshot rotated while the client iterated its shard
+                # list; a mixed-step reassembly would be silent corruption.
+                request._send(409, json.dumps(
+                    {"error": "step-rotated", "step": view.step}).encode())
+                return
+            payload = view.payloads.get(name)
+            if payload is None:
+                request._send(404, json.dumps(
+                    {"error": "unknown-shard"}).encode())
+                return
+            request._send(
+                200, payload, content_type="application/octet-stream",
+                headers={"X-Step": str(view.step),
+                         "X-Checksum": view.checksums[name]},
+            )
+            return
+        if parsed.path == "/v1/bundle":
+            if view is None:
+                request._send(503, json.dumps(
+                    {"error": "no-snapshot"}).encode())
+                return
+            query = urllib.parse.parse_qs(parsed.query)
+            want_step = query.get("step", [None])[0]
+            if want_step is not None and int(want_step) != view.step:
+                request._send(409, json.dumps(
+                    {"error": "step-rotated", "step": view.step}).encode())
+                return
+            names = sorted(view.payloads)
+            total = sum(
+                4 + len(n.encode("utf-8")) + 8 + len(view.payloads[n])
+                for n in names
+            )
+            request.send_response(200)
+            request.send_header("Content-Type", "application/octet-stream")
+            request.send_header("Content-Length", str(total))
+            request.send_header("X-Step", str(view.step))
+            request.end_headers()
+            for n in names:
+                encoded = n.encode("utf-8")
+                request.wfile.write(struct.pack(">I", len(encoded)))
+                request.wfile.write(encoded)
+                request.wfile.write(struct.pack(">Q", len(view.payloads[n])))
+                request.wfile.write(view.payloads[n])
+            return
+        request._send(404, json.dumps({"error": "unknown-path"}).encode())
+
+
+def start_shard_server(checkpoint_manager, host: str = "127.0.0.1",
+                       port: int = 0) -> SnapshotShardServer:
+    """Start a shard server over a CheckpointManager's host snapshot and
+    return it (``.address`` is the rider payload for record_peer_address).
+    Each durable save warms the view cache so restoring peers never pay
+    the encode+hash cost inline."""
+    server = SnapshotShardServer(checkpoint_manager.host_snapshot,
+                                 host=host, port=port).start()
+    try:
+        checkpoint_manager.add_durability_listener(lambda _step: server.warm())
+    except AttributeError:
+        pass  # bare snapshot sources (tests) have no listener seam
+    return server

@@ -1,27 +1,83 @@
-"""Checkpoint/resume for training workloads (orbax).
+"""Checkpoint/resume for training workloads (orbax) — snapshot-then-persist.
 
 The reference deliberately keeps checkpointing OUT of the operator
 (SURVEY.md §5.4): restart semantics assume the framework resumes from its
 own checkpoints, and the operator only contributes restart orchestration
 plus stable identities. This module is the workload half of that contract:
-sharded async orbax checkpoints keyed by step, so a replica recreated by
-the ExitCode restart policy resumes exactly where the gang left off.
+sharded checkpoints keyed by step, so a replica recreated by the ExitCode
+restart policy resumes exactly where the gang left off.
 
-TPU-first: saves are async (training continues while the previous state
-streams to storage) and restores are sharding-aware (each host reads only
-its own shards — no host ever materializes the full 7B state).
+Save is split into two phases (docs/design/checkpoint_recovery.md):
+
+- **snapshot** — a synchronous device→host copy taken at the step boundary.
+  Training resumes the moment it returns; the host copy is also retained
+  in memory as the shard source for peer-to-peer restore
+  (runtime/shard_server.py).
+- **persist** — a background write of that host copy to storage. A step is
+  DURABLE only once the persist is finalized (orbax's atomic rename), and
+  only then do the durability listeners fire. ``record_checkpoint`` — the
+  signal the operator's checkpoint-gated elastic shrink consumes — must be
+  registered as a listener, never called after ``save()`` returns: the
+  return only proves the snapshot, and publishing a step whose persist is
+  still in flight lets the autoscaler shrink against a checkpoint that a
+  crash in the persist window erases.
+
+States that are not fully process-addressable (multi-host sharded worlds)
+cannot be host-snapshotted by one process; those saves go straight through
+orbax's async machinery (training still resumes immediately) and the
+durability listeners still fire only after ``wait_until_finished`` — but
+there is no host snapshot to serve peers from (``host_snapshot()`` is
+None and restores degrade to the storage path).
 """
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+import logging
+import os
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Tuple
 
 import jax
+
+log = logging.getLogger(__name__)
+
+
+def geometry_mismatch(saved: Optional[dict], current: Optional[dict]) -> dict:
+    """Keys whose recorded and current model geometry disagree — the
+    guard against configs with identical flattened kernel shapes but
+    different head grouping loading each other's checkpoints and silently
+    computing differently-grouped attention (ADVICE r2). Shared by the
+    storage sidecar check and the peer-restore meta check."""
+    if not saved or not current:
+        return {}
+    return {
+        k: (saved[k], current[k])
+        for k in saved.keys() & current.keys()
+        if saved[k] != current[k]
+    }
+
+
+@dataclass
+class HostSnapshot:
+    """One step's host-resident state copy: the peer-restore shard source.
+    ``tree`` is the TrainState structure with numpy leaves; treated as
+    immutable once published (the shard server may be mid-serve)."""
+
+    step: int
+    tree: Any
+    model_meta: Optional[dict] = None
+    # Monotonic publication stamp (diagnostics only — never compared
+    # across hosts).
+    taken_at: float = field(default_factory=time.monotonic)
 
 
 class CheckpointManager:
     """Thin wrapper over orbax CheckpointManager bound to one TrainState
-    sharding, so save/restore round-trips preserve the mesh layout."""
+    sharding, so save/restore round-trips preserve the mesh layout —
+    plus the snapshot/persist split and the durability barrier."""
 
     def __init__(
         self,
@@ -30,9 +86,9 @@ class CheckpointManager:
         max_to_keep: int = 3,
         save_interval_steps: int = 1,
         model_meta: Optional[dict] = None,
+        async_persist: Optional[bool] = None,
+        on_durable: Optional[Callable[[int], None]] = None,
     ):
-        import os
-
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
@@ -45,6 +101,11 @@ class CheckpointManager:
         # guard (ADVICE r2).
         self._model_meta = model_meta
         self._meta_path = os.path.join(os.path.abspath(directory), "model_meta.json")
+        if async_persist is None:
+            async_persist = os.environ.get(
+                "TF_OPERATOR_SYNC_CHECKPOINT", ""
+            ) not in ("1", "true", "yes")
+        self.async_persist = bool(async_persist)
         self._mgr = ocp.CheckpointManager(
             os.path.abspath(directory),  # orbax requires absolute paths
             options=ocp.CheckpointManagerOptions(
@@ -53,31 +114,47 @@ class CheckpointManager:
                 enable_async_checkpointing=True,
             ),
         )
+        # Durability plumbing. The worker thread owns the persist tail:
+        # it (re)issues the orbax save for host snapshots, waits for the
+        # finalize, THEN advances last_durable_step and fires listeners —
+        # the only place either ever happens, so a listener can never
+        # observe a step whose bytes are not committed.
+        self._listeners: List[Callable[[int], None]] = []
+        if on_durable is not None:
+            self._listeners.append(on_durable)
+        self._durable_lock = threading.Lock()
+        self._last_durable: Optional[int] = None
+        self._last_snapshot_step: Optional[int] = None
+        self._snapshot: Optional[HostSnapshot] = None
+        self._persist_queue: "queue.Queue[tuple]" = queue.Queue()
+        self._persist_thread: Optional[threading.Thread] = None
+        self._persist_errors = 0
+        self._closed = False
+        # Test seam: called in the persist worker between the snapshot
+        # and the storage write — the crash-in-persist-window regressions
+        # block or raise here to hold a step non-durable deterministically.
+        self._persist_gate: Optional[Callable[[int], None]] = None
 
+    # ----------------------------------------------------------- sidecar
     def _write_meta(self) -> None:
         import json
-        import os
 
         if self._model_meta is None or os.path.exists(self._meta_path):
             return
         tmp = f"{self._meta_path}.tmp.{os.getpid()}"
+        os.makedirs(os.path.dirname(self._meta_path), exist_ok=True)
         with open(tmp, "w") as f:
             json.dump(self._model_meta, f, sort_keys=True)
         os.replace(tmp, self._meta_path)
 
     def _validate_meta(self) -> None:
         import json
-        import os
 
         if self._model_meta is None or not os.path.exists(self._meta_path):
             return
         with open(self._meta_path) as f:
             on_disk = json.load(f)
-        mismatched = {
-            k: (on_disk[k], self._model_meta[k])
-            for k in on_disk.keys() & self._model_meta.keys()
-            if on_disk[k] != self._model_meta[k]
-        }
+        mismatched = geometry_mismatch(on_disk, self._model_meta)
         if mismatched:
             raise ValueError(
                 "checkpoint model geometry mismatch (saved vs current): "
@@ -85,55 +162,210 @@ class CheckpointManager:
                 "under different head/layer geometries in one directory"
             )
 
+    # ------------------------------------------------------ durability
+    def add_durability_listener(self, cb: Callable[[int], None]) -> None:
+        """Register cb(step), fired once per step AFTER its persist is
+        finalized on storage — the only correct place to publish the
+        checkpoint-step heartbeat rider (``record_checkpoint``)."""
+        self._listeners.append(cb)
+
+    def last_durable_step(self) -> Optional[int]:
+        """Newest step this manager has FINALIZED on storage in this
+        process's lifetime (None before the first persist completes —
+        distinct from latest_step(), which also sees pre-existing
+        checkpoints in the directory)."""
+        with self._durable_lock:
+            return self._last_durable
+
+    def _mark_durable(self, step: int, persist_seconds: float) -> None:
+        with self._durable_lock:
+            if self._last_durable is None or step > self._last_durable:
+                self._last_durable = step
+        try:
+            from ..metrics import METRICS
+
+            METRICS.observe_checkpoint_persist(persist_seconds)
+        except Exception:  # noqa: BLE001 — telemetry never gates durability
+            pass
+        for cb in list(self._listeners):
+            try:
+                cb(step)
+            except Exception:  # noqa: BLE001 — a broken listener must not
+                # wedge the persist worker (later steps still need it).
+                log.exception("checkpoint durability listener failed")
+
+    def _persist_loop(self) -> None:
+        while True:
+            item = self._persist_queue.get()
+            try:
+                if item[0] == "stop":
+                    return
+                kind, step, tree, t0 = item
+                try:
+                    if self._persist_gate is not None:
+                        self._persist_gate(step)
+                    if kind == "save":
+                        # Host-snapshot path: the write itself happens
+                        # here, off the training thread. force=True — the
+                        # should_save decision was taken at snapshot time.
+                        self._mgr.save(
+                            step,
+                            args=self._ocp.args.StandardSave(tree),
+                            force=True,
+                        )
+                    # Both paths: durable only once orbax finalizes.
+                    self._mgr.wait_until_finished()
+                except Exception:  # noqa: BLE001
+                    self._persist_errors += 1
+                    log.exception(
+                        "checkpoint persist for step %s failed — the step "
+                        "is NOT durable and will never be published", step
+                    )
+                    continue
+                self._mark_durable(step, time.perf_counter() - t0)
+            finally:
+                self._persist_queue.task_done()
+
+    def _ensure_worker(self) -> None:
+        if self._persist_thread is None:
+            self._persist_thread = threading.Thread(
+                target=self._persist_loop, name="ckpt-persist", daemon=True
+            )
+            self._persist_thread.start()
+
+    # -------------------------------------------------------- snapshot
+    @staticmethod
+    def _to_host(state) -> Optional[Any]:
+        """Device→host copy of a fully process-addressable state; None when
+        any leaf is sharded beyond this process (multi-host worlds — no
+        single host can serve the full tree)."""
+        import numpy as np
+
+        leaves = jax.tree_util.tree_leaves(state)
+        for leaf in leaves:
+            if hasattr(leaf, "is_fully_addressable") and not leaf.is_fully_addressable:
+                return None
+        return jax.tree.map(lambda leaf: np.asarray(jax.device_get(leaf)), state)
+
+    def host_snapshot(self) -> Optional[HostSnapshot]:
+        """The newest host-resident snapshot (the peer-restore shard
+        source), or None when no fully-addressable save happened yet. May
+        be ahead of last_durable_step(): a snapshot is servable the moment
+        it exists — the restore arbitration compares steps, not
+        durability."""
+        return self._snapshot
+
+    # ------------------------------------------------------------ save
     def save(self, state, force: bool = False) -> bool:
-        """Async save at the state's own step counter. A step that is
+        """Snapshot now, persist in the background. Returns True iff the
+        step was accepted (snapshot taken + persist scheduled); the step
+        is durable only when the durability listeners fire. A step that is
         already on disk is a no-op (a final flush after a periodic save
         lands on the same step)."""
         step = int(jax.device_get(state.step))
-        if self._mgr.latest_step() == step:
+        if self._mgr.latest_step() == step or self._last_snapshot_step == step:
+            return False
+        if not force and not self._mgr.should_save(step):
             return False
         # Save-only runs reusing a directory must not mix geometries under
         # one sidecar: validate against any existing record before writing.
         self._validate_meta()
-        saved = self._mgr.save(
-            step, args=self._ocp.args.StandardSave(state), force=force
-        )
-        if saved:
-            self._write_meta()
-        return saved
+        t0 = time.perf_counter()
+        host_tree = self._to_host(state)
+        self._last_snapshot_step = step
+        if host_tree is not None:
+            self._snapshot = HostSnapshot(
+                step=step, tree=host_tree, model_meta=self._model_meta
+            )
+            if self.async_persist:
+                self._ensure_worker()
+                self._persist_queue.put(("save", step, host_tree, t0))
+            else:
+                self._mgr.save(
+                    step, args=self._ocp.args.StandardSave(host_tree),
+                    force=True,
+                )
+                self._mgr.wait_until_finished()
+                self._mark_durable(step, time.perf_counter() - t0)
+        else:
+            # Multi-host sharded state: every process contributes its own
+            # shards through orbax's async machinery (returns after ITS
+            # device→host snapshot), and the worker turns the finalize
+            # into the durability edge.
+            self._mgr.save(
+                step, args=self._ocp.args.StandardSave(state), force=True
+            )
+            if self.async_persist:
+                self._ensure_worker()
+                self._persist_queue.put(("finalize", step, None, t0))
+            else:
+                self._mgr.wait_until_finished()
+                self._mark_durable(step, time.perf_counter() - t0)
+        self._write_meta()
+        return True
 
+    # --------------------------------------------------------- restore
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
-    def restore_latest(self, state) -> Tuple[Any, Optional[int]]:
-        """Restore the newest checkpoint into `state`'s structure/shardings;
-        returns (state, step) — (input unchanged, None) when no checkpoint
-        exists yet (first boot of the job)."""
-        step = self._mgr.latest_step()
-        if step is None:
-            return state, None
-        self._validate_meta()
+    def abstract_state(self, state):
+        """`state`'s structure as ShapeDtypeStructs carrying the target
+        shardings — what StandardRestore (and the peer-restore assembly)
+        place restored values onto."""
 
         def as_abstract(leaf, shard):
             return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=shard)
 
         if self.sharding is not None:
-            abstract = jax.tree.map(as_abstract, state, self.sharding)
-        else:
-            abstract = jax.tree.map(
-                lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=leaf.sharding)
-                if hasattr(leaf, "sharding")
-                else jax.ShapeDtypeStruct(leaf.shape, leaf.dtype),
-                state,
-            )
+            return jax.tree.map(as_abstract, state, self.sharding)
+        return jax.tree.map(
+            lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=leaf.sharding)
+            if hasattr(leaf, "sharding")
+            else jax.ShapeDtypeStruct(leaf.shape, leaf.dtype),
+            state,
+        )
+
+    def restore_latest(self, state) -> Tuple[Any, Optional[int]]:
+        """Restore the newest checkpoint into `state`'s structure/shardings;
+        returns (state, step) — (input unchanged, None) when no checkpoint
+        exists yet (first boot of the job). This is the STORAGE leg of the
+        restore ladder; train/restore.py composes it with the peer path."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return state, None
+        self._validate_meta()
         restored = self._mgr.restore(
-            step, args=self._ocp.args.StandardRestore(abstract)
+            step, args=self._ocp.args.StandardRestore(self.abstract_state(state))
         )
         return restored, step
 
+    # -------------------------------------------------------- shutdown
     def wait(self) -> None:
+        """Drain: every scheduled persist is finalized (and its listeners
+        fired) when this returns."""
+        if self._persist_thread is not None:
+            self._persist_queue.join()
         self._mgr.wait_until_finished()
 
     def close(self) -> None:
+        """Shutdown hygiene: drain the persist queue, stop the worker, and
+        close orbax — a completing (or failing) job must never exit with
+        an in-flight async write, or the newest checkpoint it believes it
+        took is a torn tmp dir. Idempotent; safe on half-constructed
+        managers (__exit__ runs on any error path)."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._persist_thread is not None:
+            self._persist_queue.join()
+            self._persist_queue.put(("stop",))
+            self._persist_thread.join(timeout=60.0)
+            self._persist_thread = None
         self._mgr.wait_until_finished()
         self._mgr.close()
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

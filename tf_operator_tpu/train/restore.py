@@ -1,0 +1,343 @@
+"""Restore ladder: peer-to-peer shard fetch with degraded storage fallback.
+
+A recreated slice or a grown gang restores from, in order of preference:
+
+1. **peer** — a survivor rank's host-resident snapshot, fetched over the
+   runtime/shard_server.py wire (discovered via the heartbeat-lease
+   peer-address rider, injected by the operator as
+   ``TPU_PEER_RESTORE_ADDRS``). Skips the storage round-trip entirely.
+2. **storage** — the orbax checkpoint directory
+   (``CheckpointManager.restore_latest``), whenever the peer path degrades.
+3. **none** — fresh state (first boot: no peers AND no checkpoint).
+
+Degradations and their recorded causes (metrics label + fault log):
+
+- ``no-peers``           — no addresses advertised (peer path not enabled,
+                           or every survivor died with the slice)
+- ``peer-unreachable``   — connect refused / per-peer timeout after
+                           retry-with-backoff on every peer
+- ``partial-snapshot``   — a peer answered but holds no servable snapshot
+                           (multi-host sharded state, or pre-first-save)
+- ``stale-snapshot``     — the best peer's step is strictly older than
+                           storage's newest checkpoint; storage wins
+- ``checksum-mismatch``  — a shard failed sha256 verification (truncated
+                           or corrupted in flight) and retries didn't heal
+
+One failure is NOT a degradation: a ``model_meta`` geometry mismatch on
+the peer path hard-fails (:class:`GeometryMismatch`). A peer serving a
+differently-grouped attention layout is a config error — silently falling
+back to storage would mask it and let a mixed-geometry gang train (the
+exact hazard the sidecar check guards on the storage path).
+
+Everything network-shaped goes through the ``fetcher`` seam so chaos tests
+and the seeded :class:`~tf_operator_tpu.cluster.chaos.RestoreFaultInjector`
+can fault the path deterministically without sockets.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+log = logging.getLogger(__name__)
+
+
+class GeometryMismatch(ValueError):
+    """Peer snapshot was trained under a different model geometry — a
+    config error, never recoverable by falling back (see module doc)."""
+
+
+@dataclass
+class RestoreOutcome:
+    """What the ladder decided, for metrics + the restore heartbeat rider."""
+
+    state: Any
+    step: Optional[int]
+    path: str          # "peer" | "storage" | "none"
+    cause: str         # "ok" on the happy paths, degradation cause otherwise
+    seconds: float
+    peer: Optional[str] = None  # winning peer address, peer path only
+
+
+# ---------------------------------------------------------------- transport
+def http_fetch(peer: str, path: str, timeout: float) -> Tuple[int, Dict[str, str], bytes]:
+    """Default fetcher: one GET against ``http://<peer><path>``. Returns
+    (status, headers, body); raises OSError/TimeoutError on transport
+    failure — exactly what the retry loop classifies."""
+    import urllib.error
+    import urllib.request
+
+    url = f"http://{peer}{path}"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as err:  # non-2xx still has a status
+        return err.code, dict(err.headers or {}), err.read() or b""
+
+
+def _fetch_with_retry(fetcher, peer: str, peer_index: int, path: str, *,
+                      op: str, timeout: float, retries: int, backoff: float,
+                      fault_injector=None, sleep=time.sleep):
+    """Retry-with-backoff around one logical fetch. Seeded faults are
+    consulted per attempt, so an ``at_call``-windowed fault can refuse the
+    first attempt and let the retry through (transient-fault shape) or
+    out-live the retry budget (hard-fault shape)."""
+    last_err: Optional[BaseException] = None
+    for attempt in range(retries + 1):
+        if fault_injector is not None:
+            kind = fault_injector.fault_for(op, peer_index)
+            if kind == "refuse":
+                last_err = ConnectionRefusedError("injected: connection refused")
+                sleep(backoff * (2 ** attempt))
+                continue
+            if kind == "hang":
+                # A hang IS a timeout from the client's point of view: the
+                # injector records it and the ladder sees the same
+                # TimeoutError a dead-but-accepting peer would produce
+                # (no real sleep — tests stay fast and deterministic).
+                last_err = TimeoutError("injected: peer hang (timeout)")
+                sleep(backoff * (2 ** attempt))
+                continue
+        try:
+            status, headers, body = fetcher(peer, path, timeout)
+        except (OSError, TimeoutError) as err:
+            last_err = err
+            sleep(backoff * (2 ** attempt))
+            continue
+        if fault_injector is not None and op == "shard":
+            kind = fault_injector.fault_for("shard-body", peer_index)
+            if kind == "truncate" and body:
+                body = body[: max(0, len(body) // 2)]
+        return status, headers, body
+    raise last_err if last_err is not None else OSError("fetch failed")
+
+
+# ------------------------------------------------------------------ ladder
+def _assemble(abstract, shards: Dict[str, Any]):
+    """Reassemble a restored state: every abstract leaf takes its
+    same-named fetched array, placed onto the leaf's target sharding."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(abstract)
+    leaves = []
+    for path, leaf in flat:
+        name = jax.tree_util.keystr(path)
+        if name not in shards:
+            raise KeyError(name)
+        value = shards[name]
+        if tuple(value.shape) != tuple(leaf.shape):
+            raise GeometryMismatch(
+                f"peer shard {name} has shape {tuple(value.shape)} but the "
+                f"local state expects {tuple(leaf.shape)} — refusing a "
+                "mixed-geometry restore"
+            )
+        value = value.astype(leaf.dtype)
+        sharding = getattr(leaf, "sharding", None)
+        leaves.append(
+            jax.device_put(value, sharding) if sharding is not None
+            else jax.numpy.asarray(value)
+        )
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _restore_from_peer(state, ckpt, peer: str, peer_index: int, meta: dict, *,
+                       fetcher, timeout: float, retries: int, backoff: float,
+                       fault_injector, sleep) -> Any:
+    """Fetch + verify + reassemble one peer's snapshot. Raises on any
+    failure; the caller owns fallback."""
+    from urllib.parse import quote
+
+    from ..runtime.shard_server import decode_shard, shard_checksum
+
+    step = int(meta["step"])
+
+    def fetch_one(name: str):
+        expect = meta["shards"][name]["checksum"]
+        status, _, body = _fetch_with_retry(
+            fetcher, peer, peer_index,
+            f"/v1/shard/{quote(name)}?step={step}",
+            op="shard", timeout=timeout, retries=retries, backoff=backoff,
+            fault_injector=fault_injector, sleep=sleep,
+        )
+        if status != 200:
+            raise OSError(f"peer {peer} returned {status} for shard {name}")
+        if shard_checksum(body) != expect:
+            raise ChecksumMismatch(
+                f"shard {name} from {peer} failed sha256 verification"
+            )
+        return decode_shard(body)
+
+    names = sorted(meta["shards"])
+    shards: Dict[str, Any] = {}
+    if fault_injector is not None:
+        # Sorted, sequential, per-shard: the seeded fault injector counts
+        # calls, and byte-equal replay needs the same request sequence
+        # every run.
+        for name in names:
+            shards[name] = fetch_one(name)
+        return _assemble(ckpt.abstract_state(state), shards)
+
+    # Production path: one bundle request for the whole tree — per-request
+    # overhead is what lets the storage path catch up on small states.
+    # Every framed payload is still verified against the meta checksum, so
+    # integrity semantics match the per-shard wire exactly.
+    from ..runtime.shard_server import parse_bundle
+
+    status, _, body = _fetch_with_retry(
+        fetcher, peer, peer_index, f"/v1/bundle?step={step}",
+        op="bundle", timeout=timeout, retries=retries, backoff=backoff,
+        fault_injector=fault_injector, sleep=sleep,
+    )
+    if status == 404:
+        # Older peer without the bundle endpoint: per-shard wire.
+        for name in names:
+            shards[name] = fetch_one(name)
+        return _assemble(ckpt.abstract_state(state), shards)
+    if status != 200:
+        raise OSError(f"peer {peer} returned {status} for bundle")
+    frames = parse_bundle(body)
+    for name in names:
+        payload = frames.get(name)
+        if payload is None:
+            raise OSError(f"peer {peer} bundle missing shard {name}")
+        if shard_checksum(payload) != meta["shards"][name]["checksum"]:
+            raise ChecksumMismatch(
+                f"shard {name} from {peer} failed sha256 verification"
+            )
+        shards[name] = decode_shard(payload)
+    return _assemble(ckpt.abstract_state(state), shards)
+
+
+class ChecksumMismatch(OSError):
+    """A fetched shard's bytes don't hash to the advertised checksum."""
+
+
+def restore_with_fallback(
+    state,
+    ckpt,
+    peers: Sequence[str] = (),
+    *,
+    model_meta: Optional[dict] = None,
+    timeout: float = 5.0,
+    retries: int = 2,
+    backoff: float = 0.2,
+    fetcher: Callable[[str, str, float], Tuple[int, Dict[str, str], bytes]] = http_fetch,
+    fault_injector=None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> RestoreOutcome:
+    """Run the restore ladder (module doc) and return the outcome.
+
+    ``peers`` are ``host:port`` strings in discovery order; ``model_meta``
+    is the local geometry to validate peer metas against (defaults to the
+    checkpoint manager's); ``fetcher``/``fault_injector``/``sleep`` are the
+    determinism seams.
+    """
+    from .checkpoint import geometry_mismatch
+
+    t0 = time.perf_counter()
+    if model_meta is None:
+        model_meta = getattr(ckpt, "_model_meta", None)
+    storage_step = ckpt.latest_step()
+
+    cause = "no-peers"
+    best: Optional[Tuple[int, str, dict]] = None  # (peer_index, peer, meta)
+    import json
+
+    for index, peer in enumerate(peers):
+        try:
+            status, _, body = _fetch_with_retry(
+                fetcher, peer, index, "/v1/meta", op="meta",
+                timeout=timeout, retries=retries, backoff=backoff,
+                fault_injector=fault_injector, sleep=sleep,
+            )
+        except (OSError, TimeoutError):
+            cause = "peer-unreachable"
+            log.warning("peer %s unreachable for restore meta", peer)
+            continue
+        if status == 503:
+            cause = "partial-snapshot"
+            continue
+        if status != 200:
+            cause = "peer-unreachable"
+            continue
+        try:
+            meta = json.loads(body)
+        except ValueError:
+            cause = "peer-unreachable"
+            continue
+        if fault_injector is not None:
+            kind = fault_injector.fault_for("meta-body", index)
+            if kind == "stale-meta":
+                # The snapshot a real straggler would serve: one step
+                # behind whatever storage has finalized.
+                meta = dict(meta)
+                meta["step"] = (storage_step if storage_step is not None
+                                else int(meta["step"])) - 1
+        mismatched = geometry_mismatch(meta.get("model_meta"), model_meta)
+        if mismatched:
+            raise GeometryMismatch(
+                "peer checkpoint model geometry mismatch (peer vs local): "
+                f"{mismatched} from {peer} — a mixed-geometry gang is a "
+                "config error; refusing to fall back silently"
+            )
+        if best is None or int(meta["step"]) > int(best[2]["step"]):
+            best = (index, peer, meta)
+
+    if best is not None:
+        index, peer, meta = best
+        peer_step = int(meta["step"])
+        if storage_step is not None and peer_step < storage_step:
+            cause = "stale-snapshot"
+            log.warning(
+                "peer snapshot step %d staler than storage step %d; "
+                "falling back to storage", peer_step, storage_step,
+            )
+        else:
+            try:
+                restored = _restore_from_peer(
+                    state, ckpt, peer, index, meta,
+                    fetcher=fetcher, timeout=timeout, retries=retries,
+                    backoff=backoff, fault_injector=fault_injector,
+                    sleep=sleep,
+                )
+            except GeometryMismatch:
+                raise
+            except ChecksumMismatch as err:
+                cause = "checksum-mismatch"
+                log.warning("peer restore degraded: %s", err)
+            except (OSError, TimeoutError, KeyError, ValueError) as err:
+                cause = "peer-unreachable"
+                log.warning("peer restore degraded: %s", err)
+            else:
+                outcome = RestoreOutcome(
+                    state=restored, step=peer_step, path="peer", cause="ok",
+                    seconds=time.perf_counter() - t0, peer=peer,
+                )
+                _observe(outcome)
+                return outcome
+
+    restored, step = ckpt.restore_latest(state)
+    if step is None:
+        outcome = RestoreOutcome(
+            state=state, step=None, path="none", cause=cause,
+            seconds=time.perf_counter() - t0,
+        )
+    else:
+        outcome = RestoreOutcome(
+            state=restored, step=step, path="storage",
+            cause="ok" if cause == "no-peers" and not peers else cause,
+            seconds=time.perf_counter() - t0,
+        )
+    _observe(outcome)
+    return outcome
+
+
+def _observe(outcome: RestoreOutcome) -> None:
+    try:
+        from ..metrics import METRICS
+
+        METRICS.observe_restore(outcome.path, outcome.cause, outcome.seconds)
+    except Exception:  # noqa: BLE001 — telemetry never gates a restore
+        pass
