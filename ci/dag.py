@@ -291,6 +291,26 @@ def default_dag() -> List[Step]:
              [PY, "scripts/measure_control_plane.py", "--mode", "scale",
               "--smoke", "--fleet-only"],
              deps=["shard-failover"], retries=3),
+        # Fleet digital twin tier (docs/design/fleet_simulation.md): the
+        # trace-driven discrete-event simulator that runs the REAL
+        # admission/autoscaler/sharding stack on ONE virtual clock —
+        # clock-injection audit, seeded trace/scenario determinism, the
+        # checked-in storm corpus replaying byte-identically, and the
+        # fleet-level invariants (conservation, aggregate exactly-once,
+        # lost-wakeup, fleet-wide capacity). The 100k x 1k-tenant leg
+        # is @slow.
+        Step("fleet-sim",
+             pytest + ["tests/test_fleetsim.py", "-m", "not slow"],
+             deps=["admission-chaos"]),
+        # The composed-storm smoke gate: 5k jobs / 64 tenants through
+        # capacity revocation + slice preemption + a lease steal on a
+        # 4-shard ring, 3 runs byte-equal, every invariant sweep green,
+        # virtual-time compression >=100x (zero wall-clock sleeps),
+        # wall time ratcheted via build/fleetsim_smoke_last.json.
+        Step("fleet-sim-smoke",
+             [PY, "scripts/measure_control_plane.py", "--mode",
+              "fleet-sim", "--smoke"],
+             deps=["fleet-sim"], retries=3),
         # Tracing tier (docs/design/tracing.md): deterministic-ID span
         # timelines + apiserver request accounting — Tracer semantics,
         # the accounting proxy's 1:1 pass-through, the /tracez and

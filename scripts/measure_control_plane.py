@@ -2758,6 +2758,141 @@ def recovery_main(smoke=False) -> int:
     return rc
 
 
+# --------------------------------------------------------------------------
+# --mode fleet-sim: the fleet digital twin. A trace-driven discrete-event
+# simulation (tf_operator_tpu/testing/fleetsim.py) drives the REAL
+# admission/autoscaler/sharding stack over the in-memory cluster on ONE
+# virtual clock — zero wall-clock sleeps — at fleet scale (5k jobs / 64
+# tenants in the smoke gate; the 100k x 1k-tenant leg lives behind the
+# slow test tier). Emits the same makespan/utilization/fairness table as
+# the live benches plus the report-only hot-path columns (policy-pump
+# seconds per call, watch-cache resident objects, decision-log volume)
+# that ROADMAP predicts become the 100k-scale optimization targets.
+
+FLEETSIM_BASELINE_PATH = os.path.join(
+    REPO, "build", "fleetsim_smoke_last.json")
+FLEETSIM_MIN_COMPRESSION = 100.0   # virtual seconds per wall second, floor
+FLEETSIM_REPLAY_RUNS = 3           # byte-equal digest runs in the smoke gate
+FLEETSIM_WALL_REGRESSION = 2.0     # run-over-run wall-time ratchet
+
+
+def _fleet_sim_row(report) -> dict:
+    hot = report["hot_paths"]
+    return {
+        "scenario": report["scenario"],
+        "jobs": report["jobs"],
+        "tenants": report["tenants"],
+        "completed": report["completed"],
+        "makespan_s": report["makespan_s"],
+        "utilization": report["utilization"],
+        "fairness_jain": report["fairness_jain"],
+        "preemptions": report["preemptions"],
+        "slice_restarts": report["slice_restarts"],
+        "resizes": report["resizes"],
+        "virtual_horizon_s": report["virtual_horizon_s"],
+        "wall_s": report["wall_s"],
+        "compression_x": report["compression_x"],
+        "invariant_sweeps": report["invariant_sweeps"],
+        "invariant_violations": len(report["invariant_violations"]),
+        # Report-only hot-path columns (never gated: they are the
+        # optimization targets, and gating them would ratchet noise).
+        "pump_seconds_per_call": hot["pump_seconds_per_call"],
+        "autoscaler_decide_seconds_per_call": (
+            hot["autoscaler_decide_seconds_per_call"]),
+        "watch_cache_resident_objects_peak": (
+            hot["watch_cache_resident_objects_peak"]),
+        "decision_log_entries": hot["decision_log_entries"],
+        "digest": report["digest"],
+    }
+
+
+def fleet_sim_main(smoke=False, scenario_path=None) -> int:
+    from tf_operator_tpu.testing.fleetsim import (
+        FleetSim, Scenario, builtin_scenarios, load_scenario,
+        smoke_scenario,
+    )
+
+    regressions = []
+    rows = []
+
+    if scenario_path:
+        # One user-supplied scenario: run it, and prove the DSL
+        # round-trips (load -> dump -> load == load) so a checked-in
+        # scenario file can't silently fork from what actually ran.
+        scenario = load_scenario(scenario_path)
+        if Scenario.from_json(scenario.to_json()) != scenario:
+            regressions.append(
+                f"scenario {scenario.name} does not survive its own "
+                "JSON round-trip")
+        report = FleetSim(scenario).run()
+        rows.append(_fleet_sim_row(report))
+        if report["invariant_violations"]:
+            regressions.extend(report["invariant_violations"][:10])
+    elif smoke:
+        # The CI gate: the composed storm (capacity revocation + slice
+        # preemption + a lease steal on a 4-shard ring) at 5k jobs / 64
+        # tenants, run FLEETSIM_REPLAY_RUNS times — every run must be
+        # green, byte-identical, and >= 100x faster than virtual time.
+        scenario = smoke_scenario()
+        digests = []
+        for _ in range(FLEETSIM_REPLAY_RUNS):
+            report = FleetSim(scenario).run()
+            digests.append(report["digest"])
+            rows.append(_fleet_sim_row(report))
+            if report["completed"] != report["jobs"]:
+                regressions.append(
+                    f"{report['completed']}/{report['jobs']} jobs "
+                    "completed — the fleet did not drain")
+            if report["invariant_violations"]:
+                regressions.append(
+                    f"{len(report['invariant_violations'])} invariant "
+                    "violations; first: "
+                    + report["invariant_violations"][0])
+            if report["compression_x"] < FLEETSIM_MIN_COMPRESSION:
+                regressions.append(
+                    f"virtual-time compression {report['compression_x']}x "
+                    f"below the {FLEETSIM_MIN_COMPRESSION:g}x floor — a "
+                    "wall-clock sleep leaked into the event loop")
+        if len(set(digests)) != 1:
+            regressions.append(
+                f"{FLEETSIM_REPLAY_RUNS}-run replay diverged: "
+                f"digests {sorted(set(digests))}")
+        prev = _read_baseline(FLEETSIM_BASELINE_PATH)
+        prev_wall = prev.get("wall_s")
+        wall = rows[0]["wall_s"]
+        if prev_wall and wall > prev_wall * FLEETSIM_WALL_REGRESSION:
+            regressions.append(
+                f"smoke wall time {wall}s regressed >"
+                f"{FLEETSIM_WALL_REGRESSION}x vs previous run "
+                f"({prev_wall}s)")
+    else:
+        # The full table: every checked-in storm scenario, once each.
+        for name, scenario in sorted(builtin_scenarios().items()):
+            report = FleetSim(scenario).run()
+            rows.append(_fleet_sim_row(report))
+            if report["invariant_violations"]:
+                regressions.extend(report["invariant_violations"][:5])
+
+    out = {
+        "mode": "fleet-sim",
+        "smoke": smoke,
+        "scenarios": rows,
+        "regression": "; ".join(regressions) or None,
+    }
+    rc = 1 if (smoke and regressions) else 0
+    if smoke and rc == 0:
+        _merge_baseline(FLEETSIM_BASELINE_PATH, {
+            "wall_s": rows[0]["wall_s"],
+            "compression_x": rows[0]["compression_x"],
+            "digest": rows[0]["digest"],
+            "pump_seconds_per_call": rows[0]["pump_seconds_per_call"],
+            "utilization": rows[0]["utilization"],
+            "makespan_s": rows[0]["makespan_s"],
+        })
+    print(json.dumps(out))
+    return rc
+
+
 if __name__ == "__main__":
     import argparse
 
@@ -2767,8 +2902,14 @@ if __name__ == "__main__":
                         default="process")
     parser.add_argument("--mode",
                         choices=("latency", "scale", "contention",
-                                 "elasticity", "recovery"),
+                                 "elasticity", "recovery", "fleet-sim"),
                         default="latency")
+    parser.add_argument("--scenario", default=None,
+                        help="fleet-sim mode: run ONE scenario loaded "
+                        "from this JSON file (the DSL checked in under "
+                        "tf_operator_tpu/testing/scenarios/) instead of "
+                        "the builtin table; the file is also round-trip "
+                        "verified (load -> dump -> load)")
     parser.add_argument("--smoke", action="store_true",
                         help="scale mode: fast CI check (32-replica-gang "
                         "fan-out gate + the multi-vs-single sync-worker "
@@ -2833,6 +2974,14 @@ if __name__ == "__main__":
                      "exclusive: the smoke tier has its own fixed gates")
     if args.policy and args.mode != "contention":
         parser.error("--policy requires --mode contention")
+    if args.scenario and args.mode != "fleet-sim":
+        parser.error("--scenario requires --mode fleet-sim")
+    if args.mode == "fleet-sim":
+        if args.smoke and args.scenario:
+            parser.error("--smoke and --scenario are mutually exclusive: "
+                         "the smoke tier gates its own fixed scenario")
+        sys.exit(fleet_sim_main(smoke=args.smoke,
+                                scenario_path=args.scenario))
     if args.mode == "contention":
         sys.exit(contention_main(smoke=args.smoke, policy=args.policy))
     if args.mode == "elasticity":

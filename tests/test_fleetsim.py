@@ -1,0 +1,431 @@
+"""Fleet digital twin (tf_operator_tpu/testing/fleetsim.py): the
+virtual-clock contract, trace/scenario determinism, the storm corpus,
+and the fleet-level invariants — the `fleet-sim` CI tier.
+
+The one property everything here leans on: a FleetSim run is a pure
+function of its Scenario. Same scenario => same trace bytes, same
+admission/autoscaler decision logs, same chaos fault log, same
+completion order — all folded into one digest, compared across runs.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from tf_operator_tpu.core import constants
+from tf_operator_tpu.testing.fleetsim import (
+    SCENARIO_DIR,
+    ClockAuditError,
+    FleetSim,
+    JobArrival,
+    Scenario,
+    SimClock,
+    StormEvent,
+    audit_sim_clocks,
+    builtin_scenarios,
+    generate_trace,
+    load_named,
+    named_scenarios,
+    smoke_scenario,
+)
+
+
+def tiny_scenario(**overrides) -> Scenario:
+    base = dict(
+        name="tiny", seed=11, profile="bursty", jobs=40, tenants=4,
+        horizon=600.0, capacity_pods=16,
+    )
+    base.update(overrides)
+    return Scenario(**base)
+
+
+# ------------------------------------------------------------ sim clock
+
+
+class TestSimClock:
+    def test_callable_and_monotone(self):
+        clock = SimClock()
+        assert clock() == 0.0
+        clock.advance_to(5.0)
+        assert clock() == 5.0
+        with pytest.raises(ValueError):
+            clock.advance_to(4.0)
+
+    def test_audit_passes_for_sim_hosted_components(self):
+        # The real constructors, the real attribute names — if a
+        # refactor re-defaults one of them to the wall clock, this is
+        # the test that goes red.
+        sim = FleetSim(tiny_scenario(autoscaler=True, elastic_jobs=2,
+                                     shards=2))
+        sim._audit_clocks()  # must not raise
+
+    def test_audit_rejects_wall_clock_fallback(self):
+        from tf_operator_tpu.core.workqueue import WorkQueue
+
+        clock = SimClock()
+        wall_queue = WorkQueue()  # defaults to time.monotonic
+        with pytest.raises(ClockAuditError) as err:
+            audit_sim_clocks(clock, {"workqueue": wall_queue})
+        assert "workqueue" in str(err.value)
+
+    def test_audit_rejects_a_copy_of_the_sim_clock(self):
+        from tf_operator_tpu.core.workqueue import WorkQueue
+
+        clock = SimClock()
+        impostor = SimClock()  # equal-valued but not THE clock
+        queue = WorkQueue(clock=impostor)
+        with pytest.raises(ClockAuditError):
+            audit_sim_clocks(clock, {"workqueue": queue})
+
+    def test_audit_covers_token_bucket(self):
+        # The TokenBucket is not sim-hosted (its acquire() can sleep,
+        # which the zero-sleep engine must never enter), but its clock
+        # slot still honors injection — the audit can vouch for it.
+        from tf_operator_tpu.core.control import TokenBucket
+
+        clock = SimClock()
+        bucket = TokenBucket(qps=10.0, burst=5, clock=clock)
+        audit_sim_clocks(clock, {"token_bucket": bucket})
+        with pytest.raises(ClockAuditError):
+            audit_sim_clocks(clock, {"token_bucket": TokenBucket(
+                qps=10.0, burst=5)})
+
+
+# ------------------------------------------------------ trace generator
+
+
+class TestTraceGenerator:
+    def test_trace_is_byte_deterministic_across_runs(self):
+        sc = tiny_scenario(jobs=200, tenants=16)
+        lines = ["\n".join(a.line() for a in generate_trace(sc))
+                 for _ in range(3)]
+        assert lines[0] == lines[1] == lines[2]
+
+    def test_seed_changes_the_trace(self):
+        a = generate_trace(tiny_scenario(seed=1))
+        b = generate_trace(tiny_scenario(seed=2))
+        assert [x.line() for x in a] != [x.line() for x in b]
+
+    def test_every_profile_generates(self):
+        for profile in ("diurnal", "bursty", "mixed-generation",
+                        "preemption-heavy", "serving-trough"):
+            sc = tiny_scenario(
+                profile=profile,
+                generations={"v4": {"pods": "8"}, "v5e": {"pods": "8"}}
+                if profile == "mixed-generation" else {},
+            )
+            trace = generate_trace(sc)
+            assert len(trace) == sc.jobs
+            assert all(0 <= a.t <= sc.horizon for a in trace)
+            assert all(a.namespace.startswith("tenant-") for a in trace)
+
+    def test_preemption_heavy_mixes_bands(self):
+        trace = generate_trace(tiny_scenario(profile="preemption-heavy",
+                                             jobs=60))
+        bands = {a.priority for a in trace}
+        assert "high" in bands and "low" in bands
+
+    def test_unknown_profile_rejected(self):
+        with pytest.raises(ValueError):
+            tiny_scenario(profile="lunar")
+
+
+# ------------------------------------------------------- scenario DSL
+
+
+class TestScenarioRoundTrip:
+    def test_json_round_trip_exact(self):
+        sc = smoke_scenario()
+        assert Scenario.from_json(sc.to_json()) == sc
+
+    def test_unknown_field_rejected(self):
+        data = tiny_scenario().to_dict()
+        data["warp_factor"] = 9
+        with pytest.raises(ValueError):
+            Scenario.from_dict(data)
+
+    def test_unknown_storm_kind_rejected(self):
+        with pytest.raises(ValueError):
+            tiny_scenario(storm=[StormEvent(t=1.0, kind="meteor")])
+
+    def test_corpus_files_match_their_generators(self):
+        # The checked-in JSON files ARE builtin_scenarios() serialized;
+        # a drive-by edit to either side fails here, not in a replay.
+        builtins = builtin_scenarios()
+        assert set(named_scenarios()) == set(builtins)
+        for name, sc in builtins.items():
+            assert load_named(name) == sc, name
+
+    def test_corpus_has_the_required_storms(self):
+        names = set(named_scenarios())
+        assert {"burst-storm", "capacity-churn-slices",
+                "lease-steal-flap", "diurnal-trough-backfill"} <= names
+
+
+# ------------------------------------------------------------- engine
+
+
+class TestFleetSimEngine:
+    def test_small_fleet_drains_and_sweeps_green(self):
+        report = FleetSim(tiny_scenario()).run()
+        assert report["completed"] == report["jobs"]
+        assert report["invariant_violations"] == []
+        assert report["invariant_sweeps"] >= 1
+
+    def test_zero_wall_clock_sleeps(self):
+        # 40 jobs over a 600s virtual horizon: if anything in the loop
+        # slept on the wall clock the compression collapses. (The smoke
+        # gate enforces >=100x at 5k jobs; tiny runs are far faster.)
+        started = time.perf_counter()
+        report = FleetSim(tiny_scenario()).run()
+        assert time.perf_counter() - started < 30.0
+        assert report["compression_x"] >= 100.0
+
+    def test_three_run_digest_byte_equal(self):
+        sc = tiny_scenario(jobs=60, tenants=6)
+        digests = {FleetSim(sc).run()["digest"] for _ in range(3)}
+        assert len(digests) == 1
+
+    def test_seed_changes_the_digest(self):
+        a = FleetSim(tiny_scenario(seed=1)).run()["digest"]
+        b = FleetSim(tiny_scenario(seed=2)).run()["digest"]
+        assert a != b
+
+    def test_capacity_revocation_storm_preempts_and_recovers(self):
+        sc = tiny_scenario(
+            jobs=60, capacity_pods=16, horizon=900.0,
+            storm=[
+                StormEvent(t=200.0, kind="revoke-capacity",
+                           capacity={"pods": "6"}),
+                StormEvent(t=500.0, kind="revoke-capacity",
+                           capacity={"pods": "16"}),
+            ])
+        sim = FleetSim(sc)
+        report = sim.run()
+        assert report["completed"] == report["jobs"]
+        assert report["invariant_violations"] == []
+        assert report["fault_log_entries"] >= 2
+        # The chaos fault log recorded the revocations.
+        assert any("capacity-revoke" in e for e in sim.chaos.fault_log)
+
+    def test_heartbeats_feed_the_autoscaler(self):
+        sc = tiny_scenario(jobs=24, autoscaler=True, elastic_jobs=3,
+                           capacity_pods=24, horizon=900.0)
+        sim = FleetSim(sc)
+        report = sim.run()
+        assert report["completed"] == report["jobs"]
+        assert report["invariant_violations"] == []
+        # Modeled step progress reached the autoscaler's observation
+        # plane as real heartbeat-lease riders.
+        assert report["hot_paths"]["autoscaler_decide_calls"] > 0
+
+    def test_hot_path_columns_populate(self):
+        report = FleetSim(tiny_scenario()).run()
+        hot = report["hot_paths"]
+        assert hot["pump_calls"] > 0
+        assert hot["pump_seconds_per_call"] > 0
+        assert hot["watch_cache_resident_objects_peak"] > 0
+        assert hot["decision_log_entries"] > 0
+
+    def test_pods_carry_the_invariant_labels(self):
+        # Mid-run dependents must satisfy check_dependents_invariants:
+        # exercise the labels directly on a started job.
+        sim = FleetSim(tiny_scenario(jobs=4, horizon=10.0))
+        arrival = sim.trace[0]
+        sim.clock.advance_to(arrival.t)
+        sim._arrive(arrival)
+        sim._drain_queue()
+        job = sim.jobs[f"JAXJob:{arrival.namespace}/{arrival.name}"]
+        pods = [
+            p for p in sim.mem.list_pods(
+                arrival.namespace,
+                labels={constants.LABEL_JOB_NAME: arrival.name})
+            if p.metadata.deletion_timestamp is None
+        ]
+        assert len(pods) == arrival.workers
+        assert len(job.live) == arrival.workers  # ledger matches backend
+        for pod in pods:
+            labels = pod.metadata.labels
+            assert labels[constants.LABEL_JOB_NAME] == arrival.name
+            assert labels[constants.LABEL_REPLICA_TYPE] == "worker"
+            assert constants.LABEL_REPLICA_INDEX in labels
+
+
+# ------------------------------------------------------- corpus replay
+
+
+@pytest.mark.parametrize("name", sorted(builtin_scenarios()))
+def test_corpus_scenario_replays_byte_identically(name):
+    """Each checked-in storm replays byte-identically (2 runs in the
+    default tier; the smoke gate does 3 at 5k jobs) and sweeps green."""
+    sc = load_named(name)
+    first = FleetSim(sc).run()
+    second = FleetSim(sc).run()
+    assert first["invariant_violations"] == []
+    assert first["completed"] == first["jobs"]
+    assert first["digest"] == second["digest"]
+
+
+def test_scenario_file_round_trip_through_disk(tmp_path):
+    """--scenario <json>: load -> dump -> load lands on the same run."""
+    sc = tiny_scenario(jobs=30)
+    path = tmp_path / "tiny.json"
+    path.write_text(sc.to_json())
+    loaded = Scenario.from_json(path.read_text())
+    assert loaded == sc
+    assert FleetSim(loaded).run()["digest"] == FleetSim(sc).run()["digest"]
+
+
+def test_corpus_directory_is_the_scenario_dir():
+    assert os.path.basename(SCENARIO_DIR) == "scenarios"
+    for name in named_scenarios():
+        with open(os.path.join(SCENARIO_DIR, f"{name}.json")) as f:
+            assert json.load(f)["name"] == name
+
+
+# --------------------------------------------------- fleet invariants
+
+
+class TestFleetInvariants:
+    def test_conservation_violation_detected(self):
+        from tf_operator_tpu.testing.invariants import (
+            check_fleet_invariants,
+        )
+
+        out = check_fleet_invariants(
+            arrivals=10, completed=4, running=3, queued=2,
+            preempt_marks=0, preempt_acks=0)
+        assert any("conservation" in v for v in out)
+
+    def test_ledger_aggregate_violation_detected(self):
+        from tf_operator_tpu.testing.invariants import (
+            check_fleet_invariants,
+        )
+
+        out = check_fleet_invariants(
+            arrivals=3, completed=1, running=1, queued=1,
+            preempt_marks=5, preempt_acks=4)
+        assert any("exactly-once" in v for v in out)
+
+    def test_capacity_violation_detected(self):
+        from tf_operator_tpu.testing.invariants import (
+            check_fleet_invariants,
+        )
+
+        out = check_fleet_invariants(
+            arrivals=2, completed=0, running=2, queued=0,
+            preempt_marks=0, preempt_acks=0,
+            admission_snapshot={"capacity": {"pods": "8"}},
+            running_pods=12)
+        assert any("capacity exceeded" in v for v in out)
+
+    def test_lost_wakeup_detected(self):
+        from tf_operator_tpu.testing.invariants import (
+            check_fleet_invariants,
+        )
+
+        out = check_fleet_invariants(
+            arrivals=2, completed=0, running=1, queued=1,
+            preempt_marks=0, preempt_acks=0,
+            queued_waits=[("JAXJob:ns/ghost", 500.0, 2)],
+            admission_snapshot={"waiting": [], "admitted": []})
+        assert any("lost wakeup" in v for v in out)
+
+    def test_stalled_pump_detected(self):
+        from tf_operator_tpu.testing.invariants import (
+            check_fleet_invariants,
+        )
+
+        out = check_fleet_invariants(
+            arrivals=2, completed=0, running=0, queued=2,
+            preempt_marks=0, preempt_acks=0,
+            queued_waits=[("JAXJob:ns/old", 2000.0, 2)],
+            aging_seconds=300.0, resync_period=60.0,
+            admission_snapshot={
+                "capacity": {"pods": "8"}, "usage": {"pods": "0"},
+                "waiting": [{"key": "JAXJob:ns/old"}], "admitted": [],
+            },
+            admits_in_window=0)
+        assert any("pump is not being driven" in v for v in out)
+
+    def test_draining_backlog_is_not_flagged(self):
+        from tf_operator_tpu.testing.invariants import (
+            check_fleet_invariants,
+        )
+
+        # Long waits under contention with admissions still landing:
+        # the scheduler working, not starvation.
+        out = check_fleet_invariants(
+            arrivals=10, completed=4, running=4, queued=2,
+            preempt_marks=0, preempt_acks=0,
+            queued_waits=[("JAXJob:ns/patient", 2000.0, 2)],
+            admission_snapshot={
+                "capacity": {"pods": "8"}, "usage": {"pods": "8"},
+                "waiting": [{"key": "JAXJob:ns/patient"}], "admitted": [],
+            },
+            admits_in_window=3)
+        assert out == []
+
+
+# ------------------------------------------------- histogram satellites
+
+
+class TestHotPathHistograms:
+    def test_admission_pump_histogram_observes(self):
+        from tf_operator_tpu.core.admission import AdmissionController
+        from tf_operator_tpu.metrics import Metrics
+
+        metrics = Metrics()
+        admission = AdmissionController(
+            capacity={"pods": "8"}, metrics=metrics)
+        from fractions import Fraction
+
+        admission.try_admit(
+            key="JAXJob:ns/a", kind="JAXJob", namespace="ns", name="a",
+            uid="u1", demand={"pods": Fraction(2)}, members=2)
+        count, total = metrics.labeled_histogram_stats(
+            "training_operator_admission_pump_seconds")
+        assert count > 0 and total >= 0.0
+
+    def test_autoscaler_decide_histogram_observes(self):
+        report = FleetSim(tiny_scenario(
+            jobs=12, autoscaler=True, elastic_jobs=2,
+            capacity_pods=24)).run()
+        assert report["hot_paths"]["autoscaler_decide_calls"] > 0
+
+    def test_histograms_render(self):
+        from tf_operator_tpu.metrics import Metrics
+
+        metrics = Metrics()
+        metrics.observe_admission_pump(0.002)
+        metrics.observe_autoscaler_decide(0.0001)
+        text = metrics.render()
+        assert "training_operator_admission_pump_seconds" in text
+        assert "training_operator_autoscaler_decide_seconds" in text
+
+
+# ----------------------------------------------------------- slow leg
+
+
+@pytest.mark.slow
+def test_full_fleet_100k_jobs_1k_tenants():
+    """The full fleet leg: 100k jobs over 1k tenants with a composed
+    storm. Slow tier only — the smoke gate runs the 5k/64 cut."""
+    sc = Scenario(
+        name="full-fleet", seed=31337, profile="diurnal", jobs=100_000,
+        tenants=1000, horizon=259_200.0, capacity_pods=4096,
+        policy="priority", aging_seconds=900.0, shards=8,
+        resync_period=120.0, epoch_seconds=7200.0,
+        storm=[
+            StormEvent(t=43_200.0, kind="revoke-capacity",
+                       capacity={"pods": "2048"}),
+            StormEvent(t=86_400.0, kind="revoke-capacity",
+                       capacity={"pods": "4096"}),
+        ],
+    )
+    report = FleetSim(sc).run()
+    assert report["completed"] == report["jobs"]
+    assert report["invariant_violations"] == []
+    assert report["compression_x"] >= 100.0

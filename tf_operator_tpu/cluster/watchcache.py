@@ -347,6 +347,13 @@ class SharedWatchCache:
                 for key in doomed:
                     store.pop(key, None)
 
+    def resident_objects(self) -> int:
+        """Total objects resident across every store — the cache-memory
+        hot-path column the fleet simulator reports at 100k objects (the
+        constant drop_shard exists to bound)."""
+        with self._lock:
+            return sum(len(store) for store in self._stores.values())
+
     # -------------------------------------------------------------- reads
     def bookmark(self, resource: str) -> int:
         """Highest resourceVersion applied to `resource`'s store — the

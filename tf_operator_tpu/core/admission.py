@@ -639,6 +639,7 @@ class AdmissionController:
         whoever stays waiting. The default priority policy reproduces
         the PR 9 procedure byte-for-byte."""
         self._pump_count += 1
+        pump_started = time.perf_counter()
         cap = self.effective_capacity()
         state = self._policy_state_locked(now, cap)
         decisions = self.policy.decide(state)
@@ -677,6 +678,12 @@ class AdmissionController:
                  "seed": self.seed, "actions": applied}
             )
         self._update_gauges_locked(cap)
+        # Wall time (perf_counter), never the injected clock: under the
+        # fleet simulator the virtual clock is frozen inside an event,
+        # and the whole point of this histogram is the REAL per-pump
+        # cost at fleet object counts.
+        self.metrics.observe_admission_pump(
+            time.perf_counter() - pump_started)
 
     def _update_gauges_locked(self, cap=None) -> None:
         depths: Dict[int, int] = {}

@@ -738,7 +738,13 @@ class GangAutoscaler:
         started = time.perf_counter()
         self._tick_count += 1
         state = self.collect_state()
+        decide_started = time.perf_counter()
         decisions = decide(state, self.config)
+        # The pure planning cost alone (observe/apply excluded) — the
+        # fleet simulator's per-tick hot-path column. Wall time by
+        # design: the injected clock is virtual there.
+        self.metrics.observe_autoscaler_decide(
+            time.perf_counter() - decide_started)
         views = {j.key: j for j in state.jobs}
         applied: List[Resize] = []
         logged: List[list] = []

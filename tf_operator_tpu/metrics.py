@@ -353,6 +353,21 @@ class Metrics:
         "training_restore_seconds": (
             ("path", "cause"), (0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 15, 60, 300),
         ),
+        # One policy-pump pass inside the admission arbiter's lock (the
+        # per-tick hot path the fleet simulator columns at 100k objects).
+        # Tens-of-microseconds when healthy at bench scale; the tail
+        # grows with admitted+waiting set size, so ms-scale buckets.
+        "training_operator_admission_pump_seconds": (
+            (), (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                 0.025, 0.1, 0.5),
+        ),
+        # The PURE decide() inside one autoscaler tick — the planning
+        # cost alone, distinct from the whole observe+decide+apply tick
+        # (training_operator_autoscaler_decision_latency_seconds).
+        "training_operator_autoscaler_decide_seconds": (
+            (), (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                 0.025, 0.1, 0.5),
+        ),
     }
 
     def __init__(self):
@@ -724,6 +739,31 @@ class Metrics:
             self._labeled_histograms["training_restore_seconds"][
                 (path, cause)
             ].observe(seconds)
+
+    def observe_admission_pump(self, seconds: float) -> None:
+        """One policy-pump pass (wall time under the arbiter's lock)."""
+        with self._lock:
+            self._labeled_histograms[
+                "training_operator_admission_pump_seconds"][()].observe(seconds)
+
+    def observe_autoscaler_decide(self, seconds: float) -> None:
+        """One pure decide() evaluation inside an autoscaler tick."""
+        with self._lock:
+            self._labeled_histograms[
+                "training_operator_autoscaler_decide_seconds"][()].observe(
+                    seconds)
+
+    def labeled_histogram_stats(
+            self, name: str, *label_values: str) -> Tuple[int, float]:
+        """(count, sum-of-observations) of one labeled-histogram series —
+        the per-call hot-path columns the fleet simulator reports."""
+        with self._lock:
+            series = self._labeled_histograms[name]
+            key = tuple(label_values)
+            if key not in series:
+                return 0, 0.0
+            hist = series[key]
+            return hist.count, hist.total
 
     def labeled_histogram_count(self, name: str, *label_values: str) -> int:
         with self._lock:

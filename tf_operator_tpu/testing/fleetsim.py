@@ -1,0 +1,1290 @@
+"""Fleet digital twin: a trace-driven discrete-event simulator that runs
+the REAL control plane — admission arbiter, gang autoscaler, shard
+coordinator, workqueue, expectations, tracer — over the in-memory
+cluster at 100k-job / 1k-tenant scale with zero wall-clock sleeps.
+
+The whole design rests on one property the repo built deliberately:
+every decision maker is a pure function of an injected clock plus an
+immutable snapshot (core/policies.py, core/autoscaler.py decide(),
+core/sharding.py, WorkQueue timers, expectations). So the simulator
+owns ONE virtual clock (:class:`SimClock`), threads it into every
+clock-accepting component, and advances it event by event — a year of
+diurnal waves replays in seconds, and the same seed replays the same
+trace, the same decision logs, and the same fault log byte-for-byte.
+
+Layers:
+
+- :class:`SimClock` + :func:`audit_sim_clocks` — the virtual-clock
+  contract. The audit walks every sim-hosted component and asserts its
+  clock attribute IS the sim clock object; a component that silently
+  fell back to ``time.time`` fails loudly before the run starts.
+- :func:`generate_trace` — seeded workload-trace generator producing
+  tenant mixes (diurnal, bursty, mixed-generation, preemption-heavy,
+  serving-trough backfill) as a list of :class:`JobArrival` records.
+- :class:`Scenario` — the JSON-round-trippable scenario DSL: trace
+  parameters, capacity/quota/policy/autoscaler config, and a storm
+  layer composing the existing fault levers (capacity revocation,
+  slice preemption, lease steals/renew delays, crash points, restore
+  faults) into named fleet storms.
+- :class:`FleetSim` — the engine: a heapq event loop (arrivals,
+  modeled step progress feeding heartbeat ``tokens_per_sec`` /
+  checkpoint riders, fault firings, periodic admission resyncs,
+  autoscaler + shard-coordinator ticks) with ``testing/invariants.py``
+  sweeps plus the new fleet-level invariants between epochs.
+
+Surfaced as ``scripts/measure_control_plane.py --mode fleet-sim`` with
+the smoke gate ratcheted via ``build/fleetsim_smoke_last.json``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import heapq
+import json
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from fractions import Fraction
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..core import constants
+
+# ----------------------------------------------------------------- clock
+
+
+class ClockAuditError(AssertionError):
+    """A sim-hosted component is not running on the sim clock."""
+
+
+class SimClock:
+    """The single virtual clock of a fleet simulation. Callable (every
+    component in this repo takes ``clock=`` as a zero-arg callable) and
+    monotone: events may only advance it. One instance serves as both
+    the wall-style clock (``time.time`` slots) and the monotonic clock
+    (``time.monotonic`` slots) — in virtual time they are the same
+    axis, which is exactly what makes replays exact."""
+
+    __slots__ = ("_now",)
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+
+    def __call__(self) -> float:
+        return self._now
+
+    @property
+    def now(self) -> float:
+        return self._now
+
+    def advance_to(self, t: float) -> None:
+        if t < self._now - 1e-9:
+            raise ValueError(
+                f"virtual clock may not rewind: {t} < {self._now}"
+            )
+        self._now = max(self._now, float(t))
+
+
+#: Attribute names under which this repo's components store their
+#: injected clocks (core/admission.py ``clock``, WorkQueue/expectations
+#: ``_clock``, sharding/leaderelection ``_clock``+``_mono``, …).
+_CLOCK_ATTRS = ("clock", "_clock", "_mono")
+
+
+def audit_sim_clocks(clock, components: Dict[str, object]) -> None:
+    """Assert every component's clock attribute IS `clock` (object
+    identity, not equality — a lambda wrapping ``time.time`` would
+    compare unequal anyway, but identity also rejects a *copy* of the
+    sim clock, which would silently stop advancing). Raises
+    :class:`ClockAuditError` naming every offender, so a refactor that
+    re-defaults one constructor to the wall clock fails the whole
+    fleet tier loudly instead of corrupting timers quietly."""
+    failures: List[str] = []
+    for name, obj in sorted(components.items()):
+        found = False
+        for attr in _CLOCK_ATTRS:
+            probe = obj.__dict__.get(attr) if hasattr(obj, "__dict__") else None
+            if probe is None:
+                continue
+            found = True
+            if probe is not clock:
+                failures.append(
+                    f"{name}.{attr} is not the sim clock "
+                    f"({getattr(probe, '__name__', type(probe).__name__)}"
+                    " — wall-clock fallback)"
+                )
+        if not found:
+            failures.append(f"{name}: no injected clock attribute found")
+    if failures:
+        raise ClockAuditError(
+            "clock-injection audit failed:\n  " + "\n  ".join(failures)
+        )
+
+
+# ----------------------------------------------------------- trace layer
+
+PROFILES = (
+    "diurnal",
+    "bursty",
+    "mixed-generation",
+    "preemption-heavy",
+    "serving-trough",
+)
+
+
+@dataclass(frozen=True)
+class JobArrival:
+    """One job in the workload trace. Everything downstream (manifest,
+    admission demand, completion model) derives from these fields, so
+    the trace line is the replay artifact for the arrival layer."""
+
+    t: float
+    name: str
+    namespace: str
+    workers: int
+    work_seconds: float
+    priority: str = ""
+    throughput_ratios: Dict[str, float] = field(default_factory=dict)
+    elastic: bool = False
+    num_slices: int = 1
+    min_slices: int = 1
+    max_slices: int = 4
+
+    def line(self) -> str:
+        return json.dumps(
+            dataclasses.asdict(self), sort_keys=True, separators=(",", ":")
+        )
+
+
+def _tenant(rng: random.Random, tenants: int) -> str:
+    """Zipf-flavored tenant pick: a few namespaces dominate (the real
+    multi-tenant shape), the long tail stays busy."""
+    r = rng.random()
+    skew = int(tenants * (r ** 2.2))
+    return f"tenant-{min(skew, tenants - 1):04d}"
+
+
+def _diurnal_accept(rng: random.Random, t: float, horizon: float) -> bool:
+    import math
+
+    period = max(horizon / 3.0, 1.0)  # three "days" per run
+    rate = 0.5 + 0.5 * (0.5 * (1 + math.sin(2 * math.pi * t / period)))
+    return rng.random() < rate
+
+
+def generate_trace(scenario: "Scenario") -> List[JobArrival]:
+    """The seeded workload-trace generator. Pure function of the
+    scenario (all entropy from ``random.Random(seed)``): same scenario,
+    same bytes — the foundation of the 3-run replay gate."""
+    sc = scenario
+    rng = random.Random(sc.seed)
+    arrivals: List[JobArrival] = []
+    elastic_budget = sc.elastic_jobs
+    sizes = (1, 1, 2, 2, 2, 4, 4, 8)
+
+    def arrival_time(i: int) -> float:
+        if sc.profile == "diurnal" or sc.profile == "serving-trough":
+            while True:
+                t = rng.random() * sc.horizon
+                if _diurnal_accept(rng, t, sc.horizon):
+                    return t
+        if sc.profile == "bursty":
+            # 1-in-3 jobs ride a burst: a handful of storm instants
+            # each concentrating a wave of near-simultaneous arrivals.
+            if rng.random() < 0.34:
+                burst = rng.randrange(max(1, sc.jobs // 64))
+                center = (burst + 0.5) * sc.horizon / max(
+                    1, sc.jobs // 64)
+                return min(sc.horizon, center + rng.random() * 5.0)
+            return rng.random() * sc.horizon
+        if sc.profile == "preemption-heavy":
+            # Low-band carpet early, high-band storm in the middle
+            # third — the arbiter must preempt its way through it.
+            if i % 3 == 0:
+                return sc.horizon * (0.33 + 0.34 * rng.random())
+            return rng.random() * sc.horizon * 0.9
+        return rng.random() * sc.horizon
+
+    for i in range(sc.jobs):
+        t = arrival_time(i)
+        ns = _tenant(rng, sc.tenants)
+        workers = rng.choice(sizes)
+        work = 30.0 + rng.random() * 270.0
+        priority = ""
+        ratios: Dict[str, float] = {}
+        elastic = False
+        num_slices = 1
+        if sc.profile == "preemption-heavy":
+            priority = "high" if i % 3 == 0 else "low"
+        elif sc.profile == "serving-trough":
+            # Serving gangs: high-band, long-lived, diurnal; batch
+            # training backfills the troughs at the default band.
+            if i % 4 == 0:
+                priority = "high"
+                work = 120.0 + rng.random() * 240.0
+            else:
+                priority = "low"
+        elif sc.profile == "mixed-generation":
+            gens = sorted(sc.generations) or ["v4", "v5e"]
+            ratios = {
+                gen: round(0.5 + 0.5 * rng.random(), 3) for gen in gens
+            }
+            ratios[gens[i % len(gens)]] = 1.0
+        if elastic_budget > 0 and i % max(1, sc.jobs // max(
+                1, sc.elastic_jobs)) == 0:
+            elastic_budget -= 1
+            elastic = True
+            num_slices = rng.choice((1, 2))
+            workers = num_slices * sc.hosts_per_slice
+            work = 120.0 + rng.random() * 240.0
+        arrivals.append(JobArrival(
+            t=round(t, 3),
+            name=f"fleet-{i:06d}",
+            namespace=ns,
+            workers=workers,
+            work_seconds=round(work, 3),
+            priority=priority,
+            throughput_ratios=ratios,
+            elastic=elastic,
+            num_slices=num_slices,
+            min_slices=1,
+            max_slices=4,
+        ))
+    arrivals.sort(key=lambda a: (a.t, a.name))
+    return arrivals
+
+
+# -------------------------------------------------------- scenario layer
+
+
+@dataclass
+class StormEvent:
+    """One virtual-time-keyed storm firing. Counter-keyed levers (lease
+    steals, renew delays, crash points, restore faults) live in the
+    scenario's chaos plan instead — they key on deterministic call
+    counters, the contract chaos.py already guarantees."""
+
+    t: float
+    kind: str  # revoke-capacity | preempt-slice | freeze-heartbeats | thaw-heartbeats
+    capacity: Optional[Dict[str, str]] = None
+    slice_index: int = 0
+    name_contains: str = ""
+
+
+STORM_KINDS = (
+    "revoke-capacity", "preempt-slice", "freeze-heartbeats",
+    "thaw-heartbeats",
+)
+
+
+@dataclass
+class Scenario:
+    """The fleet-storm DSL: everything a run depends on, JSON-round-
+    trippable (``--scenario file.json``). ``from_dict(to_dict(s)) == s``
+    is a regression test — a field that doesn't survive the round trip
+    silently forks checked-in corpus scenarios from their replays."""
+
+    name: str
+    seed: int = 0
+    profile: str = "bursty"
+    jobs: int = 200
+    tenants: int = 8
+    horizon: float = 3600.0
+    capacity_pods: int = 64
+    generations: Dict[str, Dict[str, str]] = field(default_factory=dict)
+    policy: str = "priority"
+    quotas: Dict[str, Dict[str, str]] = field(default_factory=dict)
+    tenant_weights: Dict[str, float] = field(default_factory=dict)
+    backfill_max_members: int = 8
+    aging_seconds: float = 600.0
+    autoscaler: bool = False
+    autoscaler_config: Dict[str, float] = field(default_factory=dict)
+    elastic_jobs: int = 0
+    hosts_per_slice: int = 2
+    shards: int = 1
+    storm: List[StormEvent] = field(default_factory=list)
+    lease_steals: List[Dict] = field(default_factory=list)
+    renew_delays: List[Dict] = field(default_factory=list)
+    crash_points: List[Dict] = field(default_factory=list)
+    restore_faults: List[Dict] = field(default_factory=list)
+    # Engine cadence (virtual seconds).
+    resync_period: float = 60.0
+    autoscaler_tick: float = 15.0
+    coordinator_tick: float = 10.0
+    heartbeat_period: float = 10.0
+    epoch_seconds: float = 600.0
+
+    def __post_init__(self):
+        if self.profile not in PROFILES:
+            raise ValueError(
+                f"unknown trace profile {self.profile!r} "
+                f"(known: {', '.join(PROFILES)})"
+            )
+        for ev in self.storm:
+            if ev.kind not in STORM_KINDS:
+                raise ValueError(
+                    f"unknown storm kind {ev.kind!r} "
+                    f"(known: {', '.join(STORM_KINDS)})"
+                )
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "Scenario":
+        data = dict(data)
+        data["storm"] = [
+            StormEvent(**ev) for ev in data.get("storm") or []
+        ]
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = sorted(set(data) - known)
+        if unknown:
+            raise ValueError(f"unknown scenario fields: {unknown}")
+        return cls(**data)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True, indent=2) + "\n"
+
+    @classmethod
+    def from_json(cls, text: str) -> "Scenario":
+        return cls.from_dict(json.loads(text))
+
+
+#: Checked-in storm corpus directory (tf_operator_tpu/testing/scenarios).
+SCENARIO_DIR = os.path.join(os.path.dirname(__file__), "scenarios")
+
+
+def load_scenario(path: str) -> Scenario:
+    with open(path) as f:
+        return Scenario.from_json(f.read())
+
+
+def named_scenarios() -> List[str]:
+    """The corpus, sorted — stable iteration order for the replay tier."""
+    if not os.path.isdir(SCENARIO_DIR):
+        return []
+    return sorted(
+        os.path.splitext(p)[0]
+        for p in os.listdir(SCENARIO_DIR)
+        if p.endswith(".json")
+    )
+
+
+def load_named(name: str) -> Scenario:
+    return load_scenario(os.path.join(SCENARIO_DIR, f"{name}.json"))
+
+
+# --------------------------------------------------------------- engine
+
+
+@dataclass
+class _SimJob:
+    """Sim-side job state: the workload model's view (accrued work,
+    completion-event versioning) beside what lives in the cluster."""
+
+    arrival: JobArrival
+    key: str
+    uid: str = ""
+    phase: str = "queued"  # queued | running | completed
+    workers: int = 0
+    num_slices: int = 1
+    done: float = 0.0        # accrued work-seconds
+    ran_since: Optional[float] = None
+    queued_since: float = 0.0
+    completion_version: int = 0
+    ckpt_step: int = 0
+    preemptions: int = 0
+    completed_at: Optional[float] = None
+    disruptions: int = 0
+    slice_restarts: int = 0
+    # Live pods the sim itself created, replica index -> pod name, in
+    # creation order (mirrors the backend's insertion order). The sim is
+    # the only pod writer, so this ledger replaces per-sync list_pods
+    # round-trips — which deep-copy every pod and dominated the wall
+    # clock at 100k jobs.
+    live: Dict[int, str] = field(default_factory=dict)
+
+
+class FleetSim:
+    """The discrete-event engine. Single-threaded by construction: the
+    heap orders everything, components are called inline, and the only
+    concurrency the real stack's locks ever see is re-entrant kicks —
+    so per-method chaos call indices are a pure function of the event
+    sequence and every run replays byte-identically from its seed."""
+
+    def __init__(self, scenario: Scenario):
+        from ..cluster.chaos import (
+            ChaosCluster, ChaosSpec, CrashPoint, ScheduledLeaseSteal,
+            ScheduledRenewDelay, ScheduledRestoreFault,
+        )
+        from ..cluster.memory import InMemoryCluster
+        from ..cluster.watchcache import SharedWatchCache
+        from ..core.admission import AdmissionController
+        from ..core.autoscaler import AutoscalerConfig, GangAutoscaler
+        from ..core.expectations import ControllerExpectations
+        from ..core.sharding import ShardCoordinator
+        from ..core.tracing import Tracer
+        from ..core.workqueue import WorkQueue
+        from ..metrics import Metrics
+
+        self.scenario = scenario
+        self.clock = SimClock()
+        self.rng = random.Random(scenario.seed ^ 0x5EED)
+        self.metrics = Metrics()
+        self.tracer = Tracer(max_traces=64, max_spans=256, clock=self.clock)
+
+        self.mem = InMemoryCluster(clock=self.clock)
+        self.mem.set_schedulable_capacity(
+            {"pods": str(scenario.capacity_pods)},
+            generations={
+                gen: dict(res) for gen, res in scenario.generations.items()
+            } or None,
+        )
+        spec = ChaosSpec(
+            seed=scenario.seed,
+            lease_steals=tuple(
+                ScheduledLeaseSteal(**d) for d in scenario.lease_steals
+            ),
+            renew_delays=tuple(
+                ScheduledRenewDelay(**d) for d in scenario.renew_delays
+            ),
+            crash_points=tuple(
+                CrashPoint(**d) for d in scenario.crash_points
+            ),
+            restore_faults=tuple(
+                ScheduledRestoreFault(**d) for d in scenario.restore_faults
+            ),
+        )
+        self.chaos = ChaosCluster(self.mem, spec)
+        # Observation-only watch cache on the backend: the resident-
+        # object hot-path column at fleet scale (the ChaosCluster pins
+        # its own serving cache off; this one never serves reads).
+        self.watch_cache = SharedWatchCache(
+            self.mem, namespace=None, metrics=self.metrics)
+        self.watch_cache.register_kind("JAXJob")
+
+        self.admission = AdmissionController(
+            quotas={ns: dict(q) for ns, q in scenario.quotas.items()} or None,
+            backfill_max_members=scenario.backfill_max_members,
+            aging_seconds=scenario.aging_seconds,
+            clock=self.clock,
+            metrics=self.metrics,
+            capacity_fn=self.mem.schedulable_capacity,
+            generations=scenario.generations or None,
+            policy=scenario.policy,
+            tenant_weights=scenario.tenant_weights or None,
+            seed=scenario.seed,
+        )
+        self.queue = WorkQueue(clock=self.clock)
+        self.expectations = ControllerExpectations(clock=self.clock)
+        self.autoscaler = None
+        if scenario.autoscaler:
+            cfg = AutoscalerConfig(seed=scenario.seed)
+            for knob, value in scenario.autoscaler_config.items():
+                if not hasattr(cfg, knob):
+                    raise ValueError(f"unknown autoscaler knob {knob!r}")
+                setattr(cfg, knob, value)
+            self.autoscaler = GangAutoscaler(
+                self.chaos, self.admission, cfg,
+                clock=self.clock, metrics=self.metrics,
+            )
+        self.coordinator = None
+        if scenario.shards > 1:
+            self.coordinator = ShardCoordinator(
+                self.chaos, shards=scenario.shards,
+                identity="fleetsim-replica-0", namespace="fleet-sim",
+                duration=30.0, clock=self.clock, mono=self.clock,
+            )
+
+        self._audit_clocks()
+
+        self.trace = generate_trace(scenario)
+        self.jobs: Dict[str, _SimJob] = {}
+        # Non-terminal jobs only (arrival order). Periodic scans —
+        # resync, storms, epoch sweeps — walk this instead of the
+        # all-jobs dict, which keeps them O(live fleet) instead of
+        # O(every job that ever arrived).
+        self.active: Dict[str, _SimJob] = {}
+        self._heap: List[tuple] = []
+        self._seq = 0
+        self._arrived = 0
+        self._completed = 0
+        self._preempt_marks = 0
+        self._preempt_acks = 0
+        self._admits_in_window = 0
+        self._deferred_syncs = 0
+        self._sweeps = 0
+        self._sweep_violations: List[str] = []
+        self._util_area = 0.0
+        self._running_pods = 0
+        self._last_util_t = 0.0
+        self._first_arrival_t: Optional[float] = None
+        self._last_completion_t = 0.0
+        self._frozen_slices: Dict[str, float] = {}
+        self._resident_peak = 0
+        self._per_tenant_done: Dict[str, int] = {}
+        self._end_t = 0.0
+        self.report: Optional[dict] = None
+
+    # ------------------------------------------------------------ audit
+    def _audit_clocks(self) -> None:
+        components: Dict[str, object] = {
+            "admission": self.admission,
+            "workqueue": self.queue,
+            "expectations": self.expectations,
+            "tracer": self.tracer,
+            "cluster": self.mem,
+        }
+        if self.autoscaler is not None:
+            components["autoscaler"] = self.autoscaler
+        if self.coordinator is not None:
+            components["shard_coordinator"] = self.coordinator
+        audit_sim_clocks(self.clock, components)
+
+    # ------------------------------------------------------- event heap
+    def _push(self, t: float, kind: str, data=None) -> None:
+        self._seq += 1
+        heapq.heappush(self._heap, (t, self._seq, kind, data))
+
+    # --------------------------------------------------------- manifest
+    def _manifest(self, a: JobArrival) -> dict:
+        spec: dict = {
+            "jaxReplicaSpecs": {
+                "Worker": {
+                    "replicas": a.workers,
+                    "template": {"spec": {"containers": [
+                        {"name": "jax", "image": "fleetsim:1"}]}},
+                }
+            },
+        }
+        if a.elastic:
+            spec["numSlices"] = a.num_slices
+            spec["elastic"] = {
+                "minSlices": a.min_slices, "maxSlices": a.max_slices,
+            }
+        sp: dict = {}
+        if a.priority:
+            sp["priorityClass"] = a.priority
+        if a.throughput_ratios:
+            sp["throughputRatios"] = dict(a.throughput_ratios)
+        if sp:
+            spec["runPolicy"] = {"schedulingPolicy": sp}
+        return {
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "JAXJob",
+            "metadata": {"name": a.name, "namespace": a.namespace},
+            "spec": spec,
+        }
+
+    # ----------------------------------------------------- progress math
+    def _rate(self, job: _SimJob) -> float:
+        """Work-seconds accrued per virtual second: rigid gangs run at
+        1x; elastic gangs scale with their CURRENT world relative to
+        the arrival-time world (what a resize buys)."""
+        if not job.arrival.elastic:
+            return 1.0
+        base = max(1, job.arrival.workers)
+        return max(1, job.workers) / base
+
+    def _accrue(self, job: _SimJob) -> None:
+        if job.phase == "running" and job.ran_since is not None:
+            job.done += (self.clock.now - job.ran_since) * self._rate(job)
+            job.ran_since = self.clock.now
+
+    def _schedule_completion(self, job: _SimJob) -> None:
+        job.completion_version += 1
+        remaining = max(0.0, job.arrival.work_seconds - job.done)
+        eta = self.clock.now + remaining / max(self._rate(job), 1e-9)
+        self._push(eta, "complete", (job.key, job.completion_version))
+
+    # ------------------------------------------------------- utilization
+    def _note_util(self) -> None:
+        now = self.clock.now
+        self._util_area += self._running_pods * (now - self._last_util_t)
+        self._last_util_t = now
+
+    def _set_running_pods(self, delta: int) -> None:
+        self._note_util()
+        self._running_pods += delta
+
+    # ------------------------------------------------------------- pods
+    def _owner_ref(self, job: _SimJob):
+        from ..api.k8s import OwnerReference
+
+        return OwnerReference(
+            api_version="kubeflow.org/v1", kind="JAXJob",
+            name=job.arrival.name, uid=job.uid, controller=True,
+        )
+
+    def _make_pod(self, job: _SimJob, index: int):
+        from ..api.k8s import Container, ObjectMeta, Pod, PodSpec
+
+        a = job.arrival
+        hosts = max(1, self.scenario.hosts_per_slice)
+        labels = {
+            constants.LABEL_GROUP_NAME: constants.GROUP_NAME,
+            constants.LABEL_JOB_NAME: a.name,
+            constants.LABEL_REPLICA_TYPE: "worker",
+            constants.LABEL_REPLICA_INDEX: str(index),
+        }
+        if a.elastic:
+            labels[constants.LABEL_SLICE_INDEX] = str(index // hosts)
+        pod = Pod()
+        pod.metadata = ObjectMeta(
+            name=f"{a.name}-worker-{index}", namespace=a.namespace,
+            labels=labels, owner_references=[self._owner_ref(job)],
+        )
+        pod.spec = PodSpec(containers=[Container(name="jax", image="fleetsim:1")])
+        return pod
+
+    def _reconcile_pods(self, job: _SimJob) -> None:
+        """Create/delete pods so the live set matches the CURRENT world
+        (job.workers) — the sim's stand-in for the engine's replica
+        reconcile, with expectations armed around the writes."""
+        from ..cluster.base import NotFound
+
+        before = len(job.live)
+        want = set(range(job.workers))
+        extra = sorted(set(job.live) - want)
+        missing = sorted(want - set(job.live))
+        if extra:
+            self.expectations.expect_deletions(job.key, "pod", len(extra))
+            for idx in extra:
+                try:
+                    self.chaos.delete_pod(
+                        job.arrival.namespace, job.live[idx])
+                except NotFound:
+                    pass
+                del job.live[idx]
+                self.expectations.deletion_observed(job.key, "pod")
+        if missing:
+            self.expectations.expect_creations(job.key, "pod", len(missing))
+            for idx in missing:
+                pod = self._make_pod(job, idx)
+                self.chaos.create_pod(pod)
+                job.live[idx] = pod.metadata.name
+                self.expectations.creation_observed(job.key, "pod")
+        if extra or missing:
+            self.mem.step()  # bind fresh pods (gang-blind: no pod groups)
+        self._set_running_pods(job.workers - before)
+
+    def _delete_pods(self, job: _SimJob) -> int:
+        from ..cluster.base import NotFound
+
+        dead = len(job.live)
+        if job.live:
+            self.expectations.expect_deletions(job.key, "pod", dead)
+        for name in job.live.values():
+            try:
+                self.chaos.delete_pod(job.arrival.namespace, name)
+            except NotFound:
+                pass
+            self.expectations.deletion_observed(job.key, "pod")
+        job.live.clear()
+        self._set_running_pods(-dead)
+        return dead
+
+    # --------------------------------------------------------- lifecycle
+    def _arrive(self, a: JobArrival) -> None:
+        created = self.chaos.create_job(self._manifest(a))
+        key = f"JAXJob:{a.namespace}/{a.name}"
+        job = _SimJob(
+            arrival=a, key=key, uid=created["metadata"]["uid"],
+            workers=a.workers, num_slices=a.num_slices,
+            queued_since=self.clock.now,
+        )
+        self.jobs[key] = job
+        self.active[key] = job
+        self._arrived += 1
+        if self._first_arrival_t is None:
+            self._first_arrival_t = self.clock.now
+        self.queue.add(key)
+
+    def _shard_owned(self, job: _SimJob) -> bool:
+        if self.coordinator is None:
+            return True
+        from ..core.sharding import shard_for_key
+
+        shard = shard_for_key(
+            job.arrival.namespace, job.arrival.name, self.coordinator.shards)
+        return shard in self.coordinator.owned_shards()
+
+    def _sync(self, key: str) -> None:
+        job = self.jobs.get(key)
+        if job is None or job.phase == "completed":
+            return
+        if not self._shard_owned(job):
+            # Shard lost (lease steal in flight): defer, exactly as the
+            # sharded engine defers foreign keys; the claim-back resync
+            # (or the periodic resync) picks it up.
+            self._deferred_syncs += 1
+            delay = self.scenario.coordinator_tick
+            self.queue.add_after(key, delay)
+            self._push(self.clock.now + delay, "drain", None)
+            return
+        cause = self.admission.preemption_requested(key)
+        if cause is not None and job.phase == "running":
+            self._preempt_teardown(job, cause)
+            return
+        a = job.arrival
+        result = self.admission.try_admit(
+            key=key, kind="JAXJob", namespace=a.namespace, name=a.name,
+            uid=job.uid, priority_class=a.priority,
+            demand={"pods": Fraction(job.workers)}, members=job.workers,
+            has_pods=bool(job.phase == "running" and job.live),
+            kick=lambda k=key: self.queue.add(k),
+            throughput_ratios=a.throughput_ratios or None,
+            victim_rank=job.preemptions,
+        )
+        if result.admitted and job.phase == "queued":
+            self._start_running(job)
+
+    def _start_running(self, job: _SimJob) -> None:
+        job.phase = "running"
+        job.ran_since = self.clock.now
+        self._admits_in_window += 1
+        self._reconcile_pods(job)
+        self._schedule_completion(job)
+        if job.arrival.elastic:
+            self._push(
+                self.clock.now + self.scenario.heartbeat_period,
+                "heartbeat", job.key)
+
+    def _patch_status(self, job: _SimJob, mutate: Callable[[dict], None]) -> None:
+        from ..cluster.base import NotFound
+
+        try:
+            current = self.mem.get_job(
+                "JAXJob", job.arrival.namespace, job.arrival.name)
+        except NotFound:
+            return
+        status = current.get("status") or {}
+        mutate(status)
+        self.chaos.patch_job_status(
+            "JAXJob", job.arrival.namespace, job.arrival.name, status)
+
+    def _preempt_teardown(self, job: _SimJob, cause: str) -> None:
+        """The counted-disruption protocol in sim form: accrue progress
+        (resume-from-checkpoint), count the disruption restart BEFORE
+        acknowledging (the admission invariant's ordering), tear the
+        pods down, ack exactly once, and re-queue."""
+        self._accrue(job)
+        job.ran_since = None
+        self._preempt_marks += 1
+        job.disruptions += 1
+        job.preemptions += 1
+
+        def bump(status: dict) -> None:
+            counts = status.setdefault("disruptionCounts", {})
+            counts["Worker"] = int(counts.get("Worker") or 0) + 1
+
+        self._patch_status(job, bump)
+        self._delete_pods(job)
+        if self.admission.note_preempted(job.key, job.uid, cause):
+            self._preempt_acks += 1
+        job.phase = "queued"
+        job.queued_since = self.clock.now
+        job.completion_version += 1  # invalidate the scheduled completion
+        self.queue.add(job.key)
+
+    def _complete(self, key: str, version: int) -> None:
+        job = self.jobs.get(key)
+        if job is None or job.phase != "running":
+            return
+        if version != job.completion_version:
+            return  # resized/preempted since scheduled: stale event
+        self._accrue(job)
+        job.phase = "completed"
+        self.active.pop(key, None)
+        job.completed_at = self.clock.now
+        self._last_completion_t = self.clock.now
+        self._completed += 1
+        ns = job.arrival.namespace
+        self._per_tenant_done[ns] = self._per_tenant_done.get(ns, 0) + 1
+
+        def succeed(status: dict) -> None:
+            conds = [
+                c for c in status.get("conditions") or []
+                if c.get("type") != "Succeeded"
+            ]
+            conds.append({
+                "type": "Succeeded", "status": "True",
+                "reason": "FleetSimCompleted",
+            })
+            status["conditions"] = conds
+
+        self._patch_status(job, succeed)
+        self.admission.release(key)
+        self._delete_pods(job)
+        # Reap the terminal job so the live set (and every O(live)
+        # control-plane scan) stays bounded at fleet scale — the GC
+        # sweep a real cluster runs, compressed to the completion event.
+        from ..cluster.base import NotFound
+
+        try:
+            self.chaos.delete_job(
+                "JAXJob", job.arrival.namespace, job.arrival.name)
+        except NotFound:
+            pass
+        self.expectations.delete_expectations(job.key, "pod")
+
+    # -------------------------------------------------------- heartbeats
+    def _heartbeat(self, key: str) -> None:
+        job = self.jobs.get(key)
+        if job is None or job.phase != "running":
+            return
+        self._accrue(job)
+        job.ckpt_step = int(job.done)
+        tps = 1000.0 * max(1, job.workers)
+        pod_name = f"{job.arrival.name}-worker-0"
+        lease_name = constants.heartbeat_lease_name(pod_name)
+        lease = {
+            "metadata": {
+                "namespace": job.arrival.namespace,
+                "name": lease_name,
+                "annotations": {
+                    constants.ANNOTATION_HEARTBEAT_TPS: f"{tps:.1f}",
+                    constants.ANNOTATION_HEARTBEAT_STEP: str(job.ckpt_step),
+                    constants.ANNOTATION_HEARTBEAT_CKPT: str(job.ckpt_step),
+                },
+            },
+            "spec": {
+                "holderIdentity": pod_name,
+                "renewTime": self.clock.now,
+            },
+        }
+        from ..cluster.base import NotFound
+
+        try:
+            self.mem.get_lease(job.arrival.namespace, lease_name)
+            self.chaos.update_lease(lease)
+        except NotFound:
+            self.chaos.create_lease(lease)
+        self._push(
+            self.clock.now + self.scenario.heartbeat_period,
+            "heartbeat", key)
+
+    # ------------------------------------------------------------ storms
+    def _fire_storm(self, ev: StormEvent) -> None:
+        if ev.kind == "revoke-capacity":
+            self.chaos.revoke_capacity(dict(ev.capacity or {}))
+            # The arbiter only notices at its next pump: nudge every
+            # admitted job through a sync, exactly as the engine's
+            # resync would — the revocation sweep preempts to fit.
+            for key in sorted(self.active):
+                if self.active[key].phase == "running":
+                    self.queue.add(key)
+        elif ev.kind == "preempt-slice":
+            target = self._slice_target(ev.slice_index)
+            if target is not None:
+                self.chaos.preempt_slice(
+                    target.arrival.name, ev.slice_index,
+                    namespace=target.arrival.namespace)
+                self._slice_restart(target, ev.slice_index)
+        elif ev.kind == "freeze-heartbeats":
+            self.chaos.freeze_heartbeats(name_contains=ev.name_contains)
+        elif ev.kind == "thaw-heartbeats":
+            self.chaos.thaw_heartbeats()
+
+    def _slice_target(self, slice_index: int) -> Optional[_SimJob]:
+        for key in sorted(self.active):
+            job = self.active[key]
+            if (job.phase == "running" and job.arrival.elastic
+                    and job.num_slices > slice_index):
+                return job
+        return None
+
+    def _slice_restart(self, job: _SimJob, slice_index: int) -> None:
+        """Slice-scoped counted restart (PR 11's failure domain): the
+        reclaimed slice's pods died; count it, replace ONLY those pods
+        (survivor UIDs stable), and charge a restart penalty to the
+        completion model."""
+        self._accrue(job)
+        job.slice_restarts += 1
+
+        def bump(status: dict) -> None:
+            counts = status.setdefault("sliceRestartCounts", {})
+            counts["Worker"] = int(counts.get("Worker") or 0) + 1
+
+        self._patch_status(job, bump)
+        hosts = max(1, self.scenario.hosts_per_slice)
+        from ..cluster.base import NotFound
+
+        base = slice_index * hosts
+        dead = 0
+        for idx, name in [
+                (i, n) for i, n in job.live.items()
+                if base <= i < base + hosts]:
+            try:
+                self.chaos.delete_pod(job.arrival.namespace, name)
+                dead += 1
+            except NotFound:
+                pass
+            del job.live[idx]
+        self._set_running_pods(-dead)
+        if job.phase == "running":
+            self.expectations.expect_creations(job.key, "pod", hosts)
+            for idx in range(base, base + hosts):
+                pod = self._make_pod(job, idx)
+                self.chaos.create_pod(pod)
+                job.live[idx] = pod.metadata.name
+                self.expectations.creation_observed(job.key, "pod")
+            self.mem.step()
+            self._set_running_pods(hosts)
+            job.done = max(0.0, job.done - 10.0)  # restart-window loss
+            self._schedule_completion(job)
+
+    # --------------------------------------------------------- resyncs
+    def _resync(self) -> None:
+        """Periodic backstop. ONE pump evaluates the whole waiting set
+        and its admit-kicks requeue every newly admitted gang, so the
+        resync pokes only the oldest queued gang (O(queued), not
+        O(queued^2) pumps) — plus any running gang with a pending
+        preemption mark, whose counted teardown the engine owes."""
+        oldest: Optional[Tuple[float, str]] = None
+        marked: List[str] = []
+        for key, job in self.active.items():
+            if job.phase == "queued":
+                if oldest is None or (job.queued_since, key) < oldest:
+                    oldest = (job.queued_since, key)
+            elif job.phase == "running" and (
+                    self.admission.preemption_requested(key) is not None):
+                marked.append(key)
+        for key in sorted(marked):
+            self.queue.add(key)
+        if oldest is not None:
+            self.queue.add(oldest[1])
+
+    def _autoscaler_tick(self) -> None:
+        if self.autoscaler is None:
+            return
+        applied = self.autoscaler.tick()
+        for resize in applied:
+            job = self.jobs.get(resize.key)
+            if job is None or job.phase != "running":
+                continue
+            self._accrue(job)
+            hosts = max(1, self.scenario.hosts_per_slice)
+            job.num_slices = resize.to_slices
+            job.workers = resize.to_slices * hosts
+            # Re-ask the gate at the new demand BEFORE touching pods
+            # (grow must re-grant in place or cap; shrink releases).
+            self._sync(resize.key)
+            if job.phase == "running":
+                self._reconcile_pods(job)
+                self._schedule_completion(job)
+
+    def _coordinator_tick(self) -> None:
+        if self.coordinator is not None:
+            self.coordinator.tick()
+
+    # ----------------------------------------------------- epoch sweeps
+    def _queued_view(self) -> List[Tuple[str, float, int]]:
+        return [
+            (key, self.clock.now - j.queued_since, j.workers)
+            for key, j in sorted(self.active.items())
+            if j.phase == "queued"
+        ]
+
+    def _epoch_sweep(self, label: str) -> None:
+        from .invariants import (
+            check_admission_invariants, check_autoscaler_invariants,
+            check_fleet_invariants, check_job_invariants,
+        )
+
+        self._sweeps += 1
+        violations = check_job_invariants(self.mem, ("JAXJob",))
+        violations.extend(check_admission_invariants(
+            self.admission, cluster=self.mem, kinds=("JAXJob",)))
+        if self.autoscaler is not None:
+            violations.extend(check_autoscaler_invariants(
+                self.autoscaler, cluster=self.mem, kinds=("JAXJob",)))
+        running = sum(
+            1 for j in self.active.values() if j.phase == "running")
+        queued = self._queued_view()
+        snap = self.admission.snapshot()
+        violations.extend(check_fleet_invariants(
+            arrivals=self._arrived,
+            completed=self._completed,
+            running=running,
+            queued=len(queued),
+            preempt_marks=self._preempt_marks,
+            preempt_acks=self._preempt_acks,
+            queued_waits=queued,
+            aging_seconds=self.scenario.aging_seconds,
+            resync_period=self.scenario.resync_period,
+            admission_snapshot=snap,
+            running_pods=self._running_pods,
+            admits_in_window=self._admits_in_window,
+        ))
+        self._admits_in_window = 0
+        if violations:
+            self._sweep_violations.extend(
+                f"[{label}] {v}" for v in violations)
+        self._resident_peak = max(
+            self._resident_peak, self.watch_cache.resident_objects())
+
+    # --------------------------------------------------------- draining
+    def _drain_queue(self) -> None:
+        while True:
+            item = self.queue.get(timeout=0)
+            if item is None:
+                return
+            try:
+                self._sync(item)
+            finally:
+                self.queue.done(item)
+
+    # --------------------------------------------------------------- run
+    def run(self) -> dict:
+        sc = self.scenario
+        wall0 = time.perf_counter()
+        for a in self.trace:
+            self._push(a.t, "arrival", a)
+        for ev in sc.storm:
+            self._push(ev.t, "storm", ev)
+        # Recurring ticks self-reschedule while the fleet is live (so a
+        # storm backlog keeps getting resynced however long it takes to
+        # drain), and stop once every arrival is accounted terminal —
+        # the virtual horizon then reflects actual work. A wedged
+        # scenario (a freeze with no thaw) is cut off at the hard cap
+        # and fails its final invariant sweep loudly.
+        self._hard_stop = sc.horizon * 10 + 86400.0
+        self._push(sc.resync_period, "resync", None)
+        if self.autoscaler is not None:
+            self._push(sc.autoscaler_tick, "autoscaler", None)
+        if self.coordinator is not None:
+            self._push(0.0, "coordinator", None)
+        self._push(sc.epoch_seconds, "epoch", None)
+
+        recurring = {
+            "resync": (sc.resync_period, lambda d: self._resync()),
+            "autoscaler": (
+                sc.autoscaler_tick, lambda d: self._autoscaler_tick()),
+            "coordinator": (
+                sc.coordinator_tick, lambda d: self._coordinator_tick()),
+            "epoch": (
+                sc.epoch_seconds,
+                lambda d: self._epoch_sweep(f"epoch@{self.clock.now:g}")),
+        }
+        handlers = {
+            "arrival": lambda d: self._arrive(d),
+            "storm": lambda d: self._fire_storm(d),
+            "heartbeat": lambda d: self._heartbeat(d),
+            "complete": lambda d: self._complete(*d),
+            "drain": lambda d: None,
+        }
+        while self._heap:
+            t, _, kind, data = heapq.heappop(self._heap)
+            drained = self._completed >= len(self.trace)
+            if kind in recurring:
+                if drained:
+                    continue
+                self.clock.advance_to(t)
+                period, handler = recurring[kind]
+                handler(data)
+                if t + period <= self._hard_stop:
+                    self._push(t + period, kind, None)
+                self._drain_queue()
+                continue
+            if drained and kind == "drain":
+                continue
+            self.clock.advance_to(t)
+            handlers[kind](data)
+            self._drain_queue()
+        self._end_t = self.clock.now
+        self._note_util()
+        self._epoch_sweep("final")
+        wall = time.perf_counter() - wall0
+        self.report = self._build_report(wall)
+        return self.report
+
+    # ------------------------------------------------------------ report
+    def _hot_paths(self) -> dict:
+        pump_count, pump_sum = self.metrics.labeled_histogram_stats(
+            "training_operator_admission_pump_seconds")
+        decide_count, decide_sum = self.metrics.labeled_histogram_stats(
+            "training_operator_autoscaler_decide_seconds")
+        return {
+            "pump_calls": pump_count,
+            "pump_seconds_total": round(pump_sum, 6),
+            "pump_seconds_per_call": round(
+                pump_sum / pump_count, 9) if pump_count else None,
+            "autoscaler_decide_calls": decide_count,
+            "autoscaler_decide_seconds_per_call": round(
+                decide_sum / decide_count, 9) if decide_count else None,
+            "watch_cache_resident_objects_peak": self._resident_peak,
+            "decision_log_entries": (
+                len(self.admission.decision_log)
+                + (len(self.autoscaler.decision_log)
+                   if self.autoscaler else 0)
+            ),
+        }
+
+    def digest(self) -> str:
+        """The byte-equality artifact: trace lines + both decision logs
+        + the chaos fault log + the completion order, hashed. Two runs
+        of one scenario must agree on every byte here."""
+        h = hashlib.sha256()
+        for a in self.trace:
+            h.update(a.line().encode())
+            h.update(b"\n")
+        for line in self.admission.decision_log_lines():
+            h.update(line.encode())
+            h.update(b"\n")
+        if self.autoscaler is not None:
+            for line in self.autoscaler.decision_log_lines():
+                h.update(line.encode())
+                h.update(b"\n")
+        for entry in self.chaos.fault_log:
+            h.update(entry.encode())
+            h.update(b"\n")
+        for key, job in sorted(self.jobs.items()):
+            h.update(
+                f"{key}:{job.phase}:{job.completed_at}:{job.disruptions}:"
+                f"{job.slice_restarts}".encode())
+            h.update(b"\n")
+        return h.hexdigest()
+
+    def _build_report(self, wall: float) -> dict:
+        sc = self.scenario
+        horizon = max(self._end_t, 1e-9)
+        makespan = None
+        if self._first_arrival_t is not None and self._last_completion_t:
+            makespan = round(
+                self._last_completion_t - self._first_arrival_t, 3)
+        capacity_area = sc.capacity_pods * horizon
+        tenants_done = dict(sorted(self._per_tenant_done.items()))
+        shares = [
+            n / max(1, self._completed) for n in tenants_done.values()
+        ]
+        jain = (
+            round(sum(shares) ** 2 / (len(shares) * sum(
+                s * s for s in shares)), 4)
+            if shares and sum(s * s for s in shares) > 0 else None
+        )
+        return {
+            "scenario": sc.name,
+            "seed": sc.seed,
+            "profile": sc.profile,
+            "jobs": len(self.trace),
+            "tenants": sc.tenants,
+            "virtual_horizon_s": round(horizon, 3),
+            "wall_s": round(wall, 3),
+            "compression_x": round(horizon / max(wall, 1e-9), 1),
+            "completed": self._completed,
+            "makespan_s": makespan,
+            "utilization": round(
+                self._util_area / capacity_area, 4) if capacity_area else None,
+            "fairness_jain": jain,
+            "preemptions": self._preempt_acks,
+            "slice_restarts": sum(
+                j.slice_restarts for j in self.jobs.values()),
+            "resizes": (
+                len(self.autoscaler.resize_ledger)
+                if self.autoscaler else 0),
+            "deferred_syncs": self._deferred_syncs,
+            "fault_log_entries": len(self.chaos.fault_log),
+            "invariant_sweeps": self._sweeps,
+            "invariant_violations": list(self._sweep_violations),
+            "hot_paths": self._hot_paths(),
+            "digest": self.digest(),
+        }
+
+
+def run_scenario(scenario: Scenario) -> dict:
+    """One seeded fleet-sim run: build, run, report."""
+    return FleetSim(scenario).run()
+
+
+# ------------------------------------------------------- builtin corpus
+
+
+def smoke_scenario() -> Scenario:
+    """The CI smoke gate's composed storm: 5k jobs / 64 tenants with
+    capacity revocation + slice preemption + a lease steal landing on a
+    4-shard ring, sized to clear the >=100x compression gate well inside
+    the existing CI step budgets."""
+    return Scenario(
+        name="smoke-composed", seed=2026, profile="bursty", jobs=5000,
+        tenants=64, horizon=14400.0, capacity_pods=192, policy="priority",
+        autoscaler=True, elastic_jobs=24, hosts_per_slice=2, shards=4,
+        aging_seconds=600.0,
+        storm=[
+            StormEvent(t=3600.0, kind="revoke-capacity",
+                       capacity={"pods": "128"}),
+            StormEvent(t=4200.0, kind="preempt-slice", slice_index=0),
+            StormEvent(t=5400.0, kind="revoke-capacity",
+                       capacity={"pods": "192"}),
+            StormEvent(t=6000.0, kind="preempt-slice", slice_index=0),
+            StormEvent(t=9000.0, kind="preempt-slice", slice_index=1),
+            StormEvent(t=10800.0, kind="preempt-slice", slice_index=0),
+        ],
+        lease_steals=[
+            {"at_renew": 12, "name_contains": "-shard-1",
+             "rival": "phantom"},
+        ],
+    )
+
+
+def builtin_scenarios() -> Dict[str, Scenario]:
+    """The storm corpus, generated in code so the checked-in JSON files
+    (tf_operator_tpu/testing/scenarios/*.json) can be regression-tested
+    against their generators: a drive-by edit to a corpus file that
+    changes replay bytes fails the fleet tier, not a user's run."""
+    return {
+        "burst-storm": Scenario(
+            name="burst-storm", seed=1701, profile="bursty",
+            jobs=600, tenants=16, horizon=3600.0, capacity_pods=48,
+            policy="priority", aging_seconds=600.0, shards=1,
+            storm=[
+                StormEvent(t=900.0, kind="revoke-capacity",
+                           capacity={"pods": "24"}),
+                StormEvent(t=1800.0, kind="revoke-capacity",
+                           capacity={"pods": "48"}),
+            ],
+        ),
+        "capacity-churn-slices": Scenario(
+            name="capacity-churn-slices", seed=1702, profile="bursty",
+            jobs=400, tenants=12, horizon=3600.0, capacity_pods=48,
+            policy="priority", autoscaler=True, elastic_jobs=6,
+            hosts_per_slice=2, aging_seconds=600.0,
+            storm=[
+                StormEvent(t=600.0, kind="revoke-capacity",
+                           capacity={"pods": "28"}),
+                StormEvent(t=1200.0, kind="preempt-slice", slice_index=0),
+                StormEvent(t=2000.0, kind="revoke-capacity",
+                           capacity={"pods": "48"}),
+                StormEvent(t=2600.0, kind="preempt-slice", slice_index=1),
+            ],
+        ),
+        "lease-steal-flap": Scenario(
+            name="lease-steal-flap", seed=1703, profile="diurnal",
+            jobs=400, tenants=12, horizon=3600.0, capacity_pods=40,
+            policy="priority", shards=4, aging_seconds=600.0,
+            lease_steals=[
+                {"at_renew": 6, "name_contains": "-shard-0",
+                 "rival": "phantom-a"},
+                {"at_renew": 14, "name_contains": "-shard-2",
+                 "rival": "phantom-b"},
+            ],
+            renew_delays=[
+                {"after_renews": 20, "drop_renews": 2,
+                 "name_contains": "-shard-1"},
+            ],
+        ),
+        "diurnal-trough-backfill": Scenario(
+            name="diurnal-trough-backfill", seed=1704,
+            profile="serving-trough", jobs=600, tenants=16,
+            horizon=7200.0, capacity_pods=48, policy="drf",
+            tenant_weights={"tenant-0000": 2.0},
+            aging_seconds=600.0,
+            storm=[
+                StormEvent(t=2400.0, kind="revoke-capacity",
+                           capacity={"pods": "32"}),
+                StormEvent(t=4800.0, kind="revoke-capacity",
+                           capacity={"pods": "48"}),
+            ],
+        ),
+    }

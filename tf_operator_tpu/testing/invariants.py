@@ -37,7 +37,8 @@ from __future__ import annotations
 
 import os
 import re
-from typing import Dict, List, Optional, Sequence
+from fractions import Fraction
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..core import constants
 
@@ -563,6 +564,118 @@ def check_autoscaler_invariants(
                         f"autoscaler: live job {name} has numSlices "
                         f"{num_slices} above maxSlices {hi}"
                     )
+    return violations
+
+
+def check_fleet_invariants(
+    *,
+    arrivals: int,
+    completed: int,
+    running: int,
+    queued: int,
+    preempt_marks: int,
+    preempt_acks: int,
+    queued_waits: Sequence[Tuple[str, float, int]] = (),
+    aging_seconds: float = 300.0,
+    resync_period: float = 60.0,
+    admission_snapshot: Optional[dict] = None,
+    running_pods: Optional[int] = None,
+    admits_in_window: Optional[int] = None,
+) -> List[str]:
+    """Fleet-level invariants — aggregate properties the per-job and
+    per-arbiter checkers cannot see, audited from the fleet-sim engine's
+    own counters plus the admission snapshot:
+
+    - conservation: no job is ever lost — every arrival is exactly one
+      of completed / running / queued at all times;
+    - ledger exactly-once in aggregate: every counted preemption mark
+      was acknowledged exactly once (marks == acks across the fleet);
+    - no lost wakeups: every gang the ENGINE considers queued is
+      registered waiting (or pending-preempt) in the arbiter — a queued
+      job the arbiter has forgotten can never be admitted again, which
+      is exactly the "stuck QUEUED" failure this invariant hunts (a
+      backlogged-but-draining fleet is NOT stuck: long waits under
+      contention are the scheduler working);
+    - progress: when the oldest waiter is past its aging bound AND fits
+      the free pool, the window since the last sweep must have admitted
+      something — aging guarantees escalation, so a whole sweep window
+      with free capacity, an aged head, and zero admissions means the
+      pump stopped being driven;
+    - fleet-wide capacity: the engine's live pod count never exceeds
+      the declared schedulable pool (`queued_waits` carries each queued
+      gang's (key, wait_seconds, member_count)).
+    """
+    violations: List[str] = []
+    accounted = completed + running + queued
+    if accounted != arrivals:
+        violations.append(
+            f"fleet: conservation broken — {arrivals} arrivals but "
+            f"{accounted} accounted (completed={completed} "
+            f"running={running} queued={queued}); jobs were lost or "
+            "double-counted"
+        )
+    if preempt_acks != preempt_marks:
+        violations.append(
+            f"fleet: preemption ledger not exactly-once in aggregate — "
+            f"{preempt_marks} counted marks vs {preempt_acks} acks"
+        )
+    snap = admission_snapshot or {}
+    capacity = snap.get("capacity") or {}
+    pod_capacity: Optional[float] = None
+    if "pods" in capacity:
+        try:
+            pod_capacity = float(Fraction(str(capacity["pods"])))
+        except (ValueError, ZeroDivisionError):
+            pod_capacity = None
+    if pod_capacity is not None and running_pods is not None:
+        if running_pods > pod_capacity + 1e-9:
+            violations.append(
+                f"fleet: capacity exceeded — {running_pods} live pods "
+                f"against a schedulable pool of {pod_capacity:g}"
+            )
+    usage = snap.get("usage") or {}
+    if pod_capacity is not None and "pods" in usage:
+        try:
+            used = float(Fraction(str(usage["pods"])))
+        except (ValueError, ZeroDivisionError):
+            used = 0.0
+        if used > pod_capacity + 1e-9:
+            violations.append(
+                f"fleet: admission usage {used:g} pods exceeds "
+                f"capacity {pod_capacity:g}"
+            )
+        free = pod_capacity - used
+    else:
+        free = None
+    if admission_snapshot is not None and queued_waits:
+        registered = {
+            entry.get("key") for entry in snap.get("waiting") or []
+        }
+        admitted_keys = {
+            entry.get("key") for entry in snap.get("admitted") or []
+        }
+        for key, waited, _members in queued_waits:
+            if waited <= 2.0 * resync_period:
+                continue  # redelivery slack: a fresh requeue may not have synced
+            if key not in registered and key not in admitted_keys:
+                violations.append(
+                    f"fleet: {key} is QUEUED in the engine but unknown "
+                    f"to the arbiter after {waited:.0f}s — lost wakeup"
+                )
+    if queued_waits and admits_in_window == 0:
+        stuck_bound = aging_seconds + 2.0 * resync_period
+        oldest_key, oldest_wait, oldest_members = max(
+            queued_waits, key=lambda q: q[1]
+        )
+        if oldest_wait > stuck_bound and (
+                free is None or oldest_members <= free + 1e-9):
+            violations.append(
+                f"fleet: no admissions for a whole sweep window while "
+                f"{oldest_key} has waited {oldest_wait:.0f}s (> aging "
+                f"{aging_seconds:g}s + 2x resync {resync_period:g}s) and "
+                f"its {oldest_members} pods fit the free pool — the pump "
+                "is not being driven"
+            )
     return violations
 
 
