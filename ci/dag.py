@@ -428,7 +428,8 @@ def default_dag() -> List[Step]:
         # Recovery tier (docs/design/checkpoint_recovery.md): the
         # fast-recovery plane. recovery-chaos runs the seeded restore-path
         # fault ladder (peer refused / hang / truncated shard / stale
-        # snapshot — byte-identical fault-log replay) plus the durability
+        # snapshot / died mid-transfer / stale manifest / partial owner —
+        # byte-identical fault-log replay) plus the durability
         # barrier units: the listener fires only after the async persist
         # finalizes, a crash in the persist window resumes on the previous
         # checkpoint, and the autoscaler's fresh-checkpoint gate can never
@@ -441,9 +442,12 @@ def default_dag() -> List[Step]:
         # --smoke): storage-vs-peer restore on one durable checkpoint
         # (peer must beat MODELED remote storage), the seeded
         # degraded-fallback ladder replayed byte-identically, operator
-        # peer discovery with exactly-once recovery ledgers, and the
-        # kill->restart->step-resumed wall clock; margins ratcheted via
-        # build/recovery_smoke_last.json.
+        # peer discovery with exactly-once recovery ledgers, the
+        # kill->restart->step-resumed wall clock, and the sharded leg:
+        # scatter-gather across two strided owners must beat the
+        # single-survivor pull (NIC model), its fault scenarios replay
+        # byte-equal, and the warm-start restore does zero storage
+        # reads; margins ratcheted via build/recovery_smoke_last.json.
         Step("recovery-smoke",
              [PY, "scripts/measure_control_plane.py", "--mode",
               "recovery", "--smoke"],

@@ -94,6 +94,8 @@ def main(argv=None) -> int:
         from tf_operator_tpu.bootstrap.heartbeat import (
             ENV_PEER_RESTORE_ADDRS,
             ENV_SHARD_SERVER,
+            ENV_SHARDED_RESTORE,
+            ENV_WARM_START,
         )
         from tf_operator_tpu.train.checkpoint import CheckpointManager
         from tf_operator_tpu.train.restore import restore_with_fallback
@@ -118,7 +120,15 @@ def main(argv=None) -> int:
             a for a in os.environ.get(ENV_PEER_RESTORE_ADDRS, "").split(",")
             if a
         ]
-        outcome = restore_with_fallback(state, ckpt, peers)
+        truthy = ("1", "true", "yes")
+        outcome = restore_with_fallback(
+            state, ckpt, peers,
+            # Operator contracts (bootstrap/heartbeat.py): scatter-gather
+            # across survivors, and the elastic-grow zero-storage-read
+            # warm start. Both absent on a dev box.
+            sharded=os.environ.get(ENV_SHARDED_RESTORE) in truthy,
+            warm_start=os.environ.get(ENV_WARM_START) in truthy,
+        )
         state = outcome.state
         record_restore(outcome.path, outcome.cause, outcome.seconds)
         if outcome.step is not None:
@@ -132,7 +142,13 @@ def main(argv=None) -> int:
             # advertise the address on the heartbeat lease.
             from tf_operator_tpu.runtime.shard_server import start_shard_server
 
-            shard_srv = start_shard_server(ckpt)
+            # Slice topology shapes the /v1/manifest ownership stride so
+            # scatter-gather clients split their pull across slices.
+            shard_srv = start_shard_server(
+                ckpt,
+                slice_index=topo.slice_index if topo.num_slices > 1 else None,
+                num_slices=topo.num_slices if topo.num_slices > 1 else None,
+            )
             record_peer_address(shard_srv.address)
 
     if args.batch % topo.num_processes:

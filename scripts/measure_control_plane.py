@@ -2660,6 +2660,216 @@ def _recovery_restart_leg(ckpt_dir, peer_address, regressions):
     return {"storage": storage, "peer": peer}
 
 
+# The 2-survivor NIC model (the sharded leg's analog of RECOVERY_REMOTE_*):
+# both legs run on loopback, where serving bytes is nearly free — but on a
+# real pod a restoring rank's pull is bounded by each SERVING peer's NIC.
+# The modeled figures charge every peer its served bytes at a sustained
+# single-NIC rate: the single-survivor pull pushes the whole tree through
+# one NIC, the scatter-gather's bottleneck is only its most-loaded peer.
+# Raw wall-clock numbers ride along in the JSON for audit, exactly like
+# the storage legs.
+RECOVERY_PEER_NIC_BPS = 200e6
+
+
+def _recovery_sharded_leg(state, fresh, ckpt_dir, servers, regressions):
+    """Leg E: scatter-gather vs single-survivor restore on the 2-survivor
+    topology. Both survivors serve the same durable snapshot; each claims
+    its stride of the shard namespace via /v1/manifest, so the sharded
+    client splits the transfer while the single-survivor client pulls the
+    whole tree through one peer."""
+    from tf_operator_tpu.train.checkpoint import CheckpointManager
+    from tf_operator_tpu.train.restore import http_fetch, restore_with_fallback
+
+    addrs = [s.address for s in servers]
+    meta = json.loads(http_fetch(addrs[0], "/v1/meta", 5.0)[2])
+    num_shards = len(meta["shards"])
+    total_bytes = sum(s["bytes"] for s in meta["shards"].values())
+
+    single_s, sharded_s = [], []
+    max_share = 1.0
+    mgr = CheckpointManager(ckpt_dir)
+    try:
+        for trial in range(RECOVERY_TRIALS):
+            o_single = restore_with_fallback(fresh, mgr, [addrs[0]])
+            o_sharded = restore_with_fallback(
+                fresh, mgr, addrs, sharded=True)
+            single_s.append(o_single.seconds)
+            sharded_s.append(o_sharded.seconds)
+            if trial == 0:
+                if (o_sharded.path, o_sharded.cause) != ("peer-sharded", "ok") \
+                        or o_sharded.step != RECOVERY_STEP:
+                    regressions.append(
+                        f"sharded restore landed on {o_sharded.path}/"
+                        f"{o_sharded.cause}/{o_sharded.step}, wanted "
+                        f"peer-sharded/ok/{RECOVERY_STEP}")
+                elif not _trees_equal(o_sharded.state, state):
+                    regressions.append(
+                        "sharded-restored state differs from the saved state")
+                sources = o_sharded.sources or {}
+                if sorted(sources) != sorted(addrs):
+                    regressions.append(
+                        f"scatter-gather did not split across both "
+                        f"survivors: sources={sources}")
+                else:
+                    max_share = max(sources.values()) / max(num_shards, 1)
+    finally:
+        mgr.close()
+
+    single_raw = statistics.median(single_s)
+    sharded_raw = statistics.median(sharded_s)
+    single_modeled = single_raw + total_bytes / RECOVERY_PEER_NIC_BPS
+    sharded_modeled = sharded_raw + (
+        max_share * total_bytes / RECOVERY_PEER_NIC_BPS)
+    return {
+        "single_survivor_raw_s": round(single_raw, 4),
+        "single_survivor_s": round(single_modeled, 4),
+        "sharded_raw_s": round(sharded_raw, 4),
+        "sharded_restore_s": round(sharded_modeled, 4),
+        "max_peer_share": round(max_share, 4),
+        "shards": num_shards,
+        "bytes": total_bytes,
+        "nic_model_bps": RECOVERY_PEER_NIC_BPS,
+        "trials": RECOVERY_TRIALS,
+    }
+
+
+# (label, fault kwargs, expected (path, cause)) for the SHARDED ladder on
+# the 2-survivor topology — every scenario must land where stated, twice,
+# with byte-equal fault logs (the new-kind injector coverage the docs'
+# failure-mode taxonomy points at).
+RECOVERY_SHARDED_FAULT_SCENARIOS = (
+    # Peer 0 dies on its first shard fetch: its planned shards re-plan
+    # onto the surviving peer and the restore still completes peer-side.
+    ("die-mid-transfer",
+     {"kind": "die-mid-transfer", "op": "shard", "peer": 0, "at_call": 1},
+     ("peer-sharded", "ok")),
+    # BOTH manifests advertise one step behind storage: staleness
+    # arbitration sends the whole tree to storage, same as stale-meta.
+    ("stale-manifest",
+     {"kind": "stale-manifest", "op": "manifest-body", "at_call": 1,
+      "count": 2},
+     ("storage", "stale-snapshot")),
+    # Both survivors claim only the front half of their strides: the
+    # orphaned names fall back to the all-peers plan and still arrive.
+    ("partial-owner",
+     {"kind": "partial-owner", "op": "manifest-body", "at_call": 1,
+      "count": 2},
+     ("peer-sharded", "ok")),
+)
+
+
+def _recovery_sharded_fault_leg(fresh, ckpt_dir, servers, regressions):
+    """Leg F: the seeded sharded-ladder faults (die-mid-transfer /
+    stale-manifest / partial-owner), each replayed twice byte-equal with
+    the features ON."""
+    from tf_operator_tpu.cluster.chaos import (
+        ChaosCluster,
+        ChaosSpec,
+        ScheduledRestoreFault,
+    )
+    from tf_operator_tpu.cluster.memory import InMemoryCluster
+    from tf_operator_tpu.train.checkpoint import CheckpointManager
+    from tf_operator_tpu.train.restore import restore_with_fallback
+
+    addrs = [s.address for s in servers]
+    results = []
+    mgr = CheckpointManager(ckpt_dir)
+    try:
+        for label, fault_kwargs, want in RECOVERY_SHARDED_FAULT_SCENARIOS:
+            logs = []
+            outcome = None
+            for _run in range(2):
+                chaos = ChaosCluster(InMemoryCluster(), ChaosSpec(
+                    seed=11,
+                    restore_faults=(ScheduledRestoreFault(**fault_kwargs),),
+                ))
+                outcome = restore_with_fallback(
+                    fresh, mgr, addrs, sharded=True,
+                    fault_injector=chaos.restore_fault_injector(),
+                    sleep=lambda _s: None,
+                )
+                logs.append(list(chaos.fault_log))
+            if (outcome.path, outcome.cause) != want or \
+                    outcome.step != RECOVERY_STEP:
+                regressions.append(
+                    f"sharded fault scenario {label}: got {outcome.path}/"
+                    f"{outcome.cause}/{outcome.step}, wanted "
+                    f"{want[0]}/{want[1]}/{RECOVERY_STEP}")
+            if logs[0] != logs[1]:
+                regressions.append(
+                    f"sharded fault scenario {label}: seeded replay "
+                    f"diverged ({logs[0]} vs {logs[1]})")
+            if not logs[0]:
+                regressions.append(
+                    f"sharded fault scenario {label}: no fault fired — "
+                    "the scenario is vacuous")
+            results.append({"scenario": label, "path": outcome.path,
+                            "cause": outcome.cause, "fault_log": logs[0]})
+    finally:
+        mgr.close()
+    return results
+
+
+class _StorageReadCounter:
+    """CheckpointManager proxy that counts every storage READ the restore
+    ladder performs — the warm-start grow's zero-read attribution."""
+
+    def __init__(self, mgr):
+        self._mgr = mgr
+        self.storage_reads = 0
+
+    def latest_step(self):
+        self.storage_reads += 1
+        return self._mgr.latest_step()
+
+    def restore_latest(self, state):
+        self.storage_reads += 1
+        return self._mgr.restore_latest(state)
+
+    def abstract_state(self, state):
+        return self._mgr.abstract_state(state)
+
+    def __getattr__(self, name):
+        return getattr(self._mgr, name)
+
+
+def _recovery_warm_start_leg(state, fresh, ckpt_dir, servers, regressions):
+    """Leg G: a warm-start grow restore (the TPU_WARM_START contract)
+    completes entirely from live peers with ZERO storage reads — the
+    counting proxy attributes every latest_step()/restore_latest() the
+    ladder would have issued."""
+    from tf_operator_tpu.train.checkpoint import CheckpointManager
+    from tf_operator_tpu.train.restore import restore_with_fallback
+
+    addrs = [s.address for s in servers]
+    mgr = CheckpointManager(ckpt_dir)
+    counter = _StorageReadCounter(mgr)
+    try:
+        outcome = restore_with_fallback(
+            fresh, counter, addrs, sharded=True, warm_start=True)
+    finally:
+        mgr.close()
+    if (outcome.path, outcome.cause) != ("peer-sharded", "ok") or \
+            outcome.step != RECOVERY_STEP:
+        regressions.append(
+            f"warm-start restore landed on {outcome.path}/{outcome.cause}/"
+            f"{outcome.step}, wanted peer-sharded/ok/{RECOVERY_STEP}")
+    elif not _trees_equal(outcome.state, state):
+        regressions.append(
+            "warm-start-restored state differs from the saved state")
+    if counter.storage_reads != 0:
+        regressions.append(
+            f"warm-start grow performed {counter.storage_reads} storage "
+            "read(s); the contract is zero")
+    return {
+        "path": outcome.path,
+        "cause": outcome.cause,
+        "seconds": round(outcome.seconds, 4),
+        "storage_reads": counter.storage_reads,
+        "sources": outcome.sources,
+    }
+
+
 def recovery_main(smoke=False) -> int:
     """--mode recovery: the fast-recovery plane head-to-head. Leg A times
     storage-vs-peer restore on one durable checkpoint (peer must beat the
@@ -2667,8 +2877,13 @@ def recovery_main(smoke=False) -> int:
     leg B replays the seeded degraded-fallback ladder byte-identically;
     leg C proves operator-side peer discovery with exactly-once recovery
     ledgers; leg D measures kill->restart->step-resumed wall clock in a
-    fresh interpreter. --smoke gates all of it and ratchets the margins
-    via build/recovery_smoke_last.json."""
+    fresh interpreter; leg E races the scatter-gather restore against the
+    single-survivor pull on a 2-survivor topology (NIC-modeled, see
+    RECOVERY_PEER_NIC_BPS); leg F replays the sharded fault ladder
+    (die-mid-transfer / stale-manifest / partial-owner) byte-identically;
+    leg G proves a warm-start grow restores with zero storage reads.
+    --smoke gates all of it and ratchets the margins via
+    build/recovery_smoke_last.json."""
     import shutil
     import tempfile
 
@@ -2682,6 +2897,14 @@ def recovery_main(smoke=False) -> int:
     fresh = _recovery_state(step=0, fill="zeros")
     mgr = CheckpointManager(ckpt_dir)
     server = start_shard_server(mgr)
+    # The 2-survivor topology for the sharded legs: two servers over the
+    # same durable snapshot, each claiming its slice stride of the shard
+    # namespace (what two surviving slices of a 3-slice gang look like to
+    # a restoring rank).
+    shard_servers = [
+        start_shard_server(mgr, slice_index=0, num_slices=2),
+        start_shard_server(mgr, slice_index=1, num_slices=2),
+    ]
     try:
         t0 = time.perf_counter()
         mgr.save(state, force=True)
@@ -2700,8 +2923,16 @@ def recovery_main(smoke=False) -> int:
         operator = _recovery_operator_leg(regressions)
         restart = _recovery_restart_leg(
             ckpt_dir, server.address, regressions)
+        sharded = _recovery_sharded_leg(
+            state, fresh, ckpt_dir, shard_servers, regressions)
+        sharded_faults = _recovery_sharded_fault_leg(
+            fresh, ckpt_dir, shard_servers, regressions)
+        warm_start = _recovery_warm_start_leg(
+            state, fresh, ckpt_dir, shard_servers, regressions)
     finally:
         server.stop()
+        for s in shard_servers:
+            s.stop()
         mgr.close()
         shutil.rmtree(workdir, ignore_errors=True)
 
@@ -2731,7 +2962,25 @@ def recovery_main(smoke=False) -> int:
                 f"peer-vs-storage speedup {speedup}x regressed >"
                 f"{RECOVERY_REGRESSION}x vs previous run "
                 f"({prev_speedup}x)")
+        # Sharded gate: on the 2-survivor topology the scatter-gather
+        # pull must beat the single-survivor full-tree pull (both
+        # NIC-modeled — the split transfer is the whole point).
+        if sharded["sharded_restore_s"] >= sharded["single_survivor_s"]:
+            regressions.append(
+                f"sharded restore ({sharded['sharded_restore_s']}s) did "
+                f"not beat the single-survivor pull "
+                f"({sharded['single_survivor_s']}s)")
+        prev_sharded = prev.get("sharded_restore_s")
+        if prev_sharded and sharded["sharded_restore_s"] > (
+                prev_sharded * RECOVERY_REGRESSION):
+            regressions.append(
+                f"sharded restore {sharded['sharded_restore_s']}s "
+                f"regressed >{RECOVERY_REGRESSION}x vs previous run "
+                f"({prev_sharded}s)")
 
+    sharded_speedup = round(
+        sharded["single_survivor_s"]
+        / max(sharded["sharded_restore_s"], 1e-9), 3)
     out = {
         "mode": "recovery",
         "smoke": smoke,
@@ -2742,6 +2991,10 @@ def recovery_main(smoke=False) -> int:
         "faults": faults,
         "operator": operator,
         "restart": restart,
+        "sharded": sharded,
+        "sharded_speedup": sharded_speedup,
+        "sharded_faults": sharded_faults,
+        "warm_start": warm_start,
         "regression": "; ".join(regressions) or None,
     }
     rc = 1 if (smoke and regressions) else 0
@@ -2753,6 +3006,10 @@ def recovery_main(smoke=False) -> int:
             "snapshot_stall_s": round(snapshot_stall_s, 4),
             "restart_to_resumed_peer_s": (
                 (restart.get("peer") or {}).get("restart_to_resumed_s")),
+            "sharded_restore_s": sharded["sharded_restore_s"],
+            "single_survivor_s": sharded["single_survivor_s"],
+            "sharded_speedup": sharded_speedup,
+            "warm_start_storage_reads": warm_start["storage_reads"],
         })
     print(json.dumps(out))
     return rc

@@ -273,6 +273,30 @@ class TestAdmissionControllerUnit:
         assert adm.is_admitted("JAXJob:default/b")
         assert not adm.is_admitted("JAXJob:default/c")
 
+    def test_decision_log_ring_is_bounded_and_counts_drops(self):
+        """The decision-log audit ring has an EXPLICIT configurable cap;
+        overflow rotates oldest-out and the dropped counter tells the
+        determinism audit its window is truncated (0 = complete)."""
+        adm, _ = self._adm(capacity={"pods": "100"}, decision_log_max=2)
+        for i in range(5):
+            assert self._ask(adm, f"j{i}", 1).admitted
+        assert adm.decision_log_max == 2
+        assert len(adm.decision_log) == 2
+        assert adm.decision_log_dropped == 3
+        # The surviving window is the NEWEST entries, in order.
+        admitted = [a[1] for e in adm.decision_log for a in e["actions"]
+                    if a[0] == "admit"]
+        assert admitted == ["JAXJob:default/j3", "JAXJob:default/j4"]
+        snap = adm.snapshot()
+        assert snap["decision_log_max"] == 2
+        assert snap["decision_log_dropped"] == 3
+        # Default cap: generous, never unbounded, and nothing dropped
+        # at unit scale.
+        adm2, _ = self._adm(capacity={"pods": "100"})
+        assert self._ask(adm2, "a", 1).admitted
+        assert adm2.decision_log_max == 4096
+        assert adm2.snapshot()["decision_log_dropped"] == 0
+
     def test_quota_blocks_without_holding_the_line(self):
         adm, _ = self._adm(capacity={"pods": "8"}, quotas={"t": {"pods": "4"}})
         assert self._ask(adm, "t1", 4, ns="t").admitted

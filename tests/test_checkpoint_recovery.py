@@ -37,6 +37,7 @@ from tf_operator_tpu.runtime.shard_server import (
     SnapshotShardServer,
     decode_shard,
     parse_bundle,
+    partition_shard_names,
     shard_checksum,
     start_shard_server,
 )
@@ -45,6 +46,7 @@ from tf_operator_tpu.train.restore import (
     ChecksumMismatch,
     GeometryMismatch,
     http_fetch,
+    plan_scatter,
     restore_with_fallback,
 )
 from tf_operator_tpu.train.train_step import TrainState
@@ -423,6 +425,212 @@ class TestRestoreLadder:
         assert (out.path, out.step) == ("none", None)
         assert out.state is initial
         mgr.close()
+
+
+# --------------------------------------------------- scatter-gather restore
+def make_wide_state(step=5, scale=1.0, layers=4):
+    """A state with enough leaves (2 per layer) that a 2-way ownership
+    stride is non-trivial on both sides."""
+    return TrainState(
+        step=jnp.asarray(step, jnp.int32),
+        params={f"l{i}": {"w": jnp.full((4, 4), scale + i, jnp.float32)}
+                for i in range(layers)},
+        opt_state={f"l{i}": {"m": jnp.full((4, 4), scale * 2 + i,
+                                           jnp.float32)}
+                   for i in range(layers)},
+    )
+
+
+@pytest.fixture()
+def strided_ckpt(tmp_path):
+    """Step-5 durable checkpoint served by TWO survivors with strided
+    /v1/manifest ownership (slice 0 and slice 1 of 2)."""
+    mgr = CheckpointManager(str(tmp_path / "src"),
+                            model_meta={"heads": 16, "layers": 2})
+    servers = [
+        start_shard_server(mgr, slice_index=0, num_slices=2),
+        start_shard_server(mgr, slice_index=1, num_slices=2),
+    ]
+    mgr.save(make_wide_state(step=5, scale=3.0), force=True)
+    mgr.wait()
+    yield mgr, servers, tmp_path
+    for server in servers:
+        server.stop()
+    mgr.close()
+
+
+class TestShardedRestore:
+    def test_partition_strides_cover_the_namespace(self):
+        names = [f"s{i}" for i in range(7)]
+        a = partition_shard_names(names, 0, 2)
+        b = partition_shard_names(names, 1, 2)
+        assert sorted(a + b) == sorted(names)
+        assert not set(a) & set(b)
+        # Degenerate topologies own everything; slice index wraps.
+        assert partition_shard_names(names, 0, 1) == sorted(names)
+        assert partition_shard_names(names, 0, 0) == sorted(names)
+        assert partition_shard_names(names, 2, 2) == a
+
+    def test_manifest_endpoint_serves_owned_stride(self, strided_ckpt):
+        _mgr, servers, _ = strided_ckpt
+        manifests = []
+        for server in servers:
+            status, _, body = http_fetch(server.address, "/v1/manifest", 5.0)
+            assert status == 200
+            manifests.append(json.loads(body))
+        names = sorted(manifests[0]["shards"])
+        assert sorted(manifests[1]["shards"]) == names
+        owned0, owned1 = manifests[0]["owned"], manifests[1]["owned"]
+        assert sorted(owned0 + owned1) == names
+        assert not set(owned0) & set(owned1)
+        assert manifests[0]["step"] == 5
+
+    def test_manifest_defaults_to_full_ownership(self, durable_ckpt):
+        """A server started without slice topology claims every shard —
+        the single-survivor degenerate case of the scatter plan."""
+        _mgr, server, _ = durable_ckpt
+        status, _, body = http_fetch(server.address, "/v1/manifest", 5.0)
+        assert status == 200
+        manifest = json.loads(body)
+        assert manifest["owned"] == sorted(manifest["shards"])
+
+    def test_manifest_503_before_any_snapshot(self, snapshot_server):
+        _snap, server = snapshot_server
+        status, _, body = http_fetch(server.address, "/v1/manifest", 5.0)
+        assert status == 503
+        assert json.loads(body)["error"] == "no-snapshot"
+
+    def test_plan_scatter_balances_and_orphans_fall_back(self):
+        owners = {0: {"a", "c"}, 1: {"b", "d"}}
+        plan = plan_scatter(["a", "b", "c", "d"], owners)
+        assert plan == {"a": 0, "b": 1, "c": 0, "d": 1}
+        # An orphan (claimed by nobody) goes to the least-loaded peer:
+        # ownership is a planning hint, every survivor serves everything.
+        plan = plan_scatter(["a", "c", "e"], owners)
+        assert plan["a"] == 0 and plan["c"] == 0 and plan["e"] == 1
+
+    def test_scatter_gather_restores_exact_bytes(self, strided_ckpt):
+        mgr, servers, tmp_path = strided_ckpt
+        restore_mgr = CheckpointManager(str(tmp_path / "dst"))
+        addrs = [s.address for s in servers]
+        out = restore_with_fallback(
+            make_wide_state(step=0, scale=0.0), restore_mgr, addrs,
+            sharded=True)
+        assert (out.path, out.cause, out.step) == ("peer-sharded", "ok", 5)
+        assert leaves_equal(out.state, make_wide_state(step=5, scale=3.0))
+        # Both survivors actually served shards, covering the namespace.
+        assert sorted(out.sources) == sorted(addrs)
+        assert sum(out.sources.values()) == 9  # 8 tree leaves + step
+        restore_mgr.close()
+
+    def test_mixed_version_fleet_converges(self, strided_ckpt):
+        """One manifest-speaking survivor + one bundle-era peer (404 on
+        /v1/manifest): the probe falls back to /v1/meta for the old peer
+        and treats it as a full owner; the restore still scatter-gathers
+        across BOTH."""
+        mgr, servers, tmp_path = strided_ckpt
+        legacy = servers[1].address
+
+        def versioned(peer, path, timeout):
+            if peer == legacy and path.startswith("/v1/manifest"):
+                return 404, {}, b'{"error": "not-found"}'
+            return http_fetch(peer, path, timeout)
+
+        restore_mgr = CheckpointManager(str(tmp_path / "dst"))
+        out = restore_with_fallback(
+            make_wide_state(step=0, scale=0.0), restore_mgr,
+            [s.address for s in servers], sharded=True, fetcher=versioned)
+        assert (out.path, out.cause, out.step) == ("peer-sharded", "ok", 5)
+        assert leaves_equal(out.state, make_wide_state(step=5, scale=3.0))
+        assert sorted(out.sources) == sorted(s.address for s in servers)
+        restore_mgr.close()
+
+    def test_warm_start_does_zero_storage_reads(self, strided_ckpt):
+        """The elastic-grow contract: warm_start skips the staleness probe
+        and the happy path never touches storage at all."""
+        mgr, servers, tmp_path = strided_ckpt
+
+        class CountingCkpt:
+            def __init__(self, inner):
+                self._inner = inner
+                self.reads = 0
+
+            def latest_step(self):
+                self.reads += 1
+                return self._inner.latest_step()
+
+            def restore_latest(self, state):
+                self.reads += 1
+                return self._inner.restore_latest(state)
+
+            def abstract_state(self, state):
+                return self._inner.abstract_state(state)
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        counting = CountingCkpt(CheckpointManager(str(tmp_path / "dst")))
+        out = restore_with_fallback(
+            make_wide_state(step=0, scale=0.0), counting,
+            [s.address for s in servers], sharded=True, warm_start=True)
+        assert (out.path, out.cause, out.step) == ("peer-sharded", "ok", 5)
+        assert counting.reads == 0
+        assert leaves_equal(out.state, make_wide_state(step=5, scale=3.0))
+        counting._inner.close()
+
+    def test_all_peers_dead_storage_shard_fill(self, strided_ckpt):
+        """Every survivor dies mid-transfer; storage holds the SAME step,
+        so the per-shard fill completes the scatter plan (path stays
+        peer-sharded, cause names the fill)."""
+        from tf_operator_tpu.cluster.chaos import (
+            RestoreFaultInjector,
+            ScheduledRestoreFault,
+        )
+
+        mgr, servers, _ = strided_ckpt
+        inj = RestoreFaultInjector((
+            ScheduledRestoreFault(kind="die-mid-transfer", op="shard",
+                                  peer=0, at_call=1),
+            ScheduledRestoreFault(kind="die-mid-transfer", op="shard",
+                                  peer=1, at_call=1),
+        ))
+        out = restore_with_fallback(
+            make_wide_state(step=0, scale=0.0), mgr,
+            [s.address for s in servers], sharded=True,
+            fault_injector=inj, sleep=lambda _s: None)
+        assert (out.path, out.cause, out.step) == (
+            "peer-sharded", "storage-shard-fill", 5)
+        assert out.sources.get("storage", 0) > 0
+        assert leaves_equal(out.state, make_wide_state(step=5, scale=3.0))
+
+    def test_shard_fill_step_mismatch_degrades_whole_tree(self, strided_ckpt,
+                                                          tmp_path):
+        """Warm start, every peer dead, and storage holds a DIFFERENT step:
+        a mixed-step per-shard fill would assemble torn state, so the
+        ladder refuses it and degrades the WHOLE restore to storage."""
+        from tf_operator_tpu.cluster.chaos import (
+            RestoreFaultInjector,
+            ScheduledRestoreFault,
+        )
+
+        mgr, servers, _ = strided_ckpt
+        behind = CheckpointManager(str(tmp_path / "behind"))
+        behind.save(make_wide_state(step=3, scale=1.0), force=True)
+        behind.wait()
+        inj = RestoreFaultInjector((
+            ScheduledRestoreFault(kind="die-mid-transfer", op="shard",
+                                  peer=0, at_call=1),
+            ScheduledRestoreFault(kind="die-mid-transfer", op="shard",
+                                  peer=1, at_call=1),
+        ))
+        out = restore_with_fallback(
+            make_wide_state(step=0, scale=0.0), behind,
+            [s.address for s in servers], sharded=True, warm_start=True,
+            fault_injector=inj, sleep=lambda _s: None)
+        assert (out.path, out.cause, out.step) == (
+            "storage", "shard-fill-step-mismatch", 3)
+        assert leaves_equal(out.state, make_wide_state(step=3, scale=1.0))
+        behind.close()
 
 
 # ----------------------------------------------------------- heartbeat riders

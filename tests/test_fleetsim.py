@@ -161,7 +161,8 @@ class TestScenarioRoundTrip:
     def test_corpus_has_the_required_storms(self):
         names = set(named_scenarios())
         assert {"burst-storm", "capacity-churn-slices",
-                "lease-steal-flap", "diurnal-trough-backfill"} <= names
+                "lease-steal-flap", "diurnal-trough-backfill",
+                "warm-start-grow-churn"} <= names
 
 
 # ------------------------------------------------------------- engine
@@ -220,6 +221,40 @@ class TestFleetSimEngine:
         # Modeled step progress reached the autoscaler's observation
         # plane as real heartbeat-lease riders.
         assert report["hot_paths"]["autoscaler_decide_calls"] > 0
+
+    def test_warm_start_grows_counted_and_cheaper(self):
+        """Scenario.warm_start attributes applied grows to the warm path
+        (report keys grows / warm_start_grows) and charges the smaller
+        warm_start_restore_seconds penalty. The penalty feeds back into
+        completion timing (the decision streams legitimately diverge),
+        but on this seeded scenario the warm fleet grows and drains
+        strictly sooner."""
+        base = dict(jobs=24, autoscaler=True, elastic_jobs=4,
+                    capacity_pods=24, horizon=1200.0,
+                    grow_restore_seconds=60.0,
+                    warm_start_restore_seconds=5.0)
+        cold = FleetSim(tiny_scenario(**base)).run()
+        warm = FleetSim(tiny_scenario(warm_start=True, **base)).run()
+        for report in (cold, warm):
+            assert report["completed"] == report["jobs"]
+            assert report["invariant_violations"] == []
+            assert report["grows"] > 0
+        assert cold["warm_start_grows"] == 0
+        assert warm["warm_start_grows"] == warm["grows"]
+        assert warm["makespan_s"] < cold["makespan_s"]
+
+    def test_warm_start_defaults_keep_old_digests(self):
+        """The new Scenario fields default to no-ops: a pre-existing
+        scenario's digest is unchanged by their existence."""
+        sc = tiny_scenario(jobs=24, autoscaler=True, elastic_jobs=3,
+                           capacity_pods=24, horizon=900.0)
+        explicit = tiny_scenario(jobs=24, autoscaler=True, elastic_jobs=3,
+                                 capacity_pods=24, horizon=900.0,
+                                 warm_start=False, grow_restore_seconds=0.0,
+                                 warm_start_restore_seconds=0.0)
+        assert sc == explicit
+        assert FleetSim(sc).run()["digest"] == \
+            FleetSim(explicit).run()["digest"]
 
     def test_hot_path_columns_populate(self):
         report = FleetSim(tiny_scenario()).run()

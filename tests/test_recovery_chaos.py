@@ -101,6 +101,28 @@ class TestRestoreFaultInjector:
         assert inj.fault_for("meta", 0) == "refuse"
         assert chaos.fault_log == ["restore:meta#1:refuse:peer0"]
 
+    def test_die_mid_transfer_dead_set_freezes_counters(self):
+        """A peer killed by die-mid-transfer stays dead: every later
+        consult refuses silently (logged once, at the death), and the
+        dead peer's consults stop advancing counters — so the remaining
+        schedule plays out against survivors exactly as authored."""
+        log = []
+        inj = RestoreFaultInjector((
+            ScheduledRestoreFault(kind="die-mid-transfer", op="shard",
+                                  peer=0, at_call=1),
+            ScheduledRestoreFault(kind="truncate", op="shard",
+                                  peer=0, at_call=2),
+        ), log=log)
+        assert inj.fault_for("shard", 0) == "die-mid-transfer"
+        # Dead is dead — on EVERY op, without new log entries, and the
+        # at_call=2 truncate can never fire against a corpse.
+        assert inj.fault_for("shard", 0) == "refuse"
+        assert inj.fault_for("meta", 0) == "refuse"
+        assert inj.fault_for("shard", 0) == "refuse"
+        assert log == ["restore:shard#1:die-mid-transfer:peer0"]
+        # Other peers are untouched by the death.
+        assert inj.fault_for("shard", 1) is None
+
 
 # -------------------------------------------------------------- ladder + seed
 @pytest.fixture()
@@ -169,6 +191,87 @@ class TestSeededRestoreLadder:
         assert log1 == log2 and log1
         assert (out1.path, out1.cause) == (out2.path, out2.cause)
         assert out1.step == out2.step == STEP  # always lands somewhere real
+
+
+# -------------------------------------------------- sharded ladder + seed
+@pytest.fixture()
+def strided_served(tmp_path):
+    """Step-5 checkpoint behind TWO survivors with strided /v1/manifest
+    ownership — the scatter-gather ladder's 2-survivor topology."""
+    mgr = CheckpointManager(str(tmp_path / "src"))
+    servers = [
+        start_shard_server(mgr, slice_index=0, num_slices=2),
+        start_shard_server(mgr, slice_index=1, num_slices=2),
+    ]
+    mgr.save(make_state(scale=3.0), force=True)
+    mgr.wait()
+    yield mgr, servers
+    for server in servers:
+        server.stop()
+    mgr.close()
+
+
+def run_sharded_ladder(served, faults, retries=2):
+    mgr, servers = served
+    chaos = ChaosCluster(InMemoryCluster(), ChaosSpec(
+        seed=11, restore_faults=tuple(faults)))
+    out = restore_with_fallback(
+        make_state(step=0, scale=0.0), mgr,
+        [server.address for server in servers],
+        retries=retries, sharded=True,
+        fault_injector=chaos.restore_fault_injector(),
+        sleep=lambda _s: None)
+    return out, list(chaos.fault_log)
+
+
+class TestSeededShardedLadder:
+    """The new fault kinds against the scatter-gather rung: each scenario's
+    outcome is deterministic and its fault log replays byte-identically."""
+
+    def test_die_mid_transfer_replans_onto_survivor(self, strided_served):
+        _mgr, servers = strided_served
+        out, log = run_sharded_ladder(strided_served, [ScheduledRestoreFault(
+            kind="die-mid-transfer", op="shard", peer=0, at_call=1)])
+        assert (out.path, out.cause, out.step) == ("peer-sharded", "ok", STEP)
+        # The dead peer served nothing; the survivor covered the whole
+        # re-planned namespace (3 shards: step + 2 tree leaves).
+        assert out.sources == {servers[1].address: 3}
+        assert log == ["restore:shard#1:die-mid-transfer:peer0"]
+
+    def test_stale_manifest_arbitrates_to_storage(self, strided_served):
+        out, log = run_sharded_ladder(strided_served, [ScheduledRestoreFault(
+            kind="stale-manifest", op="manifest-body", at_call=1, count=2)])
+        assert (out.path, out.cause, out.step) == (
+            "storage", "stale-snapshot", STEP)
+        assert log == ["restore:manifest-body#1:stale-manifest:peer0",
+                       "restore:manifest-body#2:stale-manifest:peer1"]
+
+    def test_partial_owner_orphans_fall_back_to_any_peer(self,
+                                                         strided_served):
+        out, log = run_sharded_ladder(strided_served, [ScheduledRestoreFault(
+            kind="partial-owner", op="manifest-body", at_call=1, count=2)])
+        # Ownership is a planning hint: the orphaned back halves land on
+        # the all-peers fallback and the restore still completes clean.
+        assert (out.path, out.cause, out.step) == ("peer-sharded", "ok", STEP)
+        assert sum(out.sources.values()) == 3
+        assert log == ["restore:manifest-body#1:partial-owner:peer0",
+                       "restore:manifest-body#2:partial-owner:peer1"]
+
+    @pytest.mark.parametrize("fault", [
+        ScheduledRestoreFault(kind="die-mid-transfer", op="shard", peer=0,
+                              at_call=1),
+        ScheduledRestoreFault(kind="stale-manifest", op="manifest-body",
+                              at_call=1, count=2),
+        ScheduledRestoreFault(kind="partial-owner", op="manifest-body",
+                              at_call=1, count=2),
+    ], ids=["die-mid-transfer", "stale-manifest", "partial-owner"])
+    def test_new_kinds_replay_byte_identically(self, strided_served, fault):
+        out1, log1 = run_sharded_ladder(strided_served, [fault])
+        out2, log2 = run_sharded_ladder(strided_served, [fault])
+        assert log1 == log2 and log1
+        assert (out1.path, out1.cause, out1.step) == \
+            (out2.path, out2.cause, out2.step)
+        assert out1.sources == out2.sources
 
 
 # ------------------------------------------------------------- operator loop
@@ -379,3 +482,155 @@ class TestCapabilityGating:
             tracer=gated["tracer"],
             label="recovery_gated_off",
         )
+
+
+# -------------------------------------------------------- warm-start grow
+def elastic_manifest(slices=1, hosts=2):
+    m = multislice_manifest(slices, hosts)
+    m["spec"]["elastic"] = {"minSlices": 1, "maxSlices": 4}
+    return m
+
+
+class TestWarmStartGrow:
+    """EngineOptions.warm_start: an elastic GROW flags the world so every
+    recreated rank gets TPU_WARM_START=1 (pull from surviving peers' live
+    snapshots, zero storage reads); the flag clears once the grown world
+    is fully Running, and with the option off nothing is injected."""
+
+    def _grow(self, warm_start):
+        inner = InMemoryCluster()
+        controller = JAXController(
+            inner, options=EngineOptions(
+                peer_restore=True, sharded_restore=warm_start,
+                warm_start=warm_start))
+        inner.create_job(elastic_manifest(slices=1, hosts=2))
+        controller.run_until_idle()
+        for p in inner.list_pods("default"):
+            inner.set_pod_phase("default", p.metadata.name, "Running")
+        for i, p in enumerate(sorted(inner.list_pods("default"),
+                                     key=lambda p: p.metadata.name)):
+            hb.publish_heartbeat(
+                inner, "default",
+                constants.heartbeat_lease_name(p.metadata.name),
+                identity=p.metadata.name, step=STEP, tokens_per_sec=10.0,
+                checkpoint_step=STEP, peer_addr=f"10.0.0.{i}:8470")
+        controller.queue.add("JAXJob:default/rec")
+        controller.run_until_idle()
+        # Grow 1 -> 2 slices (what the SDK scale() helper submits).
+        job = inner.get_job("JAXJob", "default", "rec")
+        job["spec"]["numSlices"] = 2
+        job["spec"]["jaxReplicaSpecs"]["Worker"]["replicas"] = 4
+        inner.update_job(job)
+        pods = []
+        for _ in range(100):
+            controller.run_until_idle()
+            pods = [p for p in inner.list_pods(
+                        "default", labels={"job-name": "rec"})
+                    if p.metadata.deletion_timestamp is None]
+            if len(pods) == 4:
+                break
+            controller.queue.add("JAXJob:default/rec")
+            time.sleep(0.002)
+        return inner, controller, pods
+
+    def test_grow_injects_warm_start_until_world_is_full(self):
+        inner, controller, pods = self._grow(warm_start=True)
+        assert len(pods) == 4
+        for pod in pods:
+            env = pod_env(pod)
+            assert env[hb_bootstrap.ENV_WARM_START] == "1"
+            assert env[hb_bootstrap.ENV_SHARDED_RESTORE] == "1"
+            assert env[hb_bootstrap.ENV_SHARD_SERVER] == "1"
+        assert controller.engine._warm_start_pending
+        # The grow settles once every declared replica is back Running;
+        # later restarts of this world run the ordinary restore ladder.
+        for p in pods:
+            inner.set_pod_phase("default", p.metadata.name, "Running")
+        controller.queue.add("JAXJob:default/rec")
+        controller.run_until_idle()
+        assert not controller.engine._warm_start_pending
+
+    def test_grown_pods_get_snapshotted_survivor_addrs(self):
+        """The full-world teardown empties the live observation cache, so
+        the grown world's peer addresses come from the snapshot captured
+        when the grow was flagged — each rank sees every pre-grow
+        survivor EXCEPT its own predecessor's (dying) server."""
+        _inner, _controller, pods = self._grow(warm_start=True)
+        assert len(pods) == 4
+        pre_grow = {"10.0.0.0:8470", "10.0.0.1:8470"}
+        for pod in sorted(pods, key=lambda p: p.metadata.name):
+            env = pod_env(pod)
+            addrs = set(env[hb_bootstrap.ENV_PEER_RESTORE_ADDRS].split(","))
+            assert addrs and addrs <= pre_grow
+        # The two ranks whose names carry over from the 1-slice world must
+        # not be pointed at their own predecessors; collectively the pods
+        # still cover both survivors.
+        all_addrs = set()
+        for pod in pods:
+            all_addrs |= set(
+                pod_env(pod)[hb_bootstrap.ENV_PEER_RESTORE_ADDRS].split(","))
+        assert all_addrs == pre_grow
+
+    def test_gated_off_grow_injects_nothing(self):
+        _inner, controller, pods = self._grow(warm_start=False)
+        assert len(pods) == 4
+        for pod in pods:
+            env = pod_env(pod)
+            assert hb_bootstrap.ENV_WARM_START not in env
+            assert hb_bootstrap.ENV_SHARDED_RESTORE not in env
+            # peer_restore itself stays on — the ordinary peer rung.
+            assert env[hb_bootstrap.ENV_SHARD_SERVER] == "1"
+        assert not controller.engine._warm_start_pending
+
+
+# ------------------------------------------------------ dead-peer pruning
+class TestDeadPeerPruning:
+    def test_stale_lease_addresses_are_filtered(self):
+        """A survivor address whose heartbeat lease went silent for a full
+        progress deadline is pruned from TPU_PEER_RESTORE_ADDRS (each dead
+        address burns a retry-budget rung of the restoring rank's ladder);
+        baselined-but-unseen ranks stay included — not renewing YET is not
+        evidence of death."""
+        clk = {"t": 1000.0}
+        inner = InMemoryCluster()
+        controller = JAXController(
+            inner, options=EngineOptions(peer_restore=True),
+            clock=lambda: clk["t"])
+        inner.create_job(multislice_manifest())
+        controller.run_until_idle()
+        for p in inner.list_pods("default"):
+            inner.set_pod_phase("default", p.metadata.name, "Running")
+        pods = sorted(p.metadata.name for p in inner.list_pods("default"))
+        addr = {name: f"10.0.0.{i}:8470" for i, name in enumerate(pods)}
+
+        def beat(names):
+            for name in names:
+                hb.publish_heartbeat(
+                    inner, "default", constants.heartbeat_lease_name(name),
+                    identity=name, step=STEP, tokens_per_sec=10.0,
+                    peer_addr=addr[name])
+
+        def sync():
+            controller.queue.add("JAXJob:default/rec")
+            controller.run_until_idle()
+
+        beat(pods)
+        sync()                    # baseline every lease
+        clk["t"] += 5.0
+        beat(pods[:3])            # ranks 0-2 renew -> seen latches
+        sync()
+        engine = controller.engine
+        job = controller.parse_job(inner.get_job("JAXJob", "default", "rec"))
+        assert engine._peer_restore_addrs(
+            job, "", progress_deadline_seconds=300.0) == sorted(addr.values())
+        clk["t"] += 250.0
+        beat(pods[1:3])           # ranks 1-2 keep renewing; rank 0 goes dark
+        sync()
+        clk["t"] += 65.0          # rank 0 now 315s stale (>= 300s deadline)
+        pruned = engine._peer_restore_addrs(
+            job, "", progress_deadline_seconds=300.0)
+        # Rank 0 (seen, then silent past the deadline) is OUT; ranks 1-2
+        # (fresh) and rank 3 (baselined but never seen) stay IN.
+        assert pruned == sorted(addr[n] for n in pods[1:])
+        # Without a deadline the filter is inert (the legacy behavior).
+        assert engine._peer_restore_addrs(job, "") == sorted(addr.values())

@@ -47,6 +47,21 @@ ENV_HEARTBEAT_FILE = "TPU_HEARTBEAT_FILE"
 #                                ladder tries before the storage fallback.
 ENV_SHARD_SERVER = "TPU_SHARD_SERVER"
 ENV_PEER_RESTORE_ADDRS = "TPU_PEER_RESTORE_ADDRS"
+# Sharded-restore plane (EngineOptions.sharded_restore / warm_start; both
+# absent unless the operator enables them):
+# - TPU_SHARDED_RESTORE=1        the restore ladder should plan a
+#                                scatter-gather across the advertised
+#                                survivors (train/restore.py sharded=True)
+#                                instead of the single-survivor pull.
+# - TPU_WARM_START=1             elastic-grow contract: this rank was
+#                                (re)created by an autoscaler grow while
+#                                peers survived — restore from live peer
+#                                snapshots without any storage read
+#                                (train/restore.py warm_start=True).
+#                                Injected only on grow-recreated pods and
+#                                only while the grow is settling.
+ENV_SHARDED_RESTORE = "TPU_SHARDED_RESTORE"
+ENV_WARM_START = "TPU_WARM_START"
 
 
 def heartbeat_interval_seconds(progress_deadline_seconds: int) -> float:

@@ -135,6 +135,15 @@ class OperatorOptions:
     # (the default) = no pod env changes and no new annotations consumed,
     # so every PR 1-15 seeded tier replays byte-identically.
     enable_peer_restore: bool = False
+    # Scatter-gather restore: pods additionally advertise strided shard
+    # ownership (/v1/manifest) and restorers pull shards from EVERY
+    # survivor in parallel instead of one peer's bundle. Requires
+    # --enable-peer-restore; off by default for seeded-replay parity.
+    enable_sharded_restore: bool = False
+    # Checkpoint-free warm start: pods created by an elastic grow get
+    # TPU_WARM_START=1 so their restore pulls live peer snapshots with
+    # zero storage reads. Requires --enable-peer-restore.
+    enable_warm_start: bool = False
     # Capacity-aware gang admission (core/admission.py,
     # docs/design/gang_admission.md). Off (the default) = first-come,
     # capacity-blind admission exactly as before — every PR 1-8 seeded
@@ -401,6 +410,19 @@ def build_arg_parser() -> argparse.ArgumentParser:
                              "recreated pods receive survivor addresses "
                              "(TPU_PEER_RESTORE_ADDRS) so their restore "
                              "ladder can skip the storage round-trip.")
+    parser.add_argument("--enable-sharded-restore", action="store_true",
+                        help="Scatter-gather restore on top of "
+                             "--enable-peer-restore: shard servers "
+                             "advertise strided ownership (/v1/manifest) "
+                             "and restorers pull shards from every "
+                             "survivor in parallel, so recovery no longer "
+                             "rides a single peer's bundle.")
+    parser.add_argument("--enable-warm-start", action="store_true",
+                        help="Checkpoint-free elastic warm start on top of "
+                             "--enable-peer-restore: pods created by a "
+                             "grow get TPU_WARM_START=1 and restore from "
+                             "live peer snapshots with zero storage "
+                             "reads.")
     parser.add_argument("--status-flush-interval", type=float, default=1.0,
                         help="Per-job floor (seconds) between coalesced "
                         "status flushes; replica-count churn inside the "
@@ -449,6 +471,8 @@ def options_from_args(args: argparse.Namespace) -> OperatorOptions:
         write_coalescing=not args.disable_write_coalescing,
         status_flush_interval=args.status_flush_interval,
         enable_peer_restore=args.enable_peer_restore,
+        enable_sharded_restore=args.enable_sharded_restore,
+        enable_warm_start=args.enable_warm_start,
         enable_gang_admission=args.enable_gang_admission,
         capacity=args.capacity,
         namespace_quotas=list(args.namespace_quota),
@@ -731,6 +755,8 @@ class OperatorManager:
             write_coalescing=self.options.write_coalescing,
             status_flush_interval=self.options.status_flush_interval,
             peer_restore=self.options.enable_peer_restore,
+            sharded_restore=self.options.enable_sharded_restore,
+            warm_start=self.options.enable_warm_start,
         )
         # ONE gang-admission arbiter shared by every framework controller
         # (core/admission.py): capacity and quota are operator-wide, so a

@@ -266,16 +266,26 @@ class ScheduledRestoreFault:
     Kinds: ``refuse`` (connection refused), ``hang`` (per-peer timeout),
     ``truncate`` (shard body cut in half — fails sha256 verification),
     ``stale-meta`` (peer advertises a step one behind storage — loses the
-    staleness arbitration). ``op`` scopes the fault to the client's
-    ``meta`` probe, ``shard`` fetch, or the post-fetch ``shard-body`` /
-    ``meta-body`` mutation points; ``peer`` targets one peer INDEX in the
-    client's discovery order (indices, not addresses — ephemeral ports
-    would break byte-equal replay). ``at_call``/``count`` window the fault
-    over the Nth..N+count-1th matching consults, so a fault can refuse one
-    attempt and let the retry through, or outlive the retry budget."""
+    staleness arbitration), ``die-mid-transfer`` (the peer process dies at
+    this consult: the connection resets immediately and EVERY later
+    consult for that peer refuses silently — logged once at death, the
+    injector remembers the dead set; the scatter-gather client re-plans
+    the peer's unfetched shards), ``stale-manifest`` (the manifest analog
+    of stale-meta — one step behind storage), ``partial-owner`` (the
+    manifest claims only the front half of its owned stride, orphaning
+    the rest for the planner's all-peers fallback). ``op`` scopes the
+    fault to the client's ``meta`` / ``manifest`` probes, ``shard``
+    fetch, or the post-fetch ``shard-body`` / ``meta-body`` /
+    ``manifest-body`` mutation points; ``peer`` targets one peer INDEX in
+    the client's discovery order (indices, not addresses — ephemeral
+    ports would break byte-equal replay). ``at_call``/``count`` window
+    the fault over the Nth..N+count-1th matching consults, so a fault can
+    refuse one attempt and let the retry through, or outlive the retry
+    budget."""
 
     kind: str
-    op: str = "*"                 # meta | shard | meta-body | shard-body | *
+    # meta | manifest | shard | meta-body | manifest-body | shard-body | *
+    op: str = "*"
     peer: Optional[int] = None    # discovery-order index; None = any peer
     at_call: int = 1              # 1-based index of the first faulted consult
     count: int = 1
@@ -296,14 +306,21 @@ class RestoreFaultInjector:
         self.fault_log = log if log is not None else []
         self._lock = threading.Lock()
         self._consults: Dict[int, int] = {}
+        self._dead: set = set()  # peers killed by die-mid-transfer
 
     def fault_for(self, op: str, peer_index: int) -> Optional[str]:
         """The fault kind (or None) for this consult. Every matching
         entry's counter advances (so same-op entries with disjoint
         at_call windows compose); the first entry whose window covers the
-        consult fires."""
+        consult fires. A peer a ``die-mid-transfer`` fault has killed
+        stays dead: every later consult for it refuses silently (logged
+        once at the death — an unbounded refusal stream would bloat the
+        byte-equal log) without advancing any counters, so the remaining
+        schedule plays out against the survivors exactly as authored."""
         fired: Optional[str] = None
         with self._lock:
+            if peer_index in self._dead:
+                return "refuse"
             for i, fault in enumerate(self.faults):
                 if fault.op not in ("*", op):
                     continue
@@ -316,6 +333,8 @@ class RestoreFaultInjector:
                         f"restore:{op}#{n}:{fault.kind}:peer{peer_index}"
                     )
                     fired = fault.kind
+            if fired == "die-mid-transfer":
+                self._dead.add(peer_index)
         return fired
 
 

@@ -323,6 +323,7 @@ class AdmissionController:
         generations_fn: Optional[Callable[[], Optional[Dict]]] = None,
         tenant_weights: Optional[Dict[str, float]] = None,
         seed: int = 0,
+        decision_log_max: int = 4096,
     ):
         # Per-SLICE admission (--admission-slice-granularity, flagged
         # headroom for multislice jobs): the ENGINE reads this and
@@ -404,8 +405,15 @@ class AdmissionController:
         # an action (admits/preempts, in applied order) — a pure record
         # of the policy's observable schedule. Same-seed runs over the
         # same call sequence must produce byte-equal logs
-        # (decision_log_lines); bounded like the other rings.
-        self.decision_log: "deque[dict]" = deque(maxlen=4096)
+        # (decision_log_lines); bounded like the other rings, but with
+        # the cap EXPLICIT (decision_log_max — the fleet-sim smoke run
+        # alone accretes ~4.1k entries) and a dropped counter so an
+        # auditor can tell a complete log from a truncated window (a
+        # byte-equality check over a silently-rotated ring would pass
+        # on two DIFFERENT histories that merely share a tail).
+        self.decision_log_max = max(1, int(decision_log_max))
+        self.decision_log: "deque[dict]" = deque(maxlen=self.decision_log_max)
+        self.decision_log_dropped = 0
         self._pump_count = 0
 
     # --------------------------------------------------------- capacity
@@ -673,6 +681,10 @@ class AdmissionController:
             if gang is not None:
                 gang.blocked_on = verdict
         if applied:
+            if len(self.decision_log) >= self.decision_log_max:
+                # The ring is about to rotate: count the eviction so the
+                # determinism audit knows its window is truncated.
+                self.decision_log_dropped += 1
             self.decision_log.append(
                 {"pump": self._pump_count, "policy": self.policy.name,
                  "seed": self.seed, "actions": applied}
@@ -1095,6 +1107,11 @@ class AdmissionController:
                 "preemption_ledger": [list(t) for t in self.preemption_ledger],
                 "effective_throughput": self._effective_throughput_locked(),
                 "dominant_shares": self._dominant_shares_locked(cap),
+                # Additive since the explicit decision-log bound: how
+                # big the audit ring is and how many entries it has
+                # rotated out (0 = the log is the complete history).
+                "decision_log_max": self.decision_log_max,
+                "decision_log_dropped": self.decision_log_dropped,
             }
             if self.tenant_weights:
                 out["tenant_weights"] = dict(sorted(

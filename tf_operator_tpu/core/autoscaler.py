@@ -210,6 +210,15 @@ class AutoscalerConfig:
     # job to be grown again.
     efficiency_floor: float = 0.7
     seed: int = 0
+    # Checkpoint-free warm starts (EngineOptions.warm_start, the elastic-
+    # grow contract): grows are attributed "warm-start" in the resize
+    # ledger and decision log — the engine injects TPU_WARM_START=1 into
+    # the recreated ranks, so the grow never waits on a storage
+    # round-trip. Attribution only: the decide() function is unchanged
+    # (growing is already gated on surplus + efficiency, never on a
+    # fresh checkpoint — that gate is shrink-side). Default OFF keeps
+    # every seeded ledger/decision-log byte-identical.
+    warm_start: bool = False
 
 
 #: The blocked-verdict vocabulary of the SHRINK path — the only causes
@@ -762,12 +771,19 @@ class GangAutoscaler:
             if not self._apply(resize):
                 continue
             applied.append(resize)
-            logged.append([
+            warm = (self.config.warm_start and resize.direction == "grow")
+            entry = [
                 resize.direction, resize.key, resize.from_slices,
                 resize.to_slices, resize.reason,
-            ])
+            ]
+            if warm:
+                # Attribution rides as an extra column ONLY when the
+                # feature is on — seeded logs with it off stay
+                # byte-identical to every prior PR.
+                entry.append("warm-start")
+            logged.append(entry)
             view = views.get(resize.key)
-            self.resize_ledger.append({
+            ledger_entry = {
                 "key": resize.key,
                 "direction": resize.direction,
                 "from": resize.from_slices,
@@ -780,7 +796,10 @@ class GangAutoscaler:
                 "cooldown_until": self._cooldown_until.get(resize.key, 0.0),
                 "prev_resize_at": self._last_resize.get(resize.key),
                 "dwell_seconds": self.config.dwell_seconds,
-            })
+            }
+            if warm:
+                ledger_entry["warm_start"] = True
+            self.resize_ledger.append(ledger_entry)
             self.metrics.autoscaler_resize_inc(
                 resize.direction, resize.reason
             )

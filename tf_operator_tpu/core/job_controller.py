@@ -466,6 +466,23 @@ class EngineOptions:
     # OFF: no pod env changes, no new annotations consumed — every
     # PR 1-15 seeded tier replays byte-identically.
     peer_restore: bool = False
+    # Sharded peer restore (--enable-sharded-restore, requires
+    # peer_restore): recreated pods additionally receive
+    # TPU_SHARDED_RESTORE=1 so the restore ladder plans a scatter-gather
+    # across ALL advertised survivors (train/restore.py sharded=True)
+    # instead of pulling the whole tree from one. Default OFF: no env
+    # deltas, every PR 1-17 seeded tier replays byte-identically.
+    sharded_restore: bool = False
+    # Checkpoint-free elastic warm start (--enable-warm-start, requires
+    # peer_restore): when a stale-world resize GROWS the gang, the
+    # recreated/new ranks get TPU_WARM_START=1 while the grow settles, so
+    # they restore from surviving peers' live host snapshots with zero
+    # storage reads (train/restore.py warm_start=True). The flag is
+    # per-(job, world-uid) engine state, cleared once the grown world is
+    # fully present; a controller crash simply loses it and the ranks run
+    # the ordinary ladder — warm start is an optimization contract, never
+    # a correctness gate. Default OFF.
+    warm_start: bool = False
     # Capacity-aware gang admission (core/admission.py,
     # --enable-gang-admission) has NO EngineOptions field on purpose:
     # the switch is the `admission` object itself — the operator manager
@@ -657,6 +674,21 @@ class JobController:
         # thread delivering DELETED — unsynchronized iteration would race.
         self._gang_declared: Dict[tuple, set] = {}
         self._gang_declared_lock = threading.Lock()
+        # (job key, uid) gangs mid-grow under EngineOptions.warm_start,
+        # mapped to the survivor address snapshot {pod name: peer addr}
+        # captured when the grow was flagged: a stale-world resize that
+        # GROWS the declared world adds the key; _build_pod injects
+        # TPU_WARM_START=1 while it is present; the liveness sweep clears
+        # it once every declared replica is back. The snapshot matters
+        # because the teardown itself empties the live observation cache
+        # (no pod is Running mid-restart), yet the replaced ranks' shard
+        # servers keep serving through their termination grace — without
+        # it the grown world would have no peers to warm-start from.
+        # In-memory on purpose — a controller crash loses the flag and the
+        # recreated ranks run the ordinary restore ladder (warm start is
+        # an optimization contract, never a correctness gate). Guarded by
+        # _hb_lock; pruned via forget_job.
+        self._warm_start_pending: Dict[tuple, Dict[str, str]] = {}
 
     def close(self) -> None:
         """Release process-lifetime resources (the fan-out thread pool).
@@ -689,6 +721,8 @@ class JobController:
                 self._hb_gc_done.discard(cache_key)
             for cache_key in [k for k in self._force_deleted if k[0] == key]:
                 self._force_deleted.discard(cache_key)
+            for cache_key in [k for k in self._warm_start_pending if k[0] == key]:
+                self._warm_start_pending.pop(cache_key, None)
         with self._status_lock:
             for cache_key in [k for k in self._status_last_flush if k[0] == key]:
                 self._status_last_flush.pop(cache_key, None)
@@ -1020,6 +1054,38 @@ class JobController:
         # pruned to present pods so it stays gang-sized.
         stale = self.hooks.stale_world_pods(job, replicas, pods)
         if stale:
+            if self.options.warm_start and self.options.peer_restore:
+                # A resize whose declared world is LARGER than the live
+                # pod set is a grow: survivors keep serving their host
+                # snapshots through the teardown, so the recreated/new
+                # ranks can warm-start from peers with zero storage reads.
+                # Flag the (job, world) before any pod dies — _build_pod
+                # injects TPU_WARM_START=1 while the flag is pending and
+                # the liveness sweep clears it once the grown world is
+                # fully present. A shrink (or same-size reshape) never
+                # sets it: fewer survivors is the ordinary restore path.
+                declared = sum(spec.replicas or 0 for spec in replicas.values())
+                if declared > len(pods):
+                    # Snapshot the survivors' addresses NOW, before any
+                    # pod dies: the teardown drains the live observation
+                    # cache, but the replaced ranks' shard servers keep
+                    # serving through termination grace, so these are
+                    # exactly the peers the grown world can pull from.
+                    # setdefault — a re-detected grow mid-teardown must
+                    # not overwrite the snapshot with the (emptier) view.
+                    now = self.clock()
+                    pdl = run_policy.progress_deadline_seconds
+                    with self._hb_lock:
+                        obs = self._hb_obs.get(
+                            (job.key(), job.metadata.uid)) or {}
+                        snapshot = {
+                            s.pod_name: s.peer_addr for s in obs.values()
+                            if s.peer_addr and s.pod_name
+                            and not (pdl is not None and s.seen
+                                     and now - s.observed_at >= pdl)
+                        }
+                        self._warm_start_pending.setdefault(
+                            (job.key(), job.metadata.uid), snapshot)
             present = {p.metadata.uid for p in pods}
             already = set(job.status.gang_handled_uids or ())
             fresh = any(p.metadata.uid not in already for p in stale)
@@ -1537,18 +1603,32 @@ class JobController:
             log.debug("heartbeat lease GC failed for %s/%s", job.namespace,
                       pod_name, exc_info=True)
 
-    def _peer_restore_addrs(self, job: JobObject,
-                            exclude_pod: str = "") -> List[str]:
+    def _peer_restore_addrs(
+        self, job: JobObject, exclude_pod: str = "",
+        progress_deadline_seconds: Optional[float] = None,
+    ) -> List[str]:
         """Survivor shard-server addresses for one job, from the liveness
         observation cache (peer-address lease riders seen on live ranks).
         Sorted for deterministic env rendering; the pod being built is
         excluded — a restarted rank must not be told to restore from its
-        own predecessor's dead server."""
+        own predecessor's dead server.
+
+        With ``progress_deadline_seconds``, addresses whose rank's
+        heartbeat lease has gone stale (an observed renewal, then nothing
+        for a full deadline — the same local-clock rule the stall
+        detector enforces) are filtered out: each dead address would burn
+        a full retry-budget rung of the restoring rank's ladder before it
+        moved on. Baselined-but-unseen ranks stay included — a rank that
+        has not renewed YET (mid-rendezvous) is not evidence of death."""
+        now = self.clock()
         with self._hb_lock:
             obs = self._hb_obs.get((job.key(), job.metadata.uid)) or {}
+            pdl = progress_deadline_seconds
             return sorted({
                 state.peer_addr for state in obs.values()
                 if state.peer_addr and state.pod_name != exclude_pod
+                and not (pdl is not None and state.seen
+                         and now - state.observed_at >= pdl)
             })
 
     def _check_liveness(
@@ -1787,6 +1867,16 @@ class JobController:
             state = obs.pop(uid)
             if state.pod_name and state.index >= declared.get(state.rtype, 0):
                 self._gc_heartbeat_lease(job, state.pod_name)
+        if self._warm_start_pending:
+            # A pending warm-start grow settles once every declared
+            # replica is back Running in-range: later restarts of this
+            # world are ordinary failures, not the grow, and must run the
+            # full restore ladder (storage arbitration included).
+            total = sum(declared.values())
+            if total and len(present) >= total:
+                with self._hb_lock:
+                    self._warm_start_pending.pop(
+                        (job.key(), job.metadata.uid), None)
         self.on_heartbeat_age(job, worst_age)
         if best_tps is not None:
             self.on_workload_throughput(job, best_tps)
@@ -2496,9 +2586,42 @@ class JobController:
                 # apiserver reads in the build path); pods that died took
                 # their observations with them, so only survivors appear.
                 hb_env[hb_bootstrap.ENV_SHARD_SERVER] = "1"
-                addrs = self._peer_restore_addrs(job, template.metadata.name)
+                addrs = self._peer_restore_addrs(
+                    job, template.metadata.name,
+                    progress_deadline_seconds=(
+                        run_policy.progress_deadline_seconds),
+                )
                 if addrs:
                     hb_env[hb_bootstrap.ENV_PEER_RESTORE_ADDRS] = ",".join(addrs)
+                if self.options.sharded_restore:
+                    # Scatter-gather contract: the ladder's peer rung
+                    # plans across ALL advertised survivors instead of
+                    # pulling the full tree from one.
+                    hb_env[hb_bootstrap.ENV_SHARDED_RESTORE] = "1"
+                if self.options.warm_start:
+                    with self._hb_lock:
+                        grow_snapshot = self._warm_start_pending.get(
+                            (job.key(), job.metadata.uid))
+                    if grow_snapshot is not None:
+                        # This pod is (re)created by a settling elastic
+                        # grow: peers hold live snapshots at least as
+                        # fresh as storage, so skip the storage probe
+                        # entirely (zero-read contract). The live
+                        # observation cache is empty mid-restart (every
+                        # pod was torn down), so fall back to the
+                        # addresses snapshotted when the grow was
+                        # flagged — the replaced ranks serve through
+                        # their termination grace. Own-name exclusion
+                        # still applies: rank N must not wait on its own
+                        # predecessor's dying server.
+                        hb_env[hb_bootstrap.ENV_WARM_START] = "1"
+                        if hb_bootstrap.ENV_PEER_RESTORE_ADDRS not in hb_env:
+                            fallback = sorted(
+                                addr for name, addr in grow_snapshot.items()
+                                if name != template.metadata.name)
+                            if fallback:
+                                hb_env[hb_bootstrap.ENV_PEER_RESTORE_ADDRS] = (
+                                    ",".join(fallback))
             for container in template.spec.containers:
                 if container.name != self.hooks.default_container_name:
                     continue

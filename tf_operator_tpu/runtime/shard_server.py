@@ -24,6 +24,20 @@ endpoints:
   name order. One request instead of one per leaf — request overhead is
   what lets the storage path catch up on small states, and the frames are
   written straight from the per-shard cache (no bundled second copy).
+- ``GET /v1/manifest`` -> the meta payload plus ``owned``: the sorted
+  shard names THIS survivor claims under the slice-scoped ownership
+  partition (derived from the slice-local checkpoint topology — each
+  survivor slice prefers to serve its stride of the sorted name space).
+  A scatter-gather client (train/restore.py, ``sharded=True``) plans one
+  fetch per shard against the claiming owners so the transfer splits
+  across survivor NICs instead of serializing through one. Ownership is
+  a PLANNING HINT, not an ACL: every survivor holds the full host
+  snapshot (the per-slice checkpoint streams carry the whole replicated
+  state), so ``/v1/shard`` serves any name — which is what lets the
+  client re-plan orphaned shards onto non-owners when an owner dies
+  mid-transfer, and lets a manifest-speaking client converge against a
+  bundle-era peer that predates this endpoint (404 -> treated as a
+  full owner).
 
 The server reads the snapshot through a callable seam (usually
 ``CheckpointManager.host_snapshot``) so it always serves the newest step
@@ -76,6 +90,18 @@ def decode_shard(payload: bytes):
 
 def shard_checksum(payload: bytes) -> str:
     return hashlib.sha256(payload).hexdigest()
+
+
+def partition_shard_names(names, slice_index: int, num_slices: int):
+    """The slice-scoped ownership partition: slice k of n owns every nth
+    name of the SORTED shard namespace starting at k. Strided (not
+    contiguous blocks) so parameter and optimizer leaves — which sort
+    adjacently per layer — spread evenly across owners by bytes, and pure
+    (both ends of the wire, and the restore planner, derive the identical
+    partition from the same inputs)."""
+    if num_slices <= 1:
+        return sorted(names)
+    return sorted(names)[slice_index % num_slices::num_slices]
 
 
 def parse_bundle(body: bytes) -> Dict[str, bytes]:
@@ -138,12 +164,20 @@ class SnapshotShardServer:
 
     ``source()`` returns the newest HostSnapshot (or None); the view cache
     re-encodes only when the step advances. ``address`` is the
-    ``host:port`` string to advertise via the heartbeat rider."""
+    ``host:port`` string to advertise via the heartbeat rider.
+
+    ``owned`` is the slice-scoped ownership seam for ``/v1/manifest``: a
+    pure function from the full sorted shard-name list to the subset this
+    survivor claims (None = claims everything — a single-survivor or
+    non-sliced topology is a full owner). It shapes only the manifest's
+    ``owned`` list; serving is never restricted by it (module doc)."""
 
     def __init__(self, source: Callable[[], Optional[Any]],
                  host: str = "127.0.0.1", port: int = 0,
-                 advertise_host: Optional[str] = None) -> None:
+                 advertise_host: Optional[str] = None,
+                 owned: Optional[Callable[[Any], Any]] = None) -> None:
         self._source = source
+        self._owned = owned
         self._lock = threading.Lock()
         self._view: Optional[_SnapshotView] = None
         self._advertise_host = advertise_host
@@ -231,6 +265,18 @@ class SnapshotShardServer:
                 return
             request._send(200, json.dumps(view.meta).encode())
             return
+        if parsed.path == "/v1/manifest":
+            if view is None:
+                request._send(503, json.dumps(
+                    {"error": "no-snapshot"}).encode())
+                return
+            names = sorted(view.payloads)
+            owned = names if self._owned is None else sorted(
+                self._owned(names))
+            manifest = dict(view.meta)
+            manifest["owned"] = owned
+            request._send(200, json.dumps(manifest).encode())
+            return
         if parsed.path.startswith("/v1/shard/"):
             if view is None:
                 request._send(503, json.dumps(
@@ -288,13 +334,24 @@ class SnapshotShardServer:
 
 
 def start_shard_server(checkpoint_manager, host: str = "127.0.0.1",
-                       port: int = 0) -> SnapshotShardServer:
+                       port: int = 0, slice_index: Optional[int] = None,
+                       num_slices: Optional[int] = None) -> SnapshotShardServer:
     """Start a shard server over a CheckpointManager's host snapshot and
     return it (``.address`` is the rider payload for record_peer_address).
     Each durable save warms the view cache so restoring peers never pay
-    the encode+hash cost inline."""
+    the encode+hash cost inline. With a slice topology
+    (``slice_index``/``num_slices``), the manifest claims only this
+    slice's stride of the shard namespace (partition_shard_names), so a
+    scatter-gather restore splits its transfer across survivor slices."""
+    owned = None
+    if slice_index is not None and num_slices is not None and num_slices > 1:
+        idx, n = int(slice_index), int(num_slices)
+
+        def owned(names, _idx=idx, _n=n):  # noqa: F811 — the seam value
+            return partition_shard_names(names, _idx, _n)
+
     server = SnapshotShardServer(checkpoint_manager.host_snapshot,
-                                 host=host, port=port).start()
+                                 host=host, port=port, owned=owned).start()
     try:
         checkpoint_manager.add_durability_listener(lambda _step: server.warm())
     except AttributeError:

@@ -300,6 +300,16 @@ class Scenario:
     aging_seconds: float = 600.0
     autoscaler: bool = False
     autoscaler_config: Dict[str, float] = field(default_factory=dict)
+    # Checkpoint-free elastic warm start (EngineOptions.warm_start): the
+    # autoscaler's grows charge the WARM restart penalty
+    # (warm_start_restore_seconds — peer pull, no storage round-trip)
+    # instead of the cold one (grow_restore_seconds). Both penalties
+    # default 0.0 and the flag defaults False, so every pre-existing
+    # corpus scenario replays byte-identically (from_dict would reject
+    # the fields if they weren't declared; defaults make them no-ops).
+    warm_start: bool = False
+    grow_restore_seconds: float = 0.0
+    warm_start_restore_seconds: float = 0.0
     elastic_jobs: int = 0
     hosts_per_slice: int = 2
     shards: int = 1
@@ -485,6 +495,8 @@ class FleetSim:
                 if not hasattr(cfg, knob):
                     raise ValueError(f"unknown autoscaler knob {knob!r}")
                 setattr(cfg, knob, value)
+            if scenario.warm_start:
+                cfg.warm_start = True
             self.autoscaler = GangAutoscaler(
                 self.chaos, self.admission, cfg,
                 clock=self.clock, metrics=self.metrics,
@@ -514,6 +526,8 @@ class FleetSim:
         self._preempt_acks = 0
         self._admits_in_window = 0
         self._deferred_syncs = 0
+        self._grows = 0
+        self._warm_start_grows = 0
         self._sweeps = 0
         self._sweep_violations: List[str] = []
         self._util_area = 0.0
@@ -973,6 +987,19 @@ class FleetSim:
             self._sync(resize.key)
             if job.phase == "running":
                 self._reconcile_pods(job)
+                if resize.direction == "grow":
+                    # The grow's restore penalty (the _slice_restart
+                    # charging pattern): a warm start pulls from live
+                    # peers, a cold one round-trips storage. Both knobs
+                    # default 0.0 — pre-existing corpus digests hold.
+                    sc = self.scenario
+                    penalty = (sc.warm_start_restore_seconds if sc.warm_start
+                               else sc.grow_restore_seconds)
+                    if penalty:
+                        job.done = max(0.0, job.done - penalty)
+                    self._grows += 1
+                    if sc.warm_start:
+                        self._warm_start_grows += 1
                 self._schedule_completion(job)
 
     def _coordinator_tick(self) -> None:
@@ -1184,6 +1211,8 @@ class FleetSim:
             "resizes": (
                 len(self.autoscaler.resize_ledger)
                 if self.autoscaler else 0),
+            "grows": self._grows,
+            "warm_start_grows": self._warm_start_grows,
             "deferred_syncs": self._deferred_syncs,
             "fault_log_entries": len(self.chaos.fault_log),
             "invariant_sweeps": self._sweeps,
@@ -1272,6 +1301,34 @@ def builtin_scenarios() -> Dict[str, Scenario]:
             renew_delays=[
                 {"after_renews": 20, "drop_renews": 2,
                  "name_contains": "-shard-1"},
+            ],
+        ),
+        "warm-start-grow-churn": Scenario(
+            name="warm-start-grow-churn", seed=1705, profile="bursty",
+            jobs=400, tenants=12, horizon=3600.0, capacity_pods=48,
+            policy="priority", autoscaler=True, elastic_jobs=8,
+            hosts_per_slice=2, aging_seconds=600.0,
+            # Checkpoint-free grows landing DURING capacity churn: the
+            # revoke/restore cycle frees and re-frees surplus, so grows
+            # fire into the same windows slice preemptions are tearing
+            # ranks down — the storm the warm-start plane exists for.
+            # The asymmetric penalties (cold 30s storage round-trip vs
+            # 5s peer pull) make the warm path's effect visible in the
+            # completion model, not just the attribution columns.
+            warm_start=True,
+            grow_restore_seconds=30.0,
+            warm_start_restore_seconds=5.0,
+            storm=[
+                StormEvent(t=600.0, kind="revoke-capacity",
+                           capacity={"pods": "28"}),
+                StormEvent(t=1000.0, kind="preempt-slice", slice_index=0),
+                StormEvent(t=1400.0, kind="revoke-capacity",
+                           capacity={"pods": "48"}),
+                StormEvent(t=1900.0, kind="preempt-slice", slice_index=1),
+                StormEvent(t=2400.0, kind="revoke-capacity",
+                           capacity={"pods": "32"}),
+                StormEvent(t=2900.0, kind="revoke-capacity",
+                           capacity={"pods": "48"}),
             ],
         ),
         "diurnal-trough-backfill": Scenario(

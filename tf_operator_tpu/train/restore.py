@@ -10,6 +10,30 @@ A recreated slice or a grown gang restores from, in order of preference:
    (``CheckpointManager.restore_latest``), whenever the peer path degrades.
 3. **none** — fresh state (first boot: no peers AND no checkpoint).
 
+With ``sharded=True`` the peer rung becomes a **scatter-gather**: every
+peer's ``/v1/manifest`` is probed (which shard names does THIS survivor
+own — the slice-scoped partition the shard server derives), each shard is
+planned onto the least-loaded claiming owner (ties to the lowest
+discovery index — the plan is a pure function of the manifests in
+discovery order plus the sorted shard names, so seeded runs replay
+byte-identically), transfers run in parallel across survivors, and a peer
+dying mid-transfer re-plans its unfetched shards against the remaining
+survivor set. The storage ladder then degrades **per shard**: shards with
+no surviving source are filled from storage — but only when storage holds
+exactly the plan step (a mixed-step fill would be torn state, the same
+silent corruption the shard server's 409-on-rotation refuses). A peer
+that predates the manifest endpoint (404) is treated as a full owner and
+served over the per-shard wire, so mixed-version fleets converge. The
+sharded happy path reports ``path="peer-sharded"``.
+
+With ``warm_start=True`` (the elastic-grow contract, ``TPU_WARM_START``):
+the restoring rank is a recreated/new member of a gang whose survivors
+hold live host snapshots at least as fresh as anything durable — so the
+happy path never touches storage at all (no ``latest_step()`` probe, no
+orbax read; the staleness arbitration is skipped). Peers all failing
+still degrades to storage with the cause named: warm start is an
+optimization contract, never a correctness gate.
+
 Degradations and their recorded causes (metrics label + fault log):
 
 - ``no-peers``           — no addresses advertised (peer path not enabled,
@@ -22,6 +46,13 @@ Degradations and their recorded causes (metrics label + fault log):
                            storage's newest checkpoint; storage wins
 - ``checksum-mismatch``  — a shard failed sha256 verification (truncated
                            or corrupted in flight) and retries didn't heal
+- ``storage-shard-fill`` — scatter-gather completed, but some shards lost
+                           every surviving owner and were filled from
+                           same-step storage (path stays "peer-sharded";
+                           the fill is the per-shard degraded rung)
+- ``shard-fill-step-mismatch`` — shards needed a storage fill but storage
+                           does not hold the plan step; the whole tree
+                           degrades to storage (torn-state refusal)
 
 One failure is NOT a degradation: a ``model_meta`` geometry mismatch on
 the peer path hard-fails (:class:`GeometryMismatch`). A peer serving a
@@ -55,10 +86,13 @@ class RestoreOutcome:
 
     state: Any
     step: Optional[int]
-    path: str          # "peer" | "storage" | "none"
+    path: str          # "peer" | "peer-sharded" | "storage" | "none"
     cause: str         # "ok" on the happy paths, degradation cause otherwise
     seconds: float
     peer: Optional[str] = None  # winning peer address, peer path only
+    # Scatter-gather attribution: shard counts per source ("<host:port>"
+    # or "storage" for per-shard fills). None outside the sharded path.
+    sources: Optional[Dict[str, int]] = None
 
 
 # ---------------------------------------------------------------- transport
@@ -100,6 +134,14 @@ def _fetch_with_retry(fetcher, peer: str, peer_index: int, path: str, *,
                 last_err = TimeoutError("injected: peer hang (timeout)")
                 sleep(backoff * (2 ** attempt))
                 continue
+            if kind == "die-mid-transfer":
+                # The peer process died partway through this transfer:
+                # the connection drops NOW and every later consult for
+                # this peer refuses (the injector remembers the death).
+                # No retry loop — retrying a dead peer burns budget the
+                # re-planner should spend on survivors.
+                raise ConnectionResetError(
+                    "injected: peer died mid-transfer")
         try:
             status, headers, body = fetcher(peer, path, timeout)
         except (OSError, TimeoutError) as err:
@@ -214,6 +256,173 @@ class ChecksumMismatch(OSError):
     """A fetched shard's bytes don't hash to the advertised checksum."""
 
 
+class ShardFillStepMismatch(OSError):
+    """Shards lost every surviving peer source and storage does not hold
+    the plan step — a per-shard fill from a different step would assemble
+    torn state, so the whole tree must degrade to storage instead."""
+
+
+# ---------------------------------------------------------- scatter-gather
+def plan_scatter(shard_names: Sequence[str],
+                 owners: Dict[int, set]) -> Dict[str, int]:
+    """Assign each shard to a peer: among the peers claiming ownership
+    (falling back to ALL live peers for orphaned names — ownership is a
+    planning hint, every survivor serves every shard), pick the one with
+    the fewest shards assigned so far, ties to the lowest discovery
+    index. Pure function of (sorted names, owners map) so a seeded run
+    plans — and replays — identically."""
+    assignments: Dict[str, int] = {}
+    load = {index: 0 for index in owners}
+    all_indices = sorted(owners)
+    for name in sorted(shard_names):
+        claiming = [i for i in all_indices if name in owners[i]]
+        candidates = claiming or all_indices
+        pick = min(candidates, key=lambda i: (load[i], i))
+        assignments[name] = pick
+        load[pick] += 1
+    return assignments
+
+
+def _fetch_one_shard(fetcher, peer: str, peer_index: int, name: str,
+                     step: int, expect: str, *, timeout, retries, backoff,
+                     fault_injector, sleep):
+    """One shard off one peer, verified. Raises on any failure; the
+    scatter-gather loop owns re-planning."""
+    from urllib.parse import quote
+
+    from ..runtime.shard_server import decode_shard, shard_checksum
+
+    status, _, body = _fetch_with_retry(
+        fetcher, peer, peer_index, f"/v1/shard/{quote(name)}?step={step}",
+        op="shard", timeout=timeout, retries=retries, backoff=backoff,
+        fault_injector=fault_injector, sleep=sleep,
+    )
+    if status != 200:
+        raise OSError(f"peer {peer} returned {status} for shard {name}")
+    if shard_checksum(body) != expect:
+        raise ChecksumMismatch(
+            f"shard {name} from {peer} failed sha256 verification"
+        )
+    return decode_shard(body)
+
+
+def _storage_shard_fill(state, ckpt, step: int, names: Sequence[str]):
+    """The per-shard degraded rung: read ONLY the named shards' values out
+    of storage — legal solely when storage holds exactly the plan step
+    (module doc: a mixed-step fill is torn state)."""
+    import numpy as np
+
+    from ..runtime.shard_server import flatten_tree
+
+    latest = ckpt.latest_step()
+    if latest != step:
+        raise ShardFillStepMismatch(
+            f"storage holds step {latest} but the scatter-gather plan is "
+            f"step {step}; refusing a mixed-step shard fill"
+        )
+    restored, _ = ckpt.restore_latest(state)
+    flat = flatten_tree(restored)
+    out = {}
+    for name in names:
+        if name not in flat:
+            raise KeyError(name)
+        out[name] = np.asarray(flat[name])
+    return out
+
+
+def _restore_sharded(state, ckpt, candidates, step: int, *, fetcher,
+                     timeout: float, retries: int, backoff: float,
+                     fault_injector, sleep):
+    """Scatter-gather restore against every candidate peer at ``step``.
+
+    ``candidates`` is ``[(peer_index, peer, manifest)]`` in discovery
+    order. Loops plan -> fetch -> re-plan: any peer failure marks that
+    peer dead for the rest of the restore and its unfetched shards are
+    re-planned against the survivors; shards that run out of peers are
+    filled per-shard from same-step storage. Returns
+    ``(assembled_state, sources)`` where sources counts shards per
+    serving address (plus "storage" for fills)."""
+    live = {}
+    all_names = None
+    for index, peer, manifest in candidates:
+        names = sorted(manifest["shards"])
+        if all_names is None:
+            all_names = names
+        owned = manifest.get("owned")
+        live[index] = {
+            "peer": peer,
+            "manifest": manifest,
+            # A manifest-less (bundle-era) peer claims everything.
+            "owned": set(owned) if owned is not None else set(names),
+        }
+    shards: Dict[str, Any] = {}
+    sources: Dict[str, int] = {}
+    remaining = list(all_names or ())
+
+    def fetch_group(index: int, names: Sequence[str]):
+        """Sequentially pull one peer's assigned shards. Returns
+        (fetched, unfetched) — a failure abandons the rest of the group
+        (the peer is presumed dead; the re-planner owns its shards)."""
+        entry = live[index]
+        fetched: Dict[str, Any] = {}
+        unfetched: List[str] = []
+        for pos, name in enumerate(names):
+            try:
+                fetched[name] = _fetch_one_shard(
+                    fetcher, entry["peer"], index, name, step,
+                    entry["manifest"]["shards"][name]["checksum"],
+                    timeout=timeout, retries=retries, backoff=backoff,
+                    fault_injector=fault_injector, sleep=sleep,
+                )
+            except (OSError, TimeoutError, ValueError, KeyError) as err:
+                log.warning("peer %s lost mid-scatter (%s); re-planning "
+                            "%d shard(s)", entry["peer"], err,
+                            len(names) - pos)
+                unfetched = list(names[pos:])
+                break
+        return fetched, unfetched
+
+    while remaining:
+        if not live:
+            fill = _storage_shard_fill(state, ckpt, step, remaining)
+            shards.update(fill)
+            sources["storage"] = sources.get("storage", 0) + len(fill)
+            break
+        plan = plan_scatter(
+            remaining, {i: e["owned"] for i, e in live.items()})
+        groups: Dict[int, List[str]] = {}
+        for name in sorted(plan):
+            groups.setdefault(plan[name], []).append(name)
+        failed: List[str] = []
+        dead: List[int] = []
+        if fault_injector is not None or len(groups) <= 1:
+            # Deterministic sequential wire: peers in discovery order,
+            # each group in sorted shard order — the consult-counter
+            # sequence the seeded injector replays byte-identically.
+            results = [(i, fetch_group(i, groups[i])) for i in sorted(groups)]
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=len(groups)) as pool:
+                futures = [
+                    (i, pool.submit(fetch_group, i, groups[i]))
+                    for i in sorted(groups)
+                ]
+                results = [(i, f.result()) for i, f in futures]
+        for index, (fetched, unfetched) in results:
+            shards.update(fetched)
+            if fetched:
+                peer = live[index]["peer"]
+                sources[peer] = sources.get(peer, 0) + len(fetched)
+            if unfetched:
+                failed.extend(unfetched)
+                dead.append(index)
+        for index in dead:
+            live.pop(index, None)
+        remaining = failed
+    return _assemble(ckpt.abstract_state(state), shards), sources
+
+
 def restore_with_fallback(
     state,
     ckpt,
@@ -226,32 +435,52 @@ def restore_with_fallback(
     fetcher: Callable[[str, str, float], Tuple[int, Dict[str, str], bytes]] = http_fetch,
     fault_injector=None,
     sleep: Callable[[float], None] = time.sleep,
+    sharded: bool = False,
+    warm_start: bool = False,
 ) -> RestoreOutcome:
     """Run the restore ladder (module doc) and return the outcome.
 
     ``peers`` are ``host:port`` strings in discovery order; ``model_meta``
     is the local geometry to validate peer metas against (defaults to the
     checkpoint manager's); ``fetcher``/``fault_injector``/``sleep`` are the
-    determinism seams.
+    determinism seams. ``sharded`` turns the peer rung into the
+    scatter-gather plan (module doc); ``warm_start`` is the elastic-grow
+    contract — skip the storage staleness probe entirely so the happy
+    path performs zero storage reads.
     """
     from .checkpoint import geometry_mismatch
 
     t0 = time.perf_counter()
     if model_meta is None:
         model_meta = getattr(ckpt, "_model_meta", None)
-    storage_step = ckpt.latest_step()
+    # Warm start: don't even ask storage what it has. Survivor snapshots
+    # are the freshest state a grown gang can see, and the latest_step()
+    # probe is itself a storage read the zero-read contract forbids.
+    storage_step = None if warm_start else ckpt.latest_step()
 
     cause = "no-peers"
     best: Optional[Tuple[int, str, dict]] = None  # (peer_index, peer, meta)
+    probed: List[Tuple[int, str, dict]] = []
     import json
 
     for index, peer in enumerate(peers):
+        probe_path = "/v1/manifest" if sharded else "/v1/meta"
+        probe_op = "manifest" if sharded else "meta"
         try:
             status, _, body = _fetch_with_retry(
-                fetcher, peer, index, "/v1/meta", op="meta",
+                fetcher, peer, index, probe_path, op=probe_op,
                 timeout=timeout, retries=retries, backoff=backoff,
                 fault_injector=fault_injector, sleep=sleep,
             )
+            if sharded and status == 404:
+                # Bundle-era peer that predates /v1/manifest: probe the
+                # meta endpoint instead and treat the peer as a full
+                # owner (no "owned" key — _restore_sharded's default).
+                status, _, body = _fetch_with_retry(
+                    fetcher, peer, index, "/v1/meta", op="meta",
+                    timeout=timeout, retries=retries, backoff=backoff,
+                    fault_injector=fault_injector, sleep=sleep,
+                )
         except (OSError, TimeoutError):
             cause = "peer-unreachable"
             log.warning("peer %s unreachable for restore meta", peer)
@@ -267,7 +496,22 @@ def restore_with_fallback(
         except ValueError:
             cause = "peer-unreachable"
             continue
-        if fault_injector is not None:
+        if fault_injector is not None and sharded:
+            kind = fault_injector.fault_for("manifest-body", index)
+            if kind == "stale-manifest":
+                # The manifest a real straggler would serve: one step
+                # behind whatever storage has finalized.
+                meta = dict(meta)
+                meta["step"] = (storage_step if storage_step is not None
+                                else int(meta["step"])) - 1
+            elif kind == "partial-owner" and meta.get("owned"):
+                # A survivor that lost half its claimed stride (e.g. a
+                # mid-resharding manifest): claims only the front half,
+                # leaving orphans for the planner's all-peers fallback.
+                meta = dict(meta)
+                owned = list(meta["owned"])
+                meta["owned"] = owned[: (len(owned) + 1) // 2]
+        elif fault_injector is not None:
             kind = fault_injector.fault_for("meta-body", index)
             if kind == "stale-meta":
                 # The snapshot a real straggler would serve: one step
@@ -282,10 +526,55 @@ def restore_with_fallback(
                 f"{mismatched} from {peer} — a mixed-geometry gang is a "
                 "config error; refusing to fall back silently"
             )
+        probed.append((index, peer, meta))
         if best is None or int(meta["step"]) > int(best[2]["step"]):
             best = (index, peer, meta)
 
-    if best is not None:
+    if best is not None and sharded:
+        best_step = int(best[2]["step"])
+        if storage_step is not None and best_step < storage_step:
+            cause = "stale-snapshot"
+            log.warning(
+                "peer snapshot step %d staler than storage step %d; "
+                "falling back to storage", best_step, storage_step,
+            )
+        else:
+            # Every peer serving the winning step joins the scatter plan;
+            # stragglers on an older step are excluded (their shards would
+            # be a mixed-step reassembly).
+            candidates = [
+                entry for entry in probed
+                if int(entry[2]["step"]) == best_step
+            ]
+            try:
+                restored, sources = _restore_sharded(
+                    state, ckpt, candidates, best_step,
+                    fetcher=fetcher, timeout=timeout, retries=retries,
+                    backoff=backoff, fault_injector=fault_injector,
+                    sleep=sleep,
+                )
+            except GeometryMismatch:
+                raise
+            except ShardFillStepMismatch as err:
+                cause = "shard-fill-step-mismatch"
+                log.warning("sharded restore degraded: %s", err)
+            except ChecksumMismatch as err:
+                cause = "checksum-mismatch"
+                log.warning("sharded restore degraded: %s", err)
+            except (OSError, TimeoutError, KeyError, ValueError) as err:
+                cause = "peer-unreachable"
+                log.warning("sharded restore degraded: %s", err)
+            else:
+                outcome = RestoreOutcome(
+                    state=restored, step=best_step, path="peer-sharded",
+                    cause=("storage-shard-fill" if "storage" in sources
+                           else "ok"),
+                    seconds=time.perf_counter() - t0, peer=best[1],
+                    sources=sources,
+                )
+                _observe(outcome)
+                return outcome
+    elif best is not None:
         index, peer, meta = best
         peer_step = int(meta["step"])
         if storage_step is not None and peer_step < storage_step:
