@@ -356,9 +356,16 @@ def default_dag() -> List[Step]:
         # bounded backfill with the aging starvation bound, the seeded
         # capacity-revocation fault with byte-identical fault_log +
         # span_sequence replay, and the PodGroup/admission lifecycle
-        # hygiene regressions.
+        # hygiene regressions. Plus the admissibility-index tier: the
+        # mechanism unit pins (watermarks, capacity-epoch skip, the
+        # version-keyed capacity cache, per-policy prune fallback) and
+        # the schedule-equivalence property — randomized paired traces
+        # through the indexed and full-scan arbiters for every policy,
+        # byte-equal decision logs and observable state at every step.
         Step("admission-chaos",
              pytest + ["tests/test_admission.py", "tests/test_policies.py",
+                       "tests/test_admission_index.py",
+                       "tests/test_admission_equivalence.py",
                        "-m", "not slow"],
              deps=["operator-integration"], retries=2),
         # Contention smoke (scripts/measure_control_plane.py --mode

@@ -3031,12 +3031,19 @@ FLEETSIM_BASELINE_PATH = os.path.join(
 FLEETSIM_MIN_COMPRESSION = 100.0   # virtual seconds per wall second, floor
 FLEETSIM_REPLAY_RUNS = 3           # byte-equal digest runs in the smoke gate
 FLEETSIM_WALL_REGRESSION = 2.0     # run-over-run wall-time ratchet
+# Admissibility-index gates: the indexed leg's total pump time must be
+# >= this factor below the full-scan leg's at the 5k-job/64-tenant mix,
+# and the indexed pump columns ratchet run-over-run with the same 2x
+# tolerance as the wall clock (they are wall-time measurements too).
+FLEETSIM_PUMP_SPEEDUP_MIN = 3.0
+FLEETSIM_PUMP_REGRESSION = 2.0
 
 
-def _fleet_sim_row(report) -> dict:
+def _fleet_sim_row(report, admission_index=False) -> dict:
     hot = report["hot_paths"]
     return {
         "scenario": report["scenario"],
+        "admission_index": admission_index,
         "jobs": report["jobs"],
         "tenants": report["tenants"],
         "completed": report["completed"],
@@ -3051,13 +3058,26 @@ def _fleet_sim_row(report) -> dict:
         "compression_x": report["compression_x"],
         "invariant_sweeps": report["invariant_sweeps"],
         "invariant_violations": len(report["invariant_violations"]),
-        # Report-only hot-path columns (never gated: they are the
-        # optimization targets, and gating them would ratchet noise).
+        # Hot-path columns. pump_seconds_total / pump_mean_ms graduated
+        # from report-only to GATED in the smoke run (the admissibility-
+        # index speedup gate + the 2x run-over-run ratchet); the rest
+        # stay report-only optimization targets.
+        "pump_calls": hot["pump_calls"],
+        "pump_seconds_total": hot["pump_seconds_total"],
+        "pump_mean_ms": (
+            round(hot["pump_seconds_per_call"] * 1000.0, 6)
+            if hot["pump_seconds_per_call"] is not None else None),
         "pump_seconds_per_call": hot["pump_seconds_per_call"],
+        "pump_skipped_no_capacity_delta": (
+            hot["pump_skipped_no_capacity_delta"]),
+        "pump_skipped_band_watermark": hot["pump_skipped_band_watermark"],
+        "index_fallback_pumps": hot["index_fallback_pumps"],
         "autoscaler_decide_seconds_per_call": (
             hot["autoscaler_decide_seconds_per_call"]),
         "watch_cache_resident_objects_peak": (
             hot["watch_cache_resident_objects_peak"]),
+        "watch_cache_resident_bytes_peak": (
+            hot["watch_cache_resident_bytes_peak"]),
         "decision_log_entries": hot["decision_log_entries"],
         "digest": report["digest"],
     }
@@ -3090,6 +3110,8 @@ def fleet_sim_main(smoke=False, scenario_path=None) -> int:
         # preemption + a lease steal on a 4-shard ring) at 5k jobs / 64
         # tenants, run FLEETSIM_REPLAY_RUNS times — every run must be
         # green, byte-identical, and >= 100x faster than virtual time.
+        import dataclasses as _dc
+
         scenario = smoke_scenario()
         digests = []
         for _ in range(FLEETSIM_REPLAY_RUNS):
@@ -3122,6 +3144,60 @@ def fleet_sim_main(smoke=False, scenario_path=None) -> int:
                 f"smoke wall time {wall}s regressed >"
                 f"{FLEETSIM_WALL_REGRESSION}x vs previous run "
                 f"({prev_wall}s)")
+        # ---- admissibility-index leg: same storm, index ON ----
+        # Three gates: (1) schedule equivalence — every indexed run's
+        # digest is byte-equal to the full-scan digest (the index is a
+        # pure pruning filter, so the flag may not move a single byte);
+        # (2) speedup — mean total pump time >= 3x below full-scan at
+        # this 5k-job/64-tenant mix; (3) the indexed pump columns
+        # ratchet run-over-run like the wall clock.
+        indexed_scenario = _dc.replace(scenario, admission_index=True)
+        indexed_rows = []
+        for _ in range(FLEETSIM_REPLAY_RUNS):
+            report = FleetSim(indexed_scenario).run()
+            row = _fleet_sim_row(report, admission_index=True)
+            indexed_rows.append(row)
+            rows.append(row)
+            if report["completed"] != report["jobs"]:
+                regressions.append(
+                    f"indexed leg: {report['completed']}/{report['jobs']} "
+                    "jobs completed — the fleet did not drain")
+            if report["invariant_violations"]:
+                regressions.append(
+                    "indexed leg: "
+                    f"{len(report['invariant_violations'])} invariant "
+                    "violations; first: "
+                    + report["invariant_violations"][0])
+        indexed_digests = {r["digest"] for r in indexed_rows}
+        if indexed_digests != set(digests):
+            regressions.append(
+                "admissibility index changed the schedule: indexed "
+                f"digests {sorted(indexed_digests)} vs full-scan "
+                f"{sorted(set(digests))}")
+        full_pump = statistics.mean(
+            r["pump_seconds_total"] for r in rows[:FLEETSIM_REPLAY_RUNS])
+        indexed_pump = statistics.mean(
+            r["pump_seconds_total"] for r in indexed_rows)
+        pump_speedup = (full_pump / indexed_pump) if indexed_pump else 0.0
+        if indexed_pump and pump_speedup < FLEETSIM_PUMP_SPEEDUP_MIN:
+            regressions.append(
+                f"indexed pump time {indexed_pump:.4f}s is only "
+                f"{pump_speedup:.2f}x below full-scan {full_pump:.4f}s "
+                f"(gate: >={FLEETSIM_PUMP_SPEEDUP_MIN:g}x)")
+        prev_pump = prev.get("pump_seconds_total")
+        if prev_pump and indexed_pump > prev_pump * FLEETSIM_PUMP_REGRESSION:
+            regressions.append(
+                f"indexed pump_seconds_total {indexed_pump:.4f}s "
+                f"regressed >{FLEETSIM_PUMP_REGRESSION}x vs previous "
+                f"run ({prev_pump}s)")
+        prev_pump_ms = prev.get("pump_mean_ms")
+        pump_mean_ms = indexed_rows[0]["pump_mean_ms"] or 0.0
+        if prev_pump_ms and pump_mean_ms > (
+                prev_pump_ms * FLEETSIM_PUMP_REGRESSION):
+            regressions.append(
+                f"indexed pump_mean_ms {pump_mean_ms} regressed >"
+                f"{FLEETSIM_PUMP_REGRESSION}x vs previous run "
+                f"({prev_pump_ms})")
     else:
         # The full table: every checked-in storm scenario, once each.
         for name, scenario in sorted(builtin_scenarios().items()):
@@ -3138,14 +3214,32 @@ def fleet_sim_main(smoke=False, scenario_path=None) -> int:
     }
     rc = 1 if (smoke and regressions) else 0
     if smoke and rc == 0:
-        _merge_baseline(FLEETSIM_BASELINE_PATH, {
+        indexed_first = next(
+            (r for r in rows if r.get("admission_index")), None)
+        updates = {
             "wall_s": rows[0]["wall_s"],
             "compression_x": rows[0]["compression_x"],
             "digest": rows[0]["digest"],
             "pump_seconds_per_call": rows[0]["pump_seconds_per_call"],
             "utilization": rows[0]["utilization"],
             "makespan_s": rows[0]["makespan_s"],
-        })
+        }
+        if indexed_first is not None:
+            # The ratcheted pump columns track the INDEXED leg — that is
+            # the configuration the gate protects; the full-scan numbers
+            # ride along for the docs before/after table.
+            updates.update({
+                "pump_seconds_total": indexed_first["pump_seconds_total"],
+                "pump_mean_ms": indexed_first["pump_mean_ms"],
+                "full_scan_pump_seconds_total": (
+                    rows[0]["pump_seconds_total"]),
+                "full_scan_pump_mean_ms": rows[0]["pump_mean_ms"],
+                "pump_speedup_x": (
+                    round(rows[0]["pump_seconds_total"]
+                          / indexed_first["pump_seconds_total"], 2)
+                    if indexed_first["pump_seconds_total"] else None),
+            })
+        _merge_baseline(FLEETSIM_BASELINE_PATH, updates)
     print(json.dumps(out))
     return rc
 

@@ -1,0 +1,253 @@
+"""Schedule-equivalence property tier for the admissibility index
+(docs/design/gang_admission.md, "Admissibility index"): the indexed
+arbiter is a pure PRUNING filter over ``policy.decide`` — for any call
+sequence it must produce the byte-identical decision log, the same
+admitted/waiting/preempting sets, the same queue positions, and the
+same blocked verdicts as the full-scan arbiter.
+
+Two layers of evidence:
+
+- A seeded randomized PAIRED DRIVER: the same operation trace (new
+  gangs, steady-state re-asks, elastic demand changes, releases,
+  engine-style preemption acks, clock advances) is fed to a full-scan
+  controller and an indexed controller in lockstep, and the complete
+  observable state is compared after EVERY operation — a divergence
+  fails at the exact step that introduced it, with the trace seed in
+  the test id for replay.
+- FleetSim digest equality per policy: a whole storm scenario (arrival
+  trace + decision logs + fault log + terminal states, hashed) must
+  not move by one byte when the flag flips.
+
+Runs in the admission-chaos CI tier (ci/dag.py) beside the seeded
+admission scenarios.
+"""
+
+import random
+from fractions import Fraction
+
+import pytest
+
+from tf_operator_tpu.core.admission import AdmissionController
+from tf_operator_tpu.metrics import Metrics
+
+NAMESPACES = ("tenant-a", "tenant-b", "tenant-c")
+BANDS = ("low", "", "default", "high", "critical")
+
+
+class FakeClock:
+    def __init__(self, now=1000.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds):
+        self.now += seconds
+
+
+def make_pair(policy, quotas=None, generations=None, weights=None, seed=0):
+    """(full-scan, indexed) controllers over identical configuration.
+    Only the index flag differs — that flag is the thing under test."""
+    pair = []
+    for index in (False, True):
+        clock = FakeClock()
+        adm = AdmissionController(
+            capacity={"pods": "16"} if generations is None else None,
+            quotas=quotas, generations=generations, tenant_weights=weights,
+            policy=policy, seed=seed, clock=clock, metrics=Metrics(),
+            aging_seconds=120.0, backfill_max_members=4,
+            admission_index=index,
+        )
+        pair.append((adm, clock))
+    return pair
+
+
+def observable(adm):
+    """Everything the engine (and the determinism audit) can see."""
+    snap = adm.snapshot()
+    return {
+        "admitted": sorted(g["key"] for g in snap["admitted"]),
+        "waiting": [
+            (w["key"], w["band"], w["position"], w["blocked_on"])
+            for w in snap["waiting"]
+        ],
+        "preempting": snap["preempting"],
+        "usage": snap["usage"],
+        "namespace_usage": snap["namespace_usage"],
+        "dominant_shares": snap["dominant_shares"],
+        "log": adm.decision_log_lines(),
+    }
+
+
+def assert_equivalent(pair, context):
+    full, indexed = observable(pair[0][0]), observable(pair[1][0])
+    assert indexed == full, f"diverged after {context}"
+
+
+class PairedDriver:
+    """Feeds one randomized operation trace to both controllers and
+    checks full observable equality after every single operation."""
+
+    def __init__(self, policy, seed, quotas=None, generations=None,
+                 weights=None):
+        self.rng = random.Random(seed)
+        self.generations = generations
+        self.pair = make_pair(
+            policy, quotas=quotas, generations=generations,
+            weights=weights, seed=seed)
+        self.specs = {}  # key -> ask kwargs (kept identical across asks)
+        self.counter = 0
+
+    def ask_both(self, key, has_pods=False):
+        spec = self.specs[key]
+        for adm, _ in self.pair:
+            adm.try_admit(key=key, has_pods=has_pods, **spec)
+
+    def op_new(self):
+        self.counter += 1
+        ns = self.rng.choice(NAMESPACES)
+        name = f"job-{self.counter:03d}"
+        pods = self.rng.randint(1, 6)
+        ratios = None
+        if self.generations and self.rng.random() < 0.5:
+            ratios = {
+                gen: self.rng.choice((0.4, 0.7, 1.0))
+                for gen in self.generations
+            }
+        key = f"JAXJob:{ns}/{name}"
+        self.specs[key] = dict(
+            kind="JAXJob", namespace=ns, name=name, uid=f"uid-{name}",
+            priority_class=self.rng.choice(BANDS),
+            demand={"pods": Fraction(pods)}, members=pods,
+            throughput_ratios=ratios,
+        )
+        self.ask_both(key, has_pods=self.rng.random() < 0.1)
+        return f"new {key}"
+
+    def op_reask(self):
+        if not self.specs:
+            return self.op_new()
+        key = self.rng.choice(sorted(self.specs))
+        if self.rng.random() < 0.25:  # elastic resize: decide-relevant
+            pods = self.rng.randint(1, 6)
+            self.specs[key]["demand"] = {"pods": Fraction(pods)}
+            self.specs[key]["members"] = pods
+        self.ask_both(key)
+        return f"reask {key}"
+
+    def op_release(self):
+        if not self.specs:
+            return self.op_new()
+        key = self.rng.choice(sorted(self.specs))
+        del self.specs[key]
+        for adm, _ in self.pair:
+            adm.release(key)
+        return f"release {key}"
+
+    def op_ack(self):
+        pending = sorted(self.pair[0][0].snapshot()["preempting"])
+        if not pending:
+            return self.op_tick()
+        key = pending[0]
+        uid = self.specs.get(key, {}).get("uid", "uid-gone")
+        for adm, _ in self.pair:
+            adm.note_preempted(key, uid)
+        return f"ack {key}"
+
+    def op_tick(self):
+        seconds = self.rng.choice((5.0, 30.0, 90.0, 200.0))
+        for _, clock in self.pair:
+            clock.advance(seconds)
+        return f"tick {seconds}"
+
+    def run(self, steps=120):
+        ops = (
+            (self.op_new, 4), (self.op_reask, 4), (self.op_release, 2),
+            (self.op_ack, 2), (self.op_tick, 2),
+        )
+        table = [op for op, weight in ops for _ in range(weight)]
+        for step in range(steps):
+            context = f"step {step}: {self.rng.choice(table)()}"
+            assert_equivalent(self.pair, context)
+        # Drain: ack every pending preemption, then release everything,
+        # still in lockstep — the tail (emptying queues, watermark
+        # teardown) is where removal bookkeeping bugs hide.
+        while True:
+            pending = sorted(self.pair[0][0].snapshot()["preempting"])
+            if not pending:
+                break
+            for key in pending:
+                uid = self.specs.get(key, {}).get("uid", "uid-gone")
+                for adm, _ in self.pair:
+                    adm.note_preempted(key, uid)
+                assert_equivalent(self.pair, f"drain ack {key}")
+        for key in sorted(self.specs):
+            for adm, _ in self.pair:
+                adm.release(key)
+            assert_equivalent(self.pair, f"drain release {key}")
+        indexed = self.pair[1][0]
+        assert indexed._band_order == {}
+        assert indexed._usage_idx == {}
+        assert indexed._ns_usage_idx == {}
+
+
+SEEDS = (1, 2, 3)
+
+
+class TestPairedTraces:
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_priority(self, seed):
+        PairedDriver("priority", seed).run()
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_gavel_generations(self, seed):
+        PairedDriver(
+            "gavel", seed,
+            generations={"v5lite": {"pods": "8"}, "v6": {"pods": "8"}},
+        ).run()
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_drf_weighted(self, seed):
+        # drf declines the prune contract: the indexed controller runs
+        # decide over the full maintained state — still byte-equal.
+        PairedDriver(
+            "drf", seed,
+            weights={"tenant-a": 2.0, "tenant-b": 1.0},
+        ).run()
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_priority_quota_fallback(self, seed):
+        # Quotas also decline the prune (head-of-line selection is
+        # quota-aware); only the no-op short-circuit remains active.
+        PairedDriver(
+            "priority", seed,
+            quotas={"tenant-a": {"pods": "6"}, "tenant-b": {"pods": "6"}},
+        ).run()
+
+
+class TestFleetSimDigest:
+    @pytest.mark.parametrize("policy", ["priority", "gavel", "drf"])
+    def test_digest_unmoved_by_the_flag(self, policy):
+        import dataclasses
+
+        from tf_operator_tpu.testing.fleetsim import (
+            FleetSim, Scenario, StormEvent,
+        )
+
+        scenario = Scenario(
+            name=f"index-eq-{policy}", seed=71, profile="bursty",
+            jobs=120, tenants=6, horizon=1800.0, capacity_pods=24,
+            policy=policy, aging_seconds=300.0,
+            storm=[
+                StormEvent(t=300.0, kind="revoke-capacity",
+                           capacity={"pods": "12"}),
+                StormEvent(t=900.0, kind="revoke-capacity",
+                           capacity={"pods": "24"}),
+            ],
+        )
+        full = FleetSim(scenario).run()
+        indexed = FleetSim(
+            dataclasses.replace(scenario, admission_index=True)).run()
+        assert indexed["digest"] == full["digest"]
+        assert indexed["completed"] == full["completed"] == full["jobs"]
+        assert indexed["invariant_violations"] == []

@@ -8,6 +8,7 @@ admission/autoscaler decision logs, same chaos fault log, same
 completion order — all folded into one digest, compared across runs.
 """
 
+import dataclasses
 import json
 import os
 import time
@@ -260,9 +261,25 @@ class TestFleetSimEngine:
         report = FleetSim(tiny_scenario()).run()
         hot = report["hot_paths"]
         assert hot["pump_calls"] > 0
+        assert hot["pump_seconds_total"] > 0
         assert hot["pump_seconds_per_call"] > 0
         assert hot["watch_cache_resident_objects_peak"] > 0
+        assert hot["watch_cache_resident_bytes_peak"] > 0
         assert hot["decision_log_entries"] > 0
+        # Index OFF (the default): no pump ever skipped or fell back.
+        assert hot["pump_skipped_no_capacity_delta"] == 0
+        assert hot["pump_skipped_band_watermark"] == 0
+        assert hot["index_fallback_pumps"] == 0
+
+    def test_admission_index_skips_pumps_and_keeps_digest(self):
+        sc = tiny_scenario()
+        full = FleetSim(sc).run()
+        indexed = FleetSim(
+            dataclasses.replace(sc, admission_index=True)).run()
+        assert indexed["digest"] == full["digest"]
+        hot = indexed["hot_paths"]
+        assert hot["pump_calls"] == full["hot_paths"]["pump_calls"]
+        assert hot["pump_skipped_no_capacity_delta"] > 0
 
     def test_pods_carry_the_invariant_labels(self):
         # Mid-run dependents must satisfy check_dependents_invariants:
@@ -464,3 +481,7 @@ def test_full_fleet_100k_jobs_1k_tenants():
     assert report["completed"] == report["jobs"]
     assert report["invariant_violations"] == []
     assert report["compression_x"] >= 100.0
+    # Watch-cache memory accounting at full fleet depth: the resident-
+    # bytes gauge must be live (epoch sweeps sample it) and plausibly
+    # sized — 100k sharded jobs peak well above the 1 MiB floor.
+    assert report["hot_paths"]["watch_cache_resident_bytes_peak"] > 1 << 20

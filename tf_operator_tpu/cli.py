@@ -192,6 +192,13 @@ class OperatorOptions:
     # evicting the whole job. Off (default) keeps the PR 9 job-granular
     # arbiter byte-identical.
     admission_slice_granularity: bool = False
+    # Incremental admissibility index (EngineOptions.admission_index):
+    # the arbiter maintains per-band min-demand watermarks, a capacity
+    # epoch / dirty bit, and incremental PolicyState structures so a
+    # pump is O(newly-fittable) instead of O(waiting set). Schedule-
+    # equivalent by contract (byte-equal decision logs); off (default)
+    # keeps the historical full-scan pump byte-identical.
+    enable_admission_index: bool = False
     # Signal-driven gang autoscaler (core/autoscaler.py, one per operator
     # like the AdmissionController): automatically resizes elastic
     # JAXJob gangs through the existing spec-resize path from the
@@ -290,6 +297,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "preempt-lowest-band on contention, and bounded "
                         "backfill. Default off = first-come admission "
                         "exactly as before.")
+    parser.add_argument("--enable-admission-index", action="store_true",
+                        help="Incremental admissibility index for the "
+                        "gang-admission arbiter: per-band min-demand "
+                        "watermarks, a capacity epoch/dirty bit, and "
+                        "incrementally-maintained policy state make a "
+                        "pump O(newly-fittable) instead of O(waiting "
+                        "set). Schedule-equivalent to the full scan "
+                        "(byte-equal decision logs). Default off.")
     parser.add_argument("--capacity", default="",
                         help="Declared admission pool, 'res=qty[,res=qty]' "
                         "(e.g. 'google.com/tpu=128,pods=32'); 'pods' "
@@ -479,6 +494,7 @@ def options_from_args(args: argparse.Namespace) -> OperatorOptions:
         backfill_max_members=args.backfill_max_members,
         admission_aging_seconds=args.admission_aging_seconds,
         admission_slice_granularity=args.admission_slice_granularity,
+        enable_admission_index=args.enable_admission_index,
         admission_policy=args.admission_policy,
         tenant_weights=list(args.tenant_weight),
         admission_seed=args.admission_seed,
@@ -757,6 +773,7 @@ class OperatorManager:
             peer_restore=self.options.enable_peer_restore,
             sharded_restore=self.options.enable_sharded_restore,
             warm_start=self.options.enable_warm_start,
+            admission_index=self.options.enable_admission_index,
         )
         # ONE gang-admission arbiter shared by every framework controller
         # (core/admission.py): capacity and quota are operator-wide, so a
@@ -804,6 +821,9 @@ class OperatorManager:
                 policy=self.options.admission_policy,
                 tenant_weights=weights,
                 seed=self.options.admission_seed,
+                admission_index=self.options.enable_admission_index,
+                capacity_version_fn=getattr(
+                    cluster, "schedulable_capacity_version", None),
             )
         # Signal-driven gang autoscaler (core/autoscaler.py): one per
         # operator, built only when opted in — the None default keeps

@@ -112,6 +112,13 @@ class InMemoryCluster(base.Cluster):
         # FLAT pool — which generation a pod's chips come from is the
         # operator's placement decision, not the simulator's.
         self._capacity_generations: Optional[Dict[str, Dict[str, str]]] = None
+        # Monotonic capacity-model epoch: bumped on every
+        # set_schedulable_capacity (which rewrites BOTH the flat pool
+        # and the generation sub-pools). The admission layer's
+        # capacity_version_fn polls this so its effective-capacity
+        # cache (EngineOptions.admission_index) invalidates exactly
+        # when the backend's capacity model changed.
+        self._capacity_version = 0
 
     # ------------------------------------------------------------------ util
     def latest_rv(self) -> int:
@@ -595,6 +602,13 @@ class InMemoryCluster(base.Cluster):
                 {gen: dict(res) for gen, res in generations.items()}
                 if generations else None
             )
+            self._capacity_version += 1
+
+    def schedulable_capacity_version(self) -> int:
+        """Capacity-model epoch (see __init__): changes iff a
+        set_schedulable_capacity call happened since the last read."""
+        with self._lock:
+            return self._capacity_version
 
     def schedulable_capacity(self) -> Optional[Dict[str, str]]:
         """The declared pool (None = unbounded). The admission layer's

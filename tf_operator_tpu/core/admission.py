@@ -57,6 +57,7 @@ from __future__ import annotations
 
 import threading
 import time
+from bisect import bisect_left, insort
 from dataclasses import dataclass, field
 from fractions import Fraction
 from typing import Callable, Dict, List, Optional, Tuple
@@ -72,6 +73,7 @@ from .policies import (
     PREEMPT_CAUSE_THROUGHPUT,
     Preempt,
     build_policy,
+    fits,
     ratio_of,
 )
 
@@ -297,6 +299,16 @@ class _Gang:
     # fallback-requeue poll of a still-blocked gang (which would trip
     # the denial-rate alert forever for one patiently-waiting job).
     reported_block: str = ""
+    # Admissibility-index bookkeeping (EngineOptions.admission_index;
+    # dead weight when the index is OFF). ``reg`` is a monotonic
+    # registration stamp assigned at every insertion into the waiting
+    # dict: the maintained per-band order sorts by (seq, reg), which
+    # reproduces the full scan's stable sort exactly — sorted() breaks
+    # equal-seq ties by dict insertion order, and reg IS that order.
+    # ``cached_view`` memoizes the GangView so an unchanged gang costs
+    # zero per pump; every mutation of a view field clears it.
+    reg: int = 0
+    cached_view: Optional[GangView] = None
 
 
 class AdmissionController:
@@ -324,6 +336,8 @@ class AdmissionController:
         tenant_weights: Optional[Dict[str, float]] = None,
         seed: int = 0,
         decision_log_max: int = 4096,
+        admission_index: bool = False,
+        capacity_version_fn: Optional[Callable[[], int]] = None,
     ):
         # Per-SLICE admission (--admission-slice-granularity, flagged
         # headroom for multislice jobs): the ENGINE reads this and
@@ -415,12 +429,100 @@ class AdmissionController:
         self.decision_log: "deque[dict]" = deque(maxlen=self.decision_log_max)
         self.decision_log_dropped = 0
         self._pump_count = 0
+        # ---- admissibility index (EngineOptions.admission_index) ----
+        # Default OFF: every structure below stays empty and _pump_locked
+        # takes the historical full-scan path byte-for-byte. ON, a pump
+        # touches only gangs that could NEWLY fit: (1) per-band minimum-
+        # demand watermarks prune whole bands the free pool cannot cover;
+        # (2) a capacity epoch / dirty bit short-circuits triggers that
+        # changed nothing since the last scan (counted, never silent);
+        # (3) the waiting order, the GangViews, and the usage snapshots
+        # are maintained at mutation points instead of rebuilt per pump.
+        # Schedule-equivalence is the contract: identical decision-log
+        # bytes and verdicts vs the full scan (see
+        # docs/design/gang_admission.md "Admissibility index").
+        self._index = bool(admission_index)
+        # Backend capacity-model epoch provider (the memory cluster's
+        # schedulable_capacity_version): keys the effective-capacity /
+        # effective-generations cache so a no-op pump does not re-parse
+        # the pool, while a set_schedulable_capacity (revocation, grow)
+        # invalidates it on the very next read.
+        self._capacity_version_fn = capacity_version_fn
+        self._cap_version_seen: object = object()  # never equals an int
+        self._cap_cache: Optional[Dict[str, Fraction]] = None
+        self._gens_cache: Dict[str, Dict[str, Fraction]] = {}
+        # Waiting-set index: band -> gangs ordered by (seq, reg), plus
+        # the band's minimum-demand watermark (per-resource min over its
+        # members, kept only for resources every member demands).
+        self._reg = 0
+        self._band_order: Dict[int, List[_Gang]] = {}
+        self._band_min: Dict[int, Dict[str, Fraction]] = {}
+        # Admitted-set index: flat + per-tenant usage (exact Fraction
+        # sums — value-identical to the scans), tenant gang counts (the
+        # dominant-share tenant enumeration), and the memoized admitted
+        # view tuple.
+        self._usage_idx: Dict[str, Fraction] = {}
+        self._ns_usage_idx: Dict[str, Dict[str, Fraction]] = {}
+        self._ns_count: Dict[str, int] = {}
+        self._admitted_views: Optional[tuple] = None
+        # Dirty protocol: "full" = decide-relevant state changed since
+        # the last scan; ("enqueue", key) = exactly one new waiter
+        # arrived; None = clean. Together with the free-capacity vector
+        # the last scan saw, this is the capacity epoch: a trigger that
+        # changed neither is provably a fixpoint (re-deciding the
+        # unchanged post-pump state yields zero actions) and skips.
+        self._pending_delta = "full"
+        self._scanned_cap: Optional[Dict[str, Fraction]] = None
+        self._scanned_gens: Optional[Dict[str, Dict[str, Fraction]]] = None
+        # Gauge memo: the admission gauges (queue depths, effective
+        # throughput, dominant shares) are pure functions of (waiting
+        # index, admitted set, cap). Mutation helpers flip the stale
+        # bit; a decide-running pump whose inputs did not move since
+        # the last publish re-publishes nothing — the values would be
+        # bit-identical (same items, same iteration order).
+        self._gauges_stale = True
+        self._gauge_cap: Optional[Dict[str, Fraction]] = None
 
     # --------------------------------------------------------- capacity
+    def _refresh_capacity_cache(self) -> bool:
+        """True when the version-keyed capacity cache is authoritative
+        (index ON and the backend exposes a capacity-model epoch); on
+        an epoch move, re-derives both cached vectors. A provider error
+        disables the cache for that read — a flaky provider must not
+        freeze admission on a stale pool."""
+        if not self._index or self._capacity_version_fn is None:
+            return False
+        try:
+            version = self._capacity_version_fn()
+        except Exception:  # noqa: BLE001
+            return False
+        if version != self._cap_version_seen:
+            self._cap_cache = self._effective_capacity_uncached()
+            self._gens_cache = self._effective_generations_uncached()
+            self._cap_version_seen = version
+        return True
+
     def effective_capacity(self) -> Optional[Dict[str, Fraction]]:
         """None = unlimited. With both a declared pool and a live
         provider, a resource's bound is the smaller of the two (a
-        revocation can only shrink the pool, never grow past --capacity)."""
+        revocation can only shrink the pool, never grow past --capacity).
+        With the admissibility index ON and a capacity_version_fn, the
+        parsed vector is cached on the backend's capacity-model epoch —
+        a no-op pump stops paying the re-parse, and a
+        set_schedulable_capacity invalidates on the next read."""
+        if self._refresh_capacity_cache():
+            return dict(self._cap_cache) if self._cap_cache is not None else None
+        return self._effective_capacity_uncached()
+
+    def effective_generations(self) -> Dict[str, Dict[str, Fraction]]:
+        """The device-generation sub-pools ({} = homogeneous), min-merged
+        with the live provider like the flat pool; cached on the same
+        capacity-model epoch (set_schedulable_capacity rewrites both)."""
+        if self._refresh_capacity_cache():
+            return {g: dict(r) for g, r in self._gens_cache.items()}
+        return self._effective_generations_uncached()
+
+    def _effective_capacity_uncached(self) -> Optional[Dict[str, Fraction]]:
         cap = dict(self._declared) if self._declared is not None else None
         if self._capacity_fn is not None:
             try:
@@ -436,12 +538,11 @@ class AdmissionController:
                         cap[name] = min(cap.get(name, qty), qty)
         return cap
 
-    def effective_generations(self) -> Dict[str, Dict[str, Fraction]]:
-        """The device-generation sub-pools ({} = homogeneous). With a
-        live provider (the memory cluster's schedulable_generations),
-        a declared generation's bound is the per-resource MIN of the
-        two — a generation-scoped revocation can only shrink its
-        sub-pool, mirroring the flat rule."""
+    def _effective_generations_uncached(self) -> Dict[str, Dict[str, Fraction]]:
+        """With a live provider (the memory cluster's
+        schedulable_generations), a declared generation's bound is the
+        per-resource MIN of the two — a generation-scoped revocation can
+        only shrink its sub-pool, mirroring the flat rule."""
         gens = {g: dict(r) for g, r in self._declared_gens.items()}
         if self._generations_fn is not None:
             try:
@@ -475,6 +576,179 @@ class AdmissionController:
                 usage[name] = usage.get(name, Fraction(0)) + qty
         return usage
 
+    # ------------------------------------------- admissibility index
+    # Maintained mirrors of the per-pump scans, updated at the mutation
+    # points (register/refresh/admit/demote/preempt-ack/release). Every
+    # helper is a no-op with the index OFF, so the historical path never
+    # touches them. Fraction arithmetic is exact, so the incremental
+    # usage vectors are VALUE-identical to the scans — the one structure
+    # deliberately not maintained incrementally is the float
+    # effective-throughput gauge (float sums are order-sensitive and the
+    # autoscaler digests its decisions).
+    @staticmethod
+    def _band_sort_key(gang: _Gang):
+        return (gang.seq, gang.reg)
+
+    def _index_wait_register_locked(self, gang: _Gang) -> None:
+        """Gang inserted into the waiting DICT: stamp the registration
+        order (the stable-sort tiebreak) and index it."""
+        if not self._index:
+            return
+        self._reg += 1
+        gang.reg = self._reg
+        self._index_wait_insert_locked(gang)
+
+    def _index_wait_insert_locked(self, gang: _Gang) -> None:
+        if not self._index:
+            return
+        self._gauges_stale = True
+        members = self._band_order.setdefault(gang.band, [])
+        insort(members, gang, key=self._band_sort_key)
+        wm = self._band_min.get(gang.band)
+        if wm is None or len(members) == 1:
+            self._band_min[gang.band] = dict(gang.demand)
+        else:
+            demand = gang.demand
+            # Min-merge, keeping only resources EVERY member demands: a
+            # resource some member lacks cannot prove that member unfit.
+            self._band_min[gang.band] = {
+                name: min(qty, demand[name])
+                for name, qty in wm.items() if name in demand
+            }
+
+    def _index_wait_remove_locked(self, gang: _Gang) -> None:
+        if not self._index:
+            return
+        self._gauges_stale = True
+        members = self._band_order.get(gang.band)
+        if not members:
+            return
+        i = bisect_left(members, self._band_sort_key(gang),
+                        key=self._band_sort_key)
+        if i < len(members) and members[i] is gang:
+            del members[i]
+        else:  # defensive: stamp drifted — fall back to identity scan
+            for j, other in enumerate(members):
+                if other is gang:
+                    del members[j]
+                    break
+            else:
+                return
+        if not members:
+            self._band_order.pop(gang.band, None)
+            self._band_min.pop(gang.band, None)
+            return
+        wm = self._band_min.get(gang.band)
+        if wm is None or any(
+            gang.demand.get(name) == qty for name, qty in wm.items()
+        ):
+            # The leaver held (or tied) a band minimum: recompute
+            # exactly. Otherwise keep the stale watermark — it is <=
+            # the true minimum, so it can only under-prune, never
+            # over-prune (soundness is one-sided by construction).
+            self._recompute_band_min_locked(gang.band)
+
+    def _recompute_band_min_locked(self, band: int) -> None:
+        members = self._band_order.get(band)
+        if not members:
+            self._band_min.pop(band, None)
+            return
+        wm = dict(members[0].demand)
+        for gang in members[1:]:
+            demand = gang.demand
+            wm = {
+                name: min(qty, demand[name])
+                for name, qty in wm.items() if name in demand
+            }
+            if not wm:
+                break
+        self._band_min[band] = wm
+
+    def _index_usage_add_locked(self, gang: _Gang) -> None:
+        self._gauges_stale = True
+        usage = self._usage_idx
+        bucket = self._ns_usage_idx.setdefault(gang.namespace, {})
+        zero = Fraction(0)
+        for name, qty in gang.demand.items():
+            usage[name] = usage.get(name, zero) + qty
+            bucket[name] = bucket.get(name, zero) + qty
+
+    def _index_usage_sub_locked(self, gang: _Gang) -> None:
+        self._gauges_stale = True
+        usage = self._usage_idx
+        bucket = self._ns_usage_idx.get(gang.namespace, {})
+        zero = Fraction(0)
+        for name, qty in gang.demand.items():
+            left = usage.get(name, zero) - qty
+            if left:
+                usage[name] = left
+            else:  # zero-pruned: `fits` reads .get(name, 0) either way
+                usage.pop(name, None)
+            ns_left = bucket.get(name, zero) - qty
+            if ns_left:
+                bucket[name] = ns_left
+            else:
+                bucket.pop(name, None)
+
+    def _index_admit_add_locked(self, gang: _Gang) -> None:
+        """Gang entered the admitted dict (view fields just changed)."""
+        if not self._index:
+            return
+        gang.cached_view = None
+        self._admitted_views = None
+        self._index_usage_add_locked(gang)
+        self._ns_count[gang.namespace] = (
+            self._ns_count.get(gang.namespace, 0) + 1)
+
+    def _index_admit_remove_locked(self, gang: _Gang) -> None:
+        if not self._index:
+            return
+        self._admitted_views = None
+        self._index_usage_sub_locked(gang)
+        left = self._ns_count.get(gang.namespace, 0) - 1
+        if left > 0:
+            self._ns_count[gang.namespace] = left
+        else:
+            self._ns_count.pop(gang.namespace, None)
+            self._ns_usage_idx.pop(gang.namespace, None)
+
+    def _index_dirty_locked(self) -> None:
+        """Decide-relevant state changed outside a pump: the next pump
+        must run a full decide (the no-op short-circuit stands down)."""
+        if self._index:
+            self._pending_delta = "full"
+
+    def _view_locked(self, gang: _Gang) -> GangView:
+        view = gang.cached_view
+        if view is None:
+            view = self._gang_view(gang)
+            gang.cached_view = view
+        return view
+
+    def _admitted_views_locked(self) -> tuple:
+        views = self._admitted_views
+        if views is None:
+            views = tuple(
+                self._view_locked(g) for g in self._admitted.values())
+            self._admitted_views = views
+        return views
+
+    def _prune_ok_locked(self) -> bool:
+        """May the waiting set be band-pruned for the active policy?
+        Requires the policy's declared prune contract AND a quota-free
+        pool (quota verdicts need every gang scanned, and the head-of-
+        line selection is quota-aware)."""
+        return (
+            getattr(self.policy, "supports_waiting_prune", False)
+            and not self.quotas
+        )
+
+    def _is_order_head_locked(self, gang: _Gang) -> bool:
+        """Is this WAITING gang the (band desc, seq asc) order head —
+        i.e. first in the top non-empty band?"""
+        top = max(self._band_order)
+        return self._band_order[top][0] is gang
+
     # ------------------------------------------------------------- pump
     # (Fit/quota predicates live in core/policies.py now — the seam owns
     # the decision procedure; this class owns registration, application,
@@ -485,7 +759,8 @@ class AdmissionController:
     def _admit_locked(self, gang: _Gang, now: float, backfill: bool,
                       head_wait: Optional[float],
                       generation: Optional[str] = None) -> None:
-        self._waiting.pop(gang.key, None)
+        if self._waiting.pop(gang.key, None) is not None:
+            self._index_wait_remove_locked(gang)
         gang.admitted_at = now
         gang.backfilled = backfill
         gang.blocked_on = ""
@@ -493,6 +768,7 @@ class AdmissionController:
         gang.generation = generation
         gang.admitted_demand = dict(gang.demand)
         self._admitted[gang.key] = gang
+        self._index_admit_add_locked(gang)
         entry = {
             "key": gang.key, "band": gang.band, "backfill": backfill,
             "head_wait_at_admit": head_wait,
@@ -555,7 +831,8 @@ class AdmissionController:
         guard's no-bypass path): head of its band with a fresh aging
         clock — it held capacity in good standing and must not lose its
         place to later arrivals for asking to grow."""
-        self._admitted.pop(gang.key, None)
+        if self._admitted.pop(gang.key, None) is not None:
+            self._index_admit_remove_locked(gang)
         gang.admitted_at = None
         gang.backfilled = False
         gang.announced_admit = False
@@ -569,6 +846,9 @@ class AdmissionController:
         gang.seq = (min(band_seqs) - 1) if band_seqs else gang.seq
         gang.enqueued_at = now
         self._waiting[gang.key] = gang
+        gang.cached_view = None
+        self._index_wait_register_locked(gang)
+        self._index_dirty_locked()
 
     def _mark_preempt_locked(self, gang: _Gang, cause: str) -> None:
         if gang.key in self._preempt:
@@ -645,12 +925,167 @@ class AdmissionController:
         output order IS its observable schedule), preempts mark victims
         for the engine's counted teardown, and blocked verdicts land on
         whoever stays waiting. The default priority policy reproduces
-        the PR 9 procedure byte-for-byte."""
+        the PR 9 procedure byte-for-byte.
+
+        With the admissibility index ON, the pump first consults the
+        capacity epoch / dirty bit (_pump_indexed_locked): a trigger
+        that changed nothing since the last scan short-circuits —
+        counted, never silent — and a dirty pump runs decide over the
+        maintained (optionally band-pruned) state instead of rebuilding
+        it. Both paths share _apply_decisions_locked, so an acting pump
+        writes byte-identical decision-log entries either way; skipped
+        pumps still advance _pump_count and observe the pump histogram,
+        keeping acting pumps' numbering and the pump_calls column
+        identical to a full-scan run."""
         self._pump_count += 1
         pump_started = time.perf_counter()
+        if self._index:
+            self._pump_indexed_locked(now, pump_started)
+            return
         cap = self.effective_capacity()
         state = self._policy_state_locked(now, cap)
         decisions = self.policy.decide(state)
+        self._apply_decisions_locked(decisions, now)
+        self._update_gauges_locked(cap)
+        # Wall time (perf_counter), never the injected clock: under the
+        # fleet simulator the virtual clock is frozen inside an event,
+        # and the whole point of this histogram is the REAL per-pump
+        # cost at fleet object counts.
+        self.metrics.observe_admission_pump(
+            time.perf_counter() - pump_started)
+
+    def _pump_indexed_locked(self, now: float, pump_started: float) -> None:
+        """The indexed pump. Skip rule (exact, not heuristic): if no
+        decide-relevant mutation landed since the last scan, the last
+        scan was ACTION-FREE (the only way the clean bit gets set), and
+        the effective capacity/generation vectors are unchanged, the
+        last scan's outcome is a FIXPOINT — any fitting+eligible gang
+        would already have been admitted, the verdicts were computed
+        against exactly the current usage, and time only enters decide
+        through head_wait/aging, which can only retract backfill
+        eligibility, never create an admit from nothing — so decide
+        would return zero actions and identical verdicts for every
+        policy. The
+        arrival fast path extends this one step: a single new waiter
+        that is not the order head and cannot fit the free pool gets
+        its provable "capacity" verdict directly."""
+        cap = self.effective_capacity()
+        gens = self.effective_generations()
+        delta = self._pending_delta
+        unchanged = (
+            delta != "full"
+            and cap == self._scanned_cap
+            and gens == self._scanned_gens
+        )
+        if unchanged:
+            if delta is None:
+                self.metrics.admission_pump_skipped_inc("no-capacity-delta")
+                self.metrics.observe_admission_pump(
+                    time.perf_counter() - pump_started)
+                return
+            gang = self._waiting.get(delta[1])
+            if (
+                gang is not None
+                and cap is not None
+                and self._prune_ok_locked()
+                and not self._is_order_head_locked(gang)
+                and not fits(gang.demand, self._usage_idx, cap)
+            ):
+                # Exactly one enqueue since a fixpoint scan: the scan
+                # prefix before this gang replays unchanged (no admits
+                # there — fixpoint), so by the time the full scan
+                # reached it the head chain would already be occupied;
+                # a non-head gang that cannot fit the free pool gets
+                # verdict "capacity" under the prune contract.
+                gang.blocked_on = "capacity"
+                self._pending_delta = None
+                self.metrics.admission_pump_skipped_inc("band-watermark")
+                self.metrics.observe_admission_pump(
+                    time.perf_counter() - pump_started)
+                return
+        state, pruned = self._policy_state_indexed_locked(now, cap, gens)
+        decisions = self.policy.decide(state)
+        acted = self._apply_decisions_locked(decisions, now)
+        for gang in pruned:
+            # Self-applied verdict for band-pruned gangs: the prune
+            # contract guarantees the full scan would say exactly this.
+            gang.blocked_on = "capacity"
+        self._update_gauges_locked(cap)
+        self._scanned_cap = cap
+        self._scanned_gens = gens
+        # Clean ONLY after a zero-action decide. An acting pump's blocked
+        # verdicts were computed mid-scan, relative to pre-admission
+        # usage — the full scan refreshes them on its NEXT pump (e.g. a
+        # waiter verdict flips "capacity" -> "quota" once a same-tenant
+        # admit lands), so the indexed pump must re-decide once too
+        # before it may start skipping. The fixpoint argument for the
+        # skip therefore always rests on an action-free scan. One exact
+        # refinement: an acting pump that leaves the waiting set EMPTY
+        # has no verdicts left to go stale (pending preemption marks are
+        # idempotent — re-deciding emits nothing new), so it may go
+        # clean immediately.
+        self._pending_delta = "full" if (acted and self._waiting) else None
+        self.metrics.observe_admission_pump(
+            time.perf_counter() - pump_started)
+
+    def _policy_state_indexed_locked(
+        self, now: float, cap, gens,
+    ) -> Tuple[PolicyState, List[_Gang]]:
+        """PolicyState from the maintained structures, optionally band-
+        pruned. For every band whose minimum-demand watermark cannot fit
+        the free pool (some resource r with usage[r] + watermark[r] >
+        cap[r] — every member's demand[r] >= watermark[r], so NO member
+        fits), only the band's first gang is passed through (the scan's
+        head chain stops at the first blocked waiter, which is always a
+        kept gang) and the rest are returned for the self-applied
+        "capacity" verdict. A policy that cannot honor the prune (drf)
+        or a quota'd pool falls back to the unpruned maintained state —
+        counted via admission_index_fallback_total."""
+        prune_ok = self._prune_ok_locked()
+        if not prune_ok:
+            self.metrics.admission_index_fallback_inc(self.policy.name)
+        prune = prune_ok and cap is not None
+        waiting: List[GangView] = []
+        pruned: List[_Gang] = []
+        usage = self._usage_idx
+        zero = Fraction(0)
+        for band in sorted(self._band_order, reverse=True):
+            members = self._band_order[band]
+            if prune and len(members) > 1:
+                wm = self._band_min.get(band)
+                if wm and any(
+                    name in cap and usage.get(name, zero) + qty > cap[name]
+                    for name, qty in wm.items()
+                ):
+                    waiting.append(self._view_locked(members[0]))
+                    pruned.extend(members[1:])
+                    self.metrics.admission_pump_skipped_inc("band-watermark")
+                    continue
+            for gang in members:
+                waiting.append(self._view_locked(gang))
+        state = PolicyState(
+            waiting=tuple(waiting),
+            admitted=self._admitted_views_locked(),
+            pending_preempt=frozenset(self._preempt),
+            capacity=cap,
+            generations=gens,
+            quotas=self.quotas,
+            tenant_weights=self.tenant_weights,
+            backfill_max_members=self.backfill_max_members,
+            aging_seconds=self.aging_seconds,
+            now=now,
+            seed=self.seed,
+            # The maintained admitted-usage vector (exact Fractions —
+            # value-identical to the scan): decide's prologue copies it
+            # instead of re-summing the admitted set per pump.
+            usage=dict(self._usage_idx),
+        )
+        return state, pruned
+
+    def _apply_decisions_locked(self, decisions, now: float) -> bool:
+        """Apply the policy's ordered decision list verbatim; True when
+        any action actually landed (the indexed pump's clean/dirty
+        signal — an acting pump may not mark the state clean)."""
         applied: List[list] = []
         admitted_keys: set = set()
         for action in decisions.actions:
@@ -689,18 +1124,31 @@ class AdmissionController:
                 {"pump": self._pump_count, "policy": self.policy.name,
                  "seed": self.seed, "actions": applied}
             )
-        self._update_gauges_locked(cap)
-        # Wall time (perf_counter), never the injected clock: under the
-        # fleet simulator the virtual clock is frozen inside an event,
-        # and the whole point of this histogram is the REAL per-pump
-        # cost at fleet object counts.
-        self.metrics.observe_admission_pump(
-            time.perf_counter() - pump_started)
+        return bool(applied)
 
     def _update_gauges_locked(self, cap=None) -> None:
-        depths: Dict[int, int] = {}
-        for gang in self._waiting.values():
-            depths[gang.band] = depths.get(gang.band, 0) + 1
+        if self._index:
+            # Gauge memo: these gauges are pure functions of (waiting
+            # index, admitted set, cap). If nothing moved since the
+            # last publish and the capacity vector is value-equal, the
+            # recomputed floats would be bit-identical — skip the
+            # re-publish. Index OFF keeps the publish-every-pump
+            # behaviour untouched.
+            if not self._gauges_stale and cap == self._gauge_cap:
+                return
+            self._gauges_stale = False
+            self._gauge_cap = dict(cap) if cap is not None else None
+            # Band depths straight off the maintained index (empty
+            # bands are deleted on removal, so the key set matches the
+            # scan's).
+            depths = {
+                band: len(members)
+                for band, members in self._band_order.items() if members
+            }
+        else:
+            depths = {}
+            for gang in self._waiting.values():
+                depths[gang.band] = depths.get(gang.band, 0) + 1
         self.metrics.set_admission_queue_depths(
             {str(band): depth for band, depth in depths.items()}
         )
@@ -731,8 +1179,18 @@ class AdmissionController:
         if not cap:
             return {}
         shares: Dict[str, float] = {}
-        for ns in sorted({g.namespace for g in self._admitted.values()}):
-            used = self._ns_usage_locked(ns)
+        if self._index:
+            # Maintained tenant set + usage: Fraction sums are exact, so
+            # the float conversion (and the round) lands on the same
+            # value the scan would produce.
+            namespaces = sorted(self._ns_count)
+        else:
+            namespaces = sorted({g.namespace for g in self._admitted.values()})
+        for ns in namespaces:
+            used = (
+                self._ns_usage_idx.get(ns, {}) if self._index
+                else self._ns_usage_locked(ns)
+            )
             share = 0.0
             for resource, bound in cap.items():
                 if bound <= 0:
@@ -797,6 +1255,23 @@ class AdmissionController:
             if gang is not None:
                 # Refresh demand (elastic resize changes it) and notice
                 # revocations; a same-sync re-ask stays admitted.
+                demand_changed = view_changed = False
+                if self._index:
+                    # Value comparison, not identity: the steady-state
+                    # re-ask rebinds equal dicts every sync, and a
+                    # no-change re-ask must stay a clean (skippable)
+                    # trigger. uid/kick changes are decide-invisible.
+                    demand_changed = bool(demand) and demand != gang.demand
+                    view_changed = (
+                        demand_changed
+                        or (bool(members) and members != gang.members)
+                        or victim_rank != gang.victim_rank
+                        or (throughput_ratios is not None
+                            and dict(throughput_ratios)
+                            != gang.throughput_ratios)
+                    )
+                if demand_changed:
+                    self._index_usage_sub_locked(gang)
                 gang.demand = demand or gang.demand
                 gang.admitted_demand = dict(gang.demand)
                 gang.members = members or gang.members
@@ -809,6 +1284,15 @@ class AdmissionController:
                     # keeps placing on ratios the API object no longer
                     # declares.
                     gang.throughput_ratios = dict(throughput_ratios)
+                if demand_changed:
+                    self._index_usage_add_locked(gang)
+                if view_changed:
+                    gang.cached_view = None
+                    self._admitted_views = None
+                    self._index_dirty_locked()
+                    # members/ratio edits move the effective-throughput
+                    # gauge even when demand (and thus usage) held still.
+                    self._gauges_stale = True
                 self._pump_locked(now)
                 newly = not gang.announced_admit
                 gang.announced_admit = True
@@ -830,7 +1314,33 @@ class AdmissionController:
                         throughput_ratios=dict(throughput_ratios or {}),
                     )
                     self._waiting[key] = gang
+                    if self._index:
+                        self._index_wait_register_locked(gang)
+                        # Single-enqueue delta: the arrival fast path
+                        # may verdict this gang without a decide. Any
+                        # second mutation before a scan escalates to a
+                        # full dirty bit.
+                        self._pending_delta = (
+                            ("enqueue", key)
+                            if self._pending_delta is None else "full")
                 else:
+                    wait_changed = False
+                    if self._index:
+                        wait_changed = (
+                            band != gang.band
+                            or (bool(demand) and demand != gang.demand)
+                            or (bool(members) and members != gang.members)
+                            or victim_rank != gang.victim_rank
+                            or (throughput_ratios is not None
+                                and dict(throughput_ratios)
+                                != gang.throughput_ratios)
+                        )
+                        if wait_changed:
+                            # Reposition under the OLD (band, seq, reg)
+                            # before mutating; reg is kept — the gang's
+                            # dict position (the stable-sort tiebreak)
+                            # did not change.
+                            self._index_wait_remove_locked(gang)
                     gang.band = band
                     gang.demand = demand or gang.demand
                     gang.members = members or gang.members
@@ -839,12 +1349,17 @@ class AdmissionController:
                     gang.victim_rank = victim_rank
                     if throughput_ratios is not None:
                         gang.throughput_ratios = dict(throughput_ratios)
+                    if wait_changed:
+                        gang.cached_view = None
+                        self._index_wait_insert_locked(gang)
+                        self._index_dirty_locked()
                 if has_pods:
                     self._admit_locked(
                         gang, now, backfill=False, head_wait=None,
                         generation=self._adoption_generation_locked(gang),
                     )
                     gang.announced_admit = True
+                    self._index_dirty_locked()
                     self._pump_locked(now)
                     kicks = self._drain_kicks_locked()
                     result = AdmitResult(True, newly_admitted=True)
@@ -896,6 +1411,7 @@ class AdmissionController:
             now = self.clock()
             gang = self._admitted.pop(key, None)
             if gang is not None:
+                self._index_admit_remove_locked(gang)
                 if cause == PREEMPT_CAUSE_THROUGHPUT:
                     # A gavel swap victim YIELDS its place: re-queueing
                     # at the head of its band (the priority/capacity
@@ -921,8 +1437,14 @@ class AdmissionController:
                 gang.reported_block = ""
                 gang.generation = None  # re-placed fresh on re-admission
                 self._waiting[gang.key] = gang
+                gang.cached_view = None
+                self._index_wait_register_locked(gang)
                 self.preemption_ledger.append((key, uid, cause))
                 self.metrics.gang_preemption_inc(cause, str(gang.band))
+            # Dirty even when the gang was already gone: popping the
+            # pending-preempt marker alone changes decide's input (it
+            # suppresses backfill and excludes revocation victims).
+            self._index_dirty_locked()
             self._pump_locked(now)
             kicks = self._drain_kicks_locked()
         for fn in kicks:
@@ -954,11 +1476,22 @@ class AdmissionController:
                 }
             released = False
             for k in doomed:
-                released |= self._admitted.pop(k, None) is not None
-                released |= self._waiting.pop(k, None) is not None
-                self._preempt.pop(k, None)
+                admitted = self._admitted.pop(k, None)
+                if admitted is not None:
+                    released = True
+                    self._index_admit_remove_locked(admitted)
+                waiter = self._waiting.pop(k, None)
+                if waiter is not None:
+                    released = True
+                    self._index_wait_remove_locked(waiter)
+                if self._preempt.pop(k, None) is not None:
+                    # No pump on a pending-only pop (historical
+                    # behavior), but the NEXT pump must not skip: the
+                    # pending set is decide input.
+                    self._index_dirty_locked()
             if not released:
                 return
+            self._index_dirty_locked()
             self._pump_locked(self.clock())
             kicks = self._drain_kicks_locked()
         for fn in kicks:
@@ -1001,9 +1534,14 @@ class AdmissionController:
             if not doomed:
                 return
             for k in doomed:
-                self._admitted.pop(k, None)
-                self._waiting.pop(k, None)
+                admitted = self._admitted.pop(k, None)
+                if admitted is not None:
+                    self._index_admit_remove_locked(admitted)
+                waiter = self._waiting.pop(k, None)
+                if waiter is not None:
+                    self._index_wait_remove_locked(waiter)
                 self._preempt.pop(k, None)
+            self._index_dirty_locked()
             self._pump_locked(self.clock())
             kicks = self._drain_kicks_locked()
         for fn in kicks:

@@ -483,6 +483,19 @@ class EngineOptions:
     # the ordinary ladder — warm start is an optimization contract, never
     # a correctness gate. Default OFF.
     warm_start: bool = False
+    # Incremental admissibility index (--enable-admission-index): the
+    # shared AdmissionController maintains per-band minimum-demand
+    # watermarks, a capacity epoch / dirty bit, and incremental
+    # PolicyState structures so a pump touches only gangs that could
+    # NEWLY fit instead of re-scanning the whole waiting set. Pure
+    # pruning filter over the decide() seam — schedule-equivalent by
+    # contract (byte-equal decision logs; see
+    # docs/design/gang_admission.md "Admissibility index"). Default OFF
+    # so every seeded tier replays the historical full-scan path
+    # byte-identically. Unlike gang admission itself (below), this is a
+    # legitimate options field: it parameterizes HOW the one arbiter
+    # the manager builds pumps, not WHETHER it exists.
+    admission_index: bool = False
     # Capacity-aware gang admission (core/admission.py,
     # --enable-gang-admission) has NO EngineOptions field on purpose:
     # the switch is the `admission` object itself — the operator manager

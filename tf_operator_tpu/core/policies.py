@@ -136,6 +136,13 @@ class PolicyState:
     aging_seconds: float
     now: float
     seed: int = 0
+    # Precomputed exact usage of the admitted tuple — the admissibility
+    # index's maintained Fraction vector, VALUE-identical to
+    # usage_of(admitted) (Fraction arithmetic is exact, so incremental
+    # maintenance cannot drift). None (the full-scan arbiter) means
+    # policies compute their own scan; policies must never mutate this
+    # mapping — they copy before charging.
+    usage: Optional[Mapping[str, Fraction]] = None
 
 
 # ---------------------------------------------------------------- decisions
@@ -193,6 +200,15 @@ def usage_of(gangs, exclude=frozenset()) -> Dict[str, Fraction]:
         for name, qty in gang.demand.items():
             usage[name] = usage.get(name, _F0) + qty
     return usage
+
+
+def starting_usage(state: "PolicyState", admitted_now) -> Dict[str, Fraction]:
+    """The decide prologue's admitted-usage vector: the precomputed
+    state.usage when the arbiter maintains one (a private copy — decide
+    charges admits into it), else the O(admitted) scan."""
+    if state.usage is not None:
+        return dict(state.usage)
+    return usage_of(admitted_now)
 
 
 def ns_usage_of(gangs, namespace: str, exclude=frozenset()) -> Dict[str, Fraction]:
@@ -295,6 +311,21 @@ class AdmissionPolicy:
 
     name = "base"
 
+    # Prune contract for the admissibility index (core/admission.py,
+    # EngineOptions.admission_index). True declares: on a pool with NO
+    # namespace quotas, a PolicyState whose waiting tuple keeps, for
+    # every band that provably cannot fit its smallest waiter against
+    # the free pool, only that band's FIRST gang (band desc, seq asc)
+    # yields the SAME ordered action list as the full waiting set, and
+    # every omitted gang's verdict is exactly "capacity". Sound for
+    # scan policies whose head-of-line chain stops at the first blocked
+    # waiter and whose non-head actions require a flat-pool fit. A
+    # policy that cannot honor this (drf re-sorts the scan by dominant
+    # share, so an omitted gang could BE the head) leaves it False and
+    # the arbiter falls back to the full scan — counted via
+    # admission_index_fallback_total, never silent.
+    supports_waiting_prune = False
+
     def decide(self, state: PolicyState) -> Decisions:  # pragma: no cover
         raise NotImplementedError
 
@@ -316,7 +347,14 @@ class AdmissionPolicy:
         )
         excluded = set(pending)
         for victim in victims_pool:
-            usage = usage_of(state.admitted, excluded)
+            # Read-only overcommit check: reuse the precomputed vector
+            # when nothing is excluded (the common no-revocation pump);
+            # any exclusion means a live revocation sweep — scan.
+            usage = (
+                state.usage
+                if not excluded and state.usage is not None
+                else usage_of(state.admitted, excluded)
+            )
             if all(usage.get(r, _F0) <= cap[r] for r in cap):
                 break
             decisions.actions.append(
@@ -358,6 +396,13 @@ class PriorityPolicy(AdmissionPolicy):
     exactly the strawman the gavel gate measures against."""
 
     name = "priority"
+    # Scan order is (band desc, seq asc) and stops acting at the first
+    # blocked head; every later no-fit waiter gets verdict "capacity".
+    # A band whose minimum demand exceeds the free pool therefore
+    # contributes at most its first gang (as head or as the blocked
+    # verdict the arbiter self-applies) — the prune is exact without
+    # quotas (quota verdicts would need the pruned gangs scanned).
+    supports_waiting_prune = True
 
     @staticmethod
     def _victim_order(g: GangView):
@@ -379,7 +424,7 @@ class PriorityPolicy(AdmissionPolicy):
         head: Optional[GangView] = None
         head_wait = 0.0
         admitted_now: List[GangView] = list(state.admitted)
-        usage = usage_of(admitted_now)
+        usage = starting_usage(state, admitted_now)
         gen_usage: Dict[str, Dict[str, Fraction]] = (
             gen_usage_of(admitted_now) if state.generations else {}
         )
@@ -526,6 +571,12 @@ class GavelPolicy(AdmissionPolicy):
     lowest-band-first)."""
 
     name = "gavel"
+    # Same (band desc, seq asc) scan and head chain as priority, and
+    # ``fits_somewhere`` REQUIRES a flat-pool fit (a gang that cannot
+    # fit the flat pool can never be admitted on any generation, and
+    # only the head gets swap/priority treatment) — so the band
+    # watermark prune is exact here too, with the same no-quota caveat.
+    supports_waiting_prune = True
 
     @staticmethod
     def _contribution(g: GangView) -> float:
@@ -552,7 +603,7 @@ class GavelPolicy(AdmissionPolicy):
         head: Optional[GangView] = None
         head_wait = 0.0
         admitted_now: List[GangView] = list(state.admitted)
-        usage = usage_of(admitted_now)
+        usage = starting_usage(state, admitted_now)
 
         # Incremental usage caches (the PriorityPolicy discipline — a
         # naive recompute per waiter makes every sync O(admitted x
@@ -778,6 +829,13 @@ class DrfPolicy(AdmissionPolicy):
     (drf arbitrates shares, not heterogeneity)."""
 
     name = "drf"
+    # drf re-sorts the waiting set by weighted dominant share each
+    # round, so an omitted band-tail gang could be the share-ordered
+    # HEAD (is_head drives head_wait/backfill verdicts) — pruning would
+    # change bytes. Declared here so the admissibility index falls back
+    # to the full scan for decide; the capacity-epoch no-op
+    # short-circuit (policy-agnostic) still applies.
+    supports_waiting_prune = False
 
     def _weight(self, state: PolicyState, namespace: str) -> float:
         try:
@@ -814,7 +872,7 @@ class DrfPolicy(AdmissionPolicy):
                                   revocation_order)
         pending_preempt = bool(pending)
         admitted_now: List[GangView] = list(state.admitted)
-        usage = usage_of(admitted_now)
+        usage = starting_usage(state, admitted_now)
         remaining: List[GangView] = list(state.waiting)
         head_wait: Optional[float] = None
         backfilling = False

@@ -177,6 +177,29 @@ class Metrics:
             "rate from one namespace is that tenant queueing on itself, "
             "not on cluster capacity",
         ),
+        "training_operator_admission_pump_skipped_total": (
+            ("reason",),
+            "Admission pump triggers the admissibility index elided "
+            "(core/admission.py, EngineOptions.admission_index): "
+            "reason=no-capacity-delta is the capacity-epoch short-"
+            "circuit (nothing decide-relevant changed since the last "
+            "scan — a provable fixpoint); reason=band-watermark is a "
+            "whole waiting band (or a single new arrival) pruned "
+            "because the free pool cannot cover even its smallest "
+            "demand. A near-zero rate with the index ON means the "
+            "index is pruning too little and pumps are paying the "
+            "full scan anyway",
+        ),
+        "training_operator_admission_index_fallback_total": (
+            ("policy",),
+            "Indexed admission pumps that fell back to a full waiting-"
+            "set scan because the active policy cannot honor the band "
+            "prune (drf's share-resorted passes) or the pool declares "
+            "namespace quotas (quota verdicts need every gang "
+            "scanned). The no-op short-circuit still applies; a "
+            "sustained rate on a policy expected to prune means the "
+            "index is configured but not helping",
+        ),
         "training_operator_watch_cache_events_served_total": (
             ("resource",),
             "Watch deltas APPLIED to this replica's shared watch-cache "
@@ -516,6 +539,20 @@ class Metrics:
         """One admission attempt blocked by the namespace's quota."""
         self._inc_labeled(
             "training_operator_quota_denials_total", namespace,
+        )
+
+    def admission_pump_skipped_inc(self, reason: str) -> None:
+        """One pump trigger (or one whole band within a pump) the
+        admissibility index elided — counted, never silent."""
+        self._inc_labeled(
+            "training_operator_admission_pump_skipped_total", reason,
+        )
+
+    def admission_index_fallback_inc(self, policy: str) -> None:
+        """One indexed pump that ran decide over the FULL waiting set
+        (the policy or a quota'd pool cannot honor the band prune)."""
+        self._inc_labeled(
+            "training_operator_admission_index_fallback_total", policy,
         )
 
     def observe_admission_wait(self, namespace: str, framework: str,

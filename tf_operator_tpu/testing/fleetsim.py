@@ -308,6 +308,13 @@ class Scenario:
     # corpus scenario replays byte-identically (from_dict would reject
     # the fields if they weren't declared; defaults make them no-ops).
     warm_start: bool = False
+    # Incremental admissibility index (EngineOptions.admission_index):
+    # ON, the arbiter's pumps are O(newly-fittable) — provably schedule-
+    # equivalent, so a scenario's digest must NOT change with the flag
+    # (the smoke gate asserts exactly that). Default OFF keeps every
+    # pre-existing corpus scenario on the full-scan path byte-
+    # identically.
+    admission_index: bool = False
     grow_restore_seconds: float = 0.0
     warm_start_restore_seconds: float = 0.0
     elastic_jobs: int = 0
@@ -485,6 +492,8 @@ class FleetSim:
             policy=scenario.policy,
             tenant_weights=scenario.tenant_weights or None,
             seed=scenario.seed,
+            admission_index=scenario.admission_index,
+            capacity_version_fn=self.mem.schedulable_capacity_version,
         )
         self.queue = WorkQueue(clock=self.clock)
         self.expectations = ControllerExpectations(clock=self.clock)
@@ -537,6 +546,7 @@ class FleetSim:
         self._last_completion_t = 0.0
         self._frozen_slices: Dict[str, float] = {}
         self._resident_peak = 0
+        self._resident_bytes_peak = 0
         self._per_tenant_done: Dict[str, int] = {}
         self._end_t = 0.0
         self.report: Optional[dict] = None
@@ -1051,6 +1061,11 @@ class FleetSim:
                 f"[{label}] {v}" for v in violations)
         self._resident_peak = max(
             self._resident_peak, self.watch_cache.resident_objects())
+        # Bytes approximation sampled at the same sweep cadence (an
+        # O(resident set) walk — cheap per epoch, ruinous per sync);
+        # also publishes the watch_cache_resident_bytes gauge.
+        self._resident_bytes_peak = max(
+            self._resident_bytes_peak, self.watch_cache.resident_bytes())
 
     # --------------------------------------------------------- draining
     def _drain_queue(self) -> None:
@@ -1142,11 +1157,27 @@ class FleetSim:
             "autoscaler_decide_seconds_per_call": round(
                 decide_sum / decide_count, 9) if decide_count else None,
             "watch_cache_resident_objects_peak": self._resident_peak,
+            "watch_cache_resident_bytes_peak": self._resident_bytes_peak,
             "decision_log_entries": (
                 len(self.admission.decision_log)
                 + (len(self.autoscaler.decision_log)
                    if self.autoscaler else 0)
             ),
+            # Admissibility-index observability (all zero with the
+            # index OFF): elided pump triggers by reason, plus full-
+            # scan fallbacks for the active policy.
+            "pump_skipped_no_capacity_delta": int(
+                self.metrics.labeled_counter_value(
+                    "training_operator_admission_pump_skipped_total",
+                    "no-capacity-delta")),
+            "pump_skipped_band_watermark": int(
+                self.metrics.labeled_counter_value(
+                    "training_operator_admission_pump_skipped_total",
+                    "band-watermark")),
+            "index_fallback_pumps": int(
+                self.metrics.labeled_counter_value(
+                    "training_operator_admission_index_fallback_total",
+                    self.scenario.policy)),
         }
 
     def digest(self) -> str:
