@@ -435,12 +435,15 @@ def default_dag() -> List[Step]:
         # Recovery tier (docs/design/checkpoint_recovery.md): the
         # fast-recovery plane. recovery-chaos runs the seeded restore-path
         # fault ladder (peer refused / hang / truncated shard / stale
-        # snapshot / died mid-transfer / stale manifest / partial owner —
-        # byte-identical fault-log replay) plus the durability
-        # barrier units: the listener fires only after the async persist
-        # finalizes, a crash in the persist window resumes on the previous
-        # checkpoint, and the autoscaler's fresh-checkpoint gate can never
-        # observe a non-durable step.
+        # snapshot / died mid-transfer / stale manifest / partial owner /
+        # torn delta chain: delta-missing-shard and delta-corrupt-shard
+        # degrading whole-tree to the newest full — byte-identical
+        # fault-log replay) plus the durability barrier units: the
+        # listener fires only after the async persist finalizes, a crash
+        # in the persist window resumes on the previous checkpoint, the
+        # autoscaler's fresh-checkpoint gate can never observe a
+        # non-durable step, and the delta-persist suites (chain bound,
+        # GC, flag-off layout reads, have-list transfer).
         Step("recovery-chaos",
              pytest + ["tests/test_checkpoint_recovery.py",
                        "tests/test_recovery_chaos.py", "-m", "not slow"],
@@ -453,8 +456,12 @@ def default_dag() -> List[Step]:
         # kill->restart->step-resumed wall clock, and the sharded leg:
         # scatter-gather across two strided owners must beat the
         # single-survivor pull (NIC model), its fault scenarios replay
-        # byte-equal, and the warm-start restore does zero storage
-        # reads; margins ratcheted via build/recovery_smoke_last.json.
+        # byte-equal, the warm-start restore does zero storage reads,
+        # and the delta leg: on the partial-update state, delta persist
+        # bytes and the have-list warm pull must each stay <= 50% of
+        # their full-tree counterpart, byte-equal both ways; margins
+        # (incl. delta_persist_fraction / have_list_fraction) ratcheted
+        # via build/recovery_smoke_last.json.
         Step("recovery-smoke",
              [PY, "scripts/measure_control_plane.py", "--mode",
               "recovery", "--smoke"],

@@ -92,6 +92,7 @@ def main(argv=None) -> int:
     shard_srv = None
     if args.checkpoint_dir:
         from tf_operator_tpu.bootstrap.heartbeat import (
+            ENV_DELTA_PERSIST,
             ENV_PEER_RESTORE_ADDRS,
             ENV_SHARD_SERVER,
             ENV_SHARDED_RESTORE,
@@ -106,8 +107,13 @@ def main(argv=None) -> int:
             # training replicas: each slice owns its own checkpoint
             # stream, or two coordinators would race one orbax dir.
             ckpt_dir = os.path.join(ckpt_dir, f"slice-{topo.slice_index}")
+        truthy = ("1", "true", "yes")
+        delta_persist = os.environ.get(ENV_DELTA_PERSIST) in truthy
         ckpt = CheckpointManager(
-            ckpt_dir, sharding=sharding, model_meta=config.geometry()
+            ckpt_dir, sharding=sharding, model_meta=config.geometry(),
+            # Operator contract (bootstrap/heartbeat.py): persists write
+            # only changed shards + a step manifest — bytes O(change).
+            delta_persist=delta_persist,
         )
         # DURABILITY ORDERING: record_checkpoint fires ONLY from the
         # persist-finalized callback, never after save() returns — save()
@@ -120,17 +126,20 @@ def main(argv=None) -> int:
             a for a in os.environ.get(ENV_PEER_RESTORE_ADDRS, "").split(",")
             if a
         ]
-        truthy = ("1", "true", "yes")
         outcome = restore_with_fallback(
             state, ckpt, peers,
             # Operator contracts (bootstrap/heartbeat.py): scatter-gather
             # across survivors, and the elastic-grow zero-storage-read
-            # warm start. Both absent on a dev box.
+            # warm start. Both absent on a dev box. Under delta persists
+            # the restore also advertises this rank's have-list so peers
+            # send only the shards that actually differ.
             sharded=os.environ.get(ENV_SHARDED_RESTORE) in truthy,
             warm_start=os.environ.get(ENV_WARM_START) in truthy,
+            have=delta_persist,
         )
         state = outcome.state
-        record_restore(outcome.path, outcome.cause, outcome.seconds)
+        record_restore(outcome.path, outcome.cause, outcome.seconds,
+                       outcome.bytes_moved)
         if outcome.step is not None:
             print(
                 f"[llama] resumed from step {outcome.step} "

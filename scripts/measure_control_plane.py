@@ -2870,6 +2870,130 @@ def _recovery_warm_start_leg(state, fresh, ckpt_dir, servers, regressions):
     }
 
 
+# Delta-persist leg (EngineOptions.delta_persist): the partial-update
+# bench state flips RECOVERY_DELTA_CHANGED_LAYERS of RECOVERY_LAYERS
+# param shards between two steps — optimizer state and the remaining
+# layers carry forward by reference, the shape of a real step where only
+# a fraction of the tree moved. Both byte gates share one ceiling: a
+# delta persist and a have-list warm pull must each cost <= 50% of their
+# full-tree counterpart on this state, or bytes stopped being O(change).
+RECOVERY_DELTA_CHANGED_LAYERS = 4
+RECOVERY_DELTA_MAX_FRACTION = 0.5
+
+
+def _recovery_delta_update(base):
+    """The step after ``base``: RECOVERY_DELTA_CHANGED_LAYERS params
+    bumped, everything else bit-identical (carried by reference)."""
+    from tf_operator_tpu.train.train_step import TrainState
+
+    params = {}
+    for i in range(RECOVERY_LAYERS):
+        name = f"layer{i}"
+        w = base.params[name]["w"]
+        params[name] = {
+            "w": w + 1.0 if i < RECOVERY_DELTA_CHANGED_LAYERS else w}
+    import jax.numpy as jnp
+
+    return TrainState(
+        step=jnp.asarray(RECOVERY_STEP + 1, jnp.int32),
+        params=params, opt_state=base.opt_state)
+
+
+def _recovery_delta_leg(state, fresh, workdir, regressions):
+    """Leg H: delta persists + have-list peer transfer — recovery bytes
+    proportional to change. Persist side: full-vs-delta bytes written on
+    the partial-update state, with the chain restore byte-equal. Wire
+    side: a warm survivor (holding the PREVIOUS step) advertises its
+    have-list and pulls only the changed shards, byte-equal with the
+    cold full pull."""
+    from tf_operator_tpu.runtime.shard_server import start_shard_server
+    from tf_operator_tpu.train.checkpoint import CheckpointManager
+    from tf_operator_tpu.train.restore import http_fetch, restore_with_fallback
+
+    delta_dir = os.path.join(workdir, "delta-ckpt")
+    changed = _recovery_delta_update(state)
+    mgr = CheckpointManager(delta_dir, delta_persist=True)
+    server = None
+    try:
+        mgr.save(state, force=True)
+        mgr.wait()
+        full_info = dict(mgr.last_persist_info or {})
+        mgr.save(changed, force=True)
+        mgr.wait()
+        delta_info = dict(mgr.last_persist_info or {})
+        if full_info.get("kind") != "full" or \
+                delta_info.get("kind") != "delta":
+            regressions.append(
+                f"delta leg persist kinds were {full_info.get('kind')}/"
+                f"{delta_info.get('kind')}, wanted full/delta")
+        full_bytes = int(full_info.get("bytes_written") or 0)
+        delta_bytes = int(delta_info.get("bytes_written") or 0)
+
+        # The chain restore (delta + referenced base shards) must be
+        # byte-equal to what was saved — a flag-off reader resolves it.
+        reader = CheckpointManager(delta_dir)
+        try:
+            restored, step = reader.restore_latest(fresh)
+        finally:
+            reader.close()
+        if step != RECOVERY_STEP + 1 or not _trees_equal(restored, changed):
+            regressions.append(
+                "delta-chain restore is not byte-equal to the saved state")
+
+        server = start_shard_server(mgr)
+        for _ in range(200):
+            try:
+                status, _, _ = http_fetch(server.address, "/v1/meta", 5.0)
+            except OSError:
+                status = 0
+            if status == 200:
+                break
+            time.sleep(0.01)
+
+        rmgr = CheckpointManager(os.path.join(workdir, "delta-dst"))
+        try:
+            cold = restore_with_fallback(fresh, rmgr, [server.address])
+            warm = restore_with_fallback(
+                state, rmgr, [server.address], have=True)
+        finally:
+            rmgr.close()
+        for label, out in (("cold", cold), ("warm", warm)):
+            if (out.path, out.cause) != ("peer", "ok") or \
+                    out.step != RECOVERY_STEP + 1:
+                regressions.append(
+                    f"delta leg {label} pull landed on {out.path}/"
+                    f"{out.cause}/{out.step}, wanted "
+                    f"peer/ok/{RECOVERY_STEP + 1}")
+            elif not _trees_equal(out.state, changed):
+                regressions.append(
+                    f"delta leg {label}-pulled state differs from the "
+                    "saved state")
+        cold_bytes = int(cold.bytes_moved or 0)
+        warm_bytes = int(warm.bytes_moved or 0)
+        if not cold_bytes:
+            regressions.append("delta leg cold pull moved zero bytes — "
+                               "the comparison is vacuous")
+    finally:
+        if server is not None:
+            server.stop()
+        mgr.close()
+
+    return {
+        "full_persist_bytes": full_bytes,
+        "delta_persist_bytes": delta_bytes,
+        "delta_persist_fraction": round(
+            delta_bytes / max(full_bytes, 1), 4),
+        "delta_shards_written": delta_info.get("shards_written"),
+        "delta_shards_skipped": delta_info.get("shards_skipped"),
+        "delta_chain_depth": delta_info.get("chain_depth"),
+        "cold_pull_bytes": cold_bytes,
+        "warm_pull_bytes": warm_bytes,
+        "have_list_fraction": round(warm_bytes / max(cold_bytes, 1), 4),
+        "changed_layers": RECOVERY_DELTA_CHANGED_LAYERS,
+        "layers": RECOVERY_LAYERS,
+    }
+
+
 def recovery_main(smoke=False) -> int:
     """--mode recovery: the fast-recovery plane head-to-head. Leg A times
     storage-vs-peer restore on one durable checkpoint (peer must beat the
@@ -2881,7 +3005,10 @@ def recovery_main(smoke=False) -> int:
     single-survivor pull on a 2-survivor topology (NIC-modeled, see
     RECOVERY_PEER_NIC_BPS); leg F replays the sharded fault ladder
     (die-mid-transfer / stale-manifest / partial-owner) byte-identically;
-    leg G proves a warm-start grow restores with zero storage reads.
+    leg G proves a warm-start grow restores with zero storage reads;
+    leg H prices the delta plane — persist bytes full-vs-delta on the
+    partial-update state and have-list warm pull vs cold full pull, both
+    byte-equal and both gated at RECOVERY_DELTA_MAX_FRACTION.
     --smoke gates all of it and ratchets the margins via
     build/recovery_smoke_last.json."""
     import shutil
@@ -2929,6 +3056,7 @@ def recovery_main(smoke=False) -> int:
             fresh, ckpt_dir, shard_servers, regressions)
         warm_start = _recovery_warm_start_leg(
             state, fresh, ckpt_dir, shard_servers, regressions)
+        delta = _recovery_delta_leg(state, fresh, workdir, regressions)
     finally:
         server.stop()
         for s in shard_servers:
@@ -2977,6 +3105,29 @@ def recovery_main(smoke=False) -> int:
                 f"sharded restore {sharded['sharded_restore_s']}s "
                 f"regressed >{RECOVERY_REGRESSION}x vs previous run "
                 f"({prev_sharded}s)")
+        # Delta gates: both legs must stay O(change) on the partial-
+        # update state — persist bytes and warm-pull bytes each <= 50%
+        # of their full-tree counterpart — and the (deterministic)
+        # fractions ratchet run-over-run like the latency figures.
+        if delta["delta_persist_bytes"] > (
+                delta["full_persist_bytes"] * RECOVERY_DELTA_MAX_FRACTION):
+            regressions.append(
+                f"delta persist wrote {delta['delta_persist_bytes']}B, "
+                f"above {RECOVERY_DELTA_MAX_FRACTION:.0%} of the full "
+                f"persist ({delta['full_persist_bytes']}B)")
+        if delta["warm_pull_bytes"] > (
+                delta["cold_pull_bytes"] * RECOVERY_DELTA_MAX_FRACTION):
+            regressions.append(
+                f"have-list warm pull moved {delta['warm_pull_bytes']}B, "
+                f"above {RECOVERY_DELTA_MAX_FRACTION:.0%} of the cold "
+                f"full pull ({delta['cold_pull_bytes']}B)")
+        for key in ("delta_persist_fraction", "have_list_fraction"):
+            prev_frac = prev.get(key)
+            if prev_frac and delta[key] > prev_frac * RECOVERY_REGRESSION:
+                regressions.append(
+                    f"{key} {delta[key]} regressed >"
+                    f"{RECOVERY_REGRESSION}x vs previous run "
+                    f"({prev_frac})")
 
     sharded_speedup = round(
         sharded["single_survivor_s"]
@@ -2995,6 +3146,7 @@ def recovery_main(smoke=False) -> int:
         "sharded_speedup": sharded_speedup,
         "sharded_faults": sharded_faults,
         "warm_start": warm_start,
+        "delta": delta,
         "regression": "; ".join(regressions) or None,
     }
     rc = 1 if (smoke and regressions) else 0
@@ -3010,6 +3162,10 @@ def recovery_main(smoke=False) -> int:
             "single_survivor_s": sharded["single_survivor_s"],
             "sharded_speedup": sharded_speedup,
             "warm_start_storage_reads": warm_start["storage_reads"],
+            "delta_persist_fraction": delta["delta_persist_fraction"],
+            "delta_persist_bytes": delta["delta_persist_bytes"],
+            "have_list_fraction": delta["have_list_fraction"],
+            "warm_pull_bytes": delta["warm_pull_bytes"],
         })
     print(json.dumps(out))
     return rc

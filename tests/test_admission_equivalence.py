@@ -224,6 +224,27 @@ class TestPairedTraces:
             quotas={"tenant-a": {"pods": "6"}, "tenant-b": {"pods": "6"}},
         ).run()
 
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_drf_every_tenant_weighted(self, seed):
+        # ROADMAP soak toward the index default flip: uneven weights on
+        # EVERY tenant, so no ask ever hits the unweighted fast path and
+        # each admission re-sorts the full share order.
+        PairedDriver(
+            "drf", seed,
+            weights={"tenant-a": 3.0, "tenant-b": 1.5, "tenant-c": 0.5},
+        ).run()
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_gavel_generations_with_quotas(self, seed):
+        # Generations AND namespace quotas together: gavel's per-
+        # generation placement composes with the quota decline, so both
+        # prune-decline paths are exercised in one trace.
+        PairedDriver(
+            "gavel", seed,
+            generations={"v5lite": {"pods": "8"}, "v6": {"pods": "8"}},
+            quotas={"tenant-a": {"pods": "7"}, "tenant-c": {"pods": "5"}},
+        ).run()
+
 
 class TestFleetSimDigest:
     @pytest.mark.parametrize("policy", ["priority", "gavel", "drf"])

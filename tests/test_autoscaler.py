@@ -14,6 +14,8 @@ decision procedure is a pure function of (state, config) — the 3-run
 byte-equal decision-log regression lives in test_autoscaler_chaos.py.
 """
 
+import dataclasses
+
 import pytest
 
 from tf_operator_tpu.cluster.memory import InMemoryCluster
@@ -717,3 +719,73 @@ class TestAutoscalerInvariants:
             "prev_resize_at": 10.0, "dwell_seconds": 5.0,
         }])
         assert check_autoscaler_invariants(ok) == []
+
+
+class TestWarmStartGrowPacing:
+    """AutoscalerConfig.warm_grow_pacing: under warm_start a grow is a
+    peer delta-fill, not a storage restore, so GROW decisions honor only
+    half of each hysteresis window — while every shrink window stays
+    full (shrinks still cost a disruption regardless of how the replaced
+    ranks come back)."""
+
+    WARM = dataclasses.replace(CFG, warm_start=True)  # pacing 0.5
+
+    def test_grow_dwell_window_halves_under_warm_start(self):
+        j = view()
+        # 18s since the last resize: inside the 30s cold window, past
+        # the 15s warm one.
+        s = state([j], free=6.0, surplus_since=980.0,
+                  last_resizes={j.key: 982.0})
+        assert decide(s, CFG).actions == []
+        actions = decide(s, self.WARM).actions
+        assert len(actions) == 1 and actions[0].direction == "grow"
+        # 10s since: inside BOTH windows — warm pacing relaxes, it does
+        # not abolish hysteresis.
+        s = state([j], free=6.0, surplus_since=980.0,
+                  last_resizes={j.key: 990.0})
+        assert decide(s, self.WARM).actions == []
+
+    def test_grow_cooldown_forgiven_fraction_under_warm_start(self):
+        j = view()
+        # cooldown_until = disruption + 60s; 25s remain cold, but the
+        # warm deadline (until - 60*0.5) already passed.
+        s = state([j], free=6.0, surplus_since=980.0,
+                  cooldowns={j.key: 1025.0})
+        assert decide(s, CFG).actions == []
+        assert len(decide(s, self.WARM).actions) == 1
+        # 40s remain: past the warm deadline too — still blocked.
+        s = state([j], free=6.0, surplus_since=980.0,
+                  cooldowns={j.key: 1040.0})
+        assert decide(s, self.WARM).actions == []
+
+    def test_shrink_windows_stay_full_under_warm_start(self):
+        j = view()
+        # Queue pressure + 18s since last resize: the shrink proposal is
+        # dwell-blocked under the FULL window even with warm_start on.
+        s = state([j], free=0.0, queue_depth=2,
+                  last_resizes={j.key: 982.0})
+        d = decide(s, self.WARM)
+        assert d.proposals == [] and (j.key, "dwell") in d.blocked
+        # Same for a pending shrink in cooldown.
+        s = state([j], free=0.0, queue_depth=2,
+                  pending={j.key: (1, 5)}, cooldowns={j.key: 1025.0})
+        d = decide(s, self.WARM)
+        assert d.actions == [] and (j.key, "cooldown") in d.blocked
+
+    def test_pacing_inert_without_warm_start(self):
+        """Default-off replay safety: warm_grow_pacing is dead config
+        until warm_start flips — decisions are identical field-for-field
+        whatever its value."""
+        j = view()
+        loose = dataclasses.replace(CFG, warm_grow_pacing=0.01)
+        for s in (
+            state([j], free=6.0, surplus_since=980.0,
+                  last_resizes={j.key: 982.0}),
+            state([j], free=6.0, surplus_since=980.0,
+                  cooldowns={j.key: 1025.0}),
+            state([j], free=0.0, queue_depth=2,
+                  last_resizes={j.key: 982.0}),
+        ):
+            a, b = decide(s, CFG), decide(s, loose)
+            assert (a.actions, a.proposals, a.withdrawals, a.blocked) == \
+                (b.actions, b.proposals, b.withdrawals, b.blocked)

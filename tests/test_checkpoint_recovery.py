@@ -724,3 +724,288 @@ class TestRecoveryMetrics:
         m.clear_checkpoint_last_durable_step("default", "jax", "llama")
         assert m.checkpoint_last_durable_step_value(
             "default", "jax", "llama") is None
+
+
+# ------------------------------------------------------------ delta persists
+class TestDeltaPersist:
+    """EngineOptions.delta_persist workload side (train/checkpoint.py):
+    persist bytes O(changed shards), bounded manifest chains, GC, the
+    unchanged durability contract, and flag-off replay safety."""
+
+    def test_second_persist_is_delta_with_skips_and_fewer_bytes(
+            self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "d"), delta_persist=True)
+        assert mgr.save(make_state(step=1, scale=1.0), force=True)
+        mgr.wait()
+        first = dict(mgr.last_persist_info)
+        assert first["kind"] == "full" and first["shards_skipped"] == 0
+        # Step 2 touches params only; opt_state (and nothing else big)
+        # carries forward by reference.
+        changed = TrainState(
+            step=jnp.asarray(2, jnp.int32),
+            params={"w": jnp.full((4, 4), 5.0, jnp.float32)},
+            opt_state={"m": jnp.full((4, 4), 2.0, jnp.float32)},
+        )
+        assert mgr.save(changed, force=True)
+        mgr.wait()
+        second = dict(mgr.last_persist_info)
+        assert second["kind"] == "delta"
+        assert second["shards_skipped"] >= 1
+        assert second["bytes_written"] < first["bytes_written"]
+        # The restored tree is byte-equal to what was saved — carried
+        # shards resolve through the manifest reference.
+        restored, step = mgr.restore_latest(make_state(step=0, scale=0.0))
+        assert step == 2 and mgr.last_delta_degradation is None
+        assert leaves_equal(restored, changed)
+        mgr.close()
+
+    def test_chain_bound_forces_periodic_full(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "d"), delta_persist=True,
+                                delta_full_every=3)
+        kinds, depths = [], []
+        for step in range(1, 8):
+            assert mgr.save(make_state(step=step, scale=float(step)),
+                            force=True)
+            mgr.wait()
+            kinds.append(mgr.last_persist_info["kind"])
+            depths.append(mgr.last_persist_info["chain_depth"])
+        assert kinds == ["full", "delta", "delta",
+                         "full", "delta", "delta", "full"]
+        assert max(depths) <= 2  # bounded by delta_full_every - 1
+        mgr.close()
+
+    def test_flag_off_restart_restores_delta_layout(self, tmp_path):
+        """Restore keys on the LAYOUT's presence, not the flag: a restart
+        that lost --enable-delta-persist must still resume from what the
+        flag-on predecessor persisted (no torn downgrade)."""
+        writer = CheckpointManager(str(tmp_path / "d"), delta_persist=True)
+        writer.save(make_state(step=1, scale=1.0), force=True)
+        writer.save(make_state(step=2, scale=2.0), force=True)
+        writer.wait()
+        writer.close()
+        reader = CheckpointManager(str(tmp_path / "d"))  # flag OFF
+        assert reader.latest_step() == 2
+        restored, step = reader.restore_latest(make_state(step=0, scale=0.0))
+        assert step == 2
+        assert leaves_equal(restored, make_state(step=2, scale=2.0))
+        reader.close()
+
+    def test_default_off_writes_no_delta_layout(self, tmp_path):
+        """Flag-off replay safety: a default manager never creates the
+        delta/ layout, so every pre-delta seeded tier sees byte-identical
+        storage."""
+        import os
+
+        mgr = CheckpointManager(str(tmp_path / "plain"))
+        mgr.save(make_state(step=1), force=True)
+        mgr.wait()
+        assert not os.path.isdir(str(tmp_path / "plain" / "delta"))
+        assert mgr.persisted_shard_names() == ()
+        assert mgr.delta_chain_depth() is None
+        mgr.close()
+
+    def test_gc_keeps_newest_full_and_prunes_unreferenced_payloads(
+            self, tmp_path):
+        import json
+        import os
+
+        mgr = CheckpointManager(str(tmp_path / "d"), delta_persist=True,
+                                delta_full_every=10, max_to_keep=2)
+        for step in range(1, 6):
+            mgr.save(make_state(step=step, scale=float(step)), force=True)
+        mgr.wait()
+        delta_dir = str(tmp_path / "d" / "delta")
+        manifests = sorted(
+            f for f in os.listdir(delta_dir) if f.startswith("manifest-"))
+        # Newest 2 retained, plus the step-1 full (degradation target).
+        assert manifests == ["manifest-1.json", "manifest-4.json",
+                             "manifest-5.json"]
+        referenced = set()
+        for name in manifests:
+            with open(os.path.join(delta_dir, name)) as f:
+                for entry in json.load(f)["shards"].values():
+                    referenced.add(entry["checksum"] + ".npy")
+        on_disk = set(os.listdir(os.path.join(delta_dir, "shards")))
+        assert on_disk == referenced  # nothing unreferenced survives GC
+        mgr.close()
+
+    def test_durability_listener_fires_after_manifest_durable(
+            self, tmp_path):
+        """PR 16 contract unchanged under delta persists: when the
+        listener fires, the step's manifest is already renamed into
+        place — record_checkpoint can never publish a torn step."""
+        import os
+
+        seen = []
+        mgr = CheckpointManager(str(tmp_path / "d"), delta_persist=True)
+        mgr.add_durability_listener(lambda step: seen.append(
+            (step, os.path.exists(
+                str(tmp_path / "d" / "delta" / f"manifest-{step}.json")))))
+        mgr.save(make_state(step=3, scale=1.0), force=True)
+        mgr.wait()
+        assert seen == [(3, True)]
+        assert mgr.last_durable_step() == 3
+        mgr.close()
+
+    def test_dedup_skips_already_persisted_delta_step(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "d"), delta_persist=True)
+        assert mgr.save(make_state(step=4), force=True)
+        mgr.wait()
+        assert not mgr.save(make_state(step=4), force=True)
+        mgr.close()
+
+
+# --------------------------------------------------------- have-list wire
+class TestHaveListTransfer:
+    """The peer rung's delta: the restoring rank advertises what it holds
+    warm and only the difference crosses the wire — with byte-equal
+    results and mixed-version safety."""
+
+    def _warm_local(self):
+        """A local tree matching the served step-5 snapshot except for
+        opt_state — the elastic-grow survivor shape."""
+        return TrainState(
+            step=jnp.asarray(5, jnp.int32),
+            params={"w": jnp.full((4, 4), 3.0, jnp.float32)},
+            opt_state={"m": jnp.zeros((4, 4), jnp.float32)},
+        )
+
+    def test_warm_restore_moves_fewer_bytes_byte_equal(self, durable_ckpt):
+        _mgr, server, tmp_path = durable_ckpt
+        restore_mgr = CheckpointManager(str(tmp_path / "dst"))
+        cold = restore_with_fallback(
+            make_state(step=0, scale=0.0), restore_mgr, [server.address])
+        warm = restore_with_fallback(
+            self._warm_local(), restore_mgr, [server.address], have=True)
+        assert (warm.path, warm.cause, warm.step) == ("peer", "ok", 5)
+        assert cold.bytes_moved is not None and warm.bytes_moved is not None
+        assert warm.bytes_moved < cold.bytes_moved
+        assert leaves_equal(warm.state, cold.state)
+        assert leaves_equal(warm.state, make_state(step=5, scale=3.0))
+        restore_mgr.close()
+
+    def test_older_server_ignoring_have_still_byte_equal(self, durable_ckpt):
+        """Mixed-version fleet: a peer that predates the have parameter
+        serves the full bundle; the client uses only the frames it needs
+        and the result is unchanged (just more bytes on the wire)."""
+        _mgr, server, tmp_path = durable_ckpt
+
+        def older(peer, path, timeout):
+            if "&have=" in path:
+                path = path.split("&have=")[0]
+            return http_fetch(peer, path, timeout)
+
+        restore_mgr = CheckpointManager(str(tmp_path / "dst"))
+        out = restore_with_fallback(
+            self._warm_local(), restore_mgr, [server.address],
+            have=True, fetcher=older)
+        assert (out.path, out.cause, out.step) == ("peer", "ok", 5)
+        assert leaves_equal(out.state, make_state(step=5, scale=3.0))
+        restore_mgr.close()
+
+    def test_bundle_endpoint_filters_server_side(self, durable_ckpt):
+        """/v1/bundle?have= omits matching frames at the SERVER, so the
+        saved bytes never cross the wire at all."""
+        from urllib.parse import quote as q
+
+        mgr, server, _ = durable_ckpt
+        snap = mgr.host_snapshot()
+        from tf_operator_tpu.runtime.shard_server import (
+            encode_shard, flatten_tree,
+        )
+        flat = flatten_tree(snap.tree)
+        _, _, full = http_fetch(server.address, "/v1/bundle?step=5", 5.0)
+        name = ".params['w']"
+        checksum = shard_checksum(encode_shard(flat[name]))
+        _, _, filtered = http_fetch(
+            server.address,
+            f"/v1/bundle?step=5&have={q(name, safe='')}:{checksum}", 5.0)
+        assert len(filtered) < len(full)
+        assert name not in parse_bundle(filtered)
+        assert sorted(parse_bundle(filtered)) == [
+            n for n in sorted(flat) if n != name]
+        # A checksum that does NOT match is not filtered (stale local
+        # copy must still be replaced).
+        _, _, unfiltered = http_fetch(
+            server.address,
+            f"/v1/bundle?step=5&have={q(name, safe='')}:deadbeef", 5.0)
+        assert sorted(parse_bundle(unfiltered)) == sorted(flat)
+
+    def test_sharded_have_prunes_to_local_source(self, strided_ckpt):
+        """Scatter-gather + have-list: matched shards never enter the
+        plan — attributed to source "local" with zero wire bytes."""
+        mgr, servers, tmp_path = strided_ckpt
+        restore_mgr = CheckpointManager(str(tmp_path / "dst"))
+        addrs = [s.address for s in servers]
+        cold = restore_with_fallback(
+            make_wide_state(step=0, scale=0.0), restore_mgr, addrs,
+            sharded=True)
+        # Warm local: params already match the served step-5 snapshot,
+        # opt_state is stale.
+        warm_local = TrainState(
+            step=jnp.asarray(5, jnp.int32),
+            params={f"l{i}": {"w": jnp.full((4, 4), 3.0 + i, jnp.float32)}
+                    for i in range(4)},
+            opt_state={f"l{i}": {"m": jnp.zeros((4, 4), jnp.float32)}
+                       for i in range(4)},
+        )
+        warm = restore_with_fallback(
+            warm_local, restore_mgr, addrs, sharded=True, have=True)
+        assert (warm.path, warm.cause, warm.step) == ("peer-sharded", "ok", 5)
+        assert warm.sources.get("local", 0) == 5  # 4 params + step
+        assert warm.bytes_moved < cold.bytes_moved
+        assert leaves_equal(warm.state, cold.state)
+        assert leaves_equal(warm.state, make_wide_state(step=5, scale=3.0))
+        restore_mgr.close()
+
+    def test_have_list_helper_matches_server_checksums(self, durable_ckpt):
+        """have_list() hashes with the exact encode the server uses, so a
+        match PROVES local bytes equal peer bytes."""
+        from tf_operator_tpu.train.restore import have_list
+
+        mgr, server, _ = durable_ckpt
+        local = have_list(make_state(step=5, scale=3.0))
+        status, _, body = http_fetch(server.address, "/v1/meta", 5.0)
+        assert status == 200
+        meta = json.loads(body)
+        assert local == {
+            name: entry["checksum"]
+            for name, entry in meta["shards"].items()
+        }
+
+
+# ------------------------------------------------- slice-derived ownership
+class TestSliceDerivedOwnership:
+    def test_owned_derives_from_persisted_delta_layout(self, tmp_path):
+        """ROADMAP rung: with per-slice delta layouts, /v1/manifest's
+        owned set is what the slice PHYSICALLY persisted — not a name
+        stride. Striding stays the fallback without a layout."""
+        mgr = CheckpointManager(str(tmp_path / "slice0"), delta_persist=True)
+        server = start_shard_server(mgr, slice_index=0, num_slices=2)
+        try:
+            mgr.save(make_state(step=5, scale=3.0), force=True)
+            mgr.wait()
+            status, _, body = http_fetch(server.address, "/v1/manifest", 5.0)
+            assert status == 200
+            manifest = json.loads(body)
+            # The delta layout holds every shard this stream persisted, so
+            # the derived owned set is the full name set — physically held
+            # beats the stride hint.
+            assert manifest["owned"] == sorted(manifest["shards"])
+            assert set(manifest["owned"]) == set(mgr.persisted_shard_names())
+        finally:
+            server.stop()
+            mgr.close()
+
+    def test_without_delta_layout_striding_is_unchanged(self, strided_ckpt):
+        """No delta layout → the historical stride, byte-identical (the
+        sharded bench legs and seeded tiers replay untouched)."""
+        _mgr, servers, _ = strided_ckpt
+        owned = []
+        for server in servers:
+            _, _, body = http_fetch(server.address, "/v1/manifest", 5.0)
+            manifest = json.loads(body)
+            owned.append(manifest["owned"])
+        names = sorted(json.loads(body)["shards"])
+        assert owned[0] == partition_shard_names(names, 0, 2)
+        assert owned[1] == partition_shard_names(names, 1, 2)

@@ -300,7 +300,7 @@ def pod_env(pod):
     return {e.name: e.value for e in containers[0].env}
 
 
-def run_operator_recovery(seed, peer_restore=True):
+def run_operator_recovery(seed, peer_restore=True, delta_persist=False):
     """One seeded run: 2x2 gang, survivors advertise shard servers on the
     heartbeat leases, slice 1 preempted; returns what the assertions need."""
     slices, hosts = 2, 2
@@ -311,7 +311,8 @@ def run_operator_recovery(seed, peer_restore=True):
     tracer = Tracer()
     controller = JAXController(
         chaos, metrics=metrics, tracer=tracer,
-        options=EngineOptions(peer_restore=peer_restore))
+        options=EngineOptions(peer_restore=peer_restore,
+                              delta_persist=delta_persist))
     inner.create_job(multislice_manifest(slices, hosts))
     state = {"preempted": False, "reported": False, "finished": False}
     survivors = {}
@@ -634,3 +635,105 @@ class TestDeadPeerPruning:
         assert pruned == sorted(addr[n] for n in pods[1:])
         # Without a deadline the filter is inert (the legacy behavior).
         assert engine._peer_restore_addrs(job, "") == sorted(addr.values())
+
+
+# ------------------------------------------------------- torn delta chains
+@pytest.fixture()
+def delta_checkpoint(tmp_path):
+    """A delta store whose NEWEST manifest is a delta: step-1 full, then a
+    step-2 delta that changes params but carries opt_state by reference —
+    the layout a torn chain degrades within."""
+    mgr = CheckpointManager(str(tmp_path / "src"), delta_persist=True)
+    mgr.save(make_state(step=1, scale=1.0), force=True)
+    mgr.save(TrainState(
+        step=jnp.asarray(2, jnp.int32),
+        params={"w": jnp.full((4, 4), 9.0, jnp.float32)},
+        opt_state={"m": jnp.full((4, 4), 2.0, jnp.float32)},
+    ), force=True)
+    mgr.wait()
+    yield mgr
+    mgr.close()
+
+
+def run_delta_ladder(mgr, faults):
+    """Storage-rung restore (no peers) under a seeded injector — the
+    delta-shard consults in checkpoint._resolve_delta are the only fault
+    points in play."""
+    chaos = ChaosCluster(InMemoryCluster(), ChaosSpec(
+        seed=11, restore_faults=tuple(faults)))
+    out = restore_with_fallback(
+        make_state(step=0, scale=0.0), mgr, [],
+        fault_injector=chaos.restore_fault_injector(),
+        sleep=lambda _s: None)
+    return out, list(chaos.fault_log)
+
+
+class TestSeededDeltaChain:
+    """Torn-chain storage faults: a broken or corrupted delta payload
+    degrades the WHOLE tree to the newest full manifest with a named
+    cause — never a partial mix — and every scenario replays its fault
+    log byte-identically from the spec alone."""
+
+    def test_clean_chain_resolves_newest_delta_step(self, delta_checkpoint):
+        out, log = run_delta_ladder(delta_checkpoint, [])
+        assert (out.path, out.cause, out.step) == ("storage", "ok", 2)
+        assert float(np.asarray(out.state.params["w"])[0, 0]) == 9.0
+        assert log == []
+
+    def test_missing_shard_degrades_whole_tree_to_full(
+            self, delta_checkpoint):
+        out, log = run_delta_ladder(delta_checkpoint, [ScheduledRestoreFault(
+            kind="delta-missing-shard", op="delta-shard", at_call=1,
+            count=1)])
+        assert (out.path, out.cause, out.step) == \
+            ("storage", "delta-chain-broken", 1)
+        # WHOLE tree from the step-1 full — params did not leak in from
+        # the torn step-2 delta.
+        assert float(np.asarray(out.state.params["w"])[0, 0]) == 1.0
+        assert float(np.asarray(out.state.opt_state["m"])[0, 0]) == 2.0
+        assert log == ["restore:delta-shard#1:delta-missing-shard:peer0"]
+
+    def test_corrupt_shard_degrades_with_checksum_cause(
+            self, delta_checkpoint):
+        out, log = run_delta_ladder(delta_checkpoint, [ScheduledRestoreFault(
+            kind="delta-corrupt-shard", op="delta-shard", at_call=1,
+            count=1)])
+        assert (out.path, out.cause, out.step) == \
+            ("storage", "delta-checksum-mismatch", 1)
+        assert float(np.asarray(out.state.params["w"])[0, 0]) == 1.0
+        assert log == ["restore:delta-shard#1:delta-corrupt-shard:peer0"]
+
+    def test_torn_chain_replays_fault_log_byte_identically(
+            self, delta_checkpoint):
+        faults = [ScheduledRestoreFault(
+            kind="delta-corrupt-shard", op="delta-shard", at_call=1,
+            count=1)]
+        first = run_delta_ladder(delta_checkpoint, faults)
+        second = run_delta_ladder(delta_checkpoint, faults)
+        assert first[1] == second[1]
+        assert (first[0].path, first[0].cause, first[0].step) == \
+            (second[0].path, second[0].cause, second[0].step)
+
+    def test_delta_fault_inert_without_delta_layout(self, served_checkpoint):
+        """Replay safety for the pre-delta seeded tiers: without a delta
+        layout the delta-shard consult point is never reached, so a
+        scheduled delta fault fires nothing and the log stays empty."""
+        out, log = run_ladder(served_checkpoint, [ScheduledRestoreFault(
+            kind="delta-missing-shard", op="delta-shard", at_call=1,
+            count=999)])
+        assert (out.path, out.cause, out.step) == ("peer", "ok", STEP)
+        assert log == []
+
+    def test_delta_persist_env_capability_gated(self):
+        """EngineOptions.delta_persist injects TPU_DELTA_PERSIST=1 into
+        every replica pod; default-off injects nothing (the PR 1-19
+        seeded tiers replay untouched)."""
+        on = run_operator_recovery(seed=23, delta_persist=True)
+        assert on["converged"]
+        for env in on["all_env"]:
+            assert env[hb_bootstrap.ENV_DELTA_PERSIST] == "1"
+        off = run_operator_recovery(seed=23)
+        assert off["converged"]
+        for env in off["all_env"]:
+            assert hb_bootstrap.ENV_DELTA_PERSIST not in env
+        assert on["fault_log"] == off["fault_log"]

@@ -62,6 +62,15 @@ ENV_PEER_RESTORE_ADDRS = "TPU_PEER_RESTORE_ADDRS"
 #                                only while the grow is settling.
 ENV_SHARDED_RESTORE = "TPU_SHARDED_RESTORE"
 ENV_WARM_START = "TPU_WARM_START"
+# Delta-persist plane (EngineOptions.delta_persist; absent unless the
+# operator enables it):
+# - TPU_DELTA_PERSIST=1          the workload's CheckpointManager should
+#                                run delta persists (changed shards + a
+#                                step manifest, train/checkpoint.py) and
+#                                advertise its have-list on peer restores
+#                                (train/restore.py have=True) so persist
+#                                and recovery bytes are O(changed shards).
+ENV_DELTA_PERSIST = "TPU_DELTA_PERSIST"
 
 
 def heartbeat_interval_seconds(progress_deadline_seconds: int) -> float:

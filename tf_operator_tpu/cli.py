@@ -144,6 +144,12 @@ class OperatorOptions:
     # TPU_WARM_START=1 so their restore pulls live peer snapshots with
     # zero storage reads. Requires --enable-peer-restore.
     enable_warm_start: bool = False
+    # Delta checkpoint persists: heartbeat-enabled replicas get
+    # TPU_DELTA_PERSIST=1 so their CheckpointManager writes only changed
+    # shards + a step manifest, and peer restores advertise a have-list —
+    # persist and recovery bytes O(changed shards). Off by default for
+    # seeded-replay parity (no delta/ layout is ever written).
+    enable_delta_persist: bool = False
     # Capacity-aware gang admission (core/admission.py,
     # docs/design/gang_admission.md). Off (the default) = first-come,
     # capacity-blind admission exactly as before — every PR 1-8 seeded
@@ -438,6 +444,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                              "grow get TPU_WARM_START=1 and restore from "
                              "live peer snapshots with zero storage "
                              "reads.")
+    parser.add_argument("--enable-delta-persist", action="store_true",
+                        help="Delta checkpoint persists: workloads get "
+                             "TPU_DELTA_PERSIST=1 so persists write only "
+                             "changed shards + a step manifest, and peer "
+                             "restores advertise a have-list — recovery "
+                             "bytes proportional to change.")
     parser.add_argument("--status-flush-interval", type=float, default=1.0,
                         help="Per-job floor (seconds) between coalesced "
                         "status flushes; replica-count churn inside the "
@@ -488,6 +500,7 @@ def options_from_args(args: argparse.Namespace) -> OperatorOptions:
         enable_peer_restore=args.enable_peer_restore,
         enable_sharded_restore=args.enable_sharded_restore,
         enable_warm_start=args.enable_warm_start,
+        enable_delta_persist=args.enable_delta_persist,
         enable_gang_admission=args.enable_gang_admission,
         capacity=args.capacity,
         namespace_quotas=list(args.namespace_quota),
@@ -773,6 +786,7 @@ class OperatorManager:
             peer_restore=self.options.enable_peer_restore,
             sharded_restore=self.options.enable_sharded_restore,
             warm_start=self.options.enable_warm_start,
+            delta_persist=self.options.enable_delta_persist,
             admission_index=self.options.enable_admission_index,
         )
         # ONE gang-admission arbiter shared by every framework controller
@@ -855,6 +869,10 @@ class OperatorManager:
                         self.options.autoscaler_efficiency_floor
                     ),
                     seed=self.options.autoscaler_seed,
+                    # Warm-start grows cost a peer delta-fill, not a
+                    # storage restore: attribute them in the ledger and
+                    # pace grow-side hysteresis faster (warm_grow_pacing).
+                    warm_start=self.options.enable_warm_start,
                 ),
                 metrics=self.metrics,
             )

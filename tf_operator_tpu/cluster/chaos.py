@@ -273,18 +273,26 @@ class ScheduledRestoreFault:
     the peer's unfetched shards), ``stale-manifest`` (the manifest analog
     of stale-meta — one step behind storage), ``partial-owner`` (the
     manifest claims only the front half of its owned stride, orphaning
-    the rest for the planner's all-peers fallback). ``op`` scopes the
-    fault to the client's ``meta`` / ``manifest`` probes, ``shard``
-    fetch, or the post-fetch ``shard-body`` / ``meta-body`` /
-    ``manifest-body`` mutation points; ``peer`` targets one peer INDEX in
-    the client's discovery order (indices, not addresses — ephemeral
-    ports would break byte-equal replay). ``at_call``/``count`` window
-    the fault over the Nth..N+count-1th matching consults, so a fault can
-    refuse one attempt and let the retry through, or outlive the retry
-    budget."""
+    the rest for the planner's all-peers fallback), ``delta-missing-shard``
+    (a delta-manifest shard payload is absent from the content-addressed
+    store — the STORAGE rung's torn-chain fault; the whole tree degrades
+    to the newest full step with cause ``delta-chain-broken``),
+    ``delta-corrupt-shard`` (a delta payload truncated to half — fails
+    sha256 against the manifest, cause ``delta-checksum-mismatch``).
+    ``op`` scopes the fault to the client's ``meta`` / ``manifest``
+    probes, ``shard`` fetch, the post-fetch ``shard-body`` /
+    ``meta-body`` / ``manifest-body`` mutation points, or the storage
+    rung's ``delta-shard`` manifest resolution (consulted with peer
+    index 0 — storage has no discovery order); ``peer`` targets one peer
+    INDEX in the client's discovery order (indices, not addresses —
+    ephemeral ports would break byte-equal replay). ``at_call``/``count``
+    window the fault over the Nth..N+count-1th matching consults, so a
+    fault can refuse one attempt and let the retry through, or outlive
+    the retry budget."""
 
     kind: str
-    # meta | manifest | shard | meta-body | manifest-body | shard-body | *
+    # meta | manifest | shard | meta-body | manifest-body | shard-body |
+    # delta-shard | *
     op: str = "*"
     peer: Optional[int] = None    # discovery-order index; None = any peer
     at_call: int = 1              # 1-based index of the first faulted consult

@@ -389,8 +389,11 @@ class FrameworkController(FrameworkHooks):
         )
 
     def _record_restore(self, job: JobObject, path: str, cause: str,
-                        seconds: float) -> None:
+                        seconds: float,
+                        bytes_moved: "int | None" = None) -> None:
         self.metrics.observe_restore(path, cause, seconds)
+        if bytes_moved is not None:
+            self.metrics.observe_restore_bytes(path, bytes_moved)
 
     def _record_force_delete(self, job: JobObject, cause: str) -> None:
         self.metrics.force_delete_inc(job.namespace, self.kind, cause)

@@ -214,11 +214,17 @@ class AutoscalerConfig:
     # grow contract): grows are attributed "warm-start" in the resize
     # ledger and decision log — the engine injects TPU_WARM_START=1 into
     # the recreated ranks, so the grow never waits on a storage
-    # round-trip. Attribution only: the decide() function is unchanged
-    # (growing is already gated on surplus + efficiency, never on a
-    # fresh checkpoint — that gate is shrink-side). Default OFF keeps
-    # every seeded ledger/decision-log byte-identical.
+    # round-trip. With the flag ON decide() also paces grows faster
+    # (warm_grow_pacing below); shrink-side gates are untouched. Default
+    # OFF keeps every seeded ledger/decision-log byte-identical.
     warm_start: bool = False
+    # Grow-side pacing relaxation under warm_start: dwell and cooldown
+    # windows shrink to this fraction of their configured length for
+    # GROW decisions only. The hysteresis knobs were sized for grows
+    # that cost a storage restore; a warm grow costs a peer fill of the
+    # survivors' deltas, so holding the full windows just leaves surplus
+    # idle. Shrinks (the disruptive direction) keep the full windows.
+    warm_grow_pacing: float = 0.5
 
 
 #: The blocked-verdict vocabulary of the SHRINK path — the only causes
@@ -257,6 +263,26 @@ def decide(state: AutoscalerState, config: AutoscalerConfig) -> Decisions:
     def in_dwell(job: ElasticJobView) -> bool:
         last = state.last_resize_at.get(job.key)
         return last is not None and (now - last) < config.dwell_seconds
+
+    # Warm-start grow pacing: a warm grow costs a peer delta-fill, not a
+    # storage restore, so GROW decisions honor only warm_grow_pacing of
+    # each hysteresis window. cooldown_until was written as
+    # (disruption time + cooldown_seconds); subtracting the forgiven
+    # fraction recovers the shortened deadline without new state.
+    def grow_in_cooldown(job: ElasticJobView) -> bool:
+        until = state.cooldown_until.get(job.key, 0.0)
+        if config.warm_start:
+            until -= config.cooldown_seconds * (1.0 - config.warm_grow_pacing)
+        return now < until
+
+    def grow_in_dwell(job: ElasticJobView) -> bool:
+        last = state.last_resize_at.get(job.key)
+        if last is None:
+            return False
+        window = config.dwell_seconds
+        if config.warm_start:
+            window *= config.warm_grow_pacing
+        return (now - last) < window
 
     # ---- shrink side: service pending proposals first -----------------
     # A proposal whose job left the eligible set (preempted/unadmitted,
@@ -362,7 +388,7 @@ def decide(state: AutoscalerState, config: AutoscalerConfig) -> Decisions:
         # watermark exists to prevent.
         if delta <= 0 or delta > state.free_pods - config.watermark_pods:
             continue
-        if in_cooldown(job) or in_dwell(job):
+        if grow_in_cooldown(job) or grow_in_dwell(job):
             continue
         baseline = state.grow_baselines.get(job.key)
         if baseline is not None:
@@ -799,6 +825,17 @@ class GangAutoscaler:
             }
             if warm:
                 ledger_entry["warm_start"] = True
+                # The hysteresis audit (testing/invariants.py) checks
+                # each entry against the windows recorded IN it, so a
+                # warm grow must record the paced windows it was
+                # actually subject to — the raw config values would
+                # flag every legitimately-early warm grow.
+                pacing = self.config.warm_grow_pacing
+                ledger_entry["dwell_seconds"] = (
+                    self.config.dwell_seconds * pacing)
+                ledger_entry["cooldown_until"] = (
+                    ledger_entry["cooldown_until"]
+                    - self.config.cooldown_seconds * (1.0 - pacing))
             self.resize_ledger.append(ledger_entry)
             self.metrics.autoscaler_resize_inc(
                 resize.direction, resize.reason

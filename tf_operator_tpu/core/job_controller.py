@@ -483,6 +483,17 @@ class EngineOptions:
     # the ordinary ladder — warm start is an optimization contract, never
     # a correctness gate. Default OFF.
     warm_start: bool = False
+    # Delta checkpoint persists (--enable-delta-persist, requires
+    # peer_restore to matter but is independent): heartbeat-enabled
+    # replicas get TPU_DELTA_PERSIST=1 so the workload's
+    # CheckpointManager writes only changed shards plus a step manifest
+    # (train/checkpoint.py delta persists) and advertises its have-list
+    # on peer restores (train/restore.py have=True) — persist and
+    # recovery bytes become O(changed shards). Pure workload-side
+    # contract: the controller only injects the env var. Default OFF: no
+    # env deltas, no delta/ layout written, every PR 1-19 seeded tier
+    # replays byte-identically.
+    delta_persist: bool = False
     # Incremental admissibility index (--enable-admission-index): the
     # shared AdmissionController maintains per-band minimum-demand
     # watermarks, a capacity epoch / dirty bit, and incremental
@@ -548,7 +559,8 @@ class JobController:
         on_heartbeat_age: Optional[Callable[[JobObject, float], None]] = None,
         on_workload_throughput: Optional[Callable[[JobObject, float], None]] = None,
         on_durable_checkpoint: Optional[Callable[[JobObject, Optional[int]], None]] = None,
-        on_restore_observed: Optional[Callable[[JobObject, str, str, float], None]] = None,
+        on_restore_observed: Optional[
+            Callable[[JobObject, str, str, float, Optional[int]], None]] = None,
         on_force_delete: Optional[Callable[[JobObject, str], None]] = None,
         on_fanout_batch: Optional[Callable[[str, int], None]] = None,
         on_fanout_abort: Optional[Callable[[str], None]] = None,
@@ -601,12 +613,15 @@ class JobController:
         self.on_durable_checkpoint = on_durable_checkpoint or (
             lambda job, step: None
         )
-        # (job, path, cause, seconds) — fires once per NEW restore-outcome
-        # lease rider value observed on any replica (record_restore):
-        # which restore-ladder leg won and why. Exported as
-        # training_restore_total/seconds{path,cause}.
+        # (job, path, cause, seconds, bytes or None) — fires once per NEW
+        # restore-outcome lease rider value observed on any replica
+        # (record_restore): which restore-ladder leg won, why, and the
+        # wire bytes it moved when the peer path metered them (the
+        # optional 4th rider field). Exported as
+        # training_restore_total/seconds{path,cause} and
+        # training_restore_bytes_total{source}.
         self.on_restore_observed = on_restore_observed or (
-            lambda job, path, cause, seconds: None
+            lambda job, path, cause, seconds, bytes_moved=None: None
         )
         # (job, cause) — fires once per grace-period-0 escalation of a
         # stuck-Terminating pod; the controller exports it as the
@@ -1803,14 +1818,24 @@ class JobController:
                         )
                         if restore_raw and restore_raw != state.restore_raw:
                             state.restore_raw = restore_raw
+                            # path:cause:seconds with an optional 4th
+                            # bytes field (older workloads publish 3
+                            # fields; both parse — mixed-version fleets).
                             parts = restore_raw.split(":")
-                            if len(parts) == 3:
+                            if len(parts) in (3, 4):
                                 try:
                                     seconds = float(parts[2])
                                 except (TypeError, ValueError):
                                     seconds = 0.0
+                                bytes_moved = None
+                                if len(parts) == 4:
+                                    try:
+                                        bytes_moved = int(parts[3])
+                                    except (TypeError, ValueError):
+                                        bytes_moved = None
                                 self.on_restore_observed(
-                                    job, parts[0], parts[1], seconds
+                                    job, parts[0], parts[1], seconds,
+                                    bytes_moved,
                                 )
                 if not state.baselined:
                     # First read for this pod incarnation: record the
@@ -2589,6 +2614,12 @@ class JobController:
                 template.metadata.name, job.namespace,
                 run_policy.progress_deadline_seconds,
             )
+            if self.options.delta_persist:
+                # Bytes-proportional-to-change persists: the workload's
+                # CheckpointManager writes changed shards + a manifest
+                # and advertises a have-list on peer restores. Workload-
+                # side contract only — the controller just flips the env.
+                hb_env[hb_bootstrap.ENV_DELTA_PERSIST] = "1"
             if self.options.peer_restore:
                 # Fast-recovery plane: tell the workload to serve its host
                 # snapshot (TPU_SHARD_SERVER) and hand this — possibly

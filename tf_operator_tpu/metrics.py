@@ -259,6 +259,36 @@ class Metrics:
             "sustained storage share with peer restore enabled means the "
             "fast path is not winning — check the degradation causes",
         ),
+        "training_checkpoint_persist_bytes_total": (
+            ("kind",),
+            "Bytes the background persist worker actually wrote to the "
+            "checkpoint store, by persist kind (full = every shard "
+            "rewritten; delta = only changed shards + the step manifest, "
+            "EngineOptions.delta_persist). delta/full per-persist ratio "
+            "is the bytes-proportional-to-change number; a delta rate "
+            "near the full rate means nearly every shard changes every "
+            "step and delta persists are pure overhead (delta-ineffective "
+            "alert, docs/monitoring/README.md)",
+        ),
+        "training_checkpoint_delta_shards_skipped_total": (
+            ("kind",),
+            "Shards a persist carried forward BY REFERENCE instead of "
+            "rewriting (per-shard checksum unchanged since the last "
+            "durable step), by persist kind. Always 0 for kind=full "
+            "(a full rewrites everything); for kind=delta this is the "
+            "savings counter — skipped/(skipped+written) is the fraction "
+            "of the tree that sat still between durable steps",
+        ),
+        "training_restore_bytes_total": (
+            ("source",),
+            "Payload bytes the restore ladder moved, by winning path "
+            "(source=peer|peer-sharded; storage/none restores don't "
+            "report wire bytes). With have-list transfer "
+            "(restore_with_fallback(have=True)) a warm restore moves "
+            "only changed shards, so bytes-per-restore here against "
+            "training_restore_total's rate is the "
+            "recovery-bytes-proportional-to-change number",
+        ),
     }
     # Gauges with label sets: name -> (label names, help). Values live in
     # _labeled_gauges keyed by the label-value tuple, in label-name order.
@@ -459,6 +489,11 @@ class Metrics:
     def _inc_labeled(self, name: str, *label_values: str) -> None:
         with self._lock:
             self._labeled_counters[name][tuple(label_values)] += 1
+
+    def _add_labeled(self, name: str, amount: int, *label_values: str) -> None:
+        """Add-by-N for byte-scale counters (_inc_labeled adds exactly 1)."""
+        with self._lock:
+            self._labeled_counters[name][tuple(label_values)] += int(amount)
 
     def labeled_counter_value(self, name: str, *label_values: str) -> int:
         with self._lock:
@@ -776,6 +811,29 @@ class Metrics:
             self._labeled_histograms["training_restore_seconds"][
                 (path, cause)
             ].observe(seconds)
+
+    def observe_checkpoint_persist_bytes(self, kind: str, nbytes: int,
+                                         shards_skipped: int) -> None:
+        """One persist's byte accounting: what hit the store (payloads +
+        manifest) and how many shards were carried forward by reference
+        (kind = full|delta, train/checkpoint.py delta persists)."""
+        self._add_labeled(
+            "training_checkpoint_persist_bytes_total", nbytes, kind)
+        if shards_skipped:
+            self._add_labeled(
+                "training_checkpoint_delta_shards_skipped_total",
+                shards_skipped, kind)
+
+    def set_delta_chain_depth(self, depth: int) -> None:
+        """Manifest-chain depth of the newest persist (0 = full). Bounded
+        by delta_full_every; a runaway value means the periodic-full
+        forcing is broken (runaway-chain-depth alert)."""
+        self.set_gauge("training_checkpoint_delta_chain_depth", float(depth))
+
+    def observe_restore_bytes(self, source: str, nbytes: int) -> None:
+        """Wire bytes one restore moved, by winning path (peer rungs only
+        — the storage path doesn't meter bytes)."""
+        self._add_labeled("training_restore_bytes_total", nbytes, source)
 
     def observe_admission_pump(self, seconds: float) -> None:
         """One policy-pump pass (wall time under the arbiter's lock)."""

@@ -259,11 +259,17 @@ class HeartbeatPublisher:
             self._peer_addr = str(addr)
         self._wake.set()
 
-    def record_restore(self, path: str, cause: str, seconds: float) -> None:
+    def record_restore(self, path: str, cause: str, seconds: float,
+                       bytes_moved: Optional[int] = None) -> None:
         """Which restore-ladder leg won and why (train/restore.py outcome):
-        published as the compact ``path:cause:seconds`` annotation the
-        controller turns into training_restore_total/seconds."""
-        self._restore = f"{path}:{cause}:{float(seconds):.3f}"
+        published as the compact ``path:cause:seconds[:bytes]`` annotation
+        the controller turns into training_restore_total/seconds (and
+        training_restore_bytes_total when the 4th field rides — peer
+        paths that metered their wire bytes)."""
+        rider = f"{path}:{cause}:{float(seconds):.3f}"
+        if bytes_moved is not None:
+            rider += f":{int(bytes_moved)}"
+        self._restore = rider
         self._wake.set()
 
     def beat_once(self) -> None:
@@ -405,14 +411,16 @@ def record_peer_address(addr: Optional[str]) -> None:
         publisher.record_peer_address(addr)
 
 
-def record_restore(path: str, cause: str, seconds: float) -> None:
+def record_restore(path: str, cause: str, seconds: float,
+                   bytes_moved: Optional[int] = None) -> None:
     """Training-loop API: this rank restored via ``path`` ("peer" /
-    "storage" / "none") for ``cause`` in ``seconds``. Published as the
-    restore-outcome lease annotation for operator metrics. A no-op without
-    an active publisher, like record_progress."""
+    "storage" / "none") for ``cause`` in ``seconds``, moving
+    ``bytes_moved`` wire bytes when the peer path metered them. Published
+    as the restore-outcome lease annotation for operator metrics. A no-op
+    without an active publisher, like record_progress."""
     publisher = _active
     if publisher is not None:
-        publisher.record_restore(path, cause, seconds)
+        publisher.record_restore(path, cause, seconds, bytes_moved)
 
 
 def stop() -> None:

@@ -24,6 +24,16 @@ endpoints:
   name order. One request instead of one per leaf — request overhead is
   what lets the storage path catch up on small states, and the frames are
   written straight from the per-shard cache (no bundled second copy).
+  ``&have=<name>:<checksum>,...`` (names URL-quoted) is the HAVE-LIST:
+  the restoring rank advertises the shards it already holds warm, and
+  the server omits every frame whose (name, checksum) matches — the
+  transfer moves only the delta. Matching is per NAME, not per bare
+  checksum: duplicate content (all-zero optimizer shards) shares a
+  checksum across distinct names, and bare-checksum filtering would
+  wrongly drop names the client does NOT hold. Older servers that
+  predate the parameter simply ignore it and serve the full bundle —
+  the client uses the frames it needs and discards the rest, so
+  mixed-version fleets stay correct (bytes un-saved, bytes never wrong).
 - ``GET /v1/manifest`` -> the meta payload plus ``owned``: the sorted
   shard names THIS survivor claims under the slice-scoped ownership
   partition (derived from the slice-local checkpoint topology — each
@@ -314,6 +324,22 @@ class SnapshotShardServer:
                     {"error": "step-rotated", "step": view.step}).encode())
                 return
             names = sorted(view.payloads)
+            have_raw = query.get("have", [None])[0]
+            if have_raw:
+                # Have-list filter (module doc): skip frames the client
+                # already holds byte-identically. Unparseable entries are
+                # ignored (never a reason to fail the transfer).
+                # (parse_qs already URL-decoded the value — the client
+                # quotes each name exactly once.)
+                have: Dict[str, str] = {}
+                for item in have_raw.split(","):
+                    name, sep, checksum = item.rpartition(":")
+                    if sep and name:
+                        have[name] = checksum
+                names = [
+                    n for n in names
+                    if have.get(n) != view.checksums[n]
+                ]
             total = sum(
                 4 + len(n.encode("utf-8")) + 8 + len(view.payloads[n])
                 for n in names
@@ -340,14 +366,30 @@ def start_shard_server(checkpoint_manager, host: str = "127.0.0.1",
     return it (``.address`` is the rider payload for record_peer_address).
     Each durable save warms the view cache so restoring peers never pay
     the encode+hash cost inline. With a slice topology
-    (``slice_index``/``num_slices``), the manifest claims only this
-    slice's stride of the shard namespace (partition_shard_names), so a
-    scatter-gather restore splits its transfer across survivor slices."""
+    (``slice_index``/``num_slices``), the manifest's owned set is
+    SLICE-DERIVED when the manager can report what its own (PR 11
+    per-slice) checkpoint stream physically persisted
+    (``persisted_shard_names`` — the delta-layout manifest names): a
+    slice claims exactly what it holds durable, so the claim tracks
+    reality through resharding instead of assuming a static stride.
+    Name striding (partition_shard_names) stays the fallback for
+    managers without a delta layout. Either way owned is a planning
+    hint, never an ACL — serving is unrestricted (module doc)."""
     owned = None
     if slice_index is not None and num_slices is not None and num_slices > 1:
         idx, n = int(slice_index), int(num_slices)
 
-        def owned(names, _idx=idx, _n=n):  # noqa: F811 — the seam value
+        def owned(names, _idx=idx, _n=n, _mgr=checkpoint_manager):  # noqa: F811
+            persisted = getattr(_mgr, "persisted_shard_names", None)
+            if persisted is not None:
+                try:
+                    held = set(persisted())
+                except Exception:  # noqa: BLE001 — a broken derivation
+                    # must degrade to the stride, not kill the manifest
+                    held = set()
+                derived = [name for name in sorted(names) if name in held]
+                if derived:
+                    return derived
             return partition_shard_names(names, _idx, _n)
 
     server = SnapshotShardServer(checkpoint_manager.host_snapshot,

@@ -28,6 +28,31 @@ orbax's async machinery (training still resumes immediately) and the
 durability listeners still fire only after ``wait_until_finished`` — but
 there is no host snapshot to serve peers from (``host_snapshot()`` is
 None and restores degrade to the storage path).
+
+**Delta persists** (``delta_persist=True``, ``EngineOptions.delta_persist``):
+between consecutive durable steps most shards are byte-identical (frozen
+embeddings, momentum on untouched layers), yet a plain persist rewrites
+all of them. In delta mode the persist worker keeps a content-addressed
+shard store under ``<dir>/delta/`` — ``shards/<sha256>.npy`` payload files
+plus one ``manifest-<step>.json`` per durable step mapping every shard
+name to its checksum — and writes only the payloads whose checksum is new,
+so persist bytes are O(changed shards); unchanged shards are carried
+forward BY REFERENCE (the manifest names a checksum an earlier persist
+already materialized). The chain is bounded: every ``delta_full_every``-th
+persist is a FULL persist (rewrites every payload, ``chain_depth`` resets
+to 0), and GC after each persist retains the newest ``max_to_keep``
+manifests plus the newest full manifest and deletes unreferenced payload
+files. The durability contract is unchanged: the manifest is written
+tmp-then-rename strictly after every payload it references exists, and
+``_mark_durable``/listeners fire only once the manifest rename returns —
+a crash anywhere earlier leaves the previous manifest the newest durable
+step, never a torn one. Restores resolve the newest manifest and verify
+every payload's sha256; a missing referenced payload
+(``delta-chain-broken``) or a hash mismatch (``delta-checksum-mismatch``)
+degrades the WHOLE tree to the newest verifying full manifest (then to
+orbax) with the cause recorded on ``last_delta_degradation`` — a
+per-shard mix of steps would be torn state. Multi-host saves (no host
+snapshot) fall through to the orbax path unchanged.
 """
 
 from __future__ import annotations
@@ -74,10 +99,23 @@ class HostSnapshot:
     taken_at: float = field(default_factory=time.monotonic)
 
 
+class _DeltaBroken(Exception):
+    """A delta manifest could not be fully resolved. ``cause`` is the
+    named degradation ("delta-chain-broken" for a missing/unreadable
+    referenced payload, "delta-checksum-mismatch" for bytes that no
+    longer hash to the manifest's record) — the whole tree degrades,
+    never a shard at a time."""
+
+    def __init__(self, cause: str, detail: str) -> None:
+        super().__init__(detail)
+        self.cause = cause
+
+
 class CheckpointManager:
     """Thin wrapper over orbax CheckpointManager bound to one TrainState
     sharding, so save/restore round-trips preserve the mesh layout —
-    plus the snapshot/persist split and the durability barrier."""
+    plus the snapshot/persist split, the durability barrier, and the
+    optional delta-persist store (module doc)."""
 
     def __init__(
         self,
@@ -88,6 +126,8 @@ class CheckpointManager:
         model_meta: Optional[dict] = None,
         async_persist: Optional[bool] = None,
         on_durable: Optional[Callable[[int], None]] = None,
+        delta_persist: bool = False,
+        delta_full_every: int = 5,
     ):
         import orbax.checkpoint as ocp
 
@@ -134,6 +174,32 @@ class CheckpointManager:
         # and the storage write — the crash-in-persist-window regressions
         # block or raise here to hold a step non-durable deterministically.
         self._persist_gate: Optional[Callable[[int], None]] = None
+        # Delta-persist store (module doc). The WRITE side is flag-gated
+        # (default OFF keeps every pre-existing seeded tier byte-identical
+        # — no delta/ directory ever appears); the READ side keys on the
+        # layout's presence so a restarted process restores a delta step
+        # regardless of its own flag.
+        self.delta_persist = bool(delta_persist)
+        self.delta_full_every = max(1, int(delta_full_every))
+        self._max_to_keep = max(1, int(max_to_keep))
+        self._delta_dir = os.path.join(os.path.abspath(directory), "delta")
+        self._delta_shards_dir = os.path.join(self._delta_dir, "shards")
+        self._delta_persist_count = 0
+        # Stats of the most recent delta/full persist this process
+        # finalized: {"kind", "step", "chain_depth", "bytes_written",
+        # "shards_written", "shards_skipped"} — the bench/test surface
+        # behind training_checkpoint_persist_bytes_total.
+        self.last_persist_info: Optional[dict] = None
+        # The named cause when the most recent restore_latest() degraded
+        # off a delta manifest ("delta-chain-broken" /
+        # "delta-checksum-mismatch"); None on clean restores. Read by
+        # train/restore.py to stamp the RestoreOutcome.
+        self.last_delta_degradation: Optional[str] = None
+        # Seeded-chaos seam (cluster/chaos.py RestoreFaultInjector):
+        # consulted once per manifest payload read, op "delta-shard",
+        # peer index 0 (storage has no peers; the index keeps the
+        # fault_log entry shape uniform).
+        self.restore_fault_injector = None
 
     # ----------------------------------------------------------- sidecar
     def _write_meta(self) -> None:
@@ -161,6 +227,246 @@ class CheckpointManager:
                 f"{mismatched} — refusing to mix checkpoints trained "
                 "under different head/layer geometries in one directory"
             )
+
+    # ----------------------------------------------------- delta store
+    def _delta_manifest_path(self, step: int) -> str:
+        return os.path.join(self._delta_dir, f"manifest-{int(step)}.json")
+
+    def _delta_manifest_steps(self) -> List[int]:
+        """Sorted steps with a (finalized) manifest on disk."""
+        try:
+            entries = os.listdir(self._delta_dir)
+        except OSError:
+            return []
+        steps = []
+        for entry in entries:
+            if entry.startswith("manifest-") and entry.endswith(".json"):
+                try:
+                    steps.append(int(entry[len("manifest-"):-len(".json")]))
+                except ValueError:
+                    continue
+        return sorted(steps)
+
+    def _read_delta_manifest(self, step: int) -> Optional[dict]:
+        import json
+
+        try:
+            with open(self._delta_manifest_path(step)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _delta_latest_step(self) -> Optional[int]:
+        steps = self._delta_manifest_steps()
+        return steps[-1] if steps else None
+
+    def persisted_shard_names(self):
+        """Sorted shard names the newest delta manifest references — what
+        this manager's checkpoint stream PHYSICALLY holds. The
+        slice-derived ownership source for ``/v1/manifest`` (PR 11
+        per-slice checkpoint dirs: the slice claims what its own stream
+        persisted, not a name stride). Empty tuple without a delta
+        layout, which tells the shard server to fall back to striding."""
+        step = self._delta_latest_step()
+        if step is None:
+            return ()
+        manifest = self._read_delta_manifest(step)
+        if not manifest:
+            return ()
+        return tuple(sorted(manifest.get("shards", ())))
+
+    def delta_chain_depth(self) -> Optional[int]:
+        """Chain depth of the newest manifest (0 = full persist), the
+        ``training_checkpoint_delta_chain_depth`` gauge feed; None
+        without a delta layout."""
+        step = self._delta_latest_step()
+        if step is None:
+            return None
+        manifest = self._read_delta_manifest(step)
+        if not manifest:
+            return None
+        return int(manifest.get("chain_depth", 0))
+
+    @staticmethod
+    def _write_file_atomic(path: str, data: bytes) -> None:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def _persist_delta(self, step: int, tree: Any) -> None:
+        """One delta-mode persist, on the worker thread (or inline in
+        sync mode). Ordering IS the durability contract: every payload
+        the manifest references is renamed into place before the
+        manifest itself is, so a crash at any point leaves the previous
+        manifest the newest durable step — never a torn one. The caller
+        fires _mark_durable after this returns."""
+        import json
+
+        from ..runtime.shard_server import (
+            encode_shard,
+            flatten_tree,
+            shard_checksum,
+        )
+
+        os.makedirs(self._delta_shards_dir, exist_ok=True)
+        flat = flatten_tree(tree)
+        payloads = {name: encode_shard(leaf) for name, leaf in flat.items()}
+        checksums = {name: shard_checksum(p) for name, p in payloads.items()}
+        prev_step = self._delta_latest_step()
+        prev = (self._read_delta_manifest(prev_step)
+                if prev_step is not None else None)
+        self._delta_persist_count += 1
+        # Chain bound: the first persist of a lineage (or of a restarted
+        # process that inherited one at the bound) and every
+        # delta_full_every-th persist rewrite EVERYTHING.
+        full = (
+            prev is None
+            or (self._delta_persist_count - 1) % self.delta_full_every == 0
+            or int(prev.get("chain_depth", 0)) + 1 >= self.delta_full_every
+        )
+        prev_sums = ({} if full or not prev
+                     else {n: e["checksum"]
+                           for n, e in prev.get("shards", {}).items()})
+        written = skipped = bytes_written = 0
+        for name in sorted(flat):
+            payload = payloads[name]
+            if prev_sums.get(name) == checksums[name]:
+                # Unchanged since the last durable step: carried forward
+                # by reference — the payload file already exists.
+                skipped += 1
+                continue
+            path = os.path.join(
+                self._delta_shards_dir, f"{checksums[name]}.npy")
+            if full or not os.path.exists(path):
+                self._write_file_atomic(path, payload)
+            written += 1
+            bytes_written += len(payload)
+        chain_depth = 0 if full else int(prev.get("chain_depth", 0)) + 1
+        manifest = {
+            "step": int(step),
+            "kind": "full" if full else "delta",
+            "chain_depth": chain_depth,
+            "model_meta": self._model_meta,
+            "shards": {
+                name: {"checksum": checksums[name],
+                       "bytes": len(payloads[name])}
+                for name in sorted(flat)
+            },
+        }
+        manifest_bytes = json.dumps(manifest, sort_keys=True).encode()
+        self._write_file_atomic(
+            self._delta_manifest_path(step), manifest_bytes)
+        self._delta_gc()
+        self.last_persist_info = {
+            "kind": manifest["kind"],
+            "step": int(step),
+            "chain_depth": chain_depth,
+            "bytes_written": bytes_written + len(manifest_bytes),
+            "shards_written": written,
+            "shards_skipped": skipped,
+        }
+        try:
+            from ..metrics import METRICS
+
+            METRICS.observe_checkpoint_persist_bytes(
+                manifest["kind"], bytes_written + len(manifest_bytes),
+                skipped)
+            METRICS.set_delta_chain_depth(chain_depth)
+        except Exception:  # noqa: BLE001 — telemetry never gates durability
+            pass
+
+    def _delta_gc(self) -> None:
+        """Retention: the newest max_to_keep manifests, PLUS the newest
+        full manifest if none of those is full (the degradation target
+        must survive), then every payload file no retained manifest
+        references is deleted."""
+        steps = self._delta_manifest_steps()
+        if not steps:
+            return
+        manifests = {s: self._read_delta_manifest(s) for s in steps}
+        keep = set(steps[-self._max_to_keep:])
+        if not any((manifests[s] or {}).get("kind") == "full"
+                   for s in keep):
+            fulls = [s for s in steps
+                     if (manifests[s] or {}).get("kind") == "full"]
+            if fulls:
+                keep.add(fulls[-1])
+        referenced = set()
+        for s in keep:
+            for entry in (manifests[s] or {}).get("shards", {}).values():
+                referenced.add(entry["checksum"])
+        for s in steps:
+            if s not in keep:
+                try:
+                    os.remove(self._delta_manifest_path(s))
+                except OSError:
+                    pass
+        try:
+            for entry in os.listdir(self._delta_shards_dir):
+                if entry.endswith(".npy") and \
+                        entry[:-len(".npy")] not in referenced:
+                    os.remove(os.path.join(self._delta_shards_dir, entry))
+        except OSError:
+            pass
+
+    def _resolve_delta(self, state, step: int):
+        """Read + sha256-verify + reassemble one manifest's full tree.
+        Raises :class:`_DeltaBroken` on ANY shortfall — the caller owns
+        degradation to an older full manifest, never a partial mix."""
+        import numpy as np
+
+        from ..runtime.shard_server import decode_shard, shard_checksum
+
+        manifest = self._read_delta_manifest(step)
+        if not manifest or "shards" not in manifest:
+            raise _DeltaBroken(
+                "delta-chain-broken",
+                f"manifest for step {step} unreadable")
+        injector = self.restore_fault_injector
+        shards = {}
+        for name in sorted(manifest["shards"]):
+            entry = manifest["shards"][name]
+            kind = (injector.fault_for("delta-shard", 0)
+                    if injector is not None else None)
+            if kind == "delta-missing-shard":
+                raise _DeltaBroken(
+                    "delta-chain-broken",
+                    f"injected: payload for {name} missing from the store")
+            path = os.path.join(
+                self._delta_shards_dir, f"{entry['checksum']}.npy")
+            try:
+                with open(path, "rb") as f:
+                    payload = f.read()
+            except OSError as err:
+                raise _DeltaBroken(
+                    "delta-chain-broken",
+                    f"shard {name} payload {entry['checksum']} missing "
+                    f"from the store ({err})") from err
+            if kind == "delta-corrupt-shard":
+                payload = payload[: max(0, len(payload) // 2)]
+            if shard_checksum(payload) != entry["checksum"]:
+                raise _DeltaBroken(
+                    "delta-checksum-mismatch",
+                    f"shard {name} failed sha256 verification against "
+                    f"the step-{step} manifest")
+            shards[name] = decode_shard(payload)
+        abstract = self.abstract_state(state)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(abstract)
+        leaves = []
+        for key_path, leaf in flat:
+            name = jax.tree_util.keystr(key_path)
+            if name not in shards:
+                raise _DeltaBroken(
+                    "delta-chain-broken",
+                    f"manifest for step {step} lacks shard {name}")
+            value = np.asarray(shards[name]).astype(leaf.dtype)
+            sharding = getattr(leaf, "sharding", None)
+            leaves.append(
+                jax.device_put(value, sharding) if sharding is not None
+                else jax.numpy.asarray(value)
+            )
+        return jax.tree_util.tree_unflatten(treedef, leaves)
 
     # ------------------------------------------------------ durability
     def add_durability_listener(self, cb: Callable[[int], None]) -> None:
@@ -213,8 +519,13 @@ class CheckpointManager:
                             args=self._ocp.args.StandardSave(tree),
                             force=True,
                         )
-                    # Both paths: durable only once orbax finalizes.
-                    self._mgr.wait_until_finished()
+                    if kind == "delta":
+                        # Delta-mode host-snapshot path: changed payloads
+                        # then the manifest (its rename IS the finalize).
+                        self._persist_delta(step, tree)
+                    else:
+                        # Orbax paths: durable only once orbax finalizes.
+                        self._mgr.wait_until_finished()
                 except Exception:  # noqa: BLE001
                     self._persist_errors += 1
                     log.exception(
@@ -263,7 +574,8 @@ class CheckpointManager:
         already on disk is a no-op (a final flush after a periodic save
         lands on the same step)."""
         step = int(jax.device_get(state.step))
-        if self._mgr.latest_step() == step or self._last_snapshot_step == step:
+        if self._mgr.latest_step() == step or self._last_snapshot_step == step \
+                or (self.delta_persist and self._delta_latest_step() == step):
             return False
         if not force and not self._mgr.should_save(step):
             return False
@@ -277,9 +589,13 @@ class CheckpointManager:
             self._snapshot = HostSnapshot(
                 step=step, tree=host_tree, model_meta=self._model_meta
             )
+            persist_kind = "delta" if self.delta_persist else "save"
             if self.async_persist:
                 self._ensure_worker()
-                self._persist_queue.put(("save", step, host_tree, t0))
+                self._persist_queue.put((persist_kind, step, host_tree, t0))
+            elif self.delta_persist:
+                self._persist_delta(step, host_tree)
+                self._mark_durable(step, time.perf_counter() - t0)
             else:
                 self._mgr.save(
                     step, args=self._ocp.args.StandardSave(host_tree),
@@ -306,7 +622,13 @@ class CheckpointManager:
 
     # --------------------------------------------------------- restore
     def latest_step(self) -> Optional[int]:
-        return self._mgr.latest_step()
+        orbax_step = self._mgr.latest_step()
+        delta_step = self._delta_latest_step()
+        if delta_step is None:
+            return orbax_step
+        if orbax_step is None:
+            return delta_step
+        return max(orbax_step, delta_step)
 
     def abstract_state(self, state):
         """`state`'s structure as ShapeDtypeStructs carrying the target
@@ -329,15 +651,48 @@ class CheckpointManager:
         """Restore the newest checkpoint into `state`'s structure/shardings;
         returns (state, step) — (input unchanged, None) when no checkpoint
         exists yet (first boot of the job). This is the STORAGE leg of the
-        restore ladder; train/restore.py composes it with the peer path."""
-        step = self._mgr.latest_step()
-        if step is None:
+        restore ladder; train/restore.py composes it with the peer path.
+
+        Delta layouts resolve first whenever their newest manifest is at
+        least as fresh as orbax (keyed on the layout's PRESENCE, not this
+        manager's flag — a flag-off restart must still restore what a
+        flag-on predecessor persisted). A broken resolution degrades the
+        whole tree: newest verifying FULL manifest, then orbax — with the
+        first named cause kept on ``last_delta_degradation``."""
+        self.last_delta_degradation = None
+        orbax_step = self._mgr.latest_step()
+        delta_step = self._delta_latest_step()
+        if delta_step is not None and (
+                orbax_step is None or delta_step >= orbax_step):
+            self._validate_meta()
+            try:
+                return self._resolve_delta(state, delta_step), delta_step
+            except _DeltaBroken as err:
+                self.last_delta_degradation = err.cause
+                log.warning(
+                    "delta restore of step %s degraded (%s: %s); falling "
+                    "back to the newest full manifest", delta_step,
+                    err.cause, err)
+            fulls = [
+                s for s in self._delta_manifest_steps()
+                if s != delta_step
+                and (self._read_delta_manifest(s) or {}).get("kind") == "full"
+            ]
+            for s in reversed(fulls):
+                try:
+                    return self._resolve_delta(state, s), s
+                except _DeltaBroken as err:
+                    log.warning(
+                        "full manifest at step %s also broken (%s); "
+                        "continuing down", s, err.cause)
+        if orbax_step is None:
             return state, None
         self._validate_meta()
         restored = self._mgr.restore(
-            step, args=self._ocp.args.StandardRestore(self.abstract_state(state))
+            orbax_step,
+            args=self._ocp.args.StandardRestore(self.abstract_state(state))
         )
-        return restored, step
+        return restored, orbax_step
 
     # -------------------------------------------------------- shutdown
     def wait(self) -> None:

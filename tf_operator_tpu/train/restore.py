@@ -34,6 +34,27 @@ orbax read; the staleness arbitration is skipped). Peers all failing
 still degrades to storage with the cause named: warm start is an
 optimization contract, never a correctness gate.
 
+With ``have=True`` (the delta-transfer contract): the restoring rank
+hashes its CURRENT in-memory tree into a have-list ``{shard: checksum}``
+and advertises it — the scatter-gather planner prunes every shard whose
+checksum matches the winning peer's meta (those leaves are already
+byte-identical locally and are taken from the local tree, attributed to
+source ``"local"``), and the single-peer bundle wire passes the list as
+``/v1/bundle?have=`` so the server filters frames it would otherwise
+ship. Older peers ignore the parameter and serve the full bundle; the
+client keeps the frames it needs and discards the rest, so a
+mixed-version fleet loses only the byte savings, never correctness.
+``RestoreOutcome.bytes_moved`` counts the payload bytes that actually
+crossed the wire on the peer path (the
+``training_restore_bytes_total{source}`` feed, and the 4th field of the
+restore heartbeat rider).
+
+The STORAGE rung understands delta-checkpoint layouts transparently
+(train/checkpoint.py delta persists): ``restore_latest`` resolves the
+newest manifest, and a broken chain degrades the whole tree to the
+newest full step with the named cause — ``delta-chain-broken`` /
+``delta-checksum-mismatch`` — surfaced on the outcome here.
+
 Degradations and their recorded causes (metrics label + fault log):
 
 - ``no-peers``           — no addresses advertised (peer path not enabled,
@@ -90,9 +111,31 @@ class RestoreOutcome:
     cause: str         # "ok" on the happy paths, degradation cause otherwise
     seconds: float
     peer: Optional[str] = None  # winning peer address, peer path only
-    # Scatter-gather attribution: shard counts per source ("<host:port>"
-    # or "storage" for per-shard fills). None outside the sharded path.
+    # Scatter-gather attribution: shard counts per source ("<host:port>",
+    # "storage" for per-shard fills, "local" for have-list matches taken
+    # from the restoring rank's own tree). None outside the sharded path.
     sources: Optional[Dict[str, int]] = None
+    # Payload bytes that crossed the wire on the peer path (have-list
+    # pruning makes this the number worth watching). None when unknown
+    # (storage/none paths).
+    bytes_moved: Optional[int] = None
+
+
+def have_list(tree) -> Dict[str, str]:
+    """``{shard name: sha256 of its encoded payload}`` of a local tree —
+    the have-list a restoring rank advertises. Uses the identical
+    encode-then-hash the shard server uses, so a match PROVES the local
+    bytes equal the peer's."""
+    from ..runtime.shard_server import (
+        encode_shard,
+        flatten_tree,
+        shard_checksum,
+    )
+
+    return {
+        name: shard_checksum(encode_shard(leaf))
+        for name, leaf in flatten_tree(tree).items()
+    }
 
 
 # ---------------------------------------------------------------- transport
@@ -186,16 +229,23 @@ def _assemble(abstract, shards: Dict[str, Any]):
 
 def _restore_from_peer(state, ckpt, peer: str, peer_index: int, meta: dict, *,
                        fetcher, timeout: float, retries: int, backoff: float,
-                       fault_injector, sleep) -> Any:
-    """Fetch + verify + reassemble one peer's snapshot. Raises on any
-    failure; the caller owns fallback."""
+                       fault_injector, sleep,
+                       have: Optional[Dict[str, str]] = None,
+                       ) -> Tuple[Any, int]:
+    """Fetch + verify + reassemble one peer's snapshot; returns
+    (restored state, payload bytes moved). Raises on any failure; the
+    caller owns fallback. ``have`` prunes the transfer: shards whose
+    local checksum matches the peer meta are taken from the local tree
+    (byte-identical by construction) and never requested."""
     from urllib.parse import quote
 
     from ..runtime.shard_server import decode_shard, shard_checksum
 
     step = int(meta["step"])
+    bytes_moved = 0
 
     def fetch_one(name: str):
+        nonlocal bytes_moved
         expect = meta["shards"][name]["checksum"]
         status, _, body = _fetch_with_retry(
             fetcher, peer, peer_index,
@@ -209,17 +259,34 @@ def _restore_from_peer(state, ckpt, peer: str, peer_index: int, meta: dict, *,
             raise ChecksumMismatch(
                 f"shard {name} from {peer} failed sha256 verification"
             )
+        bytes_moved += len(body)
         return decode_shard(body)
 
     names = sorted(meta["shards"])
     shards: Dict[str, Any] = {}
+    needed = names
+    if have:
+        import numpy as np
+
+        from ..runtime.shard_server import flatten_tree
+
+        local_flat = flatten_tree(state)
+        needed = []
+        for name in names:
+            if have.get(name) == meta["shards"][name]["checksum"] \
+                    and name in local_flat:
+                # Byte-identical locally (same encode, same sha256):
+                # the warm leaf IS the restored value.
+                shards[name] = np.asarray(local_flat[name])
+            else:
+                needed.append(name)
     if fault_injector is not None:
         # Sorted, sequential, per-shard: the seeded fault injector counts
         # calls, and byte-equal replay needs the same request sequence
         # every run.
-        for name in names:
+        for name in needed:
             shards[name] = fetch_one(name)
-        return _assemble(ckpt.abstract_state(state), shards)
+        return _assemble(ckpt.abstract_state(state), shards), bytes_moved
 
     # Production path: one bundle request for the whole tree — per-request
     # overhead is what lets the storage path catch up on small states.
@@ -227,20 +294,28 @@ def _restore_from_peer(state, ckpt, peer: str, peer_index: int, meta: dict, *,
     # integrity semantics match the per-shard wire exactly.
     from ..runtime.shard_server import parse_bundle
 
+    bundle_path = f"/v1/bundle?step={step}"
+    if have and len(needed) < len(names):
+        # Advertise what we hold; a server that understands the
+        # parameter omits the matching frames, an older one ignores it
+        # (we use only the needed frames either way).
+        matched = [n for n in names if n not in needed]
+        bundle_path += "&have=" + ",".join(
+            f"{quote(n, safe='')}:{have[n]}" for n in matched)
     status, _, body = _fetch_with_retry(
-        fetcher, peer, peer_index, f"/v1/bundle?step={step}",
+        fetcher, peer, peer_index, bundle_path,
         op="bundle", timeout=timeout, retries=retries, backoff=backoff,
         fault_injector=fault_injector, sleep=sleep,
     )
     if status == 404:
         # Older peer without the bundle endpoint: per-shard wire.
-        for name in names:
+        for name in needed:
             shards[name] = fetch_one(name)
-        return _assemble(ckpt.abstract_state(state), shards)
+        return _assemble(ckpt.abstract_state(state), shards), bytes_moved
     if status != 200:
         raise OSError(f"peer {peer} returned {status} for bundle")
     frames = parse_bundle(body)
-    for name in names:
+    for name in needed:
         payload = frames.get(name)
         if payload is None:
             raise OSError(f"peer {peer} bundle missing shard {name}")
@@ -248,8 +323,9 @@ def _restore_from_peer(state, ckpt, peer: str, peer_index: int, meta: dict, *,
             raise ChecksumMismatch(
                 f"shard {name} from {peer} failed sha256 verification"
             )
+        bytes_moved += len(payload)
         shards[name] = decode_shard(payload)
-    return _assemble(ckpt.abstract_state(state), shards)
+    return _assemble(ckpt.abstract_state(state), shards), bytes_moved
 
 
 class ChecksumMismatch(OSError):
@@ -303,7 +379,7 @@ def _fetch_one_shard(fetcher, peer: str, peer_index: int, name: str,
         raise ChecksumMismatch(
             f"shard {name} from {peer} failed sha256 verification"
         )
-    return decode_shard(body)
+    return decode_shard(body), len(body)
 
 
 def _storage_shard_fill(state, ckpt, step: int, names: Sequence[str]):
@@ -332,22 +408,28 @@ def _storage_shard_fill(state, ckpt, step: int, names: Sequence[str]):
 
 def _restore_sharded(state, ckpt, candidates, step: int, *, fetcher,
                      timeout: float, retries: int, backoff: float,
-                     fault_injector, sleep):
+                     fault_injector, sleep,
+                     have: Optional[Dict[str, str]] = None):
     """Scatter-gather restore against every candidate peer at ``step``.
 
     ``candidates`` is ``[(peer_index, peer, manifest)]`` in discovery
     order. Loops plan -> fetch -> re-plan: any peer failure marks that
     peer dead for the rest of the restore and its unfetched shards are
     re-planned against the survivors; shards that run out of peers are
-    filled per-shard from same-step storage. Returns
-    ``(assembled_state, sources)`` where sources counts shards per
-    serving address (plus "storage" for fills)."""
+    filled per-shard from same-step storage. ``have`` prunes the plan
+    BEFORE any fetch: shards whose local checksum matches the winning
+    manifest come from the local tree (source "local", zero wire bytes).
+    Returns ``(assembled_state, sources, bytes_moved)`` where sources
+    counts shards per serving address (plus "storage" for fills and
+    "local" for have-list matches)."""
     live = {}
     all_names = None
+    reference_manifest = None
     for index, peer, manifest in candidates:
         names = sorted(manifest["shards"])
         if all_names is None:
             all_names = names
+            reference_manifest = manifest
         owned = manifest.get("owned")
         live[index] = {
             "peer": peer,
@@ -357,30 +439,49 @@ def _restore_sharded(state, ckpt, candidates, step: int, *, fetcher,
         }
     shards: Dict[str, Any] = {}
     sources: Dict[str, int] = {}
+    bytes_moved = 0
     remaining = list(all_names or ())
+    if have and reference_manifest is not None:
+        import numpy as np
+
+        from ..runtime.shard_server import flatten_tree
+
+        local_flat = flatten_tree(state)
+        pruned = []
+        for name in remaining:
+            expect = reference_manifest["shards"][name]["checksum"]
+            if have.get(name) == expect and name in local_flat:
+                shards[name] = np.asarray(local_flat[name])
+                sources["local"] = sources.get("local", 0) + 1
+            else:
+                pruned.append(name)
+        remaining = pruned
 
     def fetch_group(index: int, names: Sequence[str]):
         """Sequentially pull one peer's assigned shards. Returns
-        (fetched, unfetched) — a failure abandons the rest of the group
-        (the peer is presumed dead; the re-planner owns its shards)."""
+        (fetched, unfetched, group_bytes) — a failure abandons the rest
+        of the group (the peer is presumed dead; the re-planner owns its
+        shards)."""
         entry = live[index]
         fetched: Dict[str, Any] = {}
         unfetched: List[str] = []
+        group_bytes = 0
         for pos, name in enumerate(names):
             try:
-                fetched[name] = _fetch_one_shard(
+                fetched[name], nbytes = _fetch_one_shard(
                     fetcher, entry["peer"], index, name, step,
                     entry["manifest"]["shards"][name]["checksum"],
                     timeout=timeout, retries=retries, backoff=backoff,
                     fault_injector=fault_injector, sleep=sleep,
                 )
+                group_bytes += nbytes
             except (OSError, TimeoutError, ValueError, KeyError) as err:
                 log.warning("peer %s lost mid-scatter (%s); re-planning "
                             "%d shard(s)", entry["peer"], err,
                             len(names) - pos)
                 unfetched = list(names[pos:])
                 break
-        return fetched, unfetched
+        return fetched, unfetched, group_bytes
 
     while remaining:
         if not live:
@@ -409,8 +510,9 @@ def _restore_sharded(state, ckpt, candidates, step: int, *, fetcher,
                     for i in sorted(groups)
                 ]
                 results = [(i, f.result()) for i, f in futures]
-        for index, (fetched, unfetched) in results:
+        for index, (fetched, unfetched, group_bytes) in results:
             shards.update(fetched)
+            bytes_moved += group_bytes
             if fetched:
                 peer = live[index]["peer"]
                 sources[peer] = sources.get(peer, 0) + len(fetched)
@@ -420,7 +522,7 @@ def _restore_sharded(state, ckpt, candidates, step: int, *, fetcher,
         for index in dead:
             live.pop(index, None)
         remaining = failed
-    return _assemble(ckpt.abstract_state(state), shards), sources
+    return _assemble(ckpt.abstract_state(state), shards), sources, bytes_moved
 
 
 def restore_with_fallback(
@@ -437,6 +539,7 @@ def restore_with_fallback(
     sleep: Callable[[float], None] = time.sleep,
     sharded: bool = False,
     warm_start: bool = False,
+    have: bool = False,
 ) -> RestoreOutcome:
     """Run the restore ladder (module doc) and return the outcome.
 
@@ -446,13 +549,22 @@ def restore_with_fallback(
     determinism seams. ``sharded`` turns the peer rung into the
     scatter-gather plan (module doc); ``warm_start`` is the elastic-grow
     contract — skip the storage staleness probe entirely so the happy
-    path performs zero storage reads.
+    path performs zero storage reads. ``have`` advertises the current
+    in-memory tree's per-shard checksums so the peer rung transfers only
+    the shards that actually differ (module doc).
     """
     from .checkpoint import geometry_mismatch
 
     t0 = time.perf_counter()
     if model_meta is None:
         model_meta = getattr(ckpt, "_model_meta", None)
+    if fault_injector is not None and \
+            hasattr(ckpt, "restore_fault_injector"):
+        # Hand the seeded injector to the storage rung too: delta-chain
+        # fault kinds (delta-missing-shard / delta-corrupt-shard) fire
+        # inside CheckpointManager's manifest resolution.
+        ckpt.restore_fault_injector = fault_injector
+    have_map: Optional[Dict[str, str]] = have_list(state) if have else None
     # Warm start: don't even ask storage what it has. Survivor snapshots
     # are the freshest state a grown gang can see, and the latest_step()
     # probe is itself a storage read the zero-read contract forbids.
@@ -547,11 +659,11 @@ def restore_with_fallback(
                 if int(entry[2]["step"]) == best_step
             ]
             try:
-                restored, sources = _restore_sharded(
+                restored, sources, moved = _restore_sharded(
                     state, ckpt, candidates, best_step,
                     fetcher=fetcher, timeout=timeout, retries=retries,
                     backoff=backoff, fault_injector=fault_injector,
-                    sleep=sleep,
+                    sleep=sleep, have=have_map,
                 )
             except GeometryMismatch:
                 raise
@@ -570,7 +682,7 @@ def restore_with_fallback(
                     cause=("storage-shard-fill" if "storage" in sources
                            else "ok"),
                     seconds=time.perf_counter() - t0, peer=best[1],
-                    sources=sources,
+                    sources=sources, bytes_moved=moved,
                 )
                 _observe(outcome)
                 return outcome
@@ -585,11 +697,11 @@ def restore_with_fallback(
             )
         else:
             try:
-                restored = _restore_from_peer(
+                restored, moved = _restore_from_peer(
                     state, ckpt, peer, index, meta,
                     fetcher=fetcher, timeout=timeout, retries=retries,
                     backoff=backoff, fault_injector=fault_injector,
-                    sleep=sleep,
+                    sleep=sleep, have=have_map,
                 )
             except GeometryMismatch:
                 raise
@@ -603,20 +715,26 @@ def restore_with_fallback(
                 outcome = RestoreOutcome(
                     state=restored, step=peer_step, path="peer", cause="ok",
                     seconds=time.perf_counter() - t0, peer=peer,
+                    bytes_moved=moved,
                 )
                 _observe(outcome)
                 return outcome
 
     restored, step = ckpt.restore_latest(state)
+    # A delta manifest chain that degraded to an older full step names its
+    # cause (delta-chain-broken / delta-checksum-mismatch); surface it over
+    # the generic peer-rung cause so operators see why storage went stale.
+    delta_cause = getattr(ckpt, "last_delta_degradation", None)
     if step is None:
         outcome = RestoreOutcome(
-            state=state, step=None, path="none", cause=cause,
+            state=state, step=None, path="none", cause=delta_cause or cause,
             seconds=time.perf_counter() - t0,
         )
     else:
         outcome = RestoreOutcome(
             state=restored, step=step, path="storage",
-            cause="ok" if cause == "no-peers" and not peers else cause,
+            cause=delta_cause or (
+                "ok" if cause == "no-peers" and not peers else cause),
             seconds=time.perf_counter() - t0,
         )
     _observe(outcome)
@@ -628,5 +746,7 @@ def _observe(outcome: RestoreOutcome) -> None:
         from ..metrics import METRICS
 
         METRICS.observe_restore(outcome.path, outcome.cause, outcome.seconds)
+        if outcome.bytes_moved is not None:
+            METRICS.observe_restore_bytes(outcome.path, outcome.bytes_moved)
     except Exception:  # noqa: BLE001 — telemetry never gates a restore
         pass
